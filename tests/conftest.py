@@ -1,0 +1,54 @@
+"""Test harness: fake 8-device CPU mesh.
+
+The reference runs all "distributed" tests on a local[2] SparkSession
+(utils/.../test/TestSparkContext.scala:35-80). Our equivalent: force the CPU
+platform with 8 virtual host devices so every sharding/collective code path
+executes in CI without TPUs. Must run before jax initializes a backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override any preset TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Some environments pre-register an accelerator backend at interpreter start
+# (overriding JAX_PLATFORMS); force the CPU platform again at config level
+# before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from transmogrifai_tpu.uid import UID  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_uid():
+    UID.reset()
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    from transmogrifai_tpu.parallel import make_mesh, use_mesh
+    ctx = make_mesh(n_data=8)
+    with use_mesh(ctx):
+        yield ctx
+
+
+@pytest.fixture
+def mesh4x2():
+    from transmogrifai_tpu.parallel import make_mesh, use_mesh
+    ctx = make_mesh(n_data=4, n_model=2)
+    with use_mesh(ctx):
+        yield ctx
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,102 @@
+"""Host/device frame tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.parallel import make_mesh, use_mesh
+from transmogrifai_tpu.parallel.collectives import mesh_reduce_stats
+
+
+def _frame():
+    return fr.HostFrame.from_dict({
+        "age": (ft.Real, [32.0, None, 45.0, 18.0]),
+        "name": (ft.Text, ["ann", "bob", None, "dee"]),
+        "survived": (ft.RealNN, [1.0, 0.0, 0.0, 1.0]),
+        "cls": (ft.PickList, ["a", "b", "a", None]),
+    })
+
+
+def test_host_frame_basics():
+    f = _frame()
+    assert f.n_rows == 4
+    assert set(f.names()) == {"age", "name", "survived", "cls"}
+    assert f["age"].mask.tolist() == [True, False, True, True]
+    assert f.row(1)["age"] is None
+    assert f.row(0)["name"] == "ann"
+    g = f.drop(["name"])
+    assert "name" not in g
+    h = f.take(np.array([0, 2]))
+    assert h.n_rows == 2
+    assert h.row(1)["age"] == 45.0
+
+
+def test_ragged_frame_rejected():
+    with pytest.raises(ValueError):
+        fr.HostFrame({
+            "a": fr.HostColumn.from_values(ft.Real, [1.0, 2.0]),
+            "b": fr.HostColumn.from_values(ft.Real, [1.0]),
+        })
+
+
+def test_non_nullable_column_rejected():
+    with pytest.raises(ft.FeatureTypeValueError):
+        fr.HostColumn.from_values(ft.RealNN, [1.0, None])
+
+
+def test_numeric_column_to_device():
+    col = fr.HostColumn.from_values(ft.Real, [1.0, None, 3.0])
+    dev = fr.NumericColumn.from_host(col)
+    assert dev.values.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(dev.values), [1.0, 0.0, 3.0])
+    np.testing.assert_allclose(np.asarray(dev.mask), [1.0, 0.0, 1.0])
+    # pytree round trip under jit
+    out = jax.jit(lambda c: fr.NumericColumn(c.values * 2, c.mask))(dev)
+    np.testing.assert_allclose(np.asarray(out.values), [2.0, 0.0, 6.0])
+
+
+def test_vector_column_metadata_survives_jit():
+    from transmogrifai_tpu.vector_metadata import VectorMetadata, VectorColumnMetadata
+    meta = VectorMetadata("v", (
+        VectorColumnMetadata(("age",), ("Real",), index=0),
+        VectorColumnMetadata(("age",), ("Real",), indicator_value="NullIndicatorValue", index=1),
+    ))
+    vc = fr.VectorColumn(jnp.ones((3, 2)), meta)
+    out = jax.jit(lambda v: fr.VectorColumn(v.values + 1, v.metadata))(vc)
+    assert out.metadata is meta
+    assert out.metadata.col_names()[0].startswith("age")
+
+
+def test_codes_column_pytree():
+    cc = fr.CodesColumn(jnp.array([0, 1, -1], dtype=jnp.int32), ("a", "b"))
+    out = jax.jit(lambda c: fr.CodesColumn(c.codes + 1, c.vocab))(cc)
+    assert out.vocab == ("a", "b")
+    assert np.asarray(out.codes).tolist() == [1, 2, 0]
+
+
+def test_mesh_reduce_stats_masked_mean(mesh8):
+    # monoid stats: (sum, count) over row-sharded masked column == host mean
+    n = 40
+    vals = np.arange(n, dtype=np.float32)
+    mask = (np.arange(n) % 3 != 0).astype(np.float32)
+    v, m = jnp.asarray(vals), jnp.asarray(mask)
+
+    def local_stats(v, m):
+        return {"sum": jnp.sum(v * m), "count": jnp.sum(m)}
+
+    stats = mesh_reduce_stats(mesh8, local_stats, v, m)
+    expect = (vals * mask).sum() / mask.sum()
+    got = float(stats["sum"]) / float(stats["count"])
+    assert got == pytest.approx(expect, rel=1e-6)
+
+
+def test_fake_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    ctx = make_mesh(n_data=4, n_model=2)
+    assert ctx.n_data == 4 and ctx.n_model == 2
+    with use_mesh(ctx):
+        from transmogrifai_tpu.parallel import current_mesh
+        assert current_mesh() is ctx

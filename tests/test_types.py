@@ -1,0 +1,133 @@
+"""Feature type system tests (parity: reference FeatureTypeTest suites)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_registry_has_53_concrete_types():
+    # reference FeatureType.scala:265-355 registers exactly these
+    assert len(ft.FEATURE_TYPES) == 53
+    for name, cls in ft.FEATURE_TYPES.items():
+        assert cls.__name__ == name
+        assert issubclass(cls, ft.FeatureType)
+
+
+def test_real_nullable():
+    assert ft.Real(1.5).value == 1.5
+    assert ft.Real(None).is_empty
+    assert ft.Real(2).value == 2.0
+    assert not ft.Real(0.0).is_empty
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Real("abc")
+
+
+def test_realnn_non_nullable():
+    assert ft.RealNN(3.0).value == 3.0
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.RealNN(None)
+    assert not ft.RealNN.is_nullable
+    assert ft.Real.is_nullable
+
+
+def test_integral_and_binary():
+    assert ft.Integral(7).value == 7
+    assert ft.Integral(7.0).value == 7
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Integral(7.5)
+    assert ft.Binary(True).value is True
+    assert ft.Binary(0).value is False
+    assert ft.Binary(None).is_empty
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Binary(2)
+
+
+def test_type_lattice():
+    assert ft.is_subtype(ft.RealNN, ft.Real)
+    assert ft.is_subtype(ft.Currency, ft.Real)
+    assert ft.is_subtype(ft.DateTime, ft.Date)
+    assert ft.is_subtype(ft.Date, ft.Integral)
+    assert ft.is_subtype(ft.PickList, ft.Text)
+    assert ft.is_subtype(ft.Email, ft.Text)
+    assert not ft.is_subtype(ft.Text, ft.PickList)
+    assert ft.is_subtype(ft.CurrencyMap, ft.RealMap)
+    assert ft.is_subtype(ft.Prediction, ft.RealMap)
+    # mixins
+    assert issubclass(ft.PickList, ft.SingleResponse)
+    assert issubclass(ft.MultiPickList, ft.MultiResponse)
+    assert issubclass(ft.Country, ft.Location)
+    assert issubclass(ft.Geolocation, ft.Location)
+
+
+def test_text_and_email():
+    assert ft.Text("hi").value == "hi"
+    assert not ft.Text("").is_empty  # empty string is a value
+    assert ft.Text(None).is_empty
+    e = ft.Email("a@b.com")
+    assert e.prefix() == "a"
+    assert e.domain() == "b.com"
+    assert ft.Email("junk").prefix() is None
+
+
+def test_lists_and_sets():
+    tl = ft.TextList(["a", "b"])
+    assert tl.value == ["a", "b"]
+    assert ft.TextList(None).is_empty
+    assert ft.TextList([]).is_empty
+    mp = ft.MultiPickList({"x", "y"})
+    assert mp.contains("x")
+    assert not mp.contains("z")
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.TextList([1, 2])
+
+
+def test_geolocation():
+    g = ft.Geolocation([37.7, -122.4, 5.0])
+    assert g.lat == pytest.approx(37.7)
+    assert g.lon == pytest.approx(-122.4)
+    assert g.accuracy == 5.0
+    assert ft.Geolocation(None).is_empty
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Geolocation([100.0, 0.0, 1.0])  # bad lat
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Geolocation([1.0, 2.0])
+
+
+def test_vector():
+    v = ft.OPVector([1.0, 2.0, 3.0])
+    assert v.value.dtype == np.float32
+    assert not v.is_empty
+    assert ft.OPVector(None).value.shape == (0,)
+
+
+def test_maps():
+    m = ft.RealMap({"a": 1, "b": 2.5})
+    assert m.value == {"a": 1.0, "b": 2.5}
+    assert ft.RealMap({}).is_empty
+    tm = ft.TextMap({"k": "v"})
+    assert tm.contains("k")
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.TextMap({"k": 1})
+    bm = ft.BinaryMap({"k": 1})
+    assert bm.value == {"k": True}
+
+
+def test_prediction():
+    p = ft.Prediction.make(1.0, raw_prediction=[0.2, 0.8], probability=[0.3, 0.7])
+    assert p.prediction == 1.0
+    assert p.raw_prediction == [0.2, 0.8]
+    assert p.probability == [0.3, 0.7]
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Prediction({"probability_0": 0.5})  # missing 'prediction'
+    with pytest.raises(ft.FeatureTypeValueError):
+        ft.Prediction(None)
+
+
+def test_equality_and_hash():
+    assert ft.Real(1.0) == ft.Real(1.0)
+    assert ft.Real(1.0) != ft.Real(2.0)
+    assert ft.Real(1.0) != ft.Currency(1.0)  # different types differ
+    assert hash(ft.Text("a")) == hash(ft.Text("a"))
+    s = {ft.PickList("x"), ft.PickList("x"), ft.PickList("y")}
+    assert len(s) == 2

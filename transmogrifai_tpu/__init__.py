@@ -1,0 +1,44 @@
+"""TransmogrifAI-TPU: a TPU-native AutoML framework for structured data.
+
+A ground-up JAX/XLA re-design of the capability set of Salesforce
+TransmogrifAI (reference: /root/reference, Scala/Spark). The reference's
+essence — a typed feature algebra compiled to a stage DAG, level-scheduled
+fit/transform over immutable data, monoid-style distributed statistics, a
+model-selection sweep, and provenance metadata driving validation and
+explainability — is re-expressed TPU-first:
+
+- columnar host frame -> sharded device frame (pytrees of arrays + validity
+  masks, `jax.sharding.NamedSharding` over a `Mesh`)
+- stages are pure functions; same-DAG-layer transformers fuse into one
+  jitted program per layer
+- statistics are monoid pytrees reduced with `lax.psum` across the mesh
+- the ModelSelector's k-fold x hyperparameter sweep trains candidates as a
+  stacked leading axis under `vmap`/`shard_map` instead of a thread pool
+
+Nothing here is a port of Spark; see SURVEY.md for the layer mapping.
+"""
+
+__version__ = "0.1.0"
+
+# Lazy top-level API: submodules import on first attribute access so that the
+# foundation layers remain importable while upper layers are under build.
+_LAZY = {
+    "UID": ("transmogrifai_tpu.uid", "UID"),
+    "ft": ("transmogrifai_tpu.types", "feature_types"),
+    "Feature": ("transmogrifai_tpu.features.feature", "Feature"),
+    "FeatureLike": ("transmogrifai_tpu.features.feature", "FeatureLike"),
+    "FeatureBuilder": ("transmogrifai_tpu.features.builder", "FeatureBuilder"),
+    "Workflow": ("transmogrifai_tpu.workflow", "Workflow"),
+    "WorkflowModel": ("transmogrifai_tpu.workflow", "WorkflowModel"),
+    "HostFrame": ("transmogrifai_tpu.frame", "HostFrame"),
+}
+
+__all__ = list(_LAZY) + ["__version__"]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,310 @@
+"""Columnar data: host frame (numpy) and device columns (JAX pytrees).
+
+This replaces the reference's Spark DataFrame/RDD data abstraction
+(`features/.../utils/spark/RichDataset.scala`, `readers/DataReader.scala`)
+with a TPU-first design:
+
+- **HostFrame**: immutable dict of named ``HostColumn``s (numpy-backed).
+  Strings and maps live here; categorical columns can be dictionary-encoded.
+  This is the analog of the raw DataFrame produced by the readers.
+- **Device columns**: fixed-shape arrays + validity masks registered as JAX
+  pytrees (``NumericColumn``, ``CodesColumn``, ``VectorColumn``). Nullability
+  is a mask, not an Option. These flow through jitted, mesh-sharded stage
+  programs; the row (batch) axis shards over the ``"data"`` mesh axis.
+
+There is no shuffle: grouped aggregation is host-side sort + device segment
+ops (see readers.aggregate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = [
+    "HostColumn", "HostFrame", "NumericColumn", "CodesColumn", "VectorColumn",
+    "DeviceFrame", "NUMERIC_KINDS", "TEXT_KINDS", "MAP_KINDS", "LIST_KINDS",
+]
+
+# device_kind families
+NUMERIC_KINDS = frozenset({"real", "integral", "binary", "date", "datetime"})
+TEXT_KINDS = frozenset({
+    "text", "textarea", "email", "url", "phone", "id", "picklist", "combobox",
+    "base64", "country", "state", "city", "postalcode", "street",
+})
+LIST_KINDS = frozenset({"textlist", "datelist", "datetimelist"})
+MAP_KINDS = frozenset({k for k in (
+    "map_text map_textarea map_email map_url map_phone map_id map_picklist "
+    "map_combobox map_base64 map_country map_state map_city map_postalcode "
+    "map_street map_real map_currency map_percent map_integral map_date "
+    "map_datetime map_binary map_multipicklist map_geolocation map_namestats "
+    "prediction").split()})
+
+
+def _kind_of(ftype: type[ft.FeatureType]) -> str:
+    return ftype.device_kind
+
+
+# ---------------------------------------------------------------------------
+# Host columns
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class HostColumn:
+    """One feature column on host.
+
+    Representation by kind family:
+      numerics      -> float64 ``values`` + bool ``mask`` (True = present)
+      text          -> object ndarray of ``str | None`` in ``values``
+      lists/sets    -> object ndarray of list/set in ``values``
+      geolocation   -> float64 (n, 3) ``values`` + bool ``mask``
+      vector        -> float32 (n, d) ``values``
+      maps          -> object ndarray of dict in ``values``
+    """
+
+    ftype: type[ft.FeatureType]
+    values: np.ndarray
+    mask: Optional[np.ndarray] = None  # bool[n]; None for kinds w/o mask
+
+    @property
+    def kind(self) -> str:
+        return _kind_of(self.ftype)
+
+    def __len__(self) -> int:
+        return int(self.values.shape[0])
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_values(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
+        """Build a column from python values (None = missing), validating via
+        the feature type (the columnar analog of wrapping each value)."""
+        kind = _kind_of(ftype)
+        n = len(raw)
+        if kind in NUMERIC_KINDS:
+            vals = np.zeros(n, dtype=np.float64)
+            mask = np.zeros(n, dtype=bool)
+            for i, v in enumerate(raw):
+                pv = ftype._validate(v)
+                if pv is not None:
+                    vals[i] = float(pv)
+                    mask[i] = True
+            if not ftype.is_nullable and not mask.all():
+                raise ft.FeatureTypeValueError(
+                    f"{ftype.__name__} column contains empty values")
+            return HostColumn(ftype, vals, mask)
+        if kind in TEXT_KINDS:
+            vals = np.empty(n, dtype=object)
+            for i, v in enumerate(raw):
+                vals[i] = ftype._validate(v)
+            return HostColumn(ftype, vals, None)
+        if kind == "geolocation":
+            vals = np.zeros((n, 3), dtype=np.float64)
+            mask = np.zeros(n, dtype=bool)
+            for i, v in enumerate(raw):
+                pv = ftype._validate(v)
+                if pv:
+                    vals[i] = pv
+                    mask[i] = True
+            return HostColumn(ftype, vals, mask)
+        if kind == "vector":
+            arrs = [np.asarray(ftype._validate(v), dtype=np.float32) for v in raw]
+            d = max((a.shape[0] for a in arrs), default=0)
+            vals = np.zeros((n, d), dtype=np.float32)
+            for i, a in enumerate(arrs):
+                if a.shape[0] not in (0, d):
+                    raise ft.FeatureTypeValueError(
+                        f"ragged vector column: {a.shape[0]} vs {d}")
+                if a.shape[0] == d:
+                    vals[i] = a
+            return HostColumn(ftype, vals, None)
+        # lists, sets, maps, prediction -> object array of validated values
+        vals = np.empty(n, dtype=object)
+        for i, v in enumerate(raw):
+            vals[i] = ftype._validate(v)
+        return HostColumn(ftype, vals, None)
+
+    # -- access -------------------------------------------------------------
+    def python_value(self, i: int) -> Any:
+        """Row value as the feature type's python value (None when missing)."""
+        kind = self.kind
+        if kind in NUMERIC_KINDS:
+            if not self.mask[i]:
+                return None
+            v = self.values[i]
+            if kind in ("integral", "date", "datetime"):
+                return int(v)
+            if kind == "binary":
+                return bool(v)
+            return float(v)
+        if kind == "geolocation":
+            return list(self.values[i]) if self.mask[i] else []
+        if kind == "vector":
+            return np.asarray(self.values[i])
+        return self.values[i]
+
+    def take(self, idx: np.ndarray) -> "HostColumn":
+        return HostColumn(
+            self.ftype,
+            self.values[idx],
+            None if self.mask is None else self.mask[idx],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Device columns (JAX pytrees)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class NumericColumn:
+    """float32 values + float32 {0,1} mask. Missing slots hold 0 in values."""
+
+    values: jax.Array  # f32[n]
+    mask: jax.Array    # f32[n]
+
+    def tree_flatten(self):
+        return (self.values, self.mask), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_host(col: HostColumn) -> "NumericColumn":
+        return NumericColumn(
+            jnp.asarray(np.where(col.mask, col.values, 0.0), dtype=jnp.float32),
+            jnp.asarray(col.mask, dtype=jnp.float32),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class CodesColumn:
+    """Dictionary-encoded categorical: int32 codes into ``vocab``; -1 = null.
+
+    The vocab is static aux data (affects compiled shapes only via downstream
+    one-hot sizes, which are fixed at fit time).
+    """
+
+    codes: jax.Array            # i32[n]
+    vocab: tuple[str, ...]      # aux (host-side)
+
+    def tree_flatten(self):
+        return (self.codes,), self.vocab
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class VectorColumn:
+    """Dense f32[n, d] feature-vector block with provenance metadata.
+
+    The metadata (see ``transmogrifai_tpu.vector_metadata``) is aux data: it
+    names every one of the d columns with its parent feature, grouping,
+    pivot/indicator value and null-indicator flag — the backbone of
+    SanityChecker, ModelInsights and LOCO, mirroring the reference's
+    ``OpVectorMetadata`` riding on DataFrame schema.
+    """
+
+    values: jax.Array  # f32[n, d]
+    metadata: Any = None  # VectorMetadata | None (aux, static)
+
+    def tree_flatten(self):
+        return (self.values,), self.metadata
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+    @property
+    def width(self) -> int:
+        return int(self.values.shape[-1])
+
+
+DeviceColumn = Any  # NumericColumn | CodesColumn | VectorColumn
+DeviceFrame = dict  # dict[str, DeviceColumn]
+
+
+# ---------------------------------------------------------------------------
+# Host frame
+# ---------------------------------------------------------------------------
+
+class HostFrame:
+    """Immutable named collection of equal-length HostColumns.
+
+    The analog of the raw/intermediate Spark DataFrame. Cheap structural
+    sharing: with_columns/select return new frames referencing the same
+    column objects.
+    """
+
+    def __init__(self, columns: Mapping[str, HostColumn], key: Optional[np.ndarray] = None):
+        lens = {len(c) for c in columns.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged frame: column lengths {lens}")
+        self._cols = dict(columns)
+        self._n = lens.pop() if lens else 0
+        self.key = key  # optional entity-key column (object ndarray of str)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_dict(data: Mapping[str, tuple[type[ft.FeatureType], Sequence[Any]]],
+                  key: Optional[Sequence[str]] = None) -> "HostFrame":
+        cols = {name: HostColumn.from_values(t, vals) for name, (t, vals) in data.items()}
+        k = None if key is None else np.asarray(list(key), dtype=object)
+        return HostFrame(cols, k)
+
+    # -- structure ----------------------------------------------------------
+    @property
+    def n_rows(self) -> int:
+        return self._n
+
+    @property
+    def columns(self) -> dict[str, HostColumn]:
+        return dict(self._cols)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> HostColumn:
+        return self._cols[name]
+
+    def names(self) -> list[str]:
+        return list(self._cols)
+
+    def with_columns(self, new: Mapping[str, HostColumn]) -> "HostFrame":
+        cols = dict(self._cols)
+        cols.update(new)
+        return HostFrame(cols, self.key)
+
+    def select(self, names: Iterable[str]) -> "HostFrame":
+        return HostFrame({n: self._cols[n] for n in names}, self.key)
+
+    def drop(self, names: Iterable[str]) -> "HostFrame":
+        names = set(names)
+        return HostFrame({n: c for n, c in self._cols.items() if n not in names},
+                         self.key)
+
+    def take(self, idx: np.ndarray) -> "HostFrame":
+        return HostFrame({n: c.take(idx) for n, c in self._cols.items()},
+                         None if self.key is None else self.key[idx])
+
+    def row(self, i: int) -> dict[str, Any]:
+        return {n: c.python_value(i) for n, c in self._cols.items()}
+
+    def iter_rows(self):
+        for i in range(self._n):
+            yield self.row(i)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}: {c.ftype.__name__}" for n, c in self._cols.items())
+        return f"HostFrame(n={self._n}, [{cols}])"

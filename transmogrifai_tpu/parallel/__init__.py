@@ -1,0 +1,8 @@
+from transmogrifai_tpu.parallel.mesh import (
+    MeshContext, current_mesh, make_mesh, pad_rows, row_sharding, use_mesh,
+)
+
+__all__ = [
+    "MeshContext", "current_mesh", "make_mesh", "pad_rows", "row_sharding",
+    "use_mesh",
+]

@@ -1,0 +1,64 @@
+"""Monoid-pytree reductions over the mesh — the statistics comm backend.
+
+The reference computes every distributed statistic as an algebird monoid
+reduced via Spark ``reduce``/``reduceByKey``/``treeAggregate`` (SURVEY §2.7
+P2: RawFeatureFilter summaries, SmartTextVectorizer TextStats, SanityChecker
+contingency). Here the same algebra runs as:
+
+- inside ``shard_map``: ``tree_psum(stats, axis="data")`` — XLA all-reduce
+  over ICI, one collective per fused stats program;
+- at host level (multi-process): ``jax.experimental.multihost_utils`` style
+  all-gather is unnecessary because stats arrays are device-resident and
+  jit output shardings already materialize the reduced value replicated.
+
+A "monoid" here is any pytree of arrays whose combine is elementwise ``+``
+(sums, counts, histograms, contingency tables) — min/max/moment variants
+provide their own combine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from transmogrifai_tpu.parallel.mesh import DATA_AXIS, MeshContext
+
+__all__ = ["tree_psum", "tree_pmax", "tree_pmin", "mesh_reduce_stats"]
+
+
+def tree_psum(tree: Any, axis: str = DATA_AXIS) -> Any:
+    """All-reduce-sum every leaf across a mesh axis (use under shard_map)."""
+    return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis), tree)
+
+
+def tree_pmax(tree: Any, axis: str = DATA_AXIS) -> Any:
+    return jax.tree_util.tree_map(lambda x: jax.lax.pmax(x, axis), tree)
+
+
+def tree_pmin(tree: Any, axis: str = DATA_AXIS) -> Any:
+    return jax.tree_util.tree_map(lambda x: jax.lax.pmin(x, axis), tree)
+
+
+def mesh_reduce_stats(ctx: MeshContext,
+                      local_stats_fn: Callable[..., Any],
+                      *row_sharded_args: jax.Array) -> Any:
+    """Run a per-shard statistics function over row-sharded inputs and psum
+    the resulting monoid pytree across the data axis.
+
+    ``local_stats_fn(*shard_args) -> stats pytree`` sees only its shard of the
+    rows (masked rows contribute identity). The result is replicated.
+    This is the direct analog of the reference's
+    ``rdd.map(prepare).reduce(monoid.plus)``.
+    """
+    in_specs = tuple(
+        P(DATA_AXIS, *([None] * (a.ndim - 1))) for a in row_sharded_args)
+
+    def shard_fn(*args):
+        return tree_psum(local_stats_fn(*args), DATA_AXIS)
+
+    fn = jax.shard_map(shard_fn, mesh=ctx.mesh, in_specs=in_specs,
+                       out_specs=P())
+    return fn(*row_sharded_args)

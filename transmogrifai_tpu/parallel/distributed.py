@@ -1,0 +1,53 @@
+"""Multi-host (DCN) initialization.
+
+The reference scales out via Spark's driver/executor RPC; XGBoost adds a
+Rabit all-reduce ring (SURVEY §2.7). The TPU-native equivalent is a single
+SPMD program across hosts: ``jax.distributed.initialize`` joins processes over
+DCN, after which ``jax.devices()`` spans the pod and the normal mesh/collective
+path (mesh.py, collectives.py) is multi-host transparently.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["initialize", "is_multi_process", "process_index", "process_count"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join a multi-host pod. No-op when single-process (tests, one chip).
+
+    Arguments default from the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) or TPU metadata autodetection.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and os.environ.get("JAX_NUM_PROCESSES") is None:
+        return  # single process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def is_multi_process() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

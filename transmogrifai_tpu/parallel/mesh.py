@@ -1,0 +1,129 @@
+"""Device mesh management: the framework's distributed substrate.
+
+Replaces the reference's Spark driver/executor + shuffle/broadcast comm layer
+(SURVEY §2.7): all distribution here is a single-program `jax.sharding.Mesh`
+with XLA collectives over ICI/DCN. Two named axes:
+
+- ``"data"``  — rows (batch) shard here; the workhorse axis (reference P1).
+- ``"model"`` — model-selection candidates / feature-width shard here
+  (reference P3/P5 thread pools and the O(d^2) stats decomposition).
+
+Multi-host pods join the same mesh via ``jax.distributed.initialize`` (DCN);
+see ``transmogrifai_tpu.parallel.distributed``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "MeshContext", "make_mesh", "use_mesh", "current_mesh", "row_sharding",
+    "replicated", "pad_rows", "shard_rows", "num_data_shards",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshContext:
+    """A mesh plus the framework's axis conventions."""
+
+    mesh: Mesh
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape.get(MODEL_AXIS, 1)
+
+    def row_sharding(self, *trailing_axes: Optional[str]) -> NamedSharding:
+        """Rows sharded over 'data'; trailing dims per ``trailing_axes``."""
+        return NamedSharding(self.mesh, P(DATA_AXIS, *trailing_axes))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def model_sharding(self, *trailing_axes: Optional[str]) -> NamedSharding:
+        """Leading candidate axis sharded over 'model'."""
+        return NamedSharding(self.mesh, P(MODEL_AXIS, *trailing_axes))
+
+
+_current: contextvars.ContextVar[Optional[MeshContext]] = contextvars.ContextVar(
+    "transmogrifai_mesh", default=None)
+
+
+def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
+              devices=None) -> MeshContext:
+    """Build a (data, model) mesh over available devices.
+
+    Defaults to all devices on the data axis — the right choice for the
+    row-parallel workhorse path. ``n_model > 1`` carves off a candidate-
+    parallel axis for the ModelSelector sweep.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    total = len(devices)
+    if n_data is None:
+        n_data = total // n_model
+    if n_data * n_model != total:
+        raise ValueError(
+            f"mesh shape {n_data}x{n_model} != device count {total}")
+    arr = np.asarray(devices).reshape(n_data, n_model)
+    return MeshContext(Mesh(arr, (DATA_AXIS, MODEL_AXIS)))
+
+
+@contextlib.contextmanager
+def use_mesh(ctx: MeshContext):
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+def current_mesh() -> Optional[MeshContext]:
+    """The active mesh, or None (single-device eager fallback)."""
+    return _current.get()
+
+
+def row_sharding() -> Optional[NamedSharding]:
+    ctx = current_mesh()
+    return None if ctx is None else ctx.row_sharding()
+
+
+def replicated() -> Optional[NamedSharding]:
+    ctx = current_mesh()
+    return None if ctx is None else ctx.replicated()
+
+
+def num_data_shards() -> int:
+    ctx = current_mesh()
+    return 1 if ctx is None else ctx.n_data
+
+
+def pad_rows(n: int, multiple: Optional[int] = None) -> int:
+    """Rows padded up so the batch axis divides the data-axis size. Padded
+    slots carry mask=0 so every masked statistic ignores them."""
+    if multiple is None:
+        multiple = num_data_shards()
+    return int(math.ceil(n / multiple) * multiple) if multiple > 1 else n
+
+
+def shard_rows(arr: jax.Array) -> jax.Array:
+    """Place an array with its leading (row) axis sharded over the mesh.
+    No-op without an active mesh."""
+    ctx = current_mesh()
+    if ctx is None:
+        return arr
+    spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(ctx.mesh, spec))

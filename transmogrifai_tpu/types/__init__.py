@@ -1,0 +1,2 @@
+from transmogrifai_tpu.types import feature_types
+from transmogrifai_tpu.types.feature_types import *  # noqa: F401,F403

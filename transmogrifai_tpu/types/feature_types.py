@@ -1,0 +1,740 @@
+"""The feature type system: 45 immutable, nullable-aware wrapper types.
+
+Parity: reference ``features/src/main/scala/com/salesforce/op/features/types/``
+(`FeatureType.scala:44-116,265-355`, `Numerics.scala`, `Text.scala`, `Maps.scala`,
+`Geolocation.scala`, `OPVector.scala`). Same hierarchy, same 45 concrete types,
+same mixin semantics (``NonNullable``, ``Categorical``/``SingleResponse``/
+``MultiResponse``, ``Location``).
+
+TPU-first divergence: the *device* representation of a column of each type is
+fixed-width arrays + validity masks (nullability is a mask, not an Option) —
+see ``transmogrifai_tpu.frame``. These Python wrappers exist for (a) row-level
+local scoring (`transform_row` parity with the reference's `OpTransformer`),
+(b) the testkit generators, and (c) the typed DSL. Hot paths never construct
+them; they operate on columnar arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar, Optional
+
+import numpy as np
+
+__all__ = [
+    "FeatureType", "FeatureTypeValueError",
+    "NonNullable", "Categorical", "SingleResponse", "MultiResponse", "Location",
+    # numerics
+    "OPNumeric", "Real", "RealNN", "Integral", "Binary", "Date", "DateTime",
+    "Currency", "Percent",
+    # text
+    "Text", "TextArea", "Email", "URL", "Phone", "ID", "PickList", "ComboBox",
+    "Base64", "Country", "State", "City", "PostalCode", "Street",
+    # collections
+    "OPCollection", "OPList", "TextList", "DateList", "DateTimeList",
+    "Geolocation", "MultiPickList", "OPVector",
+    # maps
+    "OPMap", "TextMap", "TextAreaMap", "EmailMap", "URLMap", "PhoneMap",
+    "IDMap", "PickListMap", "ComboBoxMap", "Base64Map", "CountryMap",
+    "StateMap", "CityMap", "PostalCodeMap", "StreetMap", "RealMap",
+    "IntegralMap", "BinaryMap", "CurrencyMap", "PercentMap", "DateMap",
+    "DateTimeMap", "MultiPickListMap", "GeolocationMap", "NameStats",
+    "Prediction",
+    # registry / helpers
+    "FEATURE_TYPES", "feature_type_of", "is_subtype",
+]
+
+
+class FeatureTypeValueError(ValueError):
+    """Raised when a value does not conform to its feature type."""
+
+
+class FeatureType:
+    """Base of every feature type: an immutable wrapper around an optional value.
+
+    Mirrors reference ``FeatureType`` (value/isEmpty/isNullable/exists/contains).
+    """
+
+    __slots__ = ("_value",)
+
+    #: does this type admit an empty value?
+    is_nullable: ClassVar[bool] = True
+    #: short device-representation kind consumed by the frame layer
+    device_kind: ClassVar[str] = "abstract"
+
+    def __init__(self, value: Any = None):
+        self._value = self._validate(value)
+        if not self.is_nullable and self.is_empty:
+            raise FeatureTypeValueError(
+                f"{type(self).__name__} cannot be empty (NonNullable)"
+            )
+
+    # -- subclass hooks ------------------------------------------------------
+    @classmethod
+    def _validate(cls, value: Any) -> Any:
+        return value
+
+    @classmethod
+    def empty_value(cls) -> Any:
+        """The canonical empty value (reference ``FeatureTypeDefaults``)."""
+        return None
+
+    # -- accessors -----------------------------------------------------------
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @property
+    def is_empty(self) -> bool:
+        v = self._value
+        if v is None:
+            return True
+        if isinstance(v, (str,)):
+            return False  # empty string is a value, like reference Text("")
+        if isinstance(v, (list, tuple, set, frozenset, dict)):
+            return len(v) == 0
+        if isinstance(v, np.ndarray):
+            return v.size == 0
+        return False
+
+    def exists(self, predicate) -> bool:
+        return (not self.is_empty) and bool(predicate(self._value))
+
+    def contains(self, item: Any) -> bool:
+        if self.is_empty:
+            return False
+        v = self._value
+        if isinstance(v, (list, tuple, set, frozenset, dict)):
+            return item in v
+        return v == item
+
+    @classmethod
+    def empty(cls) -> "FeatureType":
+        return cls(cls.empty_value())
+
+    # -- equality / repr -----------------------------------------------------
+    def __eq__(self, other: Any) -> bool:
+        if not isinstance(other, FeatureType):
+            return NotImplemented
+        if type(self) is not type(other):
+            return False
+        a, b = self._value, other._value
+        if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        return a == b
+
+    def __hash__(self) -> int:
+        v = self._value
+        if isinstance(v, (list, np.ndarray)):
+            v = tuple(np.asarray(v).ravel().tolist())
+        elif isinstance(v, set):
+            v = frozenset(v)
+        elif isinstance(v, dict):
+            v = tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+        return hash((type(self).__name__, v))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self._value!r})"
+
+    def __bool__(self) -> bool:
+        return not self.is_empty
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, (list, np.ndarray)):
+        return tuple(np.asarray(v).ravel().tolist())
+    if isinstance(v, set):
+        return frozenset(v)
+    return v
+
+
+# --------------------------------------------------------------------------
+# Mixins (reference FeatureType.scala:118-160)
+# --------------------------------------------------------------------------
+
+class NonNullable:
+    """Marker: the type never holds an empty value."""
+    is_nullable: ClassVar[bool] = False
+
+
+class Categorical:
+    """Marker: values come from a finite vocabulary (pivotable)."""
+
+
+class SingleResponse(Categorical):
+    """Marker: single-response categorical (e.g. PickList)."""
+
+
+class MultiResponse(Categorical):
+    """Marker: multi-response categorical (e.g. MultiPickList)."""
+
+
+class Location:
+    """Marker: geographic types (Country..Street, Geolocation)."""
+
+
+# --------------------------------------------------------------------------
+# Numerics (reference types/Numerics.scala)
+# --------------------------------------------------------------------------
+
+class OPNumeric(FeatureType):
+    """Abstract numeric; value is Optional[float|int|bool]."""
+
+    def to_double(self) -> Optional[float]:
+        return None if self.is_empty else float(self._value)
+
+
+class Real(OPNumeric):
+    device_kind = "real"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, (bool, np.bool_)):
+            return float(value)
+        if isinstance(value, (int, float, np.integer, np.floating)):
+            return float(value)
+        raise FeatureTypeValueError(f"{cls.__name__} expects a number, got {value!r}")
+
+
+class RealNN(NonNullable, Real):
+    """Non-nullable real (labels, responses)."""
+    device_kind = "real"
+
+
+class Currency(Real):
+    device_kind = "real"
+
+
+class Percent(Real):
+    device_kind = "real"
+
+
+class Integral(OPNumeric):
+    device_kind = "integral"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, (bool, np.bool_)):
+            return int(value)
+        if isinstance(value, (int, np.integer)):
+            return int(value)
+        if isinstance(value, (float, np.floating)) and float(value).is_integer():
+            return int(value)
+        raise FeatureTypeValueError(f"{cls.__name__} expects an integer, got {value!r}")
+
+
+class Date(Integral):
+    """Epoch millis (day resolution in practice)."""
+    device_kind = "date"
+
+
+class DateTime(Date):
+    device_kind = "datetime"
+
+
+class Binary(SingleResponse, OPNumeric):
+    device_kind = "binary"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, (bool, np.bool_)):
+            return bool(value)
+        if isinstance(value, (int, float, np.integer, np.floating)) and value in (0, 1):
+            return bool(value)
+        raise FeatureTypeValueError(f"{cls.__name__} expects a boolean, got {value!r}")
+
+    def to_double(self) -> Optional[float]:
+        return None if self.is_empty else float(self._value)
+
+
+# --------------------------------------------------------------------------
+# Text (reference types/Text.scala)
+# --------------------------------------------------------------------------
+
+class Text(FeatureType):
+    device_kind = "text"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, str):
+            return value
+        raise FeatureTypeValueError(f"{cls.__name__} expects a string, got {value!r}")
+
+
+class TextArea(Text):
+    """Long free-form text (vectorized by hashing, never pivoted)."""
+    device_kind = "textarea"
+
+
+class Email(Text):
+    device_kind = "email"
+
+    def prefix(self) -> Optional[str]:
+        v = self._value
+        if v is None or "@" not in v:
+            return None
+        p, _, d = v.partition("@")
+        return p if p and d else None
+
+    def domain(self) -> Optional[str]:
+        v = self._value
+        if v is None or "@" not in v:
+            return None
+        p, _, d = v.partition("@")
+        return d if p and d else None
+
+
+class URL(Text):
+    device_kind = "url"
+
+
+class Phone(Text):
+    device_kind = "phone"
+
+
+class ID(Text):
+    device_kind = "id"
+
+
+class PickList(SingleResponse, Text):
+    device_kind = "picklist"
+
+
+class ComboBox(Text):
+    device_kind = "combobox"
+
+
+class Base64(Text):
+    device_kind = "base64"
+
+    def as_bytes(self) -> Optional[bytes]:
+        import base64 as _b64
+        return None if self.is_empty else _b64.b64decode(self._value)
+
+
+class Country(Location, Text):
+    device_kind = "country"
+
+
+class State(Location, Text):
+    device_kind = "state"
+
+
+class City(Location, Text):
+    device_kind = "city"
+
+
+class PostalCode(Location, Text):
+    device_kind = "postalcode"
+
+
+class Street(Location, Text):
+    device_kind = "street"
+
+
+# --------------------------------------------------------------------------
+# Collections (reference types/Lists.scala, Geolocation.scala, OPVector.scala)
+# --------------------------------------------------------------------------
+
+class OPCollection(FeatureType):
+    """Abstract collection; empty collection == empty value."""
+
+
+class OPList(OPCollection):
+    pass
+
+
+class TextList(OPList):
+    device_kind = "textlist"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return []
+        if isinstance(value, (list, tuple)):
+            out = []
+            for x in value:
+                if not isinstance(x, str):
+                    raise FeatureTypeValueError(f"TextList expects strings, got {x!r}")
+                out.append(x)
+            return out
+        raise FeatureTypeValueError(f"{cls.__name__} expects a list, got {value!r}")
+
+    @classmethod
+    def empty_value(cls):
+        return []
+
+
+class DateList(OPList):
+    device_kind = "datelist"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return []
+        if isinstance(value, (list, tuple)):
+            return [int(x) for x in value]
+        raise FeatureTypeValueError(f"{cls.__name__} expects a list, got {value!r}")
+
+    @classmethod
+    def empty_value(cls):
+        return []
+
+
+class DateTimeList(DateList):
+    device_kind = "datetimelist"
+
+
+class Geolocation(Location, OPList):
+    """(lat, lon, accuracy) triple; empty list when absent.
+
+    Parity: reference ``types/Geolocation.scala`` (accuracy is a
+    ``GeolocationAccuracy`` ordinal 0-10 there; an int here).
+    """
+    device_kind = "geolocation"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return []
+        value = list(value)
+        if len(value) == 0:
+            return []
+        if len(value) != 3:
+            raise FeatureTypeValueError(
+                f"Geolocation expects [lat, lon, accuracy], got {value!r}")
+        lat, lon, acc = float(value[0]), float(value[1]), float(value[2])
+        if not (-90.0 <= lat <= 90.0):
+            raise FeatureTypeValueError(f"Invalid latitude {lat}")
+        if not (-180.0 <= lon <= 180.0):
+            raise FeatureTypeValueError(f"Invalid longitude {lon}")
+        return [lat, lon, acc]
+
+    @classmethod
+    def empty_value(cls):
+        return []
+
+    @property
+    def lat(self) -> Optional[float]:
+        return None if self.is_empty else self._value[0]
+
+    @property
+    def lon(self) -> Optional[float]:
+        return None if self.is_empty else self._value[1]
+
+    @property
+    def accuracy(self) -> Optional[float]:
+        return None if self.is_empty else self._value[2]
+
+
+class MultiPickList(MultiResponse, OPCollection):
+    device_kind = "multipicklist"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return set()
+        if isinstance(value, (set, frozenset, list, tuple)):
+            out = set()
+            for x in value:
+                if not isinstance(x, str):
+                    raise FeatureTypeValueError(
+                        f"MultiPickList expects strings, got {x!r}")
+                out.add(x)
+            return out
+        raise FeatureTypeValueError(f"{cls.__name__} expects a set, got {value!r}")
+
+    @classmethod
+    def empty_value(cls):
+        return set()
+
+
+class OPVector(NonNullable, OPCollection):
+    """Dense/sparse numeric vector — device-native (float32 ndarray).
+
+    Parity: reference ``types/OPVector.scala`` (wraps Spark ml Vector).
+    """
+    device_kind = "vector"
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return np.zeros((0,), dtype=np.float32)
+        arr = np.asarray(value, dtype=np.float32)
+        if arr.ndim != 1:
+            raise FeatureTypeValueError(f"OPVector expects rank-1, got shape {arr.shape}")
+        return arr
+
+    @classmethod
+    def empty_value(cls):
+        return np.zeros((0,), dtype=np.float32)
+
+    @property
+    def is_empty(self) -> bool:
+        return False  # like reference: a vector (even length-0) is never "empty"
+
+
+# --------------------------------------------------------------------------
+# Maps (reference types/Maps.scala — 27 types)
+# --------------------------------------------------------------------------
+
+class OPMap(OPCollection):
+    """Abstract map String -> element; empty map == empty value."""
+
+    #: python type of the map's element values
+    element_validator: ClassVar = staticmethod(lambda v: v)
+
+    @classmethod
+    def _validate(cls, value):
+        if value is None:
+            return {}
+        if not isinstance(value, dict):
+            raise FeatureTypeValueError(f"{cls.__name__} expects a dict, got {value!r}")
+        ev = cls.element_validator
+        return {str(k): ev(v) for k, v in value.items()}
+
+    @classmethod
+    def empty_value(cls):
+        return {}
+
+
+def _text_elem(v):
+    if not isinstance(v, str):
+        raise FeatureTypeValueError(f"expected str map value, got {v!r}")
+    return v
+
+
+def _real_elem(v):
+    return float(v)
+
+
+def _integral_elem(v):
+    return int(v)
+
+
+def _binary_elem(v):
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if v in (0, 1):
+        return bool(v)
+    raise FeatureTypeValueError(f"expected bool map value, got {v!r}")
+
+
+def _set_elem(v):
+    return set(v)
+
+
+def _geo_elem(v):
+    return Geolocation._validate(v)
+
+
+class TextMap(OPMap):
+    device_kind = "map_text"
+    element_validator = staticmethod(_text_elem)
+
+
+class TextAreaMap(TextMap):
+    device_kind = "map_textarea"
+
+
+class EmailMap(TextMap):
+    device_kind = "map_email"
+
+
+class URLMap(TextMap):
+    device_kind = "map_url"
+
+
+class PhoneMap(TextMap):
+    device_kind = "map_phone"
+
+
+class IDMap(TextMap):
+    device_kind = "map_id"
+
+
+class PickListMap(SingleResponse, TextMap):
+    device_kind = "map_picklist"
+
+
+class ComboBoxMap(TextMap):
+    device_kind = "map_combobox"
+
+
+class Base64Map(TextMap):
+    device_kind = "map_base64"
+
+
+class CountryMap(Location, TextMap):
+    device_kind = "map_country"
+
+
+class StateMap(Location, TextMap):
+    device_kind = "map_state"
+
+
+class CityMap(Location, TextMap):
+    device_kind = "map_city"
+
+
+class PostalCodeMap(Location, TextMap):
+    device_kind = "map_postalcode"
+
+
+class StreetMap(Location, TextMap):
+    device_kind = "map_street"
+
+
+class RealMap(OPMap):
+    device_kind = "map_real"
+    element_validator = staticmethod(_real_elem)
+
+
+class CurrencyMap(RealMap):
+    device_kind = "map_currency"
+
+
+class PercentMap(RealMap):
+    device_kind = "map_percent"
+
+
+class IntegralMap(OPMap):
+    device_kind = "map_integral"
+    element_validator = staticmethod(_integral_elem)
+
+
+class DateMap(IntegralMap):
+    device_kind = "map_date"
+
+
+class DateTimeMap(DateMap):
+    device_kind = "map_datetime"
+
+
+class BinaryMap(OPMap):
+    device_kind = "map_binary"
+    element_validator = staticmethod(_binary_elem)
+
+
+class MultiPickListMap(MultiResponse, OPMap):
+    device_kind = "map_multipicklist"
+    element_validator = staticmethod(_set_elem)
+
+
+class GeolocationMap(Location, OPMap):
+    device_kind = "map_geolocation"
+    element_validator = staticmethod(_geo_elem)
+
+
+class NameStats(TextMap):
+    """Name-detection statistics map (reference types/NameStats.scala keys:
+    isName, originalName, gender...)."""
+    device_kind = "map_namestats"
+
+
+class Prediction(NonNullable, RealMap):
+    """Model output map with required key ``prediction`` and optional
+    ``probability_*`` / ``rawPrediction_*`` keys.
+
+    Parity: reference ``types/Maps.scala`` Prediction (`prediction/probability/
+    rawPrediction` accessors, non-nullable).
+    """
+    device_kind = "prediction"
+
+    PredictionName: ClassVar[str] = "prediction"
+    RawPredictionName: ClassVar[str] = "rawPrediction"
+    ProbabilityName: ClassVar[str] = "probability"
+
+    @classmethod
+    def _validate(cls, value):
+        out = super()._validate(value)
+        if cls.PredictionName not in out:
+            raise FeatureTypeValueError(
+                f"Prediction map must contain '{cls.PredictionName}' key, got {value!r}")
+        return out
+
+    @classmethod
+    def empty_value(cls):
+        raise FeatureTypeValueError("Prediction is non-nullable and has no empty value")
+
+    @property
+    def prediction(self) -> float:
+        return self._value[self.PredictionName]
+
+    def _keyed(self, prefix: str) -> list[float]:
+        ks = sorted(
+            (k for k in self._value if k.startswith(prefix + "_")),
+            key=lambda k: int(k.rsplit("_", 1)[1]),
+        )
+        return [self._value[k] for k in ks]
+
+    @property
+    def raw_prediction(self) -> list[float]:
+        return self._keyed(self.RawPredictionName)
+
+    @property
+    def probability(self) -> list[float]:
+        return self._keyed(self.ProbabilityName)
+
+    @staticmethod
+    def make(prediction: float,
+             raw_prediction=None,
+             probability=None) -> "Prediction":
+        m: dict[str, float] = {Prediction.PredictionName: float(prediction)}
+        for i, v in enumerate(raw_prediction if raw_prediction is not None else []):
+            m[f"{Prediction.RawPredictionName}_{i}"] = float(v)
+        for i, v in enumerate(probability if probability is not None else []):
+            m[f"{Prediction.ProbabilityName}_{i}"] = float(v)
+        return Prediction(m)
+
+
+# --------------------------------------------------------------------------
+# Registry (reference FeatureType.scala:265-355 — featureTypeTags, 45 entries)
+# --------------------------------------------------------------------------
+
+FEATURE_TYPES: dict[str, type[FeatureType]] = {
+    c.__name__: c
+    for c in [
+        # vector
+        OPVector,
+        # lists
+        TextList, DateList, DateTimeList, Geolocation,
+        # maps
+        Base64Map, BinaryMap, ComboBoxMap, CurrencyMap, DateMap, DateTimeMap,
+        EmailMap, IDMap, IntegralMap, MultiPickListMap, PercentMap, PhoneMap,
+        PickListMap, RealMap, TextAreaMap, TextMap, URLMap, CountryMap,
+        StateMap, CityMap, PostalCodeMap, StreetMap, NameStats, GeolocationMap,
+        Prediction,
+        # numerics
+        Binary, Currency, Date, DateTime, Integral, Percent, Real, RealNN,
+        # sets
+        MultiPickList,
+        # text
+        Base64, ComboBox, Email, ID, Phone, PickList, Text, TextArea, URL,
+        Country, State, City, PostalCode, Street,
+    ]
+}
+
+# The reference registry (FeatureType.scala:265-355) holds exactly these 53
+# concrete entries: 1 vector + 4 lists + 25 maps + 8 numerics + 1 set + 14 text.
+assert len(FEATURE_TYPES) == 53, len(FEATURE_TYPES)
+
+
+def feature_type_of(name: str) -> type[FeatureType]:
+    try:
+        return FEATURE_TYPES[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown feature type {name!r}; known: {sorted(FEATURE_TYPES)}") from None
+
+
+def is_subtype(a: type, b: type) -> bool:
+    """``a`` conforms to ``b`` in the feature type lattice."""
+    return issubclass(a, b)

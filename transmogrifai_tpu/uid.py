@@ -1,0 +1,43 @@
+"""Stage/feature UID registry.
+
+Parity: reference `utils/src/main/scala/com/salesforce/op/UID.scala` —
+`ClassName_000000000012`-style uids from a global counter, with reset support
+for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+
+_COUNTER = itertools.count(1)
+_LOCK = threading.Lock()
+_UID_RE = re.compile(r"^(.*)_(\d{12})$")
+
+
+class UID:
+    """Global uid factory: ``UID.of("RealVectorizer") -> "RealVectorizer_000000000001"``."""
+
+    @staticmethod
+    def of(prefix: str | type) -> str:
+        if isinstance(prefix, type):
+            prefix = prefix.__name__
+        with _LOCK:
+            count = next(_COUNTER)
+        return f"{prefix}_{count:012d}"
+
+    @staticmethod
+    def reset(start: int = 1) -> None:
+        """Reset the counter (tests only — mirrors reference UID.reset)."""
+        global _COUNTER
+        with _LOCK:
+            _COUNTER = itertools.count(start)
+
+    @staticmethod
+    def from_string(uid: str) -> tuple[str, int]:
+        """Parse ``Prefix_000000000012`` into (prefix, 12). Raises on bad format."""
+        m = _UID_RE.match(uid)
+        if not m:
+            raise ValueError(f"Invalid uid format: {uid!r}")
+        return m.group(1), int(m.group(2))

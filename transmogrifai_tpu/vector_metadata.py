@@ -1,0 +1,128 @@
+"""Feature-vector provenance metadata.
+
+Parity: reference ``features/.../utils/spark/OpVectorMetadata.scala`` and
+``OpVectorColumnMetadata.scala`` — every column of every feature vector knows
+its parent feature(s), grouping (e.g. map key or pivot group), indicator value
+(pivot category), descriptor (e.g. unit-circle component) and whether it is a
+null-indicator. The reference rides this on DataFrame column Metadata; here it
+is static aux data on ``VectorColumn`` pytrees, preserved through jit.
+
+This is load-bearing: SanityChecker's per-group stats, ModelInsights'
+per-derived-column report and LOCO's hash-group aggregation all key off it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence
+
+__all__ = ["VectorColumnMetadata", "VectorMetadata", "NULL_INDICATOR", "OTHER"]
+
+#: indicator value used for null-tracking columns (reference NullString)
+NULL_INDICATOR = "NullIndicatorValue"
+#: pivot bucket for values outside topK (reference OtherString)
+OTHER = "OTHER"
+
+
+@dataclass(frozen=True)
+class VectorColumnMetadata:
+    """Provenance of one column in a feature vector."""
+
+    parent_feature: tuple[str, ...]            # raw/derived parent feature names
+    parent_feature_type: tuple[str, ...]       # their FeatureType class names
+    grouping: Optional[str] = None             # pivot group / map key
+    indicator_value: Optional[str] = None      # pivot category value
+    descriptor_value: Optional[str] = None     # e.g. "sin_HourOfDay"
+    index: int = 0                             # position in the combined vector
+
+    @property
+    def is_null_indicator(self) -> bool:
+        return self.indicator_value == NULL_INDICATOR
+
+    @property
+    def is_other_indicator(self) -> bool:
+        return self.indicator_value == OTHER
+
+    def make_col_name(self) -> str:
+        """Human-readable column name (reference makeColName)."""
+        parts = list(self.parent_feature)
+        if self.grouping and self.grouping not in parts:
+            parts.append(self.grouping)
+        tail = self.indicator_value or self.descriptor_value
+        if tail:
+            parts.append(tail)
+        return "_".join(parts) + f"_{self.index}"
+
+    def feature_group(self) -> Optional[str]:
+        """Grouping key for correlated-removal and LOCO aggregation: columns
+        sharing (parent, grouping) form one categorical/hash group."""
+        if self.grouping is not None:
+            return f"{'_'.join(self.parent_feature)}::{self.grouping}"
+        if self.indicator_value is not None:
+            return "_".join(self.parent_feature)
+        return None
+
+    def to_json(self) -> dict:
+        return {
+            "parentFeature": list(self.parent_feature),
+            "parentFeatureType": list(self.parent_feature_type),
+            "grouping": self.grouping,
+            "indicatorValue": self.indicator_value,
+            "descriptorValue": self.descriptor_value,
+            "index": self.index,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "VectorColumnMetadata":
+        return VectorColumnMetadata(
+            parent_feature=tuple(d["parentFeature"]),
+            parent_feature_type=tuple(d["parentFeatureType"]),
+            grouping=d.get("grouping"),
+            indicator_value=d.get("indicatorValue"),
+            descriptor_value=d.get("descriptorValue"),
+            index=int(d.get("index", 0)),
+        )
+
+
+@dataclass(frozen=True)
+class VectorMetadata:
+    """Metadata for a whole feature vector: ordered column provenance."""
+
+    name: str
+    columns: tuple[VectorColumnMetadata, ...] = field(default_factory=tuple)
+
+    @property
+    def size(self) -> int:
+        return len(self.columns)
+
+    def col_names(self) -> list[str]:
+        return [c.make_col_name() for c in self.columns]
+
+    def reindexed(self, start: int = 0) -> "VectorMetadata":
+        cols = tuple(replace(c, index=start + i) for i, c in enumerate(self.columns))
+        return VectorMetadata(self.name, cols)
+
+    @staticmethod
+    def flatten(name: str, metas: Sequence["VectorMetadata"]) -> "VectorMetadata":
+        """Concatenate vector metadatas (reference OpVectorMetadata.flatten),
+        reassigning global column indices."""
+        cols: list[VectorColumnMetadata] = []
+        for m in metas:
+            cols.extend(m.columns)
+        out = VectorMetadata(name, tuple(cols)).reindexed(0)
+        return out
+
+    def select(self, keep: Sequence[int]) -> "VectorMetadata":
+        """Keep a subset of columns (DropIndices rewiring), reindexed."""
+        cols = tuple(self.columns[i] for i in keep)
+        return VectorMetadata(self.name, cols).reindexed(0)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "columns": [c.to_json() for c in self.columns]}
+
+    @staticmethod
+    def from_json(d: dict) -> "VectorMetadata":
+        return VectorMetadata(
+            d["name"],
+            tuple(VectorColumnMetadata.from_json(c) for c in d.get("columns", [])),
+        )
