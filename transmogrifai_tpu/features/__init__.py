@@ -1,0 +1,4 @@
+from transmogrifai_tpu.features.feature import Feature, FeatureLike, TransientFeature
+from transmogrifai_tpu.features.builder import FeatureBuilder
+
+__all__ = ["Feature", "FeatureLike", "TransientFeature", "FeatureBuilder"]

@@ -1,0 +1,196 @@
+"""The lazy, typed feature graph.
+
+Parity: reference ``features/src/main/scala/com/salesforce/op/features/
+{FeatureLike,Feature,TransientFeature}.scala`` — a Feature is a typed, lazy
+pointer to a future column: name, uid, response flag, origin stage and parent
+features. Equality is by origin-stage uid + parents. ``transform_with`` wires
+a stage into the graph and returns its output feature; the workflow later
+back-traces lineage from result features to compile the stage DAG.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable, Optional
+
+from transmogrifai_tpu.types import feature_types as ft
+
+if TYPE_CHECKING:
+    from transmogrifai_tpu.stages.base import PipelineStage
+
+__all__ = ["FeatureLike", "Feature", "TransientFeature"]
+
+
+class FeatureLike:
+    """A typed node in the feature graph."""
+
+    def __init__(self, name: str, uid: str, ftype: type[ft.FeatureType],
+                 origin_stage: "PipelineStage",
+                 parents: tuple["FeatureLike", ...] = (),
+                 is_response: bool = False):
+        self._name = name
+        self._uid = uid
+        self._ftype = ftype
+        self._origin_stage = origin_stage
+        self._parents = tuple(parents)
+        self._is_response = is_response
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def uid(self) -> str:
+        return self._uid
+
+    @property
+    def ftype(self) -> type[ft.FeatureType]:
+        return self._ftype
+
+    @property
+    def origin_stage(self) -> "PipelineStage":
+        return self._origin_stage
+
+    @property
+    def parents(self) -> tuple["FeatureLike", ...]:
+        return self._parents
+
+    @property
+    def is_response(self) -> bool:
+        return self._is_response
+
+    @property
+    def is_raw(self) -> bool:
+        return len(self._parents) == 0
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, FeatureLike):
+            return NotImplemented
+        return (self._origin_stage.uid == other._origin_stage.uid
+                and self._name == other._name
+                and tuple(p.uid for p in self._parents)
+                == tuple(p.uid for p in other._parents))
+
+    def __hash__(self) -> int:
+        return hash((self._origin_stage.uid, self._name,
+                     tuple(p.uid for p in self._parents)))
+
+    def __repr__(self) -> str:
+        kind = "response" if self._is_response else "predictor"
+        return (f"Feature[{self._ftype.__name__}]({self._name!r}, {kind}, "
+                f"origin={self._origin_stage.uid})")
+
+    # -- graph construction --------------------------------------------------
+    def transform_with(self, stage: "PipelineStage",
+                       *others: "FeatureLike") -> "FeatureLike":
+        """Apply a stage to this feature (+ additional inputs); returns the
+        stage's output feature (reference FeatureLike.transformWith)."""
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    # -- graph traversal -----------------------------------------------------
+    def parent_stages(self) -> dict["PipelineStage", int]:
+        """All ancestor stages with their max distance from this feature
+        (reference FeatureLike.parentStages via scala-graph; plain BFS here).
+        Distance 0 = this feature's origin stage."""
+        dist: dict[PipelineStage, int] = {}
+
+        def visit(feat: "FeatureLike", d: int) -> None:
+            stage = feat.origin_stage
+            if stage is None:
+                return
+            if stage in dist and dist[stage] >= d:
+                return  # parents already propagated at >= d+1
+            dist[stage] = d
+            for p in feat.parents:
+                visit(p, d + 1)
+
+        visit(self, 0)
+        return dist
+
+    def raw_features(self) -> list["FeatureLike"]:
+        """All raw ancestors (deduped, stable order)."""
+        seen: dict[str, FeatureLike] = {}
+
+        def walk(f: "FeatureLike"):
+            if f.is_raw:
+                seen.setdefault(f.uid, f)
+            for p in f.parents:
+                walk(p)
+
+        walk(self)
+        return list(seen.values())
+
+    def all_features(self) -> list["FeatureLike"]:
+        seen: dict[str, FeatureLike] = {}
+
+        def walk(f: "FeatureLike"):
+            if f.uid not in seen:
+                seen[f.uid] = f
+                for p in f.parents:
+                    walk(p)
+
+        walk(self)
+        return list(seen.values())
+
+    def history(self) -> dict:
+        """Originating raw features + stage operation names along the lineage
+        (reference FeatureHistory)."""
+        return {
+            "originFeatures": sorted(f.name for f in self.raw_features()),
+            "stages": sorted({s.operation_name for s in self.parent_stages()
+                              if not s.is_raw_generator}),
+        }
+
+    def to_transient(self) -> "TransientFeature":
+        return TransientFeature(
+            name=self._name, uid=self._uid, ftype_name=self._ftype.__name__,
+            is_response=self._is_response, is_raw=self.is_raw,
+            origin_stage_uid=self._origin_stage.uid,
+            parent_uids=tuple(p.uid for p in self._parents),
+        )
+
+
+class Feature(FeatureLike):
+    """Concrete feature (the reference splits interface/case-class; we keep
+    the split nominal)."""
+
+
+class TransientFeature:
+    """Serialization-safe feature reference that drops the DAG pointer
+    (reference TransientFeature.scala) — what stages persist."""
+
+    def __init__(self, name: str, uid: str, ftype_name: str, is_response: bool,
+                 is_raw: bool, origin_stage_uid: str,
+                 parent_uids: tuple[str, ...] = ()):
+        self.name = name
+        self.uid = uid
+        self.ftype_name = ftype_name
+        self.is_response = is_response
+        self.is_raw = is_raw
+        self.origin_stage_uid = origin_stage_uid
+        self.parent_uids = tuple(parent_uids)
+
+    @property
+    def ftype(self) -> type[ft.FeatureType]:
+        return ft.feature_type_of(self.ftype_name)
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "uid": self.uid, "typeName": self.ftype_name,
+            "isResponse": self.is_response, "isRaw": self.is_raw,
+            "originStage": self.origin_stage_uid,
+            "parents": list(self.parent_uids),
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "TransientFeature":
+        return TransientFeature(
+            name=d["name"], uid=d["uid"], ftype_name=d["typeName"],
+            is_response=d["isResponse"], is_raw=d["isRaw"],
+            origin_stage_uid=d["originStage"],
+            parent_uids=tuple(d.get("parents", ())),
+        )
+
+    def __repr__(self) -> str:
+        return f"TransientFeature({self.name!r}, {self.ftype_name})"

@@ -1,0 +1,162 @@
+"""PipelineData: the mixed host/device view stages execute against.
+
+The analog of the raw + intermediate Spark DataFrame flowing through
+``FitStagesUtil``: a HostFrame of ingested columns plus device-resident
+columns produced by fused stage programs. Columns convert lazily between
+residencies:
+
+- numeric host columns  -> ``NumericColumn`` (f32 values + f32 mask)
+- text-ish host columns -> ``CodesColumn`` (dictionary-encoded on first use)
+- vector host columns   -> ``VectorColumn``
+- device outputs pull back to host only at the edges (save/inspect/local).
+
+When a mesh is active, device placement shards the row axis over the "data"
+axis (when divisible; callers controlling batch shape pad via
+``parallel.pad_rows``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.parallel import mesh as pmesh
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["PipelineData"]
+
+
+def _shard(arr):
+    ctx = pmesh.current_mesh()
+    if ctx is None or arr.shape[0] % ctx.n_data != 0:
+        return arr
+    return pmesh.shard_rows(arr)
+
+
+class PipelineData:
+    def __init__(self, host: fr.HostFrame,
+                 device: Optional[Mapping[str, Any]] = None):
+        self.host = host
+        self.device: dict[str, Any] = dict(device or {})
+        self._codes_cache: dict[str, fr.CodesColumn] = {}
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_host(host: fr.HostFrame) -> "PipelineData":
+        return PipelineData(host)
+
+    @property
+    def n_rows(self) -> int:
+        if self.host.n_rows:
+            return self.host.n_rows
+        for c in self.device.values():
+            v = getattr(c, "values", getattr(c, "codes", None))
+            if v is not None:
+                return int(v.shape[0])
+        return 0
+
+    def has(self, name: str) -> bool:
+        return name in self.device or name in self.host
+
+    # -- column access -------------------------------------------------------
+    def host_col(self, name: str) -> fr.HostColumn:
+        if name in self.host:
+            return self.host[name]
+        if name in self.device:
+            return self._device_to_host(self.device[name])
+        raise KeyError(f"No column {name!r}")
+
+    def device_col(self, name: str) -> Any:
+        if name in self.device:
+            return self.device[name]
+        if name in self._codes_cache:
+            return self._codes_cache[name]
+        if name not in self.host:
+            raise KeyError(f"No column {name!r}")
+        col = self.host[name]
+        kind = col.kind
+        if kind in fr.NUMERIC_KINDS:
+            dev = fr.NumericColumn(
+                _shard(jnp.asarray(np.where(col.mask, col.values, 0.0),
+                                   dtype=jnp.float32)),
+                _shard(jnp.asarray(col.mask, dtype=jnp.float32)))
+            self.device[name] = dev
+            return dev
+        if kind == "vector":
+            dev = fr.VectorColumn(_shard(jnp.asarray(col.values, jnp.float32)))
+            self.device[name] = dev
+            return dev
+        if kind in fr.TEXT_KINDS:
+            dev = self._encode_text(col)
+            self._codes_cache[name] = dev
+            return dev
+        raise TypeError(
+            f"Column {name!r} of kind {kind!r} has no generic device "
+            "representation; the consuming stage must handle it on host")
+
+    @staticmethod
+    def _encode_text(col: fr.HostColumn) -> fr.CodesColumn:
+        vocab = sorted({v for v in col.values if v is not None})
+        index = {v: i for i, v in enumerate(vocab)}
+        codes = np.fromiter(
+            (index.get(v, -1) if v is not None else -1 for v in col.values),
+            count=len(col), dtype=np.int32)
+        return fr.CodesColumn(_shard(jnp.asarray(codes)), tuple(vocab))
+
+    @staticmethod
+    def _device_to_host(col: Any) -> fr.HostColumn:
+        if isinstance(col, fr.NumericColumn):
+            vals = np.asarray(col.values, dtype=np.float64)
+            mask = np.asarray(col.mask) > 0.5
+            return fr.HostColumn(ft.Real, vals, mask)
+        if isinstance(col, fr.VectorColumn):
+            return fr.HostColumn(ft.OPVector, np.asarray(col.values, np.float32))
+        if isinstance(col, fr.CodesColumn):
+            codes = np.asarray(col.codes)
+            vals = np.empty(codes.shape[0], dtype=object)
+            for i, c in enumerate(codes):
+                vals[i] = col.vocab[c] if c >= 0 else None
+            return fr.HostColumn(ft.Text, vals)
+        raise TypeError(f"Cannot pull {type(col).__name__} to host")
+
+    # -- updates -------------------------------------------------------------
+    def with_host_cols(self, new: Mapping[str, fr.HostColumn]) -> "PipelineData":
+        return PipelineData(self.host.with_columns(new), self.device)
+
+    def with_device_cols(self, new: Mapping[str, Any]) -> "PipelineData":
+        dev = dict(self.device)
+        dev.update(new)
+        out = PipelineData(self.host, dev)
+        out._codes_cache = self._codes_cache
+        return out
+
+    def select_result(self, names: Iterable[str]) -> "PipelineData":
+        names = list(names)
+        host_cols = {n: self.host[n] for n in names if n in self.host}
+        dev_cols = {n: self.device[n] for n in names if n in self.device}
+        return PipelineData(fr.HostFrame(host_cols, self.host.key), dev_cols)
+
+    # -- row-axis ops (splits) ----------------------------------------------
+    def take(self, idx: np.ndarray) -> "PipelineData":
+        host = self.host.take(idx) if self.host.names() else self.host
+        jidx = jnp.asarray(np.asarray(idx))
+        dev = {}
+        for n, c in self.device.items():
+            if isinstance(c, fr.NumericColumn):
+                dev[n] = fr.NumericColumn(c.values[jidx], c.mask[jidx])
+            elif isinstance(c, fr.VectorColumn):
+                dev[n] = fr.VectorColumn(c.values[jidx], c.metadata)
+            elif isinstance(c, fr.CodesColumn):
+                dev[n] = fr.CodesColumn(c.codes[jidx], c.vocab)
+            else:
+                raise TypeError(f"take: unsupported device column {type(c)}")
+        if self.host.names():
+            return PipelineData(host, dev)
+        return PipelineData(fr.HostFrame({}, None), dev)
+
+    def vector_meta(self, name: str):
+        col = self.device.get(name)
+        return getattr(col, "metadata", None)

@@ -1,0 +1,10 @@
+from transmogrifai_tpu.stages.base import (
+    Estimator, FeatureGeneratorStage, HostTransformer, DeviceTransformer,
+    LambdaTransformer, PipelineStage, Transformer, STAGE_REGISTRY,
+)
+
+__all__ = [
+    "Estimator", "FeatureGeneratorStage", "HostTransformer",
+    "DeviceTransformer", "LambdaTransformer", "PipelineStage", "Transformer",
+    "STAGE_REGISTRY",
+]
