@@ -1,0 +1,116 @@
+"""Reader + aggregator tests (parity: reference DataGenerationTest /
+aggregator suites with hand-computed expectations)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.aggregators.monoid import Event, aggregator_of
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.readers import (
+    AggregateDataReader, CSVReader, CustomReader, DataReaders, infer_csv_schema,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_aggregator_defaults():
+    assert aggregator_of(ft.Real).reduce([1.0, None, 2.5]) == 3.5
+    assert aggregator_of(ft.Real).reduce([None, None]) is None
+    assert aggregator_of(ft.Percent).reduce([0.2, 0.4]) == pytest.approx(0.3)
+    assert aggregator_of(ft.Integral).reduce([1, 2]) == 3
+    assert aggregator_of(ft.Date).reduce([5, 9, 2]) == 9
+    assert aggregator_of(ft.Binary).reduce([False, None, True]) is True
+    assert aggregator_of(ft.Text).reduce(["a", None, "b"]) == "ab"
+    assert aggregator_of(ft.PickList).reduce(["x", "y", "x"]) == "x"
+    assert aggregator_of(ft.PickList).reduce(["y", "x"]) == "x"  # tie -> lexicographic
+    assert aggregator_of(ft.MultiPickList).reduce([{"a"}, {"b"}, None]) == {"a", "b"}
+    assert aggregator_of(ft.TextList).reduce([["a"], ["b", "c"]]) == ["a", "b", "c"]
+    assert aggregator_of(ft.RealMap).reduce([{"a": 1.0}, {"a": 2.0, "b": 1.0}]) == \
+        {"a": 3.0, "b": 1.0}
+    assert aggregator_of(ft.TextMap).reduce([{"k": "x"}, {"k": "y"}]) == {"k": "xy"}
+    assert aggregator_of(ft.DateMap).reduce([{"k": 3}, {"k": 7}]) == {"k": 7}
+    mid = aggregator_of(ft.Geolocation).reduce([[10.0, 20.0, 1.0], [20.0, 40.0, 3.0]])
+    assert mid == [15.0, 30.0, 3.0]
+    np.testing.assert_allclose(
+        aggregator_of(ft.OPVector).reduce([np.ones(3), 2 * np.ones(3)]),
+        3 * np.ones(3))
+    # subtype dispatch: Currency sums, CurrencyMap sums per key
+    assert aggregator_of(ft.Currency).reduce([1.0, 2.0]) == 3.0
+
+
+def test_custom_reader_generates_frame():
+    records = [
+        {"id": "a", "age": 30, "label": 1.0},
+        {"id": "b", "age": None, "label": 0.0},
+    ]
+    age = FeatureBuilder.Real("age").as_predictor()
+    label = FeatureBuilder.RealNN("label").as_response()
+    reader = DataReaders.Simple.custom(records, key_fn=lambda r: r["id"])
+    frame = reader.generate_frame([age, label])
+    assert frame.n_rows == 2
+    assert frame["age"].mask.tolist() == [True, False]
+    assert frame.key.tolist() == ["a", "b"]
+
+
+def test_csv_reader_inference(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "id,age,height,vip,name\n"
+        "1,32,5.5,true,ann\n"
+        "2,,6.1,false,bob\n"
+        "3,45,5.9,,\n")
+    reader = CSVReader(str(p))
+    schema = reader.schema
+    assert schema["age"] is ft.Integral
+    assert schema["height"] is ft.Real
+    assert schema["vip"] is ft.Binary
+    assert schema["name"] is ft.Text
+    recs = list(reader.read())
+    assert recs[0]["age"] == 32 and recs[1]["age"] is None
+    assert recs[0]["vip"] is True and recs[2]["vip"] is None
+    assert recs[2]["name"] is None
+
+
+def test_infer_schema_int_not_bool():
+    rows = [{"x": "0"}, {"x": "1"}]
+    assert infer_csv_schema(rows)["x"] is ft.Integral
+
+
+def test_aggregate_reader():
+    # entity "u1": events at t=1 (amt 10), t=5 (amt 20), t=9 (amt 40)
+    records = [
+        {"k": "u1", "t": 1, "amt": 10.0, "resp": 0.0},
+        {"k": "u1", "t": 5, "amt": 20.0, "resp": 1.0},
+        {"k": "u1", "t": 9, "amt": 40.0, "resp": 1.0},
+        {"k": "u2", "t": 2, "amt": 5.0, "resp": 0.0},
+    ]
+    amt = FeatureBuilder.Real("amt").extract(lambda r: r["amt"]).as_predictor()
+    resp = FeatureBuilder.RealNN("resp").extract(lambda r: r["resp"]).as_response()
+    reader = DataReaders.Aggregate.custom(
+        records, key_fn=lambda r: r["k"], time_fn=lambda r: r["t"], cutoff_ms=5)
+    frame = reader.generate_frame([amt, resp])
+    # predictors: t<=5 -> u1: 10+20=30, u2: 5 ; response: t>5 -> u1: 1, u2: none->0? sum of none = None -> RealNN violation
+    assert frame.n_rows == 2
+    assert frame.key.tolist() == ["u1", "u2"]
+    row_u1 = frame.row(0)
+    assert row_u1["amt"] == 30.0
+    assert row_u1["resp"] == 1.0
+
+
+def test_conditional_reader():
+    records = [
+        {"k": "a", "t": 1, "amt": 1.0, "buy": False, "resp": 0.0},
+        {"k": "a", "t": 3, "amt": 2.0, "buy": True, "resp": 0.0},
+        {"k": "a", "t": 7, "amt": 8.0, "buy": False, "resp": 1.0},
+        {"k": "b", "t": 2, "amt": 9.0, "buy": False, "resp": 1.0},  # no condition -> dropped
+    ]
+    amt = FeatureBuilder.Real("amt").extract(lambda r: r["amt"]).as_predictor()
+    resp = FeatureBuilder.Real("resp").extract(lambda r: r["resp"]).as_response()
+    reader = DataReaders.Conditional.custom(
+        records, key_fn=lambda r: r["k"], time_fn=lambda r: r["t"],
+        condition_fn=lambda r: r["buy"])
+    frame = reader.generate_frame([amt, resp])
+    assert frame.n_rows == 1
+    row = frame.row(0)
+    # cutoff at t=3: predictors t<=3 -> 1+2=3 ; response t>3 -> 1.0
+    assert row["amt"] == 3.0
+    assert row["resp"] == 1.0

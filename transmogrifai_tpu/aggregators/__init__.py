@@ -1,0 +1,5 @@
+from transmogrifai_tpu.aggregators.monoid import (
+    Event, FeatureAggregator, MonoidAggregator, aggregator_of,
+)
+
+__all__ = ["Event", "FeatureAggregator", "MonoidAggregator", "aggregator_of"]

@@ -1,0 +1,11 @@
+from transmogrifai_tpu.readers.base import CustomReader, DataReader
+from transmogrifai_tpu.readers.csv import CSVReader, infer_csv_schema
+from transmogrifai_tpu.readers.aggregates import (
+    AggregateDataReader, ConditionalDataReader,
+)
+from transmogrifai_tpu.readers.factory import DataReaders
+
+__all__ = [
+    "CustomReader", "DataReader", "CSVReader", "infer_csv_schema",
+    "AggregateDataReader", "ConditionalDataReader", "DataReaders",
+]

@@ -1,0 +1,121 @@
+"""Aggregate & conditional readers: time-series event -> entity rollup.
+
+Parity: reference ``readers/AggregateDataReaders.scala`` /
+``ConditionalDataReaders.scala`` + ``DataReader.scala:216-260``
+(AggregatedReader): group records by entity key, then reduce each feature's
+events with its monoid aggregator honoring a cutoff:
+
+- **AggregateDataReader**: one global ``cutoff_ms``; predictors aggregate
+  events at/before it, responses after it.
+- **ConditionalDataReader**: per-key cutoff = time of the first event
+  matching ``condition_fn``; keys with no matching event are dropped.
+
+TPU note (SURVEY §2.7): the reference's groupByKey shuffle becomes a
+host-side stable sort over keys; the per-group monoid reduction happens at
+ingest (string/object-typed), so there is nothing to put on device here.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu.aggregators.monoid import (
+    Event, FeatureAggregator, aggregator_of,
+)
+from transmogrifai_tpu.features.feature import FeatureLike
+from transmogrifai_tpu.frame import HostColumn, HostFrame
+from transmogrifai_tpu.readers.base import DataReader
+
+__all__ = ["AggregateDataReader", "ConditionalDataReader"]
+
+
+class _GroupingReader(DataReader):
+    def __init__(self, base: DataReader,
+                 key_fn: Callable[[Any], str],
+                 time_fn: Callable[[Any], int]):
+        super().__init__(key_fn=key_fn)
+        self.base = base
+        self.time_fn = time_fn
+
+    def read(self) -> Iterable[Any]:
+        return self.base.read()
+
+    def _groups(self) -> dict[str, list[tuple[int, Any]]]:
+        groups: dict[str, list[tuple[int, Any]]] = defaultdict(list)
+        for r in self.base.read():
+            groups[str(self.key_fn(r))].append((int(self.time_fn(r)), r))
+        for events in groups.values():
+            events.sort(key=lambda tr: tr[0])
+        return groups
+
+    def _aggregate_groups(self, raw_features: Sequence[FeatureLike],
+                          groups: dict[str, list[tuple[int, Any]]],
+                          cutoff_of: Callable[[str], Optional[int]]
+                          ) -> HostFrame:
+        keys = sorted(groups)
+        aggs = []
+        for f in raw_features:
+            stage = f.origin_stage
+            agg = stage.aggregator or aggregator_of(f.ftype)
+            aggs.append(FeatureAggregator(
+                agg, is_response=f.is_response,
+                window_ms=getattr(stage, "window_ms", None)))
+        cols: dict[str, list[Any]] = {f.name: [] for f in raw_features}
+        for k in keys:
+            cutoff = cutoff_of(k)
+            events = groups[k]
+            for f, fa in zip(raw_features, aggs):
+                stage = f.origin_stage
+                evs = [Event(t, stage.extract(r)) for t, r in events]
+                cols[f.name].append(fa.extract(evs, cutoff))
+        host_cols = {f.name: HostColumn.from_values(f.ftype, cols[f.name])
+                     for f in raw_features}
+        return HostFrame(host_cols, np.asarray(keys, dtype=object))
+
+
+class AggregateDataReader(_GroupingReader):
+    """Aggregate all of an entity's events up to a global cutoff time."""
+
+    def __init__(self, base: DataReader,
+                 key_fn: Callable[[Any], str],
+                 time_fn: Callable[[Any], int],
+                 cutoff_ms: Optional[int] = None):
+        super().__init__(base, key_fn, time_fn)
+        self.cutoff_ms = cutoff_ms
+
+    def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
+        groups = self._groups()
+        return self._aggregate_groups(
+            raw_features, groups, lambda _k: self.cutoff_ms)
+
+
+class ConditionalDataReader(_GroupingReader):
+    """Per-key cutoff from the first event matching ``condition_fn``;
+    response aggregates after the condition event, predictors before."""
+
+    def __init__(self, base: DataReader,
+                 key_fn: Callable[[Any], str],
+                 time_fn: Callable[[Any], int],
+                 condition_fn: Callable[[Any], bool],
+                 drop_if_no_condition: bool = True):
+        super().__init__(base, key_fn, time_fn)
+        self.condition_fn = condition_fn
+        self.drop_if_no_condition = drop_if_no_condition
+
+    def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
+        groups = self._groups()
+        cutoffs: dict[str, Optional[int]] = {}
+        for k, events in groups.items():
+            cut = None
+            for t, r in events:
+                if self.condition_fn(r):
+                    cut = t
+                    break
+            cutoffs[k] = cut
+        if self.drop_if_no_condition:
+            groups = {k: v for k, v in groups.items() if cutoffs[k] is not None}
+        return self._aggregate_groups(raw_features, groups,
+                                      lambda k: cutoffs[k])

@@ -1,0 +1,83 @@
+"""Data readers: records -> raw-feature HostFrame.
+
+Parity: reference ``readers/src/main/scala/com/salesforce/op/readers/
+DataReader.scala:58-208`` — ``generateDataFrame(rawFeatures)`` runs every
+``FeatureGeneratorStage.extract_fn`` per record and builds the raw frame with
+an optional entity-key column. Here the result is a columnar ``HostFrame``
+(device residency happens lazily downstream), so the per-record loop is the
+ingest boundary, not the compute hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu.features.feature import FeatureLike
+from transmogrifai_tpu.frame import HostColumn, HostFrame
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage
+
+__all__ = ["DataReader", "CustomReader"]
+
+
+class DataReader:
+    """Abstract reader of records (python dicts or objects)."""
+
+    def __init__(self, key_fn: Optional[Callable[[Any], str]] = None):
+        self.key_fn = key_fn
+
+    def read(self) -> Iterable[Any]:
+        raise NotImplementedError
+
+    # -- raw data generation -------------------------------------------------
+    def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
+        records = self.read()
+        if not isinstance(records, (list, tuple)):
+            records = list(records)
+        stages = [_origin(f) for f in raw_features]
+        cols = {}
+        for f, stage in zip(raw_features, stages):
+            vals = [stage.extract(r) for r in records]
+            cols[f.name] = HostColumn.from_values(f.ftype, vals)
+        key = None
+        if self.key_fn is not None:
+            key = np.asarray([str(self.key_fn(r)) for r in records], dtype=object)
+        return HostFrame(cols, key)
+
+
+def _origin(f: FeatureLike) -> FeatureGeneratorStage:
+    stage = f.origin_stage
+    if not isinstance(stage, FeatureGeneratorStage):
+        raise ValueError(
+            f"Feature {f.name!r} is not raw (origin {type(stage).__name__}); "
+            "readers generate raw features only")
+    return stage
+
+
+class CustomReader(DataReader):
+    """Wraps an in-memory record collection or a HostFrame (the analog of
+    ``setInputDataset``/``setInputRDD`` wrapping data in a CustomReader)."""
+
+    def __init__(self, records: Optional[Iterable[Any]] = None,
+                 frame: Optional[HostFrame] = None,
+                 key_fn: Optional[Callable[[Any], str]] = None):
+        super().__init__(key_fn)
+        if (records is None) == (frame is None):
+            raise ValueError("CustomReader: provide exactly one of records/frame")
+        self.records = None if records is None else list(records)
+        self.frame = frame
+
+    def read(self) -> Iterable[Any]:
+        if self.records is not None:
+            return self.records
+        return list(self.frame.iter_rows())
+
+    def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
+        if self.frame is not None:
+            # fast path: columns already columnar; select + validate types
+            missing = [f.name for f in raw_features if f.name not in self.frame]
+            if missing:
+                raise KeyError(f"Frame lacks raw feature columns {missing}")
+            return self.frame.select([f.name for f in raw_features])
+        return super().generate_frame(raw_features)

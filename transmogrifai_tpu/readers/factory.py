@@ -1,0 +1,61 @@
+"""Reader factory namespace.
+
+Parity: reference ``readers/DataReaders.scala:44-270`` —
+``DataReaders.Simple/Aggregate/Conditional x {csv, csvAuto, custom}``.
+(Avro/Parquet variants land with the IO layer; the factory shape is stable.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+from transmogrifai_tpu.readers.aggregates import (
+    AggregateDataReader, ConditionalDataReader,
+)
+from transmogrifai_tpu.readers.base import CustomReader, DataReader
+from transmogrifai_tpu.readers.csv import CSVReader
+
+__all__ = ["DataReaders"]
+
+
+class DataReaders:
+    class Simple:
+        @staticmethod
+        def csv(path: str, schema=None, key_col: Optional[str] = None,
+                **kw) -> CSVReader:
+            return CSVReader(path, schema=schema, key_col=key_col, **kw)
+
+        @staticmethod
+        def csv_auto(path: str, key_col: Optional[str] = None, **kw) -> CSVReader:
+            return CSVReader(path, schema=None, key_col=key_col, **kw)
+
+        @staticmethod
+        def custom(records: Iterable[Any],
+                   key_fn: Optional[Callable[[Any], str]] = None) -> CustomReader:
+            return CustomReader(records=records, key_fn=key_fn)
+
+    class Aggregate:
+        @staticmethod
+        def csv(path: str, key_fn, time_fn, cutoff_ms=None, schema=None,
+                **kw) -> AggregateDataReader:
+            return AggregateDataReader(
+                CSVReader(path, schema=schema, **kw), key_fn, time_fn, cutoff_ms)
+
+        @staticmethod
+        def custom(records: Iterable[Any], key_fn, time_fn,
+                   cutoff_ms=None) -> AggregateDataReader:
+            return AggregateDataReader(
+                CustomReader(records=records), key_fn, time_fn, cutoff_ms)
+
+    class Conditional:
+        @staticmethod
+        def csv(path: str, key_fn, time_fn, condition_fn, schema=None,
+                **kw) -> ConditionalDataReader:
+            return ConditionalDataReader(
+                CSVReader(path, schema=schema, **kw), key_fn, time_fn, condition_fn)
+
+        @staticmethod
+        def custom(records: Iterable[Any], key_fn, time_fn,
+                   condition_fn) -> ConditionalDataReader:
+            return ConditionalDataReader(
+                CustomReader(records=records), key_fn, time_fn, condition_fn)
