@@ -72,6 +72,7 @@ class HostColumn:
     ftype: type[ft.FeatureType]
     values: np.ndarray
     mask: Optional[np.ndarray] = None  # bool[n]; None for kinds w/o mask
+    meta: Optional[Any] = None         # VectorMetadata for vector kinds
 
     @property
     def kind(self) -> str:
@@ -154,6 +155,7 @@ class HostColumn:
             self.ftype,
             self.values[idx],
             None if self.mask is None else self.mask[idx],
+            self.meta,
         )
 
 

@@ -1,0 +1,52 @@
+"""VectorsCombiner: concatenate vector blocks into the final feature vector.
+
+Parity: reference ``core/.../stages/impl/feature/VectorsCombiner.scala`` —
+N OPVector inputs concatenate in input order; metadata flattens with global
+column reindexing (``OpVectorMetadata.flatten``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import DeviceTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    VectorColumnMetadata, VectorMetadata,
+)
+
+__all__ = ["VectorsCombiner"]
+
+
+class VectorsCombiner(DeviceTransformer):
+    variadic = True
+    in_types = (ft.OPVector,)
+    out_type = ft.OPVector
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def device_apply(self, params, *cols: fr.VectorColumn) -> fr.VectorColumn:
+        metas = []
+        for i, c in enumerate(cols):
+            m = c.metadata
+            width = int(c.values.shape[1])
+            if m is None or m.size != width:
+                # anonymous per-column provenance for metadata-less inputs
+                name = self.input_names[i]
+                m = VectorMetadata(name, tuple(
+                    VectorColumnMetadata((name,), ("OPVector",),
+                                         descriptor_value=f"col_{j}")
+                    for j in range(width)))
+            metas.append(m)
+        meta = VectorMetadata.flatten(self.get_output().name, metas)
+        vals = jnp.concatenate([c.values for c in cols], axis=1)
+        return fr.VectorColumn(vals, meta)
+
+    def transform_row(self, *values):
+        return np.concatenate([np.asarray(v, dtype=np.float32).ravel()
+                               for v in values])

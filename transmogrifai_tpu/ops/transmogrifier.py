@@ -1,0 +1,130 @@
+"""Transmogrifier: automatic per-type vectorization dispatch.
+
+Parity: reference ``core/.../stages/impl/feature/Transmogrifier.scala:52-352``
+— ``.transmogrify()`` groups raw/derived features by type and applies each
+group's default vectorizer, then combines everything with VectorsCombiner.
+Reference defaults honored: TopK=20, MinSupport=10, 512 hash features,
+TrackNulls=true, circular date representation.
+
+Type routing (reference Transmogrifier case analysis):
+  Real/RealNN/Currency/Percent        -> RealVectorizer (mean fill)
+  Integral                            -> IntegralVectorizer (mode fill)
+  Binary                              -> BinaryVectorizer
+  Date/DateTime                       -> DateToUnitCircleVectorizer
+  PickList/ComboBox/ID + Country/State/City/PostalCode/Street
+                                      -> OneHotVectorizer (topK pivot)
+  Text/TextArea/Email/URL/Phone/Base64-> TextHashingVectorizer
+  MultiPickList                       -> SetVectorizer
+  Geolocation                         -> GeolocationVectorizer
+  OPVector                            -> passthrough to the combiner
+  (SmartText* cardinality-adaptive vectorizers supersede the static text
+  routing when enabled — see ops/smart_text.py.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from transmogrifai_tpu.features.feature import FeatureLike
+from transmogrifai_tpu.ops.combiner import VectorsCombiner
+from transmogrifai_tpu.ops.vectorizers.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.vectorizers.geolocation import GeolocationVectorizer
+from transmogrifai_tpu.ops.vectorizers.hashing import TextHashingVectorizer
+from transmogrifai_tpu.ops.vectorizers.numeric import (
+    BinaryVectorizer, IntegralVectorizer, RealVectorizer,
+)
+from transmogrifai_tpu.ops.vectorizers.onehot import OneHotVectorizer, SetVectorizer
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["transmogrify", "TransmogrifierDefaults"]
+
+
+class TransmogrifierDefaults:
+    """Reference defaults (Transmogrifier.scala:53-70)."""
+    TOP_K = 20
+    MIN_SUPPORT = 10
+    NUM_HASH_FEATURES = 512
+    MAX_NUM_HASH_FEATURES = 2 ** 17
+    TRACK_NULLS = True
+    DATE_TIME_PERIOD = "HourOfDay"
+
+
+_PIVOT_TYPES = (ft.PickList, ft.ComboBox, ft.ID, ft.Country, ft.State,
+                ft.City, ft.PostalCode, ft.Street)
+_HASH_TYPES = (ft.Base64, ft.Email, ft.Phone, ft.URL, ft.TextArea, ft.Text)
+
+
+def _route(f: FeatureLike) -> str:
+    t = f.ftype
+    if issubclass(t, (ft.Date,)):  # Date/DateTime before Integral
+        return "date"
+    if issubclass(t, ft.Binary):
+        return "binary"
+    if issubclass(t, ft.Integral):
+        return "integral"
+    if issubclass(t, ft.Real):  # Real/RealNN/Currency/Percent
+        return "real"
+    if issubclass(t, ft.MultiPickList):
+        return "multipicklist"
+    if issubclass(t, ft.Geolocation):
+        return "geolocation"
+    if issubclass(t, ft.OPVector):
+        return "vector"
+    if issubclass(t, _PIVOT_TYPES):
+        return "pivot"
+    if issubclass(t, ft.Text):
+        return "hash"
+    raise TypeError(
+        f"Transmogrifier has no default vectorizer for {t.__name__} "
+        f"(feature {f.name!r}); vectorize it explicitly")
+
+
+def transmogrify(features: Sequence[FeatureLike],
+                 top_k: int = TransmogrifierDefaults.TOP_K,
+                 min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
+                 num_hash_features: int = TransmogrifierDefaults.NUM_HASH_FEATURES,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 date_time_period: str = TransmogrifierDefaults.DATE_TIME_PERIOD,
+                 ) -> FeatureLike:
+    """Vectorize a heterogeneous feature set into one combined OPVector."""
+    if not features:
+        raise ValueError("transmogrify: no features given")
+    groups: dict[str, list[FeatureLike]] = {}
+    for f in features:
+        groups.setdefault(_route(f), []).append(f)
+
+    blocks: list[FeatureLike] = []
+    order = ["real", "integral", "binary", "date", "pivot", "hash",
+             "multipicklist", "geolocation", "vector"]
+    for kind in order:
+        fs = groups.get(kind)
+        if not fs:
+            continue
+        if kind == "real":
+            stage = RealVectorizer(track_nulls=track_nulls)
+        elif kind == "integral":
+            stage = IntegralVectorizer(track_nulls=track_nulls)
+        elif kind == "binary":
+            stage = BinaryVectorizer(track_nulls=track_nulls)
+        elif kind == "date":
+            stage = DateToUnitCircleVectorizer(
+                time_period=date_time_period, track_nulls=track_nulls)
+        elif kind == "pivot":
+            stage = OneHotVectorizer(top_k=top_k, min_support=min_support,
+                                     track_nulls=track_nulls)
+        elif kind == "hash":
+            stage = TextHashingVectorizer(num_features=num_hash_features,
+                                          track_nulls=track_nulls)
+        elif kind == "multipicklist":
+            stage = SetVectorizer(top_k=top_k, min_support=min_support,
+                                  track_nulls=track_nulls)
+        elif kind == "geolocation":
+            stage = GeolocationVectorizer(track_nulls=track_nulls)
+        else:  # passthrough vectors
+            blocks.extend(fs)
+            continue
+        blocks.append(fs[0].transform_with(stage, *fs[1:]))
+
+    if len(blocks) == 1:
+        return blocks[0]
+    return blocks[0].transform_with(VectorsCombiner(), *blocks[1:])

@@ -1,0 +1,15 @@
+from transmogrifai_tpu.ops.vectorizers.numeric import (
+    BinaryVectorizer, IntegralVectorizer, RealVectorizer,
+)
+from transmogrifai_tpu.ops.vectorizers.onehot import (
+    OneHotVectorizer, SetVectorizer,
+)
+from transmogrifai_tpu.ops.vectorizers.hashing import TextHashingVectorizer
+from transmogrifai_tpu.ops.vectorizers.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.combiner import VectorsCombiner
+
+__all__ = [
+    "BinaryVectorizer", "IntegralVectorizer", "RealVectorizer",
+    "OneHotVectorizer", "SetVectorizer", "TextHashingVectorizer",
+    "DateToUnitCircleVectorizer", "VectorsCombiner",
+]

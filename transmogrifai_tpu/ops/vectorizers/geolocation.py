@@ -1,0 +1,97 @@
+"""Geolocation vectorizer: (lat, lon, accuracy) -> numeric block.
+
+Parity: reference ``core/.../stages/impl/feature/GeolocationVectorizer.scala``
+— mean-fill missing coordinates (geolocation midpoint of the training data)
+plus a null-indicator column per input.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import Estimator, HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
+)
+
+__all__ = ["GeolocationVectorizer", "GeolocationModel"]
+
+
+class GeolocationVectorizer(Estimator):
+    variadic = True
+    in_types = (ft.Geolocation,)
+    out_type = ft.OPVector
+
+    def __init__(self, fill_with_mean: bool = True, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        self.fill_with_mean = fill_with_mean
+        self.track_nulls = track_nulls
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        fills = []
+        for name in self.input_names:
+            col = data.host_col(name)
+            present = col.values[col.mask]
+            if self.fill_with_mean and present.shape[0] > 0:
+                fills.append(present.mean(axis=0).tolist())
+            else:
+                fills.append([0.0, 0.0, 0.0])
+        return GeolocationModel(fill_values=fills, track_nulls=self.track_nulls)
+
+
+class GeolocationModel(HostTransformer):
+    variadic = True
+    in_types = (ft.Geolocation,)
+    out_type = ft.OPVector
+
+    def __init__(self, fill_values: Sequence[Sequence[float]] = (),
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        self.fill_values = [list(v) for v in fill_values]
+        self.track_nulls = track_nulls
+        super().__init__(uid=uid)
+
+    def transform_row(self, *values):
+        out = []
+        for i, v in enumerate(values):
+            missing = not v
+            out.extend(self.fill_values[i] if missing else list(v))
+            if self.track_nulls:
+                out.append(1.0 if missing else 0.0)
+        return np.asarray(out, dtype=np.float32)
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        n = len(cols[0])
+        blocks = []
+        for i, c in enumerate(cols):
+            fill = np.asarray(self.fill_values[i], dtype=np.float32)
+            vals = np.where(c.mask[:, None], c.values, fill[None, :]).astype(np.float32)
+            if self.track_nulls:
+                vals = np.concatenate(
+                    [vals, (~c.mask).astype(np.float32)[:, None]], axis=1)
+            blocks.append(vals)
+        return fr.HostColumn(ft.OPVector, np.concatenate(blocks, axis=1),
+                             meta=self._meta())
+
+    def _meta(self) -> VectorMetadata:
+        cols = []
+        for f in self.input_features:
+            for part in ("lat", "lon", "accuracy"):
+                cols.append(VectorColumnMetadata(
+                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    descriptor_value=part))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)
+
+    def fitted_state(self):
+        return {"fill_values": np.asarray(self.fill_values, np.float64)}
+
+    def set_fitted_state(self, state):
+        self.fill_values = [list(map(float, v)) for v in state["fill_values"]]

@@ -1,0 +1,129 @@
+"""Hashing-trick vectorizer for unbounded-cardinality text.
+
+Parity: reference ``core/.../stages/impl/feature/OPCollectionHashingVectorizer
+.scala`` / ``OpHashingTF.scala`` — tokens hash into a fixed number of bins
+(default 512, max 2^17 in the reference Transmogrifier defaults), shared or
+separate hash space per input, optional binary (presence) vs count values,
+plus a null-indicator per input.
+
+Host/device split (SURVEY §7 hard part #2): tokenization + hashing are
+string work and run on host into a dense [n, bins] block; everything
+downstream consumes the device VectorColumn. The hash is crc32 (stable,
+seedable by bin count) — numeric parity with Spark's murmur3 is not a
+behavioral contract, bin distribution quality is.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
+)
+
+__all__ = ["TextHashingVectorizer", "hash_token"]
+
+_TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
+
+
+def hash_token(token: str, num_bins: int) -> int:
+    return zlib.crc32(token.encode("utf-8")) % num_bins
+
+
+def tokenize(text: str, lowercase: bool = True) -> list[str]:
+    if lowercase:
+        text = text.lower()
+    return _TOKEN_RE.findall(text)
+
+
+class TextHashingVectorizer(HostTransformer):
+    """N text inputs -> [n, N*(bins[+1])] hashed token counts."""
+
+    variadic = True
+    in_types = (ft.Text,)
+    out_type = ft.OPVector
+
+    def __init__(self, num_features: int = 512, binary_freq: bool = False,
+                 lowercase: bool = True, track_nulls: bool = True,
+                 shared_hash_space: bool = False,
+                 uid: Optional[str] = None):
+        self.num_features = num_features
+        self.binary_freq = binary_freq
+        self.lowercase = lowercase
+        self.track_nulls = track_nulls
+        self.shared_hash_space = shared_hash_space
+        super().__init__(uid=uid)
+
+    # -- hashing core --------------------------------------------------------
+    def _accumulate(self, text: Optional[str], row: np.ndarray, offset: int):
+        if text is None:
+            return
+        for tok in tokenize(text, self.lowercase):
+            b = offset + hash_token(tok, self.num_features)
+            if self.binary_freq:
+                row[b] = 1.0
+            else:
+                row[b] += 1.0
+
+    def _layout(self, n_inputs: int) -> tuple[int, list[int], int]:
+        """(hash_width, per-input offsets, total_width)."""
+        if self.shared_hash_space:
+            hash_width = self.num_features
+            offsets = [0] * n_inputs
+        else:
+            hash_width = self.num_features * n_inputs
+            offsets = [self.num_features * i for i in range(n_inputs)]
+        total = hash_width + (n_inputs if self.track_nulls else 0)
+        return hash_width, offsets, total
+
+    def transform_row(self, *values):
+        hash_width, offsets, total = self._layout(len(values))
+        row = np.zeros(total, dtype=np.float32)
+        for i, v in enumerate(values):
+            self._accumulate(v, row, offsets[i])
+            if self.track_nulls and v is None:
+                row[hash_width + i] = 1.0
+        return row
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        n = len(cols[0])
+        hash_width, offsets, total = self._layout(len(cols))
+        out = np.zeros((n, total), dtype=np.float32)
+        for i, col in enumerate(cols):
+            for r in range(n):
+                v = col.values[r]
+                self._accumulate(v, out[r], offsets[i])
+                if self.track_nulls and v is None:
+                    out[r, hash_width + i] = 1.0
+        return fr.HostColumn(ft.OPVector, out, meta=self._meta(len(cols)))
+
+    def _meta(self, n_inputs: int) -> VectorMetadata:
+        feats = self.input_features
+        hash_width, offsets, _ = self._layout(n_inputs)
+        cols = []
+        if self.shared_hash_space:
+            all_names = tuple(f.name for f in feats)
+            all_types = tuple(f.ftype.__name__ for f in feats)
+            for j in range(self.num_features):
+                cols.append(VectorColumnMetadata(
+                    all_names, all_types, grouping=None,
+                    descriptor_value=f"hash_{j}"))
+        else:
+            for f in feats:
+                for j in range(self.num_features):
+                    cols.append(VectorColumnMetadata(
+                        (f.name,), (f.ftype.__name__,), grouping=f.name,
+                        descriptor_value=f"hash_{j}"))
+        if self.track_nulls:
+            for f in feats:
+                cols.append(VectorColumnMetadata(
+                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)

@@ -1,0 +1,217 @@
+"""Categorical pivot vectorizers: topK one-hot + OTHER + null indicator.
+
+Parity: reference ``core/.../stages/impl/feature/OpOneHotVectorizer.scala``
+(``OpSetVectorizer`` for MultiPickList): per input feature, learn the topK
+category values by count (>= min_support), emit one column per category plus
+an OTHER column (unseen/rare values) and a null-indicator column.
+
+TPU-first: categories are learned as label strings (vocabulary-independent);
+at transform time the device program builds a static code->slot gather table
+from the input ``CodesColumn``'s dictionary (aux data, so a new scoring
+vocabulary retraces once and is cached) and the pivot is a one-hot gather —
+MXU-friendly and fused into the layer program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import DeviceTransformer, Estimator, HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    NULL_INDICATOR, OTHER, VectorColumnMetadata, VectorMetadata,
+)
+
+__all__ = ["OneHotVectorizer", "OneHotModel", "SetVectorizer", "SetModel"]
+
+
+def _pivot_meta(out_name: str, input_feats, categories: Sequence[Sequence[str]],
+                track_nulls: bool) -> VectorMetadata:
+    cols = []
+    for f, cats in zip(input_feats, categories):
+        for c in cats:
+            cols.append(VectorColumnMetadata(
+                (f.name,), (f.ftype.__name__,), grouping=f.name,
+                indicator_value=c))
+        cols.append(VectorColumnMetadata(
+            (f.name,), (f.ftype.__name__,), grouping=f.name,
+            indicator_value=OTHER))
+        if track_nulls:
+            cols.append(VectorColumnMetadata(
+                (f.name,), (f.ftype.__name__,), grouping=f.name,
+                indicator_value=NULL_INDICATOR))
+    return VectorMetadata(out_name, tuple(cols)).reindexed(0)
+
+
+def _top_k(values: Sequence[str], counts: Sequence[int], top_k: int,
+           min_support: int) -> list[str]:
+    """Most frequent first; ties lexicographic; support threshold applied."""
+    pairs = [(c, v) for v, c in zip(values, counts) if c >= min_support]
+    pairs.sort(key=lambda cv: (-cv[0], cv[1]))
+    return [v for _, v in pairs[:top_k]]
+
+
+class OneHotVectorizer(Estimator):
+    """Variadic estimator over text-ish categorical inputs."""
+
+    variadic = True
+    in_types = (ft.Text,)
+    out_type = ft.OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True,
+                 max_pct_cardinality: float = 1.0,
+                 uid: Optional[str] = None):
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.max_pct_cardinality = max_pct_cardinality
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        categories: list[list[str]] = []
+        n = max(data.n_rows, 1)
+        for name in self.input_names:
+            codes_col = data.device_col(name)
+            codes = np.asarray(codes_col.codes)
+            vocab = codes_col.vocab
+            counts = np.bincount(codes[codes >= 0], minlength=len(vocab))
+            if len(vocab) / n > self.max_pct_cardinality:
+                categories.append([])  # too-high cardinality: pivot nothing
+            else:
+                categories.append(
+                    _top_k(list(vocab), counts.tolist(), self.top_k,
+                           self.min_support))
+        return OneHotModel(categories=categories, track_nulls=self.track_nulls)
+
+
+class OneHotModel(DeviceTransformer):
+    variadic = True
+    in_types = (ft.Text,)
+    out_type = ft.OPVector
+
+    def __init__(self, categories: Sequence[Sequence[str]] = (),
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        self.categories = [list(c) for c in categories]
+        self.track_nulls = track_nulls
+        super().__init__(uid=uid)
+
+    def device_apply(self, params, *cols: fr.CodesColumn) -> fr.VectorColumn:
+        pieces = []
+        for i, c in enumerate(cols):
+            cats = self.categories[i]
+            slot_of = {v: j for j, v in enumerate(cats)}
+            k = len(cats)
+            width = k + 2 if self.track_nulls else k + 1
+            # static gather table from this column's dictionary (aux data)
+            table = np.full(max(len(c.vocab), 1), k, dtype=np.int32)  # -> OTHER
+            for j, v in enumerate(c.vocab):
+                table[j] = slot_of.get(v, k)
+            null_slot = k + 1 if self.track_nulls else width  # width -> zeros
+            slots = jnp.where(c.codes >= 0,
+                              jnp.asarray(table)[jnp.clip(c.codes, 0)],
+                              null_slot)
+            pieces.append(jax.nn.one_hot(slots, width, dtype=jnp.float32))
+        meta = _pivot_meta(self.get_output().name, self.input_features,
+                           self.categories, self.track_nulls)
+        return fr.VectorColumn(jnp.concatenate(pieces, axis=1), meta)
+
+    def transform_row(self, *values):
+        out = []
+        for i, v in enumerate(values):
+            cats = self.categories[i]
+            k = len(cats)
+            width = k + 2 if self.track_nulls else k + 1
+            row = [0.0] * width
+            if v is None:
+                if self.track_nulls:
+                    row[k + 1] = 1.0
+            elif v in cats:
+                row[cats.index(v)] = 1.0
+            else:
+                row[k] = 1.0
+            out.extend(row)
+        return np.asarray(out, dtype=np.float32)
+
+    def fitted_state(self):
+        return {"categories": self.categories}
+
+    def set_fitted_state(self, state):
+        self.categories = [list(c) for c in state["categories"]]
+
+
+class SetVectorizer(Estimator):
+    """MultiPickList pivot: topK multi-hot + OTHER + null."""
+
+    variadic = True
+    in_types = (ft.MultiPickList,)
+    out_type = ft.OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        categories = []
+        for name in self.input_names:
+            col = data.host_col(name)
+            counts: dict[str, int] = {}
+            for s in col.values:
+                for v in (s or ()):
+                    counts[v] = counts.get(v, 0) + 1
+            categories.append(_top_k(list(counts), list(counts.values()),
+                                     self.top_k, self.min_support))
+        return SetModel(categories=categories, track_nulls=self.track_nulls)
+
+
+class SetModel(HostTransformer):
+    variadic = True
+    in_types = (ft.MultiPickList,)
+    out_type = ft.OPVector
+
+    def __init__(self, categories: Sequence[Sequence[str]] = (),
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        self.categories = [list(c) for c in categories]
+        self.track_nulls = track_nulls
+        super().__init__(uid=uid)
+
+    def transform_row(self, *values):
+        out = []
+        for i, s in enumerate(values):
+            cats = self.categories[i]
+            k = len(cats)
+            width = k + 2 if self.track_nulls else k + 1
+            row = [0.0] * width
+            if not s:
+                if self.track_nulls:
+                    row[k + 1] = 1.0
+            else:
+                for v in s:
+                    if v in cats:
+                        row[cats.index(v)] = 1.0
+                    else:
+                        row[k] = 1.0
+            out.extend(row)
+        return np.asarray(out, dtype=np.float32)
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        n = len(cols[0])
+        rows = [self.transform_row(*(c.values[i] for c in cols))
+                for i in range(n)]
+        meta = _pivot_meta(self.get_output().name, self.input_features,
+                           self.categories, self.track_nulls)
+        return fr.HostColumn(ft.OPVector, np.stack(rows), meta=meta)
+
+    def fitted_state(self):
+        return {"categories": self.categories}
+
+    def set_fitted_state(self, state):
+        self.categories = [list(c) for c in state["categories"]]

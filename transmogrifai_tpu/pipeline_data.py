@@ -86,7 +86,8 @@ class PipelineData:
             self.device[name] = dev
             return dev
         if kind == "vector":
-            dev = fr.VectorColumn(_shard(jnp.asarray(col.values, jnp.float32)))
+            dev = fr.VectorColumn(_shard(jnp.asarray(col.values, jnp.float32)),
+                                  col.meta)
             self.device[name] = dev
             return dev
         if kind in fr.TEXT_KINDS:
@@ -113,7 +114,8 @@ class PipelineData:
             mask = np.asarray(col.mask) > 0.5
             return fr.HostColumn(ft.Real, vals, mask)
         if isinstance(col, fr.VectorColumn):
-            return fr.HostColumn(ft.OPVector, np.asarray(col.values, np.float32))
+            return fr.HostColumn(ft.OPVector, np.asarray(col.values, np.float32),
+                                 meta=col.metadata)
         if isinstance(col, fr.CodesColumn):
             codes = np.asarray(col.codes)
             vals = np.empty(codes.shape[0], dtype=object)
