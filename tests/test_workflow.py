@@ -1,0 +1,137 @@
+"""Workflow engine tests: selector on synthetic data, save/load round trip,
+local scoring parity (parity: reference OpWorkflowTest /
+OpWorkflowModelReaderWriterTest)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, DataSplitter,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow, load_model
+
+
+def _synthetic_frame(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    cat_eff = np.where(cat == "a", 1.5, np.where(cat == "b", -1.0, 0.0))
+    logits = 1.2 * x1 - 0.8 * x2 + cat_eff
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(float)
+    return fr.HostFrame.from_dict({
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "cat": (ft.PickList, cat.tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+
+
+def _train(frame, seed=7):
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    features = transmogrify(list(feats.values()), min_support=1)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3, seed=seed,
+        models_and_parameters=[
+            (OpLogisticRegression(), [{"reg_param": r} for r in (0.0, 0.01, 0.1)]),
+        ],
+        splitter=DataSplitter(reserve_test_fraction=0.2, seed=seed))
+    pred = label.transform_with(selector, features)
+    model = (Workflow()
+             .set_input_frame(frame)
+             .set_result_features(pred, features)
+             .train())
+    return model, pred, label
+
+
+def test_workflow_train_score_evaluate():
+    frame = _synthetic_frame()
+    model, pred, label = _train(frame)
+    scores = model.score(frame)
+    assert scores.n_rows == frame.n_rows
+    p0 = scores[pred.name].python_value(0)
+    assert "prediction" in p0 and "probability_1" in p0
+    metrics = model.evaluate(frame, OpBinaryClassificationEvaluator())
+    assert metrics.au_roc > 0.75
+    summary = model.selector_summary()
+    assert summary is not None
+    assert summary.best_model_type == "OpLogisticRegression"
+    assert len(summary.validation_results) == 3
+    assert summary.holdout_evaluation
+    js = model.summary_json()
+    assert js["selectedModel"]["validationMetric"] == "auPR"
+
+
+def test_workflow_save_load_score_parity(tmp_path):
+    frame = _synthetic_frame()
+    model, pred, label = _train(frame)
+    scores1 = model.score(frame)
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = load_model(path)
+    scores2 = loaded.score(frame)
+    a = np.stack([np.asarray([d["prediction"], d["probability_1"]])
+                  for d in (scores1[pred.name].python_value(i)
+                            for i in range(scores1.n_rows))])
+    b = np.stack([np.asarray([d["prediction"], d["probability_1"]])
+                  for d in (scores2[pred.name].python_value(i)
+                            for i in range(scores2.n_rows))])
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_local_scoring_matches_batch(tmp_path):
+    frame = _synthetic_frame(n=120)
+    model, pred, label = _train(frame)
+    batch = model.score(frame)
+    score_fn = model.score_function()
+    for i in [0, 3, 57, 119]:
+        row = frame.row(i)
+        row.pop("label")
+        local = score_fn(row)[pred.name]
+        batch_p = batch[pred.name].python_value(i)
+        assert local["prediction"] == batch_p["prediction"]
+        assert local["probability_1"] == pytest.approx(
+            batch_p["probability_1"], abs=1e-5)
+
+
+def test_scoring_without_label_column():
+    frame = _synthetic_frame(n=100)
+    model, pred, _ = _train(frame)
+    unlabeled = frame.drop(["label"])
+    scores = model.score(unlabeled)
+    assert scores.n_rows == 100
+    # record-based readers also drop the absent response cleanly
+    from transmogrifai_tpu.readers import CustomReader
+    records = [unlabeled.row(i) for i in range(10)]
+    scores2 = model.score(CustomReader(records=records))
+    assert scores2.n_rows == 10
+
+
+def test_binary_metrics_tie_handling():
+    from transmogrifai_tpu.evaluators.binary import binary_metrics_arrays
+    s = np.full(100, 0.5)
+    for y in (np.r_[np.ones(50), np.zeros(50)], np.r_[np.zeros(50), np.ones(50)]):
+        m = binary_metrics_arrays(y, s)
+        assert m.au_roc == pytest.approx(0.5, abs=1e-6)
+    m = binary_metrics_arrays(np.array([1.0, 1.0, 0.0, 0.0]),
+                              np.array([0.9, 0.8, 0.2, 0.1]))
+    assert m.au_roc == pytest.approx(1.0, abs=1e-6)
+
+
+def test_loaded_model_keeps_selector_summary(tmp_path):
+    frame = _synthetic_frame(n=150)
+    model, pred, _ = _train(frame)
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = load_model(path)
+    s = loaded.selector_summary()
+    assert s is not None
+    assert s.best_model_type == "OpLogisticRegression"
+    assert loaded.summary_json()["selectedModel"]["validationMetric"] == "auPR"

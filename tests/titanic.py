@@ -1,0 +1,47 @@
+"""Titanic feature definitions shared by tests/bench (module-level so the
+derived-feature lambdas are serializable)."""
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.readers import CSVReader
+from transmogrifai_tpu.stages.base import LambdaTransformer
+from transmogrifai_tpu.types import feature_types as ft
+
+TITANIC_CSV = ("/root/reference/helloworld/src/main/resources/"
+               "TitanicDataset/TitanicPassengersTrainData.csv")
+
+COLUMNS = ["id", "survived", "pclass", "name", "sex", "age", "sibsp",
+           "parch", "ticket", "fare", "cabin", "embarked"]
+
+SCHEMA = {
+    "id": ft.ID, "survived": ft.RealNN, "pclass": ft.PickList,
+    "name": ft.Text, "sex": ft.PickList, "age": ft.Real,
+    "sibsp": ft.Integral, "parch": ft.Integral, "ticket": ft.PickList,
+    "fare": ft.Real, "cabin": ft.PickList, "embarked": ft.PickList,
+}
+
+
+def family_size(sibsp, parch):
+    return float((sibsp or 0) + (parch or 0) + 1)
+
+
+def titanic_reader() -> CSVReader:
+    return CSVReader(TITANIC_CSV, schema=SCHEMA, header=False,
+                     columns=COLUMNS, key_col="id")
+
+
+def titanic_features():
+    """(response, predictor list) mirroring helloworld OpTitanicSimple."""
+    survived = FeatureBuilder.RealNN("survived").as_response()
+    pclass = FeatureBuilder.PickList("pclass").as_predictor()
+    sex = FeatureBuilder.PickList("sex").as_predictor()
+    age = FeatureBuilder.Real("age").as_predictor()
+    sibsp = FeatureBuilder.Integral("sibsp").as_predictor()
+    parch = FeatureBuilder.Integral("parch").as_predictor()
+    fare = FeatureBuilder.Real("fare").as_predictor()
+    cabin = FeatureBuilder.PickList("cabin").as_predictor()
+    embarked = FeatureBuilder.PickList("embarked").as_predictor()
+    fam = sibsp.transform_with(
+        LambdaTransformer(family_size, in_types=(ft.Integral, ft.Integral),
+                          out_type=ft.Real), parch)
+    predictors = [pclass, sex, age, sibsp, parch, fare, cabin, embarked, fam]
+    return survived, predictors

@@ -108,7 +108,7 @@ class DagExecutor:
             fused = self._fused_program(dev_ts)
             params = {t.uid: t.device_params() for t in dev_ts}
             in_cols = {n: data.device_col(n)
-                       for t in dev_ts for n in t.input_names}
+                       for t in dev_ts for n in t.runtime_input_names()}
             outs = fused(params, in_cols)
             data = data.with_device_cols(outs)
         return data
@@ -124,7 +124,7 @@ class DagExecutor:
         def fused(params, in_cols):
             out = {}
             for t in ts:
-                cols = [in_cols[n] for n in t.input_names]
+                cols = [in_cols[n] for n in t.runtime_input_names()]
                 out[t.get_output().name] = t.device_apply(params[t.uid], *cols)
             return out
 

@@ -1,0 +1,17 @@
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+from transmogrifai_tpu.evaluators.binary import (
+    BinaryClassificationMetrics, OpBinaryClassificationEvaluator,
+)
+from transmogrifai_tpu.evaluators.multi import (
+    MultiClassificationMetrics, OpMultiClassificationEvaluator,
+)
+from transmogrifai_tpu.evaluators.regression import (
+    OpRegressionEvaluator, RegressionMetrics,
+)
+
+__all__ = [
+    "EvaluatorBase",
+    "BinaryClassificationMetrics", "OpBinaryClassificationEvaluator",
+    "MultiClassificationMetrics", "OpMultiClassificationEvaluator",
+    "OpRegressionEvaluator", "RegressionMetrics",
+]

@@ -1,0 +1,58 @@
+"""Evaluator base.
+
+Parity: reference ``core/.../evaluators/OpEvaluatorBase.scala:113-226`` —
+evaluators consume (label, prediction) and emit a typed metrics bundle;
+each declares its default metric and whether larger is better (drives the
+ModelSelector's argbest).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["EvaluatorBase"]
+
+
+class EvaluatorBase:
+    name: str = "evaluator"
+    default_metric: str = ""
+    #: metric name -> larger_is_better
+    metric_directions: dict[str, bool] = {}
+
+    def evaluate_arrays(self, y, pred_col, w=None) -> Any:
+        """Compute metrics from a label array + PredictionColumn."""
+        raise NotImplementedError
+
+    def evaluate(self, data, label_name: str, pred_name: str) -> Any:
+        """Evaluate against a PipelineData holding label + prediction cols."""
+        y = data.device_col(label_name).values
+        pred = data.device_col(pred_name)
+        return self.evaluate_arrays(y, pred)
+
+    def metric_value(self, metrics: Any, metric: Optional[str] = None) -> float:
+        m = metric or self.default_metric
+        return float(getattr(metrics, _snake(m)))
+
+    def larger_is_better(self, metric: Optional[str] = None) -> bool:
+        m = metric or self.default_metric
+        return self.metric_directions.get(m, True)
+
+    @staticmethod
+    def to_json(metrics: Any) -> dict:
+        d = asdict(metrics)
+        return {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                for k, v in d.items()}
+
+
+def _snake(name: str) -> str:
+    """auPR -> au_pr, AuROC -> au_roc, F1 -> f1, Error -> error."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0 and (not name[i - 1].isupper()):
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out).replace("__", "_")

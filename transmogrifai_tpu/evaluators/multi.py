@@ -1,0 +1,73 @@
+"""Multiclass classification evaluator.
+
+Parity: reference ``core/.../evaluators/OpMultiClassificationEvaluator.scala``
+— weighted Precision/Recall/F1/Error plus top-K accuracy and the per-class
+confusion summary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+
+__all__ = ["MultiClassificationMetrics", "OpMultiClassificationEvaluator"]
+
+
+@dataclass(frozen=True)
+class MultiClassificationMetrics:
+    precision: float        # weighted by class support
+    recall: float
+    f1: float
+    error: float
+    top_k_accuracy: tuple = ()
+    confusion: Optional[list] = field(default=None, repr=False)
+
+
+class OpMultiClassificationEvaluator(EvaluatorBase):
+    name = "multiclass classification"
+    default_metric = "F1"
+    metric_directions = {"Precision": True, "Recall": True, "F1": True,
+                         "Error": False}
+
+    def __init__(self, top_ks: tuple = (1, 3), with_confusion: bool = False):
+        self.top_ks = tuple(top_ks)
+        self.with_confusion = with_confusion
+
+    def evaluate_arrays(self, y, pred_col, w=None) -> MultiClassificationMetrics:
+        y = np.asarray(y).astype(np.int64)
+        yhat = np.asarray(pred_col.prediction).astype(np.int64)
+        w = np.ones_like(y, dtype=np.float64) if w is None else np.asarray(w)
+        prob = np.asarray(pred_col.probability)
+        n_cls = max(int(y.max()), int(yhat.max())) + 1 if y.size else 1
+        conf = np.zeros((n_cls, n_cls))
+        np.add.at(conf, (y, yhat), w)
+        support = conf.sum(axis=1)
+        pred_count = conf.sum(axis=0)
+        diag = np.diag(conf)
+        prec_c = np.divide(diag, pred_count, out=np.zeros(n_cls),
+                           where=pred_count > 0)
+        rec_c = np.divide(diag, support, out=np.zeros(n_cls),
+                          where=support > 0)
+        f1_c = np.divide(2 * prec_c * rec_c, prec_c + rec_c,
+                         out=np.zeros(n_cls), where=(prec_c + rec_c) > 0)
+        wsum = max(support.sum(), 1e-12)
+        precision = float((prec_c * support).sum() / wsum)
+        recall = float((rec_c * support).sum() / wsum)
+        f1 = float((f1_c * support).sum() / wsum)
+        error = 1.0 - float(diag.sum() / wsum)
+        topks = []
+        if prob.size and prob.shape[1] > 1:
+            order = np.argsort(-prob, axis=1)
+            for k in self.top_ks:
+                hit = (order[:, :k] == y[:, None]).any(axis=1)
+                topks.append(float((hit * w).sum() / wsum))
+        return MultiClassificationMetrics(
+            precision=precision, recall=recall, f1=f1, error=error,
+            top_k_accuracy=tuple(topks),
+            confusion=conf.tolist() if self.with_confusion else None)

@@ -1,0 +1,60 @@
+"""Regression evaluator.
+
+Parity: reference ``core/.../evaluators/OpRegressionEvaluator.scala`` —
+RMSE/MSE/R2/MAE plus the signed-percentage-error histogram.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+
+__all__ = ["RegressionMetrics", "OpRegressionEvaluator"]
+
+
+@dataclass(frozen=True)
+class RegressionMetrics:
+    rmse: float
+    mse: float
+    r2: float
+    mae: float
+    signed_percentage_error_histogram: Optional[dict] = field(
+        default=None, repr=False)
+
+
+class OpRegressionEvaluator(EvaluatorBase):
+    name = "regression"
+    default_metric = "RMSE"
+    metric_directions = {"RMSE": False, "MSE": False, "MAE": False, "R2": True}
+
+    def __init__(self, with_error_histogram: bool = False,
+                 histogram_bins: tuple = (-100.0, -50.0, -25.0, -10.0, 0.0,
+                                          10.0, 25.0, 50.0, 100.0)):
+        self.with_error_histogram = with_error_histogram
+        self.histogram_bins = tuple(histogram_bins)
+
+    def evaluate_arrays(self, y, pred_col, w=None) -> RegressionMetrics:
+        y = jnp.asarray(y, jnp.float32)
+        yhat = jnp.asarray(pred_col.prediction, jnp.float32)
+        w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        err = yhat - y
+        mse = float(jnp.sum(w * err ** 2) / wsum)
+        mae = float(jnp.sum(w * jnp.abs(err)) / wsum)
+        ybar = jnp.sum(w * y) / wsum
+        ss_tot = float(jnp.sum(w * (y - ybar) ** 2))
+        ss_res = float(jnp.sum(w * err ** 2))
+        r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+        hist = None
+        if self.with_error_histogram:
+            pct = np.asarray(100.0 * err / jnp.where(jnp.abs(y) < 1e-12, 1.0, y))
+            counts, edges = np.histogram(pct, bins=np.asarray(self.histogram_bins))
+            hist = {"binEdges": edges.tolist(), "counts": counts.tolist()}
+        return RegressionMetrics(
+            rmse=float(np.sqrt(mse)), mse=mse, r2=r2, mae=mae,
+            signed_percentage_error_histogram=hist)

@@ -1,4 +1,12 @@
 from transmogrifai_tpu.features.feature import Feature, FeatureLike, TransientFeature
-from transmogrifai_tpu.features.builder import FeatureBuilder
 
 __all__ = ["Feature", "FeatureLike", "TransientFeature", "FeatureBuilder"]
+
+
+def __getattr__(name):
+    # FeatureBuilder imports stages.base (which itself imports this package's
+    # feature module); resolve it lazily to keep the import graph acyclic.
+    if name == "FeatureBuilder":
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        return FeatureBuilder
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -233,7 +233,26 @@ class VectorColumn:
         return int(self.values.shape[-1])
 
 
-DeviceColumn = Any  # NumericColumn | CodesColumn | VectorColumn
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class PredictionColumn:
+    """Model output: prediction f32[n], raw scores f32[n,C], probabilities
+    f32[n,C] — the columnar analog of the reference's ``Prediction`` map
+    type (prediction/rawPrediction/probability keys)."""
+
+    prediction: jax.Array
+    raw_prediction: jax.Array
+    probability: jax.Array
+
+    def tree_flatten(self):
+        return (self.prediction, self.raw_prediction, self.probability), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+DeviceColumn = Any  # NumericColumn | CodesColumn | VectorColumn | PredictionColumn
 DeviceFrame = dict  # dict[str, DeviceColumn]
 
 

@@ -1,0 +1,3 @@
+from transmogrifai_tpu.local.scoring import make_score_function
+
+__all__ = ["make_score_function"]

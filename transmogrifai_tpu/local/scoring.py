@@ -1,0 +1,44 @@
+"""Local (engine-free) scoring.
+
+Parity: reference ``local/src/main/scala/com/salesforce/op/local/
+OpWorkflowModelLocal.scala:43-126`` — compiles the fitted DAG into a plain
+closure ``dict -> dict`` folding each stage's row-level path, no batch
+engine involved. The contract tests assert local scoring == batch scoring
+(the reference's OpTransformerSpec invariant).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["make_score_function"]
+
+
+def make_score_function(model) -> Callable[[dict], dict]:
+    """Returns ``score(row: {raw feature name: python value}) -> {result
+    feature name: python value}``."""
+    layers = model.dag
+    raw_names = [f.name for f in model.raw_features]
+    result = [(f.name, f.ftype) for f in model.result_features]
+
+    # precompute per-stage wiring
+    plan = []
+    for layer in layers:
+        for t in layer:
+            plan.append((t, t.runtime_input_names(), t.get_output().name))
+
+    def score(row: dict) -> dict:
+        vals: dict[str, Any] = {n: row.get(n) for n in raw_names}
+        for t, in_names, out_name in plan:
+            vals[out_name] = t.transform_row(*(vals.get(n) for n in in_names))
+        out = {}
+        for name, ftype in result:
+            v = vals.get(name)
+            if issubclass(ftype, ft.OPVector) and v is not None:
+                v = list(map(float, v))
+            out[name] = v
+        return out
+
+    return score

@@ -1,0 +1,3 @@
+from transmogrifai_tpu.models.base import PredictionModel, Predictor
+
+__all__ = ["PredictionModel", "Predictor"]

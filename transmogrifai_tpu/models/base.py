@@ -1,0 +1,98 @@
+"""Predictor/PredictionModel bases.
+
+Parity: reference ``core/.../stages/sparkwrappers/specific/OpPredictorWrapper
+.scala:70-153`` and the OP model wrappers (`OpLogisticRegression` etc.) —
+every model is an Estimator of (response RealNN, features OPVector) ->
+Prediction, whose fitted form is a Transformer exposing row-level scoring.
+
+TPU-first: instead of wrapping an external engine, each model family
+implements ``fit_arrays(X, y, w, params)`` as pure JAX and, when the math
+allows, ``grid_fit_arrays`` training the entire hyperparameter grid as one
+stacked ``vmap``/sharded program (the ModelSelector's sweep axis — reference
+P3 thread-pool parallelism becomes a batched leading axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import DeviceTransformer, Estimator
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["Predictor", "PredictionModel"]
+
+
+class Predictor(Estimator):
+    """Base estimator for (label, features) -> Prediction models."""
+
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+
+    #: hyperparameters exposed to grid search, with defaults
+    default_params: dict[str, Any] = {}
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        unknown = set(params) - set(self.default_params)
+        if unknown:
+            raise ValueError(f"{type(self).__name__}: unknown params {unknown}")
+        self.params = {**self.default_params, **params}
+        super().__init__(uid=uid)
+
+    def config(self) -> dict:
+        return dict(self.params)
+
+    @classmethod
+    def from_config(cls, config: dict, uid: Optional[str] = None):
+        return cls(uid=uid, **config)
+
+    # -- data plumbing -------------------------------------------------------
+    def _xyw(self, data) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        label_name, feat_name = self.input_names
+        y_col = data.device_col(label_name)
+        x_col = data.device_col(feat_name)
+        w = getattr(data, "sample_weight", None)
+        if w is None:
+            w = jnp.ones_like(y_col.values)
+        return x_col.values, y_col.values, w
+
+    # -- model-family contract ----------------------------------------------
+    def fit_arrays(self, X, y, w, params: dict) -> "PredictionModel":
+        raise NotImplementedError
+
+    def grid_fit_arrays(self, X, y, w, grid: Sequence[dict]
+                        ) -> list["PredictionModel"]:
+        """Train every grid point. Default: sequential; vmappable families
+        override with a stacked-axis batched trainer."""
+        return [self.fit_arrays(X, y, w, {**self.params, **g}) for g in grid]
+
+    def fit_model(self, data) -> "PredictionModel":
+        X, y, w = self._xyw(data)
+        return self.fit_arrays(X, y, w, self.params)
+
+
+class PredictionModel(DeviceTransformer):
+    """Fitted model: consumes only the features vector at transform time."""
+
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+
+    def runtime_input_names(self) -> tuple[str, ...]:
+        return (self.input_names[1],) if len(self.input_names) == 2 \
+            else self.input_names
+
+    # device_apply(params, features_col) -> PredictionColumn
+    def predict_arrays(self, X) -> fr.PredictionColumn:
+        return self.device_apply(self.device_params(), fr.VectorColumn(X))
+
+    def transform_row(self, *values):
+        """Row path: last value is the feature vector (label may be absent)."""
+        x = np.asarray(values[-1], dtype=np.float32)[None, :]
+        out = self.predict_arrays(jnp.asarray(x))
+        return ft.Prediction.make(
+            float(out.prediction[0]),
+            np.asarray(out.raw_prediction[0]),
+            np.asarray(out.probability[0])).value

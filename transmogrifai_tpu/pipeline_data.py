@@ -122,6 +122,14 @@ class PipelineData:
             for i, c in enumerate(codes):
                 vals[i] = col.vocab[c] if c >= 0 else None
             return fr.HostColumn(ft.Text, vals)
+        if isinstance(col, fr.PredictionColumn):
+            pred = np.asarray(col.prediction, np.float64)
+            raw = np.asarray(col.raw_prediction, np.float64)
+            prob = np.asarray(col.probability, np.float64)
+            vals = np.empty(pred.shape[0], dtype=object)
+            for i in range(pred.shape[0]):
+                vals[i] = ft.Prediction.make(pred[i], raw[i], prob[i]).value
+            return fr.HostColumn(ft.Prediction, vals)
         raise TypeError(f"Cannot pull {type(col).__name__} to host")
 
     # -- updates -------------------------------------------------------------
@@ -153,6 +161,10 @@ class PipelineData:
                 dev[n] = fr.VectorColumn(c.values[jidx], c.metadata)
             elif isinstance(c, fr.CodesColumn):
                 dev[n] = fr.CodesColumn(c.codes[jidx], c.vocab)
+            elif isinstance(c, fr.PredictionColumn):
+                dev[n] = fr.PredictionColumn(
+                    c.prediction[jidx], c.raw_prediction[jidx],
+                    c.probability[jidx])
             else:
                 raise TypeError(f"take: unsupported device column {type(c)}")
         if self.host.names():

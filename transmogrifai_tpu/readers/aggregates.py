@@ -43,6 +43,9 @@ class _GroupingReader(DataReader):
     def read(self) -> Iterable[Any]:
         return self.base.read()
 
+    def available_columns(self):
+        return self.base.available_columns()
+
     def _groups(self) -> dict[str, list[tuple[int, Any]]]:
         groups: dict[str, list[tuple[int, Any]]] = defaultdict(list)
         for r in self.base.read():

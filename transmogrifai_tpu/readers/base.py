@@ -30,6 +30,11 @@ class DataReader:
     def read(self) -> Iterable[Any]:
         raise NotImplementedError
 
+    def available_columns(self) -> Optional[set]:
+        """Column names this reader can produce, or None when unknown.
+        Lets scoring drop absent response features instead of failing."""
+        return None
+
     # -- raw data generation -------------------------------------------------
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
         records = self.read()
@@ -72,6 +77,13 @@ class CustomReader(DataReader):
         if self.records is not None:
             return self.records
         return list(self.frame.iter_rows())
+
+    def available_columns(self) -> Optional[set]:
+        if self.frame is not None:
+            return set(self.frame.names())
+        if self.records and isinstance(self.records[0], dict):
+            return set(self.records[0].keys())
+        return None
 
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
         if self.frame is not None:

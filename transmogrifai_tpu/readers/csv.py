@@ -129,6 +129,9 @@ class CSVReader(DataReader):
             self._schema = infer_csv_schema(self._raw_rows(), self.sample)
         return self._schema
 
+    def available_columns(self):
+        return set(self.schema)
+
     def read(self) -> Iterable[dict[str, Any]]:
         schema = self.schema
         out = []

@@ -1,0 +1,21 @@
+from transmogrifai_tpu.selector.splitters import (
+    DataBalancer, DataCutter, DataSplitter,
+)
+from transmogrifai_tpu.selector.validator import (
+    OpCrossValidation, OpTrainValidationSplit,
+)
+from transmogrifai_tpu.selector.model_selector import (
+    ModelSelector, SelectedModel, ModelSelectorSummary,
+)
+from transmogrifai_tpu.selector.factories import (
+    BinaryClassificationModelSelector, MultiClassificationModelSelector,
+    RegressionModelSelector,
+)
+
+__all__ = [
+    "DataBalancer", "DataCutter", "DataSplitter",
+    "OpCrossValidation", "OpTrainValidationSplit",
+    "ModelSelector", "SelectedModel", "ModelSelectorSummary",
+    "BinaryClassificationModelSelector", "MultiClassificationModelSelector",
+    "RegressionModelSelector",
+]

@@ -1,0 +1,268 @@
+"""ModelSelector: the AutoML sweep.
+
+Parity: reference ``core/.../stages/impl/selector/ModelSelector.scala:72-264``
+— an Estimator of (label RealNN, features OPVector) -> Prediction that:
+splits data (Splitter/Balancer/Cutter), runs the validator over every
+(estimator, param-grid) candidate, refits the winner on the prepared
+training data, evaluates train + holdout with every evaluator, and emits a
+``ModelSelectorSummary``; the fitted stage is a ``SelectedModel`` wrapping
+the winning PredictionModel.
+
+TPU-first (SURVEY §2.7 P3): per fold, each candidate family trains its whole
+hyperparameter grid as one stacked vmapped program (``grid_fit_arrays``);
+folds iterate sequentially (their programs are identical, so compile once,
+run k times). No thread pool, no executor dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+from transmogrifai_tpu.models.base import PredictionModel, Predictor
+from transmogrifai_tpu.selector.splitters import DataSplitter
+from transmogrifai_tpu.selector.validator import OpCrossValidation
+from transmogrifai_tpu.stages.base import Estimator
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["ModelSelector", "SelectedModel", "ModelSelectorSummary",
+           "ModelEvaluation"]
+
+
+@dataclass
+class ModelEvaluation:
+    model_name: str
+    model_uid: str
+    model_type: str
+    params: dict
+    metric_values: dict
+
+
+@dataclass
+class ModelSelectorSummary:
+    validation_type: str
+    validation_metric: str
+    best_model_uid: str
+    best_model_name: str
+    best_model_type: str
+    best_params: dict
+    validation_results: list[ModelEvaluation] = field(default_factory=list)
+    train_evaluation: dict = field(default_factory=dict)
+    holdout_evaluation: dict = field(default_factory=dict)
+    data_prep_results: dict = field(default_factory=dict)
+    wall_time_s: float = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "validationType": self.validation_type,
+            "validationMetric": self.validation_metric,
+            "bestModelUID": self.best_model_uid,
+            "bestModelName": self.best_model_name,
+            "bestModelType": self.best_model_type,
+            "bestModelParams": _jsonable(self.best_params),
+            "validationResults": [
+                {"modelName": r.model_name, "modelUID": r.model_uid,
+                 "modelType": r.model_type, "modelParams": _jsonable(r.params),
+                 "metricValues": _jsonable(r.metric_values)}
+                for r in self.validation_results],
+            "trainEvaluation": _jsonable(self.train_evaluation),
+            "holdoutEvaluation": _jsonable(self.holdout_evaluation),
+            "dataPrepResults": _jsonable(self.data_prep_results),
+            "wallTimeSeconds": self.wall_time_s,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "ModelSelectorSummary":
+        return ModelSelectorSummary(
+            validation_type=d.get("validationType", ""),
+            validation_metric=d.get("validationMetric", ""),
+            best_model_uid=d.get("bestModelUID", ""),
+            best_model_name=d.get("bestModelName", ""),
+            best_model_type=d.get("bestModelType", ""),
+            best_params=d.get("bestModelParams", {}),
+            validation_results=[
+                ModelEvaluation(
+                    model_name=r.get("modelName", ""),
+                    model_uid=r.get("modelUID", ""),
+                    model_type=r.get("modelType", ""),
+                    params=r.get("modelParams", {}),
+                    metric_values=r.get("metricValues", {}))
+                for r in d.get("validationResults", [])],
+            train_evaluation=d.get("trainEvaluation", {}),
+            holdout_evaluation=d.get("holdoutEvaluation", {}),
+            data_prep_results=d.get("dataPrepResults", {}),
+            wall_time_s=d.get("wallTimeSeconds", 0.0),
+        )
+
+
+def _jsonable(x: Any) -> Any:
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, (np.floating, np.integer)):
+        return float(x)
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    return x
+
+
+class SelectedModel(PredictionModel):
+    """The fitted winner; delegates to the wrapped PredictionModel."""
+
+    def __init__(self, model: Optional[PredictionModel] = None,
+                 summary: Optional[ModelSelectorSummary] = None,
+                 uid: Optional[str] = None):
+        self.model = model
+        self.summary = summary
+        super().__init__(uid=uid)
+
+    def device_params(self):
+        return self.model.device_params()
+
+    def device_apply(self, params, col):
+        return self.model.device_apply(params, col)
+
+    def transform_row(self, *values):
+        return self.model.transform_row(*values)
+
+    def config(self):
+        return {"model_class": type(self.model).__name__,
+                "model_config": self.model.config(),
+                "summary": self.summary.to_json() if self.summary else None}
+
+    @classmethod
+    def from_config(cls, config, uid=None):
+        from transmogrifai_tpu.stages.base import STAGE_REGISTRY
+        model_cls = STAGE_REGISTRY[config["model_class"]]
+        model = model_cls.from_config(config.get("model_config") or {})
+        summary = None
+        if config.get("summary"):
+            summary = ModelSelectorSummary.from_json(config["summary"])
+        return cls(model=model, summary=summary, uid=uid)
+
+    def fitted_state(self):
+        return self.model.fitted_state()
+
+    def set_fitted_state(self, state):
+        self.model.set_fitted_state(state)
+
+
+class ModelSelector(Estimator):
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+
+    def __init__(self,
+                 models_and_grids: Sequence[tuple[Predictor, Sequence[dict]]],
+                 validator: Optional[OpCrossValidation] = None,
+                 splitter: Optional[DataSplitter] = None,
+                 evaluators: Sequence[EvaluatorBase] = (),
+                 validation_metric: Optional[str] = None,
+                 uid: Optional[str] = None):
+        if not models_and_grids:
+            raise ValueError("ModelSelector needs at least one candidate model")
+        self.models_and_grids = [(m, list(g) or [{}]) for m, g in models_and_grids]
+        self.validator = validator or OpCrossValidation()
+        self.splitter = splitter
+        self.evaluators = list(evaluators)
+        if not self.evaluators:
+            raise ValueError("ModelSelector needs at least one evaluator")
+        self.validation_metric = validation_metric or \
+            self.evaluators[0].default_metric
+        super().__init__(uid=uid)
+
+    def fit_model(self, data) -> SelectedModel:
+        t0 = time.time()
+        label_name, feat_name = self.input_names
+        X = data.device_col(feat_name).values
+        y = data.device_col(label_name).values
+        n = int(X.shape[0])
+        ev0 = self.evaluators[0]
+        bigger = ev0.larger_is_better(self.validation_metric)
+
+        # -- split & prepare -------------------------------------------------
+        prep_results: dict = {}
+        if self.splitter is not None:
+            train_idx, holdout_idx = self.splitter.split_indices(
+                n, np.asarray(y))
+            train_idx, w_train = self.splitter.prepare_indices(
+                train_idx, np.asarray(y))
+            if self.splitter.summary:
+                prep_results = {self.splitter.summary.splitter:
+                                self.splitter.summary.detail}
+        else:
+            train_idx = np.arange(n)
+            holdout_idx = np.zeros(0, dtype=np.int64)
+            w_train = np.ones(n, dtype=np.float32)
+        Xt, yt = X[jnp.asarray(train_idx)], y[jnp.asarray(train_idx)]
+        wt = jnp.asarray(w_train)
+
+        # -- validation sweep ------------------------------------------------
+        results: list[ModelEvaluation] = []
+        mean_metrics: list[tuple[float, int, int]] = []  # (metric, cand_i, grid_j)
+        folds = self.validator.splits(int(Xt.shape[0]), np.asarray(yt))
+        per_candidate_scores: dict[tuple[int, int], list[float]] = {}
+        for tr, va in folds:
+            jtr, jva = jnp.asarray(tr), jnp.asarray(va)
+            Xtr, ytr, wtr = Xt[jtr], yt[jtr], wt[jtr]
+            Xva, yva = Xt[jva], yt[jva]
+            for ci, (est, grid) in enumerate(self.models_and_grids):
+                models = est.grid_fit_arrays(Xtr, ytr, wtr, grid)
+                for gj, model in enumerate(models):
+                    pred = model.predict_arrays(Xva)
+                    metrics = ev0.evaluate_arrays(yva, pred)
+                    val = ev0.metric_value(metrics, self.validation_metric)
+                    per_candidate_scores.setdefault((ci, gj), []).append(val)
+        for (ci, gj), vals in per_candidate_scores.items():
+            est, grid = self.models_and_grids[ci]
+            mean = float(np.mean(vals))
+            mean_metrics.append((mean, ci, gj))
+            results.append(ModelEvaluation(
+                model_name=f"{type(est).__name__}_{ci}_{gj}",
+                model_uid=est.uid,
+                model_type=type(est).__name__,
+                params={**est.params, **grid[gj]},
+                metric_values={self.validation_metric: mean}))
+
+        best_mean, best_ci, best_gj = (max if bigger else min)(
+            mean_metrics, key=lambda t: t[0])
+        best_est, best_grid = self.models_and_grids[best_ci]
+
+        # -- refit winner on the full prepared training data -----------------
+        best_params = {**best_est.params, **best_grid[best_gj]}
+        best_model = best_est.fit_arrays(Xt, yt, wt, best_params)
+
+        # -- train/holdout evaluation with every evaluator -------------------
+        train_eval: dict = {}
+        holdout_eval: dict = {}
+        pred_train = best_model.predict_arrays(Xt)
+        for ev in self.evaluators:
+            train_eval[ev.name] = EvaluatorBase.to_json(
+                ev.evaluate_arrays(yt, pred_train))
+        if holdout_idx.size:
+            Xh = X[jnp.asarray(holdout_idx)]
+            yh = y[jnp.asarray(holdout_idx)]
+            pred_h = best_model.predict_arrays(Xh)
+            for ev in self.evaluators:
+                holdout_eval[ev.name] = EvaluatorBase.to_json(
+                    ev.evaluate_arrays(yh, pred_h))
+
+        summary = ModelSelectorSummary(
+            validation_type=self.validator.name,
+            validation_metric=self.validation_metric,
+            best_model_uid=best_est.uid,
+            best_model_name=f"{type(best_est).__name__}_{best_ci}_{best_gj}",
+            best_model_type=type(best_est).__name__,
+            best_params=best_params,
+            validation_results=results,
+            train_evaluation=train_eval,
+            holdout_evaluation=holdout_eval,
+            data_prep_results=prep_results,
+            wall_time_s=time.time() - t0,
+        )
+        return SelectedModel(model=best_model, summary=summary)

@@ -1,0 +1,124 @@
+"""Data splitters: holdout reservation, class balancing, label cutting.
+
+Parity: reference ``core/.../stages/impl/tuning/{DataSplitter,DataBalancer,
+DataCutter}.scala`` —
+
+- **DataSplitter**: reserve a test/holdout fraction (+ max training rows cap).
+- **DataBalancer** (binary): when the positive class is rarer than
+  ``sample_fraction``, down-sample the majority so positives reach that
+  fraction (keeping the sample fractions in a summary for metadata).
+- **DataCutter** (multiclass): keep at most ``max_label_categories`` labels /
+  drop labels rarer than ``min_label_fraction``; re-index kept labels.
+
+All operate on index arrays over device-resident (X, y, w) triples; the
+actual gather happens once on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["SplitterSummary", "DataSplitter", "DataBalancer", "DataCutter"]
+
+
+@dataclass
+class SplitterSummary:
+    splitter: str = ""
+    detail: dict = field(default_factory=dict)
+
+
+class DataSplitter:
+    """Random train/holdout reservation."""
+
+    def __init__(self, reserve_test_fraction: float = 0.1, seed: int = 42,
+                 max_training_sample: Optional[int] = None):
+        self.reserve_test_fraction = reserve_test_fraction
+        self.seed = seed
+        self.max_training_sample = max_training_sample
+        self.summary: Optional[SplitterSummary] = None
+
+    def split_indices(self, n: int, y: Optional[np.ndarray] = None
+                      ) -> tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        n_test = int(round(n * self.reserve_test_fraction))
+        test, train = perm[:n_test], perm[n_test:]
+        if self.max_training_sample and train.size > self.max_training_sample:
+            train = train[:self.max_training_sample]
+        self.summary = SplitterSummary(
+            "DataSplitter", {"trainRows": int(train.size),
+                             "testRows": int(test.size)})
+        return np.sort(train), np.sort(test)
+
+    # balancing hook applied to the *training* portion only
+    def prepare_indices(self, train_idx: np.ndarray, y: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (possibly resampled train indices, sample weights)."""
+        return train_idx, np.ones(train_idx.size, dtype=np.float32)
+
+
+class DataBalancer(DataSplitter):
+    """Binary down-sampler toward a target positive fraction."""
+
+    def __init__(self, sample_fraction: float = 0.1,
+                 max_training_sample: Optional[int] = 1_000_000,
+                 reserve_test_fraction: float = 0.1, seed: int = 42):
+        super().__init__(reserve_test_fraction, seed, max_training_sample)
+        self.sample_fraction = sample_fraction
+
+    def prepare_indices(self, train_idx, y):
+        rng = np.random.default_rng(self.seed + 1)
+        yt = y[train_idx]
+        pos = train_idx[yt >= 0.5]
+        neg = train_idx[yt < 0.5]
+        n_pos, n_neg = pos.size, neg.size
+        small, big = (pos, neg) if n_pos <= n_neg else (neg, pos)
+        frac = small.size / max(train_idx.size, 1)
+        if frac >= self.sample_fraction or small.size == 0:
+            self.summary = SplitterSummary(
+                "DataBalancer", {"balanced": False,
+                                 "positiveFraction": n_pos / max(train_idx.size, 1)})
+            return train_idx, np.ones(train_idx.size, dtype=np.float32)
+        # down-sample the majority so the minority reaches sample_fraction
+        target_big = int(small.size * (1.0 - self.sample_fraction)
+                         / self.sample_fraction)
+        keep_big = rng.choice(big, size=min(target_big, big.size), replace=False)
+        out = np.sort(np.concatenate([small, keep_big]))
+        self.summary = SplitterSummary(
+            "DataBalancer",
+            {"balanced": True,
+             "downSampleFraction": keep_big.size / max(big.size, 1),
+             "positiveFraction": n_pos / max(train_idx.size, 1),
+             "keptRows": int(out.size)})
+        return out, np.ones(out.size, dtype=np.float32)
+
+
+class DataCutter(DataSplitter):
+    """Multiclass label trimming: keep the most frequent labels."""
+
+    def __init__(self, max_label_categories: int = 100,
+                 min_label_fraction: float = 0.0,
+                 reserve_test_fraction: float = 0.1, seed: int = 42):
+        super().__init__(reserve_test_fraction, seed)
+        self.max_label_categories = max_label_categories
+        self.min_label_fraction = min_label_fraction
+        self.kept_labels: Optional[list[float]] = None
+
+    def prepare_indices(self, train_idx, y):
+        yt = y[train_idx]
+        labels, counts = np.unique(yt, return_counts=True)
+        frac = counts / max(yt.size, 1)
+        keep = labels[(frac >= self.min_label_fraction)]
+        if keep.size > self.max_label_categories:
+            order = np.argsort(-counts)
+            keep = labels[order[:self.max_label_categories]]
+        self.kept_labels = sorted(float(l) for l in keep)
+        mask = np.isin(yt, keep)
+        out = train_idx[mask]
+        self.summary = SplitterSummary(
+            "DataCutter", {"labelsKept": len(self.kept_labels),
+                           "labelsDropped": int(labels.size - keep.size)})
+        return out, np.ones(out.size, dtype=np.float32)

@@ -1,0 +1,83 @@
+"""Validation strategies: k-fold CV and train/validation split.
+
+Parity: reference ``core/.../stages/impl/tuning/{OpValidator,
+OpCrossValidation,OpTrainValidationSplit}.scala`` — k folds (optionally
+label-stratified), metric per (estimator, grid point) averaged across folds,
+best = argbest mean metric.
+
+TPU-first: fold membership is an index partition computed on host; each
+fold's candidate sweep trains via the estimator family's stacked
+``grid_fit_arrays`` (one vmapped program for all grid points) instead of the
+reference's Future thread pool.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["OpCrossValidation", "OpTrainValidationSplit"]
+
+
+class _ValidatorBase:
+    def splits(self, n: int, y: Optional[np.ndarray] = None
+               ) -> list[tuple[np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+    @staticmethod
+    def _stratified_folds(y: np.ndarray, n_folds: int, rng) -> np.ndarray:
+        """Assign each row a fold id, stratified per label value."""
+        fold_of = np.zeros(y.shape[0], dtype=np.int64)
+        for label in np.unique(y):
+            idx = np.flatnonzero(y == label)
+            rng.shuffle(idx)
+            fold_of[idx] = np.arange(idx.size) % n_folds
+        return fold_of
+
+
+class OpCrossValidation(_ValidatorBase):
+    def __init__(self, n_folds: int = 3, seed: int = 42,
+                 stratify: bool = False):
+        if n_folds < 2:
+            raise ValueError("n_folds must be >= 2")
+        self.n_folds = n_folds
+        self.seed = seed
+        self.stratify = stratify
+        self.name = "Cross Validation"
+
+    def splits(self, n, y=None):
+        rng = np.random.default_rng(self.seed)
+        if self.stratify and y is not None:
+            fold_of = self._stratified_folds(np.asarray(y), self.n_folds, rng)
+        else:
+            fold_of = rng.permutation(n) % self.n_folds
+        out = []
+        for f in range(self.n_folds):
+            val = np.flatnonzero(fold_of == f)
+            train = np.flatnonzero(fold_of != f)
+            out.append((train, val))
+        return out
+
+
+class OpTrainValidationSplit(_ValidatorBase):
+    def __init__(self, train_ratio: float = 0.75, seed: int = 42,
+                 stratify: bool = False):
+        self.train_ratio = train_ratio
+        self.seed = seed
+        self.stratify = stratify
+        self.name = "Train Validation Split"
+
+    def splits(self, n, y=None):
+        rng = np.random.default_rng(self.seed)
+        if self.stratify and y is not None:
+            fold_of = self._stratified_folds(
+                np.asarray(y), max(int(round(1 / (1 - self.train_ratio))), 2),
+                rng)
+            val = np.flatnonzero(fold_of == 0)
+            train = np.flatnonzero(fold_of != 0)
+        else:
+            perm = rng.permutation(n)
+            n_train = int(round(n * self.train_ratio))
+            train, val = perm[:n_train], perm[n_train:]
+        return [(np.sort(train), np.sort(val))]

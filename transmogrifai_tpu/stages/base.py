@@ -180,6 +180,12 @@ class Transformer(PipelineStage):
 
     is_device: bool = False
 
+    def runtime_input_names(self) -> tuple[str, ...]:
+        """Inputs actually required at transform time. Prediction models
+        declare (label, features) but consume only features, so scoring
+        works on label-less data (reference SelectedModel.transformFn)."""
+        return self.input_names
+
     def transform_row(self, *values: Any) -> Any:
         """Single-record scoring on plain python values (None = missing)."""
         raise NotImplementedError
@@ -200,7 +206,7 @@ class HostTransformer(Transformer):
         return HostColumn.from_values(self.out_type, vals)
 
     def output_column(self, data) -> HostColumn:
-        cols = [data.host_col(n) for n in self.input_names]
+        cols = [data.host_col(n) for n in self.runtime_input_names()]
         return self.host_apply(*cols)
 
 
@@ -222,7 +228,7 @@ class DeviceTransformer(Transformer):
         raise NotImplementedError
 
     def output_column(self, data) -> Any:
-        cols = [data.device_col(n) for n in self.input_names]
+        cols = [data.device_col(n) for n in self.runtime_input_names()]
         return self.device_apply(self.device_params(), *cols)
 
 

@@ -1,0 +1,247 @@
+"""Workflow: DAG assembly, training, scoring.
+
+Parity: reference ``core/src/main/scala/com/salesforce/op/{OpWorkflow,
+OpWorkflowCore,OpWorkflowModel}.scala`` — ``set_result_features`` back-traces
+lineage; ``train()`` generates raw data through the reader, fits the leveled
+DAG, and returns a ``WorkflowModel`` whose ``score()`` replays the fitted
+transformer DAG (layer-fused jit programs), ``evaluate()`` runs evaluators,
+``save()``/``load_model()`` round-trip the fitted pipeline, and
+``score_function()`` compiles the Spark-free local scoring closure
+(reference ``local/OpWorkflowModelLocal``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import Dag, DagExecutor, compute_dag
+from transmogrifai_tpu.features.feature import FeatureLike
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.readers.base import CustomReader, DataReader
+from transmogrifai_tpu.selector.model_selector import SelectedModel
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["Workflow", "WorkflowModel", "load_model"]
+
+
+class Workflow:
+    def __init__(self):
+        self.reader: Optional[DataReader] = None
+        self.result_features: tuple[FeatureLike, ...] = ()
+        self._raw_feature_filter = None
+
+    # -- inputs --------------------------------------------------------------
+    def set_reader(self, reader: DataReader) -> "Workflow":
+        self.reader = reader
+        return self
+
+    def set_input_frame(self, frame: fr.HostFrame) -> "Workflow":
+        self.reader = CustomReader(frame=frame)
+        return self
+
+    def set_input_records(self, records: Iterable[Any],
+                          key_fn: Optional[Callable] = None) -> "Workflow":
+        self.reader = CustomReader(records=records, key_fn=key_fn)
+        return self
+
+    def set_result_features(self, *features: FeatureLike) -> "Workflow":
+        if not features:
+            raise ValueError("need at least one result feature")
+        self.result_features = tuple(features)
+        return self
+
+    def with_raw_feature_filter(self, rff) -> "Workflow":
+        """Attach a RawFeatureFilter applied before training (blocklisting
+        low-quality raw features and rewiring the DAG)."""
+        self._raw_feature_filter = rff
+        return self
+
+    # -- lineage -------------------------------------------------------------
+    def raw_features(self) -> list[FeatureLike]:
+        seen: dict[str, FeatureLike] = {}
+        for f in self.result_features:
+            for r in f.raw_features():
+                seen.setdefault(r.uid, r)
+        return sorted(seen.values(), key=lambda f: f.name)
+
+    # -- train ---------------------------------------------------------------
+    def train(self) -> "WorkflowModel":
+        if self.reader is None:
+            raise ValueError("set a reader or input frame before train()")
+        if not self.result_features:
+            raise ValueError("set result features before train()")
+        raw = self.raw_features()
+        frame = self.reader.generate_frame(raw)
+        blocklist: list[str] = []
+        if self._raw_feature_filter is not None:
+            frame, blocklist = self._raw_feature_filter.filter_frame(
+                frame, raw)
+            raw = [f for f in raw if f.name not in set(blocklist)]
+        data = PipelineData.from_host(frame)
+        dag = compute_dag(self.result_features)
+        executor = DagExecutor()
+        _, fitted = executor.fit_transform(data, dag)
+        return WorkflowModel(
+            result_features=self.result_features,
+            raw_features=raw, dag=fitted, executor=executor,
+            blocklisted=blocklist)
+
+
+class WorkflowModel:
+    def __init__(self, result_features: Sequence[FeatureLike],
+                 raw_features: Sequence[FeatureLike], dag: Dag,
+                 executor: Optional[DagExecutor] = None,
+                 blocklisted: Sequence[str] = ()):
+        self.result_features = tuple(result_features)
+        self.raw_features = list(raw_features)
+        self.dag = dag
+        self.executor = executor or DagExecutor()
+        self.blocklisted = list(blocklisted)
+
+    # -- scoring -------------------------------------------------------------
+    def _ingest(self, reader_or_frame) -> PipelineData:
+        if isinstance(reader_or_frame, fr.HostFrame):
+            reader: DataReader = CustomReader(frame=reader_or_frame)
+        else:
+            reader = reader_or_frame
+        available = reader.available_columns()
+        raw = list(self.raw_features)
+        if available is not None:
+            # responses are optional at scoring time; predictors are not
+            missing_required = sorted(
+                f.name for f in raw
+                if not f.is_response and f.name not in available)
+            if missing_required:
+                raise KeyError(
+                    f"Scoring data lacks predictor columns {missing_required}")
+            raw = [f for f in raw if f.name in available]
+        frame = reader.generate_frame(raw)
+        return PipelineData.from_host(frame)
+
+    def transform(self, reader_or_frame) -> PipelineData:
+        data = self._ingest(reader_or_frame)
+        return self.executor.transform(data, self.dag)
+
+    def score(self, reader_or_frame, keep_raw_features: bool = False,
+              keep_intermediate_features: bool = False) -> fr.HostFrame:
+        """Run the fitted DAG; returns a host frame of result features
+        (+ key), optionally with raw/intermediate columns."""
+        data = self.transform(reader_or_frame)
+        return self._score_frame(data, keep_raw_features,
+                                 keep_intermediate_features)
+
+    def _score_frame(self, data, keep_raw_features: bool = False,
+                     keep_intermediate_features: bool = False) -> fr.HostFrame:
+        names = [f.name for f in self.result_features]
+        if keep_raw_features:
+            names = [f.name for f in self.raw_features
+                     if data.has(f.name)] + names
+        if keep_intermediate_features:
+            names = [n for n in list(data.host.names()) + list(data.device)
+                     if n not in names] + names
+        cols = {n: data.host_col(n) for n in dict.fromkeys(names)}
+        return fr.HostFrame(cols, data.host.key)
+
+    def evaluate(self, reader_or_frame, evaluator,
+                 label: Optional[FeatureLike] = None,
+                 prediction: Optional[FeatureLike] = None):
+        data = self.transform(reader_or_frame)
+        return self._evaluate_data(data, evaluator, label, prediction)
+
+    def _evaluate_data(self, data, evaluator,
+                       label: Optional[FeatureLike] = None,
+                       prediction: Optional[FeatureLike] = None):
+        pred_f = prediction or self._prediction_feature()
+        label_f = label or self._label_feature(pred_f)
+        return evaluator.evaluate(data, label_f.name, pred_f.name)
+
+    def score_and_evaluate(self, reader_or_frame, evaluator, **kw):
+        data = self.transform(reader_or_frame)
+        return (self._score_frame(data, **kw),
+                self._evaluate_data(data, evaluator))
+
+    def _prediction_feature(self) -> FeatureLike:
+        preds = [f for f in self.result_features
+                 if issubclass(f.ftype, ft.Prediction)]
+        if not preds:
+            raise ValueError("No Prediction-typed result feature")
+        return preds[0]
+
+    def _label_feature(self, pred_f: FeatureLike) -> FeatureLike:
+        for p in pred_f.origin_stage.input_features:
+            if p.is_response:
+                return p
+        resp = [f for f in self.raw_features if f.is_response]
+        if resp:
+            return resp[0]
+        raise ValueError("No response feature found for evaluation")
+
+    # -- introspection -------------------------------------------------------
+    def stages(self) -> list:
+        return [t for layer in self.dag for t in layer]
+
+    def selector_summary(self):
+        for t in self.stages():
+            if isinstance(t, SelectedModel) and t.summary is not None:
+                return t.summary
+        return None
+
+    def summary_json(self) -> dict:
+        s = self.selector_summary()
+        out = {
+            "resultFeatures": [f.name for f in self.result_features],
+            "rawFeatures": [f.name for f in self.raw_features],
+            "blocklistedFeatures": self.blocklisted,
+            "stages": [{"uid": t.uid, "operation": t.operation_name}
+                       for t in self.stages()],
+        }
+        if s is not None:
+            out["selectedModel"] = s.to_json()
+        return out
+
+    def summary_pretty(self) -> str:
+        s = self.selector_summary()
+        lines = [f"Fitted workflow with {len(self.stages())} stages"]
+        if s:
+            lines.append(f"Selected model: {s.best_model_name} "
+                         f"({s.validation_metric}={_best_metric(s):.4f} "
+                         f"over {s.validation_type})")
+            for name, m in (s.holdout_evaluation or {}).items():
+                lines.append(f"Holdout [{name}]: " + ", ".join(
+                    f"{k}={v:.4f}" for k, v in m.items()
+                    if isinstance(v, (int, float))))
+        return "\n".join(lines)
+
+    def summary(self) -> str:
+        return json.dumps(self.summary_json(), indent=2, default=str)
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from transmogrifai_tpu.serialization import save_model
+        save_model(self, path, overwrite=overwrite)
+
+    # -- local serving -------------------------------------------------------
+    def score_function(self):
+        from transmogrifai_tpu.local.scoring import make_score_function
+        return make_score_function(self)
+
+
+def _best_metric(s) -> float:
+    for r in s.validation_results:
+        if r.model_name == s.best_model_name:
+            return float(r.metric_values.get(s.validation_metric, float("nan")))
+    return float("nan")
+
+
+def load_model(path: str) -> WorkflowModel:
+    from transmogrifai_tpu.serialization import load_model as _load
+    return _load(path)
+
+
+# attach for API parity: Workflow.load_model(path)
+Workflow.load_model = staticmethod(load_model)
