@@ -1,0 +1,128 @@
+"""Benchmark: end-to-end AutoML wall-clock on a HIGGS-shaped task.
+
+North star (BASELINE.json): transmogrify + sanityCheck + 3-fold
+BinaryClassificationModelSelector on HIGGS-11M, one TPU chip vs a 32-vCPU
+Spark reference. HIGGS itself is not fetchable here (zero egress), so the
+bench runs the same pipeline shape on synthetic HIGGS-like data (28 numeric
+features, binary label, nonlinear signal).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+value        = wall seconds for the full AutoML pipeline at N_ROWS on the
+               accelerator (whatever platform jax selects; TPU under axon).
+vs_baseline  = cpu_wall / accel_wall for the identical pipeline at
+               CPU_ROWS rows, linearly extrapolated to N_ROWS — a
+               same-code host-CPU proxy for the Spark cluster baseline
+               until a recorded Spark number lands in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", 250_000))
+D = 28
+
+
+def make_data(n: int, seed: int = 0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, D)).astype("float32")
+    logits = (1.2 * X[:, 0] - 0.7 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+              + 0.8 * np.sin(X[:, 4]) - 0.4 * (X[:, 5] ** 2 - 1.0))
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype("float64")
+    return X, y
+
+
+def run_pipeline(n_rows: int) -> float:
+    """Full pipeline: frame ingest -> transmogrify -> (sanity check if
+    available) -> 3-fold LR sweep. Returns wall seconds (excluding data
+    synthesis)."""
+    import numpy as np
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, DataSplitter,
+    )
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.types import feature_types as ft
+
+    X, y = make_data(n_rows)
+    cols = {f"f{i}": fr.HostColumn(ft.Real, X[:, i].astype(np.float64),
+                                   np.ones(n_rows, bool))
+            for i in range(D)}
+    cols["label"] = fr.HostColumn(ft.RealNN, y, np.ones(n_rows, bool))
+    frame = fr.HostFrame(cols)
+
+    t0 = time.time()
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    features = transmogrify(list(feats.values()))
+    try:
+        from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+        checked = label.transform_with(SanityChecker(), features)
+    except ImportError:
+        checked = features
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3, seed=42,
+        models_and_parameters=[
+            (OpLogisticRegression(),
+             [{"reg_param": r, "elastic_net_param": e}
+              for r in (0.0, 0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
+        ],
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=42))
+    pred = label.transform_with(selector, checked)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred).train())
+    wall = time.time() - t0
+    s = model.selector_summary()
+    holdout = s.holdout_evaluation.get("binary classification", {})
+    print(f"# rows={n_rows} wall={wall:.1f}s holdout_auROC="
+          f"{holdout.get('au_roc', float('nan')):.4f} "
+          f"best={s.best_model_name}", file=sys.stderr)
+    return wall
+
+
+def main():
+    if os.environ.get("_BENCH_CHILD") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        wall = run_pipeline(CPU_ROWS)
+        print(json.dumps({"cpu_wall": wall}))
+        return
+
+    accel_wall = run_pipeline(N_ROWS)
+
+    # same-code CPU proxy baseline in a subprocess (fresh backend)
+    env = dict(os.environ, _BENCH_CHILD="cpu", JAX_PLATFORMS="cpu")
+    vs_baseline = 0.0
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=3600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        last = [l for l in out.stdout.strip().splitlines() if l.strip()][-1]
+        cpu_wall = json.loads(last)["cpu_wall"]
+        cpu_extrapolated = cpu_wall * (N_ROWS / CPU_ROWS)
+        vs_baseline = cpu_extrapolated / accel_wall
+    except Exception as e:  # baseline failure must not kill the bench
+        print(f"# cpu baseline failed: {e}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "automl_higgs_shape_1m_wall",
+        "value": round(accel_wall, 2),
+        "unit": "s",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
