@@ -1,0 +1,184 @@
+"""SanityChecker + OpStatistics + RawFeatureFilter tests (parity: reference
+SanityCheckerTest / OpStatisticsTest / RawFeatureFilterTest expectations)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import DagExecutor, compute_dag
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.filters import RawFeatureFilter
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.readers import CustomReader
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.stats import (
+    contingency_stats, cramers_v, mutual_info,
+)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def test_cramers_v_known_values():
+    # perfect association 2x2 -> V = 1
+    assert cramers_v(np.array([[50, 0], [0, 50]])) == pytest.approx(1.0)
+    # independence -> V = 0
+    assert cramers_v(np.array([[25, 25], [25, 25]])) == pytest.approx(0.0)
+    # degenerate shapes
+    assert cramers_v(np.array([[10, 20]])) == 0.0
+    # titanic sex x survived (README-adjacent sanity: strong association)
+    m = np.array([[81, 233], [468, 109]], float)
+    v = cramers_v(m)
+    assert 0.5 < v < 0.6
+
+
+def test_mutual_info_and_rules():
+    m = np.array([[50, 0], [0, 50]], float)
+    assert mutual_info(m) == pytest.approx(1.0)  # 1 bit
+    cs = contingency_stats(m)
+    np.testing.assert_allclose(cs.max_rule_confidences, [1.0, 1.0])
+    np.testing.assert_allclose(cs.supports, [0.5, 0.5])
+
+
+def _checked_pipeline(frame, **sc_kwargs):
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    checked = label.transform_with(SanityChecker(**sc_kwargs), vec)
+    data = PipelineData.from_host(frame)
+    dag = compute_dag([checked])
+    ex = DagExecutor()
+    out_data, fitted = ex.fit_transform(data, dag)
+    model = [t for layer in fitted for t in layer
+             if type(t).__name__ == "DropIndicesModel"][0]
+    return out_data, checked, model
+
+
+def test_sanity_checker_drops_low_variance_and_leakage():
+    n = 300
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, n).astype(float)
+    good = rng.normal(size=n) + 0.5 * y
+    constant = np.zeros(n)
+    leak = y * 2.0 - 1.0  # perfectly correlated with label
+    frame = fr.HostFrame.from_dict({
+        "good": (ft.Real, good.tolist()),
+        "const": (ft.Real, constant.tolist()),
+        "leak": (ft.Real, leak.tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    out_data, checked, model = _checked_pipeline(frame)
+    s = model.summary
+    dropped_parents = set()
+    for c in s.column_stats:
+        if c.dropped:
+            dropped_parents.add(c.name.split("_")[0])
+    assert "const" in dropped_parents
+    assert "leak" in dropped_parents
+    kept_meta = out_data.device_col(checked.name).metadata
+    kept_parents = {p for c in kept_meta.columns for p in c.parent_feature}
+    assert "good" in kept_parents
+    assert "leak" not in kept_parents
+    # cleaned vector width matches metadata
+    assert out_data.device_col(checked.name).values.shape[1] == kept_meta.size
+
+
+def test_sanity_checker_cramers_v_group_removal():
+    n = 400
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 2, n).astype(float)
+    # categorical that exactly encodes the label -> V = 1 -> whole group drops
+    leaky_cat = np.where(y > 0.5, "yes", "no")
+    ok_cat = rng.choice(["a", "b", "c"], n)
+    frame = fr.HostFrame.from_dict({
+        "leakycat": (ft.PickList, leaky_cat.tolist()),
+        "okcat": (ft.PickList, ok_cat.tolist()),
+        "noise": (ft.Real, rng.normal(size=n).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    out_data, checked, model = _checked_pipeline(frame)
+    cat_stats = model.summary.categorical_stats
+    leaky_groups = [g for g in cat_stats if "leakycat" in g]
+    assert leaky_groups and cat_stats[leaky_groups[0]]["cramersV"] > 0.95
+    kept_parents = {p for c in out_data.device_col(checked.name)
+                    .metadata.columns for p in c.parent_feature}
+    assert "leakycat" not in kept_parents
+    assert "okcat" in kept_parents and "noise" in kept_parents
+
+
+def test_sanity_checker_row_path_matches():
+    n = 100
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 2, n).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "a": (ft.Real, rng.normal(size=n).tolist()),
+        "b": (ft.Real, np.zeros(n).tolist()),  # dropped
+        "label": (ft.RealNN, y.tolist()),
+    })
+    out_data, checked, model = _checked_pipeline(frame)
+    vec = np.asarray(out_data.device_col(checked.name).values)
+    row0 = model.transform_row(None, np.asarray(
+        out_data.device_col(model.input_names[1]).values[0]))
+    np.testing.assert_allclose(row0, vec[0], rtol=1e-6)
+
+
+def test_raw_feature_filter_min_fill_and_divergence():
+    n = 200
+    rng = np.random.default_rng(3)
+    y = rng.integers(0, 2, n).astype(float)
+    mostly_null = [None] * (n - 1) + [1.0]
+    stable = rng.normal(size=n)
+    train_records = [
+        {"stable": float(stable[i]), "shifty": float(rng.normal()),
+         "mostly_null": mostly_null[i], "label": float(y[i])}
+        for i in range(n)]
+    score_records = [
+        {"stable": float(rng.normal()), "shifty": float(rng.normal() + 50.0),
+         "mostly_null": None} for _ in range(n)]
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = [FeatureBuilder.Real("stable").as_predictor(),
+             FeatureBuilder.Real("shifty").as_predictor(),
+             FeatureBuilder.Real("mostly_null").as_predictor(), label]
+    reader = CustomReader(records=train_records)
+    frame = reader.generate_frame(feats)
+    rff = RawFeatureFilter(
+        scoring_reader=CustomReader(records=score_records),
+        min_fill=0.1, max_js_divergence=0.5)
+    filtered, blocklist = rff.filter_frame(frame, feats)
+    assert "mostly_null" in blocklist          # fill rate
+    assert "shifty" in blocklist               # distribution shift
+    assert "stable" not in blocklist
+    assert "mostly_null" not in filtered
+    reasons = rff.results.exclusion_reasons
+    assert any("fill rate" in r for r in reasons["mostly_null"])
+    assert any("JS divergence" in r for r in reasons["shifty"])
+
+
+def test_workflow_with_rff_rewires_dag():
+    n = 200
+    rng = np.random.default_rng(4)
+    y = rng.integers(0, 2, n).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "good": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "mostly_null": (ft.Real, [None] * (n - 1) + [1.0]),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(), [{}])],
+        evaluators=[OpBinaryClassificationEvaluator()])
+    pred = label.transform_with(sel, vec)
+    model = (Workflow()
+             .set_input_frame(frame)
+             .set_result_features(pred)
+             .with_raw_feature_filter(RawFeatureFilter(min_fill=0.1))
+             .train())
+    assert model.blocklisted == ["mostly_null"]
+    scores = model.score(frame.drop(["mostly_null"]))
+    assert scores.n_rows == n

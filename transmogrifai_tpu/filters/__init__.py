@@ -1,0 +1,5 @@
+from transmogrifai_tpu.filters.raw_feature_filter import (
+    FeatureDistribution, RawFeatureFilter, RawFeatureFilterResults,
+)
+
+__all__ = ["FeatureDistribution", "RawFeatureFilter", "RawFeatureFilterResults"]

@@ -1,0 +1,205 @@
+"""RawFeatureFilter: pre-training raw-feature quality gate.
+
+Parity: reference ``core/src/main/scala/com/salesforce/op/filters/
+RawFeatureFilter.scala:90-636`` (+ ``FeatureDistribution``, ``Summary``,
+``RawFeatureFilterResults``) — compares **training vs scoring** raw feature
+distributions and drops features failing:
+
+- training fill rate < ``min_fill``
+- |train fill - scoring fill| > ``max_fill_difference``
+- max/min fill ratio > ``max_fill_ratio_diff``
+- Jensen-Shannon divergence of the binned distributions > ``max_js_divergence``
+- null-indicator <-> label correlation > ``max_correlation_null_label``
+
+Distributions are monoid summaries: numerics bin into histograms over the
+training min/max range (two passes, like the reference's Summary-then-
+Distribution map-reduces); text hashes tokens into a fixed number of bins.
+Without a scoring reader only the fill-rate and null-label-correlation
+checks apply. The resulting blocklist feeds the workflow's DAG rewiring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu.frame import HostColumn, HostFrame, NUMERIC_KINDS, TEXT_KINDS
+from transmogrifai_tpu.ops.vectorizers.hashing import hash_token, tokenize
+
+__all__ = ["FeatureDistribution", "RawFeatureFilter", "RawFeatureFilterResults"]
+
+
+@dataclass
+class FeatureDistribution:
+    name: str
+    count: int
+    nulls: int
+    distribution: np.ndarray          # binned histogram (un-normalized)
+    summary: dict = field(default_factory=dict)
+
+    @property
+    def fill_rate(self) -> float:
+        return 1.0 - self.nulls / max(self.count, 1)
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        p, q = self.distribution, other.distribution
+        ps, qs = p.sum(), q.sum()
+        if ps == 0 or qs == 0:
+            return 0.0
+        p, q = p / ps, q / qs
+        m = 0.5 * (p + q)
+
+        def kl(a, b):
+            with np.errstate(divide="ignore", invalid="ignore"):
+                t = np.where(a > 0, a * np.log2(a / b), 0.0)
+            return t.sum()
+
+        return float(0.5 * kl(p, m) + 0.5 * kl(q, m))
+
+
+@dataclass
+class RawFeatureFilterResults:
+    exclusion_reasons: dict = field(default_factory=dict)  # name -> [reasons]
+    train_distributions: dict = field(default_factory=dict)
+    score_distributions: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "exclusionReasons": {k: list(v)
+                                 for k, v in self.exclusion_reasons.items()},
+            "trainFillRates": {k: d.fill_rate
+                               for k, d in self.train_distributions.items()},
+            "scoreFillRates": {k: d.fill_rate
+                               for k, d in self.score_distributions.items()},
+        }
+
+
+def _distribution(col: HostColumn, name: str, bins: int,
+                  rng_minmax: Optional[tuple[float, float]] = None
+                  ) -> FeatureDistribution:
+    n = len(col)
+    kind = col.kind
+    if kind in NUMERIC_KINDS:
+        mask = col.mask
+        vals = col.values[mask]
+        nulls = int(n - mask.sum())
+        if kind == "binary":
+            hist = np.asarray([(vals == 0).sum(), (vals == 1).sum()], float)
+            summary = {"min": 0.0, "max": 1.0}
+        else:
+            lo, hi = rng_minmax if rng_minmax else (
+                (float(vals.min()), float(vals.max())) if vals.size
+                else (0.0, 1.0))
+            if hi <= lo:
+                hi = lo + 1.0
+            # clip so out-of-range scoring mass lands in the edge bins
+            # instead of silently vanishing (it IS the distribution shift)
+            hist, _ = np.histogram(np.clip(vals, lo, hi), bins=bins,
+                                   range=(lo, hi))
+            summary = {"min": lo, "max": hi,
+                       "mean": float(vals.mean()) if vals.size else 0.0}
+        return FeatureDistribution(name, n, nulls, hist.astype(float), summary)
+    if kind in TEXT_KINDS or kind == "textlist":
+        hist = np.zeros(bins, dtype=float)
+        nulls = 0
+        for v in col.values:
+            if v is None or (isinstance(v, list) and not v):
+                nulls += 1
+                continue
+            toks = v if isinstance(v, list) else tokenize(str(v))
+            for t in toks:
+                hist[hash_token(t, bins)] += 1.0
+        return FeatureDistribution(name, n, nulls, hist, {})
+    # everything else: fill-rate-only distribution
+    nulls = 0
+    for i in range(n):
+        v = col.python_value(i)
+        if v is None or (hasattr(v, "__len__") and len(v) == 0):
+            nulls += 1
+    return FeatureDistribution(name, n, nulls, np.zeros(1), {})
+
+
+class RawFeatureFilter:
+    def __init__(self,
+                 scoring_reader=None,
+                 bins: int = 100,
+                 min_fill: float = 0.001,
+                 max_fill_difference: float = 0.9,
+                 max_fill_ratio_diff: float = 20.0,
+                 max_js_divergence: float = 0.9,
+                 max_correlation_null_label: float = 0.9,
+                 protected_features: Sequence[str] = ()):
+        self.scoring_reader = scoring_reader
+        self.bins = bins
+        self.min_fill = min_fill
+        self.max_fill_difference = max_fill_difference
+        self.max_fill_ratio_diff = max_fill_ratio_diff
+        self.max_js_divergence = max_js_divergence
+        self.max_correlation_null_label = max_correlation_null_label
+        self.protected_features = set(protected_features)
+        self.results = RawFeatureFilterResults()
+
+    def filter_frame(self, frame: HostFrame, raw_features
+                     ) -> tuple[HostFrame, list[str]]:
+        reasons: dict[str, list[str]] = {}
+        responses = {f.name for f in raw_features if f.is_response}
+        y = None
+        for rname in responses:
+            if rname in frame and frame[rname].kind in NUMERIC_KINDS:
+                y = frame[rname].values
+                break
+
+        score_frame = None
+        if self.scoring_reader is not None:
+            predictors = [f for f in raw_features if not f.is_response]
+            score_frame = self.scoring_reader.generate_frame(predictors)
+
+        for f in raw_features:
+            name = f.name
+            if name in responses or name in self.protected_features:
+                continue
+            col = frame[name]
+            train_dist = _distribution(col, name, self.bins)
+            self.results.train_distributions[name] = train_dist
+            why: list[str] = []
+            if train_dist.fill_rate < self.min_fill:
+                why.append(f"training fill rate {train_dist.fill_rate:.4f} "
+                           f"< {self.min_fill}")
+            # null indicator <-> label correlation
+            if y is not None and col.mask is not None:
+                null_ind = (~col.mask).astype(float)
+                if 0.0 < null_ind.mean() < 1.0 and np.std(y) > 0:
+                    c = abs(float(np.corrcoef(null_ind, y)[0, 1]))
+                    if c > self.max_correlation_null_label:
+                        why.append(
+                            f"null-indicator label correlation {c:.3f} > "
+                            f"{self.max_correlation_null_label}")
+            if score_frame is not None and name in score_frame:
+                rng = None
+                if "min" in train_dist.summary:
+                    rng = (train_dist.summary["min"], train_dist.summary["max"])
+                score_dist = _distribution(score_frame[name], name, self.bins,
+                                           rng)
+                self.results.score_distributions[name] = score_dist
+                ft_, fs = train_dist.fill_rate, score_dist.fill_rate
+                if abs(ft_ - fs) > self.max_fill_difference:
+                    why.append(f"fill difference |{ft_:.3f}-{fs:.3f}| > "
+                               f"{self.max_fill_difference}")
+                ratio = (max(ft_, fs) / min(ft_, fs)) if min(ft_, fs) > 0 \
+                    else float("inf")
+                if ratio > self.max_fill_ratio_diff:
+                    why.append(f"fill ratio {ratio:.2f} > "
+                               f"{self.max_fill_ratio_diff}")
+                js = train_dist.js_divergence(score_dist)
+                if train_dist.distribution.size > 1 \
+                        and js > self.max_js_divergence:
+                    why.append(f"JS divergence {js:.3f} > "
+                               f"{self.max_js_divergence}")
+            if why:
+                reasons[name] = why
+
+        self.results.exclusion_reasons = reasons
+        blocklist = sorted(reasons)
+        return frame.drop(blocklist), blocklist

@@ -1,0 +1,5 @@
+from transmogrifai_tpu.preparators.sanity_checker import (
+    DropIndicesModel, SanityChecker, SanityCheckerSummary,
+)
+
+__all__ = ["DropIndicesModel", "SanityChecker", "SanityCheckerSummary"]

@@ -1,0 +1,281 @@
+"""SanityChecker: automated feature validation & cleaning.
+
+Parity: reference ``core/.../stages/impl/preparators/SanityChecker.scala:
+232-656`` (+ ``SanityCheckerMetadata``, ``DerivedFeatureFilterUtils``,
+``MinVarianceFilter``) — a BinaryEstimator (label RealNN, features OPVector
+-> cleaned OPVector) that computes per-column statistics, label
+correlations, optional feature-feature correlations, and per-categorical-
+group contingency stats (Cramér's V, PMI, association-rule confidence), then
+**drops columns** failing: minVariance, max/min label correlation,
+maxCramersV, maxRuleConfidence — with whole-feature-group removal. Emits a
+``SanityCheckerSummary`` consumed by ModelInsights.
+
+TPU-first: every statistic is one fused jitted program over the sharded
+feature matrix — masked moments and label covariance are [n,d] reductions,
+the feature-feature matrix is a single [d,n]x[n,d] MXU matmul, and ALL
+categorical contingency tables compute at once as ``X^T @ onehot(y)``
+(the reference's per-group reduceByKey collapses into one matmul). Only the
+tiny [d, C] results reach the host for the drop decisions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import DeviceTransformer, Estimator
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.stats import contingency_stats
+from transmogrifai_tpu.vector_metadata import VectorMetadata
+
+__all__ = ["SanityChecker", "DropIndicesModel", "SanityCheckerSummary"]
+
+
+@dataclass
+class ColumnStats:
+    name: str
+    mean: float
+    variance: float
+    min: float
+    max: float
+    corr_label: float
+    dropped: bool = False
+    reasons: list = field(default_factory=list)
+
+
+@dataclass
+class SanityCheckerSummary:
+    n_rows: int
+    names: list
+    column_stats: list            # list[ColumnStats]
+    categorical_stats: dict       # group -> {"cramersV":, "maxRuleConfidence":, "supports":}
+    dropped: list                 # names
+    feature_corr: Optional[list] = None   # d x d matrix (when computed)
+
+    def to_json(self) -> dict:
+        return {
+            "nRows": self.n_rows,
+            "columnStats": [{
+                "name": c.name, "mean": c.mean, "variance": c.variance,
+                "min": c.min, "max": c.max, "corrLabel": c.corr_label,
+                "dropped": c.dropped, "reasons": list(c.reasons),
+            } for c in self.column_stats],
+            "categoricalStats": self.categorical_stats,
+            "dropped": list(self.dropped),
+        }
+
+
+@jax.jit
+def _moment_stats(X, y):
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    var = jnp.var(X, axis=0)
+    xmin = jnp.min(X, axis=0)
+    xmax = jnp.max(X, axis=0)
+    ymean = jnp.mean(y)
+    cov = jnp.mean((X - mean) * (y - ymean)[:, None], axis=0)
+    ystd = jnp.sqrt(jnp.maximum(jnp.var(y), 1e-12))
+    corr = cov / (jnp.sqrt(jnp.maximum(var, 1e-12)) * ystd)
+    return mean, var, xmin, xmax, corr
+
+
+@jax.jit
+def _contingency(X, y_onehot):
+    return X.T @ y_onehot
+
+
+@jax.jit
+def _feature_corr(X):
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    Xc = X - mean
+    sd = jnp.sqrt(jnp.maximum(jnp.mean(Xc * Xc, axis=0), 1e-12))
+    Z = Xc / sd
+    return (Z.T @ Z) / n
+
+
+class SanityChecker(Estimator):
+    """(label, features) -> cleaned features."""
+
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.OPVector
+
+    def __init__(self,
+                 max_correlation: float = 0.95,
+                 min_correlation: float = 0.0,
+                 min_variance: float = 1e-5,
+                 max_cramers_v: float = 0.95,
+                 max_rule_confidence: float = 1.0,
+                 min_required_rule_support: float = 0.001,
+                 remove_feature_group: bool = True,
+                 compute_feature_corr: bool = True,
+                 max_feature_corr_width: int = 1500,
+                 categorical_label_max_classes: int = 100,
+                 uid: Optional[str] = None):
+        self.max_correlation = max_correlation
+        self.min_correlation = min_correlation
+        self.min_variance = min_variance
+        self.max_cramers_v = max_cramers_v
+        self.max_rule_confidence = max_rule_confidence
+        self.min_required_rule_support = min_required_rule_support
+        self.remove_feature_group = remove_feature_group
+        self.compute_feature_corr = compute_feature_corr
+        self.max_feature_corr_width = max_feature_corr_width
+        self.categorical_label_max_classes = categorical_label_max_classes
+        super().__init__(uid=uid)
+
+    def fit_model(self, data) -> "DropIndicesModel":
+        label_name, feat_name = self.input_names
+        col = data.device_col(feat_name)
+        X = col.values
+        meta: Optional[VectorMetadata] = col.metadata
+        y = data.device_col(label_name).values
+        n, d = int(X.shape[0]), int(X.shape[1])
+        names = (meta.col_names() if meta is not None and meta.size == d
+                 else [f"col_{j}" for j in range(d)])
+
+        mean, var, xmin, xmax, corr = (np.asarray(a, np.float64)
+                                       for a in _moment_stats(X, y))
+
+        # categorical groups from provenance metadata
+        groups: dict[str, list[int]] = {}
+        if meta is not None and meta.size == d:
+            for j, cm in enumerate(meta.columns):
+                g = cm.feature_group()
+                if g is not None and cm.indicator_value is not None:
+                    groups.setdefault(g, []).append(j)
+
+        # contingency stats per group via one matmul for all columns
+        cat_stats: dict[str, dict] = {}
+        y_np = np.asarray(y)
+        classes = np.unique(y_np)
+        if groups and classes.size <= self.categorical_label_max_classes \
+                and classes.size >= 2:
+            y_onehot = jnp.asarray(
+                (y_np[:, None] == classes[None, :]).astype(np.float32))
+            M = np.asarray(_contingency(X, y_onehot), np.float64)
+            for g, idxs in groups.items():
+                cs = contingency_stats(M[idxs])
+                cat_stats[g] = {
+                    "cramersV": cs.cramers_v,
+                    "mutualInfo": cs.mutual_info,
+                    "maxRuleConfidences": cs.max_rule_confidences.tolist(),
+                    "supports": cs.supports.tolist(),
+                }
+
+        # ---- drop decisions -------------------------------------------------
+        col_stats = [ColumnStats(names[j], mean[j], var[j], xmin[j], xmax[j],
+                                 corr[j]) for j in range(d)]
+        for j, c in enumerate(col_stats):
+            if c.variance < self.min_variance:
+                c.reasons.append("variance too low")
+            acorr = abs(c.corr_label)
+            if np.isfinite(acorr):
+                if acorr > self.max_correlation:
+                    c.reasons.append("label correlation too high (leakage)")
+                elif acorr < self.min_correlation:
+                    c.reasons.append("label correlation too low")
+        group_dropped: set[str] = set()
+        for g, idxs in groups.items():
+            st = cat_stats.get(g)
+            if st is None:
+                continue
+            if st["cramersV"] > self.max_cramers_v:
+                group_dropped.add(g)
+                for j in idxs:
+                    col_stats[j].reasons.append("Cramér's V too high (leakage)")
+            else:
+                conf = np.asarray(st["maxRuleConfidences"])
+                sup = np.asarray(st["supports"])
+                if np.any((conf >= self.max_rule_confidence)
+                          & (sup >= self.min_required_rule_support)):
+                    group_dropped.add(g)
+                    for j in idxs:
+                        col_stats[j].reasons.append(
+                            "association rule confidence too high")
+        if self.remove_feature_group and meta is not None and meta.size == d:
+            # a label-corr drop on any indicator removes its whole group
+            for g, idxs in groups.items():
+                if g in group_dropped:
+                    continue
+                if any("leakage" in r for j in idxs
+                       for r in col_stats[j].reasons):
+                    for j in idxs:
+                        if not col_stats[j].reasons:
+                            col_stats[j].reasons.append(
+                                "feature group removed (leaky sibling)")
+
+        keep = [j for j, c in enumerate(col_stats) if not c.reasons]
+        if not keep:
+            # never drop everything: keep the highest-|corr| column
+            j = int(np.nanargmax(np.abs(corr)))
+            col_stats[j].reasons.clear()
+            keep = [j]
+        for c in col_stats:
+            c.dropped = bool(c.reasons)
+
+        fcorr = None
+        if self.compute_feature_corr and d <= self.max_feature_corr_width:
+            fcorr = np.asarray(_feature_corr(X), np.float64).tolist()
+
+        summary = SanityCheckerSummary(
+            n_rows=n, names=names, column_stats=col_stats,
+            categorical_stats=cat_stats,
+            dropped=[c.name for c in col_stats if c.dropped],
+            feature_corr=fcorr)
+        new_meta = meta.select(keep) if meta is not None and meta.size == d \
+            else None
+        return DropIndicesModel(keep_indices=keep, out_meta=new_meta,
+                                summary=summary)
+
+
+class DropIndicesModel(DeviceTransformer):
+    """Gathers the kept columns; reindexed provenance metadata rides along."""
+
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.OPVector
+
+    def __init__(self, keep_indices=(), out_meta: Optional[VectorMetadata] = None,
+                 summary: Optional[SanityCheckerSummary] = None,
+                 uid: Optional[str] = None):
+        self.keep_indices = [int(i) for i in keep_indices]
+        self.out_meta = out_meta
+        self.summary = summary
+        super().__init__(uid=uid)
+
+    def runtime_input_names(self):
+        return (self.input_names[1],) if len(self.input_names) == 2 \
+            else self.input_names
+
+    def device_params(self):
+        return jnp.asarray(self.keep_indices, jnp.int32)
+
+    def device_apply(self, params, col: fr.VectorColumn) -> fr.VectorColumn:
+        meta = self.out_meta
+        if meta is None and col.metadata is not None \
+                and col.metadata.size == int(col.values.shape[1]):
+            meta = col.metadata.select(self.keep_indices)
+        return fr.VectorColumn(jnp.take(col.values, params, axis=1), meta)
+
+    def transform_row(self, *values):
+        vec = np.asarray(values[-1], dtype=np.float32)
+        return vec[np.asarray(self.keep_indices, dtype=np.int64)]
+
+    def config(self):
+        return {
+            "keep_indices": self.keep_indices,
+            "out_meta": self.out_meta.to_json() if self.out_meta else None,
+            "summary": self.summary.to_json() if self.summary else None,
+        }
+
+    @classmethod
+    def from_config(cls, config, uid=None):
+        meta = (VectorMetadata.from_json(config["out_meta"])
+                if config.get("out_meta") else None)
+        return cls(keep_indices=config.get("keep_indices", ()),
+                   out_meta=meta, uid=uid)

@@ -1,0 +1,109 @@
+"""Contingency-matrix statistics.
+
+Parity: reference ``utils/.../stats/OpStatistics.scala`` — chi-squared /
+Cramér's V (with bias correction), mutual information, pointwise mutual
+information, and association-rule confidence/support from a category x label
+contingency matrix.
+
+The contingency matrices themselves are produced on device as one
+``X_onehot^T @ Y_onehot`` matmul inside the SanityChecker's fused stats
+program; these helpers do the small [k, C] math on host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["ContingencyStats", "contingency_stats", "cramers_v",
+           "mutual_info", "pointwise_mutual_info"]
+
+
+def _chi2(m: np.ndarray) -> float:
+    n = m.sum()
+    if n == 0:
+        return 0.0
+    row = m.sum(axis=1, keepdims=True)
+    col = m.sum(axis=0, keepdims=True)
+    expected = row @ col / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = np.where(expected > 0, (m - expected) ** 2 / expected, 0.0)
+    return float(terms.sum())
+
+
+def _filter_empties(m: np.ndarray) -> np.ndarray:
+    """Drop all-zero rows/columns (reference OpStatistics.filterEmpties)."""
+    m = m[m.sum(axis=1) > 0]
+    if m.size:
+        m = m[:, m.sum(axis=0) > 0]
+    return m
+
+
+def cramers_v(m: np.ndarray) -> float:
+    """Plain Cramér's V = sqrt(phi^2 / min(r-1, c-1)) on the empties-filtered
+    matrix (reference OpStatistics.chiSquaredTestOnFiltered:207-209)."""
+    m = _filter_empties(np.asarray(m, dtype=np.float64))
+    if m.size == 0:
+        return 0.0
+    n = m.sum()
+    r, k = m.shape
+    if n == 0 or r < 2 or k < 2:
+        return 0.0
+    phi2 = _chi2(m) / n
+    denom = min(r - 1, k - 1)
+    return float(np.sqrt(phi2 / denom))
+
+
+def mutual_info(m: np.ndarray) -> float:
+    m = np.asarray(m, dtype=np.float64)
+    n = m.sum()
+    if n == 0:
+        return 0.0
+    p = m / n
+    px = p.sum(axis=1, keepdims=True)
+    py = p.sum(axis=0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = np.where(p > 0, p * np.log2(p / (px @ py)), 0.0)
+    return float(terms.sum())
+
+
+def pointwise_mutual_info(m: np.ndarray) -> np.ndarray:
+    """PMI per cell (log2), 0 where the cell is empty."""
+    m = np.asarray(m, dtype=np.float64)
+    n = m.sum()
+    if n == 0:
+        return np.zeros_like(m)
+    p = m / n
+    px = p.sum(axis=1, keepdims=True)
+    py = p.sum(axis=0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.where(p > 0, np.log2(p / (px @ py)), 0.0)
+
+
+@dataclass(frozen=True)
+class ContingencyStats:
+    chi2: float
+    cramers_v: float
+    mutual_info: float
+    pointwise_mutual_info: np.ndarray   # [categories, labels]
+    #: per category: max over labels of P(label | category)
+    max_rule_confidences: np.ndarray    # [categories]
+    #: per category: P(category)
+    supports: np.ndarray                # [categories]
+
+
+def contingency_stats(m: np.ndarray) -> ContingencyStats:
+    m = np.asarray(m, dtype=np.float64)
+    n = max(m.sum(), 1e-12)
+    row = m.sum(axis=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        conf = np.where(row[:, None] > 0, m / row[:, None], 0.0)
+    return ContingencyStats(
+        chi2=_chi2(m),
+        cramers_v=cramers_v(m),
+        mutual_info=mutual_info(m),
+        pointwise_mutual_info=pointwise_mutual_info(m),
+        max_rule_confidences=conf.max(axis=1) if m.shape[1] else row * 0,
+        supports=row / n,
+    )

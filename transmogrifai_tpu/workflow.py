@@ -78,16 +78,23 @@ class Workflow:
         raw = self.raw_features()
         frame = self.reader.generate_frame(raw)
         blocklist: list[str] = []
+        result = self.result_features
         if self._raw_feature_filter is not None:
             frame, blocklist = self._raw_feature_filter.filter_frame(
                 frame, raw)
-            raw = [f for f in raw if f.name not in set(blocklist)]
+            if blocklist:
+                result = _apply_blocklist(result, set(blocklist))
+                if not result:
+                    raise ValueError(
+                        "RawFeatureFilter blocked every path to the result "
+                        f"features (blocklist: {blocklist})")
+                raw = [f for f in raw if f.name not in set(blocklist)]
         data = PipelineData.from_host(frame)
-        dag = compute_dag(self.result_features)
+        dag = compute_dag(result)
         executor = DagExecutor()
         _, fitted = executor.fit_transform(data, dag)
         return WorkflowModel(
-            result_features=self.result_features,
+            result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
             blocklisted=blocklist)
 
@@ -229,6 +236,37 @@ class WorkflowModel:
     def score_function(self):
         from transmogrifai_tpu.local.scoring import make_score_function
         return make_score_function(self)
+
+
+def _apply_blocklist(result_features: Sequence[FeatureLike],
+                     blocked: set[str]) -> tuple[FeatureLike, ...]:
+    """Rewire the DAG dropping blocklisted raw features (reference
+    ``OpWorkflow.setBlocklist:118-167`` semantics): variadic stages lose the
+    blocked inputs; fixed-arity stages with a blocked input become blocked
+    themselves and the block propagates to their consumers. Mutates stage
+    wiring in place (the pre-training graph is the only owner)."""
+    blocked_uids: set[str] = set()
+
+    def is_blocked(f: FeatureLike) -> bool:
+        return (f.is_raw and f.name in blocked) or f.uid in blocked_uids
+
+    for layer in compute_dag(result_features):
+        for stage in layer:
+            new_in = tuple(p for p in stage.input_features if not is_blocked(p))
+            if len(new_in) == len(stage.input_features):
+                continue
+            min_arity = len(stage.in_types) if not stage.variadic \
+                else len(stage.in_types)  # variadic: fixed prefix + >=1
+            ok = (stage.variadic and len(new_in) >= min_arity) or \
+                 (not stage.variadic and len(new_in) == len(stage.in_types))
+            if ok:
+                stage._inputs = new_in
+                out = stage._output
+                if out is not None:
+                    out._parents = new_in
+            else:
+                blocked_uids.add(stage.get_output().uid)
+    return tuple(f for f in result_features if not is_blocked(f))
 
 
 def _best_metric(s) -> float:
