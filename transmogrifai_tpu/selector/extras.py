@@ -1,0 +1,136 @@
+"""Selector extras: random hyperparameter search + model combination.
+
+Parity: reference ``selector/RandomParamBuilder.scala`` (random grids over
+subset/uniform/exponential supports) and ``selector/SelectedModelCombiner
+.scala`` (ensemble of two selector outputs weighted by validation metric).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+from transmogrifai_tpu.models.base import PredictionModel
+from transmogrifai_tpu.stages.base import Estimator
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["RandomParamBuilder", "SelectedModelCombiner", "CombinedModel"]
+
+
+class RandomParamBuilder:
+    """``RandomParamBuilder(seed).subset("a", [1,2]).uniform("b", 0, 1)
+    .exponential("c", 1e-4, 1e-1).build(10)`` -> 10 random param dicts."""
+
+    def __init__(self, seed: int = 42):
+        self._rng = np.random.default_rng(seed)
+        self._specs: list[tuple[str, str, object]] = []
+
+    def subset(self, name: str, values: Sequence) -> "RandomParamBuilder":
+        self._specs.append((name, "subset", list(values)))
+        return self
+
+    def uniform(self, name: str, low: float, high: float) -> "RandomParamBuilder":
+        self._specs.append((name, "uniform", (low, high)))
+        return self
+
+    def exponential(self, name: str, low: float, high: float
+                    ) -> "RandomParamBuilder":
+        if low <= 0 or high <= 0:
+            raise ValueError("exponential bounds must be positive")
+        self._specs.append((name, "exponential", (low, high)))
+        return self
+
+    def build(self, n: int) -> list[dict]:
+        out = []
+        for _ in range(n):
+            d = {}
+            for name, kind, spec in self._specs:
+                if kind == "subset":
+                    d[name] = spec[self._rng.integers(len(spec))]
+                elif kind == "uniform":
+                    lo, hi = spec
+                    d[name] = float(self._rng.uniform(lo, hi))
+                else:
+                    lo, hi = spec
+                    d[name] = float(np.exp(
+                        self._rng.uniform(np.log(lo), np.log(hi))))
+            out.append(d)
+        return out
+
+
+class CombinedModel(PredictionModel):
+    """Weighted average of two Prediction inputs."""
+
+    in_types = (ft.RealNN, ft.Prediction, ft.Prediction)
+    out_type = ft.Prediction
+
+    def __init__(self, weight1: float = 0.5, weight2: float = 0.5,
+                 uid: Optional[str] = None):
+        self.weight1 = float(weight1)
+        self.weight2 = float(weight2)
+        super().__init__(uid=uid)
+
+    def runtime_input_names(self):
+        return self.input_names[1:]
+
+    def device_params(self):
+        return (jnp.float32(self.weight1), jnp.float32(self.weight2))
+
+    def device_apply(self, params, p1: fr.PredictionColumn,
+                     p2: fr.PredictionColumn) -> fr.PredictionColumn:
+        w1, w2 = params
+        prob = w1 * p1.probability + w2 * p2.probability
+        raw = w1 * p1.raw_prediction + w2 * p2.raw_prediction
+        if prob.shape[1] >= 2:
+            pred = jnp.argmax(prob, axis=1).astype(jnp.float32)
+        else:
+            pred = w1 * p1.prediction + p2.prediction * w2
+        return fr.PredictionColumn(pred, raw, prob)
+
+    def transform_row(self, *values):
+        p1, p2 = values[-2], values[-1]
+        keys = set(p1) | set(p2)
+        out = {k: self.weight1 * p1.get(k, 0.0) + self.weight2 * p2.get(k, 0.0)
+               for k in keys}
+        probs = [(int(k.rsplit("_", 1)[1]), v) for k, v in out.items()
+                 if k.startswith("probability_")]
+        if probs:
+            out["prediction"] = float(max(probs, key=lambda kv: kv[1])[0])
+        return out
+
+
+class SelectedModelCombiner(Estimator):
+    """(label, pred1, pred2) -> combined Prediction weighted by each input's
+    metric on the training data."""
+
+    in_types = (ft.RealNN, ft.Prediction, ft.Prediction)
+    out_type = ft.Prediction
+
+    def __init__(self, evaluator: Optional[EvaluatorBase] = None,
+                 metric: Optional[str] = None,
+                 uid: Optional[str] = None):
+        from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+        self.evaluator = evaluator or OpBinaryClassificationEvaluator()
+        self.metric = metric
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        label_name, p1_name, p2_name = self.input_names
+        y = data.device_col(label_name).values
+        ev = self.evaluator
+        m1 = ev.metric_value(ev.evaluate_arrays(y, data.device_col(p1_name)),
+                             self.metric)
+        m2 = ev.metric_value(ev.evaluate_arrays(y, data.device_col(p2_name)),
+                             self.metric)
+        if not ev.larger_is_better(self.metric):
+            m1, m2 = 1.0 / max(m1, 1e-12), 1.0 / max(m2, 1e-12)
+        total = m1 + m2
+        w1 = m1 / total if total > 0 else 0.5
+        model = CombinedModel(weight1=w1, weight2=1.0 - w1)
+        model.summary = {"weight1": w1, "weight2": 1.0 - w1,
+                         "metric1": m1, "metric2": m2}
+        return model
