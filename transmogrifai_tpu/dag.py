@@ -14,9 +14,19 @@ per (layer stage uids) on the executor, so repeated scoring reuses them.
 
 from __future__ import annotations
 
+import os
+import sys
+import time
 from typing import Any, Optional, Sequence
 
 import jax
+
+_PROFILE = os.environ.get("TRANSMOGRIFAI_PROFILE") == "1"
+
+
+def _plog(msg: str, t0: float) -> None:
+    if _PROFILE:
+        print(f"[profile] {msg}: {time.time() - t0:.2f}s", file=sys.stderr)
 
 from transmogrifai_tpu.features.feature import FeatureLike
 from transmogrifai_tpu.pipeline_data import PipelineData
@@ -81,12 +91,17 @@ class DagExecutor:
             fitted_layer: list[Transformer] = []
             for stage in layer:
                 if isinstance(stage, Estimator):
+                    t0 = time.time()
                     fitted_layer.append(stage.fit(data))
+                    _plog(f"fit {stage.operation_name}", t0)
                 elif isinstance(stage, Transformer):
                     fitted_layer.append(stage)
                 else:
                     raise TypeError(f"Cannot execute stage {stage!r}")
+            t0 = time.time()
             data = self.apply_layer(data, fitted_layer)
+            _plog(f"apply layer [{', '.join(t.operation_name for t in fitted_layer)}]",
+                  t0)
             fitted_dag.append(fitted_layer)
         return data, fitted_dag
 

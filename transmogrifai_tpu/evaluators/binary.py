@@ -13,6 +13,7 @@ AuROC by trapezoid and AuPR by step-wise average precision.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+import functools
 from typing import Optional
 
 import jax
@@ -74,6 +75,16 @@ def _binary_curves(y, score, yhat, w):
                 thresholds=ss, tpr=tpr, fpr=fpr, precision_curve=precision)
 
 
+@jax.jit
+def _binary_scalars(y, score, yhat, w):
+    """All scalar metrics as ONE [6] vector so the host pays a single
+    device->host sync (scalar-by-scalar pulls round-trip per value on
+    tunneled devices)."""
+    c = _binary_curves(y, score, yhat, w)
+    return jnp.stack([c["au_roc"], c["au_pr"], c["tp"], c["fp"], c["tn"],
+                      c["fn"]])
+
+
 def binary_metrics_arrays(y, score, w=None, yhat=None,
                           with_threshold_metrics: bool = False
                           ) -> BinaryClassificationMetrics:
@@ -82,8 +93,11 @@ def binary_metrics_arrays(y, score, w=None, yhat=None,
     w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
     yhat = (score >= 0.5).astype(jnp.float32) if yhat is None \
         else jnp.asarray(yhat, jnp.float32)
-    c = _binary_curves(y, score, yhat, w)
-    tp, fp, tn, fn = (float(c[k]) for k in ("tp", "fp", "tn", "fn"))
+    au_roc_v, au_pr_v, tp, fp, tn, fn = np.asarray(
+        _binary_scalars(y, score, yhat, w), np.float64)
+    c = {"au_roc": au_roc_v, "au_pr": au_pr_v}
+    if with_threshold_metrics:
+        c = _binary_curves(y, score, yhat, w)
     precision = tp / (tp + fp) if tp + fp > 0 else 0.0
     recall = tp / (tp + fn) if tp + fn > 0 else 0.0
     f1 = (2 * precision * recall / (precision + recall)
@@ -104,8 +118,33 @@ def binary_metrics_arrays(y, score, w=None, yhat=None,
         }
     return BinaryClassificationMetrics(
         precision=precision, recall=recall, f1=f1,
-        au_roc=float(c["au_roc"]), au_pr=float(c["au_pr"]), error=error,
+        au_roc=float(au_roc_v), au_pr=float(au_pr_v), error=error,
         tp=tp, tn=tn, fp=fp, fn=fn, threshold_metrics=thr)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _metric_batch(y, scores, w, metric: str):
+    """Validation metric for a whole candidate batch: [G, n] scores -> [G].
+    One fused program — the selector's sweep never syncs per candidate."""
+    def one(s):
+        c = _binary_curves(y, s, (s >= 0.0).astype(jnp.float32), w)
+        if metric == "auROC":
+            return c["au_roc"]
+        if metric == "auPR":
+            return c["au_pr"]
+        tp, fp, tn, fn = c["tp"], c["fp"], c["tn"], c["fn"]
+        precision = tp / jnp.maximum(tp + fp, 1e-12)
+        recall = tp / jnp.maximum(tp + fn, 1e-12)
+        if metric == "Precision":
+            return precision
+        if metric == "Recall":
+            return recall
+        if metric == "F1":
+            return 2 * precision * recall / jnp.maximum(
+                precision + recall, 1e-12)
+        return (fp + fn) / jnp.maximum(tp + fp + tn + fn, 1e-12)  # Error
+
+    return jax.vmap(one)(scores)
 
 
 class OpBinaryClassificationEvaluator(EvaluatorBase):
@@ -135,3 +174,10 @@ class OpBinaryClassificationEvaluator(EvaluatorBase):
         return binary_metrics_arrays(
             y, score, w, yhat=pred_col.prediction,
             with_threshold_metrics=self.with_threshold_metrics)
+
+    def metric_batch_scores(self, y, scores, metric=None, w=None) -> np.ndarray:
+        """Batched sweep path: scores [G, n] are margins (decision at 0)."""
+        y = jnp.asarray(y, jnp.float32)
+        w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
+        return np.asarray(_metric_batch(y, jnp.asarray(scores, jnp.float32),
+                                        w, metric or self.default_metric))

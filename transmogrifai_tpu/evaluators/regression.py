@@ -58,3 +58,21 @@ class OpRegressionEvaluator(EvaluatorBase):
         return RegressionMetrics(
             rmse=float(np.sqrt(mse)), mse=mse, r2=r2, mae=mae,
             signed_percentage_error_histogram=hist)
+
+    def metric_batch_scores(self, y, preds, metric=None, w=None) -> np.ndarray:
+        """Batched sweep path: preds [G, n] predictions -> metric per model."""
+        metric = metric or self.default_metric
+        y = jnp.asarray(y, jnp.float32)[None, :]
+        preds = jnp.asarray(preds, jnp.float32)
+        err = preds - y
+        mse = jnp.mean(err ** 2, axis=1)
+        if metric == "MSE":
+            out = mse
+        elif metric == "RMSE":
+            out = jnp.sqrt(mse)
+        elif metric == "MAE":
+            out = jnp.mean(jnp.abs(err), axis=1)
+        else:  # R2
+            ss_tot = jnp.maximum(jnp.sum((y - jnp.mean(y)) ** 2), 1e-12)
+            out = 1.0 - jnp.sum(err ** 2, axis=1) / ss_tot
+        return np.asarray(out)

@@ -69,6 +69,13 @@ class Predictor(Estimator):
         override with a stacked-axis batched trainer."""
         return [self.fit_arrays(X, y, w, {**self.params, **g}) for g in grid]
 
+    def grid_predict_scores(self, models: Sequence["PredictionModel"], X):
+        """Fast sweep path: validation scores for all fitted grid models as
+        one [G, n] device array (margins for binary, predictions for
+        regression), or None when the family has no batched path — the
+        selector then falls back to per-model evaluation."""
+        return None
+
     def fit_model(self, data) -> "PredictionModel":
         X, y, w = self._xyw(data)
         return self.fit_arrays(X, y, w, self.params)

@@ -127,10 +127,10 @@ class LinearClassificationModel(PredictionModel):
 
     def __init__(self, weights=None, intercept=None, probabilistic: bool = True,
                  uid: Optional[str] = None):
-        self.weights = np.asarray(weights, np.float64) if weights is not None \
-            else np.zeros((0, 2))
-        self.intercept = np.asarray(intercept, np.float64) if intercept is not None \
-            else np.zeros(2)
+        # weights may be device arrays during the CV sweep (no host pull);
+        # they convert lazily on serialization/introspection
+        self.weights = weights if weights is not None else np.zeros((0, 2))
+        self.intercept = intercept if intercept is not None else np.zeros(2)
         self.probabilistic = probabilistic
         super().__init__(uid=uid)
 
@@ -149,7 +149,8 @@ class LinearClassificationModel(PredictionModel):
         return fr.PredictionColumn(pred, z, prob)
 
     def fitted_state(self):
-        return {"weights": self.weights, "intercept": self.intercept,
+        return {"weights": np.asarray(self.weights, np.float64),
+                "intercept": np.asarray(self.intercept, np.float64),
                 "probabilistic": self.probabilistic}
 
     def set_fitted_state(self, state):
@@ -167,21 +168,20 @@ class LinearClassificationModel(PredictionModel):
     def feature_contributions(self) -> np.ndarray:
         """Per-feature coefficients (binary: positive-class column) for
         ModelInsights."""
-        W = self.weights
+        W = np.asarray(self.weights)
         return W[:, -1] if W.shape[1] >= 2 else W[:, 0]
 
 
 class LinearRegressionModel(PredictionModel):
-    def __init__(self, weights=None, intercept: float = 0.0,
+    def __init__(self, weights=None, intercept=0.0,
                  uid: Optional[str] = None):
-        self.weights = np.asarray(weights, np.float64) if weights is not None \
-            else np.zeros(0)
-        self.intercept = float(intercept)
+        self.weights = weights if weights is not None else np.zeros(0)
+        self.intercept = intercept
         super().__init__(uid=uid)
 
     def device_params(self):
         return (jnp.asarray(self.weights, jnp.float32),
-                jnp.float32(self.intercept))
+                jnp.asarray(self.intercept, jnp.float32))
 
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         W, b = params
@@ -191,7 +191,8 @@ class LinearRegressionModel(PredictionModel):
         return fr.PredictionColumn(yhat, empty, empty)
 
     def fitted_state(self):
-        return {"weights": self.weights, "intercept": np.float64(self.intercept)}
+        return {"weights": np.asarray(self.weights, np.float64),
+                "intercept": np.float64(self.intercept)}
 
     def set_fitted_state(self, state):
         self.weights = np.asarray(state["weights"], np.float64)
@@ -205,7 +206,7 @@ class LinearRegressionModel(PredictionModel):
         return cls(uid=uid)
 
     def feature_contributions(self) -> np.ndarray:
-        return self.weights
+        return np.asarray(self.weights)
 
 
 # ---------------------------------------------------------------------------
@@ -237,12 +238,11 @@ class _LinearPredictor(Predictor):
         return max(int(np.asarray(jnp.max(y))) + 1, 2)
 
     def _make_model(self, W, b) -> PredictionModel:
+        # W/b stay device-resident; host conversion happens lazily
         if self.loss_kind == "squared":
-            return LinearRegressionModel(
-                weights=np.asarray(W[:, 0]), intercept=float(b[0]))
+            return LinearRegressionModel(weights=W[:, 0], intercept=b[0])
         return LinearClassificationModel(
-            weights=np.asarray(W), intercept=np.asarray(b),
-            probabilistic=self.probabilistic)
+            weights=W, intercept=b, probabilistic=self.probabilistic)
 
     def fit_arrays(self, X, y, w, params):
         kw = self._static_kw(params, self._n_classes(y))
@@ -256,8 +256,24 @@ class _LinearPredictor(Predictor):
             return []
         kw = self._static_kw({**self.params, **grid[0]}, self._n_classes(y))
         Ws, bs, _ = _run_grid(X, y, w, grid, self.params, kw)
-        return [self._make_model(np.asarray(Ws[i]), np.asarray(bs[i]))
-                for i in range(len(grid))]
+        # keep per-model weights as device views — no host pull in the sweep
+        return [self._make_model(Ws[i], bs[i]) for i in range(len(grid))]
+
+    def grid_predict_scores(self, models, X):
+        """All grid candidates score in one einsum: [G, n] margins
+        (classification) or predictions (regression)."""
+        if not models:
+            return None
+        W = jnp.stack([jnp.asarray(m.weights, jnp.float32) for m in models])
+        b = jnp.stack([jnp.asarray(m.intercept, jnp.float32) for m in models])
+        if self.loss_kind == "squared":
+            return jnp.einsum("nd,gd->gn", X, W) + b[:, None]
+        z = jnp.einsum("nd,gdc->gnc", X, W) + b[:, None, :]
+        if z.shape[-1] == 1:       # margin-only (SVC)
+            return z[:, :, 0]
+        if z.shape[-1] == 2:       # binary margin
+            return z[:, :, 1] - z[:, :, 0]
+        return None                # multiclass: no scalar score
 
 
 class OpLogisticRegression(_LinearPredictor):

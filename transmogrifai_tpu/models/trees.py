@@ -403,6 +403,39 @@ class _TreePredictor(Predictor):
         return model
 
 
+    def grid_predict_scores(self, models, X):
+        """Batched scoring when every grid model shares tree shapes (same
+        max_depth/n_out): stack tree params and vmap one predict program."""
+        if not models or not all(isinstance(m, TreeEnsembleModel)
+                                 for m in models):
+            return None
+        m0 = models[0]
+        if any(m.max_depth != m0.max_depth or m.n_out != m0.n_out
+               or m.trees[2].shape != m0.trees[2].shape for m in models):
+            return None
+        if m0.n_out != 1:
+            return None
+        edges0 = m0.bin_edges
+        same_edges = all(np.array_equal(m.bin_edges, edges0) for m in models)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[m.trees for m in models])
+        Xb = bin_data(X, jnp.asarray(edges0)) if same_edges else None
+
+        def score_one(trees, lr, base):
+            out = predict_ensemble(Xb, trees, n_out=1, learning_rate=lr,
+                                   base_score=base, bootstrap=m0.is_forest)
+            s = out[:, 0]
+            if m0.is_forest and m0.is_classifier:
+                s = jnp.clip(s, 0.0, 1.0) - 0.5  # margin at 0
+            return s
+
+        if Xb is None:
+            return None
+        lrs = jnp.asarray([m.learning_rate for m in models], jnp.float32)
+        bases = jnp.asarray([m.base_score for m in models], jnp.float32)
+        return jax.vmap(score_one)(stacked, lrs, bases)
+
+
 class OpGBTClassifier(_TreePredictor):
     """Gradient-boosted classification trees (Spark OpGBTClassifier parity;
     one-vs-all logistic boosting for multiclass)."""

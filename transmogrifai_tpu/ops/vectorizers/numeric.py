@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,6 +28,15 @@ from transmogrifai_tpu.vector_metadata import (
 )
 
 __all__ = ["RealVectorizer", "IntegralVectorizer", "BinaryVectorizer"]
+
+
+@jax.jit
+def _masked_means(values: tuple, masks: tuple):
+    """One fused program for all columns' fill means (k separate reductions
+    would pay k dispatch round-trips on remote devices)."""
+    V = jnp.stack(values, axis=1)
+    M = jnp.stack(masks, axis=1)
+    return jnp.sum(V * M, axis=0) / jnp.maximum(jnp.sum(M, axis=0), 1.0)
 
 
 def _numeric_vector_meta(out_name: str, input_feats, track_nulls: bool
@@ -106,9 +116,9 @@ class RealVectorizer(Estimator):
     def fit_model(self, data):
         if self.fill_with_mean:
             cols = [data.device_col(n) for n in self.input_names]
-            sums = jnp.stack([jnp.sum(c.values * c.mask) for c in cols])
-            cnts = jnp.stack([jnp.sum(c.mask) for c in cols])
-            means = np.asarray(sums / jnp.maximum(cnts, 1.0), np.float64)
+            means = np.asarray(_masked_means(
+                tuple(c.values for c in cols), tuple(c.mask for c in cols)),
+                np.float64)
             fills = [float(m) for m in means]
         else:
             fills = [self.fill_value] * len(self.input_names)

@@ -79,12 +79,12 @@ class PipelineData:
         col = self.host[name]
         kind = col.kind
         if kind in fr.NUMERIC_KINDS:
-            dev = fr.NumericColumn(
-                _shard(jnp.asarray(np.where(col.mask, col.values, 0.0),
-                                   dtype=jnp.float32)),
-                _shard(jnp.asarray(col.mask, dtype=jnp.float32)))
-            self.device[name] = dev
-            return dev
+            # bulk path: move EVERY numeric host column in two transfers
+            # (one [n,k] values matrix + one mask matrix) instead of 2k
+            # small ones — host->device latency, not bandwidth, dominates
+            # on tunneled/remote devices
+            self._bulk_upload_numeric()
+            return self.device[name]
         if kind == "vector":
             dev = fr.VectorColumn(_shard(jnp.asarray(col.values, jnp.float32)),
                                   col.meta)
@@ -97,6 +97,20 @@ class PipelineData:
         raise TypeError(
             f"Column {name!r} of kind {kind!r} has no generic device "
             "representation; the consuming stage must handle it on host")
+
+    def _bulk_upload_numeric(self) -> None:
+        pending = [(n, c) for n, c in self.host.columns.items()
+                   if c.kind in fr.NUMERIC_KINDS and n not in self.device]
+        if not pending:
+            return
+        vals = np.stack([np.where(c.mask, c.values, 0.0).astype(np.float32)
+                         for _, c in pending], axis=1)
+        masks = np.stack([c.mask.astype(np.float32) for _, c in pending],
+                         axis=1)
+        dvals = _shard(jnp.asarray(vals))
+        dmasks = _shard(jnp.asarray(masks))
+        for i, (name, _) in enumerate(pending):
+            self.device[name] = fr.NumericColumn(dvals[:, i], dmasks[:, i])
 
     @staticmethod
     def _encode_text(col: fr.HostColumn) -> fr.CodesColumn:

@@ -177,6 +177,7 @@ class ModelSelector(Estimator):
         super().__init__(uid=uid)
 
     def fit_model(self, data) -> SelectedModel:
+        from transmogrifai_tpu.dag import _plog
         t0 = time.time()
         label_name, feat_name = self.input_names
         X = data.device_col(feat_name).values
@@ -188,10 +189,12 @@ class ModelSelector(Estimator):
         # -- split & prepare -------------------------------------------------
         prep_results: dict = {}
         if self.splitter is not None:
-            train_idx, holdout_idx = self.splitter.split_indices(
-                n, np.asarray(y))
+            # pull the label to host only when the splitter actually needs it
+            y_np = np.asarray(y) if getattr(self.splitter, "requires_label",
+                                            True) else None
+            train_idx, holdout_idx = self.splitter.split_indices(n, y_np)
             train_idx, w_train = self.splitter.prepare_indices(
-                train_idx, np.asarray(y))
+                train_idx, y_np)
             if self.splitter.summary:
                 prep_results = {self.splitter.summary.splitter:
                                 self.splitter.summary.detail}
@@ -205,14 +208,30 @@ class ModelSelector(Estimator):
         # -- validation sweep ------------------------------------------------
         results: list[ModelEvaluation] = []
         mean_metrics: list[tuple[float, int, int]] = []  # (metric, cand_i, grid_j)
-        folds = self.validator.splits(int(Xt.shape[0]), np.asarray(yt))
+        yt_np = (np.asarray(yt)
+                 if getattr(self.validator, "stratify", False) else None)
+        _folds = self.validator.splits(int(Xt.shape[0]), yt_np)
         per_candidate_scores: dict[tuple[int, int], list[float]] = {}
-        for tr, va in folds:
+        _plog("selector: split+prepare", t0)
+        batch_metrics = getattr(ev0, "metric_batch_scores", None)
+        t1 = time.time()
+        for tr, va in _folds:
             jtr, jva = jnp.asarray(tr), jnp.asarray(va)
             Xtr, ytr, wtr = Xt[jtr], yt[jtr], wt[jtr]
             Xva, yva = Xt[jva], yt[jva]
             for ci, (est, grid) in enumerate(self.models_and_grids):
                 models = est.grid_fit_arrays(Xtr, ytr, wtr, grid)
+                scores = (est.grid_predict_scores(models, Xva)
+                          if batch_metrics is not None else None)
+                if scores is not None:
+                    # fast path: one device program scores + one computes the
+                    # metric for the whole grid; a single host sync per
+                    # (fold, family)
+                    vals = batch_metrics(yva, scores, self.validation_metric)
+                    for gj in range(len(models)):
+                        per_candidate_scores.setdefault((ci, gj), []).append(
+                            float(vals[gj]))
+                    continue
                 for gj, model in enumerate(models):
                     pred = model.predict_arrays(Xva)
                     metrics = ev0.evaluate_arrays(yva, pred)
@@ -229,13 +248,17 @@ class ModelSelector(Estimator):
                 params={**est.params, **grid[gj]},
                 metric_values={self.validation_metric: mean}))
 
+        _plog("selector: CV sweep", t1)
         best_mean, best_ci, best_gj = (max if bigger else min)(
             mean_metrics, key=lambda t: t[0])
         best_est, best_grid = self.models_and_grids[best_ci]
 
         # -- refit winner on the full prepared training data -----------------
+        t1 = time.time()
         best_params = {**best_est.params, **best_grid[best_gj]}
         best_model = best_est.fit_arrays(Xt, yt, wt, best_params)
+        _plog("selector: refit", t1)
+        t1 = time.time()
 
         # -- train/holdout evaluation with every evaluator -------------------
         train_eval: dict = {}
@@ -252,6 +275,7 @@ class ModelSelector(Estimator):
                 holdout_eval[ev.name] = EvaluatorBase.to_json(
                     ev.evaluate_arrays(yh, pred_h))
 
+        _plog("selector: train/holdout evaluation", t1)
         summary = ModelSelectorSummary(
             validation_type=self.validator.name,
             validation_metric=self.validation_metric,

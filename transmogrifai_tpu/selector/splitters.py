@@ -33,6 +33,9 @@ class SplitterSummary:
 class DataSplitter:
     """Random train/holdout reservation."""
 
+    #: does prepare_indices need the label values on host?
+    requires_label = False
+
     def __init__(self, reserve_test_fraction: float = 0.1, seed: int = 42,
                  max_training_sample: Optional[int] = None):
         self.reserve_test_fraction = reserve_test_fraction
@@ -62,6 +65,8 @@ class DataSplitter:
 
 class DataBalancer(DataSplitter):
     """Binary down-sampler toward a target positive fraction."""
+
+    requires_label = True
 
     def __init__(self, sample_fraction: float = 0.1,
                  max_training_sample: Optional[int] = 1_000_000,
@@ -98,6 +103,8 @@ class DataBalancer(DataSplitter):
 
 class DataCutter(DataSplitter):
     """Multiclass label trimming: keep the most frequent labels."""
+
+    requires_label = True
 
     def __init__(self, max_label_categories: int = 100,
                  min_label_fraction: float = 0.0,

@@ -47,15 +47,31 @@ class OpCrossValidation(_ValidatorBase):
         self.name = "Cross Validation"
 
     def splits(self, n, y=None):
+        """Equal-shape folds: every fold has exactly n//k validation rows and
+        n - n//k training rows (leftover rows train in every fold), so the
+        per-fold training/eval programs compile once and replay k times —
+        fold-shape stability is the TPU analog of Spark reusing one physical
+        plan across folds."""
         rng = np.random.default_rng(self.seed)
+        k = self.n_folds
+        n_val = n // k
+        if n_val == 0:
+            raise ValueError(f"not enough rows ({n}) for {k} folds")
         if self.stratify and y is not None:
-            fold_of = self._stratified_folds(np.asarray(y), self.n_folds, rng)
+            fold_of = self._stratified_folds(np.asarray(y), k, rng)
+            perm = np.argsort(fold_of, kind="stable")  # grouped by fold
+            vals = [np.flatnonzero(fold_of == f) for f in range(k)]
+            vals = [rng.permutation(v)[:n_val] for v in vals]
         else:
-            fold_of = rng.permutation(n) % self.n_folds
+            perm = rng.permutation(n)
+            vals = [perm[f * n_val:(f + 1) * n_val] for f in range(k)]
         out = []
-        for f in range(self.n_folds):
-            val = np.flatnonzero(fold_of == f)
-            train = np.flatnonzero(fold_of != f)
+        all_rows = np.arange(n)
+        for f in range(k):
+            val = np.sort(vals[f])
+            train = np.setdiff1d(all_rows, val, assume_unique=False)
+            if train.size != n - n_val:  # stratified trim for equal shapes
+                train = train[:n - n_val]
             out.append((train, val))
         return out
 
