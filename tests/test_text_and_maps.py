@@ -1,0 +1,194 @@
+"""Text NLP chain, SmartText, map vectorizers, parsers (parity: reference
+TextTokenizerTest/SmartTextVectorizerTest/OPMapVectorizerTest expectations)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import DagExecutor, compute_dag
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.ops.parsers import (
+    EmailToPickList, MimeTypeDetector, PhoneNumberParser, UrlToPickList,
+    is_valid_email, parse_phone,
+)
+from transmogrifai_tpu.ops.smart_text import SmartTextVectorizer, TextStats
+from transmogrifai_tpu.ops.text import (
+    LangDetector, NGramSimilarity, OpNGram, OpStopWordsRemover,
+    TextTokenizer, detect_language,
+)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.ops.vectorizers.datelist import DateListVectorizer
+from transmogrifai_tpu.ops.vectorizers.maps import (
+    RealMapVectorizer, SmartTextMapVectorizer, TextMapPivotVectorizer,
+)
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import NULL_INDICATOR, OTHER
+
+
+def _run(host, out_feature):
+    data = PipelineData.from_host(host)
+    ex = DagExecutor()
+    out, fitted = ex.fit_transform(data, compute_dag([out_feature]))
+    return out, fitted
+
+
+def test_tokenizer_and_stopwords():
+    tok = TextTokenizer(filter_stopwords=True)
+    assert tok.transform_row("The quick brown fox!") == ["quick", "brown", "fox"]
+    assert tok.transform_row(None) == []
+    rem = OpStopWordsRemover(extra_stop_words=("fox",))
+    assert rem.transform_row(["the", "fox", "ran"]) == ["ran"]
+
+
+def test_language_detection():
+    assert detect_language("the cat and the dog are in the house") == "en"
+    assert detect_language("le chat et le chien sont dans la maison") == "fr"
+    assert detect_language("der hund und die katze sind nicht hier") == "de"
+    ld = LangDetector()
+    scores = ld.transform_row("the cat and the dog")
+    assert max(scores, key=scores.get) == "en"
+
+
+def test_ngram_and_similarity():
+    ng = OpNGram(n=2)
+    assert ng.transform_row(["a", "b", "c"]) == ["a b", "b c"]
+    sim = NGramSimilarity(n=3)
+    assert sim.transform_row("hello", "hello") == 1.0
+    assert sim.transform_row("hello", "help!") < 1.0
+    assert sim.transform_row(None, "x") == 0.0
+
+
+def test_smart_text_vectorizer_pivot_vs_hash():
+    n = 60
+    low_card = ["red", "green", "blue"] * (n // 3)
+    high_card = [f"unique text value number {i}" for i in range(n)]
+    host = fr.HostFrame.from_dict({
+        "color": (ft.Text, low_card),
+        "desc": (ft.Text, high_card),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    stage = SmartTextVectorizer(max_cardinality=10, min_support=1,
+                                num_hash_features=16)
+    out = feats["color"].transform_with(stage, feats["desc"])
+    data, fitted = _run(host, out)
+    model = fitted[0][0]
+    kinds = [t["kind"] for t in model.treatments]
+    assert kinds == ["pivot", "hash"]
+    col = data.host_col(out.name)
+    meta = col.meta
+    assert col.values.shape[1] == meta.size
+    # pivot block has the three colors
+    pivots = {c.indicator_value for c in meta.columns
+              if c.parent_feature == ("color",)}
+    assert {"red", "green", "blue", OTHER, NULL_INDICATOR} <= pivots
+
+
+def test_smart_text_name_detection():
+    names = ["john smith", "mary jones", "robert brown", "linda white"] * 10
+    host = fr.HostFrame.from_dict({"who": (ft.Text, names)})
+    feats = FeatureBuilder.from_frame(host)
+    stage = SmartTextVectorizer(detect_names=True, min_support=1)
+    out = feats["who"].transform_with(stage)
+    data, fitted = _run(host, out)
+    model = fitted[0][0]
+    assert model.treatments[0]["kind"] == "sensitive"
+    assert model.sensitive_features() == ["who"]
+    assert data.host_col(out.name).values.shape[1] == 0
+
+
+def test_real_map_vectorizer():
+    host = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, [{"a": 1.0, "b": 10.0}, {"a": 3.0}, {"b": 20.0}]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["m"].transform_with(RealMapVectorizer())
+    data, fitted = _run(host, out)
+    col = data.host_col(out.name)
+    # keys sorted [a, b]; layout per key [value_or_mean, null]
+    np.testing.assert_allclose(
+        col.values,
+        [[1.0, 0.0, 10.0, 0.0],
+         [3.0, 0.0, 15.0, 1.0],   # b missing -> mean 15
+         [2.0, 1.0, 20.0, 0.0]],  # a missing -> mean 2
+        rtol=1e-6)
+    assert [c.grouping for c in col.meta.columns] == ["a", "a", "b", "b"]
+    # row path parity
+    row = fitted[0][0].transform_row({"a": 3.0})
+    np.testing.assert_allclose(row, col.values[1], rtol=1e-6)
+
+
+def test_text_map_pivot_vectorizer():
+    host = fr.HostFrame.from_dict({
+        "m": (ft.PickListMap, [{"k": "x"}, {"k": "y"}, {"k": "x"}, {}]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["m"].transform_with(TextMapPivotVectorizer(min_support=1))
+    data, _ = _run(host, out)
+    col = data.host_col(out.name)
+    # key k: [x, y, OTHER, NULL]
+    np.testing.assert_allclose(
+        col.values, [[1, 0, 0, 0], [0, 1, 0, 0], [1, 0, 0, 0], [0, 0, 0, 1]])
+
+
+def test_smart_text_map_vectorizer():
+    rows = [{"color": "red", "note": f"long unique note {i}"} for i in range(30)]
+    host = fr.HostFrame.from_dict({"m": (ft.TextMap, rows)})
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["m"].transform_with(SmartTextMapVectorizer(
+        max_cardinality=5, min_support=1, num_hash_features=8))
+    data, fitted = _run(host, out)
+    tr = fitted[0][0].treatments[0]
+    assert tr["color"]["kind"] == "pivot"
+    assert tr["note"]["kind"] == "hash"
+
+
+def test_date_list_vectorizer():
+    day = 86_400_000
+    ref = 1_514_764_800_000
+    host = fr.HostFrame.from_dict({
+        "d": (ft.DateList, [[ref - 3 * day, ref - day], []]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["d"].transform_with(DateListVectorizer(pivot="SinceLast"))
+    data, _ = _run(host, out)
+    col = data.host_col(out.name)
+    np.testing.assert_allclose(col.values, [[1.0, 0.0], [0.0, 1.0]])
+
+
+def test_parsers():
+    assert is_valid_email("a.b@x.co")
+    assert not is_valid_email("junk@@x")
+    assert EmailToPickList().transform_row("A@Corp.COM") == "corp.com"
+    assert UrlToPickList().transform_row("https://sub.example.com/p?q=1") == \
+        "sub.example.com"
+    assert parse_phone("+1 (650) 555-1234") == "+16505551234"
+    assert parse_phone("650-555-1234") == "+16505551234"
+    assert parse_phone("123") is None
+    assert PhoneNumberParser().transform_row("6505551234") is True
+    import base64
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n....").decode()
+    assert MimeTypeDetector().transform_row(png) == "image/png"
+
+
+def test_transmogrify_with_maps_and_text():
+    n = 40
+    host = fr.HostFrame.from_dict({
+        "age": (ft.Real, [float(i % 50) for i in range(n)]),
+        "bio": (ft.Text, [f"text {i % 3}" for i in range(n)]),
+        "email": (ft.Email, [f"user{i}@dom{i % 2}.com" for i in range(n)]),
+        "scores": (ft.RealMap, [{"q1": float(i), "q2": 1.0} for i in range(n)]),
+        "tags": (ft.MultiPickListMap, [{"t": {"a", "b"}} for _ in range(n)]),
+        "stamps": (ft.DateMap, [{"s": 3_600_000 * i} for i in range(n)]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    combined = transmogrify(list(feats.values()), min_support=1,
+                            num_hash_features=8)
+    data, fitted = _run(host, combined)
+    vec = data.device_col(combined.name)
+    meta = vec.metadata
+    assert vec.values.shape == (n, meta.size)
+    parents = {p for c in meta.columns for p in c.parent_feature}
+    assert {"age", "bio", "email", "scores", "tags", "stamps"} <= parents
+    groupings = {c.grouping for c in meta.columns}
+    assert {"q1", "q2", "t", "s"} <= groupings  # map keys in provenance

@@ -1,0 +1,187 @@
+"""Type-specific parsers: email, URL, phone, base64 MIME detection.
+
+Parity: reference ``core/.../stages/impl/feature/{ValidEmailTransformer,
+EmailToPickListMapTransformer, UrlMapToPickListMapTransformer,
+PhoneNumberParser, MimeTypeDetector}.scala``. The reference leans on Google
+libphonenumber and Apache Tika; here validity is rule-based (E.164 length +
+region prefix table; magic-byte MIME table) — same stage surface, no JVM
+deps.
+"""
+
+from __future__ import annotations
+
+import base64 as _b64
+import re
+from typing import Optional
+
+from transmogrifai_tpu.stages.base import HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = [
+    "ValidEmailTransformer", "EmailToPickList", "UrlToPickList",
+    "ValidUrlTransformer", "PhoneNumberParser", "MimeTypeDetector",
+]
+
+_EMAIL_RE = re.compile(
+    r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@[A-Za-z0-9-]+(\.[A-Za-z0-9-]+)+$")
+_URL_RE = re.compile(
+    r"^(https?|ftp)://[^\s/$.?#].[^\s]*$", re.IGNORECASE)
+
+#: country calling code -> national number length range (subset)
+_PHONE_REGIONS = {
+    "1": (10, 10),    # US/CA
+    "44": (9, 10),    # UK
+    "49": (7, 11),    # DE
+    "33": (9, 9),     # FR
+    "81": (9, 10),    # JP
+    "86": (11, 11),   # CN
+    "91": (10, 10),   # IN
+    "61": (9, 9),     # AU
+    "55": (10, 11),   # BR
+}
+
+_MIME_MAGIC = [
+    (b"\x89PNG\r\n\x1a\n", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF87a", "image/gif"),
+    (b"GIF89a", "image/gif"),
+    (b"%PDF-", "application/pdf"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"BM", "image/bmp"),
+    (b"<?xml", "application/xml"),
+    (b"{", "application/json"),
+    (b"RIFF", "audio/wav"),
+    (b"OggS", "audio/ogg"),
+    (b"\x7fELF", "application/x-executable"),
+]
+
+
+def is_valid_email(s: str) -> bool:
+    return bool(_EMAIL_RE.match(s)) and len(s) <= 254
+
+
+def is_valid_url(s: str) -> bool:
+    return bool(_URL_RE.match(s))
+
+
+def parse_phone(s: str, default_region_code: str = "1"
+                ) -> Optional[str]:
+    """Normalize to E.164-ish digits; None when invalid."""
+    s = s.strip()
+    plus = s.startswith("+")
+    digits = re.sub(r"[^\d]", "", s)
+    if not digits:
+        return None
+    if plus:
+        for code, (lo, hi) in _PHONE_REGIONS.items():
+            if digits.startswith(code):
+                national = digits[len(code):]
+                if lo <= len(national) <= hi:
+                    return "+" + digits
+        return None
+    lo, hi = _PHONE_REGIONS.get(default_region_code, (7, 15))
+    if lo <= len(digits) <= hi:
+        return f"+{default_region_code}{digits}"
+    return None
+
+
+def detect_mime(data: bytes) -> Optional[str]:
+    for magic, mime in _MIME_MAGIC:
+        if data.startswith(magic):
+            return mime
+    try:
+        data.decode("utf-8")
+        return "text/plain"
+    except UnicodeDecodeError:
+        return "application/octet-stream"
+
+
+class ValidEmailTransformer(HostTransformer):
+    in_types = (ft.Email,)
+    out_type = ft.Binary
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        return None if value is None else is_valid_email(value)
+
+
+class EmailToPickList(HostTransformer):
+    """Email -> domain PickList (invalid -> None)."""
+
+    in_types = (ft.Email,)
+    out_type = ft.PickList
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None or not is_valid_email(value):
+            return None
+        return value.rsplit("@", 1)[1].lower()
+
+
+class ValidUrlTransformer(HostTransformer):
+    in_types = (ft.URL,)
+    out_type = ft.Binary
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        return None if value is None else is_valid_url(value)
+
+
+class UrlToPickList(HostTransformer):
+    """URL -> hostname PickList (invalid -> None)."""
+
+    in_types = (ft.URL,)
+    out_type = ft.PickList
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None or not is_valid_url(value):
+            return None
+        host = re.sub(r"^[a-z+]+://", "", value.lower()).split("/")[0]
+        return host.split(":")[0] or None
+
+
+class PhoneNumberParser(HostTransformer):
+    """Phone -> Binary validity (reference PhoneNumberParser.isValid path)."""
+
+    in_types = (ft.Phone,)
+    out_type = ft.Binary
+
+    def __init__(self, default_region_code: str = "1", uid=None):
+        self.default_region_code = default_region_code
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None:
+            return None
+        return parse_phone(value, self.default_region_code) is not None
+
+
+class MimeTypeDetector(HostTransformer):
+    """Base64 -> PickList MIME type via magic bytes."""
+
+    in_types = (ft.Base64,)
+    out_type = ft.PickList
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None:
+            return None
+        try:
+            data = _b64.b64decode(value, validate=False)
+        except Exception:
+            return None
+        if not data:
+            return None
+        return detect_mime(data)

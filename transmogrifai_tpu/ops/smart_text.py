@@ -1,0 +1,260 @@
+"""SmartTextVectorizer: cardinality-adaptive text vectorization.
+
+Parity: reference ``core/.../stages/impl/feature/SmartTextVectorizer.scala:
+62-200`` — per-column ``TextStats`` (a value-count monoid capped at
+``max_cardinality``) decides the treatment:
+
+- all empty            -> null-indicator only ("ignore")
+- low cardinality      -> categorical pivot (topK + OTHER + null)
+- high cardinality     -> hashing trick (+ length feature + null indicator)
+
+Optional name/sensitive-data detection (reference NameDetectFun /
+HumanNameDetector): columns whose values look like human names beyond a
+threshold are dropped and reported, when enabled (off by default, as in the
+reference's SensitiveFeatureMode.Off).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.ops.vectorizers.hashing import hash_token, tokenize
+from transmogrifai_tpu.ops.vectorizers.onehot import _top_k
+from transmogrifai_tpu.stages.base import Estimator, HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    NULL_INDICATOR, OTHER, VectorColumnMetadata, VectorMetadata, parent_of,
+)
+
+__all__ = ["TextStats", "SmartTextVectorizer", "SmartTextModel",
+           "COMMON_FIRST_NAMES", "looks_like_name"]
+
+
+@dataclass
+class TextStats:
+    """Value-count monoid with cardinality cap (reference TextStats)."""
+
+    counts: dict = field(default_factory=dict)
+    n: int = 0
+    nulls: int = 0
+    overflowed: bool = False
+    max_cardinality: int = 100
+
+    def add(self, value: Optional[str]) -> None:
+        self.n += 1
+        if value is None:
+            self.nulls += 1
+            return
+        if self.overflowed:
+            return
+        self.counts[value] = self.counts.get(value, 0) + 1
+        if len(self.counts) > self.max_cardinality:
+            self.overflowed = True
+            self.counts.clear()
+
+    @property
+    def cardinality(self) -> int:
+        return (self.max_cardinality + 1 if self.overflowed
+                else len(self.counts))
+
+
+COMMON_FIRST_NAMES = frozenset(
+    "james john robert michael william david richard joseph thomas charles "
+    "christopher daniel matthew anthony mark donald steven paul andrew "
+    "joshua kenneth kevin brian george timothy ronald edward jason jeffrey "
+    "ryan jacob gary nicholas eric jonathan stephen larry justin scott "
+    "brandon benjamin samuel gregory frank alexander raymond patrick jack "
+    "mary patricia jennifer linda elizabeth barbara susan jessica sarah "
+    "karen lisa nancy betty margaret sandra ashley kimberly emily donna "
+    "michelle carol amanda dorothy melissa deborah stephanie rebecca sharon "
+    "laura cynthia kathleen amy angela shirley anna brenda pamela emma "
+    "nicole helen samantha katherine christine debra rachel carolyn janet "
+    "catherine maria heather diane ruth julie olivia joyce virginia".split())
+
+
+def looks_like_name(value: str) -> bool:
+    toks = tokenize(value)
+    return bool(toks) and any(t in COMMON_FIRST_NAMES for t in toks)
+
+
+class SmartTextVectorizer(Estimator):
+    """Variadic estimator over Text inputs with per-column treatment."""
+
+    variadic = True
+    in_types = (ft.Text,)
+    out_type = ft.OPVector
+
+    def __init__(self, max_cardinality: int = 100, top_k: int = 20,
+                 min_support: int = 10, num_hash_features: int = 512,
+                 track_nulls: bool = True, track_text_len: bool = True,
+                 detect_names: bool = False, name_threshold: float = 0.5,
+                 uid: Optional[str] = None):
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_hash_features = num_hash_features
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+        self.detect_names = detect_names
+        self.name_threshold = name_threshold
+        super().__init__(uid=uid)
+
+    def fit_model(self, data) -> "SmartTextModel":
+        treatments: list[dict] = []
+        for name in self.input_names:
+            col = data.host_col(name)
+            stats = TextStats(max_cardinality=self.max_cardinality)
+            name_hits = 0
+            non_null = 0
+            for v in col.values:
+                stats.add(v)
+                if v is not None:
+                    non_null += 1
+                    if self.detect_names and looks_like_name(v):
+                        name_hits += 1
+            if self.detect_names and non_null > 0 \
+                    and name_hits / non_null >= self.name_threshold:
+                treatments.append({"kind": "sensitive"})
+            elif non_null == 0:
+                treatments.append({"kind": "ignore"})
+            elif not stats.overflowed:
+                cats = _top_k(list(stats.counts), list(stats.counts.values()),
+                              self.top_k, self.min_support)
+                treatments.append({"kind": "pivot", "categories": cats})
+            else:
+                treatments.append({"kind": "hash"})
+        return SmartTextModel(
+            treatments=treatments, num_hash_features=self.num_hash_features,
+            track_nulls=self.track_nulls, track_text_len=self.track_text_len)
+
+
+class SmartTextModel(HostTransformer):
+    variadic = True
+    in_types = (ft.Text,)
+    out_type = ft.OPVector
+
+    def __init__(self, treatments: Sequence[dict] = (),
+                 num_hash_features: int = 512, track_nulls: bool = True,
+                 track_text_len: bool = True, uid: Optional[str] = None):
+        self.treatments = [dict(t) for t in treatments]
+        self.num_hash_features = num_hash_features
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+        super().__init__(uid=uid)
+
+    # -- layout --------------------------------------------------------------
+    def _width(self, t: dict) -> int:
+        kind = t["kind"]
+        if kind in ("sensitive",):
+            return 0
+        if kind == "ignore":
+            return 1 if self.track_nulls else 0
+        if kind == "pivot":
+            return len(t["categories"]) + 1 + (1 if self.track_nulls else 0)
+        w = self.num_hash_features
+        if self.track_text_len:
+            w += 1
+        if self.track_nulls:
+            w += 1
+        return w
+
+    def _fill_row(self, out: np.ndarray, offset: int, t: dict,
+                  v: Optional[str]) -> None:
+        kind = t["kind"]
+        if kind == "sensitive":
+            return
+        if kind == "ignore":
+            if self.track_nulls:
+                out[offset] = 1.0 if v is None else 0.0
+            return
+        if kind == "pivot":
+            cats = t["categories"]
+            k = len(cats)
+            if v is None:
+                if self.track_nulls:
+                    out[offset + k + 1] = 1.0
+            elif v in cats:
+                out[offset + cats.index(v)] = 1.0
+            else:
+                out[offset + k] = 1.0
+            return
+        # hash
+        base = offset
+        if v is not None:
+            for tok in tokenize(v):
+                out[base + hash_token(tok, self.num_hash_features)] += 1.0
+        pos = base + self.num_hash_features
+        if self.track_text_len:
+            out[pos] = 0.0 if v is None else float(len(v))
+            pos += 1
+        if self.track_nulls:
+            out[pos] = 1.0 if v is None else 0.0
+
+    def transform_row(self, *values):
+        total = sum(self._width(t) for t in self.treatments)
+        out = np.zeros(total, dtype=np.float32)
+        offset = 0
+        for t, v in zip(self.treatments, values):
+            self._fill_row(out, offset, t, v)
+            offset += self._width(t)
+        return out
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        n = len(cols[0])
+        total = sum(self._width(t) for t in self.treatments)
+        out = np.zeros((n, total), dtype=np.float32)
+        offset = 0
+        for t, col in zip(self.treatments, cols):
+            for r in range(n):
+                self._fill_row(out[r], offset, t, col.values[r])
+            offset += self._width(t)
+        return fr.HostColumn(ft.OPVector, out, meta=self._meta())
+
+    def _meta(self) -> VectorMetadata:
+        cols: list[VectorColumnMetadata] = []
+        for t, f in zip(self.treatments, self.input_features):
+            parent = parent_of(f)
+            kind = t["kind"]
+            if kind == "sensitive":
+                continue
+            if kind == "ignore":
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        *parent, grouping=f.name,
+                        indicator_value=NULL_INDICATOR))
+                continue
+            if kind == "pivot":
+                for c in t["categories"]:
+                    cols.append(VectorColumnMetadata(
+                        *parent, grouping=f.name, indicator_value=c))
+                cols.append(VectorColumnMetadata(
+                    *parent, grouping=f.name, indicator_value=OTHER))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        *parent, grouping=f.name,
+                        indicator_value=NULL_INDICATOR))
+                continue
+            for j in range(self.num_hash_features):
+                cols.append(VectorColumnMetadata(
+                    *parent, grouping=f.name, descriptor_value=f"hash_{j}"))
+            if self.track_text_len:
+                cols.append(VectorColumnMetadata(
+                    *parent, grouping=f.name, descriptor_value="textLen"))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    *parent, grouping=f.name, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)
+
+    def sensitive_features(self) -> list[str]:
+        return [f.name for t, f in zip(self.treatments, self.input_features)
+                if t["kind"] == "sensitive"]
+
+    def fitted_state(self):
+        return {"treatments": self.treatments}
+
+    def set_fitted_state(self, state):
+        self.treatments = [dict(t) for t in state["treatments"]]

@@ -66,17 +66,53 @@ def _route(f: FeatureLike) -> str:
         return "real"
     if issubclass(t, ft.MultiPickList):
         return "multipicklist"
-    if issubclass(t, ft.Geolocation):
+    if issubclass(t, ft.Geolocation) and not issubclass(t, ft.OPMap):
         return "geolocation"
     if issubclass(t, ft.OPVector):
         return "vector"
+    # maps (before Text/lists — map types are not Text subclasses)
+    if issubclass(t, (ft.DateMap,)):
+        return "date_map"
+    if issubclass(t, ft.IntegralMap):
+        return "integral_map"
+    if issubclass(t, ft.BinaryMap):
+        return "binary_map"
+    if issubclass(t, (ft.Prediction,)):
+        raise TypeError("Prediction features are model outputs; "
+                        "they cannot be transmogrified")
+    if issubclass(t, ft.RealMap):
+        return "real_map"
+    if issubclass(t, ft.MultiPickListMap):
+        return "multipicklist_map"
+    if issubclass(t, ft.GeolocationMap):
+        return "geolocation_map"
+    if issubclass(t, (ft.TextMap,)):
+        if t in (ft.TextMap, ft.TextAreaMap):
+            return "smart_text_map"
+        return "pivot_map"  # PickListMap, CountryMap, IDMap, ...
+    if issubclass(t, ft.TextList):
+        return "textlist"
+    if issubclass(t, ft.DateList):
+        return "datelist"
+    if issubclass(t, ft.Email):
+        return "email"
+    if issubclass(t, ft.URL):
+        return "url"
+    if issubclass(t, ft.Phone):
+        return "phone"
+    if issubclass(t, ft.Base64):
+        return "base64"
     if issubclass(t, _PIVOT_TYPES):
         return "pivot"
     if issubclass(t, ft.Text):
-        return "hash"
+        return "smart_text"
     raise TypeError(
         f"Transmogrifier has no default vectorizer for {t.__name__} "
         f"(feature {f.name!r}); vectorize it explicitly")
+
+
+def _join_tokens(tokens):
+    return " ".join(tokens) if tokens else None
 
 
 def transmogrify(features: Sequence[FeatureLike],
@@ -93,9 +129,52 @@ def transmogrify(features: Sequence[FeatureLike],
     for f in features:
         groups.setdefault(_route(f), []).append(f)
 
+    from transmogrifai_tpu.ops.parsers import (
+        EmailToPickList, MimeTypeDetector, PhoneNumberParser, UrlToPickList,
+    )
+    from transmogrifai_tpu.ops.smart_text import SmartTextVectorizer
+    from transmogrifai_tpu.ops.vectorizers.datelist import DateListVectorizer
+    from transmogrifai_tpu.ops.vectorizers.maps import (
+        BinaryMapVectorizer, DateMapToUnitCircleVectorizer,
+        GeolocationMapVectorizer, IntegralMapVectorizer,
+        MultiPickListMapVectorizer, RealMapVectorizer, SmartTextMapVectorizer,
+        TextMapPivotVectorizer,
+    )
+    from transmogrifai_tpu.stages.base import LambdaTransformer
+
+    # derived routings: email/url -> domain picklist, phone -> validity
+    # binary, base64 -> mime picklist (reference Transmogrifier case
+    # analysis for these types)
+    pivot_extra: list[FeatureLike] = []
+    binary_extra: list[FeatureLike] = []
+    for f in groups.pop("email", []):
+        pivot_extra.append(f.transform_with(EmailToPickList()))
+    for f in groups.pop("url", []):
+        pivot_extra.append(f.transform_with(UrlToPickList()))
+    for f in groups.pop("base64", []):
+        pivot_extra.append(f.transform_with(MimeTypeDetector()))
+    for f in groups.pop("phone", []):
+        binary_extra.append(f.transform_with(PhoneNumberParser()))
+    # textlists hash via joined tokens
+    smart_extra: list[FeatureLike] = []
+    for f in groups.pop("textlist", []):
+        joined = f.transform_with(LambdaTransformer(
+            _join_tokens, in_types=(ft.TextList,), out_type=ft.Text,
+            operation_name="joinTokens"))
+        smart_extra.append(joined)
+    if pivot_extra:
+        groups.setdefault("pivot", []).extend(pivot_extra)
+    if binary_extra:
+        groups.setdefault("binary", []).extend(binary_extra)
+    if smart_extra:
+        groups.setdefault("smart_text", []).extend(smart_extra)
+
     blocks: list[FeatureLike] = []
-    order = ["real", "integral", "binary", "date", "pivot", "hash",
-             "multipicklist", "geolocation", "vector"]
+    order = ["real", "integral", "binary", "date", "pivot", "smart_text",
+             "multipicklist", "geolocation", "datelist",
+             "real_map", "integral_map", "binary_map", "date_map",
+             "pivot_map", "smart_text_map", "multipicklist_map",
+             "geolocation_map", "vector"]
     for kind in order:
         fs = groups.get(kind)
         if not fs:
@@ -112,14 +191,38 @@ def transmogrify(features: Sequence[FeatureLike],
         elif kind == "pivot":
             stage = OneHotVectorizer(top_k=top_k, min_support=min_support,
                                      track_nulls=track_nulls)
-        elif kind == "hash":
-            stage = TextHashingVectorizer(num_features=num_hash_features,
-                                          track_nulls=track_nulls)
+        elif kind == "smart_text":
+            stage = SmartTextVectorizer(
+                top_k=top_k, min_support=min_support,
+                num_hash_features=num_hash_features, track_nulls=track_nulls)
         elif kind == "multipicklist":
             stage = SetVectorizer(top_k=top_k, min_support=min_support,
                                   track_nulls=track_nulls)
         elif kind == "geolocation":
             stage = GeolocationVectorizer(track_nulls=track_nulls)
+        elif kind == "datelist":
+            stage = DateListVectorizer(track_nulls=track_nulls)
+        elif kind == "real_map":
+            stage = RealMapVectorizer(track_nulls=track_nulls)
+        elif kind == "integral_map":
+            stage = IntegralMapVectorizer(track_nulls=track_nulls)
+        elif kind == "binary_map":
+            stage = BinaryMapVectorizer(track_nulls=track_nulls)
+        elif kind == "date_map":
+            stage = DateMapToUnitCircleVectorizer(
+                time_period=date_time_period, track_nulls=track_nulls)
+        elif kind == "pivot_map":
+            stage = TextMapPivotVectorizer(
+                top_k=top_k, min_support=min_support, track_nulls=track_nulls)
+        elif kind == "smart_text_map":
+            stage = SmartTextMapVectorizer(
+                top_k=top_k, min_support=min_support,
+                track_nulls=track_nulls)
+        elif kind == "multipicklist_map":
+            stage = MultiPickListMapVectorizer(
+                top_k=top_k, min_support=min_support, track_nulls=track_nulls)
+        elif kind == "geolocation_map":
+            stage = GeolocationMapVectorizer(track_nulls=track_nulls)
         else:  # passthrough vectors
             blocks.extend(fs)
             continue

@@ -24,6 +24,7 @@ from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.stages.base import DeviceTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
+    parent_of,
     NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
 )
 
@@ -97,10 +98,10 @@ class DateToUnitCircleVectorizer(DeviceTransformer):
         for f in self.input_features:
             for part in ("sin", "cos"):
                 cols.append(VectorColumnMetadata(
-                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    *parent_of(f), grouping=f.name,
                     descriptor_value=f"{part}_{self.time_period}"))
             if self.track_nulls:
                 cols.append(VectorColumnMetadata(
-                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    *parent_of(f), grouping=f.name,
                     indicator_value=NULL_INDICATOR))
         return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)

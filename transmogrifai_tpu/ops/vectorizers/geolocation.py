@@ -15,6 +15,7 @@ from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.stages.base import Estimator, HostTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
+    parent_of,
     NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
 )
 
@@ -82,11 +83,11 @@ class GeolocationModel(HostTransformer):
         for f in self.input_features:
             for part in ("lat", "lon", "accuracy"):
                 cols.append(VectorColumnMetadata(
-                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    *parent_of(f), grouping=f.name,
                     descriptor_value=part))
             if self.track_nulls:
                 cols.append(VectorColumnMetadata(
-                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    *parent_of(f), grouping=f.name,
                     indicator_value=NULL_INDICATOR))
         return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)
 

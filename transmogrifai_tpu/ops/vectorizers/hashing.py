@@ -25,6 +25,7 @@ from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.stages.base import HostTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
+    parent_of,
     NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
 )
 
@@ -119,11 +120,11 @@ class TextHashingVectorizer(HostTransformer):
             for f in feats:
                 for j in range(self.num_features):
                     cols.append(VectorColumnMetadata(
-                        (f.name,), (f.ftype.__name__,), grouping=f.name,
+                        *parent_of(f), grouping=f.name,
                         descriptor_value=f"hash_{j}"))
         if self.track_nulls:
             for f in feats:
                 cols.append(VectorColumnMetadata(
-                    (f.name,), (f.ftype.__name__,), grouping=f.name,
+                    *parent_of(f), grouping=f.name,
                     indicator_value=NULL_INDICATOR))
         return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)

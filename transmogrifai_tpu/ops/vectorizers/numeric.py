@@ -24,7 +24,7 @@ from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.stages.base import DeviceTransformer, Estimator
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
-    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata, parent_of,
 )
 
 __all__ = ["RealVectorizer", "IntegralVectorizer", "BinaryVectorizer"]
@@ -43,13 +43,11 @@ def _numeric_vector_meta(out_name: str, input_feats, track_nulls: bool
                          ) -> VectorMetadata:
     cols = []
     for f in input_feats:
-        cols.append(VectorColumnMetadata(
-            parent_feature=(f.name,), parent_feature_type=(f.ftype.__name__,),
-            descriptor_value=None))
+        cols.append(VectorColumnMetadata(*parent_of(f),
+                                         descriptor_value=None))
         if track_nulls:
             cols.append(VectorColumnMetadata(
-                parent_feature=(f.name,), parent_feature_type=(f.ftype.__name__,),
-                indicator_value=NULL_INDICATOR))
+                *parent_of(f), indicator_value=NULL_INDICATOR))
     return VectorMetadata(out_name, tuple(cols)).reindexed(0)
 
 

@@ -24,6 +24,7 @@ from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.stages.base import DeviceTransformer, Estimator, HostTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
+    parent_of,
     NULL_INDICATOR, OTHER, VectorColumnMetadata, VectorMetadata,
 )
 
@@ -36,14 +37,14 @@ def _pivot_meta(out_name: str, input_feats, categories: Sequence[Sequence[str]],
     for f, cats in zip(input_feats, categories):
         for c in cats:
             cols.append(VectorColumnMetadata(
-                (f.name,), (f.ftype.__name__,), grouping=f.name,
+                *parent_of(f), grouping=f.name,
                 indicator_value=c))
         cols.append(VectorColumnMetadata(
-            (f.name,), (f.ftype.__name__,), grouping=f.name,
+            *parent_of(f), grouping=f.name,
             indicator_value=OTHER))
         if track_nulls:
             cols.append(VectorColumnMetadata(
-                (f.name,), (f.ftype.__name__,), grouping=f.name,
+                *parent_of(f), grouping=f.name,
                 indicator_value=NULL_INDICATOR))
     return VectorMetadata(out_name, tuple(cols)).reindexed(0)
 

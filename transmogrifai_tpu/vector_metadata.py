@@ -16,7 +16,19 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Optional, Sequence
 
-__all__ = ["VectorColumnMetadata", "VectorMetadata", "NULL_INDICATOR", "OTHER"]
+__all__ = ["VectorColumnMetadata", "VectorMetadata", "NULL_INDICATOR",
+           "OTHER", "parent_of"]
+
+
+def parent_of(feature) -> tuple[tuple[str, ...], tuple[str, ...]]:
+    """(parent names, parent type names) for metadata: the raw ancestors of
+    a derived feature (so provenance reaches the original columns), falling
+    back to the feature itself when it is raw."""
+    raws = feature.raw_features()
+    if raws:
+        return (tuple(r.name for r in raws),
+                tuple(r.ftype.__name__ for r in raws))
+    return (feature.name,), (feature.ftype.__name__,)
 
 #: indicator value used for null-tracking columns (reference NullString)
 NULL_INDICATOR = "NullIndicatorValue"
