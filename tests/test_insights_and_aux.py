@@ -1,0 +1,197 @@
+"""ModelInsights, LOCO, DSL, math transformers, testkit, params, runner,
+profiling tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu import dsl  # installs the DSL methods
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, DataSplitter,
+)
+from transmogrifai_tpu.testkit import (
+    RandomBinary, RandomMap, RandomReal, RandomText, TestFeatureBuilder,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    n = 300
+    x1 = rng.normal(size=n)
+    cat = rng.choice(["a", "b"], size=n)
+    logits = 2.0 * x1 + np.where(cat == "a", 1.0, -1.0)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "x1": (ft.Real, x1.tolist()),
+        "cat": (ft.PickList, cat.tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = dsl.transmogrify_features(list(feats.values()), min_support=1)
+    checked = label.sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, seed=1,
+        models_and_parameters=[(OpLogisticRegression(), [{}])],
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=1))
+    pred = label.transform_with(sel, checked)
+    model = Workflow().set_input_frame(frame).set_result_features(pred).train()
+    return model, frame, pred
+
+
+def test_model_insights(fitted):
+    model, frame, pred = fitted
+    mi = model.model_insights()
+    js = mi.to_json()
+    assert js["problemType"] == "classification"
+    assert js["selectedModel"]["bestModelType"] == "OpLogisticRegression"
+    names = {f["featureName"] for f in js["features"]}
+    assert {"x1", "cat"} <= names
+    # derived columns carry correlation + contribution
+    x1_derived = [f for f in js["features"] if f["featureName"] == "x1"
+                  ][0]["derivedFeatures"]
+    assert any(d.get("contribution") is not None for d in x1_derived)
+    assert any(d.get("corrLabel") is not None
+               for d in x1_derived if "corrLabel" in d)
+    top = mi.top_contributions(5)
+    assert top and isinstance(top[0][0], str)
+    assert "Top model contributions" in mi.pretty()
+    json.dumps(js, default=str)  # serializable
+
+
+def test_record_insights_loco(fitted):
+    model, frame, pred = fitted
+    insights = model.record_insights(frame, top_k=5)
+    assert len(insights) == frame.n_rows
+    row0 = insights[0]
+    assert isinstance(row0, dict) and len(row0) <= 5
+    # x1 is the dominant signal: its column should appear in most rows
+    hits = sum(1 for r in insights if any("x1" in k for k in r))
+    assert hits > frame.n_rows * 0.8
+
+
+def test_dsl_math_and_aliases():
+    feats, frame = TestFeatureBuilder.build(
+        ("a", ft.Real, [1.0, 2.0, None]),
+        ("b", ft.Real, [10.0, 20.0, 30.0]),
+    )
+    s = (feats["a"] + feats["b"]).alias("total")
+    assert s.name == "total"
+    from transmogrifai_tpu.dag import DagExecutor, compute_dag
+    from transmogrifai_tpu.pipeline_data import PipelineData
+    data, fitted_dag = DagExecutor().fit_transform(
+        PipelineData.from_host(frame), compute_dag([s]))
+    col = data[0] if isinstance(data, tuple) else data
+    out = data.host_col(s.name)
+    np.testing.assert_allclose(out.values[:2], [11.0, 22.0])
+    assert not out.mask[2]  # None propagates
+    # scalar + unary ops
+    doubled = feats["b"] * 2.0
+    logged = feats["b"].log()
+    d2, _ = DagExecutor().fit_transform(
+        PipelineData.from_host(frame), compute_dag([doubled, logged]))
+    np.testing.assert_allclose(d2.host_col(doubled.name).values,
+                               [20.0, 40.0, 60.0])
+    np.testing.assert_allclose(d2.host_col(logged.name).values,
+                               np.log([10.0, 20.0, 30.0]), rtol=1e-5)
+
+
+def test_z_normalize_and_fill():
+    feats, frame = TestFeatureBuilder.build(
+        ("a", ft.Real, [1.0, 2.0, 3.0, None]),
+    )
+    z = feats["a"].z_normalize()
+    filled = feats["a"].fill_missing_with_mean()
+    from transmogrifai_tpu.dag import DagExecutor, compute_dag
+    from transmogrifai_tpu.pipeline_data import PipelineData
+    data, _ = DagExecutor().fit_transform(
+        PipelineData.from_host(frame), compute_dag([z, filled]))
+    np.testing.assert_allclose(data.host_col(filled.name).values,
+                               [1.0, 2.0, 3.0, 2.0])
+    zv = data.host_col(z.name).values
+    assert abs(zv[:3].mean()) < 1e-5
+
+
+def test_testkit_generators_deterministic():
+    g1 = RandomReal.normal(seed=7).limit(5)
+    g2 = RandomReal.normal(seed=7).limit(5)
+    assert g1 == g2
+    txt = RandomText.countries(seed=3).with_prob_of_empty(0.5).limit(20)
+    assert any(v is None for v in txt) and any(v is not None for v in txt)
+    m = RandomMap.of(RandomReal.uniform(), keys=["a", "b"], seed=5).limit(3)
+    assert all(isinstance(x, dict) for x in m)
+    feats, frame = TestFeatureBuilder.from_generators(
+        50, label=(ft.RealNN, RandomReal.uniform(seed=1)),
+        vip=(ft.Binary, RandomBinary.binaries(seed=2)),
+        response="label")
+    assert frame.n_rows == 50
+    assert feats["label"].is_response
+
+
+def test_op_params_stage_overrides(tmp_path):
+    from transmogrifai_tpu.ops.vectorizers.onehot import OneHotVectorizer
+    p = OpParams.from_json({
+        "stageParams": {"OneHotVectorizer": {"top_k": 5},
+                        "OpLogisticRegression": {"reg_param": 0.5}},
+    })
+    st = OneHotVectorizer()
+    est = OpLogisticRegression()
+    applied = p.apply_to_stages([st, est])
+    assert st.top_k == 5
+    assert est.params["reg_param"] == 0.5
+    assert len(applied) == 2
+    # file round trip
+    f = tmp_path / "p.json"
+    f.write_text(json.dumps(p.to_json()))
+    p2 = OpParams.from_file(str(f))
+    assert p2.stage_params == p.stage_params
+
+
+def test_runner_train_and_evaluate(tmp_path, fitted):
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+    model, frame, pred = fitted
+    # rebuild a small workflow for the runner
+    rng = np.random.default_rng(5)
+    n = 120
+    x = rng.normal(size=n)
+    y = (x + rng.normal(size=n) * 0.5 > 0).astype(float)
+    fr2 = fr.HostFrame.from_dict({
+        "x": (ft.Real, x.tolist()), "label": (ft.RealNN, y.tolist())})
+    feats = FeatureBuilder.from_frame(fr2, response="label")
+    label = feats.pop("label")
+    vec = dsl.transmogrify_features(list(feats.values()), min_support=1)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, seed=3,
+        models_and_parameters=[(OpLogisticRegression(), [{}])],
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=3))
+    pred2 = label.transform_with(sel, vec)
+    wf = Workflow().set_input_frame(fr2).set_result_features(pred2)
+    runner = WorkflowRunner(wf, evaluator=OpBinaryClassificationEvaluator())
+    loc = str(tmp_path / "model")
+    res = runner.run(RunTypes.TRAIN, OpParams.from_json(
+        {"modelLocation": loc}))
+    assert res["status"] == "success"
+    assert res["summary"]["selectedModel"]
+    assert "ModelTraining" in res["appMetrics"]["phases"]
+    res2 = runner.run(RunTypes.EVALUATE, OpParams.from_json(
+        {"modelLocation": loc}))
+    assert res2["status"] == "success"
+    assert res2["metrics"]["au_roc"] > 0.6
+
+
+def test_profiling_metrics():
+    from transmogrifai_tpu.utils.profiling import OpStep, profiler
+    m = profiler.reset("test")
+    with profiler.phase(OpStep.SCORING):
+        pass
+    assert m.phases["Scoring"].count == 1
+    assert "Scoring" in m.pretty()

@@ -1,0 +1,136 @@
+"""Feature-operation DSL: rich methods on FeatureLike.
+
+Parity: reference ``core/src/main/scala/com/salesforce/op/dsl/*`` (11 files
+of implicit Rich*Feature classes) — ``age + fare``, ``text.tokenize()``,
+``city.pivot()``, ``features.transmogrify()``, ``label.sanity_check(vec)``
+etc. Importing this module attaches the methods to FeatureLike (the Python
+analog of the package-object implicits).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from transmogrifai_tpu.features.feature import FeatureLike
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["install", "transmogrify_features"]
+
+
+def _math(op):
+    def fn(self, other):
+        from transmogrifai_tpu.ops.math import (
+            BinaryMathTransformer, ScalarMathTransformer,
+        )
+        if isinstance(other, FeatureLike):
+            return self.transform_with(BinaryMathTransformer(op=op), other)
+        return self.transform_with(
+            ScalarMathTransformer(op=op, scalar=float(other)))
+    return fn
+
+
+def _alias(self, name: str):
+    from transmogrifai_tpu.ops.math import AliasTransformer
+    return self.transform_with(AliasTransformer(name=name))
+
+
+def _abs(self):
+    from transmogrifai_tpu.ops.math import UnaryMathTransformer
+    return self.transform_with(UnaryMathTransformer(op="abs"))
+
+
+def _log(self):
+    from transmogrifai_tpu.ops.math import UnaryMathTransformer
+    return self.transform_with(UnaryMathTransformer(op="log"))
+
+def _sqrt(self):
+    from transmogrifai_tpu.ops.math import UnaryMathTransformer
+    return self.transform_with(UnaryMathTransformer(op="sqrt"))
+
+
+def _to_occur(self):
+    from transmogrifai_tpu.ops.math import ToOccurTransformer
+    return self.transform_with(ToOccurTransformer())
+
+
+def _z_normalize(self):
+    from transmogrifai_tpu.ops.math import OpScalarStandardScaler
+    return self.transform_with(OpScalarStandardScaler())
+
+def _fill_missing_with_mean(self):
+    from transmogrifai_tpu.ops.math import FillMissingWithMean
+    return self.transform_with(FillMissingWithMean())
+
+
+def _tokenize(self, **kw):
+    from transmogrifai_tpu.ops.text import TextTokenizer
+    return self.transform_with(TextTokenizer(**kw))
+
+
+def _detect_languages(self):
+    from transmogrifai_tpu.ops.text import LangDetector
+    return self.transform_with(LangDetector())
+
+
+def _pivot(self, top_k: int = 20, min_support: int = 10, **kw):
+    from transmogrifai_tpu.ops.vectorizers.onehot import OneHotVectorizer
+    return self.transform_with(
+        OneHotVectorizer(top_k=top_k, min_support=min_support, **kw))
+
+
+def _vectorize(self, **kw):
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    return transmogrify([self], **kw)
+
+
+def _smart_vectorize(self, **kw):
+    from transmogrifai_tpu.ops.smart_text import SmartTextVectorizer
+    return self.transform_with(SmartTextVectorizer(**kw))
+
+
+def _sanity_check(self, features: FeatureLike, **kw):
+    """label.sanity_check(feature_vector) -> cleaned vector."""
+    from transmogrifai_tpu.preparators import SanityChecker
+    return self.transform_with(SanityChecker(**kw), features)
+
+
+def _combine(self, *others):
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    return self.transform_with(VectorsCombiner(), *others)
+
+
+def _similarity(self, other, n: int = 3):
+    from transmogrifai_tpu.ops.text import NGramSimilarity
+    return self.transform_with(NGramSimilarity(n=n), other)
+
+
+def transmogrify_features(features: Sequence[FeatureLike], **kw) -> FeatureLike:
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    return transmogrify(list(features), **kw)
+
+
+def install() -> None:
+    """Attach the DSL methods (idempotent)."""
+    F = FeatureLike
+    F.__add__ = _math("+")
+    F.__sub__ = _math("-")
+    F.__mul__ = _math("*")
+    F.__truediv__ = _math("/")
+    F.alias = _alias
+    F.abs = _abs
+    F.log = _log
+    F.sqrt = _sqrt
+    F.to_occur = _to_occur
+    F.z_normalize = _z_normalize
+    F.fill_missing_with_mean = _fill_missing_with_mean
+    F.tokenize = _tokenize
+    F.detect_languages = _detect_languages
+    F.pivot = _pivot
+    F.vectorize = _vectorize
+    F.smart_vectorize = _smart_vectorize
+    F.sanity_check = _sanity_check
+    F.combine = _combine
+    F.similarity = _similarity
+
+
+install()

@@ -1,0 +1,4 @@
+from transmogrifai_tpu.insights.model_insights import ModelInsights
+from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
+
+__all__ = ["ModelInsights", "RecordInsightsLOCO"]

@@ -1,0 +1,108 @@
+"""RecordInsightsLOCO: per-row leave-one-column-out explanations.
+
+Parity: reference ``core/.../stages/impl/insights/RecordInsightsLOCO.scala:
+52-347`` — for each row, zero each feature group's columns of the input
+vector and measure the prediction delta; text/date hash groups aggregate
+(Avg strategy); topK by absolute delta (or positives/negatives).
+
+TPU-first: the reference loops per row per column; here the whole batch
+evaluates all G group-masks in ONE vmapped program — ``[G]`` masked forward
+passes over the full ``[n, d]`` matrix, all on device (SURVEY: "TPUs make
+LOCO cheaper than the reference").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.models.base import PredictionModel
+from transmogrifai_tpu.stages.base import HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import VectorMetadata
+
+__all__ = ["RecordInsightsLOCO"]
+
+
+class RecordInsightsLOCO(HostTransformer):
+    """OPVector -> TextMap of ``column/group name -> score delta`` (json
+    numbers as strings, like the reference's insight map values)."""
+
+    in_types = (ft.OPVector,)
+    out_type = ft.TextMap
+
+    def __init__(self, model: Optional[PredictionModel] = None,
+                 top_k: int = 20, aggregate_groups: bool = True,
+                 uid: Optional[str] = None):
+        self.model = model
+        self.top_k = top_k
+        self.aggregate_groups = aggregate_groups
+        super().__init__(uid=uid)
+
+    # -- grouping ------------------------------------------------------------
+    def _groups(self, meta: Optional[VectorMetadata], d: int
+                ) -> list[tuple[str, list[int]]]:
+        if meta is None or meta.size != d:
+            return [(f"col_{j}", [j]) for j in range(d)]
+        if not self.aggregate_groups:
+            return [(c.make_col_name(), [c.index]) for c in meta.columns]
+        groups: dict[str, list[int]] = {}
+        order: list[str] = []
+        for c in meta.columns:
+            # hash/date descriptor columns aggregate per parent feature;
+            # pivot indicator columns stay individual (like the reference)
+            if c.descriptor_value is not None and c.grouping is not None:
+                key = f"{'_'.join(c.parent_feature)}::{c.grouping}"
+            else:
+                key = c.make_col_name()
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(c.index)
+        return [(k, groups[k]) for k in order]
+
+    # -- scoring -------------------------------------------------------------
+    def _score_fn(self):
+        model = self.model
+        params = model.device_params()
+
+        def score(X):
+            out = model.device_apply(params, fr.VectorColumn(X))
+            prob = out.probability
+            if prob is not None and prob.ndim == 2 and prob.shape[1] >= 2:
+                return prob[:, 1]
+            return out.prediction
+
+        return score
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        col = cols[0]
+        X = jnp.asarray(col.values, jnp.float32)
+        n, d = X.shape
+        meta = col.meta
+        groups = self._groups(meta, d)
+        masks = np.ones((len(groups), d), dtype=np.float32)
+        for gi, (_, idxs) in enumerate(groups):
+            masks[gi, idxs] = 0.0
+        score = self._score_fn()
+        base = score(X)                                     # [n]
+        deltas = jax.vmap(lambda m: base - score(X * m))(
+            jnp.asarray(masks))                              # [G, n]
+        deltas = np.asarray(deltas).T                        # [n, G]
+        names = [g for g, _ in groups]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            row = deltas[i]
+            top = np.argsort(-np.abs(row))[:self.top_k]
+            out[i] = {names[j]: f"{row[j]:.6f}" for j in top
+                      if row[j] != 0.0}
+        return fr.HostColumn(ft.TextMap, out)
+
+    def transform_row(self, vec):
+        host = fr.HostColumn(ft.OPVector,
+                             np.asarray(vec, np.float32)[None, :])
+        return self.host_apply(host).values[0]

@@ -1,0 +1,204 @@
+"""ModelInsights: the merged explainability report.
+
+Parity: reference ``core/src/main/scala/com/salesforce/op/ModelInsights
+.scala:64-858`` — one JSON merging: label summary, per-feature derived-column
+insights (correlation, Cramér's V, model contribution = coefficients /
+importances per model type), RawFeatureFilter results, SanityChecker
+metadata, ModelSelector summary, and stage info. Assembled from the fitted
+workflow's stages (the metadata-rides-with-the-schema pattern: every source
+is already attached to its stage/model).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["ModelInsights", "FeatureInsights", "DerivedColumnInsights"]
+
+
+@dataclass
+class DerivedColumnInsights:
+    name: str
+    index: int
+    grouping: Optional[str] = None
+    indicator_value: Optional[str] = None
+    corr_label: Optional[float] = None
+    variance: Optional[float] = None
+    cramers_v: Optional[float] = None
+    contribution: Optional[float] = None
+
+    def to_json(self):
+        names = {"corr_label": "corrLabel", "cramers_v": "cramersV",
+                 "indicator_value": "indicatorValue"}
+        return {names.get(k, k): v for k, v in self.__dict__.items()
+                if v is not None}
+
+
+@dataclass
+class FeatureInsights:
+    name: str
+    feature_type: str
+    derived: list = field(default_factory=list)
+    exclusion_reasons: list = field(default_factory=list)
+
+    def to_json(self):
+        return {
+            "featureName": self.name,
+            "featureType": self.feature_type,
+            "derivedFeatures": [d.to_json() for d in self.derived],
+            "exclusionReasons": list(self.exclusion_reasons),
+        }
+
+
+@dataclass
+class ModelInsights:
+    label_name: str
+    label_summary: dict
+    problem_type: str
+    features: list = field(default_factory=list)
+    selected_model: Optional[dict] = None
+    sanity_check: Optional[dict] = None
+    raw_feature_filter: Optional[dict] = None
+    stage_info: list = field(default_factory=list)
+
+    # -- assembly ------------------------------------------------------------
+    @staticmethod
+    def from_workflow(model, prediction=None) -> "ModelInsights":
+        """Build insights from a fitted WorkflowModel (reference
+        modelInsights(feature))."""
+        from transmogrifai_tpu.preparators.sanity_checker import DropIndicesModel
+        from transmogrifai_tpu.selector.model_selector import SelectedModel
+
+        pred_f = prediction or model._prediction_feature()
+        label_f = model._label_feature(pred_f)
+
+        selected: Optional[SelectedModel] = None
+        sanity: Optional[DropIndicesModel] = None
+        for t in model.stages():
+            if isinstance(t, SelectedModel):
+                selected = t
+            if isinstance(t, DropIndicesModel):
+                sanity = t
+
+        problem = "unknown"
+        summary_json = None
+        if selected is not None and selected.summary is not None:
+            summary_json = selected.summary.to_json()
+            best = selected.summary.best_model_type.lower()
+            if "regress" in best and "logistic" not in best:
+                problem = "regression"
+            else:
+                problem = "classification"
+
+        # derived-column insights: metadata + sanity stats + contributions
+        per_feature: dict[str, FeatureInsights] = {}
+        for f in model.raw_features:
+            per_feature[f.name] = FeatureInsights(f.name, f.ftype.__name__)
+
+        meta = None
+        if sanity is not None and sanity.out_meta is not None:
+            meta = sanity.out_meta
+        else:
+            # fall back to the prediction model's input vector metadata if
+            # present in a fitted vectorizer chain
+            for t in model.stages():
+                m = getattr(t, "out_meta", None)
+                if m is not None:
+                    meta = m
+
+        contributions = None
+        if selected is not None and hasattr(selected.model,
+                                            "feature_contributions"):
+            try:
+                contributions = np.asarray(
+                    selected.model.feature_contributions())
+            except Exception:
+                contributions = None
+
+        def _strip_index(name: str) -> str:
+            base, _, tail = name.rpartition("_")
+            return base if tail.isdigit() else name
+
+        col_stats = {}
+        cat_stats = {}
+        if sanity is not None and sanity.summary is not None:
+            s = sanity.summary
+            # sanity stats carry pre-drop indices; keep-columns reindex, so
+            # match on the index-stripped column name
+            col_stats = {_strip_index(c.name): c for c in s.column_stats}
+            cat_stats = dict(s.categorical_stats)
+
+        if meta is not None:
+            for i, cm in enumerate(meta.columns):
+                name = cm.make_col_name()
+                stats = col_stats.get(_strip_index(name))
+                group = cm.feature_group()
+                d = DerivedColumnInsights(
+                    name=name, index=cm.index, grouping=cm.grouping,
+                    indicator_value=cm.indicator_value,
+                    corr_label=(float(stats.corr_label) if stats else None),
+                    variance=(float(stats.variance) if stats else None),
+                    cramers_v=(cat_stats.get(group, {}).get("cramersV")
+                               if group else None),
+                    contribution=(float(contributions[i])
+                                  if contributions is not None
+                                  and i < len(contributions) else None),
+                )
+                for parent in cm.parent_feature:
+                    if parent in per_feature:
+                        per_feature[parent].derived.append(d)
+
+        rff = None
+        # dropped-at-ingest features
+        for name in model.blocklisted:
+            per_feature.setdefault(name, FeatureInsights(name, "unknown"))
+            per_feature[name].exclusion_reasons.append("RawFeatureFilter")
+
+        label_summary = {"name": label_f.name}
+        return ModelInsights(
+            label_name=label_f.name,
+            label_summary=label_summary,
+            problem_type=problem,
+            features=list(per_feature.values()),
+            selected_model=summary_json,
+            sanity_check=(sanity.summary.to_json()
+                          if sanity is not None and sanity.summary else None),
+            raw_feature_filter=rff,
+            stage_info=[{"uid": t.uid, "operation": t.operation_name}
+                        for t in model.stages()],
+        )
+
+    # -- rendering -----------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "label": self.label_summary,
+            "problemType": self.problem_type,
+            "features": [f.to_json() for f in self.features],
+            "selectedModel": self.selected_model,
+            "sanityCheck": self.sanity_check,
+            "rawFeatureFilter": self.raw_feature_filter,
+            "stageInfo": self.stage_info,
+        }
+
+    def json(self) -> str:
+        return json.dumps(self.to_json(), indent=2, default=str)
+
+    def top_contributions(self, k: int = 10) -> list[tuple[str, float]]:
+        rows = []
+        for f in self.features:
+            for d in f.derived:
+                if d.contribution is not None:
+                    rows.append((d.name, d.contribution))
+        rows.sort(key=lambda t: -abs(t[1]))
+        return rows[:k]
+
+    def pretty(self, k: int = 15) -> str:
+        from transmogrifai_tpu.utils.table import Table
+        rows = [(n, f"{c:+.4f}") for n, c in self.top_contributions(k)]
+        t = Table(["Derived column", "Contribution"], rows,
+                  title="Top model contributions")
+        return str(t)

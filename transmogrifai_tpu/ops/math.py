@@ -1,0 +1,305 @@
+"""Generic math / value transformers.
+
+Parity: reference ``core/.../stages/impl/feature/MathTransformers.scala``
+(+ ``AliasTransformer``, ``ToOccurTransformer``, ``SubstringTransformer``,
+``OpScalarStandardScaler``, ``FillMissingWithMean``, ``ScalerTransformer``)
+— arithmetic over numeric features with None-propagation semantics matching
+the reference's Option algebra, plus scaling estimators.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import (
+    DeviceTransformer, Estimator, HostTransformer,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = [
+    "BinaryMathTransformer", "UnaryMathTransformer", "ScalarMathTransformer",
+    "AliasTransformer", "ToOccurTransformer", "FillMissingWithMean",
+    "OpScalarStandardScaler", "ScalerTransformer", "DescalerTransformer",
+]
+
+_BINARY_OPS = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b if b != 0 else None,
+}
+
+_UNARY_OPS = {
+    "abs": abs,
+    "ceil": lambda v: float(np.ceil(v)),
+    "floor": lambda v: float(np.floor(v)),
+    "round": lambda v: float(np.round(v)),
+    "exp": lambda v: float(np.exp(v)),
+    "sqrt": lambda v: float(np.sqrt(v)) if v >= 0 else None,
+    "log": lambda v: float(np.log(v)) if v > 0 else None,
+}
+
+
+class BinaryMathTransformer(DeviceTransformer):
+    """(Real, Real) -> Real elementwise op; missing propagates."""
+
+    in_types = (ft.Real, ft.Real)
+    out_type = ft.Real
+
+    def __init__(self, op: str = "+", uid: Optional[str] = None):
+        if op not in _BINARY_OPS:
+            raise ValueError(f"Unknown op {op!r}")
+        self.op = op
+        super().__init__(operation_name=f"math_{op}", uid=uid)
+
+    def device_apply(self, params, a: fr.NumericColumn, b: fr.NumericColumn):
+        mask = a.mask * b.mask
+        if self.op == "+":
+            vals = a.values + b.values
+        elif self.op == "-":
+            vals = a.values - b.values
+        elif self.op == "*":
+            vals = a.values * b.values
+        else:
+            safe = jnp.where(b.values != 0, b.values, 1.0)
+            vals = a.values / safe
+            mask = mask * (b.values != 0)
+        return fr.NumericColumn(vals * mask, mask)
+
+    def transform_row(self, a, b):
+        if a is None or b is None:
+            return None
+        return _BINARY_OPS[self.op](float(a), float(b))
+
+
+class UnaryMathTransformer(DeviceTransformer):
+    in_types = (ft.Real,)
+    out_type = ft.Real
+
+    def __init__(self, op: str = "abs", uid: Optional[str] = None):
+        if op not in _UNARY_OPS:
+            raise ValueError(f"Unknown op {op!r}")
+        self.op = op
+        super().__init__(operation_name=f"math_{op}", uid=uid)
+
+    def device_apply(self, params, a: fr.NumericColumn):
+        v = a.values
+        mask = a.mask
+        if self.op == "abs":
+            out = jnp.abs(v)
+        elif self.op == "ceil":
+            out = jnp.ceil(v)
+        elif self.op == "floor":
+            out = jnp.floor(v)
+        elif self.op == "round":
+            out = jnp.round(v)
+        elif self.op == "exp":
+            out = jnp.exp(v)
+        elif self.op == "sqrt":
+            mask = mask * (v >= 0)
+            out = jnp.sqrt(jnp.maximum(v, 0.0))
+        else:  # log
+            mask = mask * (v > 0)
+            out = jnp.log(jnp.maximum(v, 1e-30))
+        return fr.NumericColumn(out * mask, mask)
+
+    def transform_row(self, a):
+        return None if a is None else _UNARY_OPS[self.op](float(a))
+
+
+class ScalarMathTransformer(DeviceTransformer):
+    """Real op scalar (e.g. ``f * 2.5``, ``f ** 2``)."""
+
+    in_types = (ft.Real,)
+    out_type = ft.Real
+
+    def __init__(self, op: str = "+", scalar: float = 0.0,
+                 uid: Optional[str] = None):
+        if op not in ("+", "-", "*", "/", "**"):
+            raise ValueError(f"Unknown op {op!r}")
+        self.op = op
+        self.scalar = float(scalar)
+        super().__init__(operation_name=f"math_{op}_scalar", uid=uid)
+
+    def device_params(self):
+        return jnp.float32(self.scalar)
+
+    def device_apply(self, params, a: fr.NumericColumn):
+        v, s = a.values, params
+        out = {"+": v + s, "-": v - s, "*": v * s,
+               "/": v / jnp.where(s != 0, s, 1.0),
+               "**": jnp.sign(v) * jnp.abs(v) ** s}[self.op]
+        return fr.NumericColumn(out * a.mask, a.mask)
+
+    def transform_row(self, a):
+        if a is None:
+            return None
+        s = self.scalar
+        if self.op == "/" and s == 0:
+            return None
+        return {"+": a + s, "-": a - s, "*": a * s,
+                "/": a / s if s != 0 else None,
+                "**": float(np.sign(a) * abs(a) ** s)}[self.op]
+
+
+class AliasTransformer(HostTransformer):
+    """Identity rename (reference AliasTransformer)."""
+
+    in_types = (ft.FeatureType,)
+    out_type = ft.FeatureType
+
+    def __init__(self, name: str = "alias", uid: Optional[str] = None):
+        self.name = name
+        super().__init__(operation_name="alias", uid=uid)
+
+    def set_input(self, *features):
+        super().set_input(*features)
+        self.out_type = features[0].ftype
+        return self
+
+    def make_output_name(self) -> str:
+        return self.name
+
+    def transform_row(self, v):
+        return v
+
+
+class ToOccurTransformer(HostTransformer):
+    """Any feature -> Binary occurrence (non-empty)."""
+
+    in_types = (ft.FeatureType,)
+    out_type = ft.Binary
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, v):
+        if v is None:
+            return False
+        if isinstance(v, (list, set, dict, str)):
+            return len(v) > 0
+        return True
+
+
+class FillMissingWithMean(Estimator):
+    """Real -> RealNN mean fill (reference FillMissingWithMean)."""
+
+    in_types = (ft.Real,)
+    out_type = ft.RealNN
+
+    def __init__(self, default_value: float = 0.0, uid: Optional[str] = None):
+        self.default_value = default_value
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        col = data.device_col(self.input_names[0])
+        s = float(jnp.sum(col.values * col.mask))
+        c = float(jnp.sum(col.mask))
+        return _MeanFillModel(mean=s / c if c > 0 else self.default_value)
+
+
+class _MeanFillModel(DeviceTransformer):
+    in_types = (ft.Real,)
+    out_type = ft.RealNN
+
+    def __init__(self, mean: float = 0.0, uid: Optional[str] = None):
+        self.mean = mean
+        super().__init__(uid=uid)
+
+    def device_params(self):
+        return jnp.float32(self.mean)
+
+    def device_apply(self, params, col: fr.NumericColumn):
+        vals = col.values * col.mask + params * (1.0 - col.mask)
+        return fr.NumericColumn(vals, jnp.ones_like(col.mask))
+
+    def transform_row(self, v):
+        return self.mean if v is None else v
+
+    def fitted_state(self):
+        return {"mean": np.float64(self.mean)}
+
+    def set_fitted_state(self, state):
+        self.mean = float(state["mean"])
+
+
+class OpScalarStandardScaler(Estimator):
+    """Real -> RealNN z-normalization (reference OpScalarStandardScaler)."""
+
+    in_types = (ft.Real,)
+    out_type = ft.RealNN
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        col = data.device_col(self.input_names[0])
+        c = jnp.maximum(jnp.sum(col.mask), 1.0)
+        mean = jnp.sum(col.values * col.mask) / c
+        var = jnp.sum(((col.values - mean) ** 2) * col.mask) / c
+        sd = float(jnp.sqrt(jnp.maximum(var, 1e-12)))
+        return ScalerTransformer(slope=1.0 / sd if sd > 0 else 1.0,
+                                 intercept=-float(mean) / sd if sd > 0 else 0.0)
+
+
+class ScalerTransformer(DeviceTransformer):
+    """Linear scaling v*slope + intercept, with metadata enabling
+    descaling of downstream predictions (reference ScalerTransformer)."""
+
+    in_types = (ft.Real,)
+    out_type = ft.RealNN
+
+    def __init__(self, slope: float = 1.0, intercept: float = 0.0,
+                 uid: Optional[str] = None):
+        self.slope = float(slope)
+        self.intercept = float(intercept)
+        super().__init__(uid=uid)
+
+    def device_params(self):
+        return (jnp.float32(self.slope), jnp.float32(self.intercept))
+
+    def device_apply(self, params, col: fr.NumericColumn):
+        s, b = params
+        return fr.NumericColumn((col.values * s + b) * col.mask, col.mask)
+
+    def transform_row(self, v):
+        return None if v is None else v * self.slope + self.intercept
+
+    def fitted_state(self):
+        return {"slope": np.float64(self.slope),
+                "intercept": np.float64(self.intercept)}
+
+    def set_fitted_state(self, state):
+        self.slope = float(state["slope"])
+        self.intercept = float(state["intercept"])
+
+
+class DescalerTransformer(DeviceTransformer):
+    """Inverse of a ScalerTransformer applied to a prediction feature."""
+
+    in_types = (ft.Prediction,)
+    out_type = ft.Prediction
+
+    def __init__(self, slope: float = 1.0, intercept: float = 0.0,
+                 uid: Optional[str] = None):
+        self.slope = float(slope)
+        self.intercept = float(intercept)
+        super().__init__(uid=uid)
+
+    def device_params(self):
+        return (jnp.float32(self.slope), jnp.float32(self.intercept))
+
+    def device_apply(self, params, col: fr.PredictionColumn):
+        s, b = params
+        pred = (col.prediction - b) / jnp.where(s != 0, s, 1.0)
+        return fr.PredictionColumn(pred, col.raw_prediction, col.probability)
+
+    def transform_row(self, pm):
+        out = dict(pm)
+        s = self.slope if self.slope != 0 else 1.0
+        out["prediction"] = (pm["prediction"] - self.intercept) / s
+        return out

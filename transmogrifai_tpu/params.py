@@ -1,0 +1,73 @@
+"""OpParams: JSON-loadable run configuration.
+
+Parity: reference ``features/src/main/scala/com/salesforce/op/OpParams.scala``
+— reader params (paths, key columns), per-stage parameter overrides applied
+by stage class name or uid (reflected setter), model/metrics write locations,
+and a custom params map. Applied by ``Workflow.set_parameters`` (the analog
+of ``OpWorkflow.setStageParameters``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+__all__ = ["OpParams"]
+
+
+@dataclass
+class OpParams:
+    reader_params: dict = field(default_factory=dict)   # name -> {path, ...}
+    stage_params: dict = field(default_factory=dict)    # class/uid -> {param: value}
+    model_location: Optional[str] = None
+    metrics_location: Optional[str] = None
+    score_location: Optional[str] = None
+    custom_params: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_file(path: str) -> "OpParams":
+        with open(path) as fh:
+            return OpParams.from_json(json.load(fh))
+
+    @staticmethod
+    def from_json(d: dict) -> "OpParams":
+        return OpParams(
+            reader_params=d.get("readerParams", {}),
+            stage_params=d.get("stageParams", {}),
+            model_location=d.get("modelLocation"),
+            metrics_location=d.get("metricsLocation"),
+            score_location=d.get("scoreLocation"),
+            custom_params=d.get("customParams", {}),
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "readerParams": self.reader_params,
+            "stageParams": self.stage_params,
+            "modelLocation": self.model_location,
+            "metricsLocation": self.metrics_location,
+            "scoreLocation": self.score_location,
+            "customParams": self.custom_params,
+        }
+
+    # -- application ---------------------------------------------------------
+    def apply_to_stages(self, stages) -> list[str]:
+        """Set overrides on matching stages (by class name or uid); returns
+        a log of applied overrides."""
+        applied = []
+        for stage in stages:
+            for key in (type(stage).__name__, stage.uid):
+                overrides = self.stage_params.get(key)
+                if not overrides:
+                    continue
+                for pname, value in overrides.items():
+                    if hasattr(stage, pname):
+                        setattr(stage, pname, value)
+                        applied.append(f"{stage.uid}.{pname}={value!r}")
+                    elif hasattr(stage, "params") and isinstance(
+                            getattr(stage, "params"), dict) \
+                            and pname in stage.params:
+                        stage.params[pname] = value
+                        applied.append(f"{stage.uid}.params[{pname}]={value!r}")
+        return applied

@@ -1,0 +1,120 @@
+"""Workflow runner: CLI-style train/score/evaluate entry point.
+
+Parity: reference ``core/.../OpWorkflowRunner.scala`` / ``OpApp.scala`` —
+run types Train / Score / Evaluate / Features driven by an OpParams json,
+writing model/metrics/scores to configured locations and reporting a result
+json; `python -m transmogrifai_tpu.runner --run-type train --params p.json`
+mirrors the spark-submit surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Callable, Optional
+
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.utils.profiling import OpStep, profiler
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel, load_model
+
+__all__ = ["WorkflowRunner", "RunTypes"]
+
+
+class RunTypes:
+    TRAIN = "train"
+    SCORE = "score"
+    EVALUATE = "evaluate"
+    FEATURES = "features"
+    ALL = (TRAIN, SCORE, EVALUATE, FEATURES)
+
+
+class WorkflowRunner:
+    """Wraps a workflow + evaluator + reader factory for parameterized runs."""
+
+    def __init__(self, workflow: Workflow,
+                 evaluator=None,
+                 scoring_reader_factory: Optional[Callable[[OpParams], Any]] = None):
+        self.workflow = workflow
+        self.evaluator = evaluator
+        self.scoring_reader_factory = scoring_reader_factory
+        self.on_end_handlers: list[Callable[[dict], None]] = []
+
+    def run(self, run_type: str, params: OpParams) -> dict:
+        t0 = time.time()
+        profiler.reset(app_name=f"transmogrifai_tpu.{run_type}")
+        applied = params.apply_to_stages(
+            [s for f in self.workflow.result_features
+             for s in f.parent_stages()])
+        result: dict = {"runType": run_type, "stageOverrides": applied}
+        try:
+            if run_type == RunTypes.TRAIN:
+                with profiler.phase(OpStep.MODEL_TRAINING):
+                    model = self.workflow.train()
+                if params.model_location:
+                    with profiler.phase(OpStep.RESULTS_SAVING):
+                        model.save(params.model_location)
+                    result["modelLocation"] = params.model_location
+                result["summary"] = model.summary_json()
+            elif run_type in (RunTypes.SCORE, RunTypes.EVALUATE,
+                              RunTypes.FEATURES):
+                if params.model_location is None:
+                    raise ValueError(f"{run_type} requires modelLocation")
+                model = load_model(params.model_location)
+                reader = (self.scoring_reader_factory(params)
+                          if self.scoring_reader_factory
+                          else self.workflow.reader)
+                if run_type == RunTypes.FEATURES:
+                    with profiler.phase(OpStep.FEATURE_ENGINEERING):
+                        frame = model.score(reader, keep_raw_features=True,
+                                            keep_intermediate_features=True)
+                    result["nRows"] = frame.n_rows
+                    result["columns"] = frame.names()
+                else:
+                    with profiler.phase(OpStep.SCORING):
+                        scores = model.score(reader)
+                    result["nRows"] = scores.n_rows
+                    if run_type == RunTypes.EVALUATE:
+                        if self.evaluator is None:
+                            raise ValueError("evaluate requires an evaluator")
+                        with profiler.phase(OpStep.EVALUATION):
+                            metrics = model.evaluate(reader, self.evaluator)
+                        from transmogrifai_tpu.evaluators.base import EvaluatorBase
+                        result["metrics"] = EvaluatorBase.to_json(metrics)
+                        if params.metrics_location:
+                            with open(params.metrics_location, "w") as fh:
+                                json.dump(result["metrics"], fh, indent=2)
+            else:
+                raise ValueError(
+                    f"Unknown run type {run_type!r}; one of {RunTypes.ALL}")
+            result["status"] = "success"
+        except Exception as e:  # report failure like the reference runner
+            result["status"] = "failure"
+            result["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            result["wallSeconds"] = time.time() - t0
+            result["appMetrics"] = profiler.metrics.to_json()
+            for h in self.on_end_handlers:
+                h(result)
+        return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("transmogrifai_tpu runner")
+    ap.add_argument("--run-type", required=True, choices=RunTypes.ALL)
+    ap.add_argument("--params", required=True, help="OpParams json path")
+    ap.add_argument("--workflow", required=True,
+                    help="import path to a module:attr WorkflowRunner")
+    args = ap.parse_args(argv)
+    import importlib
+    mod, _, attr = args.workflow.partition(":")
+    runner: WorkflowRunner = getattr(importlib.import_module(mod), attr)
+    result = runner.run(args.run_type, OpParams.from_file(args.params))
+    print(json.dumps(result, indent=2, default=str))
+    return 0 if result.get("status") == "success" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+"""Seeded random feature-data generators.
+
+Parity: reference ``testkit/src/main/scala/com/salesforce/op/testkit/
+Random{Text,Real,Integral,Binary,List,Map,Set,Vector}.scala`` — infinite
+deterministic generators per feature type with a probability of empty,
+``.limit(n)`` to materialize.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["RandomReal", "RandomIntegral", "RandomBinary", "RandomText",
+           "RandomList", "RandomMultiPickList", "RandomMap", "RandomVector"]
+
+_COUNTRIES = ["USA", "Canada", "Mexico", "Brazil", "France", "Germany",
+              "Japan", "India", "China", "Australia", "Kenya", "Egypt"]
+_CITIES = ["San Francisco", "New York", "Paris", "Berlin", "Tokyo", "Delhi",
+           "Shanghai", "Sydney", "Nairobi", "Cairo", "Toronto", "Recife"]
+_STATES = ["CA", "NY", "TX", "WA", "OR", "NV", "AZ", "CO", "IL", "MA"]
+_EMAILS = ["example.com", "corp.org", "mail.net", "io.dev"]
+
+
+class _Gen:
+    """Infinite seeded generator with probability-of-empty."""
+
+    def __init__(self, sample: Callable[[np.random.Generator], Any],
+                 seed: int = 42, prob_empty: float = 0.0):
+        self._sample = sample
+        self._seed = seed
+        self.prob_empty = prob_empty
+
+    def with_prob_of_empty(self, p: float) -> "_Gen":
+        return _Gen(self._sample, self._seed, p)
+
+    def reseed(self, seed: int) -> "_Gen":
+        return _Gen(self._sample, seed, self.prob_empty)
+
+    def __iter__(self) -> Iterator[Any]:
+        rng = np.random.default_rng(self._seed)
+        while True:
+            if self.prob_empty > 0 and rng.uniform() < self.prob_empty:
+                yield None
+            else:
+                yield self._sample(rng)
+
+    def limit(self, n: int) -> list:
+        it = iter(self)
+        return [next(it) for _ in range(n)]
+
+
+class RandomReal:
+    @staticmethod
+    def normal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.normal(mean, sigma)), seed)
+
+    @staticmethod
+    def uniform(low: float = 0.0, high: float = 1.0, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.uniform(low, high)), seed)
+
+    @staticmethod
+    def poisson(lam: float = 1.0, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.poisson(lam)), seed)
+
+    @staticmethod
+    def logNormal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.lognormal(mean, sigma)), seed)
+
+
+class RandomIntegral:
+    @staticmethod
+    def integrals(low: int = 0, high: int = 100, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: int(r.integers(low, high)), seed)
+
+    @staticmethod
+    def dates(start_ms: int = 1_500_000_000_000,
+              step_ms: int = 86_400_000, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: int(start_ms + r.integers(0, 365) * step_ms),
+                    seed)
+
+
+class RandomBinary:
+    @staticmethod
+    def binaries(prob_true: float = 0.5, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: bool(r.uniform() < prob_true), seed)
+
+
+class RandomText:
+    @staticmethod
+    def strings(min_len: int = 3, max_len: int = 10, seed: int = 42) -> _Gen:
+        letters = np.array(list(string.ascii_lowercase))
+
+        def sample(r):
+            n = int(r.integers(min_len, max_len + 1))
+            return "".join(r.choice(letters, n))
+
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def textFromDomain(domain: Sequence[str], seed: int = 42) -> _Gen:
+        dom = list(domain)
+        return _Gen(lambda r: dom[int(r.integers(len(dom)))], seed)
+
+    @staticmethod
+    def countries(seed: int = 42) -> _Gen:
+        return RandomText.textFromDomain(_COUNTRIES, seed)
+
+    @staticmethod
+    def cities(seed: int = 42) -> _Gen:
+        return RandomText.textFromDomain(_CITIES, seed)
+
+    @staticmethod
+    def states(seed: int = 42) -> _Gen:
+        return RandomText.textFromDomain(_STATES, seed)
+
+    @staticmethod
+    def emails(seed: int = 42) -> _Gen:
+        def sample(r):
+            name = "".join(r.choice(list("abcdefgh"), 6))
+            return f"{name}@{_EMAILS[int(r.integers(len(_EMAILS)))]}"
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def phones(seed: int = 42) -> _Gen:
+        return _Gen(lambda r: "+1" + "".join(
+            str(int(x)) for x in r.integers(0, 10, 10)), seed)
+
+    @staticmethod
+    def picklists(domain: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomText.textFromDomain(domain, seed)
+
+
+class RandomList:
+    @staticmethod
+    def of(elem_gen: _Gen, min_len: int = 0, max_len: int = 5,
+           seed: int = 42) -> _Gen:
+        def sample(r):
+            n = int(r.integers(min_len, max_len + 1))
+            sub = iter(elem_gen.reseed(int(r.integers(1 << 30))))
+            return [v for v in (next(sub) for _ in range(n)) if v is not None]
+        return _Gen(sample, seed)
+
+
+class RandomMultiPickList:
+    @staticmethod
+    def of(domain: Sequence[str], max_len: int = 3, seed: int = 42) -> _Gen:
+        dom = list(domain)
+
+        def sample(r):
+            n = int(r.integers(0, max_len + 1))
+            return set(r.choice(dom, size=min(n, len(dom)), replace=False))
+        return _Gen(sample, seed)
+
+
+class RandomMap:
+    @staticmethod
+    def of(value_gen: _Gen, keys: Sequence[str], seed: int = 42) -> _Gen:
+        ks = list(keys)
+
+        def sample(r):
+            sub = iter(value_gen.reseed(int(r.integers(1 << 30))))
+            out = {}
+            for k in ks:
+                if r.uniform() < 0.8:
+                    v = next(sub)
+                    if v is not None:
+                        out[k] = v
+            return out
+        return _Gen(sample, seed)
+
+
+class RandomVector:
+    @staticmethod
+    def dense(dim: int, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: r.normal(size=dim).astype(np.float32), seed)

@@ -1,0 +1,38 @@
+"""TestFeatureBuilder: build (features, HostFrame) from raw values.
+
+Parity: reference ``testkit/.../TestFeatureBuilder.scala:1-416`` — the
+canonical way test suites conjure a frame plus typed features from tuples.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.frame import HostColumn, HostFrame
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["TestFeatureBuilder"]
+
+
+class TestFeatureBuilder:
+    @staticmethod
+    def build(*columns: tuple, response: Optional[str] = None
+              ) -> tuple[dict[str, Feature], HostFrame]:
+        """``build(("age", ft.Real, [1.0, None]), ...)`` ->
+        ({name: Feature}, HostFrame)."""
+        cols = {}
+        for name, ftype, values in columns:
+            cols[name] = HostColumn.from_values(ftype, list(values))
+        frame = HostFrame(cols)
+        feats = FeatureBuilder.from_frame(frame, response=response)
+        return feats, frame
+
+    @staticmethod
+    def from_generators(n: int, response: Optional[str] = None,
+                        **gens) -> tuple[dict[str, Feature], HostFrame]:
+        """``from_generators(100, age=(ft.Real, RandomReal.normal()), ...)``"""
+        columns = [(name, ftype, gen.limit(n))
+                   for name, (ftype, gen) in gens.items()]
+        return TestFeatureBuilder.build(*columns, response=response)

@@ -1,0 +1,103 @@
+"""Phase-scoped profiling & run metrics.
+
+Parity: reference ``utils/.../spark/OpSparkListener.scala`` (AppMetrics) +
+``core/.../utils/spark/JobGroupUtil.scala`` (OpStep job-group taxonomy) —
+every workflow phase is attributed to an ``OpStep``, wall/(optional) device
+trace collected, and the aggregate ``AppMetrics`` is queryable/serializable
+at the end of the run.
+
+TPU-first: phases can additionally emit ``jax.profiler`` traces
+(``trace_dir``) for XProf timeline analysis — the analog of drilling into
+the Spark UI from a job group.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+__all__ = ["OpStep", "AppMetrics", "profiler", "phase"]
+
+
+class OpStep(Enum):
+    DATA_READING_AND_FILTERING = "DataReadingAndFiltering"
+    FEATURE_ENGINEERING = "FeatureEngineering"
+    CROSS_VALIDATION = "CrossValidation"
+    MODEL_TRAINING = "ModelTraining"
+    SCORING = "Scoring"
+    EVALUATION = "Evaluation"
+    RESULTS_SAVING = "ResultsSaving"
+    OTHER = "Other"
+
+
+@dataclass
+class PhaseMetrics:
+    step: str
+    wall_s: float = 0.0
+    count: int = 0
+
+
+@dataclass
+class AppMetrics:
+    app_name: str = "transmogrifai_tpu"
+    start_time: float = field(default_factory=time.time)
+    phases: dict = field(default_factory=dict)  # step -> PhaseMetrics
+
+    def record(self, step: OpStep, wall_s: float) -> None:
+        pm = self.phases.setdefault(step.value, PhaseMetrics(step.value))
+        pm.wall_s += wall_s
+        pm.count += 1
+
+    @property
+    def total_wall_s(self) -> float:
+        return time.time() - self.start_time
+
+    def to_json(self) -> dict:
+        return {
+            "appName": self.app_name,
+            "totalWallSeconds": self.total_wall_s,
+            "phases": {k: {"wallSeconds": p.wall_s, "count": p.count}
+                       for k, p in self.phases.items()},
+        }
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh, indent=2)
+
+    def pretty(self) -> str:
+        from transmogrifai_tpu.utils.table import Table
+        rows = [(k, f"{p.wall_s:.2f}", p.count)
+                for k, p in sorted(self.phases.items())]
+        return str(Table(["Phase", "Wall (s)", "Count"], rows,
+                         title=f"{self.app_name} metrics"))
+
+
+class _Profiler:
+    def __init__(self):
+        self.metrics = AppMetrics()
+        self.trace_dir: Optional[str] = None
+
+    def reset(self, app_name: str = "transmogrifai_tpu",
+              trace_dir: Optional[str] = None) -> AppMetrics:
+        self.metrics = AppMetrics(app_name=app_name)
+        self.trace_dir = trace_dir
+        return self.metrics
+
+    @contextlib.contextmanager
+    def phase(self, step: OpStep):
+        t0 = time.time()
+        ctx = contextlib.nullcontext()
+        if self.trace_dir is not None:
+            import jax
+            ctx = jax.profiler.trace(self.trace_dir)
+        with ctx:
+            yield
+        self.metrics.record(step, time.time() - t0)
+
+
+profiler = _Profiler()
+phase = profiler.phase

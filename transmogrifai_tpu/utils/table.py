@@ -1,0 +1,39 @@
+"""Pretty ASCII tables (reference ``utils/.../Table.scala``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["Table"]
+
+
+class Table:
+    def __init__(self, headers: Sequence[str], rows: Sequence[Sequence],
+                 title: Optional[str] = None):
+        self.headers = [str(h) for h in headers]
+        self.rows = [[str(c) for c in r] for r in rows]
+        self.title = title
+
+    def __str__(self) -> str:
+        widths = [len(h) for h in self.headers]
+        for r in self.rows:
+            for i, c in enumerate(r):
+                widths[i] = max(widths[i], len(c))
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+
+        def fmt(cells):
+            return "| " + " | ".join(
+                c.ljust(w) for c, w in zip(cells, widths)) + " |"
+
+        out = []
+        if self.title:
+            total = len(sep)
+            out.append("+" + "-" * (total - 2) + "+")
+            out.append("|" + self.title.center(total - 2) + "|")
+        out.append(sep)
+        out.append(fmt(self.headers))
+        out.append(sep)
+        for r in self.rows:
+            out.append(fmt(r))
+        out.append(sep)
+        return "\n".join(out)

@@ -75,24 +75,27 @@ class Workflow:
             raise ValueError("set a reader or input frame before train()")
         if not self.result_features:
             raise ValueError("set result features before train()")
+        from transmogrifai_tpu.utils.profiling import OpStep, profiler
         raw = self.raw_features()
-        frame = self.reader.generate_frame(raw)
-        blocklist: list[str] = []
-        result = self.result_features
-        if self._raw_feature_filter is not None:
-            frame, blocklist = self._raw_feature_filter.filter_frame(
-                frame, raw)
-            if blocklist:
-                result = _apply_blocklist(result, set(blocklist))
-                if not result:
-                    raise ValueError(
-                        "RawFeatureFilter blocked every path to the result "
-                        f"features (blocklist: {blocklist})")
-                raw = [f for f in raw if f.name not in set(blocklist)]
+        with profiler.phase(OpStep.DATA_READING_AND_FILTERING):
+            frame = self.reader.generate_frame(raw)
+            blocklist: list[str] = []
+            result = self.result_features
+            if self._raw_feature_filter is not None:
+                frame, blocklist = self._raw_feature_filter.filter_frame(
+                    frame, raw)
+                if blocklist:
+                    result = _apply_blocklist(result, set(blocklist))
+                    if not result:
+                        raise ValueError(
+                            "RawFeatureFilter blocked every path to the "
+                            f"result features (blocklist: {blocklist})")
+                    raw = [f for f in raw if f.name not in set(blocklist)]
         data = PipelineData.from_host(frame)
         dag = compute_dag(result)
         executor = DagExecutor()
-        _, fitted = executor.fit_transform(data, dag)
+        with profiler.phase(OpStep.FEATURE_ENGINEERING):
+            _, fitted = executor.fit_transform(data, dag)
         return WorkflowModel(
             result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
@@ -226,6 +229,27 @@ class WorkflowModel:
 
     def summary(self) -> str:
         return json.dumps(self.summary_json(), indent=2, default=str)
+
+    def model_insights(self, prediction: Optional[FeatureLike] = None):
+        """Merged explainability report (reference modelInsights(feature))."""
+        from transmogrifai_tpu.insights.model_insights import ModelInsights
+        return ModelInsights.from_workflow(self, prediction)
+
+    def record_insights(self, reader_or_frame, top_k: int = 20):
+        """Per-row LOCO insights for the scored data (reference
+        RecordInsightsLOCO applied to the model's feature vector)."""
+        from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
+        pred_f = self._prediction_feature()
+        sel = pred_f.origin_stage
+        feat_name = None
+        for t in self.stages():
+            if t.get_output() == pred_f:
+                feat_name = t.runtime_input_names()[-1]
+                model = t
+        data = self.transform(reader_or_frame)
+        loco = RecordInsightsLOCO(model=model, top_k=top_k)
+        col = data.host_col(feat_name)
+        return loco.host_apply(col).values
 
     # -- persistence ---------------------------------------------------------
     def save(self, path: str, overwrite: bool = True) -> None:
