@@ -1,0 +1,131 @@
+"""Forecast / bin-score / log-loss evaluator tests.
+
+Hand-computed expectations mirror the reference's evaluator test style
+(OpForecastEvaluatorTest, OpBinScoreEvaluatorTest in core/src/test).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.evaluators import (
+    OpBinScoreEvaluator, OpForecastEvaluator, OPLogLoss,
+)
+
+
+def _pred(pred, prob=None, raw=None):
+    n = len(pred)
+    pred = jnp.asarray(pred, jnp.float32)
+    if prob is None:
+        prob = jnp.zeros((n, 2), jnp.float32)
+    else:
+        prob = jnp.asarray(prob, jnp.float32)
+    if raw is None:
+        raw = prob
+    return fr.PredictionColumn(pred, jnp.asarray(raw, jnp.float32), prob)
+
+
+class TestForecast:
+    def test_perfect_forecast_smape_zero(self):
+        y = np.array([1.0, 2.0, 3.0, 4.0])
+        m = OpForecastEvaluator(seasonal_window=1).evaluate_arrays(y, _pred(y))
+        assert m.smape == pytest.approx(0.0)
+        assert m.mase == pytest.approx(0.0)
+        # seasonal error: mean |y_t - y_{t+1}| over first 3 = 1.0
+        assert m.seasonal_error == pytest.approx(1.0)
+
+    def test_hand_computed(self):
+        y = np.array([2.0, 4.0, 6.0])
+        yhat = np.array([3.0, 3.0, 6.0])
+        m = OpForecastEvaluator(seasonal_window=1).evaluate_arrays(y, _pred(yhat))
+        # smape = 2/3 * (1/5 + 1/7 + 0)
+        assert m.smape == pytest.approx(2.0 / 3.0 * (0.2 + 1.0 / 7.0))
+        # seasonal error = (|2-4| + |4-6|)/2 = 2 ; mase = (1+1+0)/(2*3)
+        assert m.seasonal_error == pytest.approx(2.0)
+        assert m.mase == pytest.approx(2.0 / 6.0)
+
+    def test_window_larger_handled(self):
+        y = np.array([1.0, 2.0])
+        m = OpForecastEvaluator(seasonal_window=5).evaluate_arrays(y, _pred(y))
+        assert m.mase == 0.0
+
+    def test_direction(self):
+        ev = OpForecastEvaluator()
+        assert not ev.larger_is_better("SMAPE")
+
+    def test_constant_labels_bad_forecast_is_not_perfect(self):
+        # seasonal_error = 0 but the forecast is wrong: MASE must not be 0
+        y = np.array([5.0, 5.0, 5.0])
+        yhat = np.array([1.0, 1.0, 1.0])
+        m = OpForecastEvaluator().evaluate_arrays(y, _pred(yhat))
+        assert m.mase == float("inf")
+
+
+class TestBinScore:
+    def test_brier_and_bins(self):
+        y = np.array([1.0, 0.0, 1.0, 0.0])
+        prob1 = np.array([0.9, 0.1, 0.6, 0.4])
+        prob = np.stack([1 - prob1, prob1], axis=1)
+        ev = OpBinScoreEvaluator(num_of_bins=4)
+        m = ev.evaluate_arrays(y, _pred(np.round(prob1), prob))
+        expected_brier = np.mean((prob1 - y) ** 2)
+        assert m.brier_score == pytest.approx(expected_brier, abs=1e-6)
+        assert m.bin_size == pytest.approx(0.25)
+        assert sum(m.number_of_data_points) == 4
+        # bin 0: score .1 -> count 1, 0 positives; bin 3: score .9 -> 1 pos
+        assert m.number_of_data_points[0] == 1
+        assert m.number_of_positive_labels[3] == 1
+        assert m.average_score[0] == pytest.approx(0.1, abs=1e-6)
+        assert m.average_conversion_rate[3] == pytest.approx(1.0)
+        assert m.bin_centers[0] == pytest.approx(0.125)
+
+    def test_range_expands_beyond_unit(self):
+        y = np.array([0.0, 1.0])
+        prob1 = np.array([-0.5, 1.5])
+        prob = np.stack([1 - prob1, prob1], axis=1)
+        m = OpBinScoreEvaluator(num_of_bins=2).evaluate_arrays(
+            y, _pred(np.round(np.clip(prob1, 0, 1)), prob))
+        assert m.bin_size == pytest.approx(1.0)
+        assert m.bin_centers[0] == pytest.approx(0.0)
+
+    def test_empty(self):
+        m = OpBinScoreEvaluator().evaluate_arrays(
+            np.zeros(0), _pred(np.zeros(0), np.zeros((0, 2))))
+        assert m.brier_score == 0.0
+
+
+class TestLogLoss:
+    def test_binary(self):
+        y = np.array([1.0, 0.0])
+        prob1 = np.array([0.8, 0.25])
+        prob = np.stack([1 - prob1, prob1], axis=1)
+        m = OPLogLoss().evaluate_arrays(y, _pred(np.round(prob1), prob))
+        expected = -(np.log(0.8) + np.log(0.75)) / 2
+        assert m.value == pytest.approx(expected, abs=1e-6)
+
+    def test_multiclass(self):
+        y = np.array([2, 0])
+        prob = np.array([[0.1, 0.2, 0.7], [0.5, 0.3, 0.2]])
+        m = OPLogLoss().evaluate_arrays(y, _pred(np.argmax(prob, 1), prob))
+        expected = -(np.log(0.7) + np.log(0.5)) / 2
+        assert m.value == pytest.approx(expected, abs=1e-6)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            OPLogLoss().evaluate_arrays(np.zeros(0), _pred(np.zeros(0)))
+
+    def test_factories(self):
+        assert isinstance(OPLogLoss.binary_log_loss(), OPLogLoss)
+        assert not OPLogLoss().larger_is_better()
+
+    def test_empty_probability_matrix_falls_back_to_prediction(self):
+        # margin-only models carry probability with shape (n, 0)
+        y = np.array([1.0, 0.0])
+        p1 = np.array([0.8, 0.25])
+        col = fr.PredictionColumn(
+            jnp.asarray(p1, jnp.float32),
+            jnp.zeros((2, 0), jnp.float32), jnp.zeros((2, 0), jnp.float32))
+        m = OPLogLoss().evaluate_arrays(y, col)
+        expected = -(np.log(0.8) + np.log(0.75)) / 2
+        assert m.value == pytest.approx(expected, abs=1e-6)

@@ -8,10 +8,17 @@ from transmogrifai_tpu.evaluators.multi import (
 from transmogrifai_tpu.evaluators.regression import (
     OpRegressionEvaluator, RegressionMetrics,
 )
+from transmogrifai_tpu.evaluators.extras import (
+    BinaryClassificationBinMetrics, ForecastMetrics, OpBinScoreEvaluator,
+    OpForecastEvaluator, OPLogLoss, SingleMetric,
+)
 
 __all__ = [
     "EvaluatorBase",
     "BinaryClassificationMetrics", "OpBinaryClassificationEvaluator",
     "MultiClassificationMetrics", "OpMultiClassificationEvaluator",
     "OpRegressionEvaluator", "RegressionMetrics",
+    "ForecastMetrics", "OpForecastEvaluator",
+    "BinaryClassificationBinMetrics", "OpBinScoreEvaluator",
+    "SingleMetric", "OPLogLoss",
 ]

@@ -251,6 +251,15 @@ class PredictionColumn:
     def tree_unflatten(cls, aux, children):
         return cls(*children)
 
+    def pos_score(self) -> jax.Array:
+        """Positive-class score: P(class=1) when a real probability matrix is
+        present, else the raw prediction. The single guard for the (n,0)
+        empty-probability convention used by margin-only/regression models."""
+        prob = self.probability
+        if prob is not None and getattr(prob, "ndim", 1) == 2 and prob.shape[1] >= 2:
+            return jnp.asarray(prob[:, 1], jnp.float32)
+        return jnp.asarray(self.prediction, jnp.float32)
+
 
 DeviceColumn = Any  # NumericColumn | CodesColumn | VectorColumn | PredictionColumn
 DeviceFrame = dict  # dict[str, DeviceColumn]
