@@ -65,6 +65,9 @@ def test_model_insights(fitted):
     top = mi.top_contributions(5)
     assert top and isinstance(top[0][0], str)
     assert "Top model contributions" in mi.pretty()
+    # label summary carries the train-time streaming-histogram distribution
+    dist = js["label"].get("distribution")
+    assert dist is not None and sum(dist["counts"]) == dist["count"]
     json.dumps(js, default=str)  # serializable
 
 

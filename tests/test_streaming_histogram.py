@@ -1,0 +1,170 @@
+"""StreamingHistogram tests — native C++ backend + pure-Python fallback.
+
+Mirrors the reference's utils/src/test/.../StreamingHistogramTest.scala:
+bounded bins, closest-centroid merging, mergeable shards, interpolated sum.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils import streaming_histogram as sh
+from transmogrifai_tpu.utils.streaming_histogram import (
+    StreamingHistogram, density, padded_bins,
+)
+
+
+@pytest.fixture(params=["native", "python"])
+def backend(request, monkeypatch):
+    if request.param == "python":
+        monkeypatch.setattr(sh, "_LIB", None)
+        monkeypatch.setattr(sh, "_LIB_TRIED", True)
+    else:
+        if sh._lib() is None:
+            pytest.skip("no native toolchain")
+    return request.param
+
+
+def test_native_backend_available():
+    # the build image has g++: the native path must actually engage
+    assert StreamingHistogram(8).is_native
+
+
+def test_exact_when_under_budget(backend):
+    h = StreamingHistogram(max_bins=10, max_spool=2)
+    for v in [1.0, 2.0, 2.0, 3.0]:
+        h.update(v)
+    centers, counts = h.bins()
+    assert centers.tolist() == [1.0, 2.0, 3.0]
+    assert counts.tolist() == [1, 2, 1]
+    assert (backend == "native") == h.is_native
+
+
+def test_bounded_bins_and_weighted_merge(backend):
+    # paper example: closest pair merges into weighted centroid
+    h = StreamingHistogram(max_bins=3, max_spool=0)
+    for v in [1.0, 2.0, 10.0, 20.0]:
+        h.update(v)
+    centers, counts = h.bins()
+    assert len(centers) == 3
+    # 1 and 2 are closest -> centroid 1.5 with count 2
+    assert centers[0] == pytest.approx(1.5)
+    assert counts[0] == 2
+    assert int(counts.sum()) == 4
+
+
+def test_many_values_bounded(backend):
+    rng = np.random.default_rng(0)
+    h = StreamingHistogram(max_bins=15, max_spool=500)
+    h.update_all(rng.normal(size=10_000))
+    centers, counts = h.bins()
+    assert len(centers) <= 15
+    assert int(counts.sum()) == 10_000
+    assert np.all(np.diff(centers) > 0)
+
+
+def test_merge_equals_union(backend):
+    rng = np.random.default_rng(1)
+    a_vals, b_vals = rng.normal(size=500), rng.normal(size=500) + 3
+    a = StreamingHistogram(max_bins=20).update_all(a_vals)
+    b = StreamingHistogram(max_bins=20).update_all(b_vals)
+    a.merge(b)
+    centers, counts = a.bins()
+    assert int(counts.sum()) == 1000
+    assert len(centers) <= 20
+    # mass balance across the two modes roughly preserved
+    mid = 1.5
+    left = counts[centers < mid].sum()
+    assert 400 <= left <= 600
+
+
+def test_sum_interpolation(backend):
+    h = StreamingHistogram(max_bins=10)
+    for v, m in [(1.0, 4), (3.0, 2)]:
+        h.update(v, m)
+    # at the midpoint b=2: ki/2 + trapezoid(1->2) = 4/2 + (4 + 3)/2 * 0.5
+    assert h.sum_below(2.0) == pytest.approx(4 / 2 + (4 + 3) / 2 * 0.5)
+    assert h.sum_below(100.0) == pytest.approx(6.0)
+    assert h.sum_below(0.0) == pytest.approx(0.0)
+
+
+def test_round_seconds(backend):
+    h = StreamingHistogram(max_bins=10, round_seconds=60)
+    h.update(61.0)
+    h.update(119.0)
+    centers, counts = h.bins()
+    assert centers.tolist() == [120.0]
+    assert counts.tolist() == [2]
+
+
+def test_round_seconds_negative_matches_reference(backend):
+    # Java/C++ truncated %: negative values never round (d <= 0)
+    h = StreamingHistogram(max_bins=10, round_seconds=60)
+    h.update(-61.0)
+    centers, _ = h.bins()
+    assert centers.tolist() == [-61.0]
+
+
+def test_nan_update_ignored(backend):
+    h = StreamingHistogram(max_bins=10)
+    h.update(float("nan"))
+    h.update(float("inf"))
+    h.update(1.0)
+    centers, counts = h.bins()
+    assert centers.tolist() == [1.0] and counts.tolist() == [1]
+
+
+def test_native_python_equivalence():
+    if sh._lib() is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(7)
+    vals = rng.normal(size=3000)
+    nat = StreamingHistogram(max_bins=12).update_all(vals)
+    py = StreamingHistogram.__new__(StreamingHistogram)
+    py.max_bins, py.max_spool, py.round_seconds = 12, 500, 1
+    py._ptr, py._py = None, sh._PyHist(12, 500, 1)
+    py.update_all(vals)
+    nc, nk = nat.bins()
+    pc, pk = py.bins()
+    np.testing.assert_allclose(nc, pc, rtol=1e-12)
+    np.testing.assert_array_equal(nk, pk)
+
+
+def test_padded_bins_and_density():
+    centers = np.array([1.0, 2.0])
+    counts = np.array([2, 2])
+    c, k = padded_bins(centers, counts, padding=0.5)
+    assert c.tolist() == [0.5, 1.0, 2.0, 2.5]
+    assert k.tolist() == [0.0, 2.0, 2.0, 0.0]
+    f = density(centers, counts, padding=0.5)
+    total = f(0.6) + f(1.5) + f(2.2)
+    assert total == pytest.approx(1.0)
+    assert f(1.5) > f(0.6)
+
+
+def test_label_distribution_in_workflow(tmp_path):
+    # regression-style label summary survives train + save/load
+    import jax.numpy  # noqa: F401  (jax configured cpu by conftest)
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.ops.vectorizers.numeric import RealVectorizer
+    from transmogrifai_tpu.workflow import Workflow, load_model
+
+    rng = np.random.default_rng(0)
+    n = 200
+    x = rng.normal(size=n)
+    y = 2 * x + rng.normal(size=n) * 0.1
+    frame = fr.HostFrame({
+        "x": fr.HostColumn(ft.Real, x, np.ones(n, bool)),
+        "y": fr.HostColumn(ft.RealNN, y, np.ones(n, bool)),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    vec = feats["x"].transform_with(RealVectorizer())
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(vec, feats["y"]).train())
+    d = model.label_distribution
+    assert d is not None and d["name"] == "y" and d["count"] == n
+    assert sum(d["counts"]) == n
+    model.save(str(tmp_path / "m"))
+    loaded = load_model(str(tmp_path / "m"))
+    assert loaded.label_distribution["count"] == n

@@ -159,6 +159,8 @@ class ModelInsights:
             per_feature[name].exclusion_reasons.append("RawFeatureFilter")
 
         label_summary = {"name": label_f.name}
+        if getattr(model, "label_distribution", None):
+            label_summary["distribution"] = model.label_distribution
         return ModelInsights(
             label_name=label_f.name,
             label_summary=label_summary,

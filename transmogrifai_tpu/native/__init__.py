@@ -1,0 +1,41 @@
+"""Native (C++) components, built on demand with g++ and bound via ctypes.
+
+The reference's JVM-external native layer (netlib BLAS, libxgboost JNI —
+SURVEY §2.8) maps here: host-side runtime pieces that don't belong on the
+TPU compute path get real native implementations, compiled once into
+``_build/`` next to this file and loaded with ctypes. Every binding must
+keep a pure-Python fallback so the framework works where no toolchain
+exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_BUILD_LOCK = threading.Lock()
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+
+def build_and_load(source_name: str, lib_name: str) -> Optional[ctypes.CDLL]:
+    """Compile ``source_name`` (in this dir) to ``_build/lib<name>.so`` if
+    stale/missing and dlopen it. Returns None when compilation fails (no
+    toolchain, sandbox, ...) — callers fall back to Python."""
+    src = os.path.join(_DIR, source_name)
+    out = os.path.join(_BUILD_DIR, f"lib{lib_name}.so")
+    with _BUILD_LOCK:
+        try:
+            if (not os.path.exists(out)
+                    or os.path.getmtime(out) < os.path.getmtime(src)):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-o", out, src],
+                    check=True, capture_output=True, timeout=120)
+            return ctypes.CDLL(out)
+        except Exception:
+            return None

@@ -1,0 +1,155 @@
+// Streaming decision-tree histogram (Ben-Haim & Tom-Tov, JMLR 2010).
+//
+// Native C++ equivalent of the reference's
+// utils/src/main/java/com/salesforce/op/utils/stats/StreamingHistogram.java:
+// bounded-bin histogram built by spooled exact counts that collapse the two
+// closest centroids once the bin budget is exceeded; mergeable across
+// shards (the map-reduce combiner in the reference's RDD aggregate).
+//
+// Exposed as a flat C ABI for ctypes. Bins and spool live in ordered
+// std::maps (matching the reference's TreeMap flush order, which affects
+// which centroids merge), and the bulk path ingests a whole column per call
+// so the Python boundary is crossed once per array, not once per value.
+
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <map>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Histogram {
+  std::map<double, int64_t> bin;
+  std::map<double, int64_t> spool;
+  int max_bin_size;
+  int max_spool_size;
+  int64_t round_seconds;
+
+  void merge_closest() {
+    while (static_cast<int>(bin.size()) > max_bin_size) {
+      auto it = bin.begin();
+      double p1 = it->first;
+      ++it;
+      double q1 = p1, q2 = it->first;
+      double smallest = q2 - q1;
+      double prev = it->first;
+      for (++it; it != bin.end(); ++it) {
+        double diff = it->first - prev;
+        if (diff < smallest) {
+          smallest = diff;
+          q1 = prev;
+          q2 = it->first;
+        }
+        prev = it->first;
+      }
+      int64_t k1 = bin[q1], k2 = bin[q2];
+      bin.erase(q1);
+      bin.erase(q2);
+      bin[(q1 * k1 + q2 * k2) / static_cast<double>(k1 + k2)] += k1 + k2;
+    }
+  }
+
+  void flush() {
+    if (spool.empty()) return;
+    for (const auto& kv : spool) {
+      bin[kv.first] += kv.second;
+      merge_closest();
+    }
+    spool.clear();
+  }
+
+  void update(double p, int64_t m) {
+    if (round_seconds > 1) {
+      int64_t lp = static_cast<int64_t>(p);
+      int64_t d = lp % round_seconds;
+      if (d > 0) p = static_cast<double>(lp + (round_seconds - d));
+    }
+    auto it = spool.find(p);
+    if (it != spool.end()) {
+      it->second += m;
+    } else {
+      spool.emplace(p, m);
+    }
+    if (static_cast<int>(spool.size()) > max_spool_size) flush();
+  }
+
+  // Interpolated count of points <= b (reference StreamingHistogram.sum).
+  double sum(double b) const {
+    auto next = bin.upper_bound(b);
+    if (next == bin.end()) {
+      double total = 0;
+      for (const auto& kv : bin) total += static_cast<double>(kv.second);
+      return total;
+    }
+    // floor entry: greatest key <= b
+    if (next == bin.begin()) return 0.0;
+    auto pi = std::prev(next);
+    double ki = static_cast<double>(pi->second);
+    double knext = static_cast<double>(next->second);
+    double weight = (b - pi->first) / (next->first - pi->first);
+    double mb = ki + (knext - ki) * weight;
+    double s = (ki + mb) * weight / 2.0 + ki / 2.0;
+    for (auto it = bin.begin(); it != pi; ++it)
+      s += static_cast<double>(it->second);
+    return s;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* shist_new(int max_bin_size, int max_spool_size, int round_seconds) {
+  Histogram* h = new Histogram();
+  h->max_bin_size = max_bin_size;
+  h->max_spool_size = max_spool_size;
+  h->round_seconds = round_seconds < 1 ? 1 : round_seconds;
+  return h;
+}
+
+void shist_free(void* ptr) { delete static_cast<Histogram*>(ptr); }
+
+void shist_update(void* ptr, double p, int64_t m) {
+  static_cast<Histogram*>(ptr)->update(p, m);
+}
+
+void shist_update_bulk(void* ptr, const double* p, int64_t n) {
+  Histogram* h = static_cast<Histogram*>(ptr);
+  for (int64_t i = 0; i < n; ++i) h->update(p[i], 1);
+}
+
+void shist_flush(void* ptr) { static_cast<Histogram*>(ptr)->flush(); }
+
+int shist_size(void* ptr) {
+  Histogram* h = static_cast<Histogram*>(ptr);
+  h->flush();
+  return static_cast<int>(h->bin.size());
+}
+
+void shist_get(void* ptr, double* centers, int64_t* counts) {
+  Histogram* h = static_cast<Histogram*>(ptr);
+  h->flush();
+  int64_t i = 0;
+  for (const auto& kv : h->bin) {
+    centers[i] = kv.first;
+    counts[i] = kv.second;
+    ++i;
+  }
+}
+
+double shist_sum(void* ptr, double b) {
+  Histogram* h = static_cast<Histogram*>(ptr);
+  h->flush();
+  return h->sum(b);
+}
+
+void shist_merge(void* ptr, void* other) {
+  Histogram* h = static_cast<Histogram*>(ptr);
+  Histogram* o = static_cast<Histogram*>(other);
+  o->flush();
+  for (const auto& kv : o->bin) h->update(kv.first, kv.second);
+}
+
+}  // extern "C"

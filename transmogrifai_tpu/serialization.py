@@ -74,6 +74,7 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
         "resultFeatures": [_feature_json(f) for f in model.result_features],
         "rawFeatures": [_feature_json(f) for f in model.raw_features],
         "blocklisted": list(model.blocklisted),
+        "labelDistribution": getattr(model, "label_distribution", None),
         "stages": stages_json,
     }
     with open(os.path.join(path, MODEL_JSON), "w") as fh:
@@ -157,4 +158,5 @@ def load_model(path: str):
     return WorkflowModel(
         result_features=result, raw_features=raw_feats,
         dag=[l for l in dag if l], executor=DagExecutor(),
-        blocklisted=manifest.get("blocklisted", []))
+        blocklisted=manifest.get("blocklisted", []),
+        label_distribution=manifest.get("labelDistribution"))

@@ -99,19 +99,23 @@ class Workflow:
         return WorkflowModel(
             result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
-            blocklisted=blocklist)
+            blocklisted=blocklist,
+            label_distribution=_label_distribution(frame, raw))
 
 
 class WorkflowModel:
     def __init__(self, result_features: Sequence[FeatureLike],
                  raw_features: Sequence[FeatureLike], dag: Dag,
                  executor: Optional[DagExecutor] = None,
-                 blocklisted: Sequence[str] = ()):
+                 blocklisted: Sequence[str] = (),
+                 label_distribution: Optional[dict] = None):
         self.result_features = tuple(result_features)
         self.raw_features = list(raw_features)
         self.dag = dag
         self.executor = executor or DagExecutor()
         self.blocklisted = list(blocklisted)
+        #: bounded-bin label histogram captured at train time (ModelInsights)
+        self.label_distribution = label_distribution
 
     # -- scoring -------------------------------------------------------------
     def _ingest(self, reader_or_frame) -> PipelineData:
@@ -260,6 +264,34 @@ class WorkflowModel:
     def score_function(self):
         from transmogrifai_tpu.local.scoring import make_score_function
         return make_score_function(self)
+
+
+def _label_distribution(frame: fr.HostFrame, raw_features) -> Optional[dict]:
+    """Bounded-memory label histogram (reference: StreamingHistogram fed by
+    the regression label; here for any numeric response)."""
+    from transmogrifai_tpu.utils.streaming_histogram import StreamingHistogram
+
+    for f in raw_features:
+        if not f.is_response or f.name not in frame.columns:
+            continue
+        col = frame.columns[f.name]
+        try:
+            vals = np.asarray(col.values, np.float64)
+        except (TypeError, ValueError):
+            return None
+        mask = getattr(col, "mask", None)
+        if mask is not None:
+            vals = vals[np.asarray(mask, bool)]
+        h = StreamingHistogram(max_bins=100).update_all(vals)
+        d = h.to_json()
+        d["name"] = f.name
+        d["count"] = int(np.isfinite(vals).sum())
+        if d["count"]:
+            d["mean"] = float(np.nanmean(vals))
+            d["min"] = float(np.nanmin(vals))
+            d["max"] = float(np.nanmax(vals))
+        return d
+    return None
 
 
 def _apply_blocklist(result_features: Sequence[FeatureLike],
