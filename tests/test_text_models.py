@@ -1,0 +1,223 @@
+"""CountVectorizer / Word2Vec / LDA / TimePeriod / name-detection tests.
+
+Mirrors reference suites OpCountVectorizerTest, OpWord2VecTest, OpLDATest,
+TimePeriodTransformerTest, HumanNameDetectorTest, NameEntityRecognizerTest.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.ops.names import (
+    HumanNameDetector, NameEntityRecognizer,
+)
+from transmogrifai_tpu.ops.text_models import (
+    OpCountVectorizer, OpLDA, OpWord2Vec,
+)
+from transmogrifai_tpu.ops.time_period import (
+    TimePeriod, TimePeriodListTransformer, TimePeriodMapTransformer,
+    TimePeriodTransformer,
+)
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow, load_model
+
+
+def _text_list_frame(docs):
+    vals = np.empty(len(docs), object)
+    for i, d in enumerate(docs):
+        vals[i] = d
+    return fr.HostFrame({"txt": fr.HostColumn(ft.TextList, vals)})
+
+
+def _fit_transform(frame, stage, name="txt"):
+    feats = FeatureBuilder.from_frame(frame)
+    out = feats[name].transform_with(stage)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(out).train())
+    return model, model.transform(frame), out
+
+
+class TestCountVectorizer:
+    DOCS = [["a", "b", "b"], ["b", "c"], ["a", "b"], None]
+
+    def test_counts_and_vocab_order(self):
+        frame = _text_list_frame(self.DOCS)
+        model, data, out = _fit_transform(
+            frame, OpCountVectorizer(min_df=1.0))
+        col = data.host_col(out.name)
+        # vocab ordered by corpus frequency: b(4), a(2), c(1)
+        vec = np.asarray(col.values, np.float32)
+        np.testing.assert_array_equal(vec[0], [2, 1, 0])
+        np.testing.assert_array_equal(vec[1], [1, 0, 1])
+        np.testing.assert_array_equal(vec[3], [0, 0, 0])
+        meta = col.meta
+        assert [c.descriptor_value for c in meta.columns] == ["b", "a", "c"]
+
+    def test_min_df_fraction_and_binary(self):
+        frame = _text_list_frame(self.DOCS)
+        _, data, out = _fit_transform(
+            frame, OpCountVectorizer(min_df=0.6, binary=True))
+        vec = np.asarray(data.host_col(out.name).values, np.float32)
+        # only 'b' appears in >= 60% of 4 docs (3/4); a: 2/4, c: 1/4
+        assert vec.shape[1] == 1
+        np.testing.assert_array_equal(vec[:, 0], [1, 1, 1, 0])
+
+    def test_save_load_roundtrip(self, tmp_path):
+        frame = _text_list_frame(self.DOCS)
+        model, data, out = _fit_transform(frame, OpCountVectorizer())
+        model.save(str(tmp_path / "m"))
+        loaded = load_model(str(tmp_path / "m"))
+        v1 = np.asarray(data.host_col(out.name).values)
+        v2 = np.asarray(loaded.transform(frame).host_col(out.name).values)
+        np.testing.assert_array_equal(v1, v2)
+
+
+class TestWord2Vec:
+    def test_similar_contexts_embed_close(self):
+        # apple/orange share contexts; 'jax' never co-occurs with them
+        docs = []
+        for _ in range(60):
+            docs.append(["i", "eat", "apple", "every", "day"])
+            docs.append(["i", "eat", "orange", "every", "day"])
+            docs.append(["we", "compile", "jax", "to", "xla"])
+        frame = _text_list_frame(docs)
+        stage = OpWord2Vec(vector_size=16, min_count=5, window_size=2,
+                           num_iterations=40, seed=0)
+        model, data, out = _fit_transform(frame, stage)
+        w2v = [s for s in model.stages()
+               if type(s).__name__ == "Word2VecModel"][0]
+        vecs = {t: w2v.vectors[w2v._index[t]] for t in ("apple", "orange",
+                                                        "jax")}
+
+        def cos(a, b):
+            return float(np.dot(a, b) /
+                         (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+        assert cos(vecs["apple"], vecs["orange"]) > cos(vecs["apple"],
+                                                        vecs["jax"])
+        # document vector = mean of token vectors
+        col = data.host_col(out.name)
+        assert np.asarray(col.values).shape[1] == 16
+
+    def test_empty_doc_zero_vector(self):
+        docs = [["a", "b"]] * 10 + [None]
+        frame = _text_list_frame(docs)
+        _, data, out = _fit_transform(
+            frame, OpWord2Vec(vector_size=8, min_count=1, num_iterations=1))
+        vec = np.asarray(data.host_col(out.name).values)
+        np.testing.assert_array_equal(vec[-1], np.zeros(8))
+
+
+class TestLDA:
+    def test_topics_separate_corpora(self):
+        rng = np.random.default_rng(0)
+        # two disjoint vocab blocks of 6 terms; docs draw from one block
+        n, v = 80, 12
+        x = np.zeros((n, v), np.float32)
+        for i in range(n):
+            block = 0 if i % 2 == 0 else 1
+            idx = rng.integers(0, 6, size=20) + 6 * block
+            for j in idx:
+                x[i, j] += 1
+        frame = fr.HostFrame(
+            {"vec": fr.HostColumn(ft.OPVector, x)})
+        feats = FeatureBuilder.from_frame(frame)
+        out = feats["vec"].transform_with(OpLDA(k=2, max_iter=30, seed=1))
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(out).train())
+        theta = np.asarray(model.transform(frame).host_col(out.name).values)
+        assert theta.shape == (n, 2)
+        np.testing.assert_allclose(theta.sum(1), 1.0, atol=1e-4)
+        # even and odd docs should land on different dominant topics
+        even_top = np.argmax(theta[::2].mean(0))
+        odd_top = np.argmax(theta[1::2].mean(0))
+        assert even_top != odd_top
+        assert theta[::2, even_top].mean() > 0.8
+
+
+def _ms(y, mo, d, h=0):
+    dt = datetime.datetime(y, mo, d, h, tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+class TestTimePeriod:
+    CASES = [
+        # 2019-03-01 was a Friday, day-of-year 60
+        (_ms(2019, 3, 1, 13), {"DayOfMonth": 1, "DayOfWeek": 5,
+                               "DayOfYear": 60, "HourOfDay": 13,
+                               "MonthOfYear": 3, "WeekOfMonth": 1}),
+        # 2019-03-04 Monday begins week 2 of the month
+        (_ms(2019, 3, 4), {"DayOfWeek": 1, "WeekOfMonth": 2}),
+        # leap year check: 2020-03-01 is day-of-year 61, a Sunday
+        (_ms(2020, 3, 1), {"DayOfYear": 61, "DayOfWeek": 7}),
+        # epoch day: Thursday 1970-01-01
+        (0, {"DayOfWeek": 4, "DayOfMonth": 1, "MonthOfYear": 1,
+             "DayOfYear": 1, "WeekOfYear": 1, "HourOfDay": 0}),
+    ]
+
+    @pytest.mark.parametrize("millis,expected", CASES)
+    def test_extract(self, millis, expected):
+        for period, want in expected.items():
+            got = TimePeriod(period).extract_int(millis)
+            assert got == want, f"{period}({millis}) = {got}, want {want}"
+
+    def test_matches_python_datetime_fuzz(self):
+        rng = np.random.default_rng(3)
+        for ms in rng.integers(0, 2_000_000_000_000, size=200):
+            dt = datetime.datetime.fromtimestamp(
+                int(ms) / 1000, tz=datetime.timezone.utc)
+            assert TimePeriod.DayOfMonth.extract_int(int(ms)) == dt.day
+            assert TimePeriod.DayOfWeek.extract_int(int(ms)) == dt.isoweekday()
+            assert TimePeriod.HourOfDay.extract_int(int(ms)) == dt.hour
+            assert TimePeriod.MonthOfYear.extract_int(int(ms)) == dt.month
+            assert (TimePeriod.DayOfYear.extract_int(int(ms))
+                    == dt.timetuple().tm_yday)
+
+    def test_transformers(self):
+        t = TimePeriodTransformer(period="HourOfDay")
+        assert t.transform_row(_ms(2019, 3, 1, 13)) == 13
+        assert t.transform_row(None) is None
+        tl = TimePeriodListTransformer(period="DayOfWeek")
+        np.testing.assert_array_equal(
+            tl.transform_row([_ms(2019, 3, 1), _ms(2019, 3, 4)]), [5, 1])
+        tm = TimePeriodMapTransformer(period="MonthOfYear")
+        assert tm.transform_row({"a": _ms(2019, 3, 1)}) == {"a": 3}
+        assert tm.transform_row(None) == {}
+
+
+class TestNames:
+    def test_human_name_detector_positive(self):
+        vals = np.array(["Mr John Smith", "Mary Jones", "Sarah Lee",
+                         "David Kim", None], object)
+        frame = fr.HostFrame({"who": fr.HostColumn(ft.Text, vals)})
+        feats = FeatureBuilder.from_frame(frame)
+        out = feats["who"].transform_with(HumanNameDetector(threshold=0.2))
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(out).train())
+        res = model.transform(frame).host_col(out.name)
+        assert res.values[0]["isName"] == "true"
+        assert res.values[0]["gender"] == "Male"
+        assert res.values[1]["gender"] == "Female"
+
+    def test_human_name_detector_negative(self):
+        vals = np.array(["red green blue", "alpha beta", "x y z"], object)
+        frame = fr.HostFrame({"c": fr.HostColumn(ft.Text, vals)})
+        feats = FeatureBuilder.from_frame(frame)
+        out = feats["c"].transform_with(HumanNameDetector())
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(out).train())
+        res = model.transform(frame).host_col(out.name)
+        assert res.values[0] == {}
+
+    def test_ner_tags_capitalized_names(self):
+        ner = NameEntityRecognizer()
+        tags = ner.transform_row("Yesterday John met Mary in paris")
+        assert tags.get("john") == {"Person"}
+        assert tags.get("mary") == {"Person"}
+        # lowercase 'mark' as a verb is not tagged
+        assert "mark" not in ner.transform_row("please mark the date")
+        assert ner.transform_row(None) == {}

@@ -104,6 +104,36 @@ def _similarity(self, other, n: int = 3):
     return self.transform_with(NGramSimilarity(n=n), other)
 
 
+def _count_vectorize(self, **kw):
+    from transmogrifai_tpu.ops.text_models import OpCountVectorizer
+    return self.transform_with(OpCountVectorizer(**kw))
+
+
+def _word2vec(self, **kw):
+    from transmogrifai_tpu.ops.text_models import OpWord2Vec
+    return self.transform_with(OpWord2Vec(**kw))
+
+
+def _lda(self, **kw):
+    from transmogrifai_tpu.ops.text_models import OpLDA
+    return self.transform_with(OpLDA(**kw))
+
+
+def _to_time_period(self, period="DayOfMonth"):
+    from transmogrifai_tpu.ops.time_period import TimePeriodTransformer
+    return self.transform_with(TimePeriodTransformer(period=period))
+
+
+def _name_entity_tagger(self, **kw):
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    return self.transform_with(NameEntityRecognizer(**kw))
+
+
+def _detect_human_names(self, **kw):
+    from transmogrifai_tpu.ops.names import HumanNameDetector
+    return self.transform_with(HumanNameDetector(**kw))
+
+
 def transmogrify_features(features: Sequence[FeatureLike], **kw) -> FeatureLike:
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     return transmogrify(list(features), **kw)
@@ -131,6 +161,12 @@ def install() -> None:
     F.sanity_check = _sanity_check
     F.combine = _combine
     F.similarity = _similarity
+    F.count_vectorize = _count_vectorize
+    F.word2vec = _word2vec
+    F.lda = _lda
+    F.to_time_period = _to_time_period
+    F.name_entity_tagger = _name_entity_tagger
+    F.detect_human_names = _detect_human_names
 
 
 install()

@@ -1,0 +1,440 @@
+"""Fitted text models: count vectorization, word embeddings, topic models.
+
+Parity targets:
+- ``core/.../stages/impl/feature/OpCountVectorizer.scala`` (Spark
+  CountVectorizer wrapper): vocabulary of top terms by corpus frequency with
+  a document-frequency floor, TextList -> sparse count vector.
+- ``core/.../stages/impl/feature/OpWord2Vec.scala`` (Spark Word2Vec
+  wrapper): skip-gram embeddings, document vector = mean of token vectors.
+- ``core/.../stages/impl/feature/OpLDA.scala`` (Spark LDA wrapper): online
+  variational Bayes topic model over term-count vectors.
+
+TPU-first design: vocabulary building and id-encoding are host string work
+(SURVEY §7 hard part #2); the *training loops* are JAX programs — Word2Vec
+is a ``lax.scan`` of negative-sampling SGD steps whose gather+matmul inner
+product batches onto the MXU, and LDA's E-step is a fixed-iteration digamma
+recurrence vectorized over the whole corpus (no per-document Python loop),
+M-step a single [K,n]x[n,V] matmul. Neither translates Spark's
+driver/executor parameter averaging: one device owns the parameters and the
+data streams through in batches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import Estimator, HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    parent_of, VectorColumnMetadata, VectorMetadata,
+)
+
+__all__ = ["OpCountVectorizer", "CountVectorizerModel",
+           "OpWord2Vec", "Word2VecModel", "OpLDA", "LDAModel"]
+
+
+def _doc_tokens(value) -> list[str]:
+    """TextList value -> token list (already tokenized upstream)."""
+    if value is None:
+        return []
+    if isinstance(value, str):
+        return [value]
+    return [t for t in value if t is not None]
+
+
+# ---------------------------------------------------------------------------
+# CountVectorizer
+# ---------------------------------------------------------------------------
+
+class OpCountVectorizer(Estimator):
+    """TextList -> OPVector of per-term counts over a fitted vocabulary.
+
+    ``min_df``: minimum number (>=1) or fraction (<1) of documents a term
+    must appear in; ``vocab_size``: top terms by total corpus frequency
+    (Spark CountVectorizer ordering); ``binary``: presence instead of count.
+    """
+
+    in_types = (ft.TextList,)
+    out_type = ft.OPVector
+
+    def __init__(self, vocab_size: int = 1 << 18, min_df: float = 1.0,
+                 min_tf: float = 1.0, binary: bool = False,
+                 uid: Optional[str] = None):
+        self.vocab_size = int(vocab_size)
+        self.min_df = float(min_df)
+        self.min_tf = float(min_tf)
+        self.binary = binary
+        super().__init__(uid=uid)
+
+    def fit_model(self, data) -> "CountVectorizerModel":
+        col = data.host_col(self.input_names[0])
+        tf: dict[str, int] = {}
+        df: dict[str, int] = {}
+        n_docs = 0
+        for v in col.values:
+            toks = _doc_tokens(v)
+            n_docs += 1
+            for t in toks:
+                tf[t] = tf.get(t, 0) + 1
+            for t in set(toks):
+                df[t] = df.get(t, 0) + 1
+        min_docs = (self.min_df if self.min_df >= 1.0
+                    else self.min_df * max(n_docs, 1))
+        terms = [t for t in tf if df[t] >= min_docs]
+        # top by corpus frequency, ties broken lexicographically for
+        # deterministic vocabularies across runs
+        terms.sort(key=lambda t: (-tf[t], t))
+        vocab = terms[: self.vocab_size]
+        return CountVectorizerModel(vocab=vocab, min_tf=self.min_tf,
+                                    binary=self.binary)
+
+
+class CountVectorizerModel(HostTransformer):
+    in_types = (ft.TextList,)
+    out_type = ft.OPVector
+
+    def __init__(self, vocab: Sequence[str] = (), min_tf: float = 1.0,
+                 binary: bool = False, uid: Optional[str] = None):
+        self.vocab = list(vocab)
+        self.min_tf = float(min_tf)
+        self.binary = binary
+        self._index = {t: i for i, t in enumerate(self.vocab)}
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        out = np.zeros(len(self.vocab), dtype=np.float32)
+        toks = _doc_tokens(value)
+        for t in toks:
+            i = self._index.get(t)
+            if i is not None:
+                out[i] += 1.0
+        # per-document term-frequency floor (Spark minTF: count or fraction)
+        floor = (self.min_tf if self.min_tf >= 1.0
+                 else self.min_tf * max(len(toks), 1))
+        out[out < floor] = 0.0
+        if self.binary:
+            out = (out > 0).astype(np.float32)
+        return out
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        vals = np.stack([self.transform_row(v) for v in cols[0].values]) \
+            if len(cols[0]) else np.zeros((0, len(self.vocab)), np.float32)
+        return fr.HostColumn(ft.OPVector, vals.astype(np.float32),
+                             meta=self._meta())
+
+    def _meta(self) -> VectorMetadata:
+        f = self.input_features[0]
+        cols = tuple(VectorColumnMetadata(*parent_of(f), grouping=f.name,
+                                          descriptor_value=term)
+                     for term in self.vocab)
+        return VectorMetadata(self.get_output().name, cols).reindexed(0)
+
+    def config(self) -> dict:
+        return {"vocab": self.vocab, "min_tf": self.min_tf,
+                "binary": self.binary}
+
+
+# ---------------------------------------------------------------------------
+# Word2Vec
+# ---------------------------------------------------------------------------
+
+class OpWord2Vec(Estimator):
+    """TextList -> OPVector document embedding (mean of token vectors).
+
+    Skip-gram with negative sampling trained as one jitted ``lax.scan`` over
+    minibatches: each step gathers (center, context, k negatives) embedding
+    rows and reduces sigmoid losses — gather + batched dot products, MXU
+    friendly, no Python in the loop.
+    """
+
+    in_types = (ft.TextList,)
+    out_type = ft.OPVector
+
+    def __init__(self, vector_size: int = 100, min_count: int = 5,
+                 window_size: int = 5, num_iterations: int = 1,
+                 num_negatives: int = 5, step_size: float = 0.025,
+                 batch_size: int = 1024, max_vocab: int = 1 << 17,
+                 seed: int = 42, uid: Optional[str] = None):
+        self.vector_size = int(vector_size)
+        self.min_count = int(min_count)
+        self.window_size = int(window_size)
+        self.num_iterations = int(num_iterations)
+        self.num_negatives = int(num_negatives)
+        self.step_size = float(step_size)
+        self.batch_size = int(batch_size)
+        self.max_vocab = int(max_vocab)
+        self.seed = int(seed)
+        super().__init__(uid=uid)
+
+    # -- host side: vocab + pair generation ----------------------------------
+    def _pairs(self, docs) -> tuple[list[str], np.ndarray, np.ndarray]:
+        counts: dict[str, int] = {}
+        for v in docs:
+            for t in _doc_tokens(v):
+                counts[t] = counts.get(t, 0) + 1
+        vocab = [t for t, c in counts.items() if c >= self.min_count]
+        vocab.sort(key=lambda t: (-counts[t], t))
+        vocab = vocab[: self.max_vocab]
+        index = {t: i for i, t in enumerate(vocab)}
+        centers, contexts = [], []
+        for v in docs:
+            ids = [index[t] for t in _doc_tokens(v) if t in index]
+            for i, c in enumerate(ids):
+                lo = max(0, i - self.window_size)
+                hi = min(len(ids), i + self.window_size + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        return (vocab, np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    def fit_model(self, data) -> "Word2VecModel":
+        col = data.host_col(self.input_names[0])
+        vocab, centers, contexts = self._pairs(col.values)
+        v, d = len(vocab), self.vector_size
+        if v == 0 or centers.size == 0:
+            return Word2VecModel(vocab=vocab,
+                                 vectors=np.zeros((0, d), np.float32))
+        import optax
+
+        key = jax.random.PRNGKey(self.seed)
+        k_init, k_shuf, k_train = jax.random.split(key, 3)
+        emb_in = (jax.random.uniform(k_init, (v, d), jnp.float32) - 0.5) / d
+        emb_out = jnp.zeros((v, d), jnp.float32)
+
+        b = min(self.batch_size, centers.size)
+        n_batches = centers.size // b
+        c_full = jnp.asarray(centers)
+        x_full = jnp.asarray(contexts)
+        kn = self.num_negatives
+        opt = optax.adam(self.step_size)
+        del k_shuf  # per-epoch shuffles derive from the training key
+
+        def epoch_step(carry, batch):
+            params, opt_state, key = carry
+            c_ids, x_ids = batch
+            key, k_neg = jax.random.split(key)
+            neg = jax.random.randint(k_neg, (b, kn), 0, v)
+
+            def loss_fn(p):
+                e_i, e_o = p
+                ec = e_i[c_ids]                      # [b, d]
+                ox = e_o[x_ids]                      # [b, d]
+                on = e_o[neg]                        # [b, kn, d]
+                pos = jnp.sum(ec * ox, axis=-1)      # [b]
+                negs = jnp.einsum("bd,bkd->bk", ec, on)
+                return -(jnp.mean(jax.nn.log_sigmoid(pos))
+                         + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            grads = jax.grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state, key), ()
+
+        @jax.jit
+        def train(params, key):
+            opt_state = opt.init(params)
+
+            def one_epoch(carry, _):
+                params, opt_state, key = carry
+                key, k_perm = jax.random.split(key)
+                # fresh shuffle each epoch so the truncated tail rotates and
+                # every pair trains across epochs
+                perm = jax.random.permutation(
+                    k_perm, centers.size)[: n_batches * b]
+                batches = (c_full[perm].reshape(n_batches, b),
+                           x_full[perm].reshape(n_batches, b))
+                carry, _ = jax.lax.scan(
+                    epoch_step, (params, opt_state, key), batches)
+                return carry, ()
+
+            (params, _, _), _ = jax.lax.scan(
+                one_epoch, (params, opt_state, key), None,
+                length=self.num_iterations)
+            return params[0]
+
+        vectors = np.asarray(train((emb_in, emb_out), k_train))
+        return Word2VecModel(vocab=vocab, vectors=vectors)
+
+
+class Word2VecModel(HostTransformer):
+    in_types = (ft.TextList,)
+    out_type = ft.OPVector
+
+    def __init__(self, vocab: Sequence[str] = (),
+                 vectors: Optional[np.ndarray] = None,
+                 uid: Optional[str] = None):
+        self.vocab = list(vocab)
+        self.vectors = (np.zeros((0, 0), np.float32) if vectors is None
+                        else np.asarray(vectors, np.float32))
+        self._index = {t: i for i, t in enumerate(self.vocab)}
+        super().__init__(uid=uid)
+
+    @property
+    def vector_size(self) -> int:
+        return self.vectors.shape[1] if self.vectors.size else 0
+
+    def transform_row(self, value):
+        d = self.vector_size
+        ids = [self._index[t] for t in _doc_tokens(value) if t in self._index]
+        if not ids or d == 0:
+            return np.zeros(d, np.float32)
+        return self.vectors[ids].mean(axis=0)
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        d = self.vector_size
+        vals = (np.stack([self.transform_row(v) for v in cols[0].values])
+                if len(cols[0]) else np.zeros((0, d), np.float32))
+        f = self.input_features[0]
+        meta = VectorMetadata(self.get_output().name, tuple(
+            VectorColumnMetadata(*parent_of(f), grouping=f.name,
+                                 descriptor_value=f"w2v_{j}")
+            for j in range(d))).reindexed(0)
+        return fr.HostColumn(ft.OPVector, vals.astype(np.float32), meta=meta)
+
+    def config(self) -> dict:
+        return {"vocab": self.vocab}
+
+    def fitted_state(self) -> dict:
+        return {"vectors": self.vectors}
+
+    def set_fitted_state(self, state: dict) -> None:
+        self.vectors = np.asarray(state["vectors"], np.float32)
+
+
+# ---------------------------------------------------------------------------
+# LDA
+# ---------------------------------------------------------------------------
+
+def _lda_e_step(lam: jnp.ndarray, x: jnp.ndarray, alpha: float,
+                n_iter: int = 30):
+    """Variational E-step for all docs at once: gamma [n, K]."""
+    from jax.scipy.special import digamma
+
+    e_log_beta = digamma(lam) - digamma(lam.sum(1, keepdims=True))  # [K, V]
+    exp_elog_beta = jnp.exp(e_log_beta)                             # [K, V]
+
+    def body(gamma, _):
+        e_log_theta = digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
+        exp_elog_theta = jnp.exp(e_log_theta)                       # [n, K]
+        # phi normalizer per (doc, word): [n, V]
+        norm = exp_elog_theta @ exp_elog_beta + 1e-30
+        gamma_new = alpha + exp_elog_theta * ((x / norm) @ exp_elog_beta.T)
+        return gamma_new, ()
+
+    n, k = x.shape[0], lam.shape[0]
+    gamma0 = jnp.ones((n, k), jnp.float32)
+    gamma, _ = jax.lax.scan(body, gamma0, None, length=n_iter)
+    return gamma, exp_elog_beta
+
+
+class OpLDA(Estimator):
+    """OPVector (term counts) -> OPVector (topic mixture).
+
+    Batch variational Bayes (the full-corpus case of Hoffman's online VB):
+    E-step is a fixed-iteration scan over digamma updates vectorized across
+    every document simultaneously; M-step one matmul. Everything jitted.
+    """
+
+    in_types = (ft.OPVector,)
+    out_type = ft.OPVector
+
+    def __init__(self, k: int = 10, max_iter: int = 20,
+                 doc_concentration: Optional[float] = None,
+                 topic_concentration: Optional[float] = None,
+                 seed: int = 42, uid: Optional[str] = None):
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        self.doc_concentration = doc_concentration
+        self.topic_concentration = topic_concentration
+        self.seed = int(seed)
+        super().__init__(uid=uid)
+
+    def fit_model(self, data) -> "LDAModel":
+        col = data.device_col(self.input_names[0])
+        x = jnp.asarray(col.values, jnp.float32)
+        n, v = x.shape
+        k = self.k
+        # Spark default ~ 1/k; explicit values must be positive (0 drives
+        # the digamma recurrence to -inf)
+        alpha = (1.0 / k if self.doc_concentration is None
+                 else float(self.doc_concentration))
+        eta = (1.0 / k if self.topic_concentration is None
+               else float(self.topic_concentration))
+        if alpha <= 0 or eta <= 0:
+            raise ValueError("doc/topic concentration must be positive")
+        key = jax.random.PRNGKey(self.seed)
+        lam0 = jax.random.gamma(key, 100.0, (k, v)) / 100.0
+
+        @jax.jit
+        def train(lam):
+            def one_iter(lam, _):
+                gamma, exp_elog_beta = _lda_e_step(lam, x, alpha)
+                from jax.scipy.special import digamma
+                e_log_theta = digamma(gamma) - digamma(
+                    gamma.sum(1, keepdims=True))
+                exp_elog_theta = jnp.exp(e_log_theta)
+                norm = exp_elog_theta @ exp_elog_beta + 1e-30
+                # sufficient stats: [K, V]
+                stats = exp_elog_beta * (exp_elog_theta.T @ (x / norm))
+                return eta + stats, ()
+            lam, _ = jax.lax.scan(one_iter, lam, None, length=self.max_iter)
+            return lam
+
+        lam = np.asarray(train(lam0))
+        return LDAModel(topics=lam, doc_concentration=float(alpha))
+
+
+class LDAModel(HostTransformer):
+    """Inference: normalized variational gamma = E[theta | doc]."""
+
+    in_types = (ft.OPVector,)
+    out_type = ft.OPVector
+
+    def __init__(self, topics: Optional[np.ndarray] = None,
+                 doc_concentration: float = 0.1,
+                 uid: Optional[str] = None):
+        self.topics = (np.zeros((0, 0), np.float32) if topics is None
+                       else np.asarray(topics, np.float32))
+        self.doc_concentration = float(doc_concentration)
+        super().__init__(uid=uid)
+
+    @property
+    def k(self) -> int:
+        return self.topics.shape[0]
+
+    def _infer(self, x: np.ndarray) -> np.ndarray:
+        gamma, _ = _lda_e_step(jnp.asarray(self.topics),
+                               jnp.asarray(x, jnp.float32),
+                               self.doc_concentration)
+        g = np.asarray(gamma)
+        return g / np.maximum(g.sum(axis=1, keepdims=True), 1e-30)
+
+    def transform_row(self, value):
+        x = np.asarray(value, np.float32).reshape(1, -1)
+        return self._infer(x)[0]
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        x = np.asarray(cols[0].values, np.float32)
+        vals = (self._infer(x) if x.shape[0]
+                else np.zeros((0, self.k), np.float32))
+        f = self.input_features[0]
+        meta = VectorMetadata(self.get_output().name, tuple(
+            VectorColumnMetadata(*parent_of(f), grouping=f.name,
+                                 descriptor_value=f"topic_{j}")
+            for j in range(self.k))).reindexed(0)
+        return fr.HostColumn(ft.OPVector, vals.astype(np.float32), meta=meta)
+
+    def config(self) -> dict:
+        return {"doc_concentration": self.doc_concentration}
+
+    def fitted_state(self) -> dict:
+        return {"topics": self.topics}
+
+    def set_fitted_state(self, state: dict) -> None:
+        self.topics = np.asarray(state["topics"], np.float32)

@@ -1,0 +1,167 @@
+"""Calendar time-period extraction from epoch-millis date features.
+
+Parity: reference ``features/.../impl/feature/TimePeriod.scala`` (enum of
+DayOfMonth/DayOfWeek/DayOfYear/HourOfDay/MonthOfYear/WeekOfMonth/WeekOfYear,
+weeks numbered per ``java.time.WeekFields.of(MONDAY, 1)``: Monday-first,
+minimalDays=1 — NOT ISO-8601's minimalDays=4) and ``core/.../impl/feature/
+TimePeriod{,List,Map}Transformer.scala``. All UTC, like the reference's
+default zone.
+
+Exact calendar integers need 64-bit epoch millis, which the (x64-disabled)
+device path cannot carry — so these are vectorized int64 *host* kernels
+(civil-from-days integer arithmetic over whole numpy columns, no per-row
+datetime objects). The device-side cyclic encoding of the same periods
+lives in ``vectorizers/dates.py``, where phase precision suffices.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+
+__all__ = ["TimePeriod", "TimePeriodTransformer", "TimePeriodListTransformer",
+           "TimePeriodMapTransformer"]
+
+
+def _civil_from_millis(ms: np.ndarray):
+    """Vectorized epoch-millis -> (year, month, day, hour, day_of_week
+    Mon=1..Sun=7, day_of_year). Howard Hinnant's civil_from_days, branch-free
+    over int64 arrays."""
+    ms = np.asarray(ms, np.int64)
+    days = np.floor_divide(ms, 86_400_000)
+    secs = np.floor_divide(ms - days * 86_400_000, 1000)
+    hour = np.floor_divide(secs, 3600)
+    z = days + 719_468
+    era = np.floor_divide(z, 146_097)
+    doe = z - era * 146_097
+    yoe = np.floor_divide(
+        doe - np.floor_divide(doe, 1460) + np.floor_divide(doe, 36_524)
+        - np.floor_divide(doe, 146_096), 365)
+    y = yoe + era * 400
+    doy_mar = doe - (365 * yoe + np.floor_divide(yoe, 4)
+                     - np.floor_divide(yoe, 100))          # [0, 365]
+    mp = np.floor_divide(5 * doy_mar + 2, 153)
+    day = doy_mar - np.floor_divide(153 * mp + 2, 5) + 1
+    month = mp + np.where(mp < 10, 3, -9)
+    year = y + np.where(month <= 2, 1, 0)
+    # ISO day-of-week: 1970-01-01 was a Thursday (=4)
+    dow = ((days + 3) % 7) + 1
+    # day-of-year via days since Jan 1 of `year`
+    y1 = year - 1
+    jan1 = (365 * y1 + np.floor_divide(y1, 4) - np.floor_divide(y1, 100)
+            + np.floor_divide(y1, 400)) - 719_162
+    doy = days - jan1 + 1
+    return year, month, day, hour, dow, doy
+
+
+def _week_fields(day_in_period, dow):
+    """Week number with Monday-start weeks, minimalDays=1 (java WeekFields
+    .of(MONDAY, 1)): week = ceil((day + offset)/7) where offset is the
+    Monday-aligned weekday of day 1 of the period."""
+    first_dow = ((dow - 1) - (day_in_period - 1)) % 7      # Mon=0 of day 1
+    return np.floor_divide(day_in_period + first_dow - 1, 7) + 1
+
+
+class TimePeriod(Enum):
+    DayOfMonth = "DayOfMonth"
+    DayOfWeek = "DayOfWeek"
+    DayOfYear = "DayOfYear"
+    HourOfDay = "HourOfDay"
+    MonthOfYear = "MonthOfYear"
+    WeekOfMonth = "WeekOfMonth"
+    WeekOfYear = "WeekOfYear"
+
+    def extract(self, millis):
+        """Vectorized extraction over an array of epoch millis."""
+        year, month, day, hour, dow, doy = _civil_from_millis(millis)
+        if self is TimePeriod.DayOfMonth:
+            return day
+        if self is TimePeriod.DayOfWeek:
+            return dow
+        if self is TimePeriod.DayOfYear:
+            return doy
+        if self is TimePeriod.HourOfDay:
+            return hour
+        if self is TimePeriod.MonthOfYear:
+            return month
+        if self is TimePeriod.WeekOfMonth:
+            return _week_fields(day, dow)
+        return _week_fields(doy, dow)                      # WeekOfYear
+
+    def extract_int(self, millis: int) -> int:
+        return int(self.extract(np.asarray([millis], np.int64))[0])
+
+
+class TimePeriodTransformer(HostTransformer):
+    """Date -> Integral period value (reference dateToTimePeriod)."""
+
+    in_types = (ft.Date,)
+    out_type = ft.Integral
+
+    def __init__(self, period="DayOfMonth", uid: Optional[str] = None):
+        self.period = (period.value if isinstance(period, TimePeriod)
+                       else str(period))
+        super().__init__(uid=uid)
+
+    def _period(self) -> TimePeriod:
+        return TimePeriod(self.period)
+
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        col = cols[0]
+        vals = np.asarray(
+            [0 if v is None else int(v) for v in col.values], np.int64)
+        out = self._period().extract(vals)
+        return fr.HostColumn.from_values(
+            ft.Integral,
+            [int(out[i]) if col.values[i] is not None else None
+             for i in range(len(col))])
+
+    def transform_row(self, value):
+        if value is None:
+            return None
+        return self._period().extract_int(int(value))
+
+
+class TimePeriodListTransformer(HostTransformer):
+    """DateList -> OPVector of per-event period values (reference
+    dateListToTimePeriod)."""
+
+    in_types = (ft.DateList,)
+    out_type = ft.OPVector
+
+    def __init__(self, period="DayOfMonth", uid: Optional[str] = None):
+        self.period = (period.value if isinstance(period, TimePeriod)
+                       else str(period))
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if not value:
+            return np.zeros(0, np.float32)
+        p = TimePeriod(self.period)
+        return p.extract(np.asarray(list(value), np.int64)).astype(np.float32)
+
+
+class TimePeriodMapTransformer(HostTransformer):
+    """DateMap -> IntegralMap of per-key period values (reference
+    dateMapToTimePeriod)."""
+
+    in_types = (ft.DateMap,)
+    out_type = ft.IntegralMap
+
+    def __init__(self, period="DayOfMonth", uid: Optional[str] = None):
+        self.period = (period.value if isinstance(period, TimePeriod)
+                       else str(period))
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if not value:
+            return {}
+        p = TimePeriod(self.period)
+        return {k: p.extract_int(int(v)) for k, v in value.items()
+                if v is not None}

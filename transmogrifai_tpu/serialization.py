@@ -60,6 +60,7 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
                     state_json[k] = v
             stages_json.append({
                 "class": type(t).__name__,
+                "module": type(t).__module__,
                 "uid": t.uid,
                 "operationName": t.operation_name,
                 "config": t.config(),
@@ -131,6 +132,14 @@ def load_model(path: str):
     dag = [[] for _ in range(n_layers)]
     for s in manifest["stages"]:
         cls = STAGE_REGISTRY.get(s["class"])
+        if cls is None and s.get("module"):
+            # registry fills on import; manifests record the defining module
+            import importlib
+            try:
+                importlib.import_module(s["module"])
+            except ImportError:
+                pass  # fall through to the actionable KeyError below
+            cls = STAGE_REGISTRY.get(s["class"])
         if cls is None:
             raise KeyError(f"Unknown stage class {s['class']!r}; import its "
                            "module before loading")

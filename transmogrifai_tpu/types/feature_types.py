@@ -634,8 +634,8 @@ class GeolocationMap(Location, OPMap):
 
 
 class NameStats(TextMap):
-    """Name-detection statistics map (reference types/NameStats.scala keys:
-    isName, originalName, gender...)."""
+    """Name-detection statistics map (reference types/Maps.scala NameStats
+    keys: isName, originalValue, gender)."""
     device_kind = "map_namestats"
 
 
