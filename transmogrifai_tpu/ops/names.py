@@ -200,6 +200,8 @@ class HumanNameDetectorModel(HostTransformer):
         if not self.treat_as_name:
             return {}
         toks = _tokens(value)
+        if not toks:
+            return {}  # a missing value is not a detected name
         gender = "GenderNA"
         for s in self.strategies:
             g = GenderDetectStrategy(s["kind"], s.get("index", 0)).detect(toks)

@@ -146,6 +146,18 @@ class TimePeriodListTransformer(HostTransformer):
         p = TimePeriod(self.period)
         return p.extract(np.asarray(list(value), np.int64)).astype(np.float32)
 
+    def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
+        # Rows have one period value per event, so widths vary (the reference
+        # emits variable-length Spark vectors). The columnar frame needs one
+        # static width: pad each row with zeros to the batch max.
+        rows = [self.transform_row(cols[0].values[i])
+                for i in range(len(cols[0]))]
+        width = max((r.shape[0] for r in rows), default=0)
+        out = np.zeros((len(rows), width), np.float32)
+        for i, r in enumerate(rows):
+            out[i, :r.shape[0]] = r
+        return fr.HostColumn(ft.OPVector, out, None)
+
 
 class TimePeriodMapTransformer(HostTransformer):
     """DateMap -> IntegralMap of per-key period values (reference

@@ -3,9 +3,17 @@ from transmogrifai_tpu.readers.csv import CSVReader, infer_csv_schema
 from transmogrifai_tpu.readers.aggregates import (
     AggregateDataReader, ConditionalDataReader,
 )
+from transmogrifai_tpu.readers.avro import (
+    AvroReader, feature_schema_of_avro, save_avro,
+)
 from transmogrifai_tpu.readers.factory import DataReaders
+from transmogrifai_tpu.readers.joined import (
+    JoinKeys, JoinedAggregateDataReader, JoinedDataReader, TimeBasedFilter,
+)
 
 __all__ = [
     "CustomReader", "DataReader", "CSVReader", "infer_csv_schema",
     "AggregateDataReader", "ConditionalDataReader", "DataReaders",
+    "JoinKeys", "JoinedDataReader", "JoinedAggregateDataReader",
+    "TimeBasedFilter", "AvroReader", "feature_schema_of_avro", "save_avro",
 ]

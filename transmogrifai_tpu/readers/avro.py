@@ -1,0 +1,123 @@
+"""Avro reader: container files -> records -> raw-feature HostFrame.
+
+Parity: reference ``readers/DataReaders.scala`` avro variants +
+``utils/io/avro/AvroInOut.scala`` + ``FeatureBuilder.fromSchema`` (Avro
+schema -> typed features). Uses the pure-Python container codec in
+``utils/avro_io`` (deflate/snappy/null).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from transmogrifai_tpu.readers.base import DataReader
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.avro_io import iter_avro, read_avro_schema
+
+__all__ = ["AvroReader", "feature_schema_of_avro", "save_avro"]
+
+
+def save_avro(frame, path: str, name: str = "Row",
+              codec: str = "deflate") -> None:
+    """Save a HostFrame as an Avro container file (reference
+    ``RichDataset.saveAvro``). The entity key, when present, is written as a
+    ``key`` column."""
+    from transmogrifai_tpu.utils.avro_io import (
+        avro_schema_of_records, plain_value, write_avro,
+    )
+    records = []
+    for i in range(frame.n_rows):
+        rec = {k: plain_value(v) for k, v in frame.row(i).items()}
+        if frame.key is not None:
+            rec.setdefault("key", str(frame.key[i]))
+        records.append(rec)
+    schema = avro_schema_of_records(records, name=name)
+    write_avro(path, schema, records, codec=codec)
+
+
+def _branch_types(t: Any) -> list:
+    """Union -> non-null branches; plain type -> [type]."""
+    if isinstance(t, list):
+        return [b for b in t if b != "null"]
+    return [t]
+
+
+def feature_schema_of_avro(avro_schema: dict) -> dict[str, type[ft.FeatureType]]:
+    """Map an Avro record schema to feature types (reference
+    ``FeatureBuilder.fromSchema``: int/long -> Integral, float/double -> Real,
+    boolean -> Binary, string/enum -> Text, map[string] -> TextMap,
+    map[numeric] -> RealMap, array[string] -> TextList)."""
+    if avro_schema.get("type") != "record":
+        raise ValueError("expected an Avro record schema")
+    out: dict[str, type[ft.FeatureType]] = {}
+    for f in avro_schema["fields"]:
+        branches = _branch_types(f["type"])
+        t = branches[0] if branches else "null"
+        name = t if isinstance(t, str) else t.get("type")
+        if name in ("int", "long"):
+            fty: type[ft.FeatureType] = ft.Integral
+        elif name in ("float", "double"):
+            fty = ft.Real
+        elif name == "boolean":
+            fty = ft.Binary
+        elif name in ("string", "enum", "bytes", "fixed"):
+            fty = ft.Text
+        elif name == "map":
+            vt = _branch_types(t["values"])
+            vname = vt[0] if isinstance(vt[0], str) else vt[0].get("type")
+            if vname in ("int", "long", "float", "double"):
+                fty = ft.RealMap
+            elif vname == "boolean":
+                fty = ft.BinaryMap
+            else:
+                fty = ft.TextMap
+        elif name == "array":
+            fty = ft.TextList
+        else:  # nested records etc. -> opaque text
+            fty = ft.Text
+        out[f["name"]] = fty
+    return out
+
+
+class AvroReader(DataReader):
+    """Reads Avro container files; one record dict per row."""
+
+    def __init__(self, path: str,
+                 schema: Optional[dict[str, type[ft.FeatureType]]] = None,
+                 key_col: Optional[str] = None):
+        super().__init__(
+            key_fn=(lambda r: str(r[key_col])) if key_col else None)
+        self.path = path
+        self._schema = schema
+        self._avro_schema: Optional[dict] = None
+
+    @property
+    def avro_schema(self) -> dict:
+        if self._avro_schema is None:
+            self._avro_schema = read_avro_schema(self.path)
+        return self._avro_schema
+
+    def schema(self) -> dict[str, type[ft.FeatureType]]:
+        """Feature-type schema: explicit if given, else inferred from the
+        file's Avro schema."""
+        if self._schema is None:
+            self._schema = feature_schema_of_avro(self.avro_schema)
+        return self._schema
+
+    def available_columns(self):
+        return set(self.schema())
+
+    def read(self) -> Iterable[dict[str, Any]]:
+        sch = self.schema()
+        for rec in iter_avro(self.path):
+            yield {k: _coerce(v, sch.get(k)) for k, v in rec.items()}
+
+
+def _coerce(v: Any, fty: Optional[type[ft.FeatureType]]) -> Any:
+    if v is None or fty is None:
+        return v
+    if fty is ft.Real and isinstance(v, int):
+        return float(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v

@@ -35,6 +35,16 @@ class DataReader:
         Lets scoring drop absent response features instead of failing."""
         return None
 
+    # -- joins (reference Reader.leftOuterJoin/innerJoin) --------------------
+    def left_outer_join(self, other: "DataReader", join_keys=None):
+        from transmogrifai_tpu.readers.joined import JoinedDataReader, JoinKeys
+        return JoinedDataReader(self, other, join_keys or JoinKeys(),
+                                "left-outer")
+
+    def inner_join(self, other: "DataReader", join_keys=None):
+        from transmogrifai_tpu.readers.joined import JoinedDataReader, JoinKeys
+        return JoinedDataReader(self, other, join_keys or JoinKeys(), "inner")
+
     # -- raw data generation -------------------------------------------------
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
         records = self.read()

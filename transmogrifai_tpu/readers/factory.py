@@ -12,6 +12,7 @@ from typing import Any, Callable, Iterable, Optional
 from transmogrifai_tpu.readers.aggregates import (
     AggregateDataReader, ConditionalDataReader,
 )
+from transmogrifai_tpu.readers.avro import AvroReader
 from transmogrifai_tpu.readers.base import CustomReader, DataReader
 from transmogrifai_tpu.readers.csv import CSVReader
 
@@ -30,6 +31,11 @@ class DataReaders:
             return CSVReader(path, schema=None, key_col=key_col, **kw)
 
         @staticmethod
+        def avro(path: str, schema=None, key_col: Optional[str] = None
+                 ) -> AvroReader:
+            return AvroReader(path, schema=schema, key_col=key_col)
+
+        @staticmethod
         def custom(records: Iterable[Any],
                    key_fn: Optional[Callable[[Any], str]] = None) -> CustomReader:
             return CustomReader(records=records, key_fn=key_fn)
@@ -40,6 +46,12 @@ class DataReaders:
                 **kw) -> AggregateDataReader:
             return AggregateDataReader(
                 CSVReader(path, schema=schema, **kw), key_fn, time_fn, cutoff_ms)
+
+        @staticmethod
+        def avro(path: str, key_fn, time_fn, cutoff_ms=None, schema=None
+                 ) -> AggregateDataReader:
+            return AggregateDataReader(
+                AvroReader(path, schema=schema), key_fn, time_fn, cutoff_ms)
 
         @staticmethod
         def custom(records: Iterable[Any], key_fn, time_fn,
@@ -53,6 +65,12 @@ class DataReaders:
                 **kw) -> ConditionalDataReader:
             return ConditionalDataReader(
                 CSVReader(path, schema=schema, **kw), key_fn, time_fn, condition_fn)
+
+        @staticmethod
+        def avro(path: str, key_fn, time_fn, condition_fn, schema=None
+                 ) -> ConditionalDataReader:
+            return ConditionalDataReader(
+                AvroReader(path, schema=schema), key_fn, time_fn, condition_fn)
 
         @staticmethod
         def custom(records: Iterable[Any], key_fn, time_fn,

@@ -1,0 +1,531 @@
+"""Pure-Python Avro Object Container File codec (read + write).
+
+Parity: reference ``utils/src/main/scala/com/salesforce/op/utils/io/avro/
+AvroInOut.scala`` (read/write Avro datasets) and ``RichDataset.saveAvro``.
+The environment ships no avro library, so this implements the Avro 1.x
+binary spec directly: zigzag-varint longs, little-endian float/double,
+length-prefixed bytes/strings, records/arrays/maps/unions/enums/fixed, and
+container files with ``null`` or ``deflate`` codecs.
+
+Supports the schema subset TransmogrifAI uses (GenericRecord rows of
+primitive/union[null,...] fields plus nested arrays/maps/records), which is
+also everything our ``HostFrame`` ingest needs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import Any, Iterable, Iterator, Optional
+
+__all__ = ["read_avro", "iter_avro", "write_avro", "avro_schema_of_records"]
+
+_MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# Binary primitives
+# ---------------------------------------------------------------------------
+
+def _read_long(buf: io.BufferedIOBase) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("unexpected EOF in varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # zigzag decode
+
+
+def _write_long(out: io.BufferedIOBase, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag encode
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes((b | 0x80,)))
+        else:
+            out.write(bytes((b,)))
+            break
+
+
+def _read_bytes(buf: io.BufferedIOBase) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("unexpected EOF in bytes")
+    return data
+
+
+def _write_bytes(out: io.BufferedIOBase, data: bytes) -> None:
+    _write_long(out, len(data))
+    out.write(data)
+
+
+# ---------------------------------------------------------------------------
+# Schema-driven datum codec
+# ---------------------------------------------------------------------------
+
+def _norm_schema(schema: Any, named: dict[str, Any]) -> Any:
+    """Resolve named-type references and normalize {"type": "x"} wrappers."""
+    if isinstance(schema, str):
+        return named.get(schema, schema)
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed") and "name" in schema:
+            named[schema["name"]] = schema
+            ns = schema.get("namespace")
+            if ns:
+                named[f"{ns}.{schema['name']}"] = schema
+        return schema
+    return schema
+
+
+def _read_datum(buf: io.BufferedIOBase, schema: Any, named: dict[str, Any]) -> Any:
+    schema = _norm_schema(schema, named)
+    if isinstance(schema, list):  # union
+        idx = _read_long(buf)
+        return _read_datum(buf, schema[idx], named)
+    t = schema if isinstance(schema, str) else schema["type"]
+    if t == "null":
+        return None
+    if t == "boolean":
+        b = buf.read(1)
+        return b[0] != 0
+    if t in ("int", "long"):
+        return _read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return _read_bytes(buf)
+    if t == "string":
+        return _read_bytes(buf).decode("utf-8")
+    if t == "record":
+        return {f["name"]: _read_datum(buf, f["type"], named)
+                for f in schema["fields"]}
+    if t == "enum":
+        return schema["symbols"][_read_long(buf)]
+    if t == "fixed":
+        return buf.read(schema["size"])
+    if t == "array":
+        out = []
+        while True:
+            n = _read_long(buf)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(buf)  # block byte size, unused
+                n = -n
+            for _ in range(n):
+                out.append(_read_datum(buf, schema["items"], named))
+        return out
+    if t == "map":
+        out = {}
+        while True:
+            n = _read_long(buf)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(buf)
+                n = -n
+            for _ in range(n):
+                k = _read_bytes(buf).decode("utf-8")
+                out[k] = _read_datum(buf, schema["values"], named)
+        return out
+    raise ValueError(f"unsupported Avro type: {t!r}")
+
+
+def _union_branch(schema: list, value: Any) -> int:
+    """Pick the union branch for a python value (null-vs-one-type unions and
+    simple primitive discrimination — the shapes TransmogrifAI writes)."""
+    for i, s in enumerate(schema):
+        t = s if isinstance(s, str) else s.get("type")
+        if value is None and t == "null":
+            return i
+        if value is not None and t != "null":
+            if isinstance(value, bool) and t == "boolean":
+                return i
+            if isinstance(value, bool):
+                continue
+            if isinstance(value, int) and t in ("int", "long"):
+                return i
+            if isinstance(value, float) and t in ("float", "double"):
+                return i
+            if isinstance(value, str) and t in ("string", "enum"):
+                return i
+            if isinstance(value, bytes) and t in ("bytes", "fixed"):
+                return i
+            if isinstance(value, dict) and t in ("record", "map"):
+                return i
+            if isinstance(value, (list, tuple)) and t == "array":
+                return i
+    # fallback: first non-null branch for non-null values
+    for i, s in enumerate(schema):
+        t = s if isinstance(s, str) else s.get("type")
+        if (t == "null") == (value is None):
+            return i
+    raise ValueError(f"no union branch for {value!r} in {schema}")
+
+
+def _write_datum(out: io.BufferedIOBase, schema: Any, value: Any,
+                 named: dict[str, Any]) -> None:
+    schema = _norm_schema(schema, named)
+    if isinstance(schema, list):
+        idx = _union_branch(schema, value)
+        _write_long(out, idx)
+        _write_datum(out, schema[idx], value, named)
+        return
+    t = schema if isinstance(schema, str) else schema["type"]
+    if t == "null":
+        return
+    if t == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+    elif t in ("int", "long"):
+        _write_long(out, int(value))
+    elif t == "float":
+        out.write(struct.pack("<f", float(value)))
+    elif t == "double":
+        out.write(struct.pack("<d", float(value)))
+    elif t == "bytes":
+        _write_bytes(out, bytes(value))
+    elif t == "string":
+        _write_bytes(out, str(value).encode("utf-8"))
+    elif t == "record":
+        for f in schema["fields"]:
+            _write_datum(out, f["type"], value.get(f["name"]), named)
+    elif t == "enum":
+        _write_long(out, schema["symbols"].index(value))
+    elif t == "fixed":
+        out.write(bytes(value))
+    elif t == "array":
+        if value:
+            _write_long(out, len(value))
+            for v in value:
+                _write_datum(out, schema["items"], v, named)
+        _write_long(out, 0)
+    elif t == "map":
+        if value:
+            _write_long(out, len(value))
+            for k, v in value.items():
+                _write_bytes(out, str(k).encode("utf-8"))
+                _write_datum(out, schema["values"], v, named)
+        _write_long(out, 0)
+    else:
+        raise ValueError(f"unsupported Avro type: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Snappy block format (no python-snappy in the image; the format is simple:
+# varint uncompressed length + literal/copy tagged elements). Avro frames
+# snappy blocks with a trailing big-endian CRC32 of the uncompressed data.
+# ---------------------------------------------------------------------------
+
+def _snappy_decompress(data: bytes) -> bytes:
+    pos = 0
+    # preamble: little-endian varint of uncompressed length
+    ulen = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        ulen |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            n = tag >> 2
+            if n >= 60:
+                extra = n - 59
+                n = int.from_bytes(data[pos:pos + extra], "little")
+                pos += extra
+            n += 1
+            out += data[pos:pos + n]
+            pos += n
+        else:  # copy
+            if kind == 1:
+                n = ((tag >> 2) & 0x7) + 4
+                off = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:
+                n = (tag >> 2) + 1
+                off = int.from_bytes(data[pos:pos + 2], "little")
+                pos += 2
+            else:
+                n = (tag >> 2) + 1
+                off = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+            if off == 0:
+                raise ValueError("snappy: zero copy offset")
+            start = len(out) - off
+            for i in range(n):  # may self-overlap; byte-wise per spec
+                out.append(out[start + i])
+    if len(out) != ulen:
+        raise ValueError(f"snappy: length mismatch {len(out)} != {ulen}")
+    return bytes(out)
+
+
+def _snappy_compress(data: bytes) -> bytes:
+    """All-literal snappy encoding (valid per spec, no matching)."""
+    out = bytearray()
+    n = len(data)
+    v = n
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | 0x80 if v else b)
+        if not v:
+            break
+    pos = 0
+    while pos < n:
+        chunk = min(n - pos, 0x10000)  # literal length fits in 2 extra bytes
+        if chunk <= 60:
+            out.append((chunk - 1) << 2)
+        else:
+            out.append(61 << 2)  # literal with 2-byte little-endian length
+            out += (chunk - 1).to_bytes(2, "little")
+        out += data[pos:pos + chunk]
+        pos += chunk
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Container files
+# ---------------------------------------------------------------------------
+
+def iter_avro(path: str) -> Iterator[dict]:
+    """Stream records from an Avro container file."""
+    with open(path, "rb") as f:
+        if f.read(4) != _MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta: dict[str, bytes] = {}
+        while True:
+            n = _read_long(f)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(f)
+                n = -n
+            for _ in range(n):
+                k = _read_bytes(f).decode("utf-8")
+                meta[k] = _read_bytes(f)
+        schema = json.loads(meta["avro.schema"].decode("utf-8"))
+        codec = meta.get("avro.codec", b"null").decode("utf-8")
+        if codec not in ("null", "deflate", "snappy"):
+            raise ValueError(f"unsupported Avro codec {codec!r}")
+        sync = f.read(16)
+        named: dict[str, Any] = {}
+        while True:
+            first = f.read(1)
+            if not first:
+                return
+            f.seek(-1, 1)
+            count = _read_long(f)
+            size = _read_long(f)
+            block = f.read(size)
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            elif codec == "snappy":
+                body, crc = block[:-4], block[-4:]
+                block = _snappy_decompress(body)
+                if zlib.crc32(block) != int.from_bytes(crc, "big"):
+                    raise ValueError(f"{path}: snappy block CRC mismatch")
+            buf = io.BytesIO(block)
+            for _ in range(count):
+                yield _read_datum(buf, schema, named)
+            if f.read(16) != sync:
+                raise ValueError(f"{path}: sync marker mismatch")
+
+
+def read_avro_schema(path: str) -> dict:
+    """Read only the schema from an Avro container file's header."""
+    with open(path, "rb") as f:
+        if f.read(4) != _MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        while True:
+            n = _read_long(f)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(f)
+                n = -n
+            for _ in range(n):
+                k = _read_bytes(f).decode("utf-8")
+                v = _read_bytes(f)
+                if k == "avro.schema":
+                    return json.loads(v.decode("utf-8"))
+    raise ValueError(f"{path}: no avro.schema in header")
+
+
+def read_avro(path: str) -> tuple[dict, list[dict]]:
+    """Read an Avro container file -> (schema, records)."""
+    with open(path, "rb") as f:
+        if f.read(4) != _MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta: dict[str, bytes] = {}
+        while True:
+            n = _read_long(f)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(f)
+                n = -n
+            for _ in range(n):
+                k = _read_bytes(f).decode("utf-8")
+                meta[k] = _read_bytes(f)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    return schema, list(iter_avro(path))
+
+
+def write_avro(path: str, schema: dict, records: Iterable[dict],
+               codec: str = "deflate", sync_interval: int = 4000) -> None:
+    """Write records to an Avro container file."""
+    if codec not in ("null", "deflate", "snappy"):
+        raise ValueError(f"unsupported Avro codec {codec!r}")
+    # deterministic sync marker from the schema (no RNG needed)
+    sync = zlib.crc32(json.dumps(schema, sort_keys=True).encode("utf-8"))
+    sync_marker = struct.pack("<IIII", sync, ~sync & 0xFFFFFFFF,
+                              sync ^ 0xA5A5A5A5, sync ^ 0x5A5A5A5A)
+    named: dict[str, Any] = {}
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        meta = {"avro.schema": json.dumps(schema).encode("utf-8"),
+                "avro.codec": codec.encode("utf-8")}
+        _write_long(f, len(meta))
+        for k, v in meta.items():
+            _write_bytes(f, k.encode("utf-8"))
+            _write_bytes(f, v)
+        _write_long(f, 0)
+        f.write(sync_marker)
+
+        block = io.BytesIO()
+        count = 0
+
+        def flush():
+            nonlocal count
+            if count == 0:
+                return
+            data = block.getvalue()
+            if codec == "deflate":
+                c = zlib.compressobj(wbits=-15)
+                data = c.compress(data) + c.flush()
+            elif codec == "snappy":
+                data = (_snappy_compress(data)
+                        + zlib.crc32(data).to_bytes(4, "big"))
+            _write_long(f, count)
+            _write_long(f, len(data))
+            f.write(data)
+            f.write(sync_marker)
+            block.seek(0)
+            block.truncate()
+            count = 0
+
+        for rec in records:
+            _write_datum(block, schema, rec, named)
+            count += 1
+            if count >= sync_interval:
+                flush()
+        flush()
+
+
+def avro_schema_of_records(records: list[dict], name: str = "Row",
+                           namespace: str = "transmogrifai_tpu") -> dict:
+    """Infer a union[null, T] record schema from python dict records
+    (the shape ``saveAvro`` needs for score/frame output). Handles scalars,
+    numeric/string maps and arrays; anything else stringifies."""
+    fields: dict[str, set] = {}
+    for rec in records:
+        for k, v in rec.items():
+            fields.setdefault(k, set()).add(json.dumps(_avro_type_of(v)))
+    out_fields = []
+    for k, types in fields.items():
+        types.discard('"null"')
+        loaded = [json.loads(t) for t in sorted(types)]
+        if not loaded:
+            t: Any = ["null", "string"]
+        elif len(loaded) == 1:
+            t = ["null", loaded[0]]
+        elif all(isinstance(x, str) for x in loaded) and \
+                set(loaded) <= {"int", "long", "double"}:
+            t = ["null", "double"]
+        elif all(isinstance(x, dict) and x.get("type") == "array"
+                 for x in loaded):
+            items = {json.dumps(x["items"]) for x in loaded}
+            merged = ("double" if items <= {'"double"', '"long"'}
+                      else "string")
+            t = ["null", {"type": "array", "items": merged}]
+        elif all(isinstance(x, dict) and x.get("type") == "map"
+                 for x in loaded):
+            vals = {json.dumps(x["values"]) for x in loaded}
+            merged_v = (["null", "double"]
+                        if vals <= {'["null", "double"]'} else
+                        ["null", "string"])
+            t = ["null", {"type": "map", "values": merged_v}]
+        else:
+            t = ["null", "string"]
+        out_fields.append({"name": k, "type": t})
+    return {"type": "record", "name": name, "namespace": namespace,
+            "fields": out_fields}
+
+
+def _avro_type_of(v: Any) -> Any:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, int):
+        return "long"
+    if isinstance(v, float):
+        return "double"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, dict):
+        vals = set()
+        for x in v.values():
+            t = _avro_type_of(x)
+            vals.add(t if isinstance(t, str) else "string")
+        if vals <= {"long", "double", "null"}:
+            return {"type": "map", "values": ["null", "double"]}
+        if vals <= {"boolean", "null"}:
+            return {"type": "map", "values": ["null", "boolean"]}
+        return {"type": "map", "values": ["null", "string"]}
+    if isinstance(v, (list, tuple)) or type(v).__name__ == "ndarray":
+        items = set()
+        for x in v:
+            t = _avro_type_of(x)
+            items.add(t if isinstance(t, str) else "string")
+        if items <= {"long", "double", "null"}:
+            return {"type": "array", "items": "double"}
+        return {"type": "array", "items": "string"}
+    return "string"
+
+
+def plain_value(v: Any) -> Any:
+    """Coerce numpy scalars/arrays/sets into Avro-encodable python values."""
+    tname = type(v).__name__
+    if tname in ("float32", "float64"):
+        return float(v)
+    if tname in ("int32", "int64", "bool_"):
+        return bool(v) if tname == "bool_" else int(v)
+    if tname == "ndarray":
+        return [plain_value(x) for x in v.tolist()]
+    if isinstance(v, (set, frozenset, tuple)):
+        return [plain_value(x) for x in v]
+    if isinstance(v, list):
+        return [plain_value(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): plain_value(x) for k, x in v.items()}
+    return v
