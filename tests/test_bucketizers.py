@@ -1,0 +1,201 @@
+"""Bucketizer + indexer tests (parity: reference NumericBucketizerTest,
+DecisionTreeNumericBucketizerTest, PercentileCalibratorTest,
+OpStringIndexerTest suites — hand-computed expectations)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401 — installs DSL methods
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import DagExecutor, compute_dag
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.ops.indexers import (
+    MultiLabelJoiner, OpIndexToString, OpIndexToStringNoFilter,
+    OpStringIndexer, OpStringIndexerNoFilter, TextListNullTransformer,
+    TopNLabelJoiner,
+)
+from transmogrifai_tpu.ops.vectorizers.bucketizers import (
+    DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
+    NumericBucketizer, PercentileCalibrator, find_tree_splits,
+)
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _run(host, result_feature):
+    data = PipelineData.from_host(host)
+    dag = compute_dag([result_feature])
+    out_data, fitted = DagExecutor().fit_transform(data, dag)
+    return out_data, fitted
+
+
+def test_numeric_bucketizer_hand_computed():
+    host = fr.HostFrame.from_dict({
+        "x": (ft.Real, [-1.0, 0.5, 3.0, None]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["x"].transform_with(
+        NumericBucketizer(splits=[float("-inf"), 0.0, 1.0, float("inf")]))
+    data, _ = _run(host, out)
+    vec = np.asarray(data.device_col(out.name).values)
+    # 3 buckets + null indicator
+    np.testing.assert_allclose(vec, [
+        [1, 0, 0, 0],
+        [0, 1, 0, 0],
+        [0, 0, 1, 0],
+        [0, 0, 0, 1],
+    ])
+    meta = data.device_col(out.name).metadata
+    assert meta.size == 4
+    assert meta.columns[-1].is_null_indicator
+
+
+def test_numeric_bucketizer_invalid_tracking_and_row_parity():
+    b = NumericBucketizer(splits=[0.0, 1.0, 2.0], track_invalid=True)
+    np.testing.assert_allclose(b.transform_row(0.5), [1, 0, 0, 0])
+    np.testing.assert_allclose(b.transform_row(5.0), [0, 0, 1, 0])  # invalid
+    np.testing.assert_allclose(b.transform_row(None), [0, 0, 0, 1])
+    with pytest.raises(ValueError):
+        NumericBucketizer(splits=[1.0, 1.0])
+
+
+def test_find_tree_splits_recovers_step():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, size=500)
+    y = (x > 0.25).astype(np.float64)  # single clean threshold
+    splits = find_tree_splits(x, y, max_depth=2)
+    assert len(splits) >= 1
+    assert any(abs(s - 0.25) < 0.2 for s in splits)
+
+
+def test_decision_tree_bucketizer_splits_informative_feature():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, size=400)
+    y = (x > 0.5).astype(np.float64)
+    host = fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "x": (ft.Real, x.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    out = feats["label"].transform_with(
+        DecisionTreeNumericBucketizer(), feats["x"])
+    data, fitted = _run(host, out)
+    vec = np.asarray(data.device_col(out.name).values)
+    assert vec.shape[1] >= 3  # >=2 buckets + null col
+    # every present row falls in exactly one bucket
+    np.testing.assert_allclose(vec.sum(axis=1), 1.0)
+    # row path == columnar path (scoring omits the label input)
+    model = [s for layer in fitted for s in layer if type(s).__name__ == "_TreeBucketizerModel"][0]
+    row = model.transform_row(float(x[0]))
+    np.testing.assert_allclose(row, vec[0])
+
+
+def test_decision_tree_bucketizer_no_split_on_noise():
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0, 1, size=300)
+    y = rng.integers(0, 2, size=300).astype(np.float64)  # independent label
+    host = fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "x": (ft.Real, x.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    out = feats["label"].transform_with(
+        DecisionTreeNumericBucketizer(min_info_gain=0.05), feats["x"])
+    data, _ = _run(host, out)
+    vec = np.asarray(data.device_col(out.name).values)
+    assert vec.shape[1] == 1  # null indicator only: shouldSplit=false
+
+
+def test_decision_tree_map_bucketizer():
+    rng = np.random.default_rng(3)
+    n = 300
+    a = rng.uniform(0, 1, size=n)
+    y = (a > 0.4).astype(np.float64)
+    maps = [{"a": float(a[i]), "b": float(rng.uniform())} for i in range(n)]
+    host = fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "m": (ft.RealMap, maps),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    out = feats["label"].transform_with(
+        DecisionTreeNumericMapBucketizer(min_info_gain=0.05), feats["m"])
+    data, _ = _run(host, out)
+    col = data.host_col(out.name)
+    meta = col.meta
+    groups = {c.grouping for c in meta.columns}
+    assert groups == {"a", "b"}
+    # 'a' splits (buckets+null), 'b' does not (null only)
+    a_cols = [c for c in meta.columns if c.grouping == "a"]
+    b_cols = [c for c in meta.columns if c.grouping == "b"]
+    assert len(a_cols) >= 3 and len(b_cols) == 1
+
+
+def test_percentile_calibrator():
+    vals = list(np.arange(100, dtype=np.float64))
+    host = fr.HostFrame.from_dict({"x": (ft.Real, vals)})
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["x"].to_percentile()
+    data, fitted = _run(host, out)
+    res = np.asarray(data.device_col(out.name).values)
+    assert res.min() == 0.0 and res.max() == 99.0
+    assert np.all(np.diff(res) >= 0)  # monotone
+    model = [s for layer in fitted for s in layer if type(s).__name__ == "_PercentileModel"][0]
+    assert model.transform_row(0.0) == 0.0
+    assert model.transform_row(99.0) == 99.0
+
+
+def test_string_indexer_round_trip():
+    host = fr.HostFrame.from_dict({
+        "s": (ft.Text, ["b", "a", "b", None, "c", "b", "a"]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["s"].index_string()  # no_filter default
+    data, fitted = _run(host, out)
+    idx = np.asarray(data.device_col(out.name).values)
+    model = [s for layer in fitted for s in layer if type(s).__name__ == "StringIndexerModel"][0]
+    # b(3) first, then a(2), then c(1), null(1) -> "null"
+    assert model.labels[0] == "b" and model.labels[1] == "a"
+    assert model.transform_row("zzz") == float(len(model.labels))  # unseen
+    # round trip through IndexToString
+    inv = OpIndexToStringNoFilter(labels=model.labels)
+    assert inv.transform_row(idx[0]) == "b"
+    assert inv.transform_row(999.0) == "UnseenIndex"
+
+
+def test_string_indexer_error_mode():
+    host = fr.HostFrame.from_dict({
+        "s": (ft.Text, ["x", "y", "x"]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["s"].transform_with(OpStringIndexer())
+    data, fitted = _run(host, out)
+    model = [s for layer in fitted for s in layer if type(s).__name__ == "StringIndexerModel"][0]
+    assert model.transform_row("x") == 0.0
+    with pytest.raises(ValueError):
+        model.transform_row("unseen-value")
+    inv = OpIndexToString(labels=model.labels)
+    with pytest.raises(ValueError):
+        inv.transform_row(7.0)
+
+
+def test_multi_label_joiner_and_top_n():
+    j = MultiLabelJoiner(labels=["cat", "dog", "fish"])
+    res = j.transform_row(None, np.asarray([0.2, 0.5, 0.3]))
+    assert res == {"cat": 0.2, "dog": 0.5, "fish": 0.3}
+    top = TopNLabelJoiner(labels=["cat", "dog", "UnseenLabel"], top_n=1)
+    res2 = top.transform_row(None, np.asarray([0.1, 0.3, 0.6]))
+    assert res2 == {"dog": 0.3}  # UnseenLabel filtered before topN
+
+
+def test_text_list_null_transformer():
+    host = fr.HostFrame.from_dict({
+        "t1": (ft.TextList, [["a"], [], ["b", "c"]]),
+        "t2": (ft.TextList, [[], ["x"], None]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["t1"].transform_with(TextListNullTransformer(), feats["t2"])
+    data, _ = _run(host, out)
+    col = data.host_col(out.name)
+    np.testing.assert_allclose(
+        col.values, [[0, 1], [1, 0], [0, 1]])
+    assert all(c.is_null_indicator for c in col.meta.columns)

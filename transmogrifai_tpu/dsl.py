@@ -134,6 +134,38 @@ def _detect_human_names(self, **kw):
     return self.transform_with(HumanNameDetector(**kw))
 
 
+def _bucketize(self, splits, track_nulls: bool = True,
+               track_invalid: bool = False, labels=None):
+    from transmogrifai_tpu.ops.vectorizers.bucketizers import NumericBucketizer
+    return self.transform_with(NumericBucketizer(
+        splits=splits, track_nulls=track_nulls, track_invalid=track_invalid,
+        labels=labels))
+
+
+def _auto_bucketize(self, label, **kw):
+    """feature.auto_bucketize(label) — label-aware decision-tree buckets."""
+    from transmogrifai_tpu.ops.vectorizers.bucketizers import (
+        DecisionTreeNumericBucketizer,
+    )
+    return label.transform_with(DecisionTreeNumericBucketizer(**kw), self)
+
+
+def _to_percentile(self, buckets: int = 100):
+    from transmogrifai_tpu.ops.vectorizers.bucketizers import (
+        PercentileCalibrator,
+    )
+    return self.transform_with(PercentileCalibrator(
+        expected_num_buckets=buckets))
+
+
+def _index_string(self, no_filter: bool = True, **kw):
+    from transmogrifai_tpu.ops.indexers import (
+        OpStringIndexer, OpStringIndexerNoFilter,
+    )
+    stage = OpStringIndexerNoFilter(**kw) if no_filter else OpStringIndexer(**kw)
+    return self.transform_with(stage)
+
+
 def transmogrify_features(features: Sequence[FeatureLike], **kw) -> FeatureLike:
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     return transmogrify(list(features), **kw)
@@ -167,6 +199,10 @@ def install() -> None:
     F.to_time_period = _to_time_period
     F.name_entity_tagger = _name_entity_tagger
     F.detect_human_names = _detect_human_names
+    F.bucketize = _bucketize
+    F.auto_bucketize = _auto_bucketize
+    F.to_percentile = _to_percentile
+    F.index_string = _index_string
 
 
 install()

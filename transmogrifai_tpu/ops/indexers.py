@@ -1,0 +1,279 @@
+"""Label indexing and label/probability joining stages.
+
+Parity: reference ``core/.../stages/impl/feature/{OpStringIndexer,
+OpStringIndexerNoFilter, OpIndexToString, OpIndexToStringNoFilter,
+MultiLabelJoiner, TextListNullTransformer}.scala`` — string label <-> index
+round-trips for multiclass labels, joining class probabilities back to label
+strings, and null-tracking for text lists.
+
+These are thin host-side stages (string-shaped, fit once); the heavy
+numeric consumers downstream stay on device.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import Estimator, HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata, parent_of,
+)
+
+__all__ = [
+    "OpStringIndexer", "OpStringIndexerNoFilter", "StringIndexerModel",
+    "OpIndexToString", "OpIndexToStringNoFilter",
+    "MultiLabelJoiner", "TopNLabelJoiner", "TopNLabelProbMap",
+    "TextListNullTransformer", "UNSEEN_LABEL", "UNSEEN_INDEX",
+]
+
+UNSEEN_LABEL = "UnseenLabel"
+UNSEEN_INDEX = "UnseenIndex"
+
+
+def _labels_by_count(values, skip_null: bool) -> list[Optional[str]]:
+    """Labels most-frequent-first (ties lexicographic, nulls last)."""
+    counts = Counter(values)
+    return [lb for lb, _ in sorted(
+        counts.items(),
+        key=lambda kv: (-kv[1], kv[0] is None, kv[0] or ""))
+        if not (skip_null and lb is None)]
+
+
+class OpStringIndexer(Estimator):
+    """Text labels -> label indices ordered by descending frequency.
+
+    ``handle_invalid``: "error" raises on unseen values at score time;
+    "skip" maps them to missing (the Spark StringIndexer analog of dropping
+    the row).
+    """
+
+    in_types = (ft.Text,)
+    out_type = ft.RealNN
+
+    def __init__(self, handle_invalid: str = "error",
+                 uid: Optional[str] = None):
+        if handle_invalid not in ("error", "skip"):
+            raise ValueError("handle_invalid must be 'error' or 'skip'")
+        self.handle_invalid = handle_invalid
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        col = data.host_col(self.input_names[0])
+        vals = [col.python_value(i) for i in range(len(col))]
+        labels = [lb for lb in _labels_by_count(vals, skip_null=True)]
+        return StringIndexerModel(labels=labels,
+                                  handle_invalid=self.handle_invalid)
+
+
+class OpStringIndexerNoFilter(Estimator):
+    """Indexer that never fails: unseen/new values map to the extra
+    ``unseen_name`` slot at index ``len(labels)`` (reference
+    ``OpStringIndexerNoFilter.scala:54-70``); nulls are indexed as "null"."""
+
+    in_types = (ft.Text,)
+    out_type = ft.RealNN
+
+    def __init__(self, unseen_name: str = UNSEEN_LABEL,
+                 uid: Optional[str] = None):
+        self.unseen_name = unseen_name
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        col = data.host_col(self.input_names[0])
+        vals = [col.python_value(i) for i in range(len(col))]
+        labels = ["null" if lb is None else lb
+                  for lb in _labels_by_count(vals, skip_null=False)]
+        return StringIndexerModel(labels=labels, handle_invalid="unseen",
+                                  unseen_name=self.unseen_name)
+
+
+class StringIndexerModel(HostTransformer):
+    in_types = (ft.Text,)
+    out_type = ft.RealNN
+
+    def __init__(self, labels: Sequence[str] = (),
+                 handle_invalid: str = "error",
+                 unseen_name: str = UNSEEN_LABEL,
+                 uid: Optional[str] = None):
+        self.labels = list(labels)
+        self.handle_invalid = handle_invalid
+        self.unseen_name = unseen_name
+        self._index = {lb: i for i, lb in enumerate(self.labels)}
+        super().__init__(uid=uid)
+
+    @property
+    def all_labels(self) -> list[str]:
+        """Labels incl. the unseen slot when present (for joiners)."""
+        if self.handle_invalid == "unseen":
+            return self.labels + [self.unseen_name]
+        return self.labels
+
+    def transform_row(self, value):
+        key = "null" if (value is None and self.handle_invalid == "unseen"
+                         ) else value
+        if key in self._index:
+            return float(self._index[key])
+        if self.handle_invalid == "unseen":
+            return float(len(self.labels))
+        if self.handle_invalid == "skip" or value is None:
+            return None
+        raise ValueError(
+            f"{self}: unseen label {value!r} (handle_invalid='error')")
+
+    def fitted_state(self):
+        return {"labels": list(self.labels)}  # strings ride the JSON side
+
+    def set_fitted_state(self, state):
+        self.labels = [str(x) for x in state["labels"]]
+        self._index = {lb: i for i, lb in enumerate(self.labels)}
+
+    def config(self):
+        return {"handle_invalid": self.handle_invalid,
+                "unseen_name": self.unseen_name}
+
+
+class OpIndexToString(HostTransformer):
+    """Label indices -> label strings from a user-supplied labels array.
+
+    Out-of-range indices raise; use ``OpIndexToStringNoFilter`` to map them
+    to ``unseen_name`` instead.
+    """
+
+    in_types = (ft.RealNN,)
+    out_type = ft.Text
+
+    def __init__(self, labels: Sequence[str] = (), uid: Optional[str] = None):
+        self.labels = list(labels)
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None:
+            return None
+        i = int(value)
+        if 0 <= i < len(self.labels):
+            return self.labels[i]
+        return self._out_of_range(i)
+
+    def _out_of_range(self, i: int):
+        raise ValueError(f"{self}: index {i} outside labels array "
+                         f"(size {len(self.labels)})")
+
+    def config(self):
+        return {"labels": self.labels}
+
+
+class OpIndexToStringNoFilter(OpIndexToString):
+    def __init__(self, labels: Sequence[str] = (),
+                 unseen_name: str = UNSEEN_INDEX, uid: Optional[str] = None):
+        self.unseen_name = unseen_name
+        super().__init__(labels=labels, uid=uid)
+
+    def _out_of_range(self, i: int):
+        return self.unseen_name
+
+    def config(self):
+        return {"labels": self.labels, "unseen_name": self.unseen_name}
+
+
+class MultiLabelJoiner(HostTransformer):
+    """(indexed label, class-probability vector) -> {label: probability}.
+
+    Parity: reference ``MultiLabelJoiner.scala:44-59`` (labels come from the
+    indexer's metadata there; passed explicitly or wired from a
+    ``StringIndexerModel`` here).
+    """
+
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.RealMap
+
+    def __init__(self, labels: Sequence[str] = (), uid: Optional[str] = None):
+        self.labels = list(labels)
+        super().__init__(uid=uid)
+
+    @classmethod
+    def from_indexer(cls, indexer: StringIndexerModel) -> "MultiLabelJoiner":
+        return cls(labels=indexer.all_labels)
+
+    def runtime_input_names(self):
+        return (self.input_names[1],)
+
+    def transform_row(self, *values):
+        probs = values[-1]
+        if probs is None:
+            return {}
+        arr = np.asarray(probs, np.float64).ravel()
+        return {lb: float(p) for lb, p in zip(self.labels, arr)}
+
+    def config(self):
+        return {"labels": self.labels}
+
+
+def top_n_of(label_prob: dict, top_n: int) -> dict:
+    pairs = sorted(label_prob.items(), key=lambda kv: (-kv[1], kv[0]))
+    return dict(pairs[:top_n])
+
+
+class TopNLabelJoiner(MultiLabelJoiner):
+    """MultiLabelJoiner keeping only the topN classes by probability and
+    dropping the UnseenLabel class (reference ``TopNLabelJoiner``)."""
+
+    def __init__(self, labels: Sequence[str] = (), top_n: int = 3,
+                 uid: Optional[str] = None):
+        self.top_n = top_n
+        super().__init__(labels=labels, uid=uid)
+
+    def transform_row(self, *values):
+        full = super().transform_row(*values)
+        full.pop(UNSEEN_LABEL, None)
+        return top_n_of(full, self.top_n)
+
+    def config(self):
+        return {"labels": self.labels, "top_n": self.top_n}
+
+
+class TopNLabelProbMap(HostTransformer):
+    """RealMap of label->prob -> its topN entries (reference
+    ``TopNLabelProbMap``)."""
+
+    in_types = (ft.RealMap,)
+    out_type = ft.RealMap
+
+    def __init__(self, top_n: int = 3, uid: Optional[str] = None):
+        self.top_n = top_n
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        return top_n_of(value or {}, self.top_n)
+
+
+class TextListNullTransformer(HostTransformer):
+    """N TextList inputs -> vector of empty/null indicators (reference
+    ``TextListNullTransformer.scala:48-68``)."""
+
+    variadic = True
+    in_types = (ft.TextList,)
+    out_type = ft.OPVector
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, *values):
+        return np.asarray([1.0 if not v else 0.0 for v in values],
+                          np.float32)
+
+    def host_apply(self, *cols: fr.HostColumn):
+        rows = np.stack([self.transform_row(
+            *(c.python_value(i) for c in cols))
+            for i in range(len(cols[0]))]) if len(cols[0]) else np.zeros(
+            (0, len(cols)), np.float32)
+        name = self.get_output().name
+        meta = VectorMetadata(name, tuple(
+            VectorColumnMetadata(*parent_of(f), grouping=f.name,
+                                 indicator_value=NULL_INDICATOR)
+            for f in self.input_features)).reindexed(0)
+        return fr.HostColumn(ft.OPVector, rows.astype(np.float32), meta=meta)

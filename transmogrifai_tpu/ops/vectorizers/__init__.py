@@ -6,10 +6,16 @@ from transmogrifai_tpu.ops.vectorizers.onehot import (
 )
 from transmogrifai_tpu.ops.vectorizers.hashing import TextHashingVectorizer
 from transmogrifai_tpu.ops.vectorizers.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.vectorizers.bucketizers import (
+    DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
+    NumericBucketizer, PercentileCalibrator,
+)
 from transmogrifai_tpu.ops.combiner import VectorsCombiner
 
 __all__ = [
     "BinaryVectorizer", "IntegralVectorizer", "RealVectorizer",
     "OneHotVectorizer", "SetVectorizer", "TextHashingVectorizer",
     "DateToUnitCircleVectorizer", "VectorsCombiner",
+    "NumericBucketizer", "DecisionTreeNumericBucketizer",
+    "DecisionTreeNumericMapBucketizer", "PercentileCalibrator",
 ]

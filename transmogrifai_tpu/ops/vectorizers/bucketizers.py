@@ -1,0 +1,546 @@
+"""Numeric bucketizers: fixed-split, decision-tree-driven, and percentile.
+
+Parity: reference ``core/.../stages/impl/feature/NumericBucketizer.scala``
+(fixed splits -> one-hot bucket block with optional invalid/null tracking),
+``DecisionTreeNumericBucketizer.scala`` (fits a single-feature decision tree
+against the label; the tree's thresholds become the splits; no informative
+split -> passthrough empty block), ``DecisionTreeNumericMapBucketizer.scala``
+(same per map key) and ``PercentileCalibrator.scala`` (empirical quantile
+mapping onto [0, buckets-1]).
+
+TPU-first: bucketization at transform time is a ``searchsorted`` + one-hot
+gather fused into the layer program (MXU-friendly one-hot matmul consumers);
+the split *search* at fit time is a host-side exact scan over quantile
+candidates — fitting happens once, scoring is the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import (
+    AllowLabelAsInput, DeviceTransformer, Estimator, HostTransformer,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata, parent_of,
+)
+
+__all__ = [
+    "NumericBucketizer", "DecisionTreeNumericBucketizer",
+    "DecisionTreeNumericMapBucketizer", "PercentileCalibrator",
+]
+
+#: indicator for values outside the split range (reference trackInvalid)
+INVALID_INDICATOR = "InvalidIndicatorValue"
+
+
+def bucket_labels(splits: Sequence[float]) -> list[str]:
+    """Human-readable interval labels "lo-hi" per bucket."""
+    def s(x: float) -> str:
+        if np.isneginf(x):
+            return "-Inf"
+        if np.isposinf(x):
+            return "Inf"
+        return f"{x:.6g}"
+    return [f"{s(a)}-{s(b)}" for a, b in zip(splits[:-1], splits[1:])]
+
+
+def _bucket_meta(out_name, feature, labels: Sequence[str], track_invalid: bool,
+                 track_nulls: bool, grouping: Optional[str] = None
+                 ) -> list[VectorColumnMetadata]:
+    group = grouping or feature.name
+    cols = [VectorColumnMetadata(*parent_of(feature), grouping=group,
+                                 indicator_value=lb) for lb in labels]
+    if track_invalid:
+        cols.append(VectorColumnMetadata(*parent_of(feature), grouping=group,
+                                         indicator_value=INVALID_INDICATOR))
+    if track_nulls:
+        cols.append(VectorColumnMetadata(*parent_of(feature), grouping=group,
+                                         indicator_value=NULL_INDICATOR))
+    return cols
+
+
+def _bucketize_block(values, mask, splits: np.ndarray, track_invalid: bool,
+                     track_nulls: bool):
+    """Jittable: one-hot bucket block for one numeric column.
+
+    Layout: [bucket_0..bucket_{k-1}, invalid?, null?] — a present value in
+    [splits[i], splits[i+1]) lights bucket i; out-of-range lights the invalid
+    column (or nothing); missing lights the null column (or nothing).
+    """
+    k = len(splits) - 1
+    inner = jnp.asarray(splits[1:-1], jnp.float32)
+    idx = jnp.searchsorted(inner, values, side="right") if k > 1 else (
+        jnp.zeros(values.shape, jnp.int32))
+    in_range = (values >= splits[0]) & (values <= splits[-1])
+    width = k + int(track_invalid) + int(track_nulls)
+    # slot: bucket for valid, k for invalid, k+trackInvalid for null,
+    # `width` (one-hot of width drops it) for untracked cases
+    invalid_slot = k if track_invalid else width
+    null_slot = k + int(track_invalid) if track_nulls else width
+    slot = jnp.where(in_range, idx, invalid_slot)
+    slot = jnp.where(mask > 0, slot, null_slot)
+    return jax.nn.one_hot(slot, width, dtype=jnp.float32)
+
+
+class NumericBucketizer(DeviceTransformer):
+    """Fixed-split bucketizer: one numeric feature -> one-hot bucket block.
+
+    Splits must be strictly increasing and cover the expected range; pass
+    ``-inf``/``inf`` ends for total coverage.
+    """
+
+    in_types = (ft.Real,)
+    out_type = ft.OPVector
+
+    def __init__(self, splits: Sequence[float] = (float("-inf"), 0.0, float("inf")),
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 labels: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        sp = [float(s) for s in splits]
+        if len(sp) < 2 or any(a >= b for a, b in zip(sp[:-1], sp[1:])):
+            raise ValueError(f"splits must be strictly increasing, got {sp}")
+        self.splits = sp
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        self.labels = list(labels) if labels is not None else bucket_labels(sp)
+        if len(self.labels) != len(sp) - 1:
+            raise ValueError("need one label per bucket")
+        super().__init__(uid=uid)
+
+    def device_apply(self, params, col: fr.NumericColumn) -> fr.VectorColumn:
+        block = _bucketize_block(col.values, col.mask,
+                                 np.asarray(self.splits, np.float64),
+                                 self.track_invalid, self.track_nulls)
+        meta = VectorMetadata(self.get_output().name, tuple(_bucket_meta(
+            self.get_output().name, self.input_features[0], self.labels,
+            self.track_invalid, self.track_nulls))).reindexed(0)
+        return fr.VectorColumn(block, meta)
+
+    def transform_row(self, value):
+        k = len(self.splits) - 1
+        width = k + int(self.track_invalid) + int(self.track_nulls)
+        out = np.zeros(width, np.float32)
+        if value is None:
+            if self.track_nulls:
+                out[k + int(self.track_invalid)] = 1.0
+            return out
+        v = float(value)
+        if v < self.splits[0] or v > self.splits[-1]:
+            if self.track_invalid:
+                out[k] = 1.0
+            return out
+        idx = int(np.searchsorted(self.splits[1:-1], v, side="right"))
+        out[min(idx, k - 1)] = 1.0
+        return out
+
+    def config(self):
+        return {"splits": self.splits, "track_nulls": self.track_nulls,
+                "track_invalid": self.track_invalid, "labels": self.labels}
+
+
+# ---------------------------------------------------------------------------
+# Decision-tree split search (single feature vs label)
+# ---------------------------------------------------------------------------
+
+def _impurity(counts: np.ndarray, is_regression: bool, sum_y=0.0, sum_y2=0.0,
+              n=0.0) -> float:
+    if is_regression:
+        if n <= 0:
+            return 0.0
+        return max(sum_y2 / n - (sum_y / n) ** 2, 0.0)
+    tot = counts.sum()
+    if tot <= 0:
+        return 0.0
+    p = counts / tot
+    return float(1.0 - np.sum(p * p))  # gini
+
+
+def find_tree_splits(x: np.ndarray, y: np.ndarray, *, max_depth: int = 2,
+                     max_bins: int = 32, min_info_gain: float = 0.01,
+                     min_instances_per_node: int = 1,
+                     is_regression: Optional[bool] = None) -> list[float]:
+    """Greedy single-feature decision-tree thresholds against the label.
+
+    Mirrors reference ``DecisionTreeNumericBucketizer.computeSplits`` (which
+    delegates to a Spark DecisionTree on the one feature): candidate
+    thresholds from quantiles (max_bins), recursive best-gini/variance-gain
+    splits, pruned by min_info_gain and min_instances_per_node. Returns the
+    sorted distinct thresholds (empty -> the feature should not be split).
+    """
+    if is_regression is None:
+        uniq = np.unique(y)
+        is_regression = uniq.size > 10 or not np.allclose(uniq, np.round(uniq))
+    classes = None if is_regression else np.unique(y)
+
+    cands = np.unique(np.quantile(x, np.linspace(0, 1, max_bins + 1)[1:-1])
+                      ) if x.size else np.array([])
+    out: list[float] = []
+
+    def impurity_of(idx) -> float:
+        if is_regression:
+            yy = y[idx]
+            return _impurity(np.array([]), True, yy.sum(),
+                             (yy ** 2).sum(), yy.size)
+        cnt = np.array([(y[idx] == c).sum() for c in classes], np.float64)
+        return _impurity(cnt, False)
+
+    def recurse(idx: np.ndarray, depth: int):
+        if depth >= max_depth or idx.size < 2 * min_instances_per_node:
+            return
+        parent_imp = impurity_of(idx)
+        best_gain, best_t = 0.0, None
+        xv = x[idx]
+        for t in cands:
+            left = xv <= t
+            nl, nr = int(left.sum()), int((~left).sum())
+            if nl < min_instances_per_node or nr < min_instances_per_node:
+                continue
+            gain = parent_imp - (
+                nl / idx.size * impurity_of(idx[left])
+                + nr / idx.size * impurity_of(idx[~left]))
+            if gain > best_gain:
+                best_gain, best_t = gain, float(t)
+        if best_t is None or best_gain < min_info_gain:
+            return
+        out.append(best_t)
+        left = x[idx] <= best_t
+        recurse(idx[left], depth + 1)
+        recurse(idx[~left], depth + 1)
+
+    if x.size:
+        recurse(np.arange(x.size), 0)
+    return sorted(set(out))
+
+
+class DecisionTreeNumericBucketizer(Estimator, AllowLabelAsInput):
+    """Label-aware bucketizer: (label RealNN, numeric) -> bucket block.
+
+    Fits a single-feature decision tree against the label; its thresholds
+    (padded with -inf/inf) become the splits. If the tree finds no
+    informative split the model emits only the null-indicator column
+    (reference ``shouldSplit=false`` behavior).
+    """
+
+    in_types = (ft.RealNN, ft.Real)
+    out_type = ft.OPVector
+
+    def __init__(self, max_depth: int = 2, max_bins: int = 32,
+                 min_info_gain: float = 0.01,
+                 min_instances_per_node: int = 1,
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 uid: Optional[str] = None):
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        super().__init__(uid=uid)
+
+    def compute_splits(self, x: np.ndarray, y: np.ndarray) -> list[float]:
+        thresholds = find_tree_splits(
+            x, y, max_depth=self.max_depth, max_bins=self.max_bins,
+            min_info_gain=self.min_info_gain,
+            min_instances_per_node=self.min_instances_per_node)
+        if not thresholds:
+            return []
+        return [float("-inf")] + thresholds + [float("inf")]
+
+    def fit_model(self, data):
+        label_name, feat_name = self.input_names
+        ycol, xcol = data.host_col(label_name), data.host_col(feat_name)
+        present = xcol.mask & ycol.mask
+        splits = self.compute_splits(
+            np.asarray(xcol.values, np.float64)[present],
+            np.asarray(ycol.values, np.float64)[present])
+        return _TreeBucketizerModel(
+            splits=splits, track_nulls=self.track_nulls,
+            track_invalid=self.track_invalid)
+
+
+class _TreeBucketizerModel(DeviceTransformer):
+    """Fitted tree bucketizer; consumes only the numeric input at score."""
+
+    in_types = (ft.RealNN, ft.Real)
+    out_type = ft.OPVector
+
+    def __init__(self, splits: Sequence[float] = (), track_nulls: bool = True,
+                 track_invalid: bool = False, uid: Optional[str] = None):
+        self.splits = [float(s) for s in splits]
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        super().__init__(uid=uid)
+
+    @property
+    def should_split(self) -> bool:
+        return len(self.splits) >= 2
+
+    def runtime_input_names(self):
+        return (self.input_names[1],)
+
+    def _meta(self) -> VectorMetadata:
+        feat = self.input_features[1]
+        name = self.get_output().name
+        if self.should_split:
+            cols = _bucket_meta(name, feat, bucket_labels(self.splits),
+                                self.track_invalid, self.track_nulls)
+        else:
+            cols = _bucket_meta(name, feat, [], False, self.track_nulls)
+        return VectorMetadata(name, tuple(cols)).reindexed(0)
+
+    def device_apply(self, params, col: fr.NumericColumn) -> fr.VectorColumn:
+        if self.should_split:
+            block = _bucketize_block(
+                col.values, col.mask, np.asarray(self.splits, np.float64),
+                self.track_invalid, self.track_nulls)
+        elif self.track_nulls:
+            block = (1.0 - col.mask)[:, None]
+        else:
+            block = jnp.zeros((col.values.shape[0], 0), jnp.float32)
+        return fr.VectorColumn(block, self._meta())
+
+    def transform_row(self, *values):
+        value = values[-1]  # score-time callers may omit the label
+        if self.should_split:
+            helper = NumericBucketizer(
+                splits=self.splits, track_nulls=self.track_nulls,
+                track_invalid=self.track_invalid)
+            return helper.transform_row(value)
+        if self.track_nulls:
+            return np.asarray([1.0 if value is None else 0.0], np.float32)
+        return np.zeros(0, np.float32)
+
+    def fitted_state(self):
+        return {"splits": np.asarray(self.splits, np.float64)}
+
+    def set_fitted_state(self, state):
+        self.splits = [float(s) for s in state["splits"]]
+
+    def config(self):
+        return {"track_nulls": self.track_nulls,
+                "track_invalid": self.track_invalid}
+
+
+class DecisionTreeNumericMapBucketizer(Estimator, AllowLabelAsInput):
+    """Per-key tree bucketizer over a RealMap (label, map) -> bucket blocks.
+
+    Parity: reference ``DecisionTreeNumericMapBucketizer.scala`` — every map
+    key gets its own tree-driven splits; keys that should not split
+    contribute only their null-indicator column. ``clean_keys`` lowercases /
+    strips key names the way map vectorizers do.
+    """
+
+    in_types = (ft.RealNN, ft.RealMap)
+    out_type = ft.OPVector
+
+    def __init__(self, max_depth: int = 2, max_bins: int = 32,
+                 min_info_gain: float = 0.01,
+                 min_instances_per_node: int = 1,
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 allow_keys: Sequence[str] = (),
+                 uid: Optional[str] = None):
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        self.allow_keys = list(allow_keys)
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        label_name, map_name = self.input_names
+        ycol, mcol = data.host_col(label_name), data.host_col(map_name)
+        keys: list[str] = []
+        for i in range(len(mcol)):
+            d = mcol.python_value(i)
+            if d:
+                for k in d:
+                    if k not in keys and (not self.allow_keys
+                                          or k in self.allow_keys):
+                        keys.append(k)
+        keys.sort()
+        y_all = np.asarray(ycol.values, np.float64)
+        splits_per_key: dict[str, list[float]] = {}
+        helper = DecisionTreeNumericBucketizer(
+            max_depth=self.max_depth, max_bins=self.max_bins,
+            min_info_gain=self.min_info_gain,
+            min_instances_per_node=self.min_instances_per_node)
+        for k in keys:
+            xs, ys = [], []
+            for i in range(len(mcol)):
+                d = mcol.python_value(i)
+                if d and k in d and ycol.mask[i]:
+                    xs.append(float(d[k]))
+                    ys.append(y_all[i])
+            splits_per_key[k] = helper.compute_splits(
+                np.asarray(xs, np.float64), np.asarray(ys, np.float64))
+        return _TreeMapBucketizerModel(
+            keys=keys, splits_per_key=splits_per_key,
+            track_nulls=self.track_nulls, track_invalid=self.track_invalid)
+
+
+class _TreeMapBucketizerModel(HostTransformer):
+    in_types = (ft.RealNN, ft.RealMap)
+    out_type = ft.OPVector
+
+    def __init__(self, keys: Sequence[str] = (),
+                 splits_per_key: Optional[dict] = None,
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 uid: Optional[str] = None):
+        self.keys = list(keys)
+        self.splits_per_key = {k: [float(s) for s in v]
+                               for k, v in (splits_per_key or {}).items()}
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        super().__init__(uid=uid)
+
+    def runtime_input_names(self):
+        return (self.input_names[1],)
+
+    def _key_width(self, k: str) -> int:
+        splits = self.splits_per_key.get(k, [])
+        if len(splits) >= 2:
+            return (len(splits) - 1 + int(self.track_invalid)
+                    + int(self.track_nulls))
+        return int(self.track_nulls)
+
+    def transform_row(self, *values):
+        d = values[-1] or {}
+        out: list[np.ndarray] = []
+        for k in self.keys:
+            splits = self.splits_per_key.get(k, [])
+            v = d.get(k)
+            if len(splits) >= 2:
+                helper = NumericBucketizer(
+                    splits=splits, track_nulls=self.track_nulls,
+                    track_invalid=self.track_invalid)
+                out.append(helper.transform_row(v))
+            elif self.track_nulls:
+                out.append(np.asarray([1.0 if v is None else 0.0], np.float32))
+        return (np.concatenate(out) if out
+                else np.zeros(0, np.float32))
+
+    def _meta(self) -> VectorMetadata:
+        feat = self.input_features[1]
+        name = self.get_output().name
+        cols: list[VectorColumnMetadata] = []
+        for k in self.keys:
+            splits = self.splits_per_key.get(k, [])
+            if len(splits) >= 2:
+                cols += _bucket_meta(name, feat, bucket_labels(splits),
+                                     self.track_invalid, self.track_nulls,
+                                     grouping=k)
+            else:
+                cols += _bucket_meta(name, feat, [], False, self.track_nulls,
+                                     grouping=k)
+        return VectorMetadata(name, tuple(cols)).reindexed(0)
+
+    def host_apply(self, *cols):
+        mcol = cols[-1]
+        rows = [self.transform_row(mcol.python_value(i))
+                for i in range(len(mcol))]
+        arr = (np.stack(rows) if rows
+               else np.zeros((0, sum(self._key_width(k) for k in self.keys)),
+                             np.float32))
+        return fr.HostColumn(ft.OPVector, arr.astype(np.float32),
+                             meta=self._meta())
+
+    def output_column(self, data):
+        return self.host_apply(*[data.host_col(n)
+                                 for n in self.runtime_input_names()])
+
+    def fitted_state(self):
+        return {"keys": list(self.keys),  # strings ride the JSON side
+                "splits": {k: self.splits_per_key[k] for k in self.keys}}
+
+    def set_fitted_state(self, state):
+        self.keys = [str(k) for k in state["keys"]]
+        self.splits_per_key = {
+            k: [float(s) for s in v] for k, v in state["splits"].items()}
+
+    def config(self):
+        return {"track_nulls": self.track_nulls,
+                "track_invalid": self.track_invalid}
+
+
+# ---------------------------------------------------------------------------
+# Percentile calibrator
+# ---------------------------------------------------------------------------
+
+class PercentileCalibrator(Estimator):
+    """Maps a numeric feature onto its empirical percentile in [0, buckets-1].
+
+    Parity: reference ``PercentileCalibrator.scala`` — quantile-discretize
+    into ``expected_num_buckets`` then scale bucket index onto [0, 99].
+    """
+
+    in_types = (ft.Real,)
+    out_type = ft.RealNN
+
+    def __init__(self, expected_num_buckets: int = 100,
+                 uid: Optional[str] = None):
+        self.expected_num_buckets = expected_num_buckets
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        col = data.host_col(self.input_names[0])
+        present = np.asarray(col.values, np.float64)[col.mask]
+        if present.size:
+            qs = np.linspace(0, 1, self.expected_num_buckets + 1)[1:-1]
+            edges = np.unique(np.quantile(present, qs))
+        else:
+            edges = np.array([], np.float64)
+        return _PercentileModel(splits=[float(e) for e in edges],
+                                buckets=self.expected_num_buckets)
+
+
+class _PercentileModel(DeviceTransformer):
+    in_types = (ft.Real,)
+    out_type = ft.RealNN
+
+    def __init__(self, splits: Sequence[float] = (), buckets: int = 100,
+                 uid: Optional[str] = None):
+        self.splits = [float(s) for s in splits]
+        self.buckets = buckets
+        super().__init__(uid=uid)
+
+    def _scale(self, idx):
+        # actual bucket count may be < requested when quantiles collapse;
+        # rescale onto [0, 99] like the reference's outputCol * 99/maxBucket
+        n_buckets = max(len(self.splits) + 1, 1)
+        return jnp.round(idx * (99.0 / max(n_buckets - 1, 1)))
+
+    def device_params(self):
+        return jnp.asarray(self.splits, jnp.float32)
+
+    def device_apply(self, params, col: fr.NumericColumn) -> fr.NumericColumn:
+        if len(self.splits) == 0:
+            return fr.NumericColumn(jnp.zeros_like(col.values),
+                                    jnp.ones_like(col.mask))
+        idx = jnp.searchsorted(params, col.values, side="right")
+        scaled = self._scale(idx.astype(jnp.float32))
+        return fr.NumericColumn(scaled * col.mask,
+                                jnp.ones_like(col.mask))
+
+    def transform_row(self, value):
+        if value is None or len(self.splits) == 0:
+            return 0.0
+        idx = float(np.searchsorted(self.splits, float(value), side="right"))
+        return float(np.asarray(self._scale(idx)))
+
+    def fitted_state(self):
+        return {"splits": np.asarray(self.splits, np.float64)}
+
+    def set_fitted_state(self, state):
+        self.splits = [float(s) for s in state["splits"]]
+
+    def config(self):
+        return {"buckets": self.buckets}
