@@ -1,0 +1,133 @@
+"""Workflow-level leakage-free CV tests (parity: reference OpWorkflowCVTest
+— cutDAG partition correctness + end-to-end train/score/save/load with the
+in-CV DAG refit per fold)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401 — installs DSL methods
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import cut_dag
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow, load_model
+
+
+def _make_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = rng.normal(size=n)
+    y = ((1.5 * x1 - x2 + 0.3 * noise) > 0).astype(np.float64)
+    host = fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+    })
+    return host
+
+
+def _pipeline(host, sanity_check=True):
+    feats = FeatureBuilder.from_frame(host, response="label")
+    vec = transmogrify([feats["x1"], feats["x2"]])
+    if sanity_check:
+        vec = feats["label"].sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3, seed=7)
+    pred = feats["label"].transform_with(sel, vec)
+    return feats, vec, pred
+
+
+def test_cut_dag_partition():
+    host = _make_data()
+    feats, vec, pred = _pipeline(host, sanity_check=True)
+    cut = cut_dag([pred, vec])
+    assert cut.selector is not None
+    during_names = {type(s).__name__ for layer in cut.during for s in layer}
+    before_names = {type(s).__name__ for layer in cut.before for s in layer}
+    # the label-dependent SanityChecker refits per fold; the plain
+    # vectorizers fit once up front
+    assert "SanityChecker" in during_names
+    assert "RealVectorizer" in before_names
+    assert "SanityChecker" not in before_names
+    # nothing downstream of the selector here
+    assert cut.after == []
+
+
+def test_cut_dag_no_selector():
+    host = _make_data()
+    feats = FeatureBuilder.from_frame(host, response="label")
+    vec = transmogrify([feats["x1"], feats["x2"]])
+    cut = cut_dag([vec])
+    assert cut.selector is None
+    assert cut.during == [] and cut.after == []
+    assert len(cut.before) >= 1
+
+
+def test_workflow_cv_end_to_end(tmp_path):
+    host = _make_data()
+    feats, vec, pred = _pipeline(host, sanity_check=True)
+    model = (Workflow().set_input_frame(host)
+             .set_result_features(pred, vec)
+             .with_workflow_cv()
+             .train())
+    s = model.selector_summary()
+    assert s is not None
+    auroc = s.holdout_evaluation["binary classification"]["au_roc"]
+    assert auroc > 0.8  # separable data: CV pipeline must learn it
+    # scoring replays the fused fitted DAG incl. the during stages
+    scored = model.score(host)
+    assert scored.n_rows == host.n_rows
+    # save/load round trip preserves the stitched DAG
+    p = str(tmp_path / "m")
+    model.save(p)
+    m2 = load_model(p)
+    scored2 = m2.score(host)
+    pc1 = scored.columns[pred.name]
+    pc2 = scored2.columns[pred.name]
+    np.testing.assert_allclose(
+        [d["prediction"] for d in pc1.values],
+        [d["prediction"] for d in pc2.values])
+
+
+def test_workflow_cv_without_label_dependent_stages_falls_back():
+    host = _make_data()
+    feats, vec, pred = _pipeline(host, sanity_check=False)
+    model = (Workflow().set_input_frame(host)
+             .set_result_features(pred, vec)
+             .with_workflow_cv()
+             .train())
+    assert model.selector_summary() is not None
+
+
+def test_response_propagates_through_label_derivations():
+    """A derived label (e.g. indexed) keeps is_response, so label-dependent
+    stages downstream of it are still caught by the workflow-CV cut."""
+    host = fr.HostFrame.from_dict({
+        "label": (ft.Text, ["a", "b", "a", "b"] * 50),
+        "x1": (ft.Real, list(np.linspace(0, 1, 200))),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    indexed = feats["label"].index_string()
+    assert indexed.is_response  # single-response-input derivation
+    vec = transmogrify([feats["x1"]])
+    assert not vec.is_response  # predictor-only derivation
+    buck = feats["x1"].auto_bucketize(indexed)
+    assert not buck.is_response  # mixed inputs -> predictor
+    cut = cut_dag([buck])
+    assert cut.selector is None  # no selector, but the cut still computes
+
+
+def test_cut_dag_rejects_two_selectors():
+    host = _make_data()
+    feats = FeatureBuilder.from_frame(host, response="label")
+    vec = transmogrify([feats["x1"], feats["x2"]])
+    sel1 = BinaryClassificationModelSelector.with_train_validation_split()
+    sel2 = BinaryClassificationModelSelector.with_train_validation_split()
+    p1 = feats["label"].transform_with(sel1, vec)
+    p2 = feats["label"].transform_with(sel2, vec)
+    with pytest.raises(ValueError, match="at most 1 ModelSelector"):
+        cut_dag([p1, p2])

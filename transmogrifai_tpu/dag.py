@@ -34,7 +34,7 @@ from transmogrifai_tpu.stages.base import (
     Estimator, PipelineStage, Transformer,
 )
 
-__all__ = ["compute_dag", "DagExecutor", "Dag"]
+__all__ = ["compute_dag", "cut_dag", "CutDag", "DagExecutor", "Dag"]
 
 Dag = list  # list[list[PipelineStage]], execution order
 
@@ -61,6 +61,81 @@ def compute_dag(result_features: Sequence[FeatureLike]) -> Dag:
     for layer in layers:
         layer.sort(key=lambda s: s.uid)
     return [l for l in layers if l]
+
+
+class CutDag:
+    """The DAG cut around the ModelSelector for leakage-free workflow CV.
+
+    Parity: reference ``FitStagesUtil.cutDAG`` (``FitStagesUtil.scala:
+    302-355``) — splits the workflow DAG into:
+      - ``before``: stages safe to fit once on the full training data
+      - ``during``: label-dependent feature stages (and everything at or
+        after them on the selector's ancestor path) that must be refit
+        inside every CV fold to avoid leaking label information
+      - ``after``: stages downstream of the selector or of any during stage
+    """
+
+    def __init__(self, selector, before: Dag, during: Dag, after: Dag):
+        self.selector = selector
+        self.before = before
+        self.during = during
+        self.after = after
+
+
+def cut_dag(result_features: Sequence[FeatureLike]) -> CutDag:
+    from transmogrifai_tpu.selector.model_selector import ModelSelector
+
+    dag = compute_dag(result_features)
+    selectors = [s for layer in dag for s in layer
+                 if isinstance(s, ModelSelector)]
+    if not selectors:
+        return CutDag(None, dag, [], [])
+    if len(selectors) > 1:
+        raise ValueError(
+            f"Workflow can contain at most 1 ModelSelector, found "
+            f"{len(selectors)}: {selectors}")
+    ms = selectors[0]
+
+    # the selector's ancestor DAG, least-deep layer last (selector excluded)
+    ms_dag = compute_dag([ms.get_output()])
+    ms_dag = [[s for s in layer if s is not ms] for layer in ms_dag]
+    ms_dag = [l for l in ms_dag if l]
+
+    # first layer containing a label-dependent stage (inputs mix response
+    # and predictors): everything from there on refits inside each fold
+    def label_dependent(stage) -> bool:
+        ins = stage.input_features
+        return (any(f.is_response for f in ins)
+                and any(not f.is_response for f in ins))
+
+    first = next((i for i, layer in enumerate(ms_dag)
+                  if any(label_dependent(s) for s in layer)), None)
+    during_layers = ms_dag[first:] if first is not None else []
+    during_set = {s for layer in during_layers for s in layer}
+
+    def ancestors(stage) -> set:
+        out: set = set()
+        for f in stage.input_features:
+            out.update(f.parent_stages().keys())
+        return out
+
+    before: Dag = []
+    after: Dag = []
+    for layer in dag:
+        b_layer, a_layer = [], []
+        for s in layer:
+            if s is ms or s in during_set:
+                continue
+            anc = ancestors(s)
+            if ms in anc or (anc & during_set):
+                a_layer.append(s)
+            else:
+                b_layer.append(s)
+        if b_layer:
+            before.append(b_layer)
+        if a_layer:
+            after.append(a_layer)
+    return CutDag(ms, before, during_layers, after)
 
 
 def _check_distinct_uids(dist) -> None:

@@ -18,7 +18,9 @@ from typing import Optional, Sequence
 import numpy as np
 
 from transmogrifai_tpu import frame as fr
-from transmogrifai_tpu.stages.base import Estimator, HostTransformer
+from transmogrifai_tpu.stages.base import (
+    AllowLabelAsInput, Estimator, HostTransformer,
+)
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
     NULL_INDICATOR, VectorColumnMetadata, VectorMetadata, parent_of,
@@ -137,7 +139,7 @@ class StringIndexerModel(HostTransformer):
                 "unseen_name": self.unseen_name}
 
 
-class OpIndexToString(HostTransformer):
+class OpIndexToString(HostTransformer, AllowLabelAsInput):
     """Label indices -> label strings from a user-supplied labels array.
 
     Out-of-range indices raise; use ``OpIndexToStringNoFilter`` to map them
@@ -180,7 +182,7 @@ class OpIndexToStringNoFilter(OpIndexToString):
         return {"labels": self.labels, "unseen_name": self.unseen_name}
 
 
-class MultiLabelJoiner(HostTransformer):
+class MultiLabelJoiner(HostTransformer, AllowLabelAsInput):
     """(indexed label, class-probability vector) -> {label: probability}.
 
     Parity: reference ``MultiLabelJoiner.scala:44-59`` (labels come from the

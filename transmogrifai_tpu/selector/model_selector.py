@@ -176,18 +176,10 @@ class ModelSelector(Estimator):
             self.evaluators[0].default_metric
         super().__init__(uid=uid)
 
-    def fit_model(self, data) -> SelectedModel:
-        from transmogrifai_tpu.dag import _plog
-        t0 = time.time()
-        label_name, feat_name = self.input_names
-        X = data.device_col(feat_name).values
-        y = data.device_col(label_name).values
-        n = int(X.shape[0])
-        ev0 = self.evaluators[0]
-        bigger = ev0.larger_is_better(self.validation_metric)
-
-        # -- split & prepare -------------------------------------------------
-        prep_results: dict = {}
+    # -- shared pieces -------------------------------------------------------
+    def _split_prepare(self, n: int, y) -> tuple[np.ndarray, np.ndarray,
+                                                 np.ndarray, dict]:
+        """(train_idx, holdout_idx, train weights, prep summary)."""
         if self.splitter is not None:
             # pull the label to host only when the splitter actually needs it
             y_np = np.asarray(y) if getattr(self.splitter, "requires_label",
@@ -195,30 +187,21 @@ class ModelSelector(Estimator):
             train_idx, holdout_idx = self.splitter.split_indices(n, y_np)
             train_idx, w_train = self.splitter.prepare_indices(
                 train_idx, y_np)
-            if self.splitter.summary:
-                prep_results = {self.splitter.summary.splitter:
-                                self.splitter.summary.detail}
-        else:
-            train_idx = np.arange(n)
-            holdout_idx = np.zeros(0, dtype=np.int64)
-            w_train = np.ones(n, dtype=np.float32)
-        Xt, yt = X[jnp.asarray(train_idx)], y[jnp.asarray(train_idx)]
-        wt = jnp.asarray(w_train)
+            prep = {self.splitter.summary.splitter:
+                    self.splitter.summary.detail} \
+                if self.splitter.summary else {}
+            return train_idx, holdout_idx, w_train, prep
+        return (np.arange(n), np.zeros(0, dtype=np.int64),
+                np.ones(n, dtype=np.float32), {})
 
-        # -- validation sweep ------------------------------------------------
-        results: list[ModelEvaluation] = []
-        mean_metrics: list[tuple[float, int, int]] = []  # (metric, cand_i, grid_j)
-        yt_np = (np.asarray(yt)
-                 if getattr(self.validator, "stratify", False) else None)
-        _folds = self.validator.splits(int(Xt.shape[0]), yt_np)
-        per_candidate_scores: dict[tuple[int, int], list[float]] = {}
-        _plog("selector: split+prepare", t0)
+    def _sweep(self, fold_arrays) -> tuple[list[ModelEvaluation],
+                                           list[tuple[float, int, int]]]:
+        """Run every (candidate, grid point) over the fold arrays; returns
+        per-candidate evaluations and (mean metric, cand, grid) triples."""
+        ev0 = self.evaluators[0]
         batch_metrics = getattr(ev0, "metric_batch_scores", None)
-        t1 = time.time()
-        for tr, va in _folds:
-            jtr, jva = jnp.asarray(tr), jnp.asarray(va)
-            Xtr, ytr, wtr = Xt[jtr], yt[jtr], wt[jtr]
-            Xva, yva = Xt[jva], yt[jva]
+        per_candidate_scores: dict[tuple[int, int], list[float]] = {}
+        for Xtr, ytr, wtr, Xva, yva in fold_arrays:
             for ci, (est, grid) in enumerate(self.models_and_grids):
                 models = est.grid_fit_arrays(Xtr, ytr, wtr, grid)
                 scores = (est.grid_predict_scores(models, Xva)
@@ -237,6 +220,8 @@ class ModelSelector(Estimator):
                     metrics = ev0.evaluate_arrays(yva, pred)
                     val = ev0.metric_value(metrics, self.validation_metric)
                     per_candidate_scores.setdefault((ci, gj), []).append(val)
+        results: list[ModelEvaluation] = []
+        mean_metrics: list[tuple[float, int, int]] = []
         for (ci, gj), vals in per_candidate_scores.items():
             est, grid = self.models_and_grids[ci]
             mean = float(np.mean(vals))
@@ -247,35 +232,32 @@ class ModelSelector(Estimator):
                 model_type=type(est).__name__,
                 params={**est.params, **grid[gj]},
                 metric_values={self.validation_metric: mean}))
+        return results, mean_metrics
 
-        _plog("selector: CV sweep", t1)
-        best_mean, best_ci, best_gj = (max if bigger else min)(
+    def _finalize(self, results, mean_metrics, Xt, yt, wt, Xh, yh,
+                  prep_results: dict, t0: float) -> SelectedModel:
+        """Refit the winning candidate on the full prepared training data,
+        evaluate train + holdout, assemble the summary."""
+        ev0 = self.evaluators[0]
+        bigger = ev0.larger_is_better(self.validation_metric)
+        _, best_ci, best_gj = (max if bigger else min)(
             mean_metrics, key=lambda t: t[0])
         best_est, best_grid = self.models_and_grids[best_ci]
-
-        # -- refit winner on the full prepared training data -----------------
-        t1 = time.time()
         best_params = {**best_est.params, **best_grid[best_gj]}
         best_model = best_est.fit_arrays(Xt, yt, wt, best_params)
-        _plog("selector: refit", t1)
-        t1 = time.time()
 
-        # -- train/holdout evaluation with every evaluator -------------------
         train_eval: dict = {}
         holdout_eval: dict = {}
         pred_train = best_model.predict_arrays(Xt)
         for ev in self.evaluators:
             train_eval[ev.name] = EvaluatorBase.to_json(
                 ev.evaluate_arrays(yt, pred_train))
-        if holdout_idx.size:
-            Xh = X[jnp.asarray(holdout_idx)]
-            yh = y[jnp.asarray(holdout_idx)]
+        if Xh is not None and int(Xh.shape[0]):
             pred_h = best_model.predict_arrays(Xh)
             for ev in self.evaluators:
                 holdout_eval[ev.name] = EvaluatorBase.to_json(
                     ev.evaluate_arrays(yh, pred_h))
 
-        _plog("selector: train/holdout evaluation", t1)
         summary = ModelSelectorSummary(
             validation_type=self.validator.name,
             validation_metric=self.validation_metric,
@@ -290,3 +272,95 @@ class ModelSelector(Estimator):
             wall_time_s=time.time() - t0,
         )
         return SelectedModel(model=best_model, summary=summary)
+
+    def fit_model(self, data) -> SelectedModel:
+        from transmogrifai_tpu.dag import _plog
+        t0 = time.time()
+        label_name, feat_name = self.input_names
+        X = data.device_col(feat_name).values
+        y = data.device_col(label_name).values
+        n = int(X.shape[0])
+
+        train_idx, holdout_idx, w_train, prep_results = \
+            self._split_prepare(n, y)
+        Xt, yt = X[jnp.asarray(train_idx)], y[jnp.asarray(train_idx)]
+        wt = jnp.asarray(w_train)
+        _plog("selector: split+prepare", t0)
+
+        yt_np = (np.asarray(yt)
+                 if getattr(self.validator, "stratify", False) else None)
+        t1 = time.time()
+
+        def fold_arrays():
+            for tr, va in self.validator.splits(int(Xt.shape[0]), yt_np):
+                jtr, jva = jnp.asarray(tr), jnp.asarray(va)
+                yield Xt[jtr], yt[jtr], wt[jtr], Xt[jva], yt[jva]
+
+        results, mean_metrics = self._sweep(fold_arrays())
+        _plog("selector: CV sweep", t1)
+        t1 = time.time()
+        Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
+        yh = y[jnp.asarray(holdout_idx)] if holdout_idx.size else None
+        selected = self._finalize(results, mean_metrics, Xt, yt, wt, Xh, yh,
+                                  prep_results, t0)
+        _plog("selector: refit+evaluate", t1)
+        return selected
+
+    def fit_with_dag(self, data, during_dag, executor):
+        """Leakage-free workflow-level CV (reference ``OpWorkflow.
+        withWorkflowCV`` + ``ModelSelector.findBestEstimator`` over the in-CV
+        DAG): the label-dependent feature stages in ``during_dag`` are refit
+        inside every fold on that fold's training rows only, then the
+        candidate sweep runs on the fold-local features.
+
+        Returns ``(selected_model, fitted_during_dag, transformed_data)``
+        where ``fitted_during_dag`` was refit on the full prepared training
+        rows and ``transformed_data`` is the input data pushed through it
+        (all rows, holdout included).
+        """
+        t0 = time.time()
+        label_name, feat_name = self.input_names
+        y = data.device_col(label_name).values
+        n = int(y.shape[0])
+
+        train_idx, holdout_idx, w_train, prep_results = \
+            self._split_prepare(n, y)
+        data_train = data.take(train_idx)
+        wt_full = jnp.asarray(w_train)
+        yt_np = (np.asarray(y)[train_idx]
+                 if getattr(self.validator, "stratify", False) else None)
+
+        def fold_arrays():
+            for tr, va in self.validator.splits(train_idx.size, yt_np):
+                d_tr = data_train.take(tr)
+                d_va = data_train.take(va)
+                # scratch executor per fold: the fold's fitted models carry
+                # fold-specific static config (vocabs, splits), so their
+                # compiled programs must not accumulate in the workflow's
+                # long-lived executor cache
+                fold_ex = type(executor)()
+                d_tr2, fitted = fold_ex.fit_transform(d_tr, during_dag)
+                d_va2 = fold_ex.transform(d_va, fitted)
+                yield (d_tr2.device_col(feat_name).values,
+                       d_tr2.device_col(label_name).values,
+                       wt_full[jnp.asarray(tr)],
+                       d_va2.device_col(feat_name).values,
+                       d_va2.device_col(label_name).values)
+
+        results, mean_metrics = self._sweep(fold_arrays())
+
+        # refit the in-CV feature DAG on the full prepared training rows,
+        # then push ALL rows (train + holdout) through it for downstream use
+        _, fitted_during = executor.fit_transform(data_train, during_dag)
+        full_data = executor.transform(data, fitted_during)
+        X = full_data.device_col(feat_name).values
+        y_full = full_data.device_col(label_name).values
+        Xt = X[jnp.asarray(train_idx)]
+        yt = y_full[jnp.asarray(train_idx)]
+        Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
+        yh = y_full[jnp.asarray(holdout_idx)] if holdout_idx.size else None
+        selected = self._finalize(results, mean_metrics, Xt, yt, wt_full,
+                                  Xh, yh, prep_results, t0)
+        selected._inputs = self._inputs
+        selected._output = self.get_output()
+        return selected, fitted_during, full_data

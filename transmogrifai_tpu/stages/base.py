@@ -120,7 +120,11 @@ class PipelineStage:
         return f"{base}_{len(self._inputs)}-stagesApplied_{self.operation_name}_{n:012d}"
 
     def output_is_response(self) -> bool:
-        return False
+        """Derived features stay responses only when every input is one
+        (e.g. an indexed label); any predictor input makes the output a
+        predictor. This is what workflow-level CV's label-dependence cut
+        keys off, so response-ness must survive label derivations."""
+        return bool(self._inputs) and all(f.is_response for f in self._inputs)
 
     def get_output(self) -> Feature:
         if not self._inputs and not self.is_raw_generator:

@@ -34,6 +34,14 @@ class Workflow:
         self.reader: Optional[DataReader] = None
         self.result_features: tuple[FeatureLike, ...] = ()
         self._raw_feature_filter = None
+        self._workflow_cv = False
+
+    def with_workflow_cv(self, enabled: bool = True) -> "Workflow":
+        """Leakage-free workflow-level CV (reference ``withWorkflowCV``):
+        label-dependent feature stages feeding the ModelSelector are refit
+        inside each CV fold instead of once on the full training data."""
+        self._workflow_cv = enabled
+        return self
 
     # -- inputs --------------------------------------------------------------
     def set_reader(self, reader: DataReader) -> "Workflow":
@@ -92,15 +100,39 @@ class Workflow:
                             f"result features (blocklist: {blocklist})")
                     raw = [f for f in raw if f.name not in set(blocklist)]
         data = PipelineData.from_host(frame)
-        dag = compute_dag(result)
         executor = DagExecutor()
-        with profiler.phase(OpStep.FEATURE_ENGINEERING):
-            _, fitted = executor.fit_transform(data, dag)
+        cut = None
+        if self._workflow_cv:
+            from transmogrifai_tpu.dag import cut_dag
+            cut = cut_dag(result)
+            if cut.selector is None or not cut.during:
+                cut = None  # nothing label-dependent to protect: plain fit
+        if cut is not None:
+            fitted = self._fit_workflow_cv(data, cut, executor)
+        else:
+            dag = compute_dag(result)
+            with profiler.phase(OpStep.FEATURE_ENGINEERING):
+                _, fitted = executor.fit_transform(data, dag)
         return WorkflowModel(
             result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
             blocklisted=blocklist,
             label_distribution=_label_distribution(frame, raw))
+
+    def _fit_workflow_cv(self, data: PipelineData, cut, executor) -> Dag:
+        """Reference ``OpWorkflow.scala:408-449``: fit the pre-CV DAG once,
+        run the selector with the in-CV (label-dependent) DAG refit per
+        fold, then fit whatever remains downstream."""
+        from transmogrifai_tpu.utils.profiling import OpStep, profiler
+        with profiler.phase(OpStep.FEATURE_ENGINEERING):
+            data, fitted_before = executor.fit_transform(data, cut.before)
+        with profiler.phase(OpStep.CROSS_VALIDATION):
+            selected, fitted_during, data = cut.selector.fit_with_dag(
+                data, cut.during, executor)
+        with profiler.phase(OpStep.FEATURE_ENGINEERING):
+            _, fitted_tail = executor.fit_transform(
+                data, [[selected]] + cut.after)
+        return fitted_before + fitted_during + fitted_tail
 
 
 class WorkflowModel:
