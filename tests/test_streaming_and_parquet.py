@@ -1,0 +1,148 @@
+"""Streaming reader + parquet reader tests (parity: reference
+StreamingReadersTest + DataReaders parquet variants)."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers import (
+    CustomReader, DataReaders, FileStreamingReader, ParquetReader,
+)
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+
+def _write_csv(path, rows):
+    with open(path, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+
+
+def _train_tiny_model(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n)
+    y = (x > 0).astype(np.float64)
+    host = fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "x": (ft.Real, x.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    vec = transmogrify([feats["x"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=3)
+    pred = feats["label"].transform_with(sel, vec)
+    model = (Workflow().set_input_frame(host)
+             .set_result_features(pred).train())
+    return model, pred
+
+
+def test_parquet_reader_schema_and_rows(tmp_path):
+    t = pa.table({
+        "x": pa.array([1.5, 2.5, None], pa.float64()),
+        "n": pa.array([1, 2, 3], pa.int64()),
+        "b": pa.array([True, False, None], pa.bool_()),
+        "s": pa.array(["a", "b", None], pa.string()),
+    })
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p)
+    r = ParquetReader(p)
+    schema = r.schema()
+    assert schema["x"] is ft.Real and schema["n"] is ft.Integral
+    assert schema["b"] is ft.Binary and schema["s"] is ft.Text
+    rows = list(r.read())
+    assert rows[0] == {"x": 1.5, "n": 1, "b": True, "s": "a"}
+    assert rows[2]["x"] is None and rows[2]["s"] is None
+    # generate_frame through the feature system
+    feats = {"x": ft.Real, "n": ft.Integral}
+    from transmogrifai_tpu.stages.base import FeatureGeneratorStage
+    fs = [FeatureGeneratorStage(name=k, ftype_name=v.__name__).get_output()
+          for k, v in feats.items()]
+    frame = r.generate_frame(fs)
+    assert frame.n_rows == 3
+
+
+def test_parquet_factory():
+    assert DataReaders.Simple.parquet is not None
+
+
+def test_file_streaming_reader_batches(tmp_path):
+    d = str(tmp_path)
+    _write_csv(os.path.join(d, "a.csv"),
+               [{"x": "1.0"}, {"x": "2.0"}])
+    _write_csv(os.path.join(d, "b.csv"), [{"x": "3.0"}])
+    r = FileStreamingReader(d, pattern="*.csv", max_batches=2,
+                            poll_interval_s=0.01, timeout_s=0.5)
+    batches = list(r.stream())
+    assert len(batches) == 2
+    assert [len(b) for b in batches] == [2, 1]
+    assert batches[0][0]["x"] == 1.0
+
+
+def test_file_streaming_retries_unreadable_then_skips(tmp_path):
+    d = str(tmp_path)
+    # an invalid avro container: the reader raises on every attempt
+    with open(os.path.join(d, "bad.avro"), "wb") as fh:
+        fh.write(b"not-avro")
+    _write_csv(os.path.join(d, "ok.csv"), [{"x": "1.0"}])
+    r = FileStreamingReader(d, pattern="*", max_batches=1,
+                            poll_interval_s=0.01, timeout_s=1.0)
+    batches = list(r.stream())
+    # the good file still flows; the bad one is retried then dropped
+    assert [len(b) for b in batches] == [1]
+
+
+def test_file_streaming_timeout_returns(tmp_path):
+    r = FileStreamingReader(str(tmp_path), poll_interval_s=0.01,
+                            timeout_s=0.05)
+    assert list(r.stream()) == []
+
+
+def test_stream_score_end_to_end(tmp_path):
+    model, pred = _train_tiny_model()
+    d = str(tmp_path / "in")
+    os.makedirs(d)
+    _write_csv(os.path.join(d, "b0.csv"),
+               [{"x": "2.0"}, {"x": "-2.0"}])
+    _write_csv(os.path.join(d, "b1.csv"), [{"x": "1.0"}])
+    reader = FileStreamingReader(d, pattern="*.csv", max_batches=2,
+                                 poll_interval_s=0.01, timeout_s=1.0)
+    written = []
+    frames = list(model.score_stream(
+        reader, write_batch=lambda f, i: written.append((i, f.n_rows))))
+    assert [f.n_rows for f in frames] == [2, 1]
+    assert written == [(0, 2), (1, 1)]
+    preds = [d["prediction"] for d in frames[0].columns[pred.name].values]
+    assert preds[0] == 1.0 and preds[1] == 0.0  # x>0 learned
+
+
+def test_streaming_runner(tmp_path):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+
+    model, pred = _train_tiny_model()
+    mpath = str(tmp_path / "model")
+    model.save(mpath)
+    d = str(tmp_path / "stream")
+    os.makedirs(d)
+    _write_csv(os.path.join(d, "b0.csv"), [{"x": "0.5"}])
+    scores_dir = str(tmp_path / "scores")
+    runner = WorkflowRunner(
+        Workflow(),
+        scoring_reader_factory=lambda p: FileStreamingReader(
+            d, pattern="*.csv", max_batches=1, poll_interval_s=0.01,
+            timeout_s=1.0))
+    params = OpParams(model_location=mpath, score_location=scores_dir)
+    result = runner.run(RunTypes.STREAMING_SCORE, params)
+    assert result["status"] == "success"
+    assert result["nBatches"] == 1 and result["nRows"] == 1
+    assert os.path.exists(os.path.join(scores_dir, "batch_000000.avro"))

@@ -10,10 +10,15 @@ from transmogrifai_tpu.readers.factory import DataReaders
 from transmogrifai_tpu.readers.joined import (
     JoinKeys, JoinedAggregateDataReader, JoinedDataReader, TimeBasedFilter,
 )
+from transmogrifai_tpu.readers.parquet import ParquetReader
+from transmogrifai_tpu.readers.streaming import (
+    FileStreamingReader, StreamingReader, stream_score,
+)
 
 __all__ = [
     "CustomReader", "DataReader", "CSVReader", "infer_csv_schema",
     "AggregateDataReader", "ConditionalDataReader", "DataReaders",
     "JoinKeys", "JoinedDataReader", "JoinedAggregateDataReader",
     "TimeBasedFilter", "AvroReader", "feature_schema_of_avro", "save_avro",
+    "ParquetReader", "FileStreamingReader", "StreamingReader", "stream_score",
 ]

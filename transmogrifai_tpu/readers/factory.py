@@ -36,9 +36,28 @@ class DataReaders:
             return AvroReader(path, schema=schema, key_col=key_col)
 
         @staticmethod
+        def parquet(path: str, schema=None, key_col: Optional[str] = None,
+                    **kw):
+            from transmogrifai_tpu.readers.parquet import ParquetReader
+            return ParquetReader(path, schema=schema, key_col=key_col, **kw)
+
+        @staticmethod
         def custom(records: Iterable[Any],
                    key_fn: Optional[Callable[[Any], str]] = None) -> CustomReader:
             return CustomReader(records=records, key_fn=key_fn)
+
+    class Streaming:
+        """Micro-batch file streams (reference StreamingReaders.avro)."""
+
+        @staticmethod
+        def files(path: str, pattern: str = "*", **kw):
+            from transmogrifai_tpu.readers.streaming import FileStreamingReader
+            return FileStreamingReader(path, pattern=pattern, **kw)
+
+        @staticmethod
+        def avro(path: str, **kw):
+            from transmogrifai_tpu.readers.streaming import FileStreamingReader
+            return FileStreamingReader(path, pattern="*.avro", **kw)
 
     class Aggregate:
         @staticmethod

@@ -25,9 +25,10 @@ __all__ = ["WorkflowRunner", "RunTypes"]
 class RunTypes:
     TRAIN = "train"
     SCORE = "score"
+    STREAMING_SCORE = "streaming-score"
     EVALUATE = "evaluate"
     FEATURES = "features"
-    ALL = (TRAIN, SCORE, EVALUATE, FEATURES)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, EVALUATE, FEATURES)
 
 
 class WorkflowRunner:
@@ -57,6 +58,38 @@ class WorkflowRunner:
                         model.save(params.model_location)
                     result["modelLocation"] = params.model_location
                 result["summary"] = model.summary_json()
+            elif run_type == RunTypes.STREAMING_SCORE:
+                # reference OpWorkflowRunner StreamingScore: score every
+                # micro-batch as it lands, writing per-batch score files
+                from transmogrifai_tpu.readers.streaming import (
+                    StreamingReader, stream_score,
+                )
+                if params.model_location is None:
+                    raise ValueError(f"{run_type} requires modelLocation")
+                model = load_model(params.model_location)
+                reader = (self.scoring_reader_factory(params)
+                          if self.scoring_reader_factory
+                          else self.workflow.reader)
+                if not isinstance(reader, StreamingReader):
+                    raise ValueError(
+                        "streaming-score requires a StreamingReader (got "
+                        f"{type(reader).__name__})")
+
+                def write_batch(frame, i):
+                    if params.score_location:
+                        from transmogrifai_tpu.readers.avro import save_avro
+                        import os
+                        os.makedirs(params.score_location, exist_ok=True)
+                        save_avro(frame, os.path.join(
+                            params.score_location, f"batch_{i:06d}.avro"))
+
+                n_rows = n_batches = 0
+                with profiler.phase(OpStep.SCORING):
+                    for frame in stream_score(model, reader, write_batch):
+                        n_batches += 1
+                        n_rows += frame.n_rows
+                result["nBatches"] = n_batches
+                result["nRows"] = n_rows
             elif run_type in (RunTypes.SCORE, RunTypes.EVALUATE,
                               RunTypes.FEATURES):
                 if params.model_location is None:

@@ -133,13 +133,36 @@ class SelectedModel(PredictionModel):
 
     def config(self):
         return {"model_class": type(self.model).__name__,
+                "model_module": type(self.model).__module__,
                 "model_config": self.model.config(),
                 "summary": self.summary.to_json() if self.summary else None}
 
     @classmethod
     def from_config(cls, config, uid=None):
+        import importlib
         from transmogrifai_tpu.stages.base import STAGE_REGISTRY
-        model_cls = STAGE_REGISTRY[config["model_class"]]
+        name = config["model_class"]
+        if name not in STAGE_REGISTRY:
+            # the registry fills on import: try the recorded module first,
+            # then every model family shipped in-package (covers manifests
+            # whose recorded module was since renamed)
+            candidates = ([config["model_module"]]
+                          if config.get("model_module") else [])
+            candidates += ["transmogrifai_tpu.models.linear",
+                           "transmogrifai_tpu.models.trees",
+                           "transmogrifai_tpu.models.extras"]
+            for mod in candidates:
+                try:
+                    importlib.import_module(mod)
+                except ImportError:
+                    continue
+                if name in STAGE_REGISTRY:
+                    break
+            else:
+                raise KeyError(
+                    f"Unknown model class {name!r}: not found after "
+                    f"importing {candidates}; import its module first")
+        model_cls = STAGE_REGISTRY[name]
         model = model_cls.from_config(config.get("model_config") or {})
         summary = None
         if config.get("summary"):

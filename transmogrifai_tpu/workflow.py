@@ -287,6 +287,12 @@ class WorkflowModel:
         col = data.host_col(feat_name)
         return loco.host_apply(col).values
 
+    def score_stream(self, streaming_reader, write_batch=None):
+        """Micro-batch continuous scoring (reference StreamingScore): yields
+        one scored HostFrame per batch from the streaming reader."""
+        from transmogrifai_tpu.readers.streaming import stream_score
+        return stream_score(self, streaming_reader, write_batch)
+
     # -- persistence ---------------------------------------------------------
     def save(self, path: str, overwrite: bool = True) -> None:
         from transmogrifai_tpu.serialization import save_model
