@@ -1,0 +1,48 @@
+"""Titanic survival — the reference's flagship helloworld flow.
+
+Parity: reference ``helloworld/.../OpTitanicSimple.scala:78-160`` — typed
+features, family-size math, automatic vectorization, sanity check, binary
+model selection, evaluation. The dataset is regenerated synthetically (same
+schema and signal structure as the Kaggle data; this environment has no
+network egress).
+
+Run: python examples/op_titanic.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from transmogrifai_tpu import dsl  # noqa: F401 — installs feature DSL
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.workflow import Workflow
+
+from titanic import titanic_features, titanic_reader
+
+
+def main() -> int:
+    survived, predictors = titanic_features()
+    features = transmogrify(predictors, min_support=5)
+    checked = survived.sanity_check(features)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3, seed=42)
+    prediction = survived.transform_with(selector, checked)
+
+    model = (Workflow()
+             .set_reader(titanic_reader())
+             .set_result_features(prediction, checked)
+             .train())
+
+    print(model.summary_pretty())
+    metrics = model.evaluate(titanic_reader(),
+                             OpBinaryClassificationEvaluator())
+    print(f"Full-data AuROC: {metrics.au_roc:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Helloworld example parity tests (reference OpIris/OpBoston/OpTitanic
+end-to-end apps, run in-process on the CPU mesh)."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+
+def _load(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_iris_example_trains_accurately():
+    from transmogrifai_tpu.selector import MultiClassificationModelSelector
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu import dsl  # noqa: F401
+
+    mod = _load("op_iris")
+    frame = mod.iris_frame(300, seed=5)
+    feats = FeatureBuilder.from_frame(frame, response="species")
+    label = feats["species"].index_string()
+    features = transmogrify([feats[c] for c in (
+        "sepal_length", "sepal_width", "petal_length", "petal_width")])
+    sel = MultiClassificationModelSelector.with_train_validation_split(seed=1)
+    pred = label.transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    s = model.selector_summary()
+    err = s.holdout_evaluation["multiclass classification"]["error"]
+    assert err < 0.15  # well-separated clusters
+
+
+def test_boston_example_trains_accurately():
+    from transmogrifai_tpu.selector import RegressionModelSelector
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu import dsl  # noqa: F401
+
+    mod = _load("op_boston")
+    frame = mod.boston_frame(400, seed=2)
+    feats = FeatureBuilder.from_frame(frame, response="medv")
+    features = transmogrify([feats[c] for c in mod.COLUMNS])
+    sel = RegressionModelSelector.with_train_validation_split(seed=1)
+    pred = feats["medv"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    s = model.selector_summary()
+    r2 = s.holdout_evaluation["regression"]["r2"]
+    assert r2 > 0.6  # strong linear signal must be learned

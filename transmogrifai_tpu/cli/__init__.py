@@ -1,0 +1,39 @@
+"""Developer CLI (reference ``cli/`` module): project generation.
+
+``python -m transmogrifai_tpu.cli gen --input data.csv --id id
+--response label ProjectName`` emits a runnable AutoML project.
+"""
+
+from transmogrifai_tpu.cli.gen import (
+    ProblemKind, detect_problem_kind, generate_project,
+)
+
+__all__ = ["ProblemKind", "detect_problem_kind", "generate_project", "main"]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser("transmogrifai_tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+    gen = sub.add_parser("gen", help="generate a project from a dataset")
+    gen.add_argument("name", help="project name (output directory name)")
+    gen.add_argument("--input", required=True,
+                     help="CSV or parquet dataset path")
+    gen.add_argument("--id", required=True, dest="id_col",
+                     help="id column name")
+    gen.add_argument("--response", required=True, help="response column")
+    gen.add_argument("--schema", default=None,
+                     help="optional Avro .avsc schema path")
+    gen.add_argument("--output", default=".", help="output directory")
+    gen.add_argument("--overwrite", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.command == "gen":
+        path = generate_project(
+            name=args.name, input_path=args.input, id_col=args.id_col,
+            response_col=args.response, output_dir=args.output,
+            avro_schema_path=args.schema, overwrite=args.overwrite)
+        print(f"Generated project at {path}")
+        return 0
+    return 1

@@ -69,12 +69,26 @@ def bin_data(X, edges):
 # single-tree growth (one jitted program per (n, d, depth, B) shape)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+def _use_pallas_default() -> bool:
+    """Opt-in (TRANSMOGRIFAI_PALLAS_HIST=1) Pallas histogram path; the
+    scatter-add XLA path stays the default until the compiled kernel is
+    benchmarked faster on the target TPU generation. Interpret-mode parity
+    is covered by tests either way."""
+    import os
+    return os.environ.get("TRANSMOGRIFAI_PALLAS_HIST") == "1" \
+        and jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
+                                             "use_pallas"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
-              reg_lambda, gamma, min_child_weight):
+              reg_lambda, gamma, min_child_weight, use_pallas: bool = False):
     """Level-wise histogram tree. Returns (feats, bins, leaf_values) where
     feats/bins are tuples of per-level [2^level] arrays and leaf_values is
     [2^max_depth]. grad/hess already carry row weights."""
+    from transmogrifai_tpu.ops.histogram_pallas import (
+        node_bin_histogram, node_bin_histogram_xla,
+    )
     n, d = Xb.shape
     B = n_bins
     node = jnp.zeros(n, dtype=jnp.int32)
@@ -82,15 +96,12 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
     feats_out, bins_out = [], []
     for level in range(max_depth):
         n_nodes = 2 ** level
-        flat = (node[:, None] * d + jnp.arange(d)[None, :]) * B + Xb  # [n, d]
-        flat = flat.reshape(-1)
-        seg = n_nodes * d * B
-        hist_g = jnp.zeros(seg, jnp.float32).at[flat].add(
-            jnp.broadcast_to(grad[:, None], (n, d)).reshape(-1))
-        hist_h = jnp.zeros(seg, jnp.float32).at[flat].add(
-            jnp.broadcast_to(hess[:, None], (n, d)).reshape(-1))
-        hist_g = hist_g.reshape(n_nodes, d, B)
-        hist_h = hist_h.reshape(n_nodes, d, B)
+        if use_pallas:
+            hist_g, hist_h = node_bin_histogram(
+                Xb, node, grad, hess, n_nodes=n_nodes, n_bins=B)
+        else:
+            hist_g, hist_h = node_bin_histogram_xla(
+                Xb, node, grad, hess, n_nodes=n_nodes, n_bins=B)
         GL = jnp.cumsum(hist_g, axis=2)
         HL = jnp.cumsum(hist_h, axis=2)
         G = GL[:, :, -1:]
@@ -147,11 +158,11 @@ def predict_tree(Xb, feats, bins, leaf_values):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
-    "bootstrap", "subsample", "colsample"))
+    "bootstrap", "subsample", "colsample", "use_pallas"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
-                   bootstrap: bool, seed: int):
+                   bootstrap: bool, seed: int, use_pallas: bool = False):
     """Train a whole ensemble in one scanned program.
 
     loss: 'logistic' (n_out=1), 'softmax' (n_out=K one-vs-all), 'squared'.
@@ -195,7 +206,8 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
             return grow_tree(Xb, gk * rw, hk * rw, fmask,
                              max_depth=max_depth, n_bins=n_bins,
                              reg_lambda=reg_lambda, gamma=gamma,
-                             min_child_weight=min_child_weight)
+                             min_child_weight=min_child_weight,
+                             use_pallas=use_pallas)
 
         feats, bins, leaves = jax.vmap(grow_one, in_axes=(1, 1))(g, h)
         # feats/bins: tuples of [n_out, 2^level]; leaves [n_out, 2^depth]
@@ -393,7 +405,8 @@ class _TreePredictor(Predictor):
             subsample=float(subsample),
             colsample=float(p["colsample"]),
             base_score=jnp.float32(base),
-            bootstrap=self.bootstrap, seed=int(p["seed"]))
+            bootstrap=self.bootstrap, seed=int(p["seed"]),
+            use_pallas=_use_pallas_default())
         model = TreeEnsembleModel(
             kind=self.kind, n_out=n_out,
             learning_rate=float(p["learning_rate"]), base_score=base,

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel: (node, feature, bin) gradient/hessian histograms.
+
+The tree learner's hot op (models/trees.py grow_tree) needs, per level,
+
+    hist[s, node, f, b] = sum_n s_n(grad|hess) * 1[node_n == node] * 1[Xb_nf == b]
+
+The pure-XLA path is a scatter-add, which serializes on TPU. This kernel
+recasts it as compare + matmul: for a (feature-tile, row-chunk) grid cell it
+builds the one-hot of the combined ``node*B + bin`` index in VMEM (never in
+HBM) and contracts it with the [grad; hess] rows on the MXU. That is the
+canonical MXU-friendly histogram (the analog of what libxgboost's GPU
+backend does with shared-memory atomics — here atomics become a matmul).
+
+Parity: replaces the executor-distributed histogram aggregation of Spark
+MLlib trees / XGBoost's Rabit all-reduce (SURVEY §2.7 P5). Under a mesh the
+kernel runs per shard and the [2, d, K] output is psum'd over ICI.
+
+Falls back to interpret mode off-TPU so the same code path runs in CPU CI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["node_bin_histogram", "node_bin_histogram_xla"]
+
+#: VMEM budget for the one-hot tile (bytes); F_T adapts to stay under it
+_EQ_BUDGET = 6 * 1024 * 1024
+_CHUNK = 256  # rows per grid step (lane dim of the one-hot contraction)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _kernel(xb_ref, node_ref, gh_ref, out_ref, *, n_bins: int, K: int):
+    """Everything stays 2D (Mosaic layout-friendly): per feature of the
+    tile, a [C, K] one-hot compare feeds one (2xC)@(CxK) MXU matmul."""
+    j = pl.program_id(1)
+    F_T, C = xb_ref.shape
+    comb = xb_ref[:, :] + node_ref[0, :][None, :] * n_bins      # [F_T, C]
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (C, K), 1)     # [C, K]
+    for f in range(F_T):  # static, small: unrolled into the program
+        eqf = (comb[f, :][:, None] == k_iota).astype(jnp.float32)
+        part = jnp.dot(gh_ref[:, :], eqf,
+                       preferred_element_type=jnp.float32)      # [2, K]
+
+        @pl.when(j == 0)
+        def _(part=part, f=f):
+            out_ref[:, pl.ds(f * K, K)] = part
+
+        @pl.when(j > 0)
+        def _(part=part, f=f):
+            out_ref[:, pl.ds(f * K, K)] = out_ref[:, pl.ds(f * K, K)] + part
+
+
+def node_bin_histogram(Xb, node, grad, hess, *, n_nodes: int, n_bins: int,
+                       interpret: bool | None = None):
+    """[n_nodes, d, B] grad and hess histograms via the Pallas kernel.
+
+    Xb: [n, d] int32 bin codes in [0, B); node: [n] int32 in [0, n_nodes);
+    grad/hess: [n] f32 (row weights already applied). ``interpret=None``
+    compiles on TPU and interprets elsewhere (CPU CI runs the same path).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _node_bin_histogram(Xb, node, grad, hess, n_nodes=n_nodes,
+                               n_bins=n_bins, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins", "interpret"))
+def _node_bin_histogram(Xb, node, grad, hess, *, n_nodes: int, n_bins: int,
+                        interpret: bool):
+    n, d = Xb.shape
+    K = n_nodes * n_bins
+    # feature-tile size bounded by the VMEM one-hot budget
+    F_T = max(1, min(8, _EQ_BUDGET // max(K * _CHUNK * 4, 1)))
+    n_pad = _round_up(max(n, 1), _CHUNK)
+    d_pad = _round_up(max(d, 1), F_T)
+
+    xb_t = jnp.zeros((d_pad, n_pad), jnp.int32)
+    xb_t = xb_t.at[:d, :n].set(Xb.T)
+    node_p = jnp.zeros((1, n_pad), jnp.int32).at[0, :n].set(node)
+    gh = jnp.zeros((2, n_pad), jnp.float32)
+    gh = gh.at[0, :n].set(grad).at[1, :n].set(hess)
+
+    grid = (d_pad // F_T, n_pad // _CHUNK)
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_bins=n_bins, K=K),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((F_T, _CHUNK), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _CHUNK), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, _CHUNK), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2, F_T * K), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2, d_pad * K), jnp.float32),
+        interpret=interpret,
+    )(xb_t, node_p, gh)
+
+    # [2, d*K] -> [2, d, n_nodes, B] -> ([n_nodes, d, B], [n_nodes, d, B])
+    hist = out.reshape(2, d_pad, n_nodes, n_bins)[:, :d]
+    hist = jnp.transpose(hist, (0, 2, 1, 3))
+    return hist[0], hist[1]
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def node_bin_histogram_xla(Xb, node, grad, hess, *, n_nodes: int,
+                           n_bins: int):
+    """Scatter-add reference (the pre-Pallas path; also the parity oracle)."""
+    n, d = Xb.shape
+    flat = ((node[:, None] * d + jnp.arange(d)[None, :]) * n_bins
+            + Xb).reshape(-1)
+    seg = n_nodes * d * n_bins
+    hg = jnp.zeros(seg, jnp.float32).at[flat].add(
+        jnp.broadcast_to(grad[:, None], (n, d)).reshape(-1))
+    hh = jnp.zeros(seg, jnp.float32).at[flat].add(
+        jnp.broadcast_to(hess[:, None], (n, d)).reshape(-1))
+    return (hg.reshape(n_nodes, d, n_bins), hh.reshape(n_nodes, d, n_bins))

@@ -98,11 +98,16 @@ def _coerce(v: Any, fty: Optional[type[ft.FeatureType]]) -> Any:
     if v is None:
         return None
     if fty is not None and issubclass(fty, (ft.Date, ft.DateTime)):
+        import calendar
         import datetime
         if isinstance(v, datetime.datetime):
+            if v.tzinfo is None:
+                # naive parquet timestamps are UTC by convention; never let
+                # the host timezone shift feature values between machines
+                v = v.replace(tzinfo=datetime.timezone.utc)
             return int(v.timestamp() * 1000)
         if isinstance(v, datetime.date):
-            return int(datetime.datetime(v.year, v.month, v.day).timestamp()
+            return int(calendar.timegm((v.year, v.month, v.day, 0, 0, 0))
                        * 1000)
     if fty is not None and issubclass(fty, ft.Text) and not isinstance(v, str):
         return str(v)

@@ -71,20 +71,35 @@ class FileStreamingReader(StreamingReader):
         seen: set[str] = set(self._list_files()) if self.new_files_only \
             else set()
         failures: dict[str, int] = {}
+        next_retry: dict[str, float] = {}
         n_batches = 0
         last_new = time.monotonic()
         while True:
-            new_files = [f for f in self._list_files() if f not in seen]
+            now = time.monotonic()
+            new_files = [f for f in self._list_files()
+                         if f not in seen and next_retry.get(f, 0.0) <= now]
             for f in new_files:
                 last_new = time.monotonic()
                 try:
-                    records = list(self.make_reader(f).read())
+                    reader = self.make_reader(f)
+                except ValueError:
+                    # no reader for this extension (e.g. a sidecar .avsc
+                    # schema file): skip it permanently, never retry
+                    seen.add(f)
+                    continue
+                try:
+                    records = list(reader.read())
                 except Exception:
-                    # likely a partially-written file: leave it unseen and
-                    # retry next poll; give up after max_retries_per_file
+                    # likely a partially-written file: retry on a later
+                    # poll (one attempt per poll interval, so a slow
+                    # producer gets real wall-clock time to finish), give
+                    # up after max_retries_per_file attempts
                     failures[f] = failures.get(f, 0) + 1
                     if failures[f] >= self.max_retries_per_file:
                         seen.add(f)
+                    else:
+                        next_retry[f] = time.monotonic() + \
+                            self.poll_interval_s
                     continue
                 seen.add(f)
                 if records:
@@ -105,7 +120,7 @@ def reader_for_file(path: str, schema: Optional[dict] = None) -> DataReader:
     if ext == ".csv":
         from transmogrifai_tpu.readers.csv import CSVReader
         return CSVReader(path, schema=schema)
-    if ext in (".avro", ".avsc"):
+    if ext == ".avro":
         from transmogrifai_tpu.readers.avro import AvroReader
         return AvroReader(path, schema=schema)
     if ext in (".parquet", ".pq"):
@@ -120,14 +135,19 @@ def stream_score(model, reader: StreamingReader,
     """Continuous scoring loop (reference OpWorkflowRunner StreamingScore):
     for each micro-batch, run the fitted DAG and yield the scored frame
     (and/or hand it to ``write_batch(frame, batch_index)``)."""
-    if getattr(reader, "schema", ...) is None:
+    pinned = getattr(reader, "schema", ...) is None
+    if pinned:
         # pin batch-file parsing to the model's raw predictor types so
         # per-file inference cannot disagree with the fitted pipeline
         # (responses stay inferred: score streams usually lack them)
         reader.schema = {f.name: f.ftype for f in model.raw_features
                          if not f.is_response}
-    for i, records in enumerate(reader.stream()):
-        scored = model.score(CustomReader(records=records))
-        if write_batch is not None:
-            write_batch(scored, i)
-        yield scored
+    try:
+        for i, records in enumerate(reader.stream()):
+            scored = model.score(CustomReader(records=records))
+            if write_batch is not None:
+                write_batch(scored, i)
+            yield scored
+    finally:
+        if pinned:
+            reader.schema = None  # don't leak this model's types

@@ -63,7 +63,20 @@ def _default_binary_candidates():
 
 
 def _default_multi_candidates():
-    return [(OpLogisticRegression(), _lr_grid())]
+    # reference multiclass defaults: LR + RF + DT + NB
+    cands = [(OpLogisticRegression(), _lr_grid())]
+    try:
+        from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+        cands.append((OpRandomForestClassifier(), [
+            {"num_trees": 50, "max_depth": d} for d in (6, 12)]))
+    except ImportError:
+        pass
+    try:
+        from transmogrifai_tpu.models.extras import OpNaiveBayes
+        cands.append((OpNaiveBayes(), [{}]))
+    except ImportError:
+        pass
+    return cands
 
 
 def _default_regression_candidates():
@@ -142,6 +155,25 @@ class MultiClassificationModelSelector:
             validation_metric=validation_metric,
         )
 
+    @staticmethod
+    def with_train_validation_split(
+            train_ratio: float = 0.75,
+            validation_metric: str = "F1",
+            seed: int = 42,
+            splitter: Optional[DataSplitter] = None,
+            models_and_parameters: Optional[Sequence] = None,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_grids=(models_and_parameters
+                              or _default_multi_candidates()),
+            validator=OpTrainValidationSplit(train_ratio=train_ratio,
+                                             seed=seed),
+            splitter=splitter if splitter is not None
+            else DataCutter(seed=seed),
+            evaluators=[OpMultiClassificationEvaluator()],
+            validation_metric=validation_metric,
+        )
+
 
 class RegressionModelSelector:
     @staticmethod
@@ -156,6 +188,25 @@ class RegressionModelSelector:
             models_and_grids=(models_and_parameters
                               or _default_regression_candidates()),
             validator=OpCrossValidation(n_folds=n_folds, seed=seed),
+            splitter=splitter if splitter is not None
+            else DataSplitter(seed=seed),
+            evaluators=[OpRegressionEvaluator()],
+            validation_metric=validation_metric,
+        )
+
+    @staticmethod
+    def with_train_validation_split(
+            train_ratio: float = 0.75,
+            validation_metric: str = "RMSE",
+            seed: int = 42,
+            splitter: Optional[DataSplitter] = None,
+            models_and_parameters: Optional[Sequence] = None,
+    ) -> ModelSelector:
+        return ModelSelector(
+            models_and_grids=(models_and_parameters
+                              or _default_regression_candidates()),
+            validator=OpTrainValidationSplit(train_ratio=train_ratio,
+                                             seed=seed),
             splitter=splitter if splitter is not None
             else DataSplitter(seed=seed),
             evaluators=[OpRegressionEvaluator()],

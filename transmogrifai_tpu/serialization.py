@@ -70,8 +70,10 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
                 "stateJson": state_json,
             })
 
+    from transmogrifai_tpu.utils.version import VersionInfo
     manifest = {
         "formatVersion": FORMAT_VERSION,
+        "versionInfo": VersionInfo.to_json(),
         "resultFeatures": [_feature_json(f) for f in model.result_features],
         "rawFeatures": [_feature_json(f) for f in model.raw_features],
         "blocklisted": list(model.blocklisted),

@@ -1,0 +1,54 @@
+"""Build/version info embedded in summaries and generated projects.
+
+Parity: reference ``utils/.../version/VersionInfo.scala`` — surfaces the
+framework version plus build provenance (git commit/branch when available)
+so model artifacts record what produced them.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+
+__all__ = ["VersionInfo"]
+
+
+@functools.lru_cache(maxsize=1)
+def _git_info() -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = {}
+    for key, args in (("commit", ["rev-parse", "HEAD"]),
+                      ("branch", ["rev-parse", "--abbrev-ref", "HEAD"])):
+        try:
+            out[key] = subprocess.run(
+                ["git", "-C", repo] + args, capture_output=True, text=True,
+                timeout=5, check=True).stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            out[key] = None
+    return out
+
+
+class VersionInfo:
+    @staticmethod
+    def to_json() -> dict:
+        from transmogrifai_tpu import __version__
+        import jax
+
+        git = _git_info()
+        return {
+            "version": __version__,
+            "gitCommit": git["commit"],
+            "gitBranch": git["branch"],
+            "jaxVersion": jax.__version__,
+            "backend": _backend_or_none(),
+        }
+
+
+def _backend_or_none():
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return None

@@ -238,8 +238,10 @@ class WorkflowModel:
         return None
 
     def summary_json(self) -> dict:
+        from transmogrifai_tpu.utils.version import VersionInfo
         s = self.selector_summary()
         out = {
+            "versionInfo": VersionInfo.to_json(),
             "resultFeatures": [f.name for f in self.result_features],
             "rawFeatures": [f.name for f in self.raw_features],
             "blocklistedFeatures": self.blocklisted,
