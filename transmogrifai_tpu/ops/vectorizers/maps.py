@@ -31,7 +31,7 @@ __all__ = [
     "RealMapVectorizer", "IntegralMapVectorizer", "BinaryMapVectorizer",
     "TextMapPivotVectorizer", "MultiPickListMapVectorizer",
     "DateMapToUnitCircleVectorizer", "GeolocationMapVectorizer",
-    "SmartTextMapVectorizer",
+    "SmartTextMapVectorizer", "TextMapLenEstimator", "TextMapNullEstimator",
 ]
 
 
@@ -506,3 +506,59 @@ class SmartTextMapVectorizer(_MapVectorizerBase):
         return _SmartTextMapModel(keys=keys, track_nulls=self.track_nulls,
                                   treatments=treatments,
                                   num_hash_features=self.num_hash_features)
+
+
+# ---------------------------------------------------------------------------
+# text-map length / null estimators
+# ---------------------------------------------------------------------------
+
+class _TextMapLenModel(_KeyedModelBase):
+    in_types = (ft.TextMap,)
+
+    def key_width(self, i, key):
+        return 1
+
+    def fill_key(self, out, off, i, key, value):
+        out[off] = 0.0 if value is None else float(len(str(value)))
+
+    def key_meta(self, i, key, parent):
+        return [VectorColumnMetadata(*parent, grouping=key,
+                                     descriptor_value="TextLen")]
+
+
+class TextMapLenEstimator(_MapVectorizerBase):
+    """Per-key text lengths of a TextMap -> OPVector (reference
+    ``TextMapLenEstimator.scala`` — missing keys contribute length 0)."""
+
+    in_types = (ft.TextMap,)
+
+    def fit_model(self, data):
+        keys = [sorted(self._collect(data.host_col(n)))
+                for n in self.input_names]
+        return _TextMapLenModel(keys=keys, track_nulls=False)
+
+
+class _TextMapNullModel(_KeyedModelBase):
+    in_types = (ft.TextMap,)
+
+    def key_width(self, i, key):
+        return 1
+
+    def fill_key(self, out, off, i, key, value):
+        out[off] = 1.0 if value is None else 0.0
+
+    def key_meta(self, i, key, parent):
+        return [VectorColumnMetadata(*parent, grouping=key,
+                                     indicator_value=NULL_INDICATOR)]
+
+
+class TextMapNullEstimator(_MapVectorizerBase):
+    """Per-key null indicators of a TextMap -> OPVector (reference
+    ``TextMapNullEstimator.scala``)."""
+
+    in_types = (ft.TextMap,)
+
+    def fit_model(self, data):
+        keys = [sorted(self._collect(data.host_col(n)))
+                for n in self.input_names]
+        return _TextMapNullModel(keys=keys, track_nulls=False)

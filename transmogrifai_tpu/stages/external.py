@@ -1,0 +1,176 @@
+"""Generic wrappers for external estimators/transformers.
+
+Parity: reference ``core/.../stages/sparkwrappers/generic/Sw*.scala`` (12
+files) + ``SparkWrapperParams`` — wrap *any* third-party Transformer or
+Estimator as a pipeline stage. The Spark version wraps JVM stages and ships
+them via MLeap; the TPU-native equivalent wraps plain Python callables:
+
+- ``ExternalEstimatorWrapper``: ``fit_fn(X, y, w) -> state`` plus
+  ``predict_fn(state, X) -> scores`` (numpy in/out; e.g. an sklearn-style
+  library or hand-rolled numpy model). Runs on host — external engines
+  don't trace under jit — while everything upstream stays fused on device.
+- ``ExternalTransformerWrapper``: ``transform_fn(X) -> X2`` over the
+  feature-vector block.
+
+Both serialize like LambdaTransformer: the callables must be importable
+module-level functions, and the fitted state must be a dict of numpy
+arrays/JSON-able values (the same contract as ``fitted_state``).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.stages.base import Estimator, HostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (
+    VectorColumnMetadata, VectorMetadata, parent_of,
+)
+
+__all__ = ["ExternalEstimatorWrapper", "ExternalPredictionModel",
+           "ExternalTransformerWrapper"]
+
+
+def _fn_path(fn: Callable) -> str:
+    mod = getattr(fn, "__module__", None)
+    qn = getattr(fn, "__qualname__", "")
+    if not mod or "<lambda>" in qn or "<locals>" in qn:
+        raise ValueError(
+            f"External wrapper function {fn!r} must be an importable "
+            "module-level function to be serializable")
+    return f"{mod}:{qn}"
+
+
+def _fn_from_path(path: str) -> Callable:
+    mod, _, qn = path.partition(":")
+    obj: Any = importlib.import_module(mod)
+    for part in qn.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+class ExternalEstimatorWrapper(Estimator):
+    """(label RealNN, features OPVector) -> Prediction via external fns.
+
+    ``fit_fn(X, y, w) -> state``; ``predict_fn(state, X) -> scores`` where
+    scores is [n] (binary margin / regression value) or [n, C] class
+    probabilities.
+    """
+
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+
+    def __init__(self, fit_fn: Callable | str, predict_fn: Callable | str,
+                 uid: Optional[str] = None):
+        self.fit_fn = _fn_from_path(fit_fn) if isinstance(fit_fn, str) \
+            else fit_fn
+        self.predict_fn = _fn_from_path(predict_fn) \
+            if isinstance(predict_fn, str) else predict_fn
+        super().__init__(uid=uid)
+
+    def fit_model(self, data):
+        label_name, feat_name = self.input_names
+        y = np.asarray(data.device_col(label_name).values, np.float64)
+        X = np.asarray(data.device_col(feat_name).values, np.float64)
+        w = np.ones_like(y)
+        state = self.fit_fn(X, y, w)
+        if not isinstance(state, dict):
+            raise TypeError(
+                f"fit_fn must return a dict state, got {type(state)}")
+        return ExternalPredictionModel(
+            predict_fn=self.predict_fn, state=state)
+
+    def config(self):
+        return {"fit_fn": _fn_path(self.fit_fn),
+                "predict_fn": _fn_path(self.predict_fn)}
+
+
+class ExternalPredictionModel(HostTransformer):
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+
+    def __init__(self, predict_fn: Callable | str,
+                 state: Optional[dict] = None, uid: Optional[str] = None):
+        self.predict_fn = _fn_from_path(predict_fn) \
+            if isinstance(predict_fn, str) else predict_fn
+        self.state = state or {}
+        super().__init__(uid=uid)
+
+    def runtime_input_names(self):
+        return (self.input_names[-1],)
+
+    def _scores_to_prediction(self, scores: np.ndarray) -> list[dict]:
+        scores = np.asarray(scores, np.float64)
+        out = []
+        if scores.ndim == 1:
+            # binary margin or regression value: mirror PredictionColumn's
+            # single-score contract
+            for s in scores:
+                out.append({"prediction": float(s)})
+        else:
+            for row in scores:
+                k = int(np.argmax(row))
+                d = {"prediction": float(k)}
+                for j, p in enumerate(row):
+                    d[f"rawPrediction_{j}"] = float(p)
+                    d[f"probability_{j}"] = float(p)
+                out.append(d)
+        return out
+
+    def transform_row(self, *values):
+        X = np.asarray(values[-1], np.float64)[None, :]
+        return self._scores_to_prediction(
+            self.predict_fn(self.state, X))[0]
+
+    def host_apply(self, *cols):
+        X = np.asarray(cols[-1].values, np.float64)
+        preds = self._scores_to_prediction(self.predict_fn(self.state, X))
+        return fr.HostColumn.from_values(ft.Prediction, preds)
+
+    def output_column(self, data):
+        return self.host_apply(*[data.host_col(n)
+                                 for n in self.runtime_input_names()])
+
+    def fitted_state(self):
+        return dict(self.state)
+
+    def set_fitted_state(self, state):
+        self.state = dict(state)
+
+    def config(self):
+        return {"predict_fn": _fn_path(self.predict_fn)}
+
+
+class ExternalTransformerWrapper(HostTransformer):
+    """OPVector -> OPVector through an arbitrary numpy function."""
+
+    in_types = (ft.OPVector,)
+    out_type = ft.OPVector
+
+    def __init__(self, transform_fn: Callable | str,
+                 uid: Optional[str] = None):
+        self.transform_fn = _fn_from_path(transform_fn) \
+            if isinstance(transform_fn, str) else transform_fn
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        return np.asarray(
+            self.transform_fn(np.asarray(value)[None, :])[0], np.float32)
+
+    def host_apply(self, *cols):
+        X = np.asarray(cols[0].values)
+        X2 = np.asarray(self.transform_fn(X), np.float32)
+        name = self.get_output().name
+        f = self.input_features[0]
+        meta = VectorMetadata(name, tuple(
+            VectorColumnMetadata(*parent_of(f), grouping=f.name,
+                                 descriptor_value=f"external_{j}")
+            for j in range(X2.shape[1]))).reindexed(0)
+        return fr.HostColumn(ft.OPVector, X2, meta=meta)
+
+    def config(self):
+        return {"transform_fn": _fn_path(self.transform_fn)}

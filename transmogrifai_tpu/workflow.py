@@ -35,6 +35,7 @@ class Workflow:
         self.result_features: tuple[FeatureLike, ...] = ()
         self._raw_feature_filter = None
         self._workflow_cv = False
+        self._model_stage_overrides: dict[str, Any] = {}
 
     def with_workflow_cv(self, enabled: bool = True) -> "Workflow":
         """Leakage-free workflow-level CV (reference ``withWorkflowCV``):
@@ -68,6 +69,38 @@ class Workflow:
         low-quality raw features and rewiring the DAG)."""
         self._raw_feature_filter = rff
         return self
+
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Resume training with already-fitted stages (reference
+        ``OpWorkflow.withModelStages:468-472``): any stage in this
+        workflow's DAG whose output feature matches one fitted in ``model``
+        is reused as-is instead of refit."""
+        for layer in model.dag:
+            for t in layer:
+                out = t.get_output()
+                if out is not None:
+                    self._model_stage_overrides[out.uid] = t
+        return self
+
+    def _substitute_fitted(self, dag: Dag) -> Dag:
+        if not self._model_stage_overrides:
+            return dag
+        return [[self._model_stage_overrides.get(s.get_output().uid, s)
+                 for s in layer] for layer in dag]
+
+    def compute_data_up_to(self, feature: FeatureLike) -> fr.HostFrame:
+        """Materialize the data with all transformations applied up to (and
+        including) ``feature`` (reference ``OpWorkflow.computeDataUpTo``) —
+        fitting whatever estimators the path needs. Returns every feature
+        generated along the way (raws + intermediates + the target)."""
+        if self.reader is None:
+            raise ValueError("set a reader or input frame first")
+        raw = [f for f in feature.raw_features()] or [feature]
+        frame = self.reader.generate_frame(raw)
+        data = PipelineData.from_host(frame)
+        dag = self._substitute_fitted(compute_dag([feature]))
+        data, _ = DagExecutor().fit_transform(data, dag)
+        return _frame_up_to(data, raw, dag)
 
     # -- lineage -------------------------------------------------------------
     def raw_features(self) -> list[FeatureLike]:
@@ -107,10 +140,17 @@ class Workflow:
             cut = cut_dag(result)
             if cut.selector is None or not cut.during:
                 cut = None  # nothing label-dependent to protect: plain fit
+            elif cut.selector.get_output().uid in self._model_stage_overrides:
+                # the selector itself is already fitted (with_model_stages):
+                # nothing to sweep, the plain path reuses it as-is
+                cut = None
         if cut is not None:
+            cut.before = self._substitute_fitted(cut.before)
+            cut.during = self._substitute_fitted(cut.during)
+            cut.after = self._substitute_fitted(cut.after)
             fitted = self._fit_workflow_cv(data, cut, executor)
         else:
-            dag = compute_dag(result)
+            dag = self._substitute_fitted(compute_dag(result))
             with profiler.phase(OpStep.FEATURE_ENGINEERING):
                 _, fitted = executor.fit_transform(data, dag)
         return WorkflowModel(
@@ -289,6 +329,28 @@ class WorkflowModel:
         col = data.host_col(feat_name)
         return loco.host_apply(col).values
 
+    def compute_data_up_to(self, feature: FeatureLike,
+                           reader_or_frame) -> fr.HostFrame:
+        """Materialize data through the FITTED stages up to ``feature``
+        (reference ``OpWorkflowModel.computeDataUpTo``). Returns every
+        feature generated along the way (raws + intermediates + target)."""
+        data = self._ingest(reader_or_frame)
+        # fitted models carry their own uids; ancestry matches on the
+        # output feature nodes, which fit() shares with the estimators
+        needed_outputs = {s.get_output().uid
+                          for s in feature.parent_stages()} | {feature.uid}
+        dag = [[t for t in layer if t.get_output().uid in needed_outputs]
+               for layer in self.dag]
+        dag = [l for l in dag if l]
+        if not feature.is_raw and not any(
+                t.get_output().uid == feature.uid
+                for layer in dag for t in layer):
+            raise KeyError(
+                f"Feature {feature.name!r} is not produced by this fitted "
+                "model's DAG")
+        data = self.executor.transform(data, dag)
+        return _frame_up_to(data, feature.raw_features(), dag)
+
     def score_stream(self, streaming_reader, write_batch=None):
         """Micro-batch continuous scoring (reference StreamingScore): yields
         one scored HostFrame per batch from the streaming reader."""
@@ -304,6 +366,15 @@ class WorkflowModel:
     def score_function(self):
         from transmogrifai_tpu.local.scoring import make_score_function
         return make_score_function(self)
+
+
+def _frame_up_to(data, raw_features, dag) -> fr.HostFrame:
+    """Raws + every stage output materialized by ``dag``, as a HostFrame."""
+    names = [f.name for f in raw_features] + \
+        [s.get_output().name for layer in dag for s in layer]
+    cols = {n: data.host_col(n) for n in dict.fromkeys(names)
+            if data.has(n)}
+    return fr.HostFrame(cols, data.host.key)
 
 
 def _label_distribution(frame: fr.HostFrame, raw_features) -> Optional[dict]:
