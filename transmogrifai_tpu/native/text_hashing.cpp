@@ -1,0 +1,77 @@
+// Native tokenizer + hashing-trick accumulator for the text vectorizer.
+//
+// The reference leans on Lucene (JVM) for tokenization and Spark's murmur3
+// HashingTF for the hashing trick (OPCollectionHashingVectorizer.scala); our
+// host-side equivalent tokenizes ASCII word runs and hashes with zlib's
+// CRC-32 — bit-identical to Python's zlib.crc32, so the Python row path and
+// this columnar path agree exactly (the OpTransformerSpec parity contract).
+// Non-ASCII columns stay on the Python/regex path (dispatch in hashing.py).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+uint32_t crc_table[256];
+bool crc_ready = false;
+
+void init_crc() {
+    if (crc_ready) return;
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_ready = true;
+}
+
+inline uint32_t crc32_update(uint32_t crc, const unsigned char* p,
+                             int64_t len) {
+    crc ^= 0xFFFFFFFFu;
+    for (int64_t i = 0; i < len; ++i)
+        crc = crc_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+inline bool is_word(unsigned char c) {
+    return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') ||
+           (c >= 'A' && c <= 'Z');
+}
+
+}  // namespace
+
+extern "C" {
+
+// buf: concatenated UTF-8 rows; offsets: [n+1] byte offsets into buf.
+// out: float32 [n, stride] row-major; token bins accumulate into
+// out[r, col_offset + crc32(token) % num_bins].
+void hash_tokens_batch(const char* buf, const int64_t* offsets, int64_t n,
+                       int32_t num_bins, int32_t lowercase,
+                       int32_t binary_freq, float* out, int64_t stride,
+                       int64_t col_offset) {
+    init_crc();
+    unsigned char tok[4096];
+    for (int64_t r = 0; r < n; ++r) {
+        const char* p = buf + offsets[r];
+        const int64_t len = offsets[r + 1] - offsets[r];
+        float* row = out + r * stride + col_offset;
+        int64_t t = 0;
+        for (int64_t i = 0; i <= len; ++i) {
+            unsigned char c = (i < len) ? (unsigned char)p[i] : 0;
+            if (i < len && is_word(c)) {
+                if (t < (int64_t)sizeof(tok))
+                    tok[t++] = lowercase && c >= 'A' && c <= 'Z'
+                                   ? c + 32 : c;
+            } else if (t > 0) {
+                uint32_t h = crc32_update(0u, tok, t);
+                int64_t b = (int64_t)(h % (uint32_t)num_bins);
+                if (binary_freq) row[b] = 1.0f;
+                else row[b] += 1.0f;
+                t = 0;
+            }
+        }
+    }
+}
+
+}  // extern "C"

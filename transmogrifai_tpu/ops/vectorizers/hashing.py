@@ -33,6 +33,32 @@ __all__ = ["TextHashingVectorizer", "hash_token"]
 
 _TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
 
+#: ctypes handle to the native tokenizer+hasher (None -> pure Python)
+_native_lib = None
+_native_tried = False
+
+
+def _native():
+    """Build/load the C++ tokenizer-hasher once (None when unavailable)."""
+    global _native_lib, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        from transmogrifai_tpu.native import build_and_load
+        lib = build_and_load("text_hashing.cpp", "texthash")
+        if lib is not None:
+            import ctypes
+            lib.hash_tokens_batch.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64,
+            ]
+            lib.hash_tokens_batch.restype = None
+        _native_lib = lib
+    return _native_lib
+
 
 def hash_token(token: str, num_bins: int) -> int:
     return zlib.crc32(token.encode("utf-8")) % num_bins
@@ -93,16 +119,49 @@ class TextHashingVectorizer(HostTransformer):
                 row[hash_width + i] = 1.0
         return row
 
+    def _native_column(self, col: fr.HostColumn, out: np.ndarray,
+                       col_offset: int) -> bool:
+        """Hash one column via the C++ path. Returns False when the column
+        needs the Python path (non-ASCII text or very long rows — the
+        native tokenizer is exact only for ASCII; parity with the Python
+        row path is a contract)."""
+        lib = _native()
+        if lib is None:
+            return False
+        # eligibility pre-scan first: a late ineligible row must not waste
+        # a full encode pass before the Python fallback redoes the column
+        if not all(v is None or (v.isascii() and len(v) <= 4000)
+                   for v in col.values):
+            return False
+        parts: list[bytes] = []
+        lens = np.zeros(len(col) + 1, dtype=np.int64)
+        for r in range(len(col)):
+            v = col.values[r]
+            if v is None:
+                continue  # zero-length row: no tokens
+            b = v.encode("ascii")
+            parts.append(b)
+            lens[r + 1] = len(b)
+        offsets = np.cumsum(lens).astype(np.int64)
+        lib.hash_tokens_batch(
+            b"".join(parts), offsets, np.int64(len(col)),
+            np.int32(self.num_features), np.int32(self.lowercase),
+            np.int32(self.binary_freq), out, np.int64(out.shape[1]),
+            np.int64(col_offset))
+        return True
+
     def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
         n = len(cols[0])
         hash_width, offsets, total = self._layout(len(cols))
         out = np.zeros((n, total), dtype=np.float32)
         for i, col in enumerate(cols):
-            for r in range(n):
-                v = col.values[r]
-                self._accumulate(v, out[r], offsets[i])
-                if self.track_nulls and v is None:
-                    out[r, hash_width + i] = 1.0
+            if not self._native_column(col, out, offsets[i]):
+                for r in range(n):
+                    self._accumulate(col.values[r], out[r], offsets[i])
+            if self.track_nulls:
+                for r in range(n):
+                    if col.values[r] is None:
+                        out[r, hash_width + i] = 1.0
         return fr.HostColumn(ft.OPVector, out, meta=self._meta(len(cols)))
 
     def _meta(self, n_inputs: int) -> VectorMetadata:
