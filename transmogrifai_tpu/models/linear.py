@@ -106,6 +106,58 @@ def _train_linear(X, y, w, reg_param, elastic_net, *, loss_kind: str,
     return W_orig, b_orig, losses[-1]
 
 
+@functools.partial(jax.jit, static_argnames=("n_iter", "fit_intercept",
+                                             "standardize"))
+def _train_logistic_newton(X, y, w, reg_param, *, n_iter: int = 15,
+                           fit_intercept: bool, standardize: bool):
+    """Binary L2 logistic via damped Newton/IRLS — the workhorse grid
+    points (elastic_net=0) converge in ~10 steps instead of hundreds of
+    first-order ones; each step is two MXU matmuls (X^T R X, X^T r) and a
+    [d+1,d+1] solve. Spark's LR uses L-BFGS for the same reason; Newton is
+    the TPU-friendly second-order choice because the Hessian build is a
+    matmul.
+
+    Trained in margin space u (z = Xs @ u + b); returns the equivalent
+    2-column softmax weights so outputs match ``_train_linear`` exactly.
+    """
+    n, d = X.shape
+    if standardize:
+        mu, sd = _standardize_stats(X, w)
+        Xs = (X - mu) / sd
+    else:
+        mu, sd = jnp.zeros(d), jnp.ones(d)
+        Xs = X
+    wsum = jnp.maximum(jnp.sum(w), 1.0)
+    # softmax-space penalty reg*0.5*||W||^2 with W=[-u/2, u/2] equals
+    # margin-space 0.5*(reg/2)*||u||^2
+    lam = reg_param * 0.5
+    Xb = jnp.concatenate([Xs, jnp.ones((n, 1), Xs.dtype)], axis=1)
+
+    penalty_mask = jnp.ones(d + 1).at[-1].set(0.0)  # intercept unpenalized
+
+    def step(uv, _):
+        z = Xb @ uv
+        p = jax.nn.sigmoid(z)
+        r = w * (p - y) / wsum
+        R = w * jnp.maximum(p * (1.0 - p), 1e-6) / wsum
+        g = Xb.T @ r + lam * penalty_mask * uv
+        H = (Xb * R[:, None]).T @ Xb
+        H = H + jnp.diag(lam * penalty_mask + 1e-8)
+        delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+        if not fit_intercept:
+            delta = delta.at[-1].set(0.0)
+        return uv - delta, 0.0
+
+    uv0 = jnp.zeros(d + 1, jnp.float32)
+    uv, _ = jax.lax.scan(step, uv0, None, length=n_iter)
+    u, bu = uv[:d], uv[d]
+    # margin space -> equivalent 2-column softmax weights, unstandardized
+    W = jnp.stack([-u / 2.0, u / 2.0], axis=1) / sd[:, None]
+    b = jnp.stack([-bu / 2.0, bu / 2.0])
+    b = b - (mu / sd) @ jnp.stack([-u / 2.0, u / 2.0], axis=1)
+    return W, b, jnp.float32(0.0)
+
+
 def _run_grid(X, y, w, grid: Sequence[dict], defaults: dict, kw: dict):
     """Train the whole grid as one stacked-axis vmapped program. Static
     config (max_iter etc.) must agree across the grid; the regularization
@@ -277,9 +329,60 @@ class _LinearPredictor(Predictor):
 
 
 class OpLogisticRegression(_LinearPredictor):
-    """Multinomial/binary logistic regression (softmax NLL + elastic net)."""
+    """Multinomial/binary logistic regression (softmax NLL + elastic net).
+
+    Binary L2-only fits (elastic_net_param=0, the AutoML default grid's
+    workhorse) take the Newton/IRLS fast path — ~15 second-order steps
+    instead of ``max_iter`` first-order ones; L1 points and multiclass stay
+    on the Adam path. Capped at ``_NEWTON_MAX_D`` features (the Hessian is
+    [d+1, d+1]).
+    """
+
     loss_kind = "softmax"
     probabilistic = True
+
+    _NEWTON_MAX_D = 2048
+
+    def _newton_ok(self, params, X, y) -> bool:
+        return (float(params.get("elastic_net_param", 0.0)) == 0.0
+                and int(X.shape[1]) <= self._NEWTON_MAX_D
+                and self._n_classes(y) == 2)
+
+    def fit_arrays(self, X, y, w, params):
+        params = {**self.params, **params}
+        if self._newton_ok(params, X, y):
+            W, b, _ = _train_logistic_newton(
+                X, y, w, jnp.float32(params["reg_param"]),
+                fit_intercept=bool(params["fit_intercept"]),
+                standardize=bool(params["standardization"]))
+            return self._make_model(W, b)
+        return super().fit_arrays(X, y, w, params)
+
+    def grid_fit_arrays(self, X, y, w, grid):
+        if not grid:
+            return []
+        merged = [{**self.params, **g} for g in grid]
+        newton_idx = [i for i, g in enumerate(merged)
+                      if self._newton_ok(g, X, y)]
+        if not newton_idx:
+            return super().grid_fit_arrays(X, y, w, grid)
+        adam_idx = [i for i in range(len(grid)) if i not in set(newton_idx)]
+        models: list = [None] * len(grid)
+        # Newton points as one vmapped program over reg_param
+        rp = jnp.asarray([merged[i]["reg_param"] for i in newton_idx],
+                         jnp.float32)
+        g0 = merged[newton_idx[0]]
+        Ws, bs, _ = jax.vmap(lambda r: _train_logistic_newton(
+            X, y, w, r, fit_intercept=bool(g0["fit_intercept"]),
+            standardize=bool(g0["standardization"])))(rp)
+        for j, i in enumerate(newton_idx):
+            models[i] = self._make_model(Ws[j], bs[j])
+        if adam_idx:
+            rest = super().grid_fit_arrays(X, y, w,
+                                           [grid[i] for i in adam_idx])
+            for j, i in enumerate(adam_idx):
+                models[i] = rest[j]
+        return models
 
 
 class OpLinearSVC(_LinearPredictor):
