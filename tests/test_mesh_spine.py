@@ -1,0 +1,210 @@
+"""Mesh-is-the-spine tests: the PRODUCTION pipeline under an active mesh.
+
+Parity model: the reference distributes every transform/stat through Spark
+(FitStagesUtil.scala:96-119, SanityChecker.scala:265-272). Here the same
+workflows run under the fake 8-device CPU mesh and must match the unsharded
+single-device results numerically — including row counts that do NOT divide
+the mesh (auto-padding with masked/weighted identity rows).
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import DagExecutor, compute_dag
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, DataSplitter,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _mixed_frame(n, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n).astype(float)
+    cat = rng.choice(["a", "b", "c"], n)
+    vals = rng.normal(size=n) + 0.6 * y
+    vals2 = rng.normal(size=n)
+    mask = rng.uniform(size=n) > 0.1
+    num = [float(v) if m else None for v, m in zip(vals, mask)]
+    return fr.HostFrame.from_dict({
+        "num": (ft.Real, num),
+        "num2": (ft.Real, vals2.tolist()),
+        "cat": (ft.PickList, cat.tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+
+
+def _automl(frame):
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    checked = label.transform_with(SanityChecker(), vec)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, seed=3,
+        models_and_parameters=[
+            (OpLogisticRegression(max_iter=30),
+             [{"reg_param": r} for r in (0.01, 0.05)])],
+        splitter=DataSplitter(reserve_test_fraction=0.2, seed=3))
+    pred = label.transform_with(sel, checked)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred).train())
+    scores = model.score(frame)
+    probs = np.asarray([v["probability_1"]
+                        for v in scores.columns[scores.names()[-1]].values])
+    return model, probs
+
+
+# row counts: divisible by 8 and (crucially) not
+@pytest.mark.parametrize("n", [160, 203])
+def test_full_automl_mesh_parity(n, mesh8):
+    frame = _mixed_frame(n)
+    model_m, probs_m = _automl(frame)
+    assert probs_m.shape[0] == n and np.all(np.isfinite(probs_m))
+    s = model_m.selector_summary()
+    assert s is not None and s.holdout_evaluation
+
+
+@pytest.mark.parametrize("n", [203])
+def test_full_automl_matches_unsharded(n, mesh8):
+    frame = _mixed_frame(n)
+    _, probs_mesh = _automl(frame)
+    # rebuild the DAG fresh (UIDs differ, data identical) without the mesh
+    from transmogrifai_tpu.parallel.mesh import _current
+    token = _current.set(None)
+    try:
+        _, probs_single = _automl(_mixed_frame(n))
+    finally:
+        _current.reset(token)
+    err = np.max(np.abs(probs_mesh - probs_single))
+    assert err < 5e-3, f"mesh vs unsharded divergence {err}"
+
+
+def test_sanity_checker_stats_mesh_parity(mesh8):
+    """SanityChecker's psum-routed moments equal the single-device values on
+    a non-divisible row count (padding contributes monoid identity)."""
+    n = 203
+    frame = _mixed_frame(n, seed=5)
+
+    def run_checker():
+        feats = FeatureBuilder.from_frame(frame, response="label")
+        label = feats.pop("label")
+        vec = transmogrify(list(feats.values()), min_support=1)
+        checked = label.transform_with(SanityChecker(), vec)
+        data = PipelineData.from_host(frame)
+        _, fitted = DagExecutor().fit_transform(data, compute_dag([checked]))
+        return [t for layer in fitted for t in layer
+                if type(t).__name__ == "DropIndicesModel"][0].summary
+
+    s_mesh = run_checker()
+    from transmogrifai_tpu.parallel.mesh import _current
+    token = _current.set(None)
+    try:
+        s_single = run_checker()
+    finally:
+        _current.reset(token)
+
+    assert s_mesh.dropped == s_single.dropped
+    for cm, cs in zip(s_mesh.column_stats, s_single.column_stats):
+        assert cm.mean == pytest.approx(cs.mean, abs=1e-4)
+        assert cm.variance == pytest.approx(cs.variance, abs=1e-4)
+        assert cm.min == pytest.approx(cs.min, abs=1e-5)
+        assert cm.max == pytest.approx(cs.max, abs=1e-5)
+        if np.isfinite(cm.corr_label) or np.isfinite(cs.corr_label):
+            assert cm.corr_label == pytest.approx(cs.corr_label, abs=1e-4)
+
+
+def test_mesh4x2_grid_sharded_over_model(mesh4x2):
+    """Under a (4 data, 2 model) mesh the 4-point LR grid trains with its
+    candidate axis sharded over 'model' and rows padded over 'data'."""
+    n = 101  # not divisible by 4
+    frame = _mixed_frame(n, seed=9)
+    model, probs = _automl(frame)
+    assert probs.shape[0] == n and np.all(np.isfinite(probs))
+
+
+def test_pipeline_data_pads_and_slices(mesh8):
+    n = 13  # pads to 16 on an 8-device data axis
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=n)
+    frame = fr.HostFrame.from_dict({"a": (ft.Real, vals.tolist())})
+    data = PipelineData.from_host(frame)
+    col = data.device_col("a")
+    assert int(col.values.shape[0]) == 16  # padded
+    assert data.n_rows == n                # logical
+    m = np.asarray(data.row_mask())
+    assert m.sum() == n and m[n:].sum() == 0
+    back = data.host_col("a")              # pull slices padding off
+    assert len(back) == n
+    np.testing.assert_allclose(np.asarray(back.values), vals, rtol=1e-6)
+
+
+def test_spearman_and_feature_corr_drop():
+    """Spearman label correlation + maxFeatureCorr transitive drop semantics
+    (reference DerivedFeatureFilterUtils.reasonsToRemove: the LATER column
+    of a too-correlated pair drops)."""
+    n = 400
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 2, n).astype(float)
+    base = rng.normal(size=n) + 0.8 * y
+    dup = base * 2.0 + 1e-3 * rng.normal(size=n)  # ~perfectly corr with base
+    # monotone-but-nonlinear relation: strong Spearman, weaker Pearson
+    mono = np.exp(base / 2)
+    frame = fr.HostFrame.from_dict({
+        "base": (ft.Real, base.tolist()),
+        "dup": (ft.Real, dup.tolist()),
+        "mono": (ft.Real, mono.tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    checked = label.transform_with(
+        SanityChecker(correlation_type="spearman",
+                      max_feature_correlation=0.95), vec)
+    data = PipelineData.from_host(frame)
+    _, fitted = DagExecutor().fit_transform(data, compute_dag([checked]))
+    model = [t for layer in fitted for t in layer
+             if type(t).__name__ == "DropIndicesModel"][0]
+    s = model.summary
+    assert s.correlation_type == "spearman"
+    by_name = {c.name: c for c in s.column_stats}
+    base_col = next(c for nm, c in by_name.items() if nm.startswith("base"))
+    dup_col = next(c for nm, c in by_name.items() if nm.startswith("dup"))
+    mono_col = next(c for nm, c in by_name.items() if nm.startswith("mono"))
+    # spearman(mono, label) == spearman(base, label): ranks are identical
+    assert mono_col.corr_label == pytest.approx(base_col.corr_label, abs=1e-6)
+    # the later of the (base, dup) pair drops on feature-feature corr
+    assert not base_col.dropped
+    assert dup_col.dropped
+    assert any("feature correlation" in r for r in dup_col.reasons)
+
+
+def test_sampling_cap():
+    n = 500
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 2, n).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "a": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    checked = label.transform_with(
+        SanityChecker(sample_upper_limit=200), vec)
+    data = PipelineData.from_host(frame)
+    _, fitted = DagExecutor().fit_transform(data, compute_dag([checked]))
+    model = [t for layer in fitted for t in layer
+             if type(t).__name__ == "DropIndicesModel"][0]
+    s = model.summary
+    assert s.n_rows == 200
+    assert s.sample_fraction == pytest.approx(0.4)
+    # statistics still sane on the sample
+    a_col = next(c for c in s.column_stats if c.name.startswith("a"))
+    assert 0.2 < a_col.corr_label < 0.9

@@ -158,6 +158,18 @@ def _train_logistic_newton(X, y, w, reg_param, *, n_iter: int = 15,
     return W, b, jnp.float32(0.0)
 
 
+def _shard_candidates(*arrs):
+    """Shard the leading (candidate/grid) axis over the mesh "model" axis
+    when one is active — the grid sweep then runs 2-D parallel: rows over
+    "data" (X is row-sharded), candidates over "model" (SURVEY §2.7 P3)."""
+    from transmogrifai_tpu.parallel import mesh as pmesh
+    ctx = pmesh.current_mesh()
+    if ctx is None or ctx.n_model <= 1 or arrs[0].shape[0] % ctx.n_model:
+        return arrs
+    return tuple(jax.device_put(a, ctx.model_sharding(
+        *([None] * (a.ndim - 1)))) for a in arrs)
+
+
 def _run_grid(X, y, w, grid: Sequence[dict], defaults: dict, kw: dict):
     """Train the whole grid as one stacked-axis vmapped program. Static
     config (max_iter etc.) must agree across the grid; the regularization
@@ -166,6 +178,7 @@ def _run_grid(X, y, w, grid: Sequence[dict], defaults: dict, kw: dict):
                      jnp.float32)
     en = jnp.asarray([float({**defaults, **g}["elastic_net_param"]) for g in grid],
                      jnp.float32)
+    rp, en = _shard_candidates(rp, en)
     f = jax.vmap(lambda r, e: _train_linear(X, y, w, r, e, **kw))
     return f(rp, en)
 
@@ -306,10 +319,25 @@ class _LinearPredictor(Predictor):
     def grid_fit_arrays(self, X, y, w, grid):
         if not grid:
             return []
-        kw = self._static_kw({**self.params, **grid[0]}, self._n_classes(y))
-        Ws, bs, _ = _run_grid(X, y, w, grid, self.params, kw)
-        # keep per-model weights as device views — no host pull in the sweep
-        return [self._make_model(Ws[i], bs[i]) for i in range(len(grid))]
+        # group grid points by their static flags (max_iter/intercept/
+        # standardization are compile-time constants): one vmapped program
+        # per distinct combo, so a mixed grid never silently trains with
+        # another point's flags
+        merged = [{**self.params, **g} for g in grid]
+        models: list = [None] * len(grid)
+        by_kw: dict[tuple, list[int]] = {}
+        for i, g in enumerate(merged):
+            key = (int(g["max_iter"]), bool(g["fit_intercept"]),
+                   bool(g["standardization"]))
+            by_kw.setdefault(key, []).append(i)
+        for idxs in by_kw.values():
+            kw = self._static_kw(merged[idxs[0]], self._n_classes(y))
+            Ws, bs, _ = _run_grid(X, y, w, [grid[i] for i in idxs],
+                                  self.params, kw)
+            # keep per-model weights as device views — no host pull in sweep
+            for j, i in enumerate(idxs):
+                models[i] = self._make_model(Ws[j], bs[j])
+        return models
 
     def grid_predict_scores(self, models, X):
         """All grid candidates score in one einsum: [G, n] margins
@@ -368,15 +396,22 @@ class OpLogisticRegression(_LinearPredictor):
             return super().grid_fit_arrays(X, y, w, grid)
         adam_idx = [i for i in range(len(grid)) if i not in set(newton_idx)]
         models: list = [None] * len(grid)
-        # Newton points as one vmapped program over reg_param
-        rp = jnp.asarray([merged[i]["reg_param"] for i in newton_idx],
-                         jnp.float32)
-        g0 = merged[newton_idx[0]]
-        Ws, bs, _ = jax.vmap(lambda r: _train_logistic_newton(
-            X, y, w, r, fit_intercept=bool(g0["fit_intercept"]),
-            standardize=bool(g0["standardization"])))(rp)
-        for j, i in enumerate(newton_idx):
-            models[i] = self._make_model(Ws[j], bs[j])
+        # Newton points vmapped over reg_param, one program per distinct
+        # (fit_intercept, standardization) combo — those flags are static
+        # and must not silently inherit the first grid point's values
+        by_flags: dict[tuple[bool, bool], list[int]] = {}
+        for i in newton_idx:
+            key = (bool(merged[i]["fit_intercept"]),
+                   bool(merged[i]["standardization"]))
+            by_flags.setdefault(key, []).append(i)
+        for (fit_b, std_b), idxs in by_flags.items():
+            rp = jnp.asarray([merged[i]["reg_param"] for i in idxs],
+                             jnp.float32)
+            rp, = _shard_candidates(rp)
+            Ws, bs, _ = jax.vmap(lambda r: _train_logistic_newton(
+                X, y, w, r, fit_intercept=fit_b, standardize=std_b))(rp)
+            for j, i in enumerate(idxs):
+                models[i] = self._make_model(Ws[j], bs[j])
         if adam_idx:
             rest = super().grid_fit_arrays(X, y, w,
                                            [grid[i] for i in adam_idx])

@@ -44,20 +44,26 @@ def tree_pmin(tree: Any, axis: str = DATA_AXIS) -> Any:
 
 def mesh_reduce_stats(ctx: MeshContext,
                       local_stats_fn: Callable[..., Any],
-                      *row_sharded_args: jax.Array) -> Any:
-    """Run a per-shard statistics function over row-sharded inputs and psum
-    the resulting monoid pytree across the data axis.
+                      *row_sharded_args: jax.Array,
+                      reduce: Callable[[Any], Any] | None = None) -> Any:
+    """Run a per-shard statistics function over row-sharded inputs and
+    all-reduce the resulting monoid pytree across the data axis.
 
     ``local_stats_fn(*shard_args) -> stats pytree`` sees only its shard of the
     rows (masked rows contribute identity). The result is replicated.
     This is the direct analog of the reference's
     ``rdd.map(prepare).reduce(monoid.plus)``.
+
+    ``reduce`` combines the per-shard pytrees (default ``tree_psum``); pass a
+    custom combiner for non-additive monoids, e.g. one that psums sums but
+    pmins/pmaxes extrema — it runs inside shard_map with the data axis bound.
     """
+    combine = reduce if reduce is not None else tree_psum
     in_specs = tuple(
         P(DATA_AXIS, *([None] * (a.ndim - 1))) for a in row_sharded_args)
 
     def shard_fn(*args):
-        return tree_psum(local_stats_fn(*args), DATA_AXIS)
+        return combine(local_stats_fn(*args))
 
     fn = jax.shard_map(shard_fn, mesh=ctx.mesh, in_specs=in_specs,
                        out_specs=P())

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "MeshContext", "make_mesh", "use_mesh", "current_mesh", "row_sharding",
     "replicated", "pad_rows", "shard_rows", "num_data_shards",
+    "pad_and_shard_rows", "shard_training_rows",
 ]
 
 DATA_AXIS = "data"
@@ -127,3 +128,41 @@ def shard_rows(arr: jax.Array) -> jax.Array:
         return arr
     spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
     return jax.device_put(arr, NamedSharding(ctx.mesh, spec))
+
+
+def pad_and_shard_rows(arr, pad_value=0.0):
+    """Pad the row axis up to a multiple of the data-axis size, then shard.
+
+    The device_put row-sharding path requires the leading dim to divide the
+    mesh; padded slots are poisoned with ``pad_value`` (callers pair this
+    with a zeroed mask/weight so every masked statistic ignores them).
+    Accepts numpy or jax arrays; pads on host before transfer. No-op
+    without an active mesh.
+    """
+    ctx = current_mesh()
+    if ctx is None:
+        return arr
+    n = int(arr.shape[0])
+    n_pad = pad_rows(n, ctx.n_data)
+    if n_pad != n:
+        width = [(0, n_pad - n)] + [(0, 0)] * (arr.ndim - 1)
+        if isinstance(arr, np.ndarray):
+            arr = np.pad(arr, width, constant_values=pad_value)
+        else:
+            import jax.numpy as jnp
+            arr = jnp.pad(arr, width, constant_values=pad_value)
+    return shard_rows(arr)
+
+
+def shard_training_rows(X, y, w):
+    """Distribute one (features, label, weight) training set over the mesh:
+    rows padded to the data-axis multiple with weight 0, so every weighted
+    trainer (`fit_arrays(X, y, w, ...)`) computes identical results sharded
+    or not. No-op without an active mesh. This is the seam that makes the
+    ModelSelector sweep row-parallel (reference P1 pervasiveness:
+    FitStagesUtil.scala:96-119 — every fit is distributed)."""
+    ctx = current_mesh()
+    if ctx is None:
+        return X, y, w
+    return (pad_and_shard_rows(X), pad_and_shard_rows(y),
+            pad_and_shard_rows(w, pad_value=0.0))

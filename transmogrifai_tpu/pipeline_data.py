@@ -11,8 +11,9 @@ residencies:
 - device outputs pull back to host only at the edges (save/inspect/local).
 
 When a mesh is active, device placement shards the row axis over the "data"
-axis (when divisible; callers controlling batch shape pad via
-``parallel.pad_rows``).
+axis, padding non-divisible row counts up to the mesh multiple (padded slots
+carry mask=0 / code=-1 so masked statistics ignore them; ``row_mask`` exposes
+the validity vector and host pulls slice the padding back off).
 """
 
 from __future__ import annotations
@@ -29,19 +30,21 @@ from transmogrifai_tpu.types import feature_types as ft
 __all__ = ["PipelineData"]
 
 
-def _shard(arr):
-    ctx = pmesh.current_mesh()
-    if ctx is None or arr.shape[0] % ctx.n_data != 0:
-        return arr
-    return pmesh.shard_rows(arr)
+def _shard(arr, pad_value=0.0):
+    return pmesh.pad_and_shard_rows(arr, pad_value=pad_value)
 
 
 class PipelineData:
     def __init__(self, host: fr.HostFrame,
-                 device: Optional[Mapping[str, Any]] = None):
+                 device: Optional[Mapping[str, Any]] = None,
+                 n_rows_logical: Optional[int] = None):
         self.host = host
         self.device: dict[str, Any] = dict(device or {})
         self._codes_cache: dict[str, fr.CodesColumn] = {}
+        #: true (unpadded) row count; device columns may carry mesh padding
+        self._n_logical = n_rows_logical if n_rows_logical is not None \
+            else (host.n_rows or None)
+        self._row_mask = None
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -50,6 +53,8 @@ class PipelineData:
 
     @property
     def n_rows(self) -> int:
+        if self._n_logical is not None:
+            return self._n_logical
         if self.host.n_rows:
             return self.host.n_rows
         for c in self.device.values():
@@ -57,6 +62,19 @@ class PipelineData:
             if v is not None:
                 return int(v.shape[0])
         return 0
+
+    def row_mask(self) -> jnp.ndarray:
+        """Device validity vector over the (possibly padded) row axis:
+        1.0 for real rows, 0.0 for mesh-padding slots. Statistics stages
+        weight by this so padded rows contribute monoid identity."""
+        if self._row_mask is None:
+            n = self.n_rows
+            ctx = pmesh.current_mesh()
+            n_pad = pmesh.pad_rows(n) if ctx is not None else n
+            mask = np.zeros(n_pad, np.float32)
+            mask[:n] = 1.0
+            self._row_mask = _shard(jnp.asarray(mask))
+        return self._row_mask
 
     def has(self, name: str) -> bool:
         return name in self.device or name in self.host
@@ -107,8 +125,8 @@ class PipelineData:
                          for _, c in pending], axis=1)
         masks = np.stack([c.mask.astype(np.float32) for _, c in pending],
                          axis=1)
-        dvals = _shard(jnp.asarray(vals))
-        dmasks = _shard(jnp.asarray(masks))
+        dvals = _shard(vals)
+        dmasks = _shard(masks)
         for i, (name, _) in enumerate(pending):
             self.device[name] = fr.NumericColumn(dvals[:, i], dmasks[:, i])
 
@@ -119,27 +137,28 @@ class PipelineData:
         codes = np.fromiter(
             (index.get(v, -1) if v is not None else -1 for v in col.values),
             count=len(col), dtype=np.int32)
-        return fr.CodesColumn(_shard(jnp.asarray(codes)), tuple(vocab))
+        return fr.CodesColumn(_shard(codes, pad_value=-1), tuple(vocab))
 
-    @staticmethod
-    def _device_to_host(col: Any) -> fr.HostColumn:
+    def _device_to_host(self, col: Any) -> fr.HostColumn:
+        n = self.n_rows  # slice mesh padding back off on host pull
         if isinstance(col, fr.NumericColumn):
-            vals = np.asarray(col.values, dtype=np.float64)
-            mask = np.asarray(col.mask) > 0.5
+            vals = np.asarray(col.values, dtype=np.float64)[:n]
+            mask = (np.asarray(col.mask) > 0.5)[:n]
             return fr.HostColumn(ft.Real, vals, mask)
         if isinstance(col, fr.VectorColumn):
-            return fr.HostColumn(ft.OPVector, np.asarray(col.values, np.float32),
+            return fr.HostColumn(ft.OPVector,
+                                 np.asarray(col.values, np.float32)[:n],
                                  meta=col.metadata)
         if isinstance(col, fr.CodesColumn):
-            codes = np.asarray(col.codes)
+            codes = np.asarray(col.codes)[:n]
             vals = np.empty(codes.shape[0], dtype=object)
             for i, c in enumerate(codes):
                 vals[i] = col.vocab[c] if c >= 0 else None
             return fr.HostColumn(ft.Text, vals)
         if isinstance(col, fr.PredictionColumn):
-            pred = np.asarray(col.prediction, np.float64)
-            raw = np.asarray(col.raw_prediction, np.float64)
-            prob = np.asarray(col.probability, np.float64)
+            pred = np.asarray(col.prediction, np.float64)[:n]
+            raw = np.asarray(col.raw_prediction, np.float64)[:n]
+            prob = np.asarray(col.probability, np.float64)[:n]
             vals = np.empty(pred.shape[0], dtype=object)
             for i in range(pred.shape[0]):
                 vals[i] = ft.Prediction.make(pred[i], raw[i], prob[i]).value
@@ -148,20 +167,23 @@ class PipelineData:
 
     # -- updates -------------------------------------------------------------
     def with_host_cols(self, new: Mapping[str, fr.HostColumn]) -> "PipelineData":
-        return PipelineData(self.host.with_columns(new), self.device)
+        return PipelineData(self.host.with_columns(new), self.device,
+                            n_rows_logical=self._n_logical)
 
     def with_device_cols(self, new: Mapping[str, Any]) -> "PipelineData":
         dev = dict(self.device)
         dev.update(new)
-        out = PipelineData(self.host, dev)
+        out = PipelineData(self.host, dev, n_rows_logical=self._n_logical)
         out._codes_cache = self._codes_cache
+        out._row_mask = self._row_mask
         return out
 
     def select_result(self, names: Iterable[str]) -> "PipelineData":
         names = list(names)
         host_cols = {n: self.host[n] for n in names if n in self.host}
         dev_cols = {n: self.device[n] for n in names if n in self.device}
-        return PipelineData(fr.HostFrame(host_cols, self.host.key), dev_cols)
+        return PipelineData(fr.HostFrame(host_cols, self.host.key), dev_cols,
+                            n_rows_logical=self._n_logical)
 
     # -- row-axis ops (splits) ----------------------------------------------
     def take(self, idx: np.ndarray) -> "PipelineData":
@@ -182,8 +204,9 @@ class PipelineData:
             else:
                 raise TypeError(f"take: unsupported device column {type(c)}")
         if self.host.names():
-            return PipelineData(host, dev)
-        return PipelineData(fr.HostFrame({}, None), dev)
+            return PipelineData(host, dev, n_rows_logical=len(idx))
+        return PipelineData(fr.HostFrame({}, None), dev,
+                            n_rows_logical=len(idx))
 
     def vector_meta(self, name: str):
         col = self.device.get(name)

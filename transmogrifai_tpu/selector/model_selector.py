@@ -221,10 +221,15 @@ class ModelSelector(Estimator):
                                            list[tuple[float, int, int]]]:
         """Run every (candidate, grid point) over the fold arrays; returns
         per-candidate evaluations and (mean metric, cand, grid) triples."""
+        from transmogrifai_tpu.parallel import mesh as pmesh
         ev0 = self.evaluators[0]
         batch_metrics = getattr(ev0, "metric_batch_scores", None)
         per_candidate_scores: dict[tuple[int, int], list[float]] = {}
         for Xtr, ytr, wtr, Xva, yva in fold_arrays:
+            # row-parallel training over the mesh: fold rows padded to the
+            # data-axis multiple with weight 0 (validation stays unpadded —
+            # metrics must see real rows only)
+            Xtr, ytr, wtr = pmesh.shard_training_rows(Xtr, ytr, wtr)
             for ci, (est, grid) in enumerate(self.models_and_grids):
                 models = est.grid_fit_arrays(Xtr, ytr, wtr, grid)
                 scores = (est.grid_predict_scores(models, Xva)
@@ -261,13 +266,15 @@ class ModelSelector(Estimator):
                   prep_results: dict, t0: float) -> SelectedModel:
         """Refit the winning candidate on the full prepared training data,
         evaluate train + holdout, assemble the summary."""
+        from transmogrifai_tpu.parallel import mesh as pmesh
         ev0 = self.evaluators[0]
         bigger = ev0.larger_is_better(self.validation_metric)
         _, best_ci, best_gj = (max if bigger else min)(
             mean_metrics, key=lambda t: t[0])
         best_est, best_grid = self.models_and_grids[best_ci]
         best_params = {**best_est.params, **best_grid[best_gj]}
-        best_model = best_est.fit_arrays(Xt, yt, wt, best_params)
+        best_model = best_est.fit_arrays(
+            *pmesh.shard_training_rows(Xt, yt, wt), best_params)
 
         train_eval: dict = {}
         holdout_eval: dict = {}
@@ -302,10 +309,10 @@ class ModelSelector(Estimator):
         label_name, feat_name = self.input_names
         X = data.device_col(feat_name).values
         y = data.device_col(label_name).values
-        n = int(X.shape[0])
+        n = data.n_rows  # logical rows: device arrays may carry mesh padding
 
         train_idx, holdout_idx, w_train, prep_results = \
-            self._split_prepare(n, y)
+            self._split_prepare(n, y[:n])
         Xt, yt = X[jnp.asarray(train_idx)], y[jnp.asarray(train_idx)]
         wt = jnp.asarray(w_train)
         _plog("selector: split+prepare", t0)
@@ -344,10 +351,10 @@ class ModelSelector(Estimator):
         t0 = time.time()
         label_name, feat_name = self.input_names
         y = data.device_col(label_name).values
-        n = int(y.shape[0])
+        n = data.n_rows  # logical rows: device arrays may carry mesh padding
 
         train_idx, holdout_idx, w_train, prep_results = \
-            self._split_prepare(n, y)
+            self._split_prepare(n, y[:n])
         data_train = data.take(train_idx)
         wt_full = jnp.asarray(w_train)
         yt_np = (np.asarray(y)[train_idx]
