@@ -100,6 +100,42 @@ def test_generate_multiclass_project(tmp_path, monkeypatch):
         sys.modules.pop(m, None)
 
 
+def test_generate_text_binary_label_project(tmp_path, monkeypatch):
+    """A text-valued binary response (two non-boolean string labels) must
+    get the string indexer: the binary selector's label input is RealNN
+    (ADVICE r1). Boolean-like strings ('yes'/'no') are inferred Binary by
+    the CSV reader and take the numeric path instead."""
+    data = str(tmp_path / "churn.csv")
+    rng = np.random.default_rng(2)
+    with open(data, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=["id", "a", "b", "churned"])
+        w.writeheader()
+        for i in range(240):
+            a, b = rng.normal(), rng.normal()
+            yes = (1.5 * a - b + rng.normal() * 0.3) > 0
+            w.writerow({"id": i, "a": round(a, 4), "b": round(b, 4),
+                        "churned": "churn" if yes else "stay"})
+    rc = main(["gen", "ChurnProj", "--input", data, "--id", "id",
+               "--response", "churned", "--output", str(tmp_path)])
+    assert rc == 0
+    proj = tmp_path / "ChurnProj"
+    wf_src = (proj / "workflow.py").read_text()
+    assert "BinaryClassificationModelSelector" in wf_src
+    assert "OpStringIndexerNoFilter" in wf_src
+    monkeypatch.chdir(proj)
+    monkeypatch.syspath_prepend(str(proj))
+    for m in ("features", "workflow", "run"):
+        sys.modules.pop(m, None)
+    workflow_mod = importlib.import_module("workflow")
+    model = workflow_mod.make_workflow(data).train()
+    s = model.selector_summary()
+    assert s is not None
+    auroc = s.holdout_evaluation["binary classification"]["au_roc"]
+    assert auroc > 0.75
+    for m in ("features", "workflow", "run"):
+        sys.modules.pop(m, None)
+
+
 def test_generator_errors(tmp_path):
     data = str(tmp_path / "d.csv")
     _write_dataset(data, n=20)

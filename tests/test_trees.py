@@ -126,3 +126,78 @@ def test_multiclass_gbt():
     acc = float((np.asarray(out.prediction) == y).mean())
     assert acc > 0.9
     assert np.asarray(out.probability).shape == (n, 3)
+
+
+def test_grow_tree_chunked_matches_full():
+    """Depth beyond the histogram node budget: the lax.map node-chunked
+    path must produce the same tree as the full-histogram (sibling-
+    subtraction) path."""
+    from transmogrifai_tpu.models.trees import grow_tree
+    rng = np.random.default_rng(3)
+    n, d, B, depth = 2000, 8, 16, 6
+    Xb = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    grad = jnp.asarray(rng.normal(size=n), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.2, 1.0, size=n), jnp.float32)
+    mask = jnp.ones(d, jnp.float32)
+    kw = dict(max_depth=depth, n_bins=B, reg_lambda=jnp.float32(1.0),
+              gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0))
+    f1, b1, l1 = grow_tree(Xb, grad, hess, mask, max_hist_nodes=1024, **kw)
+    f2, b2, l2 = grow_tree(Xb, grad, hess, mask, max_hist_nodes=4, **kw)
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
+def test_depth12_forest_trains_bounded():
+    """Reference Titanic winner shape: RF depth=12 (README.md:60-80) must
+    train with bounded histogram memory — levels 10-11 exceed the node
+    budget and take the chunked path."""
+    rng = np.random.default_rng(5)
+    n = 20_000
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=n)) > 0
+         ).astype(np.float64)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+    w = jnp.ones_like(yj)
+    import transmogrifai_tpu.models.trees as T
+    old = T._MAX_HIST_NODES
+    try:
+        T._MAX_HIST_NODES = 256  # force chunking from level 9 on
+        est = OpRandomForestClassifier(num_trees=8, max_depth=12)
+        model = est.fit_arrays(Xj, yj, w, est.params)
+    finally:
+        T._MAX_HIST_NODES = old
+    pred = model.predict_arrays(Xj)
+    m = OpBinaryClassificationEvaluator().evaluate_arrays(yj, pred)
+    assert m.au_roc > 0.9
+
+
+def test_multiclass_rf_single_program():
+    """Multiclass RF: per-class trees ride ONE vmapped ensemble program
+    (no per-class host-loop refits); probabilities normalize."""
+    rng = np.random.default_rng(11)
+    n = 900
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = np.clip((X[:, 0] > 0.4).astype(int) + 2 * (X[:, 1] > 0).astype(int),
+                0, 2)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y.astype(np.float64))
+    w = jnp.ones_like(yj)
+    est = OpRandomForestClassifier(num_trees=20, max_depth=5)
+    model = est.fit_arrays(Xj, yj, w, est.params)
+    from transmogrifai_tpu.models.trees import TreeEnsembleModel
+    assert isinstance(model, TreeEnsembleModel)  # no wrapper model
+    assert model.n_out == 3
+    out = model.predict_arrays(Xj)
+    prob = np.asarray(out.probability)
+    assert prob.shape == (n, 3)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+    acc = float((np.asarray(out.prediction) == y).mean())
+    assert acc > 0.85
+    # save/load round-trip of the multiclass forest
+    state = model.fitted_state()
+    m2 = TreeEnsembleModel.from_config(model.config())
+    m2.set_fitted_state(state)
+    np.testing.assert_allclose(
+        np.asarray(m2.predict_arrays(Xj).probability), prob, atol=1e-6)

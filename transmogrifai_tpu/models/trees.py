@@ -27,7 +27,7 @@ the class-probability estimate (variance-reduction splits ~ gini for binary).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,8 +49,19 @@ __all__ = [
 # binning
 # ---------------------------------------------------------------------------
 
-def quantile_bin_edges(X: np.ndarray, max_bins: int) -> np.ndarray:
+#: rows used for quantile-edge estimation; above this the percentiles run on
+#: a deterministic subsample (XGBoost's approx-sketch analog — edge jitter of
+#: O(1/sqrt(sample)) is far below bin width at 64 bins)
+_EDGE_SAMPLE_CAP = 2_000_000
+
+
+def quantile_bin_edges(X: np.ndarray, max_bins: int,
+                       seed: int = 0) -> np.ndarray:
     """[d, max_bins-1] quantile edges per feature (host, once per fit)."""
+    if X.shape[0] > _EDGE_SAMPLE_CAP:
+        idx = np.random.default_rng(seed).choice(
+            X.shape[0], size=_EDGE_SAMPLE_CAP, replace=False)
+        X = X[np.sort(idx)]
     qs = np.linspace(0, 100, max_bins + 1)[1:-1]
     edges = np.percentile(X, qs, axis=0).T  # [d, B-1]
     return np.ascontiguousarray(edges, dtype=np.float32)
@@ -79,51 +90,125 @@ def _use_pallas_default() -> bool:
         and jax.default_backend() == "tpu"
 
 
+#: deepest level the Pallas kernel covers: Mosaic's 8-sublane feature tile
+#: puts the one-hot at [8, n_nodes*B*_CHUNK] floats in VMEM — beyond 8
+#: nodes at 64 bins that exceeds the budget; deeper levels take the scatter
+#: path (measured ~parity on-chip anyway, histogram_pallas.py docstring)
+_PALLAS_MAX_NODES = 8
+
+#: histogram node budget per materialized array: [nodes, d, B] f32 x2 (g, h).
+#: At the default (1024, d=28, B=64) that is ~14 MB; levels with more nodes
+#: compute best-splits chunk-by-chunk so HBM stays bounded at any depth.
+_MAX_HIST_NODES = 1024
+
+
+def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
+                 min_child_weight):
+    """XGBoost gain formula over [nodes, d, B] histograms via bin-axis
+    cumsums. Returns per-node (feat, bin): feat -1 / bin B on no-split
+    (Xb <= B is always true -> such nodes route every row left)."""
+    n_nodes, d, B = hist_g.shape
+    GL = jnp.cumsum(hist_g, axis=2)
+    HL = jnp.cumsum(hist_h, axis=2)
+    G = GL[:, :, -1:]
+    H = HL[:, :, -1:]
+    GR = G - GL
+    HR = H - HL
+    gain = 0.5 * (GL ** 2 / (HL + reg_lambda)
+                  + GR ** 2 / (HR + reg_lambda)
+                  - G ** 2 / (H + reg_lambda)) - gamma
+    bad = (HL < min_child_weight) | (HR < min_child_weight)
+    gain = jnp.where(bad, -jnp.inf, gain)
+    gain = jnp.where(feat_mask[None, :, None] > 0, gain, -jnp.inf)
+    # last bin can't split (right side empty by construction)
+    gain = gain.at[:, :, B - 1].set(-jnp.inf)
+    flat_gain = gain.reshape(n_nodes, d * B)
+    best = jnp.argmax(flat_gain, axis=1)
+    best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=1)[:, 0]
+    feat = (best // B).astype(jnp.int32)
+    bin_ = (best % B).astype(jnp.int32)
+    no_split = ~(best_gain > 0.0)
+    feat = jnp.where(no_split, -1, feat)
+    bin_ = jnp.where(no_split, B, bin_)
+    return feat, bin_
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
-                                             "use_pallas"))
+                                             "use_pallas", "max_hist_nodes"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
-              reg_lambda, gamma, min_child_weight, use_pallas: bool = False):
+              reg_lambda, gamma, min_child_weight, use_pallas: bool = False,
+              max_hist_nodes: int = _MAX_HIST_NODES):
     """Level-wise histogram tree. Returns (feats, bins, leaf_values) where
     feats/bins are tuples of per-level [2^level] arrays and leaf_values is
-    [2^max_depth]. grad/hess already carry row weights."""
+    [2^max_depth]. grad/hess already carry row weights.
+
+    Memory discipline for deep trees (reference RF default depth=12,
+    README.md:60-80): while a level's [nodes, d, B] histograms fit
+    ``max_hist_nodes`` they are materialized once and the level uses the
+    classic sibling-subtraction trick — only LEFT children are scattered,
+    right = parent - left, halving scatter work; deeper levels switch to a
+    ``lax.map`` over node chunks that keeps only per-node split decisions,
+    so peak HBM is O(max_hist_nodes * d * B) at any depth.
+    """
     from transmogrifai_tpu.ops.histogram_pallas import (
         node_bin_histogram, node_bin_histogram_xla,
     )
     n, d = Xb.shape
     B = n_bins
+    # node counts are powers of two; round the budget down to one so the
+    # chunked levels tile exactly (a non-power-of-two budget would otherwise
+    # fail deep inside lax.map with a reshape error)
+    max_hist_nodes = 1 << (max(int(max_hist_nodes), 1).bit_length() - 1)
+    split_kw = dict(n_bins=B, reg_lambda=reg_lambda, gamma=gamma,
+                    min_child_weight=min_child_weight)
+
+    def hist_of(node_ids, g, h, n_nodes):
+        if use_pallas and n_nodes <= _PALLAS_MAX_NODES:
+            return node_bin_histogram(Xb, node_ids, g, h,
+                                      n_nodes=n_nodes, n_bins=B)
+        return node_bin_histogram_xla(Xb, node_ids, g, h,
+                                      n_nodes=n_nodes, n_bins=B)
+
     node = jnp.zeros(n, dtype=jnp.int32)
     rows = jnp.arange(n)
     feats_out, bins_out = [], []
+    prev_hist = None  # previous level's full (g, h) histograms, if kept
     for level in range(max_depth):
         n_nodes = 2 ** level
-        if use_pallas:
-            hist_g, hist_h = node_bin_histogram(
-                Xb, node, grad, hess, n_nodes=n_nodes, n_bins=B)
+        if n_nodes <= max_hist_nodes:
+            if prev_hist is None:
+                hist_g, hist_h = hist_of(node, grad, hess, n_nodes)
+            else:
+                # sibling subtraction: scatter left children (even node ids)
+                # under their PARENT index; right = parent - left
+                is_left = (node % 2 == 0).astype(grad.dtype)
+                half = n_nodes // 2
+                lg, lh = hist_of(node // 2, grad * is_left, hess * is_left,
+                                 half)
+                pg, ph = prev_hist
+                hist_g = jnp.stack([lg, pg - lg], axis=1).reshape(
+                    n_nodes, d, B)
+                hist_h = jnp.stack([lh, ph - lh], axis=1).reshape(
+                    n_nodes, d, B)
+            prev_hist = (hist_g, hist_h)
+            feat, bin_ = _best_splits(hist_g, hist_h, feat_mask, **split_kw)
         else:
-            hist_g, hist_h = node_bin_histogram_xla(
-                Xb, node, grad, hess, n_nodes=n_nodes, n_bins=B)
-        GL = jnp.cumsum(hist_g, axis=2)
-        HL = jnp.cumsum(hist_h, axis=2)
-        G = GL[:, :, -1:]
-        H = HL[:, :, -1:]
-        GR = G - GL
-        HR = H - HL
-        gain = 0.5 * (GL ** 2 / (HL + reg_lambda)
-                      + GR ** 2 / (HR + reg_lambda)
-                      - G ** 2 / (H + reg_lambda)) - gamma
-        bad = (HL < min_child_weight) | (HR < min_child_weight)
-        gain = jnp.where(bad, -jnp.inf, gain)
-        gain = jnp.where(feat_mask[None, :, None] > 0, gain, -jnp.inf)
-        # last bin can't split (right side empty by construction)
-        gain = gain.at[:, :, B - 1].set(-jnp.inf)
-        flat_gain = gain.reshape(n_nodes, d * B)
-        best = jnp.argmax(flat_gain, axis=1)
-        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=1)[:, 0]
-        feat = (best // B).astype(jnp.int32)
-        bin_ = (best % B).astype(jnp.int32)
-        no_split = ~(best_gain > 0.0)
-        feat = jnp.where(no_split, -1, feat)
-        bin_ = jnp.where(no_split, B, bin_)  # Xb <= B always true -> left
+            # node-chunked: histogram + split per chunk, O(chunk*d*B) memory
+            prev_hist = None
+            n_chunks = n_nodes // max_hist_nodes
+
+            def chunk_splits(c):
+                base = c * max_hist_nodes
+                in_chunk = ((node >= base) & (node < base + max_hist_nodes))
+                mask = in_chunk.astype(grad.dtype)
+                local = jnp.where(in_chunk, node - base, 0).astype(jnp.int32)
+                hg, hh = hist_of(local, grad * mask, hess * mask,
+                                 max_hist_nodes)
+                return _best_splits(hg, hh, feat_mask, **split_kw)
+
+            feat_c, bin_c = jax.lax.map(chunk_splits, jnp.arange(n_chunks))
+            feat = feat_c.reshape(n_nodes)
+            bin_ = bin_c.reshape(n_nodes)
         feats_out.append(feat)
         bins_out.append(bin_)
         f_row = feat[node]
@@ -158,11 +243,12 @@ def predict_tree(Xb, feats, bins, leaf_values):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
-    "bootstrap", "subsample", "colsample", "use_pallas"))
+    "bootstrap", "subsample", "colsample", "use_pallas", "max_hist_nodes"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
-                   bootstrap: bool, seed: int, use_pallas: bool = False):
+                   bootstrap: bool, seed: int, use_pallas: bool = False,
+                   max_hist_nodes: int = _MAX_HIST_NODES):
     """Train a whole ensemble in one scanned program.
 
     loss: 'logistic' (n_out=1), 'softmax' (n_out=K one-vs-all), 'squared'.
@@ -183,6 +269,12 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
             t = jax.nn.one_hot(y.astype(jnp.int32), n_out)
             p = jax.nn.sigmoid(margin)  # one-vs-all logistic per class
             return p - t, p * (1 - p)
+        if loss == "squared_onehot":
+            # multiclass forest: per-class regression trees on the one-hot
+            # target, all classes vmapped in THIS one program (leaf value =
+            # weighted class frequency, the gini-style probability estimate)
+            t = jax.nn.one_hot(y.astype(jnp.int32), n_out)
+            return margin - t, jnp.ones_like(margin)
         return margin - y[:, None], jnp.ones_like(margin)
 
     def one_round(carry, key):
@@ -207,7 +299,8 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                              max_depth=max_depth, n_bins=n_bins,
                              reg_lambda=reg_lambda, gamma=gamma,
                              min_child_weight=min_child_weight,
-                             use_pallas=use_pallas)
+                             use_pallas=use_pallas,
+                             max_hist_nodes=max_hist_nodes)
 
         feats, bins, leaves = jax.vmap(grow_one, in_axes=(1, 1))(g, h)
         # feats/bins: tuples of [n_out, 2^level]; leaves [n_out, 2^depth]
@@ -406,7 +499,8 @@ class _TreePredictor(Predictor):
             colsample=float(p["colsample"]),
             base_score=jnp.float32(base),
             bootstrap=self.bootstrap, seed=int(p["seed"]),
-            use_pallas=_use_pallas_default())
+            use_pallas=_use_pallas_default(),
+            max_hist_nodes=_MAX_HIST_NODES)
         model = TreeEnsembleModel(
             kind=self.kind, n_out=n_out,
             learning_rate=float(p["learning_rate"]), base_score=base,
@@ -482,7 +576,11 @@ class _ForestMixin:
 
 
 class OpRandomForestClassifier(_ForestMixin, _TreePredictor):
-    """Bootstrap-aggregated probability trees (Spark RF parity)."""
+    """Bootstrap-aggregated probability trees (Spark RF parity).
+
+    Multiclass grows per-class regression trees on the one-hot target with
+    the class axis vmapped inside ONE compiled ensemble program (not K
+    sequential host-loop fits)."""
     kind = "rf_classifier"
     loss = "squared"      # CART variance-reduction on the 0/1 target
 
@@ -490,22 +588,7 @@ class OpRandomForestClassifier(_ForestMixin, _TreePredictor):
         n_classes = int(np.asarray(jnp.max(y))) + 1
         if n_classes <= 2:
             return "squared", 1, 0.0
-        return "softmax_rf", n_classes, 0.0
-
-    def fit_arrays(self, X, y, w, params):
-        loss, n_out, _ = self._loss_and_nout(y)
-        if loss == "softmax_rf":
-            # one regression tree set per class on the one-hot target
-            self_loss, self.loss = self.loss, "squared"
-            models = []
-            y_np = np.asarray(y)
-            for c in range(n_out):
-                yc = jnp.asarray((y_np == c).astype(np.float32))
-                models.append(super().fit_arrays(X, yc, w, params))
-                self.loss = "squared"
-            self.loss = self_loss
-            return _OneVsAllForest(models, n_out=n_out)
-        return super().fit_arrays(X, y, w, params)
+        return "squared_onehot", n_classes, 0.0
 
 
 class OpRandomForestRegressor(_ForestMixin, _TreePredictor):
@@ -540,50 +623,3 @@ class OpDecisionTreeRegressor(OpRandomForestRegressor):
             self.bootstrap = True
 
 
-class _OneVsAllForest(PredictionModel):
-    """Multiclass forest as per-class probability forests."""
-
-    def __init__(self, models: Sequence[TreeEnsembleModel] = (),
-                 n_out: int = 2, uid: Optional[str] = None):
-        self.models = list(models)
-        self.n_out = n_out
-        super().__init__(uid=uid)
-
-    def device_params(self):
-        return tuple(m.device_params() for m in self.models)
-
-    def device_apply(self, params, col):
-        probs = []
-        for m, p in zip(self.models, params):
-            out = m.device_apply(p, col)
-            probs.append(out.probability[:, 1])
-        s = jnp.stack(probs, axis=1)
-        prob = s / jnp.maximum(jnp.sum(s, axis=1, keepdims=True), 1e-12)
-        pred = jnp.argmax(prob, axis=1).astype(jnp.float32)
-        return fr.PredictionColumn(pred, s, prob)
-
-    def fitted_state(self):
-        state = {"n_out": self.n_out}
-        for i, m in enumerate(self.models):
-            for k, v in m.fitted_state().items():
-                state[f"m{i}::{k}"] = v
-            state[f"m{i}::__config__"] = m.config()
-        return state
-
-    def set_fitted_state(self, state):
-        self.n_out = int(state["n_out"])
-        self.models = []
-        for i in range(self.n_out):
-            cfg = state[f"m{i}::__config__"]
-            m = TreeEnsembleModel.from_config(cfg)
-            sub = {k.split("::", 1)[1]: v for k, v in state.items()
-                   if k.startswith(f"m{i}::") and not k.endswith("__config__")}
-            m.set_fitted_state(sub)
-            self.models.append(m)
-
-    def config(self):
-        return {"n_out": self.n_out}
-
-    @classmethod
-    def from_config(cls, config, uid=None):
-        return cls(n_out=config.get("n_out", 2), uid=uid)

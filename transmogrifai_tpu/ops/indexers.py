@@ -62,6 +62,8 @@ class OpStringIndexer(Estimator):
         if handle_invalid not in ("error", "skip"):
             raise ValueError("handle_invalid must be 'error' or 'skip'")
         self.handle_invalid = handle_invalid
+        if handle_invalid == "skip":
+            self.out_type = ft.Real  # unseen labels become nulls
         super().__init__(uid=uid)
 
     def fit_model(self, data):
@@ -106,6 +108,11 @@ class StringIndexerModel(HostTransformer):
         self.handle_invalid = handle_invalid
         self.unseen_name = unseen_name
         self._index = {lb: i for i, lb in enumerate(self.labels)}
+        if handle_invalid == "skip":
+            # skip mode emits None for unseen labels (Spark drops the row;
+            # here nullability must be declared) — the RealNN never-null
+            # contract cannot hold, so the output is nullable Real
+            self.out_type = ft.Real
         super().__init__(uid=uid)
 
     @property

@@ -189,18 +189,23 @@ class PipelineData:
     def take(self, idx: np.ndarray) -> "PipelineData":
         host = self.host.take(idx) if self.host.names() else self.host
         jidx = jnp.asarray(np.asarray(idx))
+        # re-pad + re-shard the gathered rows so fold subsets keep the mesh
+        # invariant (device length == pad_rows(logical), mask 0 on padding) —
+        # row_mask() of the subset must match its device columns' length
         dev = {}
         for n, c in self.device.items():
             if isinstance(c, fr.NumericColumn):
-                dev[n] = fr.NumericColumn(c.values[jidx], c.mask[jidx])
+                dev[n] = fr.NumericColumn(_shard(c.values[jidx]),
+                                          _shard(c.mask[jidx]))
             elif isinstance(c, fr.VectorColumn):
-                dev[n] = fr.VectorColumn(c.values[jidx], c.metadata)
+                dev[n] = fr.VectorColumn(_shard(c.values[jidx]), c.metadata)
             elif isinstance(c, fr.CodesColumn):
-                dev[n] = fr.CodesColumn(c.codes[jidx], c.vocab)
+                dev[n] = fr.CodesColumn(_shard(c.codes[jidx], pad_value=-1),
+                                        c.vocab)
             elif isinstance(c, fr.PredictionColumn):
                 dev[n] = fr.PredictionColumn(
-                    c.prediction[jidx], c.raw_prediction[jidx],
-                    c.probability[jidx])
+                    _shard(c.prediction[jidx]), _shard(c.raw_prediction[jidx]),
+                    _shard(c.probability[jidx]))
             else:
                 raise TypeError(f"take: unsupported device column {type(c)}")
         if self.host.names():

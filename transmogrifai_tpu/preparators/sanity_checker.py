@@ -26,6 +26,7 @@ rows carry weight 0 and contribute monoid identity.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -127,29 +128,26 @@ def _combine_moments(tree):
 _jit_moments = jax.jit(_local_moments)
 
 
+def _rank_1d(x):
+    """Tie-averaged ranks of one vector (Spearman building block)."""
+    s = jnp.sort(x)
+    left = jnp.searchsorted(s, x, side="left")
+    right = jnp.searchsorted(s, x, side="right")
+    return 0.5 * (left + right + 1).astype(jnp.float32)
+
+
 @jax.jit
 def _ranks(X, m):
-    """Tie-averaged ranks per column (Spearman). Masked rows are pushed to
-    +inf so every real row's rank is unaffected; their own ranks are
-    weighted out downstream."""
-    Xm = jnp.where(m[:, None] > 0, X, _BIG)
-
-    def col_rank(x):
-        s = jnp.sort(x)
-        left = jnp.searchsorted(s, x, side="left")
-        right = jnp.searchsorted(s, x, side="right")
-        return 0.5 * (left + right + 1).astype(jnp.float32)
-
-    return jax.vmap(col_rank, in_axes=1, out_axes=1)(Xm)
+    """Tie-averaged ranks per column. Masked rows are pushed to +inf so
+    every real row's rank is unaffected; their own ranks are weighted out
+    downstream."""
+    return jax.vmap(_rank_1d, in_axes=1, out_axes=1)(
+        jnp.where(m[:, None] > 0, X, _BIG))
 
 
 @jax.jit
 def _rank_vec(y, m):
-    ym = jnp.where(m > 0, y, _BIG)
-    s = jnp.sort(ym)
-    left = jnp.searchsorted(s, ym, side="left")
-    right = jnp.searchsorted(s, ym, side="right")
-    return 0.5 * (left + right + 1).astype(jnp.float32)
+    return _rank_1d(jnp.where(m > 0, y, _BIG))
 
 
 @jax.jit
@@ -157,28 +155,33 @@ def _contingency(X, y_onehot_masked):
     return X.T @ y_onehot_masked
 
 
+@functools.partial(jax.jit, static_argnames=("in_sharding", "out_sharding"))
+def _feature_corr_jit(Xr, m, in_sharding=None, out_sharding=None):
+    mm = m[:, None]
+    cnt = jnp.maximum(jnp.sum(m), 1.0)
+    mean = jnp.sum(Xr * mm, axis=0) / cnt
+    Xc = (Xr - mean) * mm
+    sd = jnp.sqrt(jnp.maximum(jnp.sum(Xc * Xc, axis=0) / cnt, 1e-12))
+    Z = Xc / sd
+    if in_sharding is not None:
+        Z = jax.lax.with_sharding_constraint(Z, in_sharding)
+        C = (Z.T @ Z) / cnt
+        return jax.lax.with_sharding_constraint(C, out_sharding)
+    return (Z.T @ Z) / cnt
+
+
 def _feature_corr(Xr, m, mesh_ctx):
     """Weighted correlation matrix of (rank-)columns as one MXU matmul.
     Under a mesh: rows contract over "data" (XLA inserts the psum) and the
     [d,d] output shards its leading axis over "model" — the feature-width
-    (tensor-parallel-like) decomposition for O(d²) stats."""
-
-    def go(Xr, m):
-        mm = m[:, None]
-        cnt = jnp.maximum(jnp.sum(m), 1.0)
-        mean = jnp.sum(Xr * mm, axis=0) / cnt
-        Xc = (Xr - mean) * mm
-        sd = jnp.sqrt(jnp.maximum(jnp.sum(Xc * Xc, axis=0) / cnt, 1e-12))
-        Z = Xc / sd
-        if mesh_ctx is not None:
-            Z = jax.lax.with_sharding_constraint(
-                Z, NamedSharding(mesh_ctx.mesh, P(pmesh.DATA_AXIS, None)))
-            C = (Z.T @ Z) / cnt
-            return jax.lax.with_sharding_constraint(
-                C, NamedSharding(mesh_ctx.mesh, P(pmesh.MODEL_AXIS, None)))
-        return (Z.T @ Z) / cnt
-
-    return jax.jit(go)(Xr, m)
+    (tensor-parallel-like) decomposition for O(d²) stats. Shardings ride as
+    hashable static args so the compiled program caches per shape+mesh."""
+    if mesh_ctx is None:
+        return _feature_corr_jit(Xr, m)
+    return _feature_corr_jit(
+        Xr, m,
+        in_sharding=NamedSharding(mesh_ctx.mesh, P(pmesh.DATA_AXIS, None)),
+        out_sharding=NamedSharding(mesh_ctx.mesh, P(pmesh.MODEL_AXIS, None)))
 
 
 class SanityChecker(Estimator):
@@ -347,19 +350,23 @@ class SanityChecker(Estimator):
                     c.reasons.append("label correlation too low")
         if fcorr is not None and self.max_feature_correlation < 1.0:
             # drop the LATER column of a too-correlated pair (reference:
-            # featureCorrs.take(cl.index) — only earlier columns considered)
-            for j in range(d):
-                if j in corr_excluded:
-                    continue
-                for i in range(j):
-                    if i in corr_excluded:
-                        continue
-                    v = fcorr[j, i]
-                    if np.isfinite(v) and abs(v) > self.max_feature_correlation:
-                        col_stats[j].reasons.append(
-                            f"feature correlation {v:.4f} with "
-                            f"{names[i]} too high")
-                        break
+            # featureCorrs.take(cl.index) — only earlier columns considered);
+            # one vectorized pass over the strict lower triangle, Python only
+            # touches actual hits
+            lower = np.tril(fcorr, -1)
+            A = np.where(np.isfinite(lower), np.abs(lower), 0.0)
+            if corr_excluded:
+                excl = np.zeros(d, bool)
+                excl[list(corr_excluded)] = True
+                A[excl, :] = 0.0
+                A[:, excl] = 0.0
+            over = A > self.max_feature_correlation
+            first_i = np.argmax(over, axis=1)  # first too-correlated earlier col
+            for j in np.nonzero(over.any(axis=1))[0]:
+                i = int(first_i[j])
+                col_stats[j].reasons.append(
+                    f"feature correlation {fcorr[j, i]:.4f} with "
+                    f"{names[i]} too high")
         group_dropped: set[str] = set()
         for g, idxs in groups.items():
             st = cat_stats.get(g)

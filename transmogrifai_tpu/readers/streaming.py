@@ -14,6 +14,7 @@ from __future__ import annotations
 import glob
 import os
 import time
+import warnings
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 from transmogrifai_tpu.readers.base import CustomReader, DataReader
@@ -58,6 +59,9 @@ class FileStreamingReader(StreamingReader):
         self.new_files_only = new_files_only
         self.max_batches = max_batches
         self.timeout_s = timeout_s
+        #: files abandoned after ``max_retries_per_file`` failed reads —
+        #: operators should monitor this for silent data loss
+        self.skipped_files: list[str] = []
 
     def _list_files(self) -> list[str]:
         return sorted(glob.glob(os.path.join(self.path, self.pattern)))
@@ -97,6 +101,11 @@ class FileStreamingReader(StreamingReader):
                     failures[f] = failures.get(f, 0) + 1
                     if failures[f] >= self.max_retries_per_file:
                         seen.add(f)
+                        self.skipped_files.append(f)
+                        warnings.warn(
+                            f"FileStreamingReader: abandoning {f!r} after "
+                            f"{failures[f]} failed reads — batch dropped "
+                            "from the score stream", RuntimeWarning)
                     else:
                         next_retry[f] = time.monotonic() + \
                             self.poll_interval_s

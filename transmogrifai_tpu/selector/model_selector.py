@@ -371,11 +371,15 @@ class ModelSelector(Estimator):
                 fold_ex = type(executor)()
                 d_tr2, fitted = fold_ex.fit_transform(d_tr, during_dag)
                 d_va2 = fold_ex.transform(d_va, fitted)
+                # validation slices back to logical rows: take() re-pads
+                # device columns under a mesh, and metrics must see real
+                # rows only (training padding is weight-masked instead)
+                n_va = d_va2.n_rows
                 yield (d_tr2.device_col(feat_name).values,
                        d_tr2.device_col(label_name).values,
                        wt_full[jnp.asarray(tr)],
-                       d_va2.device_col(feat_name).values,
-                       d_va2.device_col(label_name).values)
+                       d_va2.device_col(feat_name).values[:n_va],
+                       d_va2.device_col(label_name).values[:n_va])
 
         results, mean_metrics = self._sweep(fold_arrays())
 
