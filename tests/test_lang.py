@@ -1,0 +1,179 @@
+"""Language identification + language-aware tokenization tests (parity:
+reference TextTokenizer.scala language detection via Optimaize +
+LuceneTextAnalyzer CJK handling)."""
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.ops.lang import (
+    LANGUAGES, detect_language_ngram, language_scores,
+)
+from transmogrifai_tpu.ops.text import (
+    LangDetector, OpStopWordsRemover, STOP_WORDS, TextTokenizer,
+    detect_language, simple_tokenize,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+SAMPLES = {
+    "en": "I would like to go to the market with my friends tomorrow",
+    "fr": "Je voudrais aller au marché avec mes amis demain matin",
+    "de": "Ich möchte morgen früh mit meinen Freunden auf den Markt gehen",
+    "es": "Me gustaría ir al mercado con mis amigos mañana por la mañana",
+    "pt": "Gostaria de ir ao mercado com os meus amigos amanhã de manhã",
+    "ru": "Я хотел бы пойти на рынок с моими друзьями завтра утром",
+    "el": "Θα ήθελα να πάω στην αγορά με τους φίλους μου αύριο το πρωί",
+    "ar": "أود أن أذهب إلى السوق مع أصدقائي غدا صباحا",
+    "he": "הייתי רוצה ללכת לשוק עם החברים שלי מחר בבוקר",
+    "th": "ฉันอยากไปตลาดกับเพื่อนพรุ่งนี้เช้า",
+    "zh": "我想明天早上和朋友一起去市场",
+    "ja": "明日の朝、友達と市場に行きたいです",
+    "ko": "내일 아침에 친구들과 시장에 가고 싶어요",
+    "tr": "Yarın sabah arkadaşlarımla pazara gitmek istiyorum",
+    "pl": "Chciałbym jutro rano pójść na targ z moimi przyjaciółmi",
+}
+
+
+def test_profile_coverage():
+    assert len(LANGUAGES) >= 30
+
+
+def test_detects_major_languages():
+    for truth, text in SAMPLES.items():
+        assert detect_language_ngram(text) == truth, (truth, text)
+
+
+def test_no_signal():
+    assert detect_language_ngram("") is None
+    assert detect_language_ngram("12345 !!! ...") is None
+    assert language_scores("   ") == {}
+
+
+def test_cjk_tokenizes_to_bigrams():
+    toks = simple_tokenize("我想去市场")
+    assert toks == ["我想", "想去", "去市", "市场"]
+    toks_th = simple_tokenize("ไปตลาด")
+    assert all(len(t) == 2 for t in toks_th)
+    # latin unaffected
+    assert simple_tokenize("Hello World") == ["hello", "world"]
+    # mixed text: latin words + CJK bigrams
+    mixed = simple_tokenize("price 价格表 ok")
+    assert "price" in mixed and "ok" in mixed and "价格" in mixed
+    # mixed-script TOKENS split at the boundary, whichever script leads
+    assert simple_tokenize("abc漢字") == ["abc", "漢字"]
+    assert simple_tokenize("漢字abc") == ["漢字", "abc"]
+    assert simple_tokenize("漢字表abc") == ["漢字", "字表", "abc"]
+
+
+def test_lang_detector_stage():
+    det = LangDetector(top_k=2)
+    out = det.transform_row(SAMPLES["fr"])
+    assert max(out, key=out.get) == "fr"
+    assert len(out) <= 2
+    assert det.transform_row(None) == {}
+    assert det.transform_row(SAMPLES["ja"]) == {"ja": 1.0}
+
+
+def test_tokenizer_language_aware_stopwords():
+    tok = TextTokenizer(filter_stopwords=True, auto_detect_language=True)
+    fr_toks = tok.transform_row("le marché de la ville est grand")
+    assert "le" not in fr_toks and "marché" in fr_toks
+    en_toks = tok.transform_row("the market of the city is large")
+    assert "the" not in en_toks and "market" in en_toks
+    # Russian stopwords apply when detected
+    ru_toks = tok.transform_row("я хотел бы пойти на рынок")
+    assert "я" not in ru_toks and "рынок" in ru_toks
+
+
+def test_stopword_sets_expanded():
+    assert len(STOP_WORDS) >= 18
+    rm = OpStopWordsRemover(language="tr")
+    assert rm.transform_row(["ve", "pazar", "bir"]) == ["pazar"]
+
+
+def test_smart_text_vectorizer_language_dependent():
+    """SmartTextVectorizer hashes CJK text by character bigrams — two
+    Chinese strings sharing a bigram collide in hash space; unrelated ones
+    don't (the language-aware analyzer changes vectorization)."""
+    from transmogrifai_tpu.ops.vectorizers.hashing import tokenize
+    assert tokenize("市场价格") == ["市场", "场价", "价格"]
+
+    from transmogrifai_tpu.dag import DagExecutor, compute_dag
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.smart_text import SmartTextVectorizer
+    from transmogrifai_tpu.pipeline_data import PipelineData
+
+    vals = ["市场价格很高", "市场价格不低", "天气晴朗", None] * 6
+    host = fr.HostFrame.from_dict({"t": (ft.TextArea, vals)})
+    feats = FeatureBuilder.from_frame(host)
+    out = feats["t"].transform_with(
+        SmartTextVectorizer(max_cardinality=2, num_hash_features=64))
+    data = PipelineData.from_host(host)
+    out_data, _ = DagExecutor().fit_transform(data, compute_dag([out]))
+    col = out_data.device_col(out.name)
+    X = np.asarray(col.values)
+    # restrict to the hashed-token block (length/null companion features
+    # would otherwise dominate the cosine)
+    hash_idx = [c.index for c in col.metadata.columns
+                if c.descriptor_value and "hash" in c.descriptor_value]
+    assert hash_idx, "expected the hashing-trick treatment"
+    X = X[:, hash_idx]
+    # the two market-price strings share bigrams -> cosine similarity far
+    # above the unrelated weather string
+    a, b, c = X[0], X[1], X[2]
+
+    def cos(u, v):
+        return float(u @ v / (np.linalg.norm(u) * np.linalg.norm(v) + 1e-9))
+
+    assert cos(a, b) > cos(a, c) + 0.2
+
+
+def test_ner_person_location_org():
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    ner = NameEntityRecognizer()
+    out = ner.transform_row("Maria Schmidt met John Smithfield at Acme Corp "
+                            "in Berlin yesterday")
+    assert "Person" in out["maria"]
+    assert "Person" in out["schmidt"]
+    assert "Person" in out["smithfield"]  # surname bigram rule
+    assert "Organization" in out["acme"]
+    assert "Organization" in out["corp"]
+    assert "Location" in out["berlin"]
+    assert "yesterday" not in out
+    # lowercase mentions are not entities under the capitalization rule
+    assert "mark" not in ner.transform_row("please mark the date")
+
+
+def test_sensitive_features_in_model_insights():
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.insights import ModelInsights
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.names import HumanNameDetector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    n = 120
+    names = ["Mr John Smith", "Mrs Mary Jones", "Miss Anna Brown",
+             "Mr Robert Lee"]
+    y = rng.integers(0, 2, n).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "contact": (ft.Text, [names[i % 4] for i in range(n)]),
+        "x": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    name_stats = feats["contact"].transform_with(HumanNameDetector())
+    vec = transmogrify([feats["x"]], min_support=1)
+    pred = label.transform_with(OpLogisticRegression(max_iter=20), vec)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, name_stats).train())
+    ins = ModelInsights.from_workflow(model, prediction=pred)
+    assert "contact" in ins.sensitive
+    assert ins.sensitive["contact"]["detected"] is True
+    assert ins.sensitive["contact"]["probName"] > 0.5
+    js = ins.to_json()
+    assert js["sensitiveFeatures"]["contact"]["detected"] is True
+    # pretty report renders the sensitive section
+    assert "Sensitive features" in ins.pretty()

@@ -1,0 +1,133 @@
+"""Multiclass threshold / topK / confusion-by-threshold / misclassification
+metrics on hand-computed fixtures (parity:
+OpMultiClassificationEvaluator.scala:352-486 semantics)."""
+
+import json
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.evaluators import OpMultiClassificationEvaluator
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+
+
+def _pred_col(prob):
+    prob = np.asarray(prob, np.float64)
+    pred = prob.argmax(axis=1).astype(np.float64)
+    import jax.numpy as jnp
+    return fr.PredictionColumn(jnp.asarray(pred), jnp.asarray(prob),
+                               jnp.asarray(prob))
+
+
+def test_threshold_metrics_hand_computed():
+    # 4 rows, 3 classes; thresholds 0/0.5; topNs (1, 2)
+    prob = [
+        [0.9, 0.05, 0.05],   # y=0: top1 correct, true score 0.9
+        [0.3, 0.6, 0.1],     # y=0: top1 wrong, top2 contains 0 (0.3)
+        [0.2, 0.4, 0.4],     # y=2: top1 (stable argsort -> class1), 0.4
+        [0.1, 0.2, 0.7],     # y=2: top1 correct, 0.7
+    ]
+    y = np.asarray([0, 0, 2, 2], np.float64)
+    ev = OpMultiClassificationEvaluator(top_ns=(1, 2), thresholds=(0.0, 0.5),
+                                        top_ks=(1, 2))
+    m = ev.evaluate_arrays(y, _pred_col(prob))
+    t = m.threshold_metrics
+    # topN=1: rows 0,3 have true class in top-1... row 2: top-1 by stable
+    # sort is class 1 (tie 0.4/0.4 -> lower index wins), so true class 2 is
+    # NOT in top-1; row 1 wrong.
+    #   thr=0.0: correct rows {0,3}=2, incorrect {1,2}=2, nopred 0
+    #   thr=0.5: correct: true score >= 0.5 -> rows 0 (0.9), 3 (0.7) = 2
+    #            incorrect: max score >= 0.5 minus correct -> row 1 (0.6) = 1
+    #            nopred: row 2 (max 0.4 < 0.5) = 1
+    np.testing.assert_array_equal(t.correct_counts[1], [2, 2])
+    np.testing.assert_array_equal(t.incorrect_counts[1], [2, 1])
+    np.testing.assert_array_equal(t.no_prediction_counts[1], [0, 1])
+    # topN=2: row 1 true class 0 in top-2 (0.6, 0.3); its true score 0.3
+    # clears thr 0.0 only. Row 2's true class 2 IS in top-2.
+    #   thr=0.0: correct {0,1,2,3}=4, incorrect 0, nopred 0
+    #   thr=0.5: correct {0,3}=2 (scores .9/.7), incorrect {1}=1, nopred {2}
+    np.testing.assert_array_equal(t.correct_counts[2], [4, 2])
+    np.testing.assert_array_equal(t.incorrect_counts[2], [0, 1])
+    np.testing.assert_array_equal(t.no_prediction_counts[2], [0, 1])
+    # counts always partition the rows
+    for tn in (1, 2):
+        total = (np.asarray(t.correct_counts[tn])
+                 + np.asarray(t.incorrect_counts[tn])
+                 + np.asarray(t.no_prediction_counts[tn]))
+        np.testing.assert_array_equal(total, [4, 4])
+
+
+def test_topk_metrics_rare_labels_relabel():
+    # labels: 0 x4, 1 x2, 2 x1; predictions all correct
+    y = np.asarray([0, 0, 0, 0, 1, 1, 2], np.float64)
+    prob = np.eye(3)[y.astype(int)]
+    ev = OpMultiClassificationEvaluator(top_ks=(1, 2, 3), top_ns=(1,))
+    m = ev.evaluate_arrays(y, _pred_col(prob))
+    tk = m.top_k_metrics
+    assert tk["topKs"] == [1, 2, 3]
+    # k=3: all labels kept, all correct
+    assert tk["Error"][2] == 0.0 and tk["F1"][2] == 1.0
+    # k=1: labels 1,2 relabeled out-of-set; their (correct) predictions now
+    # count as errors -> error = 3/7
+    np.testing.assert_allclose(tk["Error"][0], 3 / 7)
+    # k=2: only label 2 relabeled -> error = 1/7
+    np.testing.assert_allclose(tk["Error"][1], 1 / 7)
+
+
+def test_conf_matrix_by_threshold_and_misclassification():
+    y = np.asarray([0, 0, 1, 1, 1, 2], np.float64)
+    prob = np.asarray([
+        [0.9, 0.1, 0.0],   # 0 -> 0 conf .9
+        [0.2, 0.7, 0.1],   # 0 -> 1 conf .7
+        [0.1, 0.8, 0.1],   # 1 -> 1 conf .8
+        [0.3, 0.4, 0.3],   # 1 -> 1 conf .4
+        [0.6, 0.3, 0.1],   # 1 -> 0 conf .6
+        [0.1, 0.1, 0.8],   # 2 -> 2 conf .8
+    ])
+    ev = OpMultiClassificationEvaluator(
+        top_ns=(1,), top_ks=(3,), conf_matrix_num_classes=2,
+        conf_matrix_thresholds=(0.0, 0.5))
+    m = ev.evaluate_arrays(y, _pred_col(prob))
+    cm = m.conf_matrix_by_threshold
+    # top-2 classes by label count: 1 (x3), 0 (x2); rows touching class 2
+    # drop (label or prediction outside the set)
+    assert cm["ConfMatrixClassIndices"] == [1, 0]
+    # thr 0.0: rows 0-4 kept: conf (label, pred) over classes [1, 0]:
+    #   (1->1)=2, (1->0)=1, (0->1)=1, (0->0)=1
+    # column-major flatten over (label, pred) with index order [1, 0]:
+    #   [ (1,1), (0,1), (1,0), (0,0) ] = [2, 1, 1, 1]
+    assert cm["ConfMatrices"][0] == [2, 1, 1, 1]
+    # thr 0.5: row 3 (conf .4) drops -> (1->1)=1
+    assert cm["ConfMatrices"][1] == [1, 1, 1, 1]
+
+    mis = m.misclassification
+    by_label = {d["Category"]: d for d in mis["MisClassificationsByLabel"]}
+    assert by_label[1.0]["TotalCount"] == 3
+    assert by_label[1.0]["CorrectCount"] == 2
+    assert by_label[1.0]["MisClassifications"] == [
+        {"ClassIndex": 0.0, "Count": 1}]
+    assert by_label[0.0]["MisClassifications"] == [
+        {"ClassIndex": 1.0, "Count": 1}]
+    # ordered by total count descending
+    cats = [d["Category"] for d in mis["MisClassificationsByLabel"]]
+    assert cats == [1.0, 0.0, 2.0]
+
+
+def test_metrics_json_strict():
+    rng = np.random.default_rng(0)
+    n, k = 60, 4
+    y = rng.integers(0, k, n).astype(np.float64)
+    logits = rng.normal(size=(n, k)) + 2.0 * np.eye(k)[y.astype(int)]
+    prob = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    ev = OpMultiClassificationEvaluator()
+    m = ev.evaluate_arrays(y, _pred_col(prob))
+    d = EvaluatorBase.to_json(m)
+    s = json.dumps(d, allow_nan=False)  # strict JSON must not raise
+    rt = json.loads(s)
+    # nested threshold metrics keep the reference's camelCase schema
+    assert rt["threshold_metrics"]["topNs"] == [1, 3]
+    assert len(rt["threshold_metrics"]["thresholds"]) == 101
+    assert "correctCounts" in rt["threshold_metrics"]
+    assert rt["top_k_metrics"]["topKs"] == [5, 10, 20, 50, 100]
+    assert len(rt["conf_matrix_by_threshold"]["ConfMatrices"]) == 5
+    assert rt["misclassification"]["ConfMatrixMinSupport"] == 5

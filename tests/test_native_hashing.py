@@ -55,3 +55,43 @@ def test_crc_parity_with_zlib():
     import zlib
     # the C++ CRC must be bit-identical to zlib's (hash_token contract)
     assert H.hash_token("hello", 512) == zlib.crc32(b"hello") % 512
+
+
+def test_rff_text_hist_native_parity():
+    """RawFeatureFilter's native corpus-histogram pass must be bit-identical
+    to the Python per-row/per-token loop (same tokenizer + CRC bins)."""
+    import transmogrifai_tpu.filters.raw_feature_filter as R
+    from transmogrifai_tpu.frame import HostColumn
+    from transmogrifai_tpu.types import feature_types as ft
+
+    if H._native() is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    words = ["alpha", "Beta", "gamma42", "x", "the-end", ""]
+    vals = np.empty(500, dtype=object)
+    for i in range(500):
+        if rng.uniform() < 0.1:
+            vals[i] = None
+        else:
+            vals[i] = " ".join(rng.choice(words, size=rng.integers(0, 6)))
+    col = HostColumn(ft.Text, vals)
+    d_native = R._distribution(col, "t", bins=64)
+    lib, H._native_lib = H._native_lib, None
+    try:
+        d_py = R._distribution(col, "t", bins=64)
+    finally:
+        H._native_lib = lib
+    assert d_native.nulls == d_py.nulls
+    np.testing.assert_array_equal(d_native.distribution, d_py.distribution)
+
+
+def test_rff_text_hist_non_ascii_falls_back():
+    import transmogrifai_tpu.filters.raw_feature_filter as R
+    from transmogrifai_tpu.frame import HostColumn
+    from transmogrifai_tpu.types import feature_types as ft
+
+    vals = np.asarray(["héllo wörld", "plain ascii", None], dtype=object)
+    col = HostColumn(ft.Text, vals)
+    d = R._distribution(col, "t", bins=32)  # must not crash; python path
+    assert d.nulls == 1
+    assert d.distribution.sum() == 4.0  # 2 + 2 tokens

@@ -1,0 +1,96 @@
+"""RecordInsightsCorr + insights parser tests (parity:
+RecordInsightsCorr.scala / RecordInsightsParser.scala semantics)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.insights import (
+    RecordInsightsCorr, insights_to_text, parse_insights,
+)
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _frame(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n).astype(float)
+    signal = rng.normal(size=n) + 1.5 * y       # strongly correlated
+    noise = rng.normal(size=n)                  # uncorrelated
+    return fr.HostFrame.from_dict({
+        "signal": (ft.Real, signal.tolist()),
+        "noise": (ft.Real, noise.tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+
+
+def _train(frame, **corr_kw):
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    pred = label.transform_with(OpLogisticRegression(max_iter=30), vec)
+    insights = pred.transform_with(RecordInsightsCorr(**corr_kw), vec)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(insights, pred).train())
+    scores = model.score(frame)
+    return model, scores
+
+
+def test_corr_insights_rank_signal_above_noise():
+    frame = _frame()
+    model, scores = _train(frame, top_k=3)
+    name = next(n for n in scores.names() if "RecordInsightsCorr" in n)
+    col = scores.columns[name]
+    n_signal_top = 0
+    for i in range(len(col)):
+        parsed = parse_insights(col.python_value(i))
+        assert parsed, "every record gets insights"
+        top_meta, pairs = parsed[0]
+        assert len(pairs) >= 2  # one importance per prediction column
+        if "signal" in top_meta.parent_feature[0]:
+            n_signal_top += 1
+    # the correlated feature dominates the top slot
+    assert n_signal_top > 0.7 * len(col)
+
+
+def test_parser_round_trip():
+    key, val = insights_to_text(
+        json.dumps({"parentFeature": ["age"], "parentFeatureType": ["Real"],
+                    "grouping": None, "indicatorValue": None,
+                    "descriptorValue": None, "index": 3}),
+        [(0, -0.25), (1, 0.25)])
+    parsed = parse_insights({key: val})
+    meta, pairs = parsed[0]
+    assert meta.parent_feature == ("age",)
+    assert meta.index == 3
+    assert pairs == [(0, -0.25), (1, 0.25)]
+
+
+def test_norm_types():
+    frame = _frame(seed=2)
+    for norm in ("minMax", "zNorm", "minMaxCentered"):
+        model, scores = _train(frame, top_k=2, norm_type=norm)
+        name = next(n for n in scores.names()
+                    if "RecordInsightsCorr" in n)
+        v = scores.columns[name].python_value(0)
+        assert isinstance(v, dict) and v
+    with pytest.raises(ValueError):
+        RecordInsightsCorr(norm_type="bogus")
+
+
+def test_row_path_matches_columnar():
+    frame = _frame(seed=3)
+    model, scores = _train(frame, top_k=2)
+    name = next(n for n in scores.names() if "RecordInsightsCorr" in n)
+    col = scores.columns[name]
+    fn = model.score_function()
+    row = {"signal": frame["signal"].python_value(0),
+           "noise": frame["noise"].python_value(0),
+           "label": frame["label"].python_value(0)}
+    local = fn(row)[name]
+    assert local == col.python_value(0)

@@ -1,0 +1,386 @@
+"""Universal per-stage contract suite.
+
+Parity: reference ``OpTransformerSpec.scala:56-90`` / ``OpEstimatorSpec``,
+which ~100 suites extend so EVERY stage obeys: dataset transform == row
+transform == after save/load, metadata preserved, fit deterministic. Here
+one parametrized harness walks the ENTIRE stage registry: for each public
+stage it synthesizes typed inputs from the declared ``in_types``, trains a
+mini workflow, and asserts
+
+  1. columnar scoring == local row scoring (``score_function``) per row,
+  2. both unchanged after ``save_model``/``load_model`` round-trip,
+  3. training twice is deterministic,
+  4. vector metadata (column names) survives the round-trip.
+
+Fitted model products (``*Model``, ``TreeEnsembleModel``, ...) are exercised
+through their estimators — the fitted DAG contains them, and save/load walks
+their config/fitted_state.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.serialization import load_model, save_model
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+# fill the registry from every module in the package
+for _m in pkgutil.walk_packages(transmogrifai_tpu.__path__,
+                                "transmogrifai_tpu."):
+    if "native" in _m.name or "__main__" in _m.name:
+        continue
+    try:
+        importlib.import_module(_m.name)
+    except Exception:
+        pass
+
+from transmogrifai_tpu.stages.base import (  # noqa: E402
+    STAGE_REGISTRY, AllowLabelAsInput, Estimator, Transformer,
+)
+
+N = 24
+
+#: abstract/base classes — no concrete behavior to test
+_BASES = {
+    "Transformer", "HostTransformer", "DeviceTransformer", "Estimator",
+    "Predictor", "PredictionModel", "FeatureGeneratorStage",
+    "LambdaTransformer",
+}
+
+#: fitted products — exercised through the estimator that creates them
+_PRODUCTS = {
+    "CombinedModel", "CountVectorizerModel", "DropIndicesModel", "GLMModel",
+    "GeolocationModel", "HumanNameDetectorModel", "IntegralVectorizerModel",
+    "IsotonicCalibratorModel", "LDAModel", "LinearClassificationModel",
+    "LinearRegressionModel", "MLPModel", "NaiveBayesModel", "OneHotModel",
+    "RealVectorizerModel", "SetModel", "SmartTextModel", "StringIndexerModel",
+    "TreeEnsembleModel", "Word2VecModel", "SelectedModel",
+    "ExternalPredictionModel", "RecordInsightsCorrModel",
+}
+
+#: skipped with cause; each is covered by a dedicated suite
+_SPECIAL = {
+    "ModelSelector": "full CV machinery — test_workflow_cv/_selector_*",
+    "SelectedModelCombiner": "needs two fitted selectors — test_model_extras",
+    "RecordInsightsLOCO": "needs a fitted model handle — test_insights_and_aux",
+    "ExternalEstimatorWrapper": "external fn import — test_resume_and_external",
+    "ExternalTransformerWrapper": "external fn import — test_resume_and_external",
+    "DescalerTransformer": "needs paired scaler chain — test_text_and_maps",
+}
+
+#: constructor overrides: keep heavyweight trainers tiny for the contract run
+_CTOR = {
+    "OpGBTClassifier": dict(num_rounds=3, max_depth=3),
+    "OpGBTRegressor": dict(num_rounds=3, max_depth=3),
+    "OpXGBoostClassifier": dict(num_rounds=3, max_depth=3),
+    "OpXGBoostRegressor": dict(num_rounds=3, max_depth=3),
+    "OpRandomForestClassifier": dict(num_trees=3, max_depth=3),
+    "OpRandomForestRegressor": dict(num_trees=3, max_depth=3),
+    "OpDecisionTreeClassifier": dict(max_depth=3),
+    "OpDecisionTreeRegressor": dict(max_depth=3),
+    "OpLogisticRegression": dict(max_iter=20),
+    "OpLinearRegression": dict(max_iter=20),
+    "OpLinearSVC": dict(max_iter=20),
+    "OpMultilayerPerceptronClassifier": dict(max_iter=20),
+    "OpWord2Vec": dict(vector_size=8, min_count=1, num_iterations=2),
+    "OpLDA": dict(k=3, max_iter=5),
+    "OpIndexToString": dict(labels=["zero", "one"]),
+}
+
+
+def _strings(rng, vocab, nulls=0.15):
+    return [None if rng.uniform() < nulls else str(rng.choice(vocab))
+            for _ in range(N)]
+
+
+def _values_for(t: type, rng) -> list:
+    """Synthesize N plausible python values for a feature type."""
+    name = t.__name__
+    if name == "FeatureType":  # any-typed stages (alias, len, occur): text
+        return _strings(rng, ["alpha", "beta", "gamma"])
+    if name == "RealNN":
+        return [float(x) for x in rng.normal(size=N)]
+    if name in ("Real", "Currency", "Percent"):
+        return [None if rng.uniform() < 0.15 else float(x)
+                for x in rng.normal(size=N)]
+    if name in ("Integral",):
+        return [None if rng.uniform() < 0.15 else int(x)
+                for x in rng.integers(0, 50, size=N)]
+    if name in ("Date", "DateTime"):
+        base = 1_500_000_000_000
+        return [None if rng.uniform() < 0.1 else
+                int(base + rng.integers(0, 10**10)) for _ in range(N)]
+    if name == "Binary":
+        return [None if rng.uniform() < 0.1 else bool(rng.integers(0, 2))
+                for _ in range(N)]
+    if name == "Email":
+        return _strings(rng, ["a@x.com", "b.c@y.org", "bad-email", "z@w.io"])
+    if name == "URL":
+        return _strings(rng, ["https://x.com/a", "http://y.org", "notaurl",
+                              "https://z.io/p?q=1"])
+    if name == "Phone":
+        return _strings(rng, ["+1 650 123 4567", "555-1234", "nope",
+                              "+44 20 7946 0958"])
+    if name == "Base64":
+        import base64
+        blobs = [b"%PDF-1.4 abc", b"\x89PNG\r\n\x1a\n123", b"plain text",
+                 b"GIF89a.."]
+        return _strings(rng, [base64.b64encode(b).decode() for b in blobs])
+    if name == "PostalCode":
+        return _strings(rng, ["94105", "10001", "SW1A 1AA", "75008"])
+    if name in ("Text", "TextArea", "ID", "ComboBox", "PickList", "City",
+                "Street", "Country", "State"):
+        return _strings(rng, ["alpha", "beta", "gamma", "delta epsilon"])
+    if name == "TextList":
+        vocab = ["red", "green", "blue", "cyan"]
+        return [[str(w) for w in rng.choice(vocab, size=rng.integers(0, 4))]
+                for _ in range(N)]
+    if name in ("DateList", "DateTimeList"):
+        base = 1_500_000_000_000
+        return [[int(base + rng.integers(0, 10**10))
+                 for _ in range(rng.integers(0, 3))] for _ in range(N)]
+    if name == "Geolocation":
+        return [None if rng.uniform() < 0.1 else
+                [float(rng.uniform(-60, 60)), float(rng.uniform(-170, 170)),
+                 5.0] for _ in range(N)]
+    if name == "MultiPickList":
+        vocab = ["x", "y", "z"]
+        return [sorted(set(str(w) for w in
+                           rng.choice(vocab, size=rng.integers(0, 3))))
+                for _ in range(N)]
+    if name == "MultiPickListMap":
+        vocab = ["x", "y", "z"]
+        return [{"k1": sorted(set(str(w) for w in
+                                  rng.choice(vocab,
+                                             size=rng.integers(0, 3))))}
+                for _ in range(N)]
+    if name == "GeolocationMap":
+        return [{"home": [float(rng.uniform(-60, 60)),
+                          float(rng.uniform(-170, 170)), 5.0]}
+                for _ in range(N)]
+    if name == "BinaryMap":
+        return [{"k1": bool(rng.integers(0, 2)),
+                 "k2": bool(rng.integers(0, 2))} for _ in range(N)]
+    if name in ("IntegralMap", "DateMap", "DateTimeMap"):
+        base = 1_500_000_000_000 if "Date" in name else 0
+        return [{"k1": int(base + rng.integers(0, 50)),
+                 "k2": int(base + rng.integers(0, 50))} for _ in range(N)]
+    if name in ("RealMap", "CurrencyMap", "PercentMap"):
+        return [{"k1": float(rng.normal()), "k2": float(rng.normal())}
+                for _ in range(N)]
+    if issubclass(t, ft.TextMap):
+        vocab = ["aa", "bb", "cc"]
+        return [{"k1": str(rng.choice(vocab)), "k2": str(rng.choice(vocab))}
+                for _ in range(N)]
+    raise NotImplementedError(f"no generator for {name}")
+
+
+def _collect() -> list[str]:
+    names = []
+    for name, cls in sorted(STAGE_REGISTRY.items()):
+        if name.startswith("_") or name in _BASES or name in _PRODUCTS:
+            continue
+        if name in _SPECIAL:
+            continue
+        if not (issubclass(cls, Estimator) or issubclass(cls, Transformer)):
+            continue
+        names.append(name)
+    return names
+
+
+def _build_graph(cls, rng):
+    """(workflow result feature, HostFrame, raw column names) for a stage."""
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    stage = cls(**_CTOR.get(cls.__name__, {}))
+    in_types = list(cls.in_types)
+    if cls.variadic:
+        in_types = in_types[:-1] + [in_types[-1]] * 2  # two variadic elems
+
+    cols: dict[str, tuple] = {}
+    feat_specs: list[tuple[str, type]] = []  # (col name or synth marker, t)
+    label_first = (in_types and in_types[0] is ft.RealNN
+                   and (issubclass(cls, Estimator)
+                        or issubclass(cls, AllowLabelAsInput)))
+    for i, t in enumerate(in_types):
+        nm = f"in{i}"
+        if i == 0 and label_first:
+            cols["label"] = (ft.RealNN,
+                             [float(v) for v in rng.integers(0, 2, size=N)])
+            feat_specs.append(("label", t))
+        elif t is ft.OPVector:
+            cols[f"{nm}_a"] = (ft.Real, _values_for(ft.Real, rng))
+            cols[f"{nm}_b"] = (ft.Real, _values_for(ft.Real, rng))
+            feat_specs.append((f"__vec__{nm}", t))
+        elif t is ft.Prediction:
+            cols[f"{nm}_a"] = (ft.Real, _values_for(ft.Real, rng))
+            cols[f"{nm}_b"] = (ft.Real, _values_for(ft.Real, rng))
+            if "label" not in cols:
+                cols["label"] = (
+                    ft.RealNN,
+                    [float(v) for v in rng.integers(0, 2, size=N)])
+            feat_specs.append((f"__pred__{nm}", t))
+        else:
+            # any-typed stages get a concrete Text raw column (FeatureType
+            # itself is not a constructible raw type)
+            col_t = ft.Text if t is ft.FeatureType else t
+            vals = _values_for(t, rng)
+            if cls.__name__ in _NO_NULLS:
+                vals = ["filler" if v is None else v for v in vals]
+            cols[nm] = (col_t, vals)
+            feat_specs.append((nm, col_t))
+
+    frame = fr.HostFrame.from_dict(cols)
+    feats = FeatureBuilder.from_frame(
+        frame, response="label" if "label" in cols else None)
+
+    wired = []
+    for spec, t in feat_specs:
+        if spec.startswith("__vec__"):
+            nm = spec[len("__vec__"):]
+            vec = feats[f"{nm}_a"].transform_with(
+                RealVectorizer(), feats[f"{nm}_b"])
+            wired.append(vec)
+        elif spec.startswith("__pred__"):
+            from transmogrifai_tpu.models.linear import OpLogisticRegression
+            nm = spec[len("__pred__"):]
+            vec = feats[f"{nm}_a"].transform_with(
+                RealVectorizer(), feats[f"{nm}_b"])
+            pred = feats["label"].transform_with(
+                OpLogisticRegression(max_iter=15), vec)
+            wired.append(pred)
+        else:
+            wired.append(feats[spec])
+    out = wired[0].transform_with(stage, *wired[1:])
+    return out, frame
+
+
+def _score_host(model, frame):
+    scores = model.score(frame)
+    name = scores.names()[-1]
+    col = scores.columns[name]
+    vals = [col.python_value(i) for i in range(len(col))]
+    meta = getattr(col, "meta", None)
+    return name, vals, meta
+
+
+def _eq(a, b, path="", tol=2e-3):
+    if a is None or b is None:
+        assert a is None and b is None, f"{path}: {a!r} != {b!r}"
+        return
+    if isinstance(a, dict):
+        assert isinstance(b, dict) and set(a) == set(b), \
+            f"{path}: keys {set(a)} != {set(b)}"
+        for k in a:
+            _eq(a[k], b[k], f"{path}.{k}", tol)
+        return
+    if isinstance(a, str) or isinstance(b, str):
+        assert str(a) == str(b), f"{path}: {a!r} != {b!r}"
+        return
+    if isinstance(a, (list, tuple, np.ndarray)):
+        a1, b1 = np.asarray(a), np.asarray(b)
+        assert a1.shape == b1.shape, f"{path}: shape {a1.shape}!={b1.shape}"
+        if a1.dtype.kind in "OUS":
+            assert list(map(str, a1.reshape(-1))) == \
+                list(map(str, b1.reshape(-1))), f"{path}: {a1} != {b1}"
+        else:
+            np.testing.assert_allclose(
+                a1.astype(np.float64), b1.astype(np.float64),
+                rtol=tol, atol=tol, err_msg=path)
+        return
+    if isinstance(a, bool) or isinstance(b, bool):
+        assert bool(a) == bool(b), f"{path}: {a!r} != {b!r}"
+        return
+    np.testing.assert_allclose(float(a), float(b), rtol=tol, atol=tol,
+                               err_msg=path)
+
+
+#: stages whose columnar output zero-pads variable-width rows to the batch
+#: max (by design — static shapes); the row path returns the unpadded row
+_VAR_WIDTH = {"TimePeriodListTransformer"}
+
+#: stages whose first input must be null-free (e.g. an indexer whose output
+#: contract is non-nullable RealNN under handle_invalid='error')
+_NO_NULLS = {"OpStringIndexer"}
+
+#: per-stage row-vs-columnar tolerance: the device path stores epoch millis
+#: as f32 (ulp ~2 minutes at 2017 epochs), so unit-circle positions wobble
+#: up to ~1e-2 vs the exact-integer row path
+_ATOL = {"DateToUnitCircleVectorizer": 2e-2}
+
+
+def _eq_row(a_col, b_row, path, stage_name):
+    if stage_name in _VAR_WIDTH and a_col is not None and b_row is not None:
+        a1 = np.asarray(a_col, np.float64)
+        b1 = np.asarray(b_row, np.float64)
+        assert a1.shape[0] >= b1.shape[0], path
+        np.testing.assert_allclose(a1[:b1.shape[0]], b1, rtol=2e-3,
+                                   atol=2e-3, err_msg=path)
+        np.testing.assert_allclose(a1[b1.shape[0]:], 0.0, err_msg=path)
+        return
+    _eq(a_col, b_row, path, _ATOL.get(stage_name, 2e-3))
+
+
+@pytest.mark.parametrize("stage_name", _collect())
+def test_stage_contract(stage_name, tmp_path):
+    cls = STAGE_REGISTRY[stage_name]
+    rng = np.random.default_rng(7)
+    out, frame = _build_graph(cls, rng)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(out).train())
+
+    # 1. columnar == row path
+    res_name, col_vals, meta = _score_host(model, frame)
+    score_fn = model.score_function()
+    raw_names = [f.name for f in model.raw_features]
+    for i in range(N):
+        row = {n: frame[n].python_value(i) for n in raw_names
+               if n in frame}
+        local = score_fn(row)[res_name]
+        _eq_row(col_vals[i], local, f"{stage_name} row {i}", stage_name)
+
+    # 2. save/load: columnar AND row path identical after the round-trip
+    path = str(tmp_path / "m")
+    save_model(model, path)
+    loaded = load_model(path)
+    res2, col_vals2, meta2 = _score_host(loaded, frame)
+    assert res2 == res_name
+    for i in range(N):
+        _eq(col_vals[i], col_vals2[i], f"{stage_name} loaded row {i}")
+    fn2 = loaded.score_function()
+    row0 = {n: frame[n].python_value(0) for n in raw_names if n in frame}
+    _eq_row(score_fn(row0)[res_name], fn2(row0)[res_name],
+            f"{stage_name} loaded local", stage_name)
+
+    # 3. vector metadata survives the round-trip
+    if meta is not None:
+        assert meta2 is not None, f"{stage_name}: metadata lost on load"
+        assert meta.col_names() == meta2.col_names()
+
+    # 4. deterministic fit: train again on the same data
+    from transmogrifai_tpu.uid import UID
+    UID.reset()
+    rng2 = np.random.default_rng(7)
+    out_b, frame_b = _build_graph(cls, rng2)
+    model_b = (Workflow().set_input_frame(frame_b)
+               .set_result_features(out_b).train())
+    _, col_vals_b, _ = _score_host(model_b, frame_b)
+    for i in range(N):
+        _eq(col_vals[i], col_vals_b[i], f"{stage_name} refit row {i}")
+
+
+def test_contract_coverage_is_exhaustive():
+    """Every registered public concrete stage is either parametrized here or
+    deliberately routed to a dedicated suite — no stage silently escapes."""
+    covered = set(_collect()) | _BASES | _PRODUCTS | set(_SPECIAL)
+    missing = [n for n in STAGE_REGISTRY
+               if not n.startswith("_") and n not in covered]
+    assert not missing, f"stages with no contract coverage: {missing}"

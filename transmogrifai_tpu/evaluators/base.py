@@ -41,11 +41,33 @@ class EvaluatorBase:
         m = metric or self.default_metric
         return self.metric_directions.get(m, True)
 
+    def metric_from_arrays(self, y, pred_col, metric: Optional[str] = None,
+                           w=None) -> float:
+        """One scalar metric — the CV sweep's hot call. Default computes the
+        full bundle; evaluators with expensive report families override with
+        a summary-only pass."""
+        return self.metric_value(self.evaluate_arrays(y, pred_col, w),
+                                 metric)
+
     @staticmethod
     def to_json(metrics: Any) -> dict:
-        d = asdict(metrics)
-        return {k: (v.tolist() if isinstance(v, np.ndarray) else v)
-                for k, v in d.items()}
+        def conv(v):
+            if isinstance(v, dict):
+                return {str(k): conv(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [conv(x) for x in v]
+            if isinstance(v, np.ndarray):
+                return conv(v.tolist())
+            if isinstance(v, np.integer):
+                return int(v)
+            if isinstance(v, (float, np.floating)):
+                # non-finite floats are not valid strict JSON
+                f = float(v)
+                return f if np.isfinite(f) else None
+            return v
+        if hasattr(metrics, "to_json") and callable(metrics.to_json):
+            return conv(metrics.to_json())
+        return conv(asdict(metrics))
 
 
 def _snake(name: str) -> str:

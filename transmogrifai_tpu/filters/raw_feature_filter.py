@@ -26,9 +26,29 @@ from typing import Optional, Sequence
 import numpy as np
 
 from transmogrifai_tpu.frame import HostColumn, HostFrame, NUMERIC_KINDS, TEXT_KINDS
-from transmogrifai_tpu.ops.vectorizers.hashing import hash_token, tokenize
+from transmogrifai_tpu.ops.vectorizers.hashing import (
+    _native, encode_ascii_rows, hash_token, tokenize,
+)
 
 __all__ = ["FeatureDistribution", "RawFeatureFilter", "RawFeatureFilterResults"]
+
+
+def _text_hist_native(col: HostColumn, bins: int
+                      ) -> Optional[tuple[np.ndarray, int]]:
+    """(histogram, nulls) for a text column via the C++ corpus pass (the
+    vectorizer's loader/encoder — one tokenizer contract), or None when the
+    column needs the Python path (non-string/ASCII rows)."""
+    lib = _native()
+    if lib is None:
+        return None
+    encoded = encode_ascii_rows(col.values)
+    if encoded is None:
+        return None
+    buf, offsets, nulls = encoded
+    hist = np.zeros(bins, dtype=np.float64)
+    lib.hash_tokens_hist(buf, offsets, np.int64(len(col)), np.int32(bins),
+                         np.int32(1), hist)
+    return hist, nulls
 
 
 @dataclass
@@ -102,6 +122,14 @@ def _distribution(col: HostColumn, name: str, bins: int,
                        "mean": float(vals.mean()) if vals.size else 0.0}
         return FeatureDistribution(name, n, nulls, hist.astype(float), summary)
     if kind in TEXT_KINDS or kind == "textlist":
+        # hot path: one native C pass tokenizes + CRC-hashes the whole
+        # column into the corpus histogram (the reference's map-reduce text
+        # distribution, RawFeatureFilter.scala:137-199, without the per-row
+        # Python loop); list-valued / non-ASCII columns fall back
+        native = _text_hist_native(col, bins)
+        if native is not None:
+            hist, nulls = native
+            return FeatureDistribution(name, n, nulls, hist, {})
         hist = np.zeros(bins, dtype=float)
         nulls = 0
         for v in col.values:

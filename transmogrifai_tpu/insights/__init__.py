@@ -1,4 +1,9 @@
 from transmogrifai_tpu.insights.model_insights import ModelInsights
 from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
+from transmogrifai_tpu.insights.corr import (
+    RecordInsightsCorr, RecordInsightsCorrModel, insights_to_text,
+    parse_insights,
+)
 
-__all__ = ["ModelInsights", "RecordInsightsLOCO"]
+__all__ = ["ModelInsights", "RecordInsightsLOCO", "RecordInsightsCorr",
+           "RecordInsightsCorrModel", "insights_to_text", "parse_insights"]

@@ -64,6 +64,10 @@ class ModelInsights:
     sanity_check: Optional[dict] = None
     raw_feature_filter: Optional[dict] = None
     stage_info: list = field(default_factory=list)
+    #: SensitiveFeatureInformation analog (reference ModelInsights carries
+    #: the name-detection verdict per raw text feature): feature name ->
+    #: {detected, probName, genderResultsByStrategy}
+    sensitive: dict = field(default_factory=dict)
 
     # -- assembly ------------------------------------------------------------
     @staticmethod
@@ -76,13 +80,24 @@ class ModelInsights:
         pred_f = prediction or model._prediction_feature()
         label_f = model._label_feature(pred_f)
 
+        from transmogrifai_tpu.ops.names import HumanNameDetectorModel
+
         selected: Optional[SelectedModel] = None
         sanity: Optional[DropIndicesModel] = None
+        sensitive: dict[str, dict] = {}
         for t in model.stages():
             if isinstance(t, SelectedModel):
                 selected = t
             if isinstance(t, DropIndicesModel):
                 sanity = t
+            if isinstance(t, HumanNameDetectorModel):
+                info = dict(t.metadata or {})
+                sensitive[t.input_names[0]] = {
+                    "detected": bool(t.treat_as_name),
+                    "probName": info.get("predictedNameProb"),
+                    "genderResultsByStrategy":
+                        info.get("genderResultsByStrategy", {}),
+                }
 
         problem = "unknown"
         summary_json = None
@@ -172,6 +187,7 @@ class ModelInsights:
             raw_feature_filter=rff,
             stage_info=[{"uid": t.uid, "operation": t.operation_name}
                         for t in model.stages()],
+            sensitive=sensitive,
         )
 
     # -- rendering -----------------------------------------------------------
@@ -184,6 +200,7 @@ class ModelInsights:
             "sanityCheck": self.sanity_check,
             "rawFeatureFilter": self.raw_feature_filter,
             "stageInfo": self.stage_info,
+            "sensitiveFeatures": self.sensitive,
         }
 
     def json(self) -> str:
@@ -199,8 +216,81 @@ class ModelInsights:
         return rows[:k]
 
     def pretty(self, k: int = 15) -> str:
+        """Multi-section report (the reference's prettyPrint tables:
+        selected model, validation results, top contributions + label
+        correlations, dropped columns, sensitive features)."""
         from transmogrifai_tpu.utils.table import Table
-        rows = [(n, f"{c:+.4f}") for n, c in self.top_contributions(k)]
-        t = Table(["Derived column", "Contribution"], rows,
-                  title="Top model contributions")
-        return str(t)
+        sections: list[str] = []
+
+        if self.selected_model:
+            sm = self.selected_model
+            rows = [("Best model", sm.get("bestModelName", "")),
+                    ("Model type", sm.get("bestModelType", "")),
+                    ("Validation", sm.get("validationType", "")),
+                    ("Metric", sm.get("validationMetric", ""))]
+            holdout = sm.get("holdoutEvaluation") or {}
+            for ev_name, metrics in holdout.items():
+                for mk, mv in (metrics or {}).items():
+                    if isinstance(mv, (int, float)) and mv is not None:
+                        rows.append((f"holdout {mk}", f"{mv:.4f}"))
+            sections.append(str(Table(["Field", "Value"], rows,
+                                      title="Selected model")))
+            vals = sm.get("validationResults") or []
+            if vals:
+                metric = sm.get("validationMetric", "")
+                def _key(r):
+                    mv = (r.get("metricValues") or {}).get(metric)
+                    return -(mv if mv is not None else float("-inf"))
+
+                vrows = []
+                for r in sorted(vals, key=_key):
+                    mv = (r.get("metricValues") or {}).get(metric)
+                    vrows.append((r.get("modelName", ""),
+                                  "NaN" if mv is None else f"{mv:.4f}"))
+                sections.append(str(Table(
+                    ["Candidate", metric], vrows[:k],
+                    title="Validation results")))
+
+        contrib = [(n, f"{c:+.4f}") for n, c in self.top_contributions(k)]
+        if contrib:
+            sections.append(str(Table(["Derived column", "Contribution"],
+                                      contrib,
+                                      title="Top model contributions")))
+
+        corr_rows = []
+        for f in self.features:
+            for d in f.derived:
+                if d.corr_label is not None and np.isfinite(d.corr_label):
+                    corr_rows.append((d.name, d.corr_label))
+        if corr_rows:
+            corr_rows.sort(key=lambda t: -abs(t[1]))
+            sections.append(str(Table(
+                ["Derived column", "Label correlation"],
+                [(n, f"{c:+.4f}") for n, c in corr_rows[:k]],
+                title="Top label correlations")))
+
+        if self.sanity_check:
+            dropped = self.sanity_check.get("dropped") or []
+            if dropped:
+                reasons = {c["name"]: "; ".join(c.get("reasons", []))
+                           for c in self.sanity_check.get("columnStats", [])}
+                sections.append(str(Table(
+                    ["Dropped column", "Reason"],
+                    [(n, reasons.get(n, "")) for n in dropped[:k]],
+                    title="SanityChecker drops")))
+
+        if self.sensitive:
+            sections.append(str(Table(
+                ["Feature", "Detected name", "P(name)"],
+                [(n, str(d.get("detected")),
+                  (f"{d['probName']:.3f}"
+                   if d.get("probName") is not None else ""))
+                 for n, d in self.sensitive.items()],
+                title="Sensitive features (name detection)")))
+
+        excl = [(f.name, "; ".join(f.exclusion_reasons))
+                for f in self.features if f.exclusion_reasons]
+        if excl:
+            sections.append(str(Table(["Feature", "Excluded by"], excl,
+                                      title="Excluded raw features")))
+        return "\n\n".join(sections)

@@ -39,6 +39,32 @@ inline bool is_word(unsigned char c) {
            (c >= 'A' && c <= 'Z');
 }
 
+// The ONE tokenizer loop: every entry point routes through this so the
+// word-character set, lowercase rule, and 4096-byte token cap cannot drift
+// between consumers. emit(row, crc) fires once per token.
+template <class Emit>
+inline void scan_tokens(const char* buf, const int64_t* offsets, int64_t n,
+                        int32_t lowercase, Emit&& emit) {
+    init_crc();
+    unsigned char tok[4096];
+    for (int64_t r = 0; r < n; ++r) {
+        const char* p = buf + offsets[r];
+        const int64_t len = offsets[r + 1] - offsets[r];
+        int64_t t = 0;
+        for (int64_t i = 0; i <= len; ++i) {
+            unsigned char c = (i < len) ? (unsigned char)p[i] : 0;
+            if (i < len && is_word(c)) {
+                if (t < (int64_t)sizeof(tok))
+                    tok[t++] = lowercase && c >= 'A' && c <= 'Z'
+                                   ? c + 32 : c;
+            } else if (t > 0) {
+                emit(r, crc32_update(0u, tok, t));
+                t = 0;
+            }
+        }
+    }
+}
+
 }  // namespace
 
 extern "C" {
@@ -50,28 +76,25 @@ void hash_tokens_batch(const char* buf, const int64_t* offsets, int64_t n,
                        int32_t num_bins, int32_t lowercase,
                        int32_t binary_freq, float* out, int64_t stride,
                        int64_t col_offset) {
-    init_crc();
-    unsigned char tok[4096];
-    for (int64_t r = 0; r < n; ++r) {
-        const char* p = buf + offsets[r];
-        const int64_t len = offsets[r + 1] - offsets[r];
-        float* row = out + r * stride + col_offset;
-        int64_t t = 0;
-        for (int64_t i = 0; i <= len; ++i) {
-            unsigned char c = (i < len) ? (unsigned char)p[i] : 0;
-            if (i < len && is_word(c)) {
-                if (t < (int64_t)sizeof(tok))
-                    tok[t++] = lowercase && c >= 'A' && c <= 'Z'
-                                   ? c + 32 : c;
-            } else if (t > 0) {
-                uint32_t h = crc32_update(0u, tok, t);
-                int64_t b = (int64_t)(h % (uint32_t)num_bins);
-                if (binary_freq) row[b] = 1.0f;
-                else row[b] += 1.0f;
-                t = 0;
-            }
-        }
-    }
+    scan_tokens(buf, offsets, n, lowercase,
+                [&](int64_t r, uint32_t h) {
+                    float* row = out + r * stride + col_offset;
+                    int64_t b = (int64_t)(h % (uint32_t)num_bins);
+                    if (binary_freq) row[b] = 1.0f;
+                    else row[b] += 1.0f;
+                });
+}
+
+// Accumulates every row's token bins into ONE histogram hist[num_bins]
+// (double counts) — the RawFeatureFilter distribution pass, which needs the
+// corpus-level token distribution rather than per-row vectors, so no
+// [n, bins] intermediate is materialized.
+void hash_tokens_hist(const char* buf, const int64_t* offsets, int64_t n,
+                      int32_t num_bins, int32_t lowercase, double* hist) {
+    scan_tokens(buf, offsets, n, lowercase,
+                [&](int64_t, uint32_t h) {
+                    hist[h % (uint32_t)num_bins] += 1.0;
+                });
 }
 
 }  // extern "C"

@@ -1,0 +1,211 @@
+"""Language identification: character n-gram profiles over ~30 languages.
+
+Parity: reference ``utils/text/Language.scala`` + the Optimaize detector
+behind ``TextTokenizer.scala``/``LangDetector.scala`` — the classic textcat
+"out-of-place" method (Cavnar & Trenkle 1994, the same family Optimaize
+implements): each language gets a rank-ordered profile of its most frequent
+character 1-3-grams built from embedded seed text; a document is scored by
+how far its own top n-grams sit from each profile's ranks. Unicode script
+detection short-circuits the single-script languages (Hangul, kana, Han,
+Greek, Hebrew, Thai, Devanagari) before the n-gram vote, which then mostly
+separates languages sharing a script (Latin, Cyrillic, Arabic).
+
+Profiles are built once at import from the seed corpus below (a few
+sentences of ordinary prose per language — written for this module, no
+external data).
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from collections import Counter
+from typing import Optional
+
+__all__ = ["detect_language_ngram", "language_scores", "LANGUAGES"]
+
+#: seed prose per ISO-639-1 code
+_SAMPLES: dict[str, str] = {
+    "en": ("the quick brown fox jumps over the lazy dog and the weather "
+           "today is rather pleasant because we are going to the market "
+           "with our friends who have been waiting for this day"),
+    "fr": ("le renard brun saute par dessus le chien paresseux et le temps "
+           "aujourd'hui est plutôt agréable parce que nous allons au marché "
+           "avec nos amis qui attendaient ce jour depuis longtemps"),
+    "de": ("der schnelle braune fuchs springt über den faulen hund und das "
+           "wetter ist heute ziemlich angenehm weil wir mit unseren "
+           "freunden auf den markt gehen die auf diesen tag gewartet haben"),
+    "es": ("el rápido zorro marrón salta sobre el perro perezoso y el "
+           "tiempo hoy es bastante agradable porque vamos al mercado con "
+           "nuestros amigos que esperaban este día desde hace mucho"),
+    "it": ("la rapida volpe marrone salta sopra il cane pigro e il tempo "
+           "oggi è piuttosto piacevole perché andiamo al mercato con i "
+           "nostri amici che aspettavano questo giorno da molto tempo"),
+    "pt": ("a rápida raposa marrom salta sobre o cão preguiçoso e o tempo "
+           "hoje está bastante agradável porque vamos ao mercado com os "
+           "nossos amigos que esperavam por este dia há muito tempo"),
+    "nl": ("de snelle bruine vos springt over de luie hond en het weer is "
+           "vandaag best aangenaam omdat we met onze vrienden naar de markt "
+           "gaan die al lang op deze dag hebben gewacht"),
+    "sv": ("den snabba bruna räven hoppar över den lata hunden och vädret "
+           "idag är ganska trevligt eftersom vi ska till marknaden med våra "
+           "vänner som har väntat på den här dagen länge"),
+    "da": ("den hurtige brune ræv springer over den dovne hund og vejret i "
+           "dag er ret behageligt fordi vi skal på markedet med vores "
+           "venner som har ventet på denne dag længe"),
+    "no": ("den raske brune reven hopper over den late hunden og været i "
+           "dag er ganske hyggelig fordi vi skal til markedet med vennene "
+           "våre som har ventet på denne dagen lenge"),
+    "fi": ("nopea ruskea kettu hyppää laiskan koiran yli ja sää on tänään "
+           "melko miellyttävä koska menemme torille ystäviemme kanssa "
+           "jotka ovat odottaneet tätä päivää pitkään"),
+    "pl": ("szybki brązowy lis przeskakuje nad leniwym psem a pogoda jest "
+           "dzisiaj dość przyjemna ponieważ idziemy na targ z naszymi "
+           "przyjaciółmi którzy długo czekali na ten dzień"),
+    "cs": ("rychlá hnědá liška skáče přes líného psa a počasí je dnes "
+           "docela příjemné protože jdeme na trh s našimi přáteli kteří na "
+           "tento den dlouho čekali"),
+    "sk": ("rýchla hnedá líška skáče cez lenivého psa a počasie je dnes "
+           "celkom príjemné pretože ideme na trh s našimi priateľmi ktorí "
+           "na tento deň dlho čakali"),
+    "ro": ("vulpea maro rapidă sare peste câinele leneș iar vremea de "
+           "astăzi este destul de plăcută pentru că mergem la piață cu "
+           "prietenii noștri care au așteptat mult această zi"),
+    "hu": ("a gyors barna róka átugrik a lusta kutya felett és az idő ma "
+           "elég kellemes mert a piacra megyünk a barátainkkal akik régóta "
+           "várták ezt a napot"),
+    "tr": ("hızlı kahverengi tilki tembel köpeğin üzerinden atlar ve bugün "
+           "hava oldukça güzel çünkü uzun zamandır bu günü bekleyen "
+           "arkadaşlarımızla pazara gidiyoruz"),
+    "vi": ("con cáo nâu nhanh nhẹn nhảy qua con chó lười biếng và thời "
+           "tiết hôm nay khá dễ chịu vì chúng tôi sẽ đi chợ với những "
+           "người bạn đã chờ đợi ngày này từ lâu"),
+    "id": ("rubah coklat yang cepat melompati anjing yang malas dan cuaca "
+           "hari ini cukup menyenangkan karena kami akan pergi ke pasar "
+           "bersama teman teman kami yang sudah lama menunggu hari ini"),
+    "ru": ("быстрая коричневая лиса прыгает через ленивую собаку и погода "
+           "сегодня довольно приятная потому что мы идем на рынок с "
+           "нашими друзьями которые давно ждали этот день"),
+    "uk": ("швидка коричнева лисиця стрибає через ледачого пса і погода "
+           "сьогодні досить приємна тому що ми йдемо на ринок з нашими "
+           "друзями які давно чекали на цей день"),
+    "bg": ("бързата кафява лисица прескача мързеливото куче и времето "
+           "днес е доста приятно защото отиваме на пазара с нашите "
+           "приятели които отдавна чакаха този ден"),
+    "el": ("η γρήγορη καφέ αλεπού πηδάει πάνω από τον τεμπέλη σκύλο και ο "
+           "καιρός σήμερα είναι αρκετά ευχάριστος επειδή πηγαίνουμε στην "
+           "αγορά με τους φίλους μας που περίμεναν αυτή τη μέρα"),
+    "ar": ("الثعلب البني السريع يقفز فوق الكلب الكسول والطقس اليوم لطيف "
+           "إلى حد ما لأننا ذاهبون إلى السوق مع أصدقائنا الذين انتظروا "
+           "هذا اليوم طويلا"),
+    "fa": ("روباه قهوه ای سریع از روی سگ تنبل می پرد و هوای امروز نسبتا "
+           "خوب است زیرا با دوستان خود که مدت ها منتظر این روز بودند به "
+           "بازار می رویم"),
+    "he": ("השועל החום המהיר קופץ מעל הכלב העצלן ומזג האוויר היום די נעים "
+           "כי אנחנו הולכים לשוק עם החברים שלנו שחיכו ליום הזה הרבה זמן"),
+    "hi": ("तेज भूरी लोमड़ी आलसी कुत्ते के ऊपर से कूदती है और आज का मौसम "
+           "काफी सुहावना है क्योंकि हम अपने दोस्तों के साथ बाजार जा रहे "
+           "हैं जो इस दिन का लंबे समय से इंतजार कर रहे थे"),
+    "th": ("สุนัขจิ้งจอกสีน้ำตาลตัวเร็วกระโดดข้ามสุนัขขี้เกียจและอากาศวันนี้ค่อนข้างดีเพราะเราจะไป"
+           "ตลาดกับเพื่อนของเราที่รอคอยวันนี้มานาน"),
+    "zh": ("敏捷的棕色狐狸跳过懒狗今天的天气相当不错因为我们要和朋友一起去市场"
+           "他们等这一天已经很久了"),
+    "ja": ("すばやい茶色のキツネは怠け者の犬を飛び越えます今日の天気はかなり良い"
+           "ので友達と一緒に市場に行きますこの日を長い間待っていました"),
+    "ko": ("빠른 갈색 여우가 게으른 개를 뛰어넘고 오늘 날씨가 꽤 좋아서 "
+           "오랫동안 이 날을 기다려온 친구들과 함께 시장에 갑니다"),
+}
+
+LANGUAGES = tuple(sorted(_SAMPLES))
+
+_PROFILE_SIZE = 300
+
+#: one-script languages resolvable from the dominant Unicode script alone
+_SCRIPT_LANG = {
+    "HANGUL": "ko", "GREEK": "el", "HEBREW": "he", "THAI": "th",
+    "DEVANAGARI": "hi",
+}
+
+
+def _ngrams(text: str) -> Counter:
+    """Character 1-3-gram counts over the normalized text (word-padded,
+    textcat-style)."""
+    counts: Counter = Counter()
+    for word in text.lower().split():
+        w = f" {word} "
+        for n in (1, 2, 3):
+            for i in range(len(w) - n + 1):
+                counts[w[i:i + n]] += 1
+    return counts
+
+
+def _profile(text: str) -> dict[str, int]:
+    """gram -> rank for the PROFILE_SIZE most frequent grams."""
+    top = [g for g, _ in _ngrams(text).most_common(_PROFILE_SIZE)]
+    return {g: r for r, g in enumerate(top)}
+
+
+_PROFILES: dict[str, dict[str, int]] = {
+    lang: _profile(text) for lang, text in _SAMPLES.items()
+}
+
+
+def _dominant_script(text: str) -> Optional[str]:
+    """Coarse script vote via unicodedata names (first word of the name)."""
+    votes: Counter = Counter()
+    for ch in text[:200]:
+        if ch.isspace() or not ch.isalpha():
+            continue
+        try:
+            name = unicodedata.name(ch)
+        except ValueError:
+            continue
+        votes[name.split()[0]] += 1
+    if not votes:
+        return None
+    return votes.most_common(1)[0][0]
+
+
+def language_scores(text: str) -> dict[str, float]:
+    """lang -> similarity in (0, 1]; higher is better. Empty on no signal."""
+    if not text or not any(ch.isalpha() for ch in text):
+        return {}
+    script = _dominant_script(text)
+    if script == "CJK":
+        # Han only -> Chinese; any kana -> Japanese
+        has_kana = any("HIRAGANA" in unicodedata.name(c, "")
+                       or "KATAKANA" in unicodedata.name(c, "")
+                       for c in text[:200])
+        return {"ja" if has_kana else "zh": 1.0}
+    if script in ("HIRAGANA", "KATAKANA"):
+        return {"ja": 1.0}
+    if script in _SCRIPT_LANG:
+        return {_SCRIPT_LANG[script]: 1.0}
+    candidates = LANGUAGES
+    if script == "CYRILLIC":
+        candidates = ("ru", "uk", "bg")
+    elif script == "ARABIC":
+        candidates = ("ar", "fa")
+    elif script == "LATIN":
+        candidates = tuple(l for l in LANGUAGES if l not in
+                           ("ru", "uk", "bg", "el", "ar", "fa", "he", "hi",
+                            "th", "zh", "ja", "ko"))
+    doc = [g for g, _ in _ngrams(text).most_common(_PROFILE_SIZE)]
+    if not doc:
+        return {}
+    max_oop = _PROFILE_SIZE  # out-of-place penalty for a missing gram
+    scores = {}
+    for lang in candidates:
+        prof = _PROFILES[lang]
+        dist = sum(abs(prof.get(g, max_oop) - r) for r, g in enumerate(doc))
+        worst = len(doc) * max_oop
+        scores[lang] = 1.0 - dist / max(worst, 1)
+    return scores
+
+
+def detect_language_ngram(text: str) -> Optional[str]:
+    """Best-scoring language code, or None when the text carries no
+    alphabetic signal."""
+    scores = language_scores(text)
+    if not scores:
+        return None
+    return max(scores.items(), key=lambda kv: kv[1])[0]

@@ -28,7 +28,8 @@ from transmogrifai_tpu.types import feature_types as ft
 
 __all__ = ["GenderDetectStrategy", "HumanNameDetector",
            "HumanNameDetectorModel", "NameEntityRecognizer",
-           "MALE_NAMES", "FEMALE_NAMES", "NAME_DICTIONARY"]
+           "MALE_NAMES", "FEMALE_NAMES", "NAME_DICTIONARY", "SURNAMES",
+           "LOCATIONS", "ORG_SUFFIXES"]
 
 _TOKEN_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
 
@@ -40,8 +41,18 @@ MALE_NAMES = frozenset(
     "brandon benjamin samuel gregory frank alexander raymond patrick jack "
     "dennis jerry tyler aaron jose adam nathan henry douglas zachary peter "
     "kyle noah ethan carlos juan luis miguel pedro diego omar ali ahmed "
-    "mohammed wei jun hiroshi kenji ivan dmitri sergei pierre jean luc "
-    "hans klaus giovanni marco antonio".split())
+    "mohammed muhammad mehmet mustafa ibrahim hassan hussein karim tariq "
+    "wei jun ming hao lei chen hiroshi kenji takeshi satoshi yuki kazuo "
+    "ichiro minho jihoon sung ivan dmitri sergei alexei mikhail nikolai "
+    "vladimir boris pavel andrei pierre jean luc marcel francois jacques "
+    "michel philippe henri hans klaus jurgen wolfgang dieter fritz stefan "
+    "giovanni marco antonio giuseppe luigi paolo francesco alessandro "
+    "lorenzo matteo rafael santiago javier fernando alejandro ricardo "
+    "eduardo roberto sergio pablo manuel raj amit sanjay vijay arjun rahul "
+    "ravi anil sunil deepak krishnan lars erik sven bjorn nils olaf piotr "
+    "jakub tomasz marek kofi kwame chidi emeka ade oluwaseun abdul rashid "
+    "walter arthur albert harold ernest eugene ralph howard leon oscar "
+    "felix hugo leo max victor simon martin".split())
 
 FEMALE_NAMES = frozenset(
     "mary patricia jennifer linda elizabeth barbara susan jessica sarah "
@@ -51,10 +62,64 @@ FEMALE_NAMES = frozenset(
     "nicole helen samantha katherine christine debra rachel carolyn janet "
     "catherine maria heather diane ruth julie olivia joyce virginia grace "
     "sofia isabella mia charlotte amelia harper luna camila elena fatima "
-    "aisha mei yuki sakura ingrid anastasia natasha marie claire chloe "
-    "giulia francesca".split())
+    "aisha amina leila zainab yasmin noor mei ling xiu hua yan li yuki "
+    "sakura hana akiko yoko keiko naomi jiwoo minji soyeon ingrid "
+    "anastasia natasha svetlana olga irina tatiana ekaterina yelena marie "
+    "claire chloe camille sophie juliette amelie celine margot giulia "
+    "francesca chiara alessia martina valentina lucia carmen rosa pilar "
+    "dolores mercedes josefina ana lucia priya ananya divya kavya lakshmi "
+    "meera pooja astrid freja sigrid maja ewa agnieszka katarzyna zofia "
+    "ngozi chiamaka folake abebi alice clara eva julia laura lena mila "
+    "nina rosa sara vera iris ivy jade hazel".split())
 
-NAME_DICTIONARY = MALE_NAMES | FEMALE_NAMES
+SURNAMES = frozenset(
+    "smith johnson williams brown jones garcia miller davis rodriguez "
+    "martinez hernandez lopez gonzalez wilson anderson thomas taylor moore "
+    "jackson martin lee perez thompson white harris sanchez clark ramirez "
+    "lewis robinson walker young allen king wright scott torres nguyen "
+    "hill flores green adams nelson baker hall rivera campbell mitchell "
+    "carter roberts gomez phillips evans turner diaz parker cruz edwards "
+    "collins reyes stewart morris morales murphy cook rogers gutierrez "
+    "ortiz morgan cooper peterson bailey reed kelly howard ramos kim cho "
+    "park choi kang wang li zhang liu chen yang huang zhao wu zhou xu sun "
+    "ma zhu hu lin guo he gao luo tanaka suzuki takahashi watanabe ito "
+    "yamamoto nakamura kobayashi saito kato singh kumar sharma patel gupta "
+    "khan ahmed hussain ali shah ivanov petrov sidorov smirnov kuznetsov "
+    "popov volkov muller schmidt schneider fischer weber meyer wagner "
+    "becker schulz hoffmann dubois bernard durand moreau laurent lefebvre "
+    "rossi russo ferrari esposito bianchi romano colombo ricci silva "
+    "santos oliveira souza pereira costa ferreira almeida nowak kowalski "
+    "wisniewski andersson johansson karlsson nilsson eriksson larsen "
+    "hansen olsen jensen nielsen okafor okonkwo adeyemi mensah osei".split())
+
+LOCATIONS = frozenset(
+    "london paris berlin madrid rome amsterdam brussels vienna zurich "
+    "geneva dublin lisbon athens warsaw prague budapest bucharest moscow "
+    "kyiv istanbul ankara cairo lagos nairobi johannesburg capetown accra "
+    "casablanca tokyo osaka kyoto seoul busan beijing shanghai shenzhen "
+    "guangzhou hongkong taipei singapore bangkok jakarta manila hanoi "
+    "mumbai delhi bangalore chennai kolkata karachi lahore dhaka sydney "
+    "melbourne brisbane perth auckland wellington newyork chicago boston "
+    "seattle portland denver austin dallas houston phoenix miami atlanta "
+    "detroit philadelphia baltimore toronto vancouver montreal ottawa "
+    "mexico bogota lima santiago buenosaires saopaulo rio brasilia "
+    "america england france germany spain italy portugal netherlands "
+    "belgium austria switzerland ireland poland czechia hungary romania "
+    "greece russia ukraine turkey egypt nigeria kenya ghana morocco japan "
+    "korea china india pakistan bangladesh australia canada brazil "
+    "argentina chile peru colombia".split())
+
+#: organization-name suffixes (the OpenNLP organization tag analog)
+ORG_SUFFIXES = frozenset(
+    "inc corp corporation ltd llc llp plc gmbh ag sa srl bv oy ab co "
+    "company group holdings industries technologies solutions systems "
+    "labs laboratories partners ventures capital bank university institute "
+    "foundation association society".split())
+
+#: full name dictionary for hit-rate detection (the reference's census
+#: NameDictionary spans first AND last names; gender stays on the gendered
+#: first-name sets)
+NAME_DICTIONARY = MALE_NAMES | FEMALE_NAMES | SURNAMES
 
 MALE_HONORIFICS = frozenset({"mr", "mister", "sir"})
 FEMALE_HONORIFICS = frozenset({"ms", "mrs", "miss", "madam"})
@@ -216,9 +281,11 @@ class NameEntityRecognizer(HostTransformer):
     """Text -> MultiPickListMap token -> {entity tags}.
 
     The reference runs OpenNLP's binary NER models per sentence; here a
-    dictionary/heuristic tagger: capitalized tokens in the name dictionary
-    tag as Person (capitalization distinguishes 'Mark asked' from 'mark the
-    date' — same disambiguation role the statistical model plays)."""
+    dictionary/heuristic tagger over Person (first names + surnames, with a
+    capitalized-followed-by-surname bigram rule), Location, and Organization
+    (capitalized token preceding a corporate suffix). Capitalization
+    distinguishes 'Mark asked' from 'mark the date' — the same
+    disambiguation role the statistical model plays."""
 
     in_types = (ft.Text,)
     out_type = ft.MultiPickListMap
@@ -231,10 +298,28 @@ class NameEntityRecognizer(HostTransformer):
     def transform_row(self, value):
         if not value:
             return {}
+        raw_toks = _TOKEN_RE.findall(value)
         out: dict[str, set] = {}
-        for raw in _TOKEN_RE.findall(value):
-            if self.require_capitalized and not raw[:1].isupper():
-                continue
-            if raw.lower() in NAME_DICTIONARY:
-                out.setdefault(raw.lower(), set()).add("Person")
+
+        def tag(token: str, label: str) -> None:
+            out.setdefault(token.lower(), set()).add(label)
+
+        for i, raw in enumerate(raw_toks):
+            low = raw.lower()
+            capital_ok = (not self.require_capitalized
+                          or raw[:1].isupper())
+            nxt = raw_toks[i + 1] if i + 1 < len(raw_toks) else ""
+            if capital_ok:
+                if low in NAME_DICTIONARY:  # spans first + last names
+                    tag(raw, "Person")
+                    # "John Smithfield": an unknown capitalized token right
+                    # after a first name reads as its surname
+                    if low in NAME_DICTIONARY and nxt[:1].isupper() \
+                            and nxt.lower() not in LOCATIONS:
+                        tag(nxt, "Person")
+                if low in LOCATIONS:
+                    tag(raw, "Location")
+                if nxt.lower() in ORG_SUFFIXES:
+                    tag(raw, "Organization")
+                    tag(nxt, "Organization")
         return out

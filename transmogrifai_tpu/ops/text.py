@@ -5,9 +5,11 @@ Parity: reference ``core/.../stages/impl/feature/{TextTokenizer,
 LangDetector, OpStopWordsRemover, OpNGram, NGramSimilarity,
 TextLenTransformer}.scala`` and ``core/.../utils/text/*``. The reference
 rides Lucene analyzers + the Optimaize detector; here tokenization is a
-unicode word-regex analyzer and language detection is stopword-profile
-scoring — same stage surface and behavior class, no JVM deps. All of these
-are host stages (string work stays off the device; SURVEY §7 hard part #2).
+unicode word-regex analyzer with a CJK/Thai character-bigram path (the
+LuceneTextAnalyzer/CJKAnalyzer analog) and language identification is the
+character-n-gram profile detector in ``ops/lang.py`` (~30 languages, the
+Optimaize/textcat family). All of these are host stages (string work stays
+off the device; SURVEY §7 hard part #2).
 """
 
 from __future__ import annotations
@@ -18,17 +20,28 @@ from typing import Optional
 import numpy as np
 
 from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.ops.lang import detect_language_ngram, language_scores
 from transmogrifai_tpu.stages.base import HostTransformer
 from transmogrifai_tpu.types import feature_types as ft
 
 __all__ = [
     "TextTokenizer", "LangDetector", "OpStopWordsRemover", "OpNGram",
     "NGramSimilarity", "TextLenTransformer", "STOP_WORDS",
+    "simple_tokenize", "detect_language",
 ]
 
 _WORD_RE = re.compile(r"[^\W_]+", re.UNICODE)
 
-#: minimal per-language stopword profiles (detection + removal)
+#: scripts written without spaces: tokens segment into character bigrams
+#: (the Lucene CJKAnalyzer convention)
+_BIGRAM_RANGES = (
+    (0x2E80, 0x9FFF),    # CJK radicals .. unified ideographs
+    (0x3040, 0x30FF),    # hiragana + katakana (inside the range above)
+    (0xF900, 0xFAFF),    # CJK compatibility
+    (0x0E00, 0x0E7F),    # Thai
+)
+
+#: per-language stopword profiles (removal; detection rides ops/lang.py)
 STOP_WORDS: dict[str, frozenset] = {
     "en": frozenset("the a an and or of to in is are was were be been i you "
                     "he she it we they this that with for on at by from as "
@@ -51,27 +64,79 @@ STOP_WORDS: dict[str, frozenset] = {
     "nl": frozenset("de het een en of van naar in is zijn was waren ik jij "
                     "hij zij wij jullie met voor op bij uit niet geen maar "
                     "als dan wat welke wie".split()),
+    "sv": frozenset("och det att i en jag hon som han på den med var sig "
+                    "för så till är men ett om hade de av icke mig du "
+                    "henne då sin nu har inte hans honom".split()),
+    "da": frozenset("og i jeg det at en den til er som på de med han af "
+                    "for ikke der var mig sig men et har om vi min havde "
+                    "ham hun nu over da fra du ud".split()),
+    "no": frozenset("og i jeg det at en et den til er som på de med han "
+                    "av ikke der så var meg seg men ett har om vi min "
+                    "mitt ha hadde hun nå over da ved fra du ut".split()),
+    "fi": frozenset("olla olen on ja se ei että en oli hän minä joka mitä "
+                    "tämä mutta niin kuin sen sitä tai kun nyt jos mikä "
+                    "ole vain minun hänen ovat sinä me he".split()),
+    "pl": frozenset("i w nie na się że z do to jak o co tak jest po a ale "
+                    "czy za przez od dla przy bez być może ten ta te go "
+                    "ich jego jej mnie ciebie".split()),
+    "cs": frozenset("a v na se že je s z do o k i ale jako za by pro tak "
+                    "po co když už jen při od být ten tato toto jsem jsi "
+                    "jsou byl byla bylo nebo ani".split()),
+    "ro": frozenset("și în a la cu de pe că nu este sunt un o care mai "
+                    "din pentru dar dacă ce așa după cum fără sau fi am "
+                    "ai are acest această eu tu el ea noi".split()),
+    "hu": frozenset("a az és hogy nem is ez egy van volt de meg csak már "
+                    "el mint még ki mi ha vagy lesz lehet más aki amely "
+                    "én te ő mert azt ezt nagyon".split()),
+    "tr": frozenset("ve bir bu da de için ile ne gibi daha çok ama o ben "
+                    "sen biz siz onlar mi mu değil var yok olan olarak "
+                    "kadar sonra önce her şey ki en".split()),
+    "ru": frozenset("и в не на я что он с как это а то все она так его но "
+                    "они к у же вы за бы по ее мне было вот от меня о из "
+                    "ему теперь когда даже ну ли если уже или".split()),
+    "id": frozenset("yang dan di ini itu dengan untuk tidak dari dalam "
+                    "akan pada juga saya kamu dia kami mereka ada bisa "
+                    "sudah atau ke oleh karena jika seperti".split()),
 }
+
+
+def _needs_bigrams(ch: str) -> bool:
+    cp = ord(ch)
+    return any(lo <= cp <= hi for lo, hi in _BIGRAM_RANGES)
 
 
 def simple_tokenize(text: str, lowercase: bool = True,
                     min_token_length: int = 1) -> list[str]:
+    """Unicode word tokens; runs in space-less scripts (CJK, kana, Thai)
+    segment into overlapping character bigrams. Mixed-script tokens split
+    at script boundaries first (the CJKAnalyzer convention), so 'abc漢字'
+    yields 'abc' + the CJK bigrams regardless of which script leads."""
     if lowercase:
         text = text.lower()
-    return [t for t in _WORD_RE.findall(text) if len(t) >= min_token_length]
+    out = []
+    for tok in _WORD_RE.findall(text):
+        start = 0
+        while start < len(tok):
+            is_cjk = _needs_bigrams(tok[start])
+            end = start + 1
+            while end < len(tok) and _needs_bigrams(tok[end]) == is_cjk:
+                end += 1
+            run = tok[start:end]
+            start = end
+            if is_cjk:
+                if len(run) == 1:
+                    out.append(run)
+                else:
+                    out.extend(run[i:i + 2] for i in range(len(run) - 1))
+            elif len(run) >= min_token_length:
+                out.append(run)
+    return out
 
 
 def detect_language(text: str) -> Optional[str]:
-    """Stopword-profile scoring; None when no profile matches."""
-    toks = set(simple_tokenize(text))
-    if not toks:
-        return None
-    best, best_score = None, 0
-    for lang, words in STOP_WORDS.items():
-        score = len(toks & words)
-        if score > best_score:
-            best, best_score = lang, score
-    return best
+    """Character-n-gram profile detection over ~30 languages (ops/lang.py);
+    None when the text carries no alphabetic signal."""
+    return detect_language_ngram(text)
 
 
 class TextTokenizer(HostTransformer):
@@ -106,27 +171,25 @@ class TextTokenizer(HostTransformer):
 
 
 class LangDetector(HostTransformer):
-    """Text -> RealMap of language -> confidence (reference LangDetector
-    emits the detected-language score map)."""
+    """Text -> RealMap of language -> confidence for the top candidates
+    (reference LangDetector emits the Optimaize detected-language score
+    map)."""
 
     in_types = (ft.Text,)
     out_type = ft.RealMap
 
-    def __init__(self, uid: Optional[str] = None):
+    def __init__(self, top_k: int = 3, uid: Optional[str] = None):
+        self.top_k = top_k
         super().__init__(uid=uid)
 
     def transform_row(self, value):
         if value is None:
             return {}
-        toks = set(simple_tokenize(value))
-        if not toks:
+        scores = language_scores(value)
+        if not scores:
             return {}
-        scores = {lang: len(toks & words) / len(toks)
-                  for lang, words in STOP_WORDS.items()}
-        best = max(scores.values())
-        if best <= 0:
-            return {}
-        return {k: v for k, v in scores.items() if v > 0}
+        top = sorted(scores.items(), key=lambda kv: -kv[1])[:self.top_k]
+        return {k: float(v) for k, v in top if v > 0}
 
 
 class OpStopWordsRemover(HostTransformer):

@@ -114,12 +114,15 @@ class TimePeriodTransformer(HostTransformer):
 
     def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
         col = cols[0]
-        vals = np.asarray(
-            [0 if v is None else int(v) for v in col.values], np.int64)
+        # python_value applies the null mask — numeric-backed date columns
+        # store masked slots as 0 in .values, which must stay None here
+        raw = [col.python_value(i) for i in range(len(col))]
+        vals = np.asarray([0 if v is None else int(v) for v in raw],
+                          np.int64)
         out = self._period().extract(vals)
         return fr.HostColumn.from_values(
             ft.Integral,
-            [int(out[i]) if col.values[i] is not None else None
+            [int(out[i]) if raw[i] is not None else None
              for i in range(len(col))])
 
     def transform_row(self, value):
@@ -150,7 +153,7 @@ class TimePeriodListTransformer(HostTransformer):
         # Rows have one period value per event, so widths vary (the reference
         # emits variable-length Spark vectors). The columnar frame needs one
         # static width: pad each row with zeros to the batch max.
-        rows = [self.transform_row(cols[0].values[i])
+        rows = [self.transform_row(cols[0].python_value(i))
                 for i in range(len(cols[0]))]
         width = max((r.shape[0] for r in rows), default=0)
         out = np.zeros((len(rows), width), np.float32)

@@ -29,7 +29,7 @@ from transmogrifai_tpu.vector_metadata import (
     NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
 )
 
-__all__ = ["TextHashingVectorizer", "hash_token"]
+__all__ = ["TextHashingVectorizer", "hash_token", "encode_ascii_rows"]
 
 _TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
 
@@ -39,7 +39,9 @@ _native_tried = False
 
 
 def _native():
-    """Build/load the C++ tokenizer-hasher once (None when unavailable)."""
+    """Build/load the C++ tokenizer-hasher once (None when unavailable).
+    Registers BOTH entry points (per-row batch + corpus histogram) so every
+    consumer shares one loader and one tokenizer contract."""
     global _native_lib, _native_tried
     if not _native_tried:
         _native_tried = True
@@ -47,17 +49,51 @@ def _native():
         lib = build_and_load("text_hashing.cpp", "texthash")
         if lib is not None:
             import ctypes
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
             lib.hash_tokens_batch.argtypes = [
-                ctypes.c_char_p,
-                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_char_p, i64p,
                 ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32,
                 np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
                 ctypes.c_int64, ctypes.c_int64,
             ]
             lib.hash_tokens_batch.restype = None
+            lib.hash_tokens_hist.argtypes = [
+                ctypes.c_char_p, i64p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ]
+            lib.hash_tokens_hist.restype = None
         _native_lib = lib
     return _native_lib
+
+
+#: native-eligibility row-length cap (protects the C 4096-byte token buffer
+#: with margin; longer rows take the Python path)
+_NATIVE_MAX_LEN = 4000
+
+
+def encode_ascii_rows(values) -> Optional[tuple[bytes, np.ndarray, int]]:
+    """(concatenated buffer, [n+1] offsets, null count) for the native
+    tokenizer, or None when any row is ineligible (non-str/non-ASCII/too
+    long — parity with the Python regex path is a contract). Shared by the
+    vectorizer and the RawFeatureFilter distribution pass."""
+    if not all(v is None or (isinstance(v, str) and v.isascii()
+                             and len(v) <= _NATIVE_MAX_LEN) for v in values):
+        return None
+    n = len(values)
+    parts: list[bytes] = []
+    lens = np.zeros(n + 1, dtype=np.int64)
+    nulls = 0
+    for r in range(n):
+        v = values[r]
+        if v is None:
+            nulls += 1
+            continue  # zero-length row: no tokens
+        b = v.encode("ascii")
+        parts.append(b)
+        lens[r + 1] = len(b)
+    return b"".join(parts), np.cumsum(lens).astype(np.int64), nulls
 
 
 def hash_token(token: str, num_bins: int) -> int:
@@ -67,7 +103,13 @@ def hash_token(token: str, num_bins: int) -> int:
 def tokenize(text: str, lowercase: bool = True) -> list[str]:
     if lowercase:
         text = text.lower()
-    return _TOKEN_RE.findall(text)
+    if text.isascii():
+        return _TOKEN_RE.findall(text)
+    # space-less scripts (CJK/Thai) segment into character bigrams; the
+    # script-aware analyzer lives with the text chain (never reaches the
+    # native path, which is ASCII-only by contract)
+    from transmogrifai_tpu.ops.text import simple_tokenize
+    return simple_tokenize(text, lowercase=False)
 
 
 class TextHashingVectorizer(HostTransformer):
@@ -128,23 +170,12 @@ class TextHashingVectorizer(HostTransformer):
         lib = _native()
         if lib is None:
             return False
-        # eligibility pre-scan first: a late ineligible row must not waste
-        # a full encode pass before the Python fallback redoes the column
-        if not all(v is None or (v.isascii() and len(v) <= 4000)
-                   for v in col.values):
+        encoded = encode_ascii_rows(col.values)
+        if encoded is None:
             return False
-        parts: list[bytes] = []
-        lens = np.zeros(len(col) + 1, dtype=np.int64)
-        for r in range(len(col)):
-            v = col.values[r]
-            if v is None:
-                continue  # zero-length row: no tokens
-            b = v.encode("ascii")
-            parts.append(b)
-            lens[r + 1] = len(b)
-        offsets = np.cumsum(lens).astype(np.int64)
+        buf, offsets, _ = encoded
         lib.hash_tokens_batch(
-            b"".join(parts), offsets, np.int64(len(col)),
+            buf, offsets, np.int64(len(col)),
             np.int32(self.num_features), np.int32(self.lowercase),
             np.int32(self.binary_freq), out, np.int64(out.shape[1]),
             np.int64(col_offset))

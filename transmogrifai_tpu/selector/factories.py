@@ -103,6 +103,7 @@ class BinaryClassificationModelSelector:
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
             stratify: bool = False,
+            max_wait_s: Optional[float] = 3600.0,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -113,6 +114,7 @@ class BinaryClassificationModelSelector:
             else DataSplitter(seed=seed),
             evaluators=[OpBinaryClassificationEvaluator()],
             validation_metric=validation_metric,
+            max_wait_s=max_wait_s,
         )
 
     @staticmethod
@@ -122,6 +124,7 @@ class BinaryClassificationModelSelector:
             seed: int = 42,
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
+            max_wait_s: Optional[float] = 3600.0,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -131,6 +134,7 @@ class BinaryClassificationModelSelector:
             else DataSplitter(seed=seed),
             evaluators=[OpBinaryClassificationEvaluator()],
             validation_metric=validation_metric,
+            max_wait_s=max_wait_s,
         )
 
 
@@ -143,6 +147,7 @@ class MultiClassificationModelSelector:
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
             stratify: bool = False,
+            max_wait_s: Optional[float] = 3600.0,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -153,6 +158,7 @@ class MultiClassificationModelSelector:
             else DataCutter(seed=seed),
             evaluators=[OpMultiClassificationEvaluator()],
             validation_metric=validation_metric,
+            max_wait_s=max_wait_s,
         )
 
     @staticmethod
@@ -162,6 +168,7 @@ class MultiClassificationModelSelector:
             seed: int = 42,
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
+            max_wait_s: Optional[float] = 3600.0,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -172,6 +179,7 @@ class MultiClassificationModelSelector:
             else DataCutter(seed=seed),
             evaluators=[OpMultiClassificationEvaluator()],
             validation_metric=validation_metric,
+            max_wait_s=max_wait_s,
         )
 
 
@@ -183,6 +191,7 @@ class RegressionModelSelector:
             seed: int = 42,
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
+            max_wait_s: Optional[float] = 3600.0,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -192,6 +201,7 @@ class RegressionModelSelector:
             else DataSplitter(seed=seed),
             evaluators=[OpRegressionEvaluator()],
             validation_metric=validation_metric,
+            max_wait_s=max_wait_s,
         )
 
     @staticmethod
@@ -201,6 +211,7 @@ class RegressionModelSelector:
             seed: int = 42,
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
+            max_wait_s: Optional[float] = 3600.0,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -211,4 +222,5 @@ class RegressionModelSelector:
             else DataSplitter(seed=seed),
             evaluators=[OpRegressionEvaluator()],
             validation_metric=validation_metric,
+            max_wait_s=max_wait_s,
         )

@@ -56,6 +56,9 @@ class ModelSelectorSummary:
     holdout_evaluation: dict = field(default_factory=dict)
     data_prep_results: dict = field(default_factory=dict)
     wall_time_s: float = 0.0
+    #: candidates that failed or were skipped during the sweep (reference
+    #: maxWait/failed-future semantics): [{"modelName":, "reason":}]
+    failures: list = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {
@@ -74,6 +77,7 @@ class ModelSelectorSummary:
             "holdoutEvaluation": _jsonable(self.holdout_evaluation),
             "dataPrepResults": _jsonable(self.data_prep_results),
             "wallTimeSeconds": self.wall_time_s,
+            "failures": _jsonable(self.failures),
         }
 
     @staticmethod
@@ -97,6 +101,7 @@ class ModelSelectorSummary:
             holdout_evaluation=d.get("holdoutEvaluation", {}),
             data_prep_results=d.get("dataPrepResults", {}),
             wall_time_s=d.get("wallTimeSeconds", 0.0),
+            failures=d.get("failures", []),
         )
 
 
@@ -105,10 +110,15 @@ def _jsonable(x: Any) -> Any:
         return {str(k): _jsonable(v) for k, v in x.items()}
     if isinstance(x, (list, tuple)):
         return [_jsonable(v) for v in x]
-    if isinstance(x, (np.floating, np.integer)):
-        return float(x)
+    if isinstance(x, np.integer):
+        return int(x)
+    if isinstance(x, (float, np.floating)):
+        # NaN/inf (diverged candidates) would serialize as bare NaN tokens —
+        # invalid strict JSON for non-Python manifest consumers
+        f = float(x)
+        return f if np.isfinite(f) else None
     if isinstance(x, np.ndarray):
-        return x.tolist()
+        return _jsonable(x.tolist())
     return x
 
 
@@ -186,6 +196,7 @@ class ModelSelector(Estimator):
                  splitter: Optional[DataSplitter] = None,
                  evaluators: Sequence[EvaluatorBase] = (),
                  validation_metric: Optional[str] = None,
+                 max_wait_s: Optional[float] = 3600.0,
                  uid: Optional[str] = None):
         if not models_and_grids:
             raise ValueError("ModelSelector needs at least one candidate model")
@@ -197,6 +208,10 @@ class ModelSelector(Estimator):
             raise ValueError("ModelSelector needs at least one evaluator")
         self.validation_metric = validation_metric or \
             self.evaluators[0].default_metric
+        #: sweep wall-clock budget (reference OpValidator.scala:108 maxWait):
+        #: once exceeded, remaining candidate families are skipped and
+        #: recorded as failures — provided at least one candidate scored
+        self.max_wait_s = max_wait_s
         super().__init__(uid=uid)
 
     # -- shared pieces -------------------------------------------------------
@@ -218,62 +233,127 @@ class ModelSelector(Estimator):
                 np.ones(n, dtype=np.float32), {})
 
     def _sweep(self, fold_arrays) -> tuple[list[ModelEvaluation],
-                                           list[tuple[float, int, int]]]:
+                                           list[tuple[float, int, int]],
+                                           list[dict]]:
         """Run every (candidate, grid point) over the fold arrays; returns
-        per-candidate evaluations and (mean metric, cand, grid) triples."""
+        per-candidate evaluations, (mean metric, cand, grid) triples, and
+        recorded failures.
+
+        Failure isolation (reference OpValidator.scala:108 maxWait +
+        failed-future handling): a candidate family that raises on a fold —
+        after transient device errors are retried — is recorded and skipped,
+        never aborting the sweep; families starting past the ``max_wait_s``
+        budget are skipped once at least one candidate has scored; grid
+        points whose metric comes back non-finite (diverged fit) are
+        excluded from winner selection but still reported.
+        """
         from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.retry import with_device_retry
         ev0 = self.evaluators[0]
         batch_metrics = getattr(ev0, "metric_batch_scores", None)
         per_candidate_scores: dict[tuple[int, int], list[float]] = {}
-        for Xtr, ytr, wtr, Xva, yva in fold_arrays:
+        failures: list[dict] = []
+        failed_families: set[int] = set()
+        deadline = (time.time() + self.max_wait_s
+                    if self.max_wait_s is not None else None)
+
+        def family_name(ci):
+            return f"{type(self.models_and_grids[ci][0]).__name__}_{ci}"
+
+        for fold_i, (Xtr, ytr, wtr, Xva, yva) in enumerate(fold_arrays):
             # row-parallel training over the mesh: fold rows padded to the
             # data-axis multiple with weight 0 (validation stays unpadded —
             # metrics must see real rows only)
             Xtr, ytr, wtr = pmesh.shard_training_rows(Xtr, ytr, wtr)
             for ci, (est, grid) in enumerate(self.models_and_grids):
-                models = est.grid_fit_arrays(Xtr, ytr, wtr, grid)
-                scores = (est.grid_predict_scores(models, Xva)
-                          if batch_metrics is not None else None)
-                if scores is not None:
-                    # fast path: one device program scores + one computes the
-                    # metric for the whole grid; a single host sync per
-                    # (fold, family)
-                    vals = batch_metrics(yva, scores, self.validation_metric)
-                    for gj in range(len(models)):
-                        per_candidate_scores.setdefault((ci, gj), []).append(
-                            float(vals[gj]))
+                if ci in failed_families:
                     continue
-                for gj, model in enumerate(models):
-                    pred = model.predict_arrays(Xva)
-                    metrics = ev0.evaluate_arrays(yva, pred)
-                    val = ev0.metric_value(metrics, self.validation_metric)
-                    per_candidate_scores.setdefault((ci, gj), []).append(val)
+                if deadline is not None and time.time() > deadline:
+                    # drop the family entirely (pop partial fold scores, as
+                    # the exception path does — a partial-fold mean must not
+                    # compete against full-fold means), unless it is the
+                    # only family with any score: a winner must survive
+                    others_scored = any(k[0] != ci
+                                        for k in per_candidate_scores)
+                    if others_scored:
+                        for gj in range(len(grid)):
+                            per_candidate_scores.pop((ci, gj), None)
+                        failed_families.add(ci)
+                        failures.append({
+                            "modelName": family_name(ci),
+                            "reason": f"skipped: sweep exceeded max_wait_s="
+                                      f"{self.max_wait_s}"})
+                        continue
+                try:
+                    models = with_device_retry(
+                        est.grid_fit_arrays, Xtr, ytr, wtr, grid)
+                    scores = (est.grid_predict_scores(models, Xva)
+                              if batch_metrics is not None else None)
+                    if scores is not None:
+                        # fast path: one device program scores + one computes
+                        # the metric for the whole grid; a single host sync
+                        # per (fold, family)
+                        vals = batch_metrics(yva, scores,
+                                             self.validation_metric)
+                        for gj in range(len(models)):
+                            per_candidate_scores.setdefault(
+                                (ci, gj), []).append(float(vals[gj]))
+                        continue
+                    for gj, model in enumerate(models):
+                        pred = model.predict_arrays(Xva)
+                        # summary-only metric: evaluators skip their deep
+                        # report families inside the sweep
+                        val = ev0.metric_from_arrays(yva, pred,
+                                                     self.validation_metric)
+                        per_candidate_scores.setdefault((ci, gj), []).append(
+                            val)
+                except Exception as e:  # noqa: BLE001 — isolation by design
+                    failed_families.add(ci)
+                    for gj in range(len(grid)):
+                        per_candidate_scores.pop((ci, gj), None)
+                    failures.append({
+                        "modelName": family_name(ci),
+                        "reason": f"fold {fold_i}: {type(e).__name__}: "
+                                  f"{str(e)[:300]}"})
         results: list[ModelEvaluation] = []
         mean_metrics: list[tuple[float, int, int]] = []
         for (ci, gj), vals in per_candidate_scores.items():
             est, grid = self.models_and_grids[ci]
             mean = float(np.mean(vals))
-            mean_metrics.append((mean, ci, gj))
+            name = f"{type(est).__name__}_{ci}_{gj}"
             results.append(ModelEvaluation(
-                model_name=f"{type(est).__name__}_{ci}_{gj}",
+                model_name=name,
                 model_uid=est.uid,
                 model_type=type(est).__name__,
                 params={**est.params, **grid[gj]},
                 metric_values={self.validation_metric: mean}))
-        return results, mean_metrics
+            if np.isfinite(mean):
+                mean_metrics.append((mean, ci, gj))
+            else:
+                failures.append({
+                    "modelName": name,
+                    "reason": "non-finite validation metric (diverged fit)"})
+        if not mean_metrics:
+            raise RuntimeError(
+                "ModelSelector: every candidate failed or diverged; "
+                f"failures: {failures}")
+        return results, mean_metrics, failures
 
     def _finalize(self, results, mean_metrics, Xt, yt, wt, Xh, yh,
-                  prep_results: dict, t0: float) -> SelectedModel:
+                  prep_results: dict, t0: float,
+                  failures: Optional[list] = None) -> SelectedModel:
         """Refit the winning candidate on the full prepared training data,
         evaluate train + holdout, assemble the summary."""
         from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.retry import with_device_retry
         ev0 = self.evaluators[0]
         bigger = ev0.larger_is_better(self.validation_metric)
         _, best_ci, best_gj = (max if bigger else min)(
             mean_metrics, key=lambda t: t[0])
         best_est, best_grid = self.models_and_grids[best_ci]
         best_params = {**best_est.params, **best_grid[best_gj]}
-        best_model = best_est.fit_arrays(
+        best_model = with_device_retry(
+            best_est.fit_arrays,
             *pmesh.shard_training_rows(Xt, yt, wt), best_params)
 
         train_eval: dict = {}
@@ -300,6 +380,7 @@ class ModelSelector(Estimator):
             holdout_evaluation=holdout_eval,
             data_prep_results=prep_results,
             wall_time_s=time.time() - t0,
+            failures=list(failures or []),
         )
         return SelectedModel(model=best_model, summary=summary)
 
@@ -326,13 +407,13 @@ class ModelSelector(Estimator):
                 jtr, jva = jnp.asarray(tr), jnp.asarray(va)
                 yield Xt[jtr], yt[jtr], wt[jtr], Xt[jva], yt[jva]
 
-        results, mean_metrics = self._sweep(fold_arrays())
+        results, mean_metrics, failures = self._sweep(fold_arrays())
         _plog("selector: CV sweep", t1)
         t1 = time.time()
         Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
         yh = y[jnp.asarray(holdout_idx)] if holdout_idx.size else None
         selected = self._finalize(results, mean_metrics, Xt, yt, wt, Xh, yh,
-                                  prep_results, t0)
+                                  prep_results, t0, failures)
         _plog("selector: refit+evaluate", t1)
         return selected
 
@@ -381,7 +462,7 @@ class ModelSelector(Estimator):
                        d_va2.device_col(feat_name).values[:n_va],
                        d_va2.device_col(label_name).values[:n_va])
 
-        results, mean_metrics = self._sweep(fold_arrays())
+        results, mean_metrics, failures = self._sweep(fold_arrays())
 
         # refit the in-CV feature DAG on the full prepared training rows,
         # then push ALL rows (train + holdout) through it for downstream use
@@ -394,7 +475,7 @@ class ModelSelector(Estimator):
         Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
         yh = y_full[jnp.asarray(holdout_idx)] if holdout_idx.size else None
         selected = self._finalize(results, mean_metrics, Xt, yt, wt_full,
-                                  Xh, yh, prep_results, t0)
+                                  Xh, yh, prep_results, t0, failures)
         selected._inputs = self._inputs
         selected._output = self.get_output()
         return selected, fitted_during, full_data

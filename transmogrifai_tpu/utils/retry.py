@@ -1,0 +1,54 @@
+"""Transient device-failure retry: the framework's failure-detection seam.
+
+Parity intent: the reference bounds and survives misbehaving distributed
+work — Spark task retries plus the validator's ``maxWait`` on awaited
+candidate futures (``core/.../selector/OpValidator.scala:108``). The TPU
+analog of a lost executor is a transient device/tunnel error surfacing as a
+``JaxRuntimeError`` with an UNAVAILABLE/ABORTED-class status (observed on
+real hardware: identical programs fail then succeed on retry). Genuine
+program bugs (shape errors, NaN asserts, OOM) are NOT retried.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Callable, TypeVar
+
+__all__ = ["is_transient_device_error", "with_device_retry"]
+
+T = TypeVar("T")
+
+#: status substrings treated as transient infrastructure failures
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+    "infrastructure failure", "backend setup",
+)
+
+
+def is_transient_device_error(err: BaseException) -> bool:
+    """True for runtime device errors worth retrying (flaky tunnel/device),
+    False for deterministic program errors."""
+    name = type(err).__name__
+    if name not in ("JaxRuntimeError", "XlaRuntimeError", "RuntimeError"):
+        return False
+    msg = str(err)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def with_device_retry(fn: Callable[..., T], *args,
+                      retries: int = 2, backoff_s: float = 2.0,
+                      **kwargs) -> T:
+    """Call ``fn`` retrying transient device errors with linear backoff."""
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if attempt >= retries or not is_transient_device_error(e):
+                raise
+            warnings.warn(
+                f"transient device error (attempt {attempt + 1}/"
+                f"{retries + 1}), retrying: {str(e)[:140]}",
+                RuntimeWarning)
+            time.sleep(backoff_s * (attempt + 1))
+    raise AssertionError("unreachable")
