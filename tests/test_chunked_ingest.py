@@ -1,0 +1,121 @@
+"""Chunked ingest + streaming column summaries (parity: reference
+DataReader.generateDataFrame partition-at-a-time + Summary.scala; the
+VERDICT scale on-ramp: fit statistics without full host materialization)."""
+
+import tracemalloc
+
+import numpy as np
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.frame import HostColumn
+from transmogrifai_tpu.readers.base import CustomReader, DataReader
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.streaming_histogram import StreamingHistogram
+
+
+def _features():
+    x = FeatureGeneratorStage(name="x", ftype_name="Real").get_output()
+    t = FeatureGeneratorStage(name="t", ftype_name="Text").get_output()
+    return [x, t]
+
+
+class SyntheticReader(DataReader):
+    """Yields records lazily — nothing about the dataset exists up front."""
+
+    def __init__(self, n, seed=0, **kw):
+        super().__init__(**kw)
+        self.n = n
+        self.seed = seed
+
+    def read(self):
+        rng = np.random.default_rng(self.seed)
+        for i in range(self.n):
+            v = float(rng.normal())
+            yield {"x": None if v > 2.5 else v,
+                   "t": "tok%d" % (i % 7)}
+
+
+def test_chunked_frame_matches_unchunked():
+    records = [{"x": float(i) if i % 5 else None, "t": f"w{i % 3}"}
+               for i in range(257)]
+    feats = _features()
+    big = CustomReader(records=records)
+    big.chunk_rows = 10_000_000          # one chunk
+    small = CustomReader(records=records)
+    small.chunk_rows = 32                # nine chunks
+    f1 = big.generate_frame(feats)
+    f2 = small.generate_frame(feats)
+    np.testing.assert_array_equal(np.asarray(f1["x"].values),
+                                  np.asarray(f2["x"].values))
+    np.testing.assert_array_equal(np.asarray(f1["x"].mask),
+                                  np.asarray(f2["x"].mask))
+    assert list(f1["t"].values) == list(f2["t"].values)
+
+
+def test_chunked_key_column():
+    records = [{"x": 1.0, "t": "a", "id": i} for i in range(70)]
+    r = CustomReader(records=records, key_fn=lambda rec: rec["id"])
+    r.chunk_rows = 16
+    frame = r.generate_frame(_features())
+    assert list(frame.key) == [str(i) for i in range(70)]
+
+
+def test_vector_chunk_concat_widths():
+    # an all-empty chunk (width 0) pads up to the real width...
+    a = HostColumn.from_values(ft.OPVector, [[]])
+    b = HostColumn.from_values(ft.OPVector, [[3.0, 4.0, 5.0]])
+    c = HostColumn.concat([a, b])
+    np.testing.assert_allclose(np.asarray(c.values),
+                               [[0, 0, 0], [3, 4, 5]])
+    # ...but two different REAL widths are the same ragged-column error
+    # unchunked ingest raises (chunk boundaries must not change semantics)
+    import pytest as _pytest
+    r1 = HostColumn.from_values(ft.OPVector, [[1.0, 2.0]])
+    with _pytest.raises(ft.FeatureTypeValueError, match="ragged"):
+        HostColumn.concat([r1, b])
+
+
+def test_streaming_summary_quantiles_accurate():
+    n = 200_000
+    reader = SyntheticReader(n)
+    feats = _features()
+    summary = reader.summarize(feats, max_bins=128)
+    sx = summary["x"]
+    assert sx.count == n
+    assert 0 < sx.nulls < n * 0.02        # ~P(z > 2.5)
+    # sketch quantiles vs exact over the same stream
+    rng = np.random.default_rng(0)
+    exact = np.asarray([v for v in rng.normal(size=n) if v <= 2.5])
+    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+        approx = float(sx.quantiles(q)[0])
+        true = float(np.quantile(exact, q))
+        assert abs(approx - true) < 0.05, (q, approx, true)
+    assert sx.min < -3 and 2.4 < sx.max <= 2.5
+    st = summary["t"]
+    assert st.histogram is None and st.nulls == 0 and st.count == n
+
+
+def test_summary_memory_stays_bounded():
+    """1M rows summarized with a 64k-row chunk buffer: peak python heap
+    stays far below what materializing a million record dicts would need
+    (~0.5 GB) — the fixed-budget ingest contract."""
+    n = 1_000_000
+    reader = SyntheticReader(n)
+    feats = _features()
+    tracemalloc.start()
+    summary = reader.summarize(feats, max_bins=64)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert summary["x"].count == n
+    assert peak < 150 * 1024 * 1024, f"peak {peak/1e6:.0f} MB"
+
+
+def test_histogram_quantiles_unit():
+    h = StreamingHistogram(max_bins=32)
+    h.update_all(np.arange(1000, dtype=float))
+    q = h.quantiles([0.0, 0.5, 1.0])
+    assert abs(q[1] - 500) < 40
+    assert q[0] <= q[1] <= q[2]
+    empty = StreamingHistogram(max_bins=8)
+    assert np.isnan(empty.quantiles(0.5)).all()

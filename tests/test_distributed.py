@@ -1,0 +1,78 @@
+"""Multi-process (DCN) backend test: two REAL OS processes join via
+jax.distributed, build one global mesh, and all-reduce framework statistics
+across processes (parity: the reference's Spark executor RPC / Rabit ring —
+SURVEY §2.7 comm backend)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from transmogrifai_tpu.parallel import distributed as D
+D.initialize(coordinator_address=f"127.0.0.1:{{port}}",
+             num_processes=2, process_id=pid)
+import jax.numpy as jnp
+import numpy as np
+assert D.is_multi_process()
+assert D.process_count() == 2
+assert len(jax.devices()) == 4, jax.devices()        # 2 per process
+assert len(jax.local_devices()) == 2
+
+ctx = D.global_mesh()
+assert ctx.n_data == 4
+
+# each process contributes DIFFERENT local rows; the global array spans both
+local = np.full((4, 3), float(pid + 1), np.float32)  # p0: 1s, p1: 2s
+X = D.shard_global_rows(ctx, local)
+assert X.shape == (8, 3)                              # global rows
+
+# framework monoid reduction across processes: psum rides DCN
+from transmogrifai_tpu.parallel.collectives import mesh_reduce_stats
+stats = mesh_reduce_stats(ctx, lambda x: {{"s": jnp.sum(x), "n": jnp.asarray(
+    x.shape[0], jnp.float32)}}, X)
+total = float(jax.device_get(stats["s"]))
+count = float(jax.device_get(stats["n"]))
+# sum = 4*3*1 + 4*3*2 = 36 over 8 global rows
+assert abs(total - 36.0) < 1e-5, total
+assert count == 8.0, count  # psum of per-shard rows = global row count
+
+D.barrier()
+print(f"proc {{pid}} OK", flush=True)
+"""
+
+
+def test_two_process_dcn_psum(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=210)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers hung")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n{out}\n{err[-2000:]}"
+        assert "OK" in out

@@ -129,3 +129,47 @@ class TestLogLoss:
         m = OPLogLoss().evaluate_arrays(y, col)
         expected = -(np.log(0.8) + np.log(0.75)) / 2
         assert m.value == pytest.approx(expected, abs=1e-6)
+
+
+class TestBatchSweepMetrics:
+    """metric_batch_scores: the CV sweep's binned ranking metrics must track
+    the exact sorted path (curve bias O(1/4096)), and decision metrics at
+    margin 0 must match exactly."""
+
+    def _data(self, n=60_000, g=3, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        y = jnp.asarray(rng.integers(0, 2, n), jnp.float32)
+        s = jnp.asarray(rng.normal(size=(g, n))
+                        + 0.8 * np.asarray(y)[None, :], jnp.float32)
+        return y, s
+
+    def test_ranking_metrics_track_exact(self):
+        from transmogrifai_tpu.evaluators import (
+            OpBinaryClassificationEvaluator,
+        )
+        from transmogrifai_tpu.evaluators.binary import binary_metrics_arrays
+        ev = OpBinaryClassificationEvaluator()
+        y, s = self._data()
+        for metric, attr in (("auPR", "au_pr"), ("auROC", "au_roc")):
+            v = ev.metric_batch_scores(y, s, metric)
+            for gi in range(s.shape[0]):
+                exact = getattr(binary_metrics_arrays(
+                    np.asarray(y), np.asarray(s[gi])), attr)
+                assert abs(float(v[gi]) - exact) < 2e-3, (metric, gi)
+
+    def test_decision_metrics_exact_at_margin_zero(self):
+        from transmogrifai_tpu.evaluators import (
+            OpBinaryClassificationEvaluator,
+        )
+        from transmogrifai_tpu.evaluators.binary import binary_metrics_arrays
+        ev = OpBinaryClassificationEvaluator()
+        y, s = self._data(n=20_000)
+        yhat0 = (np.asarray(s[0]) >= 0).astype(np.float32)
+        m0 = binary_metrics_arrays(np.asarray(y), np.asarray(s[0]),
+                                   yhat=yhat0)
+        for metric, exact in (("F1", m0.f1), ("Error", m0.error),
+                              ("Precision", m0.precision),
+                              ("Recall", m0.recall)):
+            v = ev.metric_batch_scores(y, s, metric)
+            assert abs(float(v[0]) - exact) < 1e-5, metric

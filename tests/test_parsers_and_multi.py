@@ -1,0 +1,267 @@
+"""Phone/MIME parser depth + multi-output stage surface tests (parity:
+PhoneNumberParser.scala region semantics, Tika-style container MIME
+detection, OpPipelineStage1to2-style arity surface)."""
+
+import base64
+import io
+import zipfile
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.ops.parsers import (
+    IsValidPhoneMapDefaultCountry, IsValidPhoneNumber, MimeTypeDetector,
+    ParsePhoneDefaultCountry, ParsePhoneNumber, PHONE_REGIONS,
+    PhoneNumberParser, detect_mime, parse_phone, resolve_region,
+)
+from transmogrifai_tpu.stages.multi import MultiOutputHostTransformer
+from transmogrifai_tpu.types import feature_types as ft
+
+
+class TestPhone:
+    def test_region_table_breadth(self):
+        assert len(PHONE_REGIONS) >= 40
+
+    def test_us_default(self):
+        assert parse_phone("(650) 555-1234") == "+16505551234"
+        assert parse_phone("650-555-1234", "US") == "+16505551234"
+        assert parse_phone("1 650 555 1234", "US") == "+16505551234"
+
+    def test_under_two_digits_invalid(self):
+        assert parse_phone("5") is None
+        assert parse_phone("") is None
+
+    def test_international_plus(self):
+        assert parse_phone("+44 20 7946 0958") == "+442079460958"
+        assert parse_phone("+81 3-1234-5678") == "+81312345678"
+        # unknown calling code
+        assert parse_phone("+999 123456") is None
+
+    def test_region_dependent_validity(self):
+        # 9 national digits: valid FR, invalid US
+        assert parse_phone("612345678", "FR") == "+33612345678"
+        assert parse_phone("612345678", "US") is None
+        # trunk prefix stripping: GB 0-prefixed national format
+        assert parse_phone("020 7946 0958", "GB") == "+442079460958"
+        # RU trunk prefix is 8
+        assert parse_phone("8 912 345 67 89", "RU") == "+79123456789"
+
+    def test_strict_vs_truncate(self):
+        # one digit too many: non-strict truncates, strict rejects
+        long_us = "650555123456"
+        assert parse_phone(long_us, "US", strict=False) is not None
+        assert parse_phone(long_us, "US", strict=True) is None
+
+    def test_resolve_region(self):
+        assert resolve_region("gb") == "GB"
+        assert resolve_region("United Kingdom") == "GB"
+        assert resolve_region("+49") == "DE"
+        assert resolve_region("nonsense", "CA") == "CA"
+
+    def test_stage_surface(self):
+        assert ParsePhoneDefaultCountry(default_region="GB").transform_row(
+            "020 7946 0958") == "+442079460958"
+        assert ParsePhoneNumber().transform_row(
+            "020 7946 0958", "United Kingdom") == "+442079460958"
+        assert IsValidPhoneNumber().transform_row("612345678", "FR") is True
+        assert IsValidPhoneNumber().transform_row("612345678", "US") is False
+        assert PhoneNumberParser().transform_row(None) is None
+        out = IsValidPhoneMapDefaultCountry().transform_row(
+            {"home": "650 555 1234", "bad": "12", "none": None})
+        assert out == {"home": True, "bad": False}
+
+
+class TestMime:
+    def _b64(self, data: bytes) -> str:
+        return base64.b64encode(data).decode()
+
+    def test_ooxml_container_detection(self):
+        for inner, expect in [
+            ("word/document.xml", "wordprocessingml.document"),
+            ("xl/workbook.xml", "spreadsheetml.sheet"),
+            ("ppt/presentation.xml", "presentationml.presentation"),
+        ]:
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w") as z:
+                z.writestr("[Content_Types].xml", "<Types/>")
+                z.writestr(inner, "<x/>")
+            assert expect in detect_mime(buf.getvalue())
+        # plain zip stays zip
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            z.writestr("data.txt", "hi")
+        assert detect_mime(buf.getvalue()) == "application/zip"
+
+    def test_riff_disambiguation(self):
+        assert detect_mime(b"RIFF\x00\x00\x00\x00WAVEfmt ") == "audio/wav"
+        assert detect_mime(b"RIFF\x00\x00\x00\x00WEBPVP8 ") == "image/webp"
+
+    def test_more_magics(self):
+        assert detect_mime(b"\x00\x00\x00\x18ftypmp42....") == "video/mp4"
+        assert detect_mime(b"ID3\x03\x00rest") == "audio/mpeg"
+        assert detect_mime(
+            b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1rest") == \
+            "application/x-ole-storage"
+        assert detect_mime(b"plain words") == "text/plain"
+
+    def test_stage(self):
+        det = MimeTypeDetector()
+        assert det.transform_row(self._b64(b"%PDF-1.4")) == "application/pdf"
+        assert det.transform_row(None) is None
+
+
+class SplitName(MultiOutputHostTransformer):
+    """Demo 1-to-2 stage: Text full name -> (first Text, last Text)."""
+
+    in_types = (ft.Text,)
+    out_types = (ft.Text, ft.Text)
+
+    def transform_row_multi(self, value):
+        if not value:
+            return None, None
+        parts = value.split()
+        return parts[0], (parts[-1] if len(parts) > 1 else None)
+
+
+class RangeStats(MultiOutputHostTransformer):
+    """Demo 2-to-3 stage: (Real, Real) -> (sum, diff, max)."""
+
+    in_types = (ft.Real, ft.Real)
+    out_types = (ft.Real, ft.Real, ft.Real)
+
+    def transform_row_multi(self, a, b):
+        if a is None or b is None:
+            return None, None, None
+        return a + b, a - b, max(a, b)
+
+
+class TestMultiOutput:
+    def test_1to2_in_workflow(self, tmp_path):
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.serialization import load_model, save_model
+        from transmogrifai_tpu.workflow import Workflow
+
+        frame = fr.HostFrame.from_dict({
+            "name": (ft.Text, ["Ada Lovelace", "Alan Turing", None,
+                               "Plato"]),
+        })
+        feats = FeatureBuilder.from_frame(frame)
+        stage = SplitName()
+        stage.set_input(feats["name"])
+        first, last = stage.get_outputs()
+        assert first.ftype is ft.Text and last.ftype is ft.Text
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(first, last).train())
+        scores = model.score(frame)
+        f_col, l_col = (scores.columns[first.name],
+                        scores.columns[last.name])
+        assert list(f_col.values) == ["Ada", "Alan", None, "Plato"]
+        assert list(l_col.values) == ["Lovelace", "Turing", None, None]
+        # row path
+        fn = model.score_function()
+        out = fn({"name": "Grace Hopper"})
+        assert out[first.name] == "Grace" and out[last.name] == "Hopper"
+        # save/load round-trip
+        save_model(model, str(tmp_path / "m"))
+        loaded = load_model(str(tmp_path / "m"))
+        out2 = loaded.score_function()({"name": "Grace Hopper"})
+        assert out2[first.name] == "Grace" and out2[last.name] == "Hopper"
+
+    def test_2to3(self):
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.workflow import Workflow
+
+        frame = fr.HostFrame.from_dict({
+            "a": (ft.Real, [1.0, 4.0]),
+            "b": (ft.Real, [2.0, 1.0]),
+        })
+        feats = FeatureBuilder.from_frame(frame)
+        stage = RangeStats()
+        stage.set_input(feats["a"], feats["b"])
+        s, d, m = stage.get_outputs()
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(s, d, m).train())
+        scores = model.score(frame)
+        np.testing.assert_allclose(
+            np.asarray(scores.columns[s.name].values, float), [3.0, 5.0])
+        np.testing.assert_allclose(
+            np.asarray(scores.columns[d.name].values, float), [-1.0, 3.0])
+        np.testing.assert_allclose(
+            np.asarray(scores.columns[m.name].values, float), [2.0, 4.0])
+
+    def test_single_output_api_guard(self):
+        stage = SplitName()
+        with pytest.raises(TypeError, match="multi-output"):
+            stage.get_output()
+
+
+class TestDslSurface:
+    """RichTextFeature / RichMapFeature / RichDateFeature DSL parity."""
+
+    def test_rich_text_and_map_dsl(self):
+        import transmogrifai_tpu.dsl  # noqa: F401 — installs methods
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.workflow import Workflow
+
+        frame = fr.HostFrame.from_dict({
+            "email": (ft.Email, ["a@x.com", "bad", None, "b@y.org"]),
+            "url": (ft.URL, ["https://x.com/p", "nope", None,
+                             "http://y.org"]),
+            "phone": (ft.Phone, ["650 555 1234", "12", None,
+                                 "+44 20 7946 0958"]),
+            "tm": (ft.TextMap, [{"a": "hello"}, {"b": "wo"}, {}, None]),
+            "dt": (ft.Date, [1_500_000_000_000, 1_500_003_600_000,
+                             None, 1_500_007_200_000]),
+        })
+        feats = FeatureBuilder.from_frame(frame)
+        results = [
+            feats["email"].email_domain(),
+            feats["email"].is_valid_email(),
+            feats["url"].url_domain(),
+            feats["phone"].parse_phone(),
+            feats["phone"].is_valid_phone("GB"),
+            feats["tm"].map_lengths(),
+            feats["tm"].map_null_indicators(),
+            feats["dt"].to_time_period("HourOfDay"),
+            feats["dt"].to_unit_circle("HourOfDay"),
+        ]
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(*results).train())
+        scores = model.score(frame)
+        dom = scores.columns[results[0].name]
+        assert list(dom.values) == ["x.com", None, None, "y.org"]
+        parsed = scores.columns[results[3].name]
+        assert parsed.python_value(0) == "+16505551234"
+        assert parsed.python_value(3) == "+442079460958"
+        hour = scores.columns[results[7].name]
+        assert hour.python_value(1) == (hour.python_value(0) + 1) % 24
+
+    def test_scale_descale_round_trip(self):
+        import transmogrifai_tpu.dsl  # noqa: F401
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.models.linear import OpLinearRegression
+        from transmogrifai_tpu.ops.transmogrifier import transmogrify
+        from transmogrifai_tpu.workflow import Workflow
+
+        rng = np.random.default_rng(0)
+        n = 200
+        x = rng.normal(size=n)
+        y = 1000.0 * (3 * x + rng.normal(size=n) * 0.1) + 50_000
+        frame = fr.HostFrame.from_dict({
+            "x": (ft.Real, x.tolist()),
+            "label": (ft.RealNN, y.tolist()),
+        })
+        feats = FeatureBuilder.from_frame(frame, response="label")
+        label = feats.pop("label")
+        scaled = label.scale(slope=1e-3, intercept=-50.0)
+        vec = transmogrify([feats["x"]], min_support=1)
+        pred = scaled.transform_with(OpLinearRegression(max_iter=60), vec)
+        descaled = pred.descale(slope=1e-3, intercept=-50.0)
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(descaled).train())
+        scores = model.score(frame)
+        out = np.asarray([v["prediction"]
+                          for v in scores.columns[descaled.name].values])
+        # descaled predictions land back on the original label scale
+        assert abs(np.mean(out) - np.mean(y)) < 2000

@@ -166,6 +166,148 @@ def _index_string(self, no_filter: bool = True, **kw):
     return self.transform_with(stage)
 
 
+# -- RichTextFeature surface (email/url/phone/base64) -----------------------
+
+def _email_domain(self):
+    from transmogrifai_tpu.ops.parsers import EmailToPickList
+    return self.transform_with(EmailToPickList())
+
+
+def _is_valid_email(self):
+    from transmogrifai_tpu.ops.parsers import ValidEmailTransformer
+    return self.transform_with(ValidEmailTransformer())
+
+
+def _url_domain(self):
+    from transmogrifai_tpu.ops.parsers import UrlToPickList
+    return self.transform_with(UrlToPickList())
+
+
+def _is_valid_url(self):
+    from transmogrifai_tpu.ops.parsers import ValidUrlTransformer
+    return self.transform_with(ValidUrlTransformer())
+
+
+def _parse_phone(self, region=None, **kw):
+    from transmogrifai_tpu.ops.parsers import (
+        ParsePhoneDefaultCountry, ParsePhoneNumber,
+    )
+    if isinstance(region, FeatureLike):
+        return self.transform_with(ParsePhoneNumber(**kw), region)
+    if region is not None:
+        kw.setdefault("default_region", region)
+    return self.transform_with(ParsePhoneDefaultCountry(**kw))
+
+
+def _is_valid_phone(self, region=None, **kw):
+    from transmogrifai_tpu.ops.parsers import (
+        IsValidPhoneNumber, PhoneNumberParser,
+    )
+    if isinstance(region, FeatureLike):
+        return self.transform_with(IsValidPhoneNumber(**kw), region)
+    if region is not None:
+        kw.setdefault("default_region", region)
+    return self.transform_with(PhoneNumberParser(**kw))
+
+
+def _mime_type(self):
+    from transmogrifai_tpu.ops.parsers import MimeTypeDetector
+    return self.transform_with(MimeTypeDetector())
+
+
+def _text_len(self, *others):
+    from transmogrifai_tpu.ops.text import TextLenTransformer
+    return self.transform_with(TextLenTransformer(), *others)
+
+
+def _remove_stopwords(self, **kw):
+    from transmogrifai_tpu.ops.text import OpStopWordsRemover
+    return self.transform_with(OpStopWordsRemover(**kw))
+
+
+def _ngram(self, n: int = 2):
+    from transmogrifai_tpu.ops.text import OpNGram
+    return self.transform_with(OpNGram(n=n))
+
+
+# -- RichDateFeature surface ------------------------------------------------
+
+def _to_unit_circle(self, period="HourOfDay"):
+    from transmogrifai_tpu.ops.vectorizers.dates import (
+        DateToUnitCircleVectorizer,
+    )
+    return self.transform_with(DateToUnitCircleVectorizer(time_period=period))
+
+
+def _to_time_period_list(self, period="DayOfMonth"):
+    from transmogrifai_tpu.ops.time_period import TimePeriodListTransformer
+    return self.transform_with(TimePeriodListTransformer(period=period))
+
+
+# -- RichMapFeature surface -------------------------------------------------
+
+def _pivot_map(self, **kw):
+    from transmogrifai_tpu.ops.vectorizers.maps import TextMapPivotVectorizer
+    return self.transform_with(TextMapPivotVectorizer(**kw))
+
+
+def _smart_vectorize_map(self, **kw):
+    from transmogrifai_tpu.ops.vectorizers.maps import SmartTextMapVectorizer
+    return self.transform_with(SmartTextMapVectorizer(**kw))
+
+
+def _map_lengths(self, **kw):
+    from transmogrifai_tpu.ops.vectorizers.maps import TextMapLenEstimator
+    return self.transform_with(TextMapLenEstimator(**kw))
+
+
+def _map_null_indicators(self, **kw):
+    from transmogrifai_tpu.ops.vectorizers.maps import TextMapNullEstimator
+    return self.transform_with(TextMapNullEstimator(**kw))
+
+
+def _to_time_period_map(self, period="DayOfMonth"):
+    from transmogrifai_tpu.ops.time_period import TimePeriodMapTransformer
+    return self.transform_with(TimePeriodMapTransformer(period=period))
+
+
+def _is_valid_phone_map(self, **kw):
+    from transmogrifai_tpu.ops.parsers import IsValidPhoneMapDefaultCountry
+    return self.transform_with(IsValidPhoneMapDefaultCountry(**kw))
+
+
+# -- scaling / calibration / prediction -------------------------------------
+
+def _scale(self, slope: float = 1.0, intercept: float = 0.0):
+    from transmogrifai_tpu.ops.math import ScalerTransformer
+    return self.transform_with(ScalerTransformer(slope=slope,
+                                                 intercept=intercept))
+
+
+def _descale(self, slope: float = 1.0, intercept: float = 0.0):
+    from transmogrifai_tpu.ops.math import DescalerTransformer
+    return self.transform_with(DescalerTransformer(slope=slope,
+                                                   intercept=intercept))
+
+
+def _calibrate(self, prediction, **kw):
+    """label.calibrate(prediction) -> isotonic-calibrated prediction."""
+    from transmogrifai_tpu.models.extras import IsotonicRegressionCalibrator
+    return self.transform_with(IsotonicRegressionCalibrator(**kw), prediction)
+
+
+def _combine_predictions(self, pred1, pred2, **kw):
+    """label.combine_predictions(p1, p2) -> metric-weighted ensemble."""
+    from transmogrifai_tpu.selector.extras import SelectedModelCombiner
+    return self.transform_with(SelectedModelCombiner(**kw), pred1, pred2)
+
+
+def _record_insights(self, features, **kw):
+    """prediction.record_insights(feature_vector) -> per-record TextMap."""
+    from transmogrifai_tpu.insights import RecordInsightsCorr
+    return self.transform_with(RecordInsightsCorr(**kw), features)
+
+
 def transmogrify_features(features: Sequence[FeatureLike], **kw) -> FeatureLike:
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     return transmogrify(list(features), **kw)
@@ -203,6 +345,33 @@ def install() -> None:
     F.auto_bucketize = _auto_bucketize
     F.to_percentile = _to_percentile
     F.index_string = _index_string
+    # RichTextFeature
+    F.email_domain = _email_domain
+    F.is_valid_email = _is_valid_email
+    F.url_domain = _url_domain
+    F.is_valid_url = _is_valid_url
+    F.parse_phone = _parse_phone
+    F.is_valid_phone = _is_valid_phone
+    F.mime_type = _mime_type
+    F.text_len = _text_len
+    F.remove_stopwords = _remove_stopwords
+    F.ngram = _ngram
+    # RichDateFeature
+    F.to_unit_circle = _to_unit_circle
+    F.to_time_period_list = _to_time_period_list
+    # RichMapFeature
+    F.pivot_map = _pivot_map
+    F.smart_vectorize_map = _smart_vectorize_map
+    F.map_lengths = _map_lengths
+    F.map_null_indicators = _map_null_indicators
+    F.to_time_period_map = _to_time_period_map
+    F.is_valid_phone_map = _is_valid_phone_map
+    # scaling / calibration / prediction
+    F.scale = _scale
+    F.descale = _descale
+    F.calibrate = _calibrate
+    F.combine_predictions = _combine_predictions
+    F.record_insights = _record_insights
 
 
 install()

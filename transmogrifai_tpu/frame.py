@@ -158,6 +158,39 @@ class HostColumn:
             self.meta,
         )
 
+    @staticmethod
+    def concat(chunks: Sequence["HostColumn"]) -> "HostColumn":
+        """Row-concatenate same-typed column chunks (the chunked-ingest
+        combiner). Vector chunks may differ in width (per-chunk max): the
+        result pads to the overall max."""
+        if not chunks:
+            raise ValueError("concat of zero chunks")
+        first = chunks[0]
+        if len(chunks) == 1:
+            return first
+        if first.kind == "vector":
+            widths = {int(c.values.shape[1]) for c in chunks}
+            d = max(widths)
+            # chunks may legitimately be NARROWER only when entirely empty
+            # (width 0: every row was an empty vector); two different
+            # non-zero widths are the same ragged-column error from_values
+            # raises on unchunked data
+            if len(widths - {0, d}) > 0:
+                raise ft.FeatureTypeValueError(
+                    f"ragged vector column across chunks: widths {sorted(widths)}")
+            n = sum(len(c) for c in chunks)
+            vals = np.zeros((n, d), np.float32)
+            at = 0
+            for c in chunks:
+                vals[at:at + len(c), :c.values.shape[1]] = c.values
+                at += len(c)
+            meta = next((c.meta for c in chunks if c.meta is not None), None)
+            return HostColumn(first.ftype, vals, None, meta)
+        values = np.concatenate([c.values for c in chunks])
+        mask = (np.concatenate([c.mask for c in chunks])
+                if first.mask is not None else None)
+        return HostColumn(first.ftype, values, mask, first.meta)
+
 
 # ---------------------------------------------------------------------------
 # Device columns (JAX pytrees)

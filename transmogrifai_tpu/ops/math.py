@@ -16,7 +16,7 @@ import numpy as np
 
 from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.stages.base import (
-    DeviceTransformer, Estimator, HostTransformer,
+    AllowLabelAsInput, DeviceTransformer, Estimator, HostTransformer,
 )
 from transmogrifai_tpu.types import feature_types as ft
 
@@ -246,9 +246,10 @@ class OpScalarStandardScaler(Estimator):
                                  intercept=-float(mean) / sd if sd > 0 else 0.0)
 
 
-class ScalerTransformer(DeviceTransformer):
+class ScalerTransformer(DeviceTransformer, AllowLabelAsInput):
     """Linear scaling v*slope + intercept, with metadata enabling
-    descaling of downstream predictions (reference ScalerTransformer)."""
+    descaling of downstream predictions (reference ScalerTransformer; may
+    scale a response label — the scaled output stays a response)."""
 
     in_types = (ft.Real,)
     out_type = ft.RealNN

@@ -20,6 +20,9 @@ from transmogrifai_tpu.types import feature_types as ft
 __all__ = [
     "ValidEmailTransformer", "EmailToPickList", "UrlToPickList",
     "ValidUrlTransformer", "PhoneNumberParser", "MimeTypeDetector",
+    "ParsePhoneNumber", "ParsePhoneDefaultCountry", "IsValidPhoneNumber",
+    "IsValidPhoneMapDefaultCountry", "PHONE_REGIONS", "parse_phone",
+    "detect_mime",
 ]
 
 _EMAIL_RE = re.compile(
@@ -27,18 +30,135 @@ _EMAIL_RE = re.compile(
 _URL_RE = re.compile(
     r"^(https?|ftp)://[^\s/$.?#].[^\s]*$", re.IGNORECASE)
 
-#: country calling code -> national number length range (subset)
-_PHONE_REGIONS = {
-    "1": (10, 10),    # US/CA
-    "44": (9, 10),    # UK
-    "49": (7, 11),    # DE
-    "33": (9, 9),     # FR
-    "81": (9, 10),    # JP
-    "86": (11, 11),   # CN
-    "91": (10, 10),   # IN
-    "61": (9, 9),     # AU
-    "55": (10, 11),   # BR
+#: per-region phone metadata: ISO alpha-2 -> (calling code, min national
+#: digits, max national digits, trunk prefix stripped in national format).
+#: The libphonenumber-lite table behind validate/parse (reference
+#: PhoneNumberParser.scala defers to Google's metadata; this covers the
+#: same contract — region-dependent validity — for ~40 regions).
+PHONE_REGIONS: dict[str, tuple[str, int, int, str]] = {
+    "US": ("1", 10, 10, ""),   "CA": ("1", 10, 10, ""),
+    "GB": ("44", 9, 10, "0"),  "DE": ("49", 6, 11, "0"),
+    "FR": ("33", 9, 9, "0"),   "ES": ("34", 9, 9, ""),
+    "IT": ("39", 8, 11, ""),   "PT": ("351", 9, 9, ""),
+    "NL": ("31", 9, 9, "0"),   "BE": ("32", 8, 9, "0"),
+    "CH": ("41", 9, 9, "0"),   "AT": ("43", 8, 12, "0"),
+    "SE": ("46", 7, 10, "0"),  "NO": ("47", 8, 8, ""),
+    "DK": ("45", 8, 8, ""),    "FI": ("358", 7, 11, "0"),
+    "PL": ("48", 9, 9, ""),    "CZ": ("420", 9, 9, ""),
+    "RU": ("7", 10, 10, "8"),  "UA": ("380", 9, 9, "0"),
+    "TR": ("90", 10, 10, "0"), "GR": ("30", 10, 10, ""),
+    "IE": ("353", 7, 10, "0"), "JP": ("81", 9, 10, "0"),
+    "CN": ("86", 11, 11, "0"), "KR": ("82", 8, 11, "0"),
+    "IN": ("91", 10, 10, "0"), "AU": ("61", 9, 9, "0"),
+    "NZ": ("64", 8, 10, "0"),  "BR": ("55", 10, 11, ""),
+    "MX": ("52", 10, 10, ""),  "AR": ("54", 10, 10, "0"),
+    "ZA": ("27", 9, 9, "0"),   "NG": ("234", 10, 10, "0"),
+    "EG": ("20", 10, 10, "0"), "SA": ("966", 9, 9, "0"),
+    "AE": ("971", 9, 9, "0"),  "IL": ("972", 8, 9, "0"),
+    "SG": ("65", 8, 8, ""),    "HK": ("852", 8, 8, ""),
+    "TH": ("66", 9, 9, "0"),   "ID": ("62", 9, 12, "0"),
+    "PH": ("63", 10, 10, "0"), "VN": ("84", 9, 10, "0"),
 }
+
+#: country display name -> ISO region (reference DefaultCountryCodes)
+COUNTRY_NAMES: dict[str, str] = {
+    "UNITED STATES": "US", "UNITED STATES OF AMERICA": "US", "CANADA": "CA",
+    "UNITED KINGDOM": "GB", "GREAT BRITAIN": "GB", "GERMANY": "DE",
+    "FRANCE": "FR", "SPAIN": "ES", "ITALY": "IT", "PORTUGAL": "PT",
+    "NETHERLANDS": "NL", "BELGIUM": "BE", "SWITZERLAND": "CH",
+    "AUSTRIA": "AT", "SWEDEN": "SE", "NORWAY": "NO", "DENMARK": "DK",
+    "FINLAND": "FI", "POLAND": "PL", "CZECHIA": "CZ", "RUSSIA": "RU",
+    "UKRAINE": "UA", "TURKEY": "TR", "GREECE": "GR", "IRELAND": "IE",
+    "JAPAN": "JP", "CHINA": "CN", "SOUTH KOREA": "KR", "KOREA": "KR",
+    "INDIA": "IN", "AUSTRALIA": "AU", "NEW ZEALAND": "NZ", "BRAZIL": "BR",
+    "MEXICO": "MX", "ARGENTINA": "AR", "SOUTH AFRICA": "ZA",
+    "NIGERIA": "NG", "EGYPT": "EG", "SAUDI ARABIA": "SA",
+    "UNITED ARAB EMIRATES": "AE", "ISRAEL": "IL", "SINGAPORE": "SG",
+    "HONG KONG": "HK", "THAILAND": "TH", "INDONESIA": "ID",
+    "PHILIPPINES": "PH", "VIETNAM": "VN",
+}
+
+#: calling code -> a representative region, longest codes first (for "+"
+#: international parses)
+_BY_CALLING_CODE = sorted(
+    {meta[0]: iso for iso, meta in sorted(PHONE_REGIONS.items(),
+                                          reverse=True)}.items(),
+    key=lambda kv: -len(kv[0]))
+
+
+def resolve_region(region: Optional[str],
+                   default_region: str = "US") -> str:
+    """ISO code, country name, or calling code -> ISO region (reference
+    validCountryCode: tries codes then names, falls back to default)."""
+    if not region:
+        return default_region
+    r = str(region).strip().upper()
+    if r in PHONE_REGIONS:
+        return r
+    if r in COUNTRY_NAMES:
+        return COUNTRY_NAMES[r]
+    digits = re.sub(r"[^\d]", "", r)
+    if digits:
+        for code, iso in _BY_CALLING_CODE:
+            if digits == code:
+                return iso
+    return default_region
+
+
+def clean_number(s: str) -> str:
+    """Trim + drop everything but digits and a leading '+' (reference
+    cleanNumber)."""
+    s = s.strip()
+    plus = s.startswith("+")
+    digits = re.sub(r"[^\d]", "", s)
+    return ("+" + digits) if plus else digits
+
+
+def parse_phone(s: str, region: str = "US",
+                strict: bool = False) -> Optional[str]:
+    """Normalize to E.164 (+<cc><national>); None when invalid.
+
+    Semantics follow the reference's libphonenumber usage
+    (PhoneNumberParser.scala:258-276): numbers under 2 digits are invalid;
+    a leading '+' forces international parsing; otherwise the region's
+    metadata applies (trunk prefix stripped, an embedded country code
+    accepted); non-strict mode truncates too-long numbers before
+    validating (truncateTooLongNumber)."""
+    cleaned = clean_number(s)
+    plus = cleaned.startswith("+")
+    digits = cleaned[1:] if plus else cleaned
+    if len(digits) < 2:
+        return None
+    if plus:
+        for code, iso in _BY_CALLING_CODE:
+            if digits.startswith(code):
+                _, lo, hi, _ = PHONE_REGIONS[iso]
+                national = digits[len(code):]
+                if not strict and len(national) > hi:
+                    national = national[:hi]
+                if lo <= len(national) <= hi:
+                    return f"+{code}{national}"
+                return None
+        return None
+    iso = resolve_region(region)
+    code, lo, hi, trunk = PHONE_REGIONS[iso]
+    national = digits
+    # national trunk prefix ("0" in most of the world, "8" in RU)
+    if trunk and national.startswith(trunk) \
+            and lo <= len(national) - len(trunk) <= hi:
+        national = national[len(trunk):]
+    # an embedded country code ("49 30 1234567" without the +)
+    elif national.startswith(code) and \
+            lo <= len(national) - len(code) <= hi:
+        national = national[len(code):]
+    if not strict and len(national) > hi:
+        national = national[:hi]
+    if lo <= len(national) <= hi:
+        return f"+{code}{national}"
+    return None
+
+
+# -- MIME ------------------------------------------------------------------
 
 _MIME_MAGIC = [
     (b"\x89PNG\r\n\x1a\n", "image/png"),
@@ -46,15 +166,44 @@ _MIME_MAGIC = [
     (b"GIF87a", "image/gif"),
     (b"GIF89a", "image/gif"),
     (b"%PDF-", "application/pdf"),
-    (b"PK\x03\x04", "application/zip"),
     (b"\x1f\x8b", "application/gzip"),
     (b"BM", "image/bmp"),
     (b"<?xml", "application/xml"),
     (b"{", "application/json"),
-    (b"RIFF", "audio/wav"),
     (b"OggS", "audio/ogg"),
     (b"\x7fELF", "application/x-executable"),
+    (b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1", "application/x-ole-storage"),
+    (b"ID3", "audio/mpeg"),
+    (b"\xff\xfb", "audio/mpeg"),
+    (b"fLaC", "audio/flac"),
+    (b"7z\xbc\xaf\x27\x1c", "application/x-7z-compressed"),
+    (b"Rar!", "application/x-rar-compressed"),
+    (b"\x00\x00\x01\x00", "image/x-icon"),
 ]
+
+
+def _zip_mime(data: bytes) -> str:
+    """Look inside ZIP containers the way Tika does: OOXML types declare
+    themselves by their internal directory layout."""
+    import io
+    import zipfile
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            names = set(z.namelist())
+    except Exception:
+        return "application/zip"
+    if any(n.startswith("word/") for n in names):
+        return ("application/vnd.openxmlformats-officedocument"
+                ".wordprocessingml.document")
+    if any(n.startswith("xl/") for n in names):
+        return ("application/vnd.openxmlformats-officedocument"
+                ".spreadsheetml.sheet")
+    if any(n.startswith("ppt/") for n in names):
+        return ("application/vnd.openxmlformats-officedocument"
+                ".presentationml.presentation")
+    if "META-INF/MANIFEST.MF" in names:
+        return "application/java-archive"
+    return "application/zip"
 
 
 def is_valid_email(s: str) -> bool:
@@ -65,28 +214,15 @@ def is_valid_url(s: str) -> bool:
     return bool(_URL_RE.match(s))
 
 
-def parse_phone(s: str, default_region_code: str = "1"
-                ) -> Optional[str]:
-    """Normalize to E.164-ish digits; None when invalid."""
-    s = s.strip()
-    plus = s.startswith("+")
-    digits = re.sub(r"[^\d]", "", s)
-    if not digits:
-        return None
-    if plus:
-        for code, (lo, hi) in _PHONE_REGIONS.items():
-            if digits.startswith(code):
-                national = digits[len(code):]
-                if lo <= len(national) <= hi:
-                    return "+" + digits
-        return None
-    lo, hi = _PHONE_REGIONS.get(default_region_code, (7, 15))
-    if lo <= len(digits) <= hi:
-        return f"+{default_region_code}{digits}"
-    return None
-
-
 def detect_mime(data: bytes) -> Optional[str]:
+    if data.startswith(b"PK\x03\x04"):
+        return _zip_mime(data)
+    if data.startswith(b"RIFF"):
+        kind = data[8:12] if len(data) >= 12 else b""
+        return {b"WAVE": "audio/wav", b"WEBP": "image/webp",
+                b"AVI ": "video/x-msvideo"}.get(kind, "audio/wav")
+    if len(data) >= 12 and data[4:8] == b"ftyp":
+        return "video/mp4"
     for magic, mime in _MIME_MAGIC:
         if data.startswith(magic):
             return mime
@@ -150,20 +286,85 @@ class UrlToPickList(HostTransformer):
         return host.split(":")[0] or None
 
 
-class PhoneNumberParser(HostTransformer):
-    """Phone -> Binary validity (reference PhoneNumberParser.isValid path)."""
+class _PhoneBase(HostTransformer):
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 uid=None):
+        self.default_region = resolve_region(default_region)
+        self.strict = bool(strict)
+        super().__init__(uid=uid)
+
+
+class ParsePhoneDefaultCountry(_PhoneBase):
+    """Phone -> normalized E.164 Phone under the default region (reference
+    ParsePhoneDefaultCountry); invalid -> None."""
 
     in_types = (ft.Phone,)
-    out_type = ft.Binary
-
-    def __init__(self, default_region_code: str = "1", uid=None):
-        self.default_region_code = default_region_code
-        super().__init__(uid=uid)
+    out_type = ft.Phone
 
     def transform_row(self, value):
         if value is None:
             return None
-        return parse_phone(value, self.default_region_code) is not None
+        return parse_phone(value, self.default_region, self.strict)
+
+
+class ParsePhoneNumber(_PhoneBase):
+    """(Phone, Text region) -> normalized E.164 Phone; the region input may
+    be an ISO code, country name, or calling code (reference
+    ParsePhoneNumber + validCountryCode)."""
+
+    in_types = (ft.Phone, ft.Text)
+    out_type = ft.Phone
+
+    def transform_row(self, value, region):
+        if value is None:
+            return None
+        return parse_phone(value,
+                           resolve_region(region, self.default_region),
+                           self.strict)
+
+
+class PhoneNumberParser(_PhoneBase):
+    """Phone -> Binary validity under the default region (reference
+    IsValidPhoneDefaultCountry; numbers under 2 digits invalid)."""
+
+    in_types = (ft.Phone,)
+    out_type = ft.Binary
+
+    def transform_row(self, value):
+        if value is None:
+            return None
+        return parse_phone(value, self.default_region, self.strict) \
+            is not None
+
+
+class IsValidPhoneNumber(_PhoneBase):
+    """(Phone, Text region) -> Binary validity (reference
+    IsValidPhoneNumber)."""
+
+    in_types = (ft.Phone, ft.Text)
+    out_type = ft.Binary
+
+    def transform_row(self, value, region):
+        if value is None:
+            return None
+        return parse_phone(value,
+                           resolve_region(region, self.default_region),
+                           self.strict) is not None
+
+
+class IsValidPhoneMapDefaultCountry(_PhoneBase):
+    """PhoneMap -> BinaryMap of per-key validity (reference
+    IsValidPhoneMapDefaultCountry; missing values drop from the map)."""
+
+    in_types = (ft.PhoneMap,)
+    out_type = ft.BinaryMap
+
+    def transform_row(self, value):
+        if not value:
+            return {}
+        return {k: parse_phone(v, self.default_region, self.strict)
+                is not None
+                for k, v in value.items() if v is not None}
 
 
 class MimeTypeDetector(HostTransformer):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Iterable, Mapping, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -32,6 +33,13 @@ __all__ = ["PipelineData"]
 
 def _shard(arr, pad_value=0.0):
     return pmesh.pad_and_shard_rows(arr, pad_value=pad_value)
+
+
+@jax.jit
+def _split_columns(dvals, dmasks):
+    k = dvals.shape[1]
+    return (tuple(dvals[:, i] for i in range(k)),
+            tuple(dmasks[:, i] for i in range(k)))
 
 
 class PipelineData:
@@ -127,8 +135,12 @@ class PipelineData:
                          axis=1)
         dvals = _shard(vals)
         dmasks = _shard(masks)
+        # split into per-column arrays inside ONE jitted program — k eager
+        # `dvals[:, i]` slices would pay k dispatch round-trips each on
+        # tunneled/remote devices (measured ~14s for 28 columns at 1M rows)
+        cols_v, cols_m = _split_columns(dvals, dmasks)
         for i, (name, _) in enumerate(pending):
-            self.device[name] = fr.NumericColumn(dvals[:, i], dmasks[:, i])
+            self.device[name] = fr.NumericColumn(cols_v[i], cols_m[i])
 
     @staticmethod
     def _encode_text(col: fr.HostColumn) -> fr.CodesColumn:

@@ -6,23 +6,58 @@ DataReader.scala:58-208`` — ``generateDataFrame(rawFeatures)`` runs every
 an optional entity-key column. Here the result is a columnar ``HostFrame``
 (device residency happens lazily downstream), so the per-record loop is the
 ingest boundary, not the compute hot loop.
+
+Scale design: ingest is CHUNKED — records stream through a bounded buffer
+and each chunk converts straight to typed numpy columns, so the python-dict
+representation of the dataset never fully materializes (the Spark
+partition-at-a-time analog). ``summarize`` computes per-column streaming
+statistics (fill counts, extrema, a C++ StreamingHistogram quantile sketch)
+in one pass with NO frame at all — the on-ramp for fits at row counts that
+don't fit host memory as python objects.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
 from transmogrifai_tpu.features.feature import FeatureLike
-from transmogrifai_tpu.frame import HostColumn, HostFrame
+from transmogrifai_tpu.frame import HostColumn, HostFrame, NUMERIC_KINDS
 from transmogrifai_tpu.stages.base import FeatureGeneratorStage
 
-__all__ = ["DataReader", "CustomReader"]
+__all__ = ["DataReader", "CustomReader", "ColumnSummary"]
+
+
+@dataclass
+class ColumnSummary:
+    """Streaming per-column ingest statistics (reference Summary.scala +
+    FeatureDistribution's first map-reduce pass)."""
+
+    name: str
+    ftype_name: str
+    count: int = 0
+    nulls: int = 0
+    min: float = float("inf")
+    max: float = float("-inf")
+    histogram: Optional[Any] = None   # StreamingHistogram for numeric kinds
+
+    @property
+    def fill_rate(self) -> float:
+        return 1.0 - self.nulls / max(self.count, 1)
+
+    def quantiles(self, qs) -> np.ndarray:
+        if self.histogram is None:
+            raise ValueError(f"{self.name}: no histogram (non-numeric)")
+        return self.histogram.quantiles(qs)
 
 
 class DataReader:
     """Abstract reader of records (python dicts or objects)."""
+
+    #: rows per ingest chunk: bounds the transient python-object footprint
+    chunk_rows: int = 65536
 
     def __init__(self, key_fn: Optional[Callable[[Any], str]] = None):
         self.key_fn = key_fn
@@ -45,20 +80,79 @@ class DataReader:
         from transmogrifai_tpu.readers.joined import JoinedDataReader, JoinKeys
         return JoinedDataReader(self, other, join_keys or JoinKeys(), "inner")
 
+    def _iter_chunks(self) -> Iterator[list]:
+        """Bounded-buffer record chunks; at least one (possibly empty)."""
+        buf: list = []
+        any_yielded = False
+        for r in self.read():
+            buf.append(r)
+            if len(buf) >= self.chunk_rows:
+                yield buf
+                any_yielded = True
+                buf = []
+        if buf or not any_yielded:
+            yield buf
+
     # -- raw data generation -------------------------------------------------
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
-        records = self.read()
-        if not isinstance(records, (list, tuple)):
-            records = list(records)
         stages = [_origin(f) for f in raw_features]
-        cols = {}
-        for f, stage in zip(raw_features, stages):
-            vals = [stage.extract(r) for r in records]
-            cols[f.name] = HostColumn.from_values(f.ftype, vals)
-        key = None
-        if self.key_fn is not None:
-            key = np.asarray([str(self.key_fn(r)) for r in records], dtype=object)
+        chunk_cols: dict[str, list[HostColumn]] = {f.name: []
+                                                   for f in raw_features}
+        key_chunks: Optional[list] = [] if self.key_fn is not None else None
+        for chunk in self._iter_chunks():
+            for f, stage in zip(raw_features, stages):
+                vals = [stage.extract(r) for r in chunk]
+                chunk_cols[f.name].append(
+                    HostColumn.from_values(f.ftype, vals))
+            if key_chunks is not None:
+                key_chunks.append(np.asarray(
+                    [str(self.key_fn(r)) for r in chunk], dtype=object))
+        cols = {name: HostColumn.concat(chunks)
+                for name, chunks in chunk_cols.items()}
+        key = np.concatenate(key_chunks) if key_chunks else None
         return HostFrame(cols, key)
+
+    # -- streaming statistics (no frame materialization) ---------------------
+    def summarize(self, raw_features: Sequence[FeatureLike],
+                  max_bins: int = 100) -> dict[str, ColumnSummary]:
+        """One streaming pass over the records: per-column fill counts,
+        extrema, and (numerics) a mergeable quantile sketch. Host memory is
+        O(chunk_rows + max_bins per column) regardless of row count."""
+        from transmogrifai_tpu.utils.streaming_histogram import (
+            StreamingHistogram,
+        )
+        stages = [_origin(f) for f in raw_features]
+        out = {f.name: ColumnSummary(
+            name=f.name, ftype_name=f.ftype.__name__,
+            histogram=(StreamingHistogram(max_bins=max_bins)
+                       if f.ftype.device_kind in NUMERIC_KINDS else None))
+            for f in raw_features}
+        for chunk in self._iter_chunks():
+            if not chunk:
+                continue
+            for f, stage in zip(raw_features, stages):
+                s = out[f.name]
+                s.count += len(chunk)
+                if s.histogram is not None:
+                    # values go through the SAME type validation ingest
+                    # applies — summary statistics must describe exactly
+                    # the data generate_frame would accept
+                    validated = [f.ftype._validate(stage.extract(r))
+                                 for r in chunk]
+                    present = np.asarray(
+                        [v for v in validated if v is not None], np.float64)
+                    s.nulls += len(chunk) - present.size
+                    if present.size:
+                        s.min = min(s.min, float(present.min()))
+                        s.max = max(s.max, float(present.max()))
+                        s.histogram.update_all(present)
+                else:
+                    for r in chunk:
+                        v = f.ftype._validate(stage.extract(r))
+                        if v is None or (hasattr(v, "__len__")
+                                         and len(v) == 0):
+                            s.nulls += 1
+        return out
 
 
 def _origin(f: FeatureLike) -> FeatureGeneratorStage:

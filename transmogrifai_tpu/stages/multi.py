@@ -1,0 +1,149 @@
+"""Multi-output (arity-typed) stage surface.
+
+Parity: reference ``features/.../stages/OpPipelineStages.scala:240-455`` —
+the ``OpPipelineStage1to2 / 1to3 / 2to2 / 2to3 / 3to2`` traits that let one
+stage emit several typed features. (The reference defines this surface
+without shipping concrete implementations; users extend it. Same here.)
+
+Design: the executor's DAG contract stays one-column-per-stage, so a
+multi-output stage never enters the DAG itself — ``get_outputs()`` wires M
+lightweight VIEW stages over the same inputs, each owning one output
+feature. The parent computes the full output tuple ONCE per batch (memoized
+on the data object) and views select their slot; on the local row path each
+view replays ``transform_row_multi`` and picks its element.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any, Optional
+
+from transmogrifai_tpu.frame import HostColumn
+from transmogrifai_tpu.stages.base import HostTransformer, STAGE_REGISTRY
+
+__all__ = ["MultiOutputHostTransformer"]
+
+#: deserialized views of one parent share a single instance (and thus the
+#: batch memo) — keyed by the saved parent uid, weakly so nothing leaks
+_PARENT_CACHE: "weakref.WeakValueDictionary[str, MultiOutputHostTransformer]" \
+    = weakref.WeakValueDictionary()
+
+
+class MultiOutputHostTransformer(HostTransformer):
+    """Base for N-in / M-out host transformers.
+
+    Subclasses declare ``in_types`` (as usual) plus ``out_types`` (one per
+    output) and implement ``transform_row_multi(*values) -> tuple``. Use
+    ``get_outputs()`` (not ``get_output()``) to obtain the M features.
+    """
+
+    out_types: tuple[type, ...] = ()
+
+    def __init__(self, operation_name: Optional[str] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name=operation_name, uid=uid)
+        self._views: Optional[tuple] = None
+        #: (weakref to the data object, columns tuple) — a weak reference
+        #: cannot alias a NEW object at a recycled address (id() could)
+        self._batch_memo: Optional[tuple] = None
+
+    # -- to implement --------------------------------------------------------
+    def transform_row_multi(self, *values: Any) -> tuple:
+        raise NotImplementedError
+
+    def host_apply_multi(self, *cols: HostColumn) -> tuple[HostColumn, ...]:
+        """Default: row-loop over transform_row_multi (override to
+        vectorize)."""
+        n = len(cols[0]) if cols else 0
+        rows = [self.transform_row_multi(
+            *(c.python_value(i) for c in cols)) for i in range(n)]
+        return tuple(
+            HostColumn.from_values(t, [r[j] for r in rows])
+            for j, t in enumerate(self.out_types))
+
+    # -- wiring --------------------------------------------------------------
+    def set_input(self, *features) -> "MultiOutputHostTransformer":
+        super().set_input(*features)
+        self._views = None
+        self._batch_memo = None
+        return self
+
+    def get_outputs(self) -> tuple:
+        """The M output features, each backed by a view stage."""
+        if not self.out_types:
+            raise ValueError(f"{self}: declare out_types")
+        if self._views is None:
+            views = []
+            for j in range(len(self.out_types)):
+                v = _MultiOutputView(parent=self, slot=j)
+                v.set_input(*self._inputs)
+                views.append(v)
+            self._views = tuple(views)
+        return tuple(v.get_output() for v in self._views)
+
+    def get_output(self):
+        raise TypeError(
+            f"{type(self).__name__} is multi-output: use get_outputs()")
+
+    # -- batch memo (one computation feeds all views of a layer) -------------
+    def _batch_columns(self, data) -> tuple[HostColumn, ...]:
+        if self._batch_memo is None or self._batch_memo[0]() is not data:
+            cols = [data.host_col(n) for n in self.runtime_input_names()]
+            self._batch_memo = (weakref.ref(data),
+                                self.host_apply_multi(*cols))
+        return self._batch_memo[1]
+
+
+class _MultiOutputView(HostTransformer):
+    """One output slot of a MultiOutputHostTransformer; the DAG-visible
+    stage."""
+
+    def __init__(self, parent: Optional[MultiOutputHostTransformer] = None,
+                 slot: int = 0, uid: Optional[str] = None):
+        self.parent = parent
+        self.slot = int(slot)
+        if parent is not None:
+            self.in_types = parent.in_types
+            self.variadic = parent.variadic
+            self.out_type = parent.out_types[slot]
+            op = f"{parent.operation_name}[{slot}]"
+        else:
+            op = None
+        super().__init__(operation_name=op, uid=uid)
+
+    def _wired_parent(self) -> MultiOutputHostTransformer:
+        # a deserialized view owns a fresh parent with no inputs: wire it
+        # from the view's own (graph-restored) inputs
+        if not self.parent._inputs and self._inputs:
+            self.parent._inputs = self._inputs
+        return self.parent
+
+    def runtime_input_names(self):
+        return self._wired_parent().runtime_input_names() if self.parent \
+            else self.input_names
+
+    def output_column(self, data) -> HostColumn:
+        return self._wired_parent()._batch_columns(data)[self.slot]
+
+    def transform_row(self, *values):
+        return self.parent.transform_row_multi(*values)[self.slot]
+
+    def config(self):
+        return {
+            "parent_class": type(self.parent).__name__,
+            "parent_config": self.parent.config(),
+            "parent_uid": self.parent.uid,
+            "slot": self.slot,
+        }
+
+    @classmethod
+    def from_config(cls, config, uid=None):
+        parent_uid = config.get("parent_uid")
+        parent = _PARENT_CACHE.get(parent_uid) if parent_uid else None
+        if parent is None:
+            parent_cls = STAGE_REGISTRY[config["parent_class"]]
+            parent = parent_cls.from_config(config["parent_config"],
+                                            uid=parent_uid)
+            if parent_uid:
+                _PARENT_CACHE[parent_uid] = parent
+        return cls(parent=parent, slot=config["slot"], uid=uid)
