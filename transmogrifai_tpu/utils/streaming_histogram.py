@@ -198,6 +198,19 @@ class StreamingHistogram:
             return float(_LIB.shist_sum(self._ptr, float(b)))
         return self._py.sum_below(b)
 
+    def quantiles(self, qs) -> np.ndarray:
+        """Approximate quantiles by inverting the Ben-Haim/Tom-Tov
+        interpolated CDF (mass at a bin center = half its count plus all
+        earlier counts — the sum-procedure's trapezoid model). The ingest
+        sketch's answer to np.percentile over the full column."""
+        centers, counts = self.bins()
+        qs = np.atleast_1d(np.asarray(qs, np.float64))
+        if centers.size == 0:
+            return np.full(qs.shape, np.nan)
+        total = float(counts.sum())
+        cum = np.cumsum(counts, dtype=np.float64) - counts / 2.0
+        return np.interp(np.clip(qs, 0.0, 1.0) * total, cum, centers)
+
     def to_json(self) -> dict:
         centers, counts = self.bins()
         return {"maxBins": self.max_bins, "centers": centers.tolist(),
