@@ -186,6 +186,8 @@ def _values_for(t: type, rng) -> list:
 def _collect() -> list[str]:
     names = []
     for name, cls in sorted(STAGE_REGISTRY.items()):
+        if not cls.__module__.startswith("transmogrifai_tpu"):
+            continue  # demo/fixture stages defined by other test modules
         if name.startswith("_") or name in _BASES or name in _PRODUCTS:
             continue
         if name in _SPECIAL:
@@ -367,7 +369,15 @@ def test_stage_contract(stage_name, tmp_path):
         assert meta2 is not None, f"{stage_name}: metadata lost on load"
         assert meta.col_names() == meta2.col_names()
 
-    # 4. deterministic fit: train again on the same data
+    # 4. transform purity (the race-detection analog, SURVEY §5): scoring
+    # the same frame twice must be bit-identical — stateful/dirty stages
+    # (mutable fitted state, host RNG use at transform time) fail here
+    _, col_vals_again, _ = _score_host(model, frame)
+    for i in range(N):
+        _eq(col_vals[i], col_vals_again[i],
+            f"{stage_name} repeat-score row {i}", 0.0)
+
+    # 5. deterministic fit: train again on the same data
     from transmogrifai_tpu.uid import UID
     UID.reset()
     rng2 = np.random.default_rng(7)
@@ -384,6 +394,7 @@ def test_contract_coverage_is_exhaustive():
     deliberately routed to a dedicated suite — no stage silently escapes."""
     covered = set(_collect()) | _BASES | _PRODUCTS | set(_SPECIAL)
     missing = [n for n, cls in STAGE_REGISTRY.items()
-               if not n.startswith("_") and n not in covered
+               if cls.__module__.startswith("transmogrifai_tpu")
+               and not n.startswith("_") and n not in covered
                and not getattr(cls, "out_types", ())]
     assert not missing, f"stages with no contract coverage: {missing}"

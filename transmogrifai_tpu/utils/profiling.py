@@ -39,6 +39,19 @@ class PhaseMetrics:
     step: str
     wall_s: float = 0.0
     count: int = 0
+    peak_hbm_bytes: int = 0   # device peak_bytes_in_use high-water mark
+
+
+def _device_memory() -> tuple[int, int]:
+    """(bytes_in_use, peak_bytes_in_use) of device 0, or zeros when the
+    backend doesn't expose memory_stats (CPU, some plugins)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return (int(stats.get("bytes_in_use", 0)),
+                int(stats.get("peak_bytes_in_use", 0)))
+    except Exception:
+        return 0, 0
 
 
 @dataclass
@@ -47,10 +60,12 @@ class AppMetrics:
     start_time: float = field(default_factory=time.time)
     phases: dict = field(default_factory=dict)  # step -> PhaseMetrics
 
-    def record(self, step: OpStep, wall_s: float) -> None:
+    def record(self, step: OpStep, wall_s: float,
+               peak_hbm: int = 0) -> None:
         pm = self.phases.setdefault(step.value, PhaseMetrics(step.value))
         pm.wall_s += wall_s
         pm.count += 1
+        pm.peak_hbm_bytes = max(pm.peak_hbm_bytes, peak_hbm)
 
     @property
     def total_wall_s(self) -> float:
@@ -60,7 +75,8 @@ class AppMetrics:
         return {
             "appName": self.app_name,
             "totalWallSeconds": self.total_wall_s,
-            "phases": {k: {"wallSeconds": p.wall_s, "count": p.count}
+            "phases": {k: {"wallSeconds": p.wall_s, "count": p.count,
+                           "peakHbmBytes": p.peak_hbm_bytes}
                        for k, p in self.phases.items()},
         }
 
@@ -70,10 +86,12 @@ class AppMetrics:
 
     def pretty(self) -> str:
         from transmogrifai_tpu.utils.table import Table
-        rows = [(k, f"{p.wall_s:.2f}", p.count)
+        rows = [(k, f"{p.wall_s:.2f}", p.count,
+                 f"{p.peak_hbm_bytes / 1e6:.0f}" if p.peak_hbm_bytes
+                 else "-")
                 for k, p in sorted(self.phases.items())]
-        return str(Table(["Phase", "Wall (s)", "Count"], rows,
-                         title=f"{self.app_name} metrics"))
+        return str(Table(["Phase", "Wall (s)", "Count", "Peak HBM (MB)"],
+                         rows, title=f"{self.app_name} metrics"))
 
 
 class _Profiler:
@@ -90,13 +108,22 @@ class _Profiler:
     @contextlib.contextmanager
     def phase(self, step: OpStep):
         t0 = time.time()
+        _, peak_before = _device_memory()
         ctx = contextlib.nullcontext()
         if self.trace_dir is not None:
             import jax
             ctx = jax.profiler.trace(self.trace_dir)
-        with ctx:
-            yield
-        self.metrics.record(step, time.time() - t0)
+        try:
+            with ctx:
+                yield
+        finally:
+            # record on the error path too — a failed run's post-mortem
+            # must still account the time spent before the failure
+            _, peak_after = _device_memory()
+            # peak_bytes_in_use is a process-lifetime high-water mark:
+            # attribute it to this phase only when THIS phase raised it
+            grew = peak_after if peak_after > peak_before else 0
+            self.metrics.record(step, time.time() - t0, peak_hbm=grew)
 
 
 profiler = _Profiler()
