@@ -1,6 +1,7 @@
 """Titanic feature definitions shared by tests/bench (module-level so the
 derived-feature lambdas are serializable)."""
 
+import transmogrifai_tpu.dsl  # noqa: F401 — installs FeatureLike operators
 from transmogrifai_tpu.features.builder import FeatureBuilder
 from transmogrifai_tpu.readers import CSVReader
 from transmogrifai_tpu.stages.base import LambdaTransformer
@@ -24,24 +25,42 @@ def family_size(sibsp, parch):
     return float((sibsp or 0) + (parch or 0) + 1)
 
 
+def age_group(age):
+    return None if age is None else ("adult" if age > 18 else "child")
+
+
 def titanic_reader() -> CSVReader:
     return CSVReader(TITANIC_CSV, schema=SCHEMA, header=False,
                      columns=COLUMNS, key_col="id")
 
 
 def titanic_features():
-    """(response, predictor list) mirroring helloworld OpTitanicSimple."""
+    """(response, predictor list) mirroring helloworld OpTitanicSimple.
+
+    Predictor set follows ``OpTitanicSimple.scala:125-129`` exactly: raw
+    ``sex``/``fare`` are REPLACED by ``pivotedSex``/``estimatedCost`` while
+    raw ``age`` rides alongside ``normedAge``/``ageGroup`` (the sanity
+    checker prunes the resulting collinearity, as in the reference)."""
     survived = FeatureBuilder.RealNN("survived").as_response()
     pclass = FeatureBuilder.PickList("pclass").as_predictor()
+    name = FeatureBuilder.Text("name").as_predictor()
     sex = FeatureBuilder.PickList("sex").as_predictor()
     age = FeatureBuilder.Real("age").as_predictor()
     sibsp = FeatureBuilder.Integral("sibsp").as_predictor()
     parch = FeatureBuilder.Integral("parch").as_predictor()
+    ticket = FeatureBuilder.PickList("ticket").as_predictor()
     fare = FeatureBuilder.Real("fare").as_predictor()
     cabin = FeatureBuilder.PickList("cabin").as_predictor()
     embarked = FeatureBuilder.PickList("embarked").as_predictor()
     fam = sibsp.transform_with(
         LambdaTransformer(family_size, in_types=(ft.Integral, ft.Integral),
                           out_type=ft.Real), parch)
-    predictors = [pclass, sex, age, sibsp, parch, fare, cabin, embarked, fam]
+    cost = fam * fare
+    pivoted_sex = sex.pivot(top_k=2, min_support=1)
+    normed_age = age.fill_missing_with_mean().z_normalize()
+    agegrp = age.transform_with(
+        LambdaTransformer(age_group, in_types=(ft.Real,),
+                          out_type=ft.PickList))
+    predictors = [pclass, name, age, sibsp, parch, ticket, cabin, embarked,
+                  fam, cost, pivoted_sex, agegrp, normed_age]
     return survived, predictors
