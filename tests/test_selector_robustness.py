@@ -153,3 +153,57 @@ def test_with_device_retry_passes_through_real_errors():
         with_device_retry(broken, backoff_s=0.0)
     assert not is_transient_device_error(ValueError("UNAVAILABLE"))
     assert is_transient_device_error(RuntimeError("ABORTED: tunnel reset"))
+
+
+def test_checkpointed_sweep_restarts(tmp_path):
+    """Restartable sweep (the reference failure-recovery aux): completed
+    (fold, family) batches persist and a re-run skips retraining them."""
+    import json
+    import os
+
+    frame = _frame(seed=9)
+    calls = {"n": 0}
+
+    class CountingLR(OpLogisticRegression):
+        def grid_fit_arrays(self, X, y, w, grid):
+            calls["n"] += 1
+            return super().grid_fit_arrays(X, y, w, grid)
+
+    def make_sel(grid=(0.01, 0.1)):
+        return BinaryClassificationModelSelector.with_cross_validation(
+            n_folds=2, seed=1,
+            models_and_parameters=[(CountingLR(max_iter=25),
+                                    [{"reg_param": r} for r in grid])],
+            splitter=DataSplitter(reserve_test_fraction=0.2, seed=1),
+            checkpoint_dir=str(tmp_path / "sweep"))
+
+    ckpt = str(tmp_path / "sweep")
+    model1 = _train(make_sel(), frame)
+    fits_first = calls["n"]
+    assert fits_first >= 2  # one grid fit per fold
+    saved = json.load(open(os.path.join(ckpt, "sweep.json")))
+    assert "fingerprint" in saved
+    keys = sorted(saved["entries"])
+    assert [k.split(":")[:2] for k in keys] == [["0", "0"], ["1", "0"]]
+    assert all(len(v) == 2 for v in saved["entries"].values())
+
+    # "restart": a fresh selector over the same checkpoint dir re-selects
+    # the same winner WITHOUT refitting any sweep candidate (only the final
+    # winner refit runs)
+    from transmogrifai_tpu.uid import UID
+    UID.reset()
+    calls["n"] = 0
+    model2 = _train(make_sel(), frame)
+    # zero grid fits on restart (the winner refit rides fit_arrays)
+    assert calls["n"] == 0
+    s1, s2 = model1.selector_summary(), model2.selector_summary()
+    assert s1.best_model_name == s2.best_model_name
+    v1 = {r.model_name: r.metric_values for r in s1.validation_results}
+    v2 = {r.model_name: r.metric_values for r in s2.validation_results}
+    assert v1 == v2
+
+    # a DIFFERENT grid over the same dir must NOT reuse the stale entries
+    UID.reset()
+    calls["n"] = 0
+    _train(make_sel(grid=(1.0, 10.0)), frame)
+    assert calls["n"] >= 2  # fingerprint mismatch -> full sweep reruns

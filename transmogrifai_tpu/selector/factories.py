@@ -104,6 +104,7 @@ class BinaryClassificationModelSelector:
             models_and_parameters: Optional[Sequence] = None,
             stratify: bool = False,
             max_wait_s: Optional[float] = 3600.0,
+            checkpoint_dir: Optional[str] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -115,6 +116,7 @@ class BinaryClassificationModelSelector:
             evaluators=[OpBinaryClassificationEvaluator()],
             validation_metric=validation_metric,
             max_wait_s=max_wait_s,
+            checkpoint_dir=checkpoint_dir,
         )
 
     @staticmethod
@@ -125,6 +127,7 @@ class BinaryClassificationModelSelector:
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
             max_wait_s: Optional[float] = 3600.0,
+            checkpoint_dir: Optional[str] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -135,6 +138,7 @@ class BinaryClassificationModelSelector:
             evaluators=[OpBinaryClassificationEvaluator()],
             validation_metric=validation_metric,
             max_wait_s=max_wait_s,
+            checkpoint_dir=checkpoint_dir,
         )
 
 
@@ -148,6 +152,7 @@ class MultiClassificationModelSelector:
             models_and_parameters: Optional[Sequence] = None,
             stratify: bool = False,
             max_wait_s: Optional[float] = 3600.0,
+            checkpoint_dir: Optional[str] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -159,6 +164,7 @@ class MultiClassificationModelSelector:
             evaluators=[OpMultiClassificationEvaluator()],
             validation_metric=validation_metric,
             max_wait_s=max_wait_s,
+            checkpoint_dir=checkpoint_dir,
         )
 
     @staticmethod
@@ -169,6 +175,7 @@ class MultiClassificationModelSelector:
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
             max_wait_s: Optional[float] = 3600.0,
+            checkpoint_dir: Optional[str] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -180,6 +187,7 @@ class MultiClassificationModelSelector:
             evaluators=[OpMultiClassificationEvaluator()],
             validation_metric=validation_metric,
             max_wait_s=max_wait_s,
+            checkpoint_dir=checkpoint_dir,
         )
 
 
@@ -192,6 +200,7 @@ class RegressionModelSelector:
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
             max_wait_s: Optional[float] = 3600.0,
+            checkpoint_dir: Optional[str] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -202,6 +211,7 @@ class RegressionModelSelector:
             evaluators=[OpRegressionEvaluator()],
             validation_metric=validation_metric,
             max_wait_s=max_wait_s,
+            checkpoint_dir=checkpoint_dir,
         )
 
     @staticmethod
@@ -212,6 +222,7 @@ class RegressionModelSelector:
             splitter: Optional[DataSplitter] = None,
             models_and_parameters: Optional[Sequence] = None,
             max_wait_s: Optional[float] = 3600.0,
+            checkpoint_dir: Optional[str] = None,
     ) -> ModelSelector:
         return ModelSelector(
             models_and_grids=(models_and_parameters
@@ -223,4 +234,5 @@ class RegressionModelSelector:
             evaluators=[OpRegressionEvaluator()],
             validation_metric=validation_metric,
             max_wait_s=max_wait_s,
+            checkpoint_dir=checkpoint_dir,
         )

@@ -197,6 +197,7 @@ class ModelSelector(Estimator):
                  evaluators: Sequence[EvaluatorBase] = (),
                  validation_metric: Optional[str] = None,
                  max_wait_s: Optional[float] = 3600.0,
+                 checkpoint_dir: Optional[str] = None,
                  uid: Optional[str] = None):
         if not models_and_grids:
             raise ValueError("ModelSelector needs at least one candidate model")
@@ -212,7 +213,80 @@ class ModelSelector(Estimator):
         #: once exceeded, remaining candidate families are skipped and
         #: recorded as failures — provided at least one candidate scored
         self.max_wait_s = max_wait_s
+        #: restartable sweep (SURVEY §5 failure-detection aux): completed
+        #: (fold, family) metric batches persist to
+        #: ``checkpoint_dir/sweep.json``; a re-run after a crash skips them.
+        #: The file carries a fingerprint of the sweep CONFIG (families,
+        #: grids, metric, validator) and entries key on the fold's training
+        #: shape — a different configuration ignores the stale file. Point
+        #: each distinct dataset at its own directory: same-shaped different
+        #: DATA cannot be distinguished from a restart.
+        self.checkpoint_dir = checkpoint_dir
         super().__init__(uid=uid)
+
+    # -- sweep checkpointing -------------------------------------------------
+    def _ckpt_fingerprint(self) -> str:
+        import hashlib
+        import json
+        spec = {
+            "metric": self.validation_metric,
+            "validator": type(self.validator).__name__,
+            "validator_cfg": {
+                k: v for k, v in sorted(vars(self.validator).items())
+                if isinstance(v, (int, float, str, bool))},
+            "families": [[type(est).__name__, grid]
+                         for est, grid in self.models_and_grids],
+        }
+        return hashlib.sha256(
+            json.dumps(spec, sort_keys=True, default=str).encode()
+        ).hexdigest()[:16]
+
+    def _ckpt_path(self) -> Optional[str]:
+        if not self.checkpoint_dir:
+            return None
+        import os
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return os.path.join(self.checkpoint_dir, "sweep.json")
+
+    def _ckpt_load(self) -> dict:
+        path = self._ckpt_path()
+        if path is None:
+            return {}
+        import json
+        import os
+        if not os.path.exists(path):
+            return {}
+        try:
+            with open(path) as fh:
+                raw = json.load(fh)
+            if raw.get("fingerprint") != self._ckpt_fingerprint():
+                return {}  # different sweep config: stale checkpoint
+            return {k: [float("nan") if v is None else float(v)
+                        for v in vals]
+                    for k, vals in raw["entries"].items()}
+        except Exception:  # malformed/wrong-shape file == absent
+            return {}
+
+    def _ckpt_save(self, done: dict) -> None:
+        """Best-effort: a checkpoint write failure must never fail a sweep
+        whose training actually succeeded."""
+        path = self._ckpt_path()
+        if path is None:
+            return
+        import json
+        import os
+        try:
+            clean = {k: [v if np.isfinite(v) else None for v in vals]
+                     for k, vals in done.items()}
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"fingerprint": self._ckpt_fingerprint(),
+                           "entries": clean}, fh, allow_nan=False)
+            os.replace(tmp, path)  # atomic: a crash never corrupts the file
+        except Exception as e:
+            import warnings
+            warnings.warn(f"sweep checkpoint write failed ({e}); "
+                          "continuing without checkpointing", RuntimeWarning)
 
     # -- shared pieces -------------------------------------------------------
     def _split_prepare(self, n: int, y) -> tuple[np.ndarray, np.ndarray,
@@ -260,6 +334,7 @@ class ModelSelector(Estimator):
         def family_name(ci):
             return f"{type(self.models_and_grids[ci][0]).__name__}_{ci}"
 
+        done = self._ckpt_load()
         for fold_i, (Xtr, ytr, wtr, Xva, yva) in enumerate(fold_arrays):
             # row-parallel training over the mesh: fold rows padded to the
             # data-axis multiple with weight 0 (validation stays unpadded —
@@ -267,6 +342,14 @@ class ModelSelector(Estimator):
             Xtr, ytr, wtr = pmesh.shard_training_rows(Xtr, ytr, wtr)
             for ci, (est, grid) in enumerate(self.models_and_grids):
                 if ci in failed_families:
+                    continue
+                ckey = (f"{fold_i}:{ci}:"
+                        f"{int(Xtr.shape[0])}x{int(Xtr.shape[1])}")
+                if ckey in done and len(done[ckey]) == len(grid):
+                    # restart path: this (fold, family) batch already scored
+                    for gj, val in enumerate(done[ckey]):
+                        per_candidate_scores.setdefault((ci, gj), []).append(
+                            float(val))
                     continue
                 if deadline is not None and time.time() > deadline:
                     # drop the family entirely (pop partial fold scores, as
@@ -293,20 +376,16 @@ class ModelSelector(Estimator):
                         # fast path: one device program scores + one computes
                         # the metric for the whole grid; a single host sync
                         # per (fold, family)
-                        vals = batch_metrics(yva, scores,
-                                             self.validation_metric)
-                        for gj in range(len(models)):
-                            per_candidate_scores.setdefault(
-                                (ci, gj), []).append(float(vals[gj]))
-                        continue
-                    for gj, model in enumerate(models):
-                        pred = model.predict_arrays(Xva)
-                        # summary-only metric: evaluators skip their deep
-                        # report families inside the sweep
-                        val = ev0.metric_from_arrays(yva, pred,
-                                                     self.validation_metric)
-                        per_candidate_scores.setdefault((ci, gj), []).append(
-                            val)
+                        vals = [float(v) for v in batch_metrics(
+                            yva, scores, self.validation_metric)]
+                    else:
+                        vals = []
+                        for model in models:
+                            pred = model.predict_arrays(Xva)
+                            # summary-only metric: evaluators skip their
+                            # deep report families inside the sweep
+                            vals.append(ev0.metric_from_arrays(
+                                yva, pred, self.validation_metric))
                 except Exception as e:  # noqa: BLE001 — isolation by design
                     failed_families.add(ci)
                     for gj in range(len(grid)):
@@ -315,6 +394,15 @@ class ModelSelector(Estimator):
                         "modelName": family_name(ci),
                         "reason": f"fold {fold_i}: {type(e).__name__}: "
                                   f"{str(e)[:300]}"})
+                else:
+                    # bookkeeping outside the isolation try: a checkpoint
+                    # I/O problem must not convert a successful fit into a
+                    # candidate failure (_ckpt_save is best-effort anyway)
+                    for gj, val in enumerate(vals):
+                        per_candidate_scores.setdefault((ci, gj), []).append(
+                            val)
+                    done[ckey] = vals
+                    self._ckpt_save(done)
         results: list[ModelEvaluation] = []
         mean_metrics: list[tuple[float, int, int]] = []
         for (ci, gj), vals in per_candidate_scores.items():
