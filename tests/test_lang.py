@@ -177,3 +177,28 @@ def test_sensitive_features_in_model_insights():
     assert js["sensitiveFeatures"]["contact"]["detected"] is True
     # pretty report renders the sensitive section
     assert "Sensitive features" in ins.pretty()
+
+
+def test_name_dictionary_asset_loader(tmp_path):
+    """External census-scale dictionaries swap in per file (the reference's
+    pretrained-asset analog); built-ins restore afterwards."""
+    import transmogrifai_tpu.ops.names as N
+
+    saved = (N.MALE_NAMES, N.FEMALE_NAMES, N.SURNAMES, N.LOCATIONS,
+             N.NAME_DICTIONARY)
+    try:
+        (tmp_path / "male.txt").write_text("Zorbulon\nQuexx\n")
+        (tmp_path / "surnames.txt").write_text("vantablack\n")
+        loaded = N.load_name_dictionaries(str(tmp_path))
+        assert loaded == {"male": 2, "surnames": 1}
+        assert "zorbulon" in N.MALE_NAMES
+        assert N.FEMALE_NAMES is saved[1]  # missing file keeps built-ins
+        assert "vantablack" in N.NAME_DICTIONARY
+        # detection machinery reads the swapped dictionaries
+        stats = N.NameDetectStats()
+        for v in ["Zorbulon Vantablack", "Quexx Vantablack"] * 10:
+            stats.add(v, N.DEFAULT_STRATEGIES)
+        assert stats.predicted_name_prob == 1.0
+    finally:
+        (N.MALE_NAMES, N.FEMALE_NAMES, N.SURNAMES, N.LOCATIONS,
+         N.NAME_DICTIONARY) = saved

@@ -19,6 +19,7 @@ swappable resource). Host stages: string work stays off the device.
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -29,7 +30,7 @@ from transmogrifai_tpu.types import feature_types as ft
 __all__ = ["GenderDetectStrategy", "HumanNameDetector",
            "HumanNameDetectorModel", "NameEntityRecognizer",
            "MALE_NAMES", "FEMALE_NAMES", "NAME_DICTIONARY", "SURNAMES",
-           "LOCATIONS", "ORG_SUFFIXES"]
+           "LOCATIONS", "ORG_SUFFIXES", "load_name_dictionaries"]
 
 _TOKEN_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
 
@@ -120,6 +121,54 @@ ORG_SUFFIXES = frozenset(
 #: NameDictionary spans first AND last names; gender stays on the gendered
 #: first-name sets)
 NAME_DICTIONARY = MALE_NAMES | FEMALE_NAMES | SURNAMES
+
+
+def load_name_dictionaries(path: str) -> dict[str, int]:
+    """Swap in external (census-scale) dictionaries — the pretrained-asset
+    hook. The reference ships OpenNLP binaries + census name lists under
+    ``models/``; here a directory of plain-text files (one entry per line,
+    case-insensitive) replaces the built-ins per file present:
+    ``male.txt``, ``female.txt``, ``surnames.txt``, ``locations.txt``.
+    A present-but-empty file replaces the built-in with the EMPTY set
+    (how you disable a category); missing files keep the built-ins.
+    Returns {file stem: entry count}. Also honored at import via
+    ``TRANSMOGRIFAI_NAME_DICT``.
+    """
+    global MALE_NAMES, FEMALE_NAMES, SURNAMES, LOCATIONS, NAME_DICTIONARY
+    loaded: dict[str, int] = {}
+
+    def read(stem: str, builtin: frozenset) -> frozenset:
+        p = os.path.join(path, f"{stem}.txt")
+        if not os.path.isfile(p):
+            return builtin
+        with open(p, encoding="utf-8") as fh:
+            entries = frozenset(
+                line.strip().lower() for line in fh if line.strip())
+        loaded[stem] = len(entries)
+        return entries
+
+    MALE_NAMES = read("male", MALE_NAMES)
+    FEMALE_NAMES = read("female", FEMALE_NAMES)
+    SURNAMES = read("surnames", SURNAMES)
+    LOCATIONS = read("locations", LOCATIONS)
+    NAME_DICTIONARY = MALE_NAMES | FEMALE_NAMES | SURNAMES
+    return loaded
+
+
+def _autoload() -> None:
+    path = os.environ.get("TRANSMOGRIFAI_NAME_DICT")
+    if not path:
+        return
+    if not os.path.isdir(path):
+        import warnings
+        warnings.warn(
+            f"TRANSMOGRIFAI_NAME_DICT={path!r} is not a directory; keeping "
+            "built-in name dictionaries", RuntimeWarning)
+        return
+    load_name_dictionaries(path)
+
+
+_autoload()
 
 MALE_HONORIFICS = frozenset({"mr", "mister", "sir"})
 FEMALE_HONORIFICS = frozenset({"ms", "mrs", "miss", "madam"})
