@@ -265,3 +265,52 @@ class TestDslSurface:
                           for v in scores.columns[descaled.name].values])
         # descaled predictions land back on the original label scale
         assert abs(np.mean(out) - np.mean(y)) < 2000
+
+
+class TestMapAndPredictionDsl:
+    def test_filter_map_keys_and_mime_map(self):
+        import base64
+        from transmogrifai_tpu.ops.vectorizers.maps import (
+            Base64MapMimeDetector, FilterMapKeys,
+        )
+        f = FilterMapKeys(allow_list=["a", "b"], block_list=["b"])
+        assert f.transform_row({"a": 1, "b": 2, "c": 3}) == {"a": 1}
+        assert f.transform_row(None) == {}
+        f2 = FilterMapKeys(block_list=["x"])
+        assert f2.transform_row({"x": 1, "y": 2}) == {"y": 2}
+        det = Base64MapMimeDetector()
+        out = det.transform_row(
+            {"doc": base64.b64encode(b"%PDF-1.4").decode(), "none": None})
+        assert out == {"doc": "application/pdf"}
+
+    def test_prediction_accessors_in_workflow(self):
+        import transmogrifai_tpu.dsl  # noqa: F401
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.models.linear import OpLogisticRegression
+        from transmogrifai_tpu.ops.transmogrifier import transmogrify
+        from transmogrifai_tpu.workflow import Workflow
+
+        rng = np.random.default_rng(0)
+        n = 120
+        y = rng.integers(0, 2, n).astype(float)
+        frame = fr.HostFrame.from_dict({
+            "x": (ft.Real, (rng.normal(size=n) + y).tolist()),
+            "label": (ft.RealNN, y.tolist()),
+        })
+        feats = FeatureBuilder.from_frame(frame, response="label")
+        label = feats.pop("label")
+        vec = transmogrify([feats["x"]], min_support=1)
+        pred = label.transform_with(OpLogisticRegression(max_iter=20), vec)
+        pv, raw, prob = pred.tupled()
+        model = (Workflow().set_input_frame(frame)
+                 .set_result_features(pred, pv, raw, prob).train())
+        scores = model.score(frame)
+        p0 = scores.columns[pred.name].python_value(0)
+        assert scores.columns[pv.name].python_value(0) == p0["prediction"]
+        prob_vec = np.asarray(scores.columns[prob.name].python_value(0))
+        np.testing.assert_allclose(
+            prob_vec, [p0["probability_0"], p0["probability_1"]], rtol=1e-5)
+        # row path parity
+        fn = model.score_function()
+        row = fn({"x": 1.0})
+        assert row[pv.name] == row[pred.name]["prediction"]

@@ -106,6 +106,8 @@ def _values_for(t: type, rng) -> list:
     name = t.__name__
     if name == "FeatureType":  # any-typed stages (alias, len, occur): text
         return _strings(rng, ["alpha", "beta", "gamma"])
+    if name in ("OPMap", "OPCollection"):
+        t = ft.TextMap
     if name == "RealNN":
         return [float(x) for x in rng.normal(size=N)]
     if name in ("Real", "Currency", "Percent"):
@@ -233,9 +235,11 @@ def _build_graph(cls, rng):
                     [float(v) for v in rng.integers(0, 2, size=N)])
             feat_specs.append((f"__pred__{nm}", t))
         else:
-            # any-typed stages get a concrete Text raw column (FeatureType
-            # itself is not a constructible raw type)
-            col_t = ft.Text if t is ft.FeatureType else t
+            # any-typed stages get a concrete raw column (FeatureType/OPMap
+            # themselves are not constructible raw types)
+            col_t = (ft.Text if t is ft.FeatureType
+                     else ft.TextMap if t in (ft.OPMap, ft.OPCollection)
+                     else t)
             vals = _values_for(t, rng)
             if cls.__name__ in _NO_NULLS:
                 vals = ["filler" if v is None else v for v in vals]

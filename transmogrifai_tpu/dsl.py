@@ -276,6 +276,55 @@ def _is_valid_phone_map(self, **kw):
     return self.transform_with(IsValidPhoneMapDefaultCountry(**kw))
 
 
+def _filter_map_keys(self, allow_list=(), block_list=()):
+    from transmogrifai_tpu.ops.vectorizers.maps import FilterMapKeys
+    return self.transform_with(FilterMapKeys(allow_list=allow_list,
+                                             block_list=block_list))
+
+
+def _mime_type_map(self):
+    from transmogrifai_tpu.ops.vectorizers.maps import Base64MapMimeDetector
+    return self.transform_with(Base64MapMimeDetector())
+
+
+def _to_unit_circle_map(self, period="HourOfDay"):
+    from transmogrifai_tpu.ops.vectorizers.maps import (
+        DateMapToUnitCircleVectorizer,
+    )
+    return self.transform_with(DateMapToUnitCircleVectorizer(
+        time_period=period))
+
+
+def _auto_bucketize_map(self, label, **kw):
+    from transmogrifai_tpu.ops.vectorizers.bucketizers import (
+        DecisionTreeNumericMapBucketizer,
+    )
+    return label.transform_with(DecisionTreeNumericMapBucketizer(**kw), self)
+
+
+# -- Prediction accessors (reference Prediction implicit extractors) --------
+
+def _pred_value(self):
+    from transmogrifai_tpu.ops.combiner import PredictionToReal
+    return self.transform_with(PredictionToReal())
+
+
+def _pred_probability(self):
+    from transmogrifai_tpu.ops.combiner import PredictionProbabilityVector
+    return self.transform_with(PredictionProbabilityVector())
+
+
+def _pred_raw(self):
+    from transmogrifai_tpu.ops.combiner import PredictionRawVector
+    return self.transform_with(PredictionRawVector())
+
+
+def _tupled(self):
+    """prediction.tupled() -> (RealNN value, raw OPVector, prob OPVector)
+    (reference RichMapFeature.tupled)."""
+    return _pred_value(self), _pred_raw(self), _pred_probability(self)
+
+
 # -- scaling / calibration / prediction -------------------------------------
 
 def _scale(self, slope: float = 1.0, intercept: float = 0.0):
@@ -366,6 +415,15 @@ def install() -> None:
     F.map_null_indicators = _map_null_indicators
     F.to_time_period_map = _to_time_period_map
     F.is_valid_phone_map = _is_valid_phone_map
+    F.filter_map_keys = _filter_map_keys
+    F.mime_type_map = _mime_type_map
+    F.to_unit_circle_map = _to_unit_circle_map
+    F.auto_bucketize_map = _auto_bucketize_map
+    # Prediction accessors
+    F.pred_value = _pred_value
+    F.pred_probability = _pred_probability
+    F.pred_raw = _pred_raw
+    F.tupled = _tupled
     # scaling / calibration / prediction
     F.scale = _scale
     F.descale = _descale

@@ -13,13 +13,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from transmogrifai_tpu import frame as fr
-from transmogrifai_tpu.stages.base import DeviceTransformer
+from transmogrifai_tpu.stages.base import AllowLabelAsInput, DeviceTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
     VectorColumnMetadata, VectorMetadata,
 )
 
-__all__ = ["VectorsCombiner"]
+__all__ = ["VectorsCombiner", "PredictionToReal",
+           "PredictionProbabilityVector", "PredictionRawVector"]
 
 
 class VectorsCombiner(DeviceTransformer):
@@ -50,3 +51,53 @@ class VectorsCombiner(DeviceTransformer):
     def transform_row(self, *values):
         return np.concatenate([np.asarray(v, dtype=np.float32).ravel()
                                for v in values])
+
+
+class PredictionToReal(DeviceTransformer, AllowLabelAsInput):
+    """Prediction -> RealNN prediction value (reference RichMapFeature's
+    implicit Prediction=>RealNN extractor / ``tupled()``)."""
+
+    in_types = (ft.Prediction,)
+    out_type = ft.RealNN
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def device_apply(self, params, col: fr.PredictionColumn) -> fr.NumericColumn:
+        return fr.NumericColumn(col.prediction,
+                                jnp.ones_like(col.prediction))
+
+    def transform_row(self, p):
+        return None if p is None else float(p["prediction"])
+
+
+class _PredictionVectorBase(DeviceTransformer, AllowLabelAsInput):
+    in_types = (ft.Prediction,)
+    out_type = ft.OPVector
+    _field = "probability"
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def device_apply(self, params, col: fr.PredictionColumn) -> fr.VectorColumn:
+        return fr.VectorColumn(getattr(col, self._field))
+
+    def transform_row(self, p):
+        if p is None:
+            return None
+        # one key-format contract: the Prediction type's own accessors
+        pred = ft.Prediction(p)
+        vals = (pred.probability if self._field == "probability"
+                else pred.raw_prediction)
+        return np.asarray(vals, np.float32)
+
+
+class PredictionProbabilityVector(_PredictionVectorBase):
+    """Prediction -> OPVector of class probabilities (reference
+    Prediction=>OPVector probability extractor)."""
+    _field = "probability"
+
+
+class PredictionRawVector(_PredictionVectorBase):
+    """Prediction -> OPVector of raw scores."""
+    _field = "raw_prediction"

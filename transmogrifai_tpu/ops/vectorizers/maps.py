@@ -28,6 +28,7 @@ from transmogrifai_tpu.vector_metadata import (
 )
 
 __all__ = [
+    "FilterMapKeys", "Base64MapMimeDetector",
     "RealMapVectorizer", "IntegralMapVectorizer", "BinaryMapVectorizer",
     "TextMapPivotVectorizer", "MultiPickListMapVectorizer",
     "DateMapToUnitCircleVectorizer", "GeolocationMapVectorizer",
@@ -562,3 +563,65 @@ class TextMapNullEstimator(_MapVectorizerBase):
         keys = [sorted(self._collect(data.host_col(n)))
                 for n in self.input_names]
         return _TextMapNullModel(keys=keys, track_nulls=False)
+
+
+class FilterMapKeys(HostTransformer):
+    """Key allow/block filtering on any map feature, type-preserving
+    (reference RichMapFeature.filter, RichMapFeature.scala:58-88)."""
+
+    in_types = (ft.OPMap,)
+    out_type = ft.OPMap
+
+    def __init__(self, allow_list: Sequence[str] = (),
+                 block_list: Sequence[str] = (),
+                 uid: Optional[str] = None):
+        self.allow_list = list(allow_list)
+        self.block_list = list(block_list)
+        self._allow = frozenset(self.allow_list)
+        self._block = frozenset(self.block_list)
+        super().__init__(uid=uid)
+
+    def set_input(self, *features):
+        super().set_input(*features)
+        self.out_type = features[0].ftype  # type-preserving
+        return self
+
+    def transform_row(self, value):
+        if not value:
+            return {}
+        allow, block = self._allow, self._block
+        return {k: v for k, v in value.items()
+                if (not allow or k in allow) and k not in block}
+
+    def config(self):
+        return {"allow_list": self.allow_list,
+                "block_list": self.block_list}
+
+
+class Base64MapMimeDetector(HostTransformer):
+    """Base64Map -> PickListMap of detected MIME types per key (reference
+    RichMapFeature.detectMimeTypes)."""
+
+    in_types = (ft.Base64Map,)
+    out_type = ft.PickListMap
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if not value:
+            return {}
+        import base64
+
+        from transmogrifai_tpu.ops.parsers import detect_mime
+        out = {}
+        for k, v in value.items():
+            if v is None:
+                continue
+            try:
+                data = base64.b64decode(v, validate=False)
+            except Exception:
+                continue
+            if data:
+                out[k] = detect_mime(data)
+        return out

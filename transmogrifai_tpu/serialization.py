@@ -156,6 +156,12 @@ def load_model(path: str):
         stage._inputs = tuple(ins)  # bypass validation: graph is trusted
         out = build_feature(s["outputFeature"], stage, ins)
         stage._output = out
+        # type-preserving stages (alias, map filters) resolve their output
+        # type from the wired input at set_input time, which this loader
+        # bypasses — restore the concrete type from the manifest
+        if type(stage).out_type in (ft.FeatureType, ft.OPMap,
+                                    ft.OPCollection):
+            stage.out_type = out.ftype
         state: dict[str, Any] = dict(s.get("stateJson") or {})
         prefix = f"{s['uid']}||"
         for k, v in arrays.items():
