@@ -1,0 +1,244 @@
+"""Vector-surface ops (tf/idf, metadata drops, min-variance) and generic
+feature ops (exists/filter/replace/map/substring) + text DSL surface
+(parity: reference RichListFeature tf/tfidf, RichVectorFeature idf /
+dropIndicesBy, MinVarianceFilter, RichFeature exists/filter/replaceWith,
+RichTextFeature toEmailPrefix/toProtocol/toMultiPickList/tokenizeRegex/
+isSubstring)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 — installs the DSL
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import DagExecutor, compute_dag
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.ops.math import (
+    ExistsTransformer, FilterValueTransformer, ReplaceTransformer,
+    SubstringTransformer,
+)
+from transmogrifai_tpu.ops.parsers import (
+    EmailPrefixTransformer, UrlProtocolTransformer,
+)
+from transmogrifai_tpu.ops.text import RegexTokenizer, TextToMultiPickList
+from transmogrifai_tpu.ops.vector_ops import (
+    DropIndicesByTransformer, MinVarianceFilter, OpHashingTF, OpIDF,
+)
+from transmogrifai_tpu.ops.vectorizers.hashing import hash_token
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _run(host, out_feature):
+    data = PipelineData.from_host(host)
+    out, fitted = DagExecutor().fit_transform(data, compute_dag([out_feature]))
+    return out, fitted
+
+
+def _vec(out, feature):
+    col = out.host_col(feature.name)
+    return np.asarray(col.values), col.meta
+
+
+def _rows(out, feature):
+    col = out.host_col(feature.name)
+    return [col.python_value(i) for i in range(len(col))]
+
+
+# ---------------------------------------------------------------------------
+# tf / idf / tfidf
+# ---------------------------------------------------------------------------
+
+def _docs_frame():
+    docs = [["a", "b", "a"], ["b", "c"], [], ["c", "c", "c"]]
+    return fr.HostFrame.from_dict({"toks": (ft.TextList, docs)}), docs
+
+
+def test_hashing_tf_counts():
+    host, docs = _docs_frame()
+    feats = FeatureBuilder.from_frame(host)
+    f = feats["toks"].tf(num_features=16)
+    out, _ = _run(host, f)
+    vals, meta = _vec(out, f)
+    assert vals.shape == (4, 16)
+    # exact expected histogram via the shared token hash
+    for r, doc in enumerate(docs):
+        exp = np.zeros(16)
+        for t in doc:
+            exp[hash_token(t, 16)] += 1
+        assert np.allclose(vals[r], exp)
+    assert meta is not None and meta.size == 16
+
+
+def test_idf_spark_semantics():
+    host, docs = _docs_frame()
+    feats = FeatureBuilder.from_frame(host)
+    f = feats["toks"].tfidf(num_features=16)
+    out, _ = _run(host, f)
+    vals, _ = _vec(out, f)
+    m = 4
+    # df per token column (hash is collision-free for 3 tokens in 16 bins
+    # unless unlucky — compute df from the tf matrix directly instead)
+    tf = np.zeros((4, 16))
+    for r, doc in enumerate(docs):
+        for t in doc:
+            tf[r, hash_token(t, 16)] += 1
+    df = (tf > 0).sum(axis=0)
+    expected = tf * np.log((m + 1.0) / (df + 1.0))[None, :]
+    assert np.allclose(vals, expected, atol=1e-5)
+
+
+def test_idf_min_doc_freq_zeroes_rare_terms():
+    host, docs = _docs_frame()
+    feats = FeatureBuilder.from_frame(host)
+    tf = feats["toks"].tf(num_features=16)
+    f = tf.idf(min_doc_freq=2)
+    out, _ = _run(host, f)
+    vals, _ = _vec(out, f)
+    counts = np.zeros((4, 16))
+    for r, doc in enumerate(docs):
+        for t in doc:
+            counts[r, hash_token(t, 16)] += 1
+    df = (counts > 0).sum(axis=0)
+    # columns with df < 2 must be exactly 0 everywhere
+    assert np.all(vals[:, df < 2] == 0.0)
+    # a df>=2 column keeps nonzero weight
+    assert vals[:, df >= 2].any()
+
+
+# ---------------------------------------------------------------------------
+# dropIndicesBy / filterMinVariance
+# ---------------------------------------------------------------------------
+
+def test_drop_indices_by_null_indicator():
+    host = fr.HostFrame.from_dict({
+        "x": (ft.Real, [1.0, None, 3.0, 4.0]),
+        "y": (ft.Real, [0.5, 0.5, None, 1.5]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    vec = feats["x"].vectorize(feats["y"])
+    out_full, _ = _run(host, vec)
+    full_vals, full_meta = _vec(out_full, vec)
+    n_null = sum(1 for c in full_meta.columns if c.is_null_indicator)
+    assert n_null >= 2
+
+    dropped = vec.drop_indices_by("null_indicator")
+    out, _ = _run(host, dropped)
+    vals, meta = _vec(out, dropped)
+    assert vals.shape[1] == full_vals.shape[1] - n_null
+    assert all(not c.is_null_indicator for c in meta.columns)
+
+
+def test_drop_indices_by_unknown_predicate_raises():
+    t = DropIndicesByTransformer(match_fn="nope")
+    with pytest.raises(KeyError):
+        t._predicate()
+
+
+def test_filter_min_variance():
+    n = 32
+    rng = np.random.default_rng(0)
+    host = fr.HostFrame.from_dict({
+        "wide": (ft.RealNN, [float(v) for v in rng.normal(size=n)]),
+        "flat": (ft.RealNN, [1.0] * n),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    vec = feats["wide"].vectorize(feats["flat"])
+    filtered = vec.filter_min_variance(1e-4)
+    out, _ = _run(host, filtered)
+    vals, meta = _vec(out, filtered)
+    # the constant column drops; the varying one survives
+    assert vals.shape[1] < _vec(_run(host, vec)[0], vec)[0].shape[1]
+    assert np.var(vals[:, 0]) > 1e-4
+
+
+# ---------------------------------------------------------------------------
+# generic feature ops
+# ---------------------------------------------------------------------------
+
+def test_exists_filter_replace_map_rows():
+    ex = ExistsTransformer(predicate=lambda v: v is not None and v > 2)
+    assert ex.transform_row(3.0) is True
+    assert ex.transform_row(1.0) is False
+
+    flt = FilterValueTransformer(predicate=lambda v: v == "keep",
+                                 default="fallback")
+    assert flt.transform_row("keep") == "keep"
+    assert flt.transform_row("drop") == "fallback"
+
+    rep = ReplaceTransformer(old="bad", new="good")
+    assert rep.transform_row("bad") == "good"
+    assert rep.transform_row("other") == "other"
+    assert ReplaceTransformer(old=None, new="filled").transform_row(None) \
+        == "filled"
+
+
+def test_generic_ops_in_workflow():
+    host = fr.HostFrame.from_dict({
+        "t": (ft.Text, ["alpha", None, "beta", "alpha"]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    replaced = feats["t"].replace_with("alpha", "ALPHA")
+    out, _ = _run(host, replaced)
+    assert _rows(out, replaced) == ["ALPHA", None, "beta", "ALPHA"]
+
+    mapped = feats["t"].map(lambda v: None if v is None else v.upper(),
+                            out_type=ft.Text)
+    out2, _ = _run(host, mapped)
+    assert _rows(out2, mapped) == ["ALPHA", None, "BETA", "ALPHA"]
+
+
+def test_substring():
+    s = SubstringTransformer()
+    assert s.transform_row("Ell", "Hello") is True
+    assert s.transform_row("xyz", "Hello") is False
+    assert s.transform_row(None, "Hello") is None
+    assert SubstringTransformer(to_lowercase=False).transform_row(
+        "Ell", "Hello") is False
+
+
+# ---------------------------------------------------------------------------
+# text surface
+# ---------------------------------------------------------------------------
+
+def test_email_prefix_and_url_protocol():
+    assert EmailPrefixTransformer().transform_row("jane.d@x.com") == "jane.d"
+    assert EmailPrefixTransformer().transform_row("not-an-email") is None
+    assert UrlProtocolTransformer().transform_row("https://x.com/a") == "https"
+    assert UrlProtocolTransformer().transform_row("ftp://files.org") == "ftp"
+    assert UrlProtocolTransformer().transform_row("garbage") is None
+
+
+def test_to_multi_pick_list():
+    t = TextToMultiPickList()
+    assert t.transform_row("a") == {"a"}
+    assert t.transform_row(None) == set()
+
+
+def test_regex_tokenizer():
+    t = RegexTokenizer(pattern=r"[a-z]+")
+    assert t.transform_row("Ab1 cd-EF") == ["ab", "cd", "ef"]
+    t2 = RegexTokenizer(pattern=r"(\d+)-(\d+)", group=2, lowercase=False)
+    assert t2.transform_row("10-20 30-40") == ["20", "40"]
+    t3 = RegexTokenizer(pattern=r"[a-z]+", min_token_length=3)
+    assert t3.transform_row("ab abc abcd") == ["abc", "abcd"]
+    assert t.transform_row(None) == []
+
+
+def test_is_substring_of_dsl():
+    host = fr.HostFrame.from_dict({
+        "sub": (ft.Text, ["ell", "xyz", None]),
+        "full": (ft.Text, ["Hello", "Hello", "Hello"]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    f = feats["sub"].is_substring_of(feats["full"])
+    out, _ = _run(host, f)
+    assert _rows(out, f) == [True, False, None]
+
+
+def test_set_jaccard_similarity():
+    from transmogrifai_tpu.ops.text import SetJaccardSimilarity
+    j = SetJaccardSimilarity()
+    assert j.transform_row({"a", "b"}, {"b", "c"}) == pytest.approx(1 / 3)
+    assert j.transform_row(set(), set()) == 1.0
+    assert j.transform_row({"a"}, set()) == 0.0
+    assert j.transform_row(None, None) == 1.0

@@ -78,9 +78,11 @@ def _pivot(self, top_k: int = 20, min_support: int = 10, **kw):
         OneHotVectorizer(top_k=top_k, min_support=min_support, **kw))
 
 
-def _vectorize(self, **kw):
+def _vectorize(self, *others, **kw):
+    """Type-default vectorization of this feature (+ ``others``, the
+    reference Rich*Feature ``vectorize(others = ...)`` convention)."""
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
-    return transmogrify([self], **kw)
+    return transmogrify([self, *others], **kw)
 
 
 def _smart_vectorize(self, **kw):
@@ -357,6 +359,91 @@ def _record_insights(self, features, **kw):
     return self.transform_with(RecordInsightsCorr(**kw), features)
 
 
+def _map(self, fn, out_type=None, operation_name="map"):
+    """Arbitrary row-function transform (reference RichFeature ``map`` via
+    UnaryLambdaTransformer); ``fn`` must be importable to serialize."""
+    from transmogrifai_tpu.stages.base import LambdaTransformer
+    return self.transform_with(LambdaTransformer(
+        fn, in_types=(self.ftype,), out_type=out_type or self.ftype,
+        operation_name=operation_name))
+
+
+def _exists(self, predicate):
+    from transmogrifai_tpu.ops.math import ExistsTransformer
+    return self.transform_with(ExistsTransformer(predicate=predicate))
+
+
+def _filter_values(self, predicate, default=None):
+    from transmogrifai_tpu.ops.math import FilterValueTransformer
+    return self.transform_with(
+        FilterValueTransformer(predicate=predicate, default=default))
+
+
+def _replace_with(self, old, new):
+    from transmogrifai_tpu.ops.math import ReplaceTransformer
+    return self.transform_with(ReplaceTransformer(old=old, new=new))
+
+
+def _is_substring_of(self, full, to_lowercase: bool = True):
+    from transmogrifai_tpu.ops.math import SubstringTransformer
+    return self.transform_with(
+        SubstringTransformer(to_lowercase=to_lowercase), full)
+
+
+def _email_prefix(self):
+    from transmogrifai_tpu.ops.parsers import EmailPrefixTransformer
+    return self.transform_with(EmailPrefixTransformer())
+
+
+def _url_protocol(self):
+    from transmogrifai_tpu.ops.parsers import UrlProtocolTransformer
+    return self.transform_with(UrlProtocolTransformer())
+
+
+def _to_multi_pick_list(self):
+    from transmogrifai_tpu.ops.text import TextToMultiPickList
+    return self.transform_with(TextToMultiPickList())
+
+
+def _tokenize_regex(self, pattern, group: int = -1,
+                    min_token_length: int = 1, lowercase: bool = True):
+    from transmogrifai_tpu.ops.text import RegexTokenizer
+    return self.transform_with(RegexTokenizer(
+        pattern=pattern, group=group, min_token_length=min_token_length,
+        lowercase=lowercase))
+
+
+def _tf(self, num_features: int = 512, binary_freq: bool = False):
+    from transmogrifai_tpu.ops.vector_ops import OpHashingTF
+    return self.transform_with(OpHashingTF(
+        num_features=num_features, binary_freq=binary_freq))
+
+
+def _idf(self, min_doc_freq: int = 0):
+    from transmogrifai_tpu.ops.vector_ops import OpIDF
+    return self.transform_with(OpIDF(min_doc_freq=min_doc_freq))
+
+
+def _tfidf(self, num_features: int = 512, binary_freq: bool = False,
+           min_doc_freq: int = 0):
+    return _idf(_tf(self, num_features, binary_freq), min_doc_freq)
+
+
+def _jaccard_similarity(self, other):
+    from transmogrifai_tpu.ops.text import SetJaccardSimilarity
+    return self.transform_with(SetJaccardSimilarity(), other)
+
+
+def _drop_indices_by(self, match_fn):
+    from transmogrifai_tpu.ops.vector_ops import DropIndicesByTransformer
+    return self.transform_with(DropIndicesByTransformer(match_fn=match_fn))
+
+
+def _filter_min_variance(self, min_variance: float = 1e-5):
+    from transmogrifai_tpu.ops.vector_ops import MinVarianceFilter
+    return self.transform_with(MinVarianceFilter(min_variance=min_variance))
+
+
 def transmogrify_features(features: Sequence[FeatureLike], **kw) -> FeatureLike:
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     return transmogrify(list(features), **kw)
@@ -424,6 +511,25 @@ def install() -> None:
     F.pred_probability = _pred_probability
     F.pred_raw = _pred_raw
     F.tupled = _tupled
+    # RichFeature generic ops
+    F.map = _map
+    F.exists = _exists
+    F.filter_values = _filter_values
+    F.replace_with = _replace_with
+    # text surface
+    F.is_substring_of = _is_substring_of
+    F.email_prefix = _email_prefix
+    F.url_protocol = _url_protocol
+    F.to_multi_pick_list = _to_multi_pick_list
+    F.tokenize_regex = _tokenize_regex
+    # RichSetFeature
+    F.jaccard_similarity = _jaccard_similarity
+    # RichListFeature / RichVectorFeature
+    F.tf = _tf
+    F.idf = _idf
+    F.tfidf = _tfidf
+    F.drop_indices_by = _drop_indices_by
+    F.filter_min_variance = _filter_min_variance
     # scaling / calibration / prediction
     F.scale = _scale
     F.descale = _descale

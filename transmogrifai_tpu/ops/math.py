@@ -24,6 +24,8 @@ __all__ = [
     "BinaryMathTransformer", "UnaryMathTransformer", "ScalarMathTransformer",
     "AliasTransformer", "ToOccurTransformer", "FillMissingWithMean",
     "OpScalarStandardScaler", "ScalerTransformer", "DescalerTransformer",
+    "ExistsTransformer", "FilterValueTransformer", "ReplaceTransformer",
+    "SubstringTransformer",
 ]
 
 _BINARY_OPS = {
@@ -183,6 +185,100 @@ class ToOccurTransformer(HostTransformer):
         if isinstance(v, (list, set, dict, str)):
             return len(v) > 0
         return True
+
+
+class ExistsTransformer(HostTransformer):
+    """Any feature -> Binary via predicate (reference RichFeature ``exists``).
+
+    The predicate must be a module-level importable function for
+    serialization (same contract as LambdaTransformer); it sees the plain
+    python value (None = missing).
+    """
+
+    in_types = (ft.FeatureType,)
+    out_type = ft.Binary
+
+    def __init__(self, predicate=None, uid: Optional[str] = None):
+        self.predicate = predicate
+        super().__init__(operation_name="exists", uid=uid)
+
+    def transform_row(self, v):
+        return bool(self.predicate(v))
+
+    def config(self) -> dict:
+        raise NotImplementedError(
+            "ExistsTransformer with an arbitrary predicate is not "
+            "serializable (reference lambdas require stable classes)")
+
+
+class FilterValueTransformer(HostTransformer):
+    """Keep the value when the predicate holds, else the default (reference
+    RichFeature ``filter``). Output type follows the input feature."""
+
+    in_types = (ft.FeatureType,)
+    out_type = ft.FeatureType
+
+    def __init__(self, predicate=None, default=None,
+                 uid: Optional[str] = None):
+        self.predicate = predicate
+        self.default = default
+        super().__init__(operation_name="filter", uid=uid)
+
+    def set_input(self, *features):
+        super().set_input(*features)
+        self.out_type = features[0].ftype
+        return self
+
+    def transform_row(self, v):
+        return v if self.predicate(v) else self.default
+
+    def config(self) -> dict:
+        raise NotImplementedError(
+            "FilterValueTransformer with an arbitrary predicate is not "
+            "serializable (reference lambdas require stable classes)")
+
+
+class ReplaceTransformer(HostTransformer):
+    """Replace matching values (reference RichFeature ``replaceWith``):
+    value == old -> new, everything else passes through. None is a legal
+    ``old``/``new`` (fill or clear)."""
+
+    in_types = (ft.FeatureType,)
+    out_type = ft.FeatureType
+
+    def __init__(self, old=None, new=None, uid: Optional[str] = None):
+        self.old = old
+        self.new = new
+        super().__init__(operation_name="replaceWith", uid=uid)
+
+    def set_input(self, *features):
+        super().set_input(*features)
+        self.out_type = features[0].ftype
+        return self
+
+    def transform_row(self, v):
+        return self.new if v == self.old else v
+
+
+class SubstringTransformer(HostTransformer):
+    """(Text sub, Text full) -> Binary: does ``full`` contain ``sub``
+    (reference ``SubstringTransformer.scala`` / RichTextFeature
+    ``isSubstring``). None if either side is missing."""
+
+    in_types = (ft.Text, ft.Text)
+    out_type = ft.Binary
+
+    def __init__(self, to_lowercase: bool = True,
+                 uid: Optional[str] = None):
+        self.to_lowercase = bool(to_lowercase)
+        super().__init__(operation_name="substring", uid=uid)
+
+    def transform_row(self, sub, full):
+        if sub is None or full is None:
+            return None
+        if self.to_lowercase:
+            sub, full = sub.lower(), full.lower()
+        return sub in full
 
 
 class FillMissingWithMean(Estimator):

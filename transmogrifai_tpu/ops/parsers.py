@@ -22,7 +22,7 @@ __all__ = [
     "ValidUrlTransformer", "PhoneNumberParser", "MimeTypeDetector",
     "ParsePhoneNumber", "ParsePhoneDefaultCountry", "IsValidPhoneNumber",
     "IsValidPhoneMapDefaultCountry", "PHONE_REGIONS", "parse_phone",
-    "detect_mime",
+    "detect_mime", "EmailPrefixTransformer", "UrlProtocolTransformer",
 ]
 
 _EMAIL_RE = re.compile(
@@ -259,6 +259,22 @@ class EmailToPickList(HostTransformer):
         return value.rsplit("@", 1)[1].lower()
 
 
+class EmailPrefixTransformer(HostTransformer):
+    """Email -> local-part Text (reference RichTextFeature ``toEmailPrefix``
+    via EmailPrefixToText); invalid -> None."""
+
+    in_types = (ft.Email,)
+    out_type = ft.Text
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None or not is_valid_email(value):
+            return None
+        return value.rsplit("@", 1)[0]
+
+
 class ValidUrlTransformer(HostTransformer):
     in_types = (ft.URL,)
     out_type = ft.Binary
@@ -284,6 +300,22 @@ class UrlToPickList(HostTransformer):
             return None
         host = re.sub(r"^[a-z+]+://", "", value.lower()).split("/")[0]
         return host.split(":")[0] or None
+
+
+class UrlProtocolTransformer(HostTransformer):
+    """URL -> protocol Text, e.g. 'http' (reference RichTextFeature
+    ``toProtocol`` via URLProtocolToText); invalid -> None."""
+
+    in_types = (ft.URL,)
+    out_type = ft.Text
+
+    def __init__(self, uid=None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None or not is_valid_url(value):
+            return None
+        return value.split("://", 1)[0].lower()
 
 
 class _PhoneBase(HostTransformer):

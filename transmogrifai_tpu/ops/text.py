@@ -28,6 +28,7 @@ __all__ = [
     "TextTokenizer", "LangDetector", "OpStopWordsRemover", "OpNGram",
     "NGramSimilarity", "TextLenTransformer", "STOP_WORDS",
     "simple_tokenize", "detect_language",
+    "RegexTokenizer", "TextToMultiPickList", "SetJaccardSimilarity",
 ]
 
 _WORD_RE = re.compile(r"[^\W_]+", re.UNICODE)
@@ -272,3 +273,69 @@ class TextLenTransformer(HostTransformer):
             else:
                 out.append(0.0)
         return np.asarray(out, dtype=np.float32)
+
+
+class RegexTokenizer(HostTransformer):
+    """Text -> TextList of regex-extracted tokens (reference RichTextFeature
+    ``tokenizeRegex`` via LuceneRegexTextAnalyzer).
+
+    ``group`` = -1 takes whole matches; >= 0 takes that capture group of
+    each match. Tokens shorter than ``min_token_length`` drop.
+    """
+
+    in_types = (ft.Text,)
+    out_type = ft.TextList
+
+    def __init__(self, pattern: str = r"[^\W_]+", group: int = -1,
+                 min_token_length: int = 1, lowercase: bool = True,
+                 uid: Optional[str] = None):
+        self.pattern = pattern
+        self.group = int(group)
+        self.min_token_length = int(min_token_length)
+        self.lowercase = bool(lowercase)
+        self._re = re.compile(pattern, re.UNICODE)
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        if value is None:
+            return []
+        if self.lowercase:
+            value = value.lower()
+        group = self.group if self.group >= 0 else 0  # 0 = whole match
+        toks = [m.group(group) or "" for m in self._re.finditer(value)]
+        return [t for t in toks if len(t) >= self.min_token_length]
+
+
+class TextToMultiPickList(HostTransformer):
+    """Text -> single-element MultiPickList (reference RichTextFeature
+    ``toMultiPickList``); empty set when missing."""
+
+    in_types = (ft.Text,)
+    out_type = ft.MultiPickList
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, value):
+        return set() if value is None else {value}
+
+
+class SetJaccardSimilarity(HostTransformer):
+    """(MultiPickList, MultiPickList) -> RealNN Jaccard similarity of the
+    two sets (reference ``JaccardSimilarity.scala`` / RichSetFeature
+    ``jaccardSimilarity``): |a & b| / |a | b|, and 1.0 when BOTH sides are
+    empty (the reference's documented convention)."""
+
+    in_types = (ft.MultiPickList, ft.MultiPickList)
+    out_type = ft.RealNN
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    def transform_row(self, a, b):
+        sa = set(a or ())
+        sb = set(b or ())
+        if not sa and not sb:
+            return 1.0
+        union = len(sa | sb)
+        return len(sa & sb) / union
