@@ -6,8 +6,15 @@ Spark reference. HIGGS itself is not fetchable here (zero egress), so the
 bench runs the same pipeline shape on synthetic HIGGS-like data (28 numeric
 features, binary label, nonlinear signal).
 
+The sweep is the DEFAULT binary candidate set (selector/factories.py):
+8-point LR grid + 4-point linear SVC + RandomForest (50 trees, depth 6/12)
++ GBT (50 rounds, depth 3/6) — the reference's own Titanic demo shape
+(README.md:60-80 sweeps LR + RF candidates; BASELINE.json names the
+GBT/XGBoost-class sweep as the north-star config).
+
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "s", "vs_baseline": N,
+   "device_time_breakdown": {...}, "scaling_curve": [...], ...}
 
 value        = wall seconds for the full AutoML pipeline at N_ROWS on the
                accelerator (TPU under axon; CPU as last-resort fallback).
@@ -15,6 +22,11 @@ vs_baseline  = cpu_wall / accel_wall for the identical pipeline at
                CPU_ROWS rows, linearly extrapolated to N_ROWS — a
                same-code host-CPU proxy for the Spark cluster baseline
                until a recorded Spark number lands in BASELINE.json.
+device_time_breakdown = per-OpStep wall + true device-busy seconds parsed
+               from a jax.profiler device trace of the accelerator run
+               (utils/profiling.py timeline attribution), plus analytic
+               training FLOPs and achieved FLOP/s / MFU-vs-bf16-peak for
+               the linear and tree trainers.
 
 Resilience design (round-1 postmortem: the whole bench died rc=1 inside
 TPU backend init): the orchestrating parent process NEVER imports jax.
@@ -30,11 +42,19 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
 CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", 250_000))
 CHILD_TIMEOUT = int(os.environ.get("BENCH_CHILD_TIMEOUT", 3000))
+#: extra accelerator-only row counts for the scaling curve ("" disables)
+CURVE = [int(x) for x in
+         os.environ.get("BENCH_CURVE", "1000000,2000000").split(",") if x]
+#: "full" = default candidate set (LR+SVC+RF+GBT); "lr" = LR-only smoke
+MODELS = os.environ.get("BENCH_MODELS", "full")
 D = 28
 
 
@@ -61,19 +81,30 @@ def _enable_compile_cache():
         pass
 
 
-def run_pipeline(n_rows: int) -> dict:
+def _candidates():
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    if MODELS == "lr":
+        return [(OpLogisticRegression(),
+                 [{"reg_param": r, "elastic_net_param": e}
+                  for r in (0.0, 0.01, 0.1, 0.2) for e in (0.0, 0.5)])]
+    return None  # factories default: LR + SVC + RF + GBT
+
+
+def run_pipeline(n_rows: int, trace: bool = False) -> dict:
     """Full pipeline: frame ingest -> transmogrify -> sanity check ->
-    3-fold LR sweep. Returns {"wall": seconds, "auroc": float,
-    "platform": str} (wall excludes data synthesis)."""
+    3-fold default-candidate sweep. Returns {"wall": s, "auroc": f,
+    "platform": str, "phases": {...}, "flops": {...}} (wall excludes data
+    synthesis)."""
     import jax
     import numpy as np
     from transmogrifai_tpu import frame as fr
     from transmogrifai_tpu.features.builder import FeatureBuilder
-    from transmogrifai_tpu.models.linear import OpLogisticRegression
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     from transmogrifai_tpu.selector import (
         BinaryClassificationModelSelector, DataSplitter,
     )
+    from transmogrifai_tpu.utils import flops
+    from transmogrifai_tpu.utils.profiling import profiler
     from transmogrifai_tpu.workflow import Workflow
     from transmogrifai_tpu.types import feature_types as ft
 
@@ -86,6 +117,10 @@ def run_pipeline(n_rows: int) -> dict:
     cols["label"] = fr.HostColumn(ft.RealNN, y, np.ones(n_rows, bool))
     frame = fr.HostFrame(cols)
 
+    trace_dir = tempfile.mkdtemp(prefix="bench_trace_") if trace else None
+    flops.reset()
+    metrics = profiler.reset(app_name="bench", trace_dir=trace_dir)
+
     t0 = time.time()
     feats = FeatureBuilder.from_frame(frame, response="label")
     label = feats.pop("label")
@@ -96,24 +131,30 @@ def run_pipeline(n_rows: int) -> dict:
     except ImportError:
         checked = features
     selector = BinaryClassificationModelSelector.with_cross_validation(
-        n_folds=3, seed=42,
-        models_and_parameters=[
-            (OpLogisticRegression(),
-             [{"reg_param": r, "elastic_net_param": e}
-              for r in (0.0, 0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
-        ],
+        n_folds=3, seed=42, models_and_parameters=_candidates(),
         splitter=DataSplitter(reserve_test_fraction=0.1, seed=42))
     pred = label.transform_with(selector, checked)
     model = (Workflow().set_input_frame(frame)
              .set_result_features(pred).train())
     wall = time.time() - t0
+    profiler.finalize()
+
     s = model.selector_summary()
     holdout = s.holdout_evaluation.get("binary classification", {})
     auroc = float(holdout.get("au_roc", float("nan")))
+    phases = {
+        k: {"wall_s": round(p.wall_s, 3),
+            "device_s": round(p.device_s, 3), "count": p.count}
+        for k, p in metrics.phases.items()}
     print(f"# rows={n_rows} wall={wall:.1f}s platform={platform} "
           f"holdout_auROC={auroc:.4f} best={s.best_model_name}",
           file=sys.stderr)
-    return {"wall": wall, "auroc": auroc, "platform": platform}
+    if trace:
+        print(f"# phases: {json.dumps(phases)}", file=sys.stderr)
+    return {"wall": wall, "auroc": auroc, "platform": platform,
+            "best": s.best_model_name, "phases": phases,
+            "flops": flops.totals(),
+            "peak_flops": flops.peak_flops_per_s()}
 
 
 def _child_main():
@@ -129,16 +170,17 @@ def _child_main():
             pass
     _enable_compile_cache()
     rows = int(os.environ["_BENCH_CHILD_ROWS"])
-    result = run_pipeline(rows)
+    trace = os.environ.get("_BENCH_TRACE") == "1"
+    result = run_pipeline(rows, trace=trace)
     print("BENCH_CHILD_RESULT " + json.dumps(result))
 
 
 def _run_child(rows: int, extra_env: dict, label: str,
-               timeout: int | None = None) -> dict | None:
+               timeout: int | None = None, trace: bool = False) -> dict | None:
     """Run one measurement in a subprocess. Returns the result dict or
     None on any failure (never raises)."""
     env = dict(os.environ, _BENCH_CHILD="1", _BENCH_CHILD_ROWS=str(rows),
-               **extra_env)
+               **({"_BENCH_TRACE": "1"} if trace else {}), **extra_env)
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         out = subprocess.run(
@@ -152,7 +194,7 @@ def _run_child(rows: int, extra_env: dict, label: str,
     except Exception as e:
         print(f"# [{label}] failed to launch: {e}", file=sys.stderr)
         return None
-    sys.stderr.write(out.stderr[-2000:])
+    sys.stderr.write(out.stderr[-3000:])
     for line in out.stdout.splitlines():
         if line.startswith("BENCH_CHILD_RESULT "):
             try:
@@ -200,6 +242,27 @@ def _probe_backend(extra_env: dict, label: str) -> str | None:
     return None
 
 
+def _device_breakdown(accel: dict) -> dict:
+    """Assemble the artifact's device_time_breakdown from a measured child
+    result: per-phase wall/device seconds + achieved FLOP/s attribution."""
+    phases = accel.get("phases") or {}
+    fl = accel.get("flops") or {}
+    out: dict = {"phases": phases}
+    train_device = sum(p.get("device_s", 0.0) for k, p in phases.items()
+                      if k in ("CrossValidation", "ModelTraining"))
+    total_device = sum(p.get("device_s", 0.0) for p in phases.values())
+    out["total_device_s"] = round(total_device, 3)
+    out["train_device_s"] = round(train_device, 3)
+    out["train_flops_estimate"] = {k: round(v) for k, v in fl.items()}
+    if train_device > 0 and fl:
+        achieved = sum(fl.values()) / train_device
+        out["achieved_train_flops_per_s"] = round(achieved)
+        peak = accel.get("peak_flops")
+        if peak:
+            out["mfu_vs_bf16_peak"] = round(achieved / peak, 5)
+    return out
+
+
 def main():
     if os.environ.get("_BENCH_CHILD"):
         _child_main()
@@ -227,8 +290,19 @@ def main():
             break
 
     accel = None
+    curve = []
     if accel_env is not None:
-        accel = _run_child(N_ROWS, accel_env, "accel measurement")
+        accel = _run_child(N_ROWS, accel_env, "accel measurement",
+                           trace=True)
+        if accel is not None:
+            for rows in CURVE:
+                if rows == N_ROWS:
+                    continue
+                r = _run_child(rows, accel_env, f"curve {rows}")
+                if r is not None:
+                    curve.append({"rows": rows, "wall_s": round(r["wall"], 2)})
+            curve.append({"rows": N_ROWS, "wall_s": round(accel["wall"], 2)})
+            curve.sort(key=lambda c: c["rows"])
 
     fell_back = False
     if accel is None:
@@ -253,12 +327,17 @@ def main():
         accel = {**cpu, "wall": cpu["wall"] * (N_ROWS / CPU_ROWS)}
         fell_back = extrapolated = True
 
-    result = {"metric": "automl_higgs_shape_1m_wall", "value": None,
-              "unit": "s", "vs_baseline": 0.0}
+    result = {"metric": f"automl_higgs_shape_{N_ROWS // 1_000_000}m_wall",
+              "value": None, "unit": "s", "vs_baseline": 0.0}
     if accel is not None:
         result["value"] = round(accel["wall"], 2)
         result["platform"] = accel.get("platform", "unknown")
         result["holdout_auroc"] = round(accel.get("auroc", 0.0), 4)
+        result["best_model"] = accel.get("best", "")
+        result["models"] = MODELS
+        result["device_time_breakdown"] = _device_breakdown(accel)
+        if curve:
+            result["scaling_curve"] = curve
         if extrapolated:
             result["note"] = ("no full-size measurement; value extrapolated "
                               "from the small CPU baseline")
@@ -267,6 +346,9 @@ def main():
         if cpu is not None and not extrapolated:
             cpu_extrapolated = cpu["wall"] * (N_ROWS / CPU_ROWS)
             result["vs_baseline"] = round(cpu_extrapolated / accel["wall"], 3)
+            result["cpu_proxy"] = {
+                "rows": CPU_ROWS, "wall_s": round(cpu["wall"], 2),
+                "extrapolated_wall_s": round(cpu_extrapolated, 2)}
     else:
         result["note"] = "all measurements failed; see stderr diagnostics"
     print(json.dumps(result))

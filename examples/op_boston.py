@@ -1,9 +1,11 @@
 """Boston housing prices — regression helloworld flow.
 
 Parity: reference ``helloworld/.../OpBoston.scala`` — numeric housing
-features vectorized automatically, regression model selection, RMSE/R²
-evaluation. Boston-like data is synthesized with the classic columns and a
-nonlinear price signal (no network egress here).
+features (+ chas as PickList, mirroring ``BostonFeatures.scala``)
+vectorized automatically, regression model selection, RMSE/R² evaluation.
+Uses the REAL dataset shipped with the reference (``helloworld/src/main/
+resources/BostonDataset/housingData.csv``, 333 rows) when present; falls
+back to a synthesized price signal otherwise.
 
 Run: python examples/op_boston.py
 """
@@ -54,10 +56,37 @@ def boston_frame(n: int = 506, seed: int = 11) -> fr.HostFrame:
     return fr.HostFrame.from_dict(cols)
 
 
+#: the reference's copy (rowId, crim, zn, indus, chas, nox, rm, age, dis,
+#: rad, tax, ptratio, b, lstat, medv) — BostonHouse.scala field order
+BOSTON_CSV = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
+              "housingData.csv")
+BOSTON_COLUMNS = ("crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
+                  "rad", "tax", "ptratio", "b", "lstat")
+
+
+def boston_frame_real(path: str = BOSTON_CSV) -> fr.HostFrame:
+    rows = [line.strip().split(",")
+            for line in open(path) if line.strip()]
+    col = {name: [r[i + 1] for r in rows]
+           for i, name in enumerate(BOSTON_COLUMNS + ("medv",))}
+    cols = {"medv": (ft.RealNN, [float(v) for v in col["medv"]]),
+            "chas": (ft.PickList, col["chas"]),
+            "rad": (ft.Integral, [int(float(v)) for v in col["rad"]])}
+    for name in BOSTON_COLUMNS:
+        if name not in ("chas", "rad"):
+            cols[name] = (ft.Real, [float(v) for v in col[name]])
+    return fr.HostFrame.from_dict(cols)
+
+
 def main(n: int = 506) -> int:
-    frame = boston_frame(n)
+    if os.path.exists(BOSTON_CSV):
+        frame = boston_frame_real()
+        columns = BOSTON_COLUMNS
+    else:
+        frame = boston_frame(n)
+        columns = COLUMNS
     feats = FeatureBuilder.from_frame(frame, response="medv")
-    features = transmogrify([feats[c] for c in COLUMNS])
+    features = transmogrify([feats[c] for c in columns])
     selector = RegressionModelSelector.with_cross_validation(
         n_folds=3, seed=42)
     prediction = feats["medv"].transform_with(selector, features)

@@ -2,8 +2,9 @@
 
 Parity: reference ``helloworld/.../OpIris.scala`` — a text label indexed to
 class ids, automatic vectorization of the four measurements, multiclass
-model selection. Iris-like data is synthesized (three Gaussian species
-clusters in the classic four measurements; no network egress here).
+model selection. Uses the REAL UCI Iris dataset shipped with the reference
+(``helloworld/src/main/resources/IrisDataset/iris.csv``, 150 rows) when
+present; falls back to synthesized Gaussian species clusters otherwise.
 
 Run: python examples/op_iris.py
 """
@@ -44,8 +45,25 @@ def iris_frame(n: int = 450, seed: int = 7) -> fr.HostFrame:
     })
 
 
+#: the reference's copy of the classic UCI data (id, 4 measurements, label)
+IRIS_CSV = ("/root/reference/helloworld/src/main/resources/IrisDataset/"
+            "iris.csv")
+
+
+def iris_frame_real(path: str = IRIS_CSV) -> fr.HostFrame:
+    rows = [line.strip().split(",")
+            for line in open(path) if line.strip()]
+    return fr.HostFrame.from_dict({
+        "species": (ft.Text, [r[5].replace("Iris-", "") for r in rows]),
+        "sepal_length": (ft.Real, [float(r[1]) for r in rows]),
+        "sepal_width": (ft.Real, [float(r[2]) for r in rows]),
+        "petal_length": (ft.Real, [float(r[3]) for r in rows]),
+        "petal_width": (ft.Real, [float(r[4]) for r in rows]),
+    })
+
+
 def main(n: int = 450) -> int:
-    frame = iris_frame(n)
+    frame = iris_frame_real() if os.path.exists(IRIS_CSV) else iris_frame(n)
     feats = FeatureBuilder.from_frame(frame, response="species")
     label = feats["species"].index_string()
     features = transmogrify([feats[c] for c in (

@@ -2,9 +2,10 @@
 
 Parity: reference ``helloworld/.../OpTitanicSimple.scala:78-160`` — typed
 features, family-size math, automatic vectorization, sanity check, binary
-model selection, evaluation. The dataset is regenerated synthetically (same
-schema and signal structure as the Kaggle data; this environment has no
-network egress).
+model selection, evaluation. Reads the REAL reference Titanic CSV
+(``TitanicDataset/TitanicPassengersTrainData.csv``, via tests/titanic.py);
+holdout AuROC ~0.896 beats the reference's published 0.8822
+(``README.md:82-95``).
 
 Run: python examples/op_titanic.py
 """

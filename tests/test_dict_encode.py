@@ -1,0 +1,80 @@
+"""Native dictionary encoder parity (the Criteo-scale text->codes ingest
+path; parity oracle is the original Python loop pipeline_data always used).
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils.dict_encode import (
+    _native, dict_encode, dict_encode_py,
+)
+
+
+def _check(values):
+    c1, v1 = dict_encode(values)
+    c2, v2 = dict_encode_py(values)
+    assert list(v1) == list(v2)
+    np.testing.assert_array_equal(c1, c2)
+    return v1
+
+
+@pytest.mark.parametrize("n", [10, 5000, 20000])
+def test_parity_ascii(n):
+    rng = np.random.default_rng(1)
+    vals = [None if rng.uniform() < 0.1
+            else f"cat_{int(rng.integers(0, 97))}" for _ in range(n)]
+    vocab = _check(vals)
+    assert vocab == sorted(vocab)
+
+
+def test_parity_empty_string_vs_null():
+    _check((["", None, "a", "", None, "b"] * 2000))
+
+
+def test_parity_non_ascii_falls_back():
+    vals = [None if i % 7 == 0 else f"caté_{i % 13}" for i in range(9000)]
+    _check(vals)
+
+
+def test_parity_all_null_and_all_same():
+    _check([None] * 5000)
+    _check(["x"] * 5000)
+
+
+def test_parity_high_cardinality_unique():
+    # every value distinct: stresses the hash table + sorted remap
+    _check([f"v{i:06d}" for i in range(8192)])
+
+
+def test_native_path_is_active():
+    if _native() is None:
+        pytest.skip("no C++ toolchain in this environment")
+    rng = np.random.default_rng(2)
+    vals = [f"k{int(x)}" for x in rng.integers(0, 1000, 10000)]
+    codes, vocab = dict_encode(vals)
+    assert codes.dtype == np.int32 and len(vocab) == 1000
+
+
+def test_pipeline_data_uses_dict_encode():
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.pipeline_data import PipelineData
+    from transmogrifai_tpu.types import feature_types as ft
+    vals = ["b", None, "a", "b"]
+    data = PipelineData.from_host(fr.HostFrame(
+        {"c": fr.HostColumn(ft.PickList, np.array(vals, dtype=object))}))
+    col = data.device_col("c")
+    assert col.vocab == ("a", "b")
+    np.testing.assert_array_equal(np.asarray(col.codes), [1, -1, 0, 1])
+
+
+def test_criteo_bench_script_smoke(monkeypatch):
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "bench_criteo_ingest.py")
+    monkeypatch.setenv("CRITEO_ROWS", "2000")
+    spec = importlib.util.spec_from_file_location("bench_criteo", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.N_ROWS = 2000
+    assert mod.main() == 0

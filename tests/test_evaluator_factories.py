@@ -1,0 +1,111 @@
+"""Evaluator factories + custom lambda metrics + OpParams depth (parity:
+reference Evaluators.scala:44-319 constructors/custom, OpParams.scala
+readerParams/customParams)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.evaluators import CustomEvaluator, Evaluators
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.selector import ModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _pred_col(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n).astype(np.float64)
+    score = np.clip(y * 0.6 + rng.uniform(0, 0.4, n), 0, 1)
+    prob = np.stack([1 - score, score], axis=1)
+    raw = np.log(np.clip(prob, 1e-9, 1.0))
+    pred = (score >= 0.5).astype(np.float64)
+    return y, fr.PredictionColumn(jnp.asarray(pred), jnp.asarray(raw),
+                                  jnp.asarray(prob))
+
+
+def test_factory_constructors_set_default_metric():
+    assert Evaluators.BinaryClassification.au_roc().default_metric == "auROC"
+    assert Evaluators.BinaryClassification.au_pr().default_metric == "auPR"
+    assert Evaluators.BinaryClassification.f1().default_metric == "F1"
+    assert Evaluators.MultiClassification.error().default_metric == "Error"
+    assert not Evaluators.MultiClassification.error().larger_is_better()
+    assert Evaluators.Regression.r2().default_metric == "R2"
+    assert Evaluators.Regression.apply().default_metric == "RMSE"
+    assert Evaluators.BinaryClassification.brier_score(
+        ).default_metric == "BrierScore"
+
+
+def test_custom_evaluator_lambda_metric():
+    y, pred = _pred_col()
+
+    def weird_metric(y_, raw, prob, yhat):
+        # anything over the four columns: here mean |prob1 - y|
+        return float(np.mean(np.abs(prob[:, 1] - y_)))
+
+    ev = Evaluators.BinaryClassification.custom(
+        "meanAbsCalibration", larger_better=False, evaluate_fn=weird_metric)
+    m = ev.evaluate_arrays(y, pred)
+    assert m.name == "meanAbsCalibration"
+    assert 0.0 <= m.value <= 1.0
+    assert ev.metric_value(m) == m.value
+    assert not ev.larger_is_better("meanAbsCalibration")
+    assert ev.metric_from_arrays(y, pred) == m.value
+
+
+def _argmax_accuracy(y_, raw, prob, yhat):
+    return float((yhat == y_).mean())
+
+
+def test_custom_evaluator_drives_model_selector():
+    n = 300
+    rng = np.random.default_rng(4)
+    y = rng.integers(0, 2, n).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "x": (ft.Real, (rng.normal(size=n) + 1.2 * y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    import transmogrifai_tpu.dsl  # noqa: F401
+    vec = feats["x"].vectorize()
+    ev = CustomEvaluator("acc", larger_better=True,
+                         evaluate_fn=_argmax_accuracy)
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(max_iter=30),
+                           [{"reg_param": r} for r in (0.0, 0.1)])],
+        evaluators=[ev], validation_metric="acc")
+    pred = label.transform_with(sel, vec)
+    model = Workflow().set_input_frame(frame).set_result_features(pred).train()
+    s = model.selector_summary()
+    assert s.validation_metric == "acc"
+    assert all("acc" in r.metric_values for r in s.validation_results)
+    assert s.train_evaluation["acc"]["value"] > 0.7
+
+
+def test_op_params_reader_overrides(tmp_path):
+    import csv
+    p1 = tmp_path / "a.csv"
+    with open(p1, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["x", "label"])
+        for i in range(10):
+            w.writerow([i * 1.0, i % 2])
+    from transmogrifai_tpu.readers import CSVReader
+    reader = CSVReader(str(tmp_path / "missing.csv"),
+                       schema={"x": ft.Real, "label": ft.RealNN})
+    params = OpParams.from_json({
+        "readerParams": {"CSVReader": {"path": str(p1),
+                                       "customParams": {"sample": 5}}},
+        "customParams": {"team": "tpu"},
+    })
+    applied = params.apply_to_reader(reader)
+    assert reader.path == str(p1)
+    assert reader.sample == 5
+    assert any("path=" in a for a in applied)
+    # round-trips through json
+    assert OpParams.from_json(params.to_json()).custom_params == {
+        "team": "tpu"}

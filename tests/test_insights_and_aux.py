@@ -198,3 +198,60 @@ def test_profiling_metrics():
         pass
     assert m.phases["Scoring"].count == 1
     assert "Scoring" in m.pretty()
+
+
+def test_testkit_generator_breadth():
+    """Reference testkit parity: per-type generators with distributions and
+    prob-of-empty across text/geo/base64/vector/map families
+    (testkit/.../RandomData.scala + Random{Text,Real,Vector,Map}.scala)."""
+    import base64
+    import numpy as np
+    from transmogrifai_tpu.testkit import (
+        RandomGeolocation, RandomIntegral, RandomList, RandomSet,
+        RandomVector,
+    )
+    from transmogrifai_tpu.testkit.random_data import RandomMap as RM
+    from transmogrifai_tpu.testkit.random_data import RandomReal as RR
+    from transmogrifai_tpu.testkit.random_data import RandomText as RT
+
+    # distributions are seeded-deterministic
+    assert RR.exponential(seed=1).limit(3) == RR.exponential(seed=1).limit(3)
+    assert all(v >= 0 for v in RR.gamma(seed=2).limit(10))
+    assert all(0 <= v <= 100 for v in RR.percents(seed=3).limit(10))
+    assert all(v >= 0 for v in RR.currencies(seed=4).limit(10))
+    # structured text families
+    for v in RT.base64s(seed=5).limit(5):
+        base64.b64decode(v)  # must round-trip
+    assert all(u.startswith(("http://", "https://"))
+               for u in RT.urls(seed=6).limit(5))
+    assert all(len(p) == 5 and p.isdigit()
+               for p in RT.postalCodes(seed=7).limit(5))
+    assert all(len(RT.ids(seed=8).limit(5)[0]) == 12 for _ in range(1))
+    streets = RT.streets(seed=9).limit(5)
+    assert all(s.split()[0].isdigit() for s in streets)
+    texts = RT.textAreas(seed=10).limit(5)
+    assert all(5 <= len(t.split()) <= 40 for t in texts)
+    uniq = RT.uniqueTexts(seed=11).limit(50)
+    assert len(set(uniq)) == 50
+    # geolocation triples
+    for lat, lon, acc in RandomGeolocation.geolocations(seed=12).limit(10):
+        assert -90 <= lat <= 90 and -180 <= lon <= 180 and 1 <= acc <= 10
+    near = RandomGeolocation.near(37.7, -122.4, 0.1, seed=13).limit(10)
+    assert all(abs(g[0] - 37.7) < 2 for g in near)
+    # vectors
+    sp = RandomVector.sparse(100, density=0.1, seed=14).limit(3)
+    assert all((v != 0).mean() < 0.35 for v in sp)
+    assert np.all(RandomVector.ones(4, seed=15).limit(1)[0] == 1.0)
+    bv = RandomVector.binary(50, prob_one=0.3, seed=16).limit(1)[0]
+    assert set(np.unique(bv)) <= {0.0, 1.0}
+    # typed maps + datetime lists + sets
+    m = RM.ofGeolocations(["home", "work"], seed=17).limit(5)
+    assert any("home" in d for d in m)
+    dl = RandomList.ofDateTimes(1, 3, seed=18).limit(4)
+    assert all(1 <= len(x) <= 3 for x in dl)
+    s = RandomSet.of(["a", "b", "c"], seed=19).limit(5)
+    assert all(isinstance(x, set) for x in s)
+    # prob-of-empty applies across families
+    geo = RandomGeolocation.geolocations(seed=20).with_prob_of_empty(
+        0.5).limit(40)
+    assert 5 < sum(1 for g in geo if g is None) < 35

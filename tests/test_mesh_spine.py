@@ -236,7 +236,7 @@ def test_tree_histograms_row_sharded_parity(mesh8):
               gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0),
               subsample=1.0, colsample=1.0, base_score=jnp.float32(0.0),
               bootstrap=False, seed=7)
-    trees_single = train_ensemble(Xb, yj, w, **kw)
+    trees_single, _ = train_ensemble(Xb, yj, w, **kw)
 
     ctx = current_mesh()
     shard = NamedSharding(ctx.mesh, P(DATA_AXIS))
@@ -261,7 +261,7 @@ def test_tree_histograms_row_sharded_parity(mesh8):
     # the full sharded ensemble trains and matches the unsharded model's
     # quality (exact tree structure may flip on near-tie gains: the sharded
     # reduction legitimately reorders float summation)
-    trees_mesh = train_ensemble(Xb_s, y_s, w_s, **kw)
+    trees_mesh, _ = train_ensemble(Xb_s, y_s, w_s, **kw)
     from transmogrifai_tpu.models.trees import predict_ensemble
     m_single = predict_ensemble(
         Xb, trees_single, n_out=1, learning_rate=jnp.float32(0.3),
@@ -282,3 +282,43 @@ def test_tree_histograms_row_sharded_parity(mesh8):
 
     a1, a2 = auc(m_single), auc(m_mesh)
     assert a1 > 0.95 and abs(a1 - a2) < 0.02, (a1, a2)
+
+
+def test_idf_and_min_variance_mesh_parity(mesh8):
+    """OpIDF / MinVarianceFilter weight their reductions by row_mask so
+    mesh-padding rows contribute monoid identity (advisor round-2 high):
+    unmasked sums would inflate document counts and skew variances toward
+    zero-mean on non-divisible row counts."""
+    n = 203  # not divisible by 8 -> padded device rows
+    rng = np.random.default_rng(11)
+    docs = [[t for t in rng.choice(["a", "b", "c", "d"],
+                                   rng.integers(0, 4)).tolist()]
+            for _ in range(n)]
+    frame = fr.HostFrame.from_dict({"toks": (ft.TextList, docs)})
+
+    def run():
+        import transmogrifai_tpu.dsl  # noqa: F401
+        feats = FeatureBuilder.from_frame(frame)
+        f = feats["toks"].tf(num_features=16).idf(min_doc_freq=2)
+        filt = f.filter_min_variance(1e-6)
+        data = PipelineData.from_host(frame)
+        out, fitted = DagExecutor().fit_transform(
+            data, compute_dag([f, filt]))
+        idf_model = [t for layer in fitted for t in layer
+                     if type(t).__name__ == "IDFModel"][0]
+        mv_model = [t for layer in fitted for t in layer
+                    if type(t).__name__ == "MinVarianceFilterModel"][0]
+        return (np.asarray(idf_model.idf), list(mv_model.keep_indices),
+                np.asarray(out.host_col(filt.name).values))
+
+    idf_m, keep_m, vals_m = run()
+    from transmogrifai_tpu.parallel.mesh import _current
+    token = _current.set(None)
+    try:
+        idf_s, keep_s, vals_s = run()
+    finally:
+        _current.reset(token)
+    assert np.allclose(idf_m, idf_s, atol=1e-5), "IDF skewed by padding rows"
+    assert keep_m == keep_s
+    assert vals_m.shape == vals_s.shape
+    assert np.allclose(vals_m, vals_s, atol=1e-5)

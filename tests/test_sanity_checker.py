@@ -182,3 +182,80 @@ def test_workflow_with_rff_rewires_dag():
     assert model.blocklisted == ["mostly_null"]
     scores = model.score(frame.drop(["mostly_null"]))
     assert scores.n_rows == n
+
+
+def test_raw_feature_filter_per_key_map_blocklist():
+    """Reference RawFeatureFilter.scala:90-636 per-key map exclusions: a
+    single bad key is excluded from the map vectorizer without killing the
+    whole map feature, and the exclusion reaches summary + ModelInsights."""
+    n = 200
+    rng = np.random.default_rng(5)
+    y = rng.integers(0, 2, n).astype(float)
+
+    def row(i):
+        m = {"good": float(rng.normal() + y[i])}
+        if i == 0:
+            m["mostly_absent"] = 1.0   # fill rate 1/200 < min_fill
+        return m
+
+    frame = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, [row(i) for i in range(n)]),
+        "num": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1)
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(max_iter=20), [{}])],
+        evaluators=[OpBinaryClassificationEvaluator()])
+    pred = label.transform_with(sel, vec)
+    model = (Workflow()
+             .set_input_frame(frame)
+             .set_result_features(pred)
+             .with_raw_feature_filter(RawFeatureFilter(min_fill=0.05))
+             .train())
+    # the map feature survives; only the bad key is excluded
+    assert "m" not in model.blocklisted
+    res = model.raw_filter_results
+    assert res.map_key_blocklist == {"m": ["mostly_absent"]}
+    assert any("fill rate" in r
+               for r in res.map_key_exclusion_reasons["m"]["mostly_absent"])
+    # the fitted map vectorizer expanded only the good key
+    keyed = [t for t in model.stages()
+             if type(t).__name__ == "_NumericMapModel"]
+    assert keyed and keyed[0].keys == [["good"]]
+    # surfaced in the summary JSON and in ModelInsights
+    sj = model.summary_json()
+    assert sj["rawFeatureFilterResults"]["mapKeyExclusionReasons"][
+        "m"]["mostly_absent"]
+    mi = model.model_insights().to_json()
+    m_ins = [f for f in mi["features"] if f["featureName"] == "m"][0]
+    assert any("mostly_absent" in r for r in m_ins["exclusionReasons"])
+    # scoring still works on the filtered map
+    scores = model.score(frame)
+    assert scores.n_rows == n
+
+
+def test_raw_feature_filter_all_keys_dead_drops_feature():
+    n = 100
+    rng = np.random.default_rng(6)
+    y = rng.integers(0, 2, n).astype(float)
+    # the map itself is always filled (whole-feature fill rate 1.0), but
+    # every individual key is sparse -> per-key pass kills them all, and an
+    # all-keys-dead map dies as a feature
+    maps = [{f"k{i % 3}": float(i)} for i in range(n)]
+    frame = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, maps),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = [FeatureBuilder.RealMap("m").as_predictor(),
+             FeatureBuilder.RealNN("label").as_response()]
+    rff = RawFeatureFilter(min_fill=0.5)
+    filtered, blocklist = rff.filter_frame(frame, feats)
+    assert blocklist == ["m"]
+    assert any("every map key excluded" in r
+               for r in rff.results.exclusion_reasons["m"])

@@ -75,9 +75,9 @@ _SPECIAL = {
     "ExternalEstimatorWrapper": "external fn import — test_resume_and_external",
     "ExternalTransformerWrapper": "external fn import — test_resume_and_external",
     "DescalerTransformer": "needs paired scaler chain — test_text_and_maps",
-    "ExistsTransformer": "lambda predicate, non-serializable — "
+    "ExistsTransformer": "needs an importable predicate arg — "
                          "test_vector_and_generic_ops",
-    "FilterValueTransformer": "lambda predicate, non-serializable — "
+    "FilterValueTransformer": "needs an importable predicate arg — "
                               "test_vector_and_generic_ops",
 }
 

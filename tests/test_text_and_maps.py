@@ -95,6 +95,45 @@ def test_smart_text_name_detection():
     assert model.treatments[0]["kind"] == "sensitive"
     assert model.sensitive_features() == ["who"]
     assert data.host_col(out.name).values.shape[1] == 0
+    # the removal is RECORDED, not silent (reference
+    # SensitiveFeatureInformation -> ModelInsights)
+    info = model.sensitive_info()
+    assert info["who"]["detected"] is True
+    assert info["who"]["probName"] == 1.0
+    assert info["who"]["action"] == "removedFromVector"
+
+
+def test_smart_text_sensitive_reaches_model_insights():
+    n = 40
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 2, n).astype(float)
+    names = ["john smith", "mary jones", "robert brown", "linda white"] * 10
+    host = fr.HostFrame.from_dict({
+        "who": (ft.Text, names),
+        "num": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    label = feats.pop("label")
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    from transmogrifai_tpu.ops.vectorizers.numeric import RealVectorizer
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+    txt = feats["who"].transform_with(
+        SmartTextVectorizer(detect_names=True, min_support=1))
+    num = feats["num"].transform_with(RealVectorizer())
+    vec = txt.transform_with(VectorsCombiner(), num)
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(max_iter=20), [{}])],
+        evaluators=[OpBinaryClassificationEvaluator()])
+    pred = label.transform_with(sel, vec)
+    from transmogrifai_tpu.workflow import Workflow
+    model = (Workflow().set_input_frame(host)
+             .set_result_features(pred).train())
+    mi = model.model_insights().to_json()
+    assert mi["sensitiveFeatures"]["who"]["detected"] is True
+    assert mi["sensitiveFeatures"]["who"]["action"] == "removedFromVector"
 
 
 def test_real_map_vectorizer():

@@ -215,11 +215,17 @@ def test_to_multi_pick_list():
 
 
 def test_regex_tokenizer():
-    t = RegexTokenizer(pattern=r"[a-z]+")
-    assert t.transform_row("Ab1 cd-EF") == ["ab", "cd", "ef"]
+    # group=-1 (default) SPLITS on the pattern, Lucene PatternTokenizer
+    # semantics: tokenizeRegex(pattern="\\s+") yields the words
+    t = RegexTokenizer(pattern=r"\s+")
+    assert t.transform_row("Ab1  cd-EF") == ["ab1", "cd-ef"]
+    assert RegexTokenizer().transform_row("Ab1 cd-EF") == ["ab1", "cd", "ef"]
+    # group >= 0 takes that capture group of each match (0 = whole match)
+    t0 = RegexTokenizer(pattern=r"[a-z]+", group=0)
+    assert t0.transform_row("Ab1 cd-EF") == ["ab", "cd", "ef"]
     t2 = RegexTokenizer(pattern=r"(\d+)-(\d+)", group=2, lowercase=False)
     assert t2.transform_row("10-20 30-40") == ["20", "40"]
-    t3 = RegexTokenizer(pattern=r"[a-z]+", min_token_length=3)
+    t3 = RegexTokenizer(pattern=r"[a-z]+", group=0, min_token_length=3)
     assert t3.transform_row("ab abc abcd") == ["abc", "abcd"]
     assert t.transform_row(None) == []
 
@@ -242,3 +248,56 @@ def test_set_jaccard_similarity():
     assert j.transform_row(set(), set()) == 1.0
     assert j.transform_row({"a"}, set()) == 0.0
     assert j.transform_row(None, None) == 1.0
+
+
+# module-level predicates: serializable via the mod:qualname scheme
+def _gt2(v):
+    return v is not None and v > 2
+
+
+def _is_keep(v):
+    return v == "keep"
+
+
+def test_exists_filter_serialize_round_trip():
+    ex = ExistsTransformer(predicate=_gt2)
+    cfg = ex.config()
+    assert cfg["predicate"].endswith(":_gt2")
+    ex2 = ExistsTransformer(**cfg)
+    assert ex2.transform_row(3.0) is True
+    assert ex2.transform_row(1.0) is False
+
+    flt = FilterValueTransformer(predicate=_is_keep, default="fb")
+    flt2 = FilterValueTransformer(**flt.config())
+    assert flt2.transform_row("keep") == "keep"
+    assert flt2.transform_row("x") == "fb"
+
+    # lambdas still refuse to serialize (reference: stable classes only)
+    with pytest.raises(ValueError):
+        ExistsTransformer(predicate=lambda v: True).config()
+
+
+def test_drop_indices_without_metadata_or_resolution_raises():
+    t = DropIndicesByTransformer()
+    with pytest.raises(RuntimeError):
+        t.transform_row(np.ones(4, dtype=np.float32))
+
+
+def test_min_variance_sample_variance_boundary():
+    # sample variance (1/(n-1)) with a strict > keep: a column whose sample
+    # variance equals the threshold exactly must DROP (reference drops on
+    # variance <= minVariance)
+    # values chosen so mean/ssq/variance are all exact in float32:
+    # mean=1, ssq=12, sample var = 12/3 = 4.0 (population var would be 3.0)
+    vals = [0.0, 0.0, 0.0, 4.0]
+    host = fr.HostFrame.from_dict({
+        "edge": (ft.RealNN, vals),
+        "wide": (ft.RealNN, [0.0, 16.0, -16.0, 8.0]),
+    })
+    feats = FeatureBuilder.from_frame(host)
+    vec = feats["wide"].vectorize(feats["edge"])
+    filtered = vec.filter_min_variance(4.0)
+    out, _ = _run(host, filtered)
+    vals_out, meta = _vec(out, filtered)
+    kept = {p for c in meta.columns for p in c.parent_feature}
+    assert "wide" in kept and "edge" not in kept

@@ -12,6 +12,7 @@ from transmogrifai_tpu.evaluators.extras import (
     BinaryClassificationBinMetrics, ForecastMetrics, OpBinScoreEvaluator,
     OpForecastEvaluator, OPLogLoss, SingleMetric,
 )
+from transmogrifai_tpu.evaluators.factories import CustomEvaluator, Evaluators
 
 __all__ = [
     "EvaluatorBase",
@@ -21,4 +22,5 @@ __all__ = [
     "ForecastMetrics", "OpForecastEvaluator",
     "BinaryClassificationBinMetrics", "OpBinScoreEvaluator",
     "SingleMetric", "OPLogLoss",
+    "CustomEvaluator", "Evaluators",
 ]

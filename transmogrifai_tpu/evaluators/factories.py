@@ -1,0 +1,163 @@
+"""Evaluator factories incl. arbitrary custom-metric evaluators.
+
+Parity: reference ``core/.../evaluators/Evaluators.scala:44-319`` — the
+``Evaluators.BinaryClassification.auROC()`` family of constructors plus
+``.custom(metricName, largerBetter, evaluateFn)`` building an evaluator
+around an arbitrary user lambda over (label, rawPrediction, probability,
+prediction).
+
+TPU-first: the custom ``evaluate_fn`` receives host numpy views
+``(y, raw, prob, pred)`` pulled once per evaluation — custom metrics are
+host-side by contract (they're user lambdas, not jittable), while the
+built-in evaluators stay on-device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from transmogrifai_tpu.evaluators.base import EvaluatorBase
+from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.evaluators.extras import (
+    OpBinScoreEvaluator, OPLogLoss, SingleMetric,
+)
+from transmogrifai_tpu.evaluators.multi import OpMultiClassificationEvaluator
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+
+__all__ = ["Evaluators", "CustomEvaluator"]
+
+
+class CustomEvaluator(EvaluatorBase):
+    """Evaluator around a user metric function (reference
+    ``Evaluators.*.custom``). ``evaluate_fn(y, raw, prob, pred) -> float``
+    over numpy arrays: y [n], raw [n, k], prob [n, k], pred [n]."""
+
+    def __init__(self, metric_name: str, larger_better: bool = True,
+                 evaluate_fn: Optional[Callable] = None,
+                 name: Optional[str] = None):
+        if evaluate_fn is None:
+            raise ValueError("CustomEvaluator needs an evaluate_fn")
+        self.name = name or metric_name
+        self.default_metric = metric_name
+        self.metric_directions = {metric_name: bool(larger_better)}
+        self.evaluate_fn = evaluate_fn
+
+    def evaluate_arrays(self, y, pred_col, w=None) -> SingleMetric:
+        y = np.asarray(y, np.float64)
+        raw = np.asarray(pred_col.raw_prediction, np.float64)
+        prob = np.asarray(pred_col.probability, np.float64)
+        pred = np.asarray(pred_col.prediction, np.float64)
+        n = y.shape[0]
+        return SingleMetric(self.default_metric,
+                            float(self.evaluate_fn(y, raw[:n], prob[:n],
+                                                   pred[:n])))
+
+    def metric_value(self, metrics: SingleMetric, metric=None) -> float:
+        return float(metrics.value)
+
+
+def _with_default(evaluator, metric: str):
+    evaluator.default_metric = metric
+    return evaluator
+
+
+class Evaluators:
+    """Factory namespace (reference ``Evaluators.scala``)."""
+
+    class BinaryClassification:
+        @staticmethod
+        def apply() -> OpBinaryClassificationEvaluator:
+            return Evaluators.BinaryClassification.au_roc()
+
+        @staticmethod
+        def au_roc() -> OpBinaryClassificationEvaluator:
+            return _with_default(OpBinaryClassificationEvaluator(), "auROC")
+
+        @staticmethod
+        def au_pr() -> OpBinaryClassificationEvaluator:
+            return _with_default(OpBinaryClassificationEvaluator(), "auPR")
+
+        @staticmethod
+        def precision() -> OpBinaryClassificationEvaluator:
+            return _with_default(OpBinaryClassificationEvaluator(),
+                                 "Precision")
+
+        @staticmethod
+        def recall() -> OpBinaryClassificationEvaluator:
+            return _with_default(OpBinaryClassificationEvaluator(), "Recall")
+
+        @staticmethod
+        def f1() -> OpBinaryClassificationEvaluator:
+            return _with_default(OpBinaryClassificationEvaluator(), "F1")
+
+        @staticmethod
+        def error() -> OpBinaryClassificationEvaluator:
+            return _with_default(OpBinaryClassificationEvaluator(), "Error")
+
+        @staticmethod
+        def brier_score() -> OpBinScoreEvaluator:
+            return OpBinScoreEvaluator()
+
+        @staticmethod
+        def log_loss() -> OPLogLoss:
+            return OPLogLoss()
+
+        @staticmethod
+        def custom(metric_name: str, larger_better: bool = True,
+                   evaluate_fn: Optional[Callable] = None) -> CustomEvaluator:
+            return CustomEvaluator(metric_name, larger_better, evaluate_fn)
+
+    class MultiClassification:
+        @staticmethod
+        def apply() -> OpMultiClassificationEvaluator:
+            return Evaluators.MultiClassification.f1()
+
+        @staticmethod
+        def precision() -> OpMultiClassificationEvaluator:
+            return _with_default(OpMultiClassificationEvaluator(),
+                                 "Precision")
+
+        @staticmethod
+        def recall() -> OpMultiClassificationEvaluator:
+            return _with_default(OpMultiClassificationEvaluator(), "Recall")
+
+        @staticmethod
+        def f1() -> OpMultiClassificationEvaluator:
+            return _with_default(OpMultiClassificationEvaluator(), "F1")
+
+        @staticmethod
+        def error() -> OpMultiClassificationEvaluator:
+            return _with_default(OpMultiClassificationEvaluator(), "Error")
+
+        @staticmethod
+        def custom(metric_name: str, larger_better: bool = True,
+                   evaluate_fn: Optional[Callable] = None) -> CustomEvaluator:
+            return CustomEvaluator(metric_name, larger_better, evaluate_fn)
+
+    class Regression:
+        @staticmethod
+        def apply() -> OpRegressionEvaluator:
+            return Evaluators.Regression.rmse()
+
+        @staticmethod
+        def rmse() -> OpRegressionEvaluator:
+            return _with_default(OpRegressionEvaluator(), "RMSE")
+
+        @staticmethod
+        def mse() -> OpRegressionEvaluator:
+            return _with_default(OpRegressionEvaluator(), "MSE")
+
+        @staticmethod
+        def mae() -> OpRegressionEvaluator:
+            return _with_default(OpRegressionEvaluator(), "MAE")
+
+        @staticmethod
+        def r2() -> OpRegressionEvaluator:
+            return _with_default(OpRegressionEvaluator(), "R2")
+
+        @staticmethod
+        def custom(metric_name: str, larger_better: bool = True,
+                   evaluate_fn: Optional[Callable] = None) -> CustomEvaluator:
+            return CustomEvaluator(metric_name, larger_better, evaluate_fn)

@@ -25,7 +25,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from transmogrifai_tpu.frame import HostColumn, HostFrame, NUMERIC_KINDS, TEXT_KINDS
+from transmogrifai_tpu.frame import (
+    HostColumn, HostFrame, MAP_KINDS, NUMERIC_KINDS, TEXT_KINDS,
+)
 from transmogrifai_tpu.ops.vectorizers.hashing import (
     _native, encode_ascii_rows, hash_token, tokenize,
 )
@@ -84,16 +86,59 @@ class RawFeatureFilterResults:
     exclusion_reasons: dict = field(default_factory=dict)  # name -> [reasons]
     train_distributions: dict = field(default_factory=dict)
     score_distributions: dict = field(default_factory=dict)
+    #: per-key exclusions for map features (reference RawFeatureFilter's
+    #: per-key map blocklist, ``RawFeatureFilter.scala:90-636``):
+    #: feature name -> {key -> [reasons]}
+    map_key_exclusion_reasons: dict = field(default_factory=dict)
+
+    @property
+    def map_key_blocklist(self) -> dict:
+        """feature name -> sorted excluded keys (consumed by the workflow's
+        map-vectorizer rewiring, the ``setBlocklist`` analog)."""
+        return {name: sorted(keys)
+                for name, keys in self.map_key_exclusion_reasons.items()
+                if keys}
 
     def to_json(self) -> dict:
         return {
             "exclusionReasons": {k: list(v)
                                  for k, v in self.exclusion_reasons.items()},
+            "mapKeyExclusionReasons": {
+                name: {k: list(v) for k, v in keys.items()}
+                for name, keys in self.map_key_exclusion_reasons.items()},
             "trainFillRates": {k: d.fill_rate
                                for k, d in self.train_distributions.items()},
             "scoreFillRates": {k: d.fill_rate
                                for k, d in self.score_distributions.items()},
         }
+
+
+def _numeric_hist(vals: np.ndarray, bins: int,
+                  rng_minmax: Optional[tuple[float, float]]
+                  ) -> tuple[np.ndarray, dict]:
+    """Shared numeric binning (whole-feature AND per-map-key paths): clip so
+    out-of-range scoring mass lands in the edge bins instead of silently
+    vanishing (it IS the distribution shift)."""
+    lo, hi = rng_minmax if rng_minmax else (
+        (float(vals.min()), float(vals.max())) if vals.size else (0.0, 1.0))
+    if hi <= lo:
+        hi = lo + 1.0
+    hist, _ = np.histogram(np.clip(vals, lo, hi), bins=bins, range=(lo, hi))
+    return hist.astype(float), {"min": lo, "max": hi,
+                                "mean": float(vals.mean())
+                                if vals.size else 0.0}
+
+
+def _token_hist(values, bins: int) -> np.ndarray:
+    """Shared hashed-token histogram for text-ish values (lists tokenize
+    element-wise; scalars through the shared tokenizer)."""
+    hist = np.zeros(bins, dtype=float)
+    for v in values:
+        toks = (list(v) if isinstance(v, (list, set, tuple))
+                else tokenize(str(v)))
+        for t in toks:
+            hist[hash_token(str(t), bins)] += 1.0
+    return hist
 
 
 def _distribution(col: HostColumn, name: str, bins: int,
@@ -109,18 +154,8 @@ def _distribution(col: HostColumn, name: str, bins: int,
             hist = np.asarray([(vals == 0).sum(), (vals == 1).sum()], float)
             summary = {"min": 0.0, "max": 1.0}
         else:
-            lo, hi = rng_minmax if rng_minmax else (
-                (float(vals.min()), float(vals.max())) if vals.size
-                else (0.0, 1.0))
-            if hi <= lo:
-                hi = lo + 1.0
-            # clip so out-of-range scoring mass lands in the edge bins
-            # instead of silently vanishing (it IS the distribution shift)
-            hist, _ = np.histogram(np.clip(vals, lo, hi), bins=bins,
-                                   range=(lo, hi))
-            summary = {"min": lo, "max": hi,
-                       "mean": float(vals.mean()) if vals.size else 0.0}
-        return FeatureDistribution(name, n, nulls, hist.astype(float), summary)
+            hist, summary = _numeric_hist(vals, bins, rng_minmax)
+        return FeatureDistribution(name, n, nulls, hist, summary)
     if kind in TEXT_KINDS or kind == "textlist":
         # hot path: one native C pass tokenizes + CRC-hashes the whole
         # column into the corpus histogram (the reference's map-reduce text
@@ -130,16 +165,10 @@ def _distribution(col: HostColumn, name: str, bins: int,
         if native is not None:
             hist, nulls = native
             return FeatureDistribution(name, n, nulls, hist, {})
-        hist = np.zeros(bins, dtype=float)
-        nulls = 0
-        for v in col.values:
-            if v is None or (isinstance(v, list) and not v):
-                nulls += 1
-                continue
-            toks = v if isinstance(v, list) else tokenize(str(v))
-            for t in toks:
-                hist[hash_token(t, bins)] += 1.0
-        return FeatureDistribution(name, n, nulls, hist, {})
+        present = [v for v in col.values
+                   if not (v is None or (isinstance(v, list) and not v))]
+        hist = _token_hist(present, bins)
+        return FeatureDistribution(name, n, n - len(present), hist, {})
     # everything else: fill-rate-only distribution
     nulls = 0
     for i in range(n):
@@ -147,6 +176,42 @@ def _distribution(col: HostColumn, name: str, bins: int,
         if v is None or (hasattr(v, "__len__") and len(v) == 0):
             nulls += 1
     return FeatureDistribution(name, n, nulls, np.zeros(1), {})
+
+
+_NUMERIC_MAP_KINDS = frozenset({
+    "map_real", "map_currency", "map_percent", "map_integral",
+    "map_date", "map_datetime"})
+
+
+def _map_key_distributions(col: HostColumn, bins: int,
+                           rng_of: Optional[dict] = None
+                           ) -> dict[str, FeatureDistribution]:
+    """Per-key FeatureDistributions of a map column (reference
+    ``PreparedFeatures.scala`` key-expansion: each key is scored like a
+    scalar feature — count is the ROW count, a row missing the key counts
+    as null for that key)."""
+    n = len(col)
+    kind = col.kind
+    per_key: dict[str, list] = {}
+    for m in col.values:
+        for k, v in (m or {}).items():
+            if v is not None:
+                per_key.setdefault(str(k), []).append(v)
+    out: dict[str, FeatureDistribution] = {}
+    for k, vals in per_key.items():
+        nulls = n - len(vals)
+        if kind in _NUMERIC_MAP_KINDS:
+            arr = np.asarray([float(v) for v in vals], dtype=float)
+            hist, summary = _numeric_hist(arr, bins, (rng_of or {}).get(k))
+            out[k] = FeatureDistribution(k, n, nulls, hist, summary)
+        elif kind == "map_binary":
+            arr = np.asarray([bool(v) for v in vals])
+            hist = np.asarray([(~arr).sum(), arr.sum()], float)
+            out[k] = FeatureDistribution(k, n, nulls, hist, {})
+        else:  # text-ish values: hashed token histogram
+            out[k] = FeatureDistribution(k, n, nulls,
+                                         _token_hist(vals, bins), {})
+    return out
 
 
 class RawFeatureFilter:
@@ -171,6 +236,10 @@ class RawFeatureFilter:
 
     def filter_frame(self, frame: HostFrame, raw_features
                      ) -> tuple[HostFrame, list[str]]:
+        # fresh results per run: stale per-key exclusions from a previous
+        # train must not leak into (and permanently blocklist keys of) a
+        # retrain on refreshed data
+        self.results = RawFeatureFilterResults()
         reasons: dict[str, list[str]] = {}
         responses = {f.name for f in raw_features if f.is_response}
         y = None
@@ -227,7 +296,78 @@ class RawFeatureFilter:
                                f"{self.max_js_divergence}")
             if why:
                 reasons[name] = why
+            elif col.kind in MAP_KINDS:
+                # per-key pass (reference RawFeatureFilter.scala:90-636
+                # per-key map exclusions): each key is checked like a scalar
+                # feature; failing keys go to the map-key blocklist the
+                # workflow feeds into the map vectorizers, so one bad key
+                # doesn't kill the whole map
+                key_reasons = self._check_map_keys(
+                    col, score_frame[name]
+                    if score_frame is not None and name in score_frame
+                    else None, y)
+                if key_reasons:
+                    seen_keys = {str(k) for m in col.values
+                                 for k in (m or {})}
+                    if seen_keys and set(key_reasons) >= seen_keys:
+                        reasons[name] = [
+                            "every map key excluded: "
+                            + "; ".join(f"{k}: {v[0]}"
+                                        for k, v in key_reasons.items())]
+                    else:
+                        self.results.map_key_exclusion_reasons[name] = \
+                            key_reasons
 
         self.results.exclusion_reasons = reasons
         blocklist = sorted(reasons)
         return frame.drop(blocklist), blocklist
+
+    def _check_map_keys(self, col: HostColumn,
+                        score_col: Optional[HostColumn], y) -> dict:
+        """{key: [reasons]} for one map column (train vs optional scoring)."""
+        train = _map_key_distributions(col, self.bins)
+        rng_of = {k: (d.summary["min"], d.summary["max"])
+                  for k, d in train.items() if "min" in d.summary}
+        score = (_map_key_distributions(score_col, self.bins, rng_of)
+                 if score_col is not None else {})
+        # ONE row pass builds every key's absence indicator (a per-key
+        # re-scan would be O(keys x rows) interpreter work)
+        absent_of: dict[str, np.ndarray] = {}
+        if y is not None and float(np.std(y)) > 0:
+            n = len(col)
+            absent_of = {k: np.ones(n, dtype=np.float64) for k in train}
+            for r, m in enumerate(col.values):
+                for k, v in (m or {}).items():
+                    if v is not None and k in absent_of:
+                        absent_of[k][r] = 0.0
+        out: dict[str, list[str]] = {}
+        for k, td in train.items():
+            why: list[str] = []
+            if td.fill_rate < self.min_fill:
+                why.append(f"training fill rate {td.fill_rate:.4f} < "
+                           f"{self.min_fill}")
+            if k in absent_of and 0 < td.nulls < td.count:
+                c = abs(float(np.corrcoef(absent_of[k], y)[0, 1]))
+                if c > self.max_correlation_null_label:
+                    why.append(f"null-indicator label correlation {c:.3f} > "
+                               f"{self.max_correlation_null_label}")
+            sd = score.get(k)
+            if score_col is not None:
+                ft_ = td.fill_rate
+                fs = sd.fill_rate if sd is not None else 0.0
+                if abs(ft_ - fs) > self.max_fill_difference:
+                    why.append(f"fill difference |{ft_:.3f}-{fs:.3f}| > "
+                               f"{self.max_fill_difference}")
+                ratio = (max(ft_, fs) / min(ft_, fs)) if min(ft_, fs) > 0 \
+                    else float("inf")
+                if ratio > self.max_fill_ratio_diff:
+                    why.append(f"fill ratio {ratio:.2f} > "
+                               f"{self.max_fill_ratio_diff}")
+                if sd is not None and td.distribution.size > 1:
+                    js = td.js_divergence(sd)
+                    if js > self.max_js_divergence:
+                        why.append(f"JS divergence {js:.3f} > "
+                                   f"{self.max_js_divergence}")
+            if why:
+                out[k] = why
+        return out

@@ -81,6 +81,7 @@ class ModelInsights:
         label_f = model._label_feature(pred_f)
 
         from transmogrifai_tpu.ops.names import HumanNameDetectorModel
+        from transmogrifai_tpu.ops.smart_text import SmartTextModel
 
         selected: Optional[SelectedModel] = None
         sanity: Optional[DropIndicesModel] = None
@@ -98,6 +99,10 @@ class ModelInsights:
                     "genderResultsByStrategy":
                         info.get("genderResultsByStrategy", {}),
                 }
+            if isinstance(t, SmartTextModel):
+                # columns the smart vectorizer silently removed as
+                # name/sensitive — the removal must reach the report
+                sensitive.update(t.sensitive_info())
 
         problem = "unknown"
         summary_json = None
@@ -168,10 +173,22 @@ class ModelInsights:
                         per_feature[parent].derived.append(d)
 
         rff = None
-        # dropped-at-ingest features
+        res = getattr(model, "raw_filter_results", None)
+        if res is not None:
+            rff = res.to_json()
+        # dropped-at-ingest features, with the filter's actual reasons
         for name in model.blocklisted:
             per_feature.setdefault(name, FeatureInsights(name, "unknown"))
-            per_feature[name].exclusion_reasons.append("RawFeatureFilter")
+            why = (res.exclusion_reasons.get(name)
+                   if res is not None else None) or ["RawFeatureFilter"]
+            per_feature[name].exclusion_reasons.extend(why)
+        # per-key map exclusions attach to their (surviving) map feature
+        if res is not None:
+            for name, keys in res.map_key_exclusion_reasons.items():
+                per_feature.setdefault(name, FeatureInsights(name, "unknown"))
+                per_feature[name].exclusion_reasons.extend(
+                    f"map key {k!r}: {r}"
+                    for k, rs in sorted(keys.items()) for r in rs)
 
         label_summary = {"name": label_f.name}
         if getattr(model, "label_distribution", None):

@@ -174,12 +174,17 @@ def _run_grid(X, y, w, grid: Sequence[dict], defaults: dict, kw: dict):
     """Train the whole grid as one stacked-axis vmapped program. Static
     config (max_iter etc.) must agree across the grid; the regularization
     scalars are the batched axes."""
+    from transmogrifai_tpu.utils import flops
     rp = jnp.asarray([float({**defaults, **g}["reg_param"]) for g in grid],
                      jnp.float32)
     en = jnp.asarray([float({**defaults, **g}["elastic_net_param"]) for g in grid],
                      jnp.float32)
     rp, en = _shard_candidates(rp, en)
     f = jax.vmap(lambda r, e: _train_linear(X, y, w, r, e, **kw))
+    n, d = X.shape
+    C = kw["n_classes"] if kw["loss_kind"] == "softmax" else 1
+    # per Adam step: forward z = X@W (2ndC) + backward grads (~4ndC)
+    flops.add("linear", len(grid) * kw["max_iter"] * 6.0 * n * d * C)
     return f(rp, en)
 
 
@@ -410,6 +415,13 @@ class OpLogisticRegression(_LinearPredictor):
             rp, = _shard_candidates(rp)
             Ws, bs, _ = jax.vmap(lambda r: _train_logistic_newton(
                 X, y, w, r, fit_intercept=fit_b, standardize=std_b))(rp)
+            from transmogrifai_tpu.utils import flops
+            n, d = X.shape
+            # per Newton step: z/grad matvecs 4n(d+1) + Hessian build
+            # 2n(d+1)^2 + dense solve (2/3)(d+1)^3
+            flops.add("linear", len(idxs) * 15 * (
+                4.0 * n * (d + 1) + 2.0 * n * (d + 1) ** 2
+                + (2.0 / 3.0) * (d + 1) ** 3))
             for j, i in enumerate(idxs):
                 models[i] = self._make_model(Ws[j], bs[j])
         if adam_idx:

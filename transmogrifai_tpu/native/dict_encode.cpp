@@ -1,0 +1,85 @@
+// Native dictionary encoder for text -> codes column ingest.
+//
+// PipelineData dictionary-encodes categorical text columns on first device
+// use; the Python path (sorted-vocab build + per-row dict lookups) crawls
+// on Criteo-scale categorical columns. This is the host-side hot loop as
+// one C pass: open-addressing FNV-1a hash over the row byte-slices,
+// assigning first-seen ids and remembering one representative row per
+// unique value. Python then sorts the (few) unique values and remaps the
+// codes vectorized — the heavy O(n) work never touches the interpreter.
+//
+// Parity contract: codes must equal the Python `sorted(vocab).index(v)`
+// encoding exactly (pipeline_data._encode_text); the Python caller does the
+// sort + remap, so this file only needs first-seen ids to be consistent.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint64_t fnv1a(const char* p, int64_t len) {
+    uint64_t h = 1469598103934665603ull;
+    for (int64_t i = 0; i < len; ++i) {
+        h ^= (unsigned char)p[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode n fixed-width rows (buf is an [n, width] zero-padded bytes matrix
+// — numpy's 'S{width}' layout, so the caller builds it with ONE vectorized
+// astype, no per-row Python; nulls[r] != 0 marks missing -> code -1).
+// Writes first-seen-id codes to codes_out and the representative row of
+// each unique id to rep_rows_out (capacity max_uniques). Returns the
+// number of uniques, or -1 when max_uniques would be exceeded (caller
+// falls back to the sort path).
+int64_t dict_encode(const char* buf, int64_t width,
+                    const unsigned char* nulls, int64_t n,
+                    int32_t* codes_out, int64_t* rep_rows_out,
+                    int64_t max_uniques) {
+    // open addressing, power-of-two table >= 2*max_uniques
+    int64_t cap = 16;
+    while (cap < max_uniques * 2) cap <<= 1;
+    int64_t* table = new int64_t[cap];  // unique id + 1; 0 = empty
+    std::memset(table, 0, sizeof(int64_t) * cap);
+    const uint64_t mask = (uint64_t)cap - 1;
+
+    int64_t n_unique = 0;
+    for (int64_t r = 0; r < n; ++r) {
+        if (nulls[r]) {
+            codes_out[r] = -1;
+            continue;
+        }
+        const char* p = buf + r * width;
+        uint64_t slot = fnv1a(p, width) & mask;
+        for (;;) {
+            int64_t entry = table[slot];
+            if (entry == 0) {  // new value
+                if (n_unique >= max_uniques) {
+                    delete[] table;
+                    return -1;
+                }
+                rep_rows_out[n_unique] = r;
+                table[slot] = n_unique + 1;
+                codes_out[r] = (int32_t)n_unique;
+                ++n_unique;
+                break;
+            }
+            const int64_t id = entry - 1;
+            if (std::memcmp(buf + rep_rows_out[id] * width, p,
+                            (size_t)width) == 0) {
+                codes_out[r] = (int32_t)id;
+                break;
+            }
+            slot = (slot + 1) & mask;
+        }
+    }
+    delete[] table;
+    return n_unique;
+}
+
+}  // extern "C"

@@ -190,37 +190,45 @@ class ToOccurTransformer(HostTransformer):
 class ExistsTransformer(HostTransformer):
     """Any feature -> Binary via predicate (reference RichFeature ``exists``).
 
-    The predicate must be a module-level importable function for
-    serialization (same contract as LambdaTransformer); it sees the plain
-    python value (None = missing).
+    A module-level importable predicate serializes via the ``mod:qualname``
+    scheme (same contract as the external wrappers); a closure/lambda works
+    in-memory but raises on save. It sees the plain python value
+    (None = missing).
     """
 
     in_types = (ft.FeatureType,)
     out_type = ft.Binary
 
     def __init__(self, predicate=None, uid: Optional[str] = None):
-        self.predicate = predicate
+        from transmogrifai_tpu.stages.external import _fn_from_path
+        self.predicate = (_fn_from_path(predicate)
+                          if isinstance(predicate, str) else predicate)
         super().__init__(operation_name="exists", uid=uid)
 
     def transform_row(self, v):
         return bool(self.predicate(v))
 
     def config(self) -> dict:
-        raise NotImplementedError(
-            "ExistsTransformer with an arbitrary predicate is not "
-            "serializable (reference lambdas require stable classes)")
+        from transmogrifai_tpu.stages.external import _fn_path
+        return {"predicate": _fn_path(self.predicate)}
 
 
 class FilterValueTransformer(HostTransformer):
     """Keep the value when the predicate holds, else the default (reference
-    RichFeature ``filter``). Output type follows the input feature."""
+    RichFeature ``filter``). Output type follows the input feature.
+
+    Serializable when the predicate is a module-level importable function
+    and the default is JSON-able (``mod:qualname`` scheme, same contract as
+    the external wrappers)."""
 
     in_types = (ft.FeatureType,)
     out_type = ft.FeatureType
 
     def __init__(self, predicate=None, default=None,
                  uid: Optional[str] = None):
-        self.predicate = predicate
+        from transmogrifai_tpu.stages.external import _fn_from_path
+        self.predicate = (_fn_from_path(predicate)
+                          if isinstance(predicate, str) else predicate)
         self.default = default
         super().__init__(operation_name="filter", uid=uid)
 
@@ -233,9 +241,9 @@ class FilterValueTransformer(HostTransformer):
         return v if self.predicate(v) else self.default
 
     def config(self) -> dict:
-        raise NotImplementedError(
-            "FilterValueTransformer with an arbitrary predicate is not "
-            "serializable (reference lambdas require stable classes)")
+        from transmogrifai_tpu.stages.external import _fn_path
+        return {"predicate": _fn_path(self.predicate),
+                "default": self.default}
 
 
 class ReplaceTransformer(HostTransformer):

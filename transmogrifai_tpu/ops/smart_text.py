@@ -118,7 +118,11 @@ class SmartTextVectorizer(Estimator):
                         name_hits += 1
             if self.detect_names and non_null > 0 \
                     and name_hits / non_null >= self.name_threshold:
-                treatments.append({"kind": "sensitive"})
+                # record WHAT was detected, not just that the column vanished
+                # (reference SensitiveFeatureInformation rides into
+                # ModelInsights via vector metadata)
+                treatments.append({"kind": "sensitive",
+                                   "prob_name": name_hits / non_null})
             elif non_null == 0:
                 treatments.append({"kind": "ignore"})
             elif not stats.overflowed:
@@ -252,6 +256,15 @@ class SmartTextModel(HostTransformer):
     def sensitive_features(self) -> list[str]:
         return [f.name for t, f in zip(self.treatments, self.input_features)
                 if t["kind"] == "sensitive"]
+
+    def sensitive_info(self) -> dict[str, dict]:
+        """SensitiveFeatureInformation analog: name -> detection record for
+        every input column the fit dropped as sensitive."""
+        return {f.name: {"detected": True,
+                         "probName": t.get("prob_name"),
+                         "action": "removedFromVector"}
+                for t, f in zip(self.treatments, self.input_features)
+                if t["kind"] == "sensitive"}
 
     def fitted_state(self):
         return {"treatments": self.treatments}

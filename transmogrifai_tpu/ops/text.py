@@ -276,17 +276,20 @@ class TextLenTransformer(HostTransformer):
 
 
 class RegexTokenizer(HostTransformer):
-    """Text -> TextList of regex-extracted tokens (reference RichTextFeature
-    ``tokenizeRegex`` via LuceneRegexTextAnalyzer).
+    """Text -> TextList of regex tokens (reference RichTextFeature
+    ``tokenizeRegex`` via LuceneRegexTextAnalyzer -> Lucene PatternTokenizer,
+    ``RichTextFeature.scala:378``, ``LuceneTextAnalyzer.scala:139``).
 
-    ``group`` = -1 takes whole matches; >= 0 takes that capture group of
-    each match. Tokens shorter than ``min_token_length`` drop.
+    ``group`` = -1 SPLITS on the pattern (Lucene's "equivalent to split",
+    dropping empty tokens — ``tokenizeRegex(pattern="\\s+")`` yields words);
+    ``group`` >= 0 takes that capture group of each match (0 = whole match).
+    Tokens shorter than ``min_token_length`` drop.
     """
 
     in_types = (ft.Text,)
     out_type = ft.TextList
 
-    def __init__(self, pattern: str = r"[^\W_]+", group: int = -1,
+    def __init__(self, pattern: str = r"\W+", group: int = -1,
                  min_token_length: int = 1, lowercase: bool = True,
                  uid: Optional[str] = None):
         self.pattern = pattern
@@ -301,8 +304,11 @@ class RegexTokenizer(HostTransformer):
             return []
         if self.lowercase:
             value = value.lower()
-        group = self.group if self.group >= 0 else 0  # 0 = whole match
-        toks = [m.group(group) or "" for m in self._re.finditer(value)]
+        if self.group < 0:
+            toks = [t for t in self._re.split(value) if t]
+        else:
+            toks = [m.group(self.group) or ""
+                    for m in self._re.finditer(value)]
         return [t for t in toks if len(t) >= self.min_token_length]
 
 

@@ -97,8 +97,11 @@ class OpIDF(Estimator):
     def fit_model(self, data) -> "IDFModel":
         col = data.device_col(self.input_names[0])
         x = col.values
-        m = x.shape[0]
-        df = jnp.sum(x != 0.0, axis=0, dtype=jnp.float32)
+        # weight by the row validity mask: device blocks may carry mesh
+        # padding rows which must contribute monoid identity
+        mask = data.row_mask()
+        m = jnp.sum(mask)
+        df = jnp.sum((x != 0.0) * mask[:, None], axis=0, dtype=jnp.float32)
         idf = jnp.log((m + 1.0) / (df + 1.0))
         idf = jnp.where(df >= self.min_doc_freq, idf, 0.0)
         return IDFModel(idf=np.asarray(idf, dtype=np.float32))
@@ -187,8 +190,13 @@ class DropIndicesByTransformer(DeviceTransformer):
 
     def _keep(self, meta: Optional[VectorMetadata], width: int) -> list[int]:
         if meta is None or meta.size != width:
-            return (self.keep_indices if self.keep_indices is not None
-                    else list(range(width)))
+            if self.keep_indices is None:
+                raise RuntimeError(
+                    "DropIndicesByTransformer has no vector metadata and no "
+                    "resolved keep_indices; run the columnar pass (or pass "
+                    "keep_indices) before row-level transform — silently "
+                    "keeping every column would turn the drop into a no-op")
+            return self.keep_indices
         p = self._predicate()
         return [i for i, c in enumerate(meta.columns) if not p(c)]
 
@@ -234,15 +242,18 @@ class MinVarianceFilter(Estimator):
     def fit_model(self, data) -> "MinVarianceFilterModel":
         col = data.device_col(self.input_names[0])
         x = col.values
-        n = max(int(x.shape[0]), 1)
-        mean = jnp.sum(x, axis=0) / n
+        mask = data.row_mask()
+        n = jnp.maximum(jnp.sum(mask), 1.0)
+        mean = jnp.sum(x * mask[:, None], axis=0) / n
         # centered second pass: E[x^2]-mean^2 catastrophically cancels in
         # float32 for large-mean columns (a constant ~5e4 column would read
-        # variance ~3e3)
-        d = x - mean[None, :]
-        var = jnp.sum(d * d, axis=0) / n
+        # variance ~3e3); masked so mesh-padding rows contribute identity.
+        # Sample variance (1/(n-1)) and a strict > keep match the reference
+        # (Spark Summarizer variance; drop when variance <= minVariance).
+        d = (x - mean[None, :]) * mask[:, None]
+        var = jnp.sum(d * d, axis=0) / jnp.maximum(n - 1.0, 1.0)
         keep = [int(i) for i in
-                np.flatnonzero(np.asarray(var) >= self.min_variance)]
+                np.flatnonzero(np.asarray(var) > self.min_variance)]
         meta = (col.metadata.select(keep)
                 if col.metadata is not None
                 and col.metadata.size == int(x.shape[1]) else None)

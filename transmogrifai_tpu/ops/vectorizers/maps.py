@@ -44,26 +44,36 @@ class _MapVectorizerBase(Estimator):
 
     def __init__(self, allow_keys: Sequence[str] = (),
                  block_keys: Sequence[str] = (),
+                 block_keys_by_feature: Optional[dict] = None,
                  track_nulls: bool = True, uid: Optional[str] = None,
                  **extra):
         self.allow_keys = tuple(allow_keys)
         self.block_keys = tuple(block_keys)
+        #: per-feature key exclusions (reference RawFeatureFilter's per-key
+        #: map blocklist, applied by OpWorkflow.setBlocklist — here the
+        #: workflow rewires fitted map vectorizers with this dict)
+        self.block_keys_by_feature = {
+            str(n): tuple(ks)
+            for n, ks in (block_keys_by_feature or {}).items()}
         self.track_nulls = track_nulls
         for k, v in extra.items():
             setattr(self, k, v)
         super().__init__(uid=uid)
 
-    def _keep_key(self, k: str) -> bool:
+    def _keep_key(self, k: str, feature: Optional[str] = None) -> bool:
         if self.allow_keys and k not in self.allow_keys:
+            return False
+        if feature is not None \
+                and k in self.block_keys_by_feature.get(feature, ()):
             return False
         return k not in self.block_keys
 
-    def _collect(self, col: fr.HostColumn):
+    def _collect(self, col: fr.HostColumn, feature: Optional[str] = None):
         """-> {key: [values...]} (missing key -> absent)."""
         per_key: dict[str, list] = {}
         for m in col.values:
             for k, v in (m or {}).items():
-                if self._keep_key(k):
+                if self._keep_key(k, feature):
                     per_key.setdefault(k, []).append(v)
         return per_key
 
@@ -177,7 +187,7 @@ class RealMapVectorizer(_MapVectorizerBase):
     def fit_model(self, data):
         keys, fills = [], []
         for name in self.input_names:
-            per_key = self._collect(data.host_col(name))
+            per_key = self._collect(data.host_col(name), name)
             ks = sorted(per_key)
             keys.append(ks)
             fills.append({k: float(np.mean([float(v) for v in per_key[k]]))
@@ -194,7 +204,7 @@ class IntegralMapVectorizer(_MapVectorizerBase):
     def fit_model(self, data):
         keys, fills = [], []
         for name in self.input_names:
-            per_key = self._collect(data.host_col(name))
+            per_key = self._collect(data.host_col(name), name)
             ks = sorted(per_key)
             keys.append(ks)
             f = {}
@@ -213,7 +223,7 @@ class BinaryMapVectorizer(_MapVectorizerBase):
     in_types = (ft.BinaryMap,)
 
     def fit_model(self, data):
-        keys = [sorted(self._collect(data.host_col(n)))
+        keys = [sorted(self._collect(data.host_col(n), n))
                 for n in self.input_names]
         fills = [{k: 0.0 for k in ks} for ks in keys]
         return _NumericMapModel(keys=keys, track_nulls=self.track_nulls,
@@ -267,7 +277,7 @@ class TextMapPivotVectorizer(_MapVectorizerBase):
     def fit_model(self, data):
         keys, categories = [], []
         for name in self.input_names:
-            per_key = self._collect(data.host_col(name))
+            per_key = self._collect(data.host_col(name), name)
             ks = sorted(per_key)
             keys.append(ks)
             cat = {}
@@ -308,7 +318,7 @@ class MultiPickListMapVectorizer(_MapVectorizerBase):
     def fit_model(self, data):
         keys, categories = [], []
         for name in self.input_names:
-            per_key = self._collect(data.host_col(name))
+            per_key = self._collect(data.host_col(name), name)
             ks = sorted(per_key)
             keys.append(ks)
             cat = {}
@@ -367,7 +377,7 @@ class DateMapToUnitCircleVectorizer(_MapVectorizerBase):
         super().__init__(time_period=time_period, **kw)
 
     def fit_model(self, data):
-        keys = [sorted(self._collect(data.host_col(n)))
+        keys = [sorted(self._collect(data.host_col(n), n))
                 for n in self.input_names]
         return _DateMapModel(keys=keys, track_nulls=self.track_nulls,
                              time_period=self.time_period)
@@ -405,7 +415,7 @@ class GeolocationMapVectorizer(_MapVectorizerBase):
     def fit_model(self, data):
         keys, fills = [], []
         for name in self.input_names:
-            per_key = self._collect(data.host_col(name))
+            per_key = self._collect(data.host_col(name), name)
             ks = sorted(per_key)
             keys.append(ks)
             f = {}
@@ -488,7 +498,7 @@ class SmartTextMapVectorizer(_MapVectorizerBase):
     def fit_model(self, data):
         keys, treatments = [], []
         for name in self.input_names:
-            per_key = self._collect(data.host_col(name))
+            per_key = self._collect(data.host_col(name), name)
             ks = sorted(per_key)
             keys.append(ks)
             tr = {}
@@ -534,7 +544,7 @@ class TextMapLenEstimator(_MapVectorizerBase):
     in_types = (ft.TextMap,)
 
     def fit_model(self, data):
-        keys = [sorted(self._collect(data.host_col(n)))
+        keys = [sorted(self._collect(data.host_col(n), n))
                 for n in self.input_names]
         return _TextMapLenModel(keys=keys, track_nulls=False)
 
@@ -560,7 +570,7 @@ class TextMapNullEstimator(_MapVectorizerBase):
     in_types = (ft.TextMap,)
 
     def fit_model(self, data):
-        keys = [sorted(self._collect(data.host_col(n)))
+        keys = [sorted(self._collect(data.host_col(n), n))
                 for n in self.input_names]
         return _TextMapNullModel(keys=keys, track_nulls=False)
 

@@ -52,6 +52,31 @@ class OpParams:
         }
 
     # -- application ---------------------------------------------------------
+    def apply_to_reader(self, reader) -> list[str]:
+        """Apply reader overrides (reference ``OpParams.scala`` readerParams:
+        per-reader-type path/partitions/custom settings). Matched by reader
+        class name (``CSVReader``) or ``"default"``; any entry key naming an
+        existing reader attribute is set (``path``, ``key_col``,
+        ``chunk_rows``...); ``customParams`` entries set attributes too.
+        Returns a log of applied overrides."""
+        if reader is None:
+            return []
+        applied = []
+        # generic defaults first so the class-specific entry wins
+        for key in ("default", type(reader).__name__):
+            overrides = self.reader_params.get(key)
+            if not overrides:
+                continue
+            items = {**{k: v for k, v in overrides.items()
+                        if k != "customParams"},
+                     **overrides.get("customParams", {})}
+            for pname, value in items.items():
+                if hasattr(reader, pname):
+                    setattr(reader, pname, value)
+                    applied.append(
+                        f"{type(reader).__name__}.{pname}={value!r}")
+        return applied
+
     def apply_to_stages(self, stages) -> list[str]:
         """Set overrides on matching stages (by class name or uid); returns
         a log of applied overrides."""

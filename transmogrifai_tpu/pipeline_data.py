@@ -27,6 +27,7 @@ import numpy as np
 from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.parallel import mesh as pmesh
 from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.dict_encode import dict_encode
 
 __all__ = ["PipelineData"]
 
@@ -129,26 +130,26 @@ class PipelineData:
                    if c.kind in fr.NUMERIC_KINDS and n not in self.device]
         if not pending:
             return
-        vals = np.stack([np.where(c.mask, c.values, 0.0).astype(np.float32)
-                         for _, c in pending], axis=1)
-        masks = np.stack([c.mask.astype(np.float32) for _, c in pending],
-                         axis=1)
-        dvals = _shard(vals)
-        dmasks = _shard(masks)
-        # split into per-column arrays inside ONE jitted program — k eager
-        # `dvals[:, i]` slices would pay k dispatch round-trips each on
-        # tunneled/remote devices (measured ~14s for 28 columns at 1M rows)
-        cols_v, cols_m = _split_columns(dvals, dmasks)
-        for i, (name, _) in enumerate(pending):
-            self.device[name] = fr.NumericColumn(cols_v[i], cols_m[i])
+        from transmogrifai_tpu.utils.profiling import OpStep, profiler
+        with profiler.phase(OpStep.DATA_READING_AND_FILTERING):
+            vals = np.stack(
+                [np.where(c.mask, c.values, 0.0).astype(np.float32)
+                 for _, c in pending], axis=1)
+            masks = np.stack([c.mask.astype(np.float32) for _, c in pending],
+                             axis=1)
+            dvals = _shard(vals)
+            dmasks = _shard(masks)
+            # split into per-column arrays inside ONE jitted program — k
+            # eager `dvals[:, i]` slices would pay k dispatch round-trips
+            # each on tunneled/remote devices (measured ~14s for 28 columns
+            # at 1M rows)
+            cols_v, cols_m = _split_columns(dvals, dmasks)
+            for i, (name, _) in enumerate(pending):
+                self.device[name] = fr.NumericColumn(cols_v[i], cols_m[i])
 
     @staticmethod
     def _encode_text(col: fr.HostColumn) -> fr.CodesColumn:
-        vocab = sorted({v for v in col.values if v is not None})
-        index = {v: i for i, v in enumerate(vocab)}
-        codes = np.fromiter(
-            (index.get(v, -1) if v is not None else -1 for v in col.values),
-            count=len(col), dtype=np.int32)
+        codes, vocab = dict_encode(col.values)
         return fr.CodesColumn(_shard(codes, pad_value=-1), tuple(vocab))
 
     def _device_to_host(self, col: Any) -> fr.HostColumn:

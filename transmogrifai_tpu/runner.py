@@ -48,7 +48,14 @@ class WorkflowRunner:
         applied = params.apply_to_stages(
             [s for f in self.workflow.result_features
              for s in f.parent_stages()])
-        result: dict = {"runType": run_type, "stageOverrides": applied}
+        reader_applied = params.apply_to_reader(self.workflow.reader)
+        #: custom params ride on the workflow for app/stage code (reference
+        #: OpParams.customParams passthrough)
+        self.workflow.op_params = params
+        result: dict = {"runType": run_type, "stageOverrides": applied,
+                        "readerOverrides": reader_applied}
+        if params.custom_params:
+            result["customParams"] = dict(params.custom_params)
         try:
             if run_type == RunTypes.TRAIN:
                 with profiler.phase(OpStep.MODEL_TRAINING):

@@ -474,6 +474,7 @@ class ModelSelector(Estimator):
 
     def fit_model(self, data) -> SelectedModel:
         from transmogrifai_tpu.dag import _plog
+        from transmogrifai_tpu.utils.profiling import OpStep, profiler
         t0 = time.time()
         label_name, feat_name = self.input_names
         X = data.device_col(feat_name).values
@@ -495,13 +496,15 @@ class ModelSelector(Estimator):
                 jtr, jva = jnp.asarray(tr), jnp.asarray(va)
                 yield Xt[jtr], yt[jtr], wt[jtr], Xt[jva], yt[jva]
 
-        results, mean_metrics, failures = self._sweep(fold_arrays())
+        with profiler.phase(OpStep.CROSS_VALIDATION):
+            results, mean_metrics, failures = self._sweep(fold_arrays())
         _plog("selector: CV sweep", t1)
         t1 = time.time()
         Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
         yh = y[jnp.asarray(holdout_idx)] if holdout_idx.size else None
-        selected = self._finalize(results, mean_metrics, Xt, yt, wt, Xh, yh,
-                                  prep_results, t0, failures)
+        with profiler.phase(OpStep.MODEL_TRAINING):
+            selected = self._finalize(results, mean_metrics, Xt, yt, wt,
+                                      Xh, yh, prep_results, t0, failures)
         _plog("selector: refit+evaluate", t1)
         return selected
 

@@ -1,11 +1,11 @@
 from transmogrifai_tpu.testkit.random_data import (
-    RandomBinary, RandomIntegral, RandomList, RandomMap, RandomMultiPickList,
-    RandomReal, RandomText, RandomVector,
+    RandomBinary, RandomGeolocation, RandomIntegral, RandomList, RandomMap,
+    RandomMultiPickList, RandomReal, RandomSet, RandomText, RandomVector,
 )
 from transmogrifai_tpu.testkit.test_feature_builder import TestFeatureBuilder
 
 __all__ = [
-    "RandomBinary", "RandomIntegral", "RandomList", "RandomMap",
-    "RandomMultiPickList", "RandomReal", "RandomText", "RandomVector",
-    "TestFeatureBuilder",
+    "RandomBinary", "RandomGeolocation", "RandomIntegral", "RandomList",
+    "RandomMap", "RandomMultiPickList", "RandomReal", "RandomSet",
+    "RandomText", "RandomVector", "TestFeatureBuilder",
 ]

@@ -14,7 +14,8 @@ from typing import Any, Callable, Iterator, Optional, Sequence
 import numpy as np
 
 __all__ = ["RandomReal", "RandomIntegral", "RandomBinary", "RandomText",
-           "RandomList", "RandomMultiPickList", "RandomMap", "RandomVector"]
+           "RandomList", "RandomMultiPickList", "RandomMap", "RandomVector",
+           "RandomGeolocation", "RandomSet"]
 
 _COUNTRIES = ["USA", "Canada", "Mexico", "Brazil", "France", "Germany",
               "Japan", "India", "China", "Australia", "Kenya", "Egypt"]
@@ -69,6 +70,28 @@ class RandomReal:
     def logNormal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> _Gen:
         return _Gen(lambda r: float(r.lognormal(mean, sigma)), seed)
 
+    @staticmethod
+    def exponential(scale: float = 1.0, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.exponential(scale)), seed)
+
+    @staticmethod
+    def gamma(shape: float = 2.0, scale: float = 1.0, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.gamma(shape, scale)), seed)
+
+    @staticmethod
+    def weibull(a: float = 1.5, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.weibull(a)), seed)
+
+    @staticmethod
+    def currencies(mean: float = 100.0, sigma: float = 30.0,
+                   seed: int = 42) -> _Gen:
+        return _Gen(lambda r: round(abs(float(r.normal(mean, sigma))), 2),
+                    seed)
+
+    @staticmethod
+    def percents(seed: int = 42) -> _Gen:
+        return _Gen(lambda r: float(r.uniform(0.0, 100.0)), seed)
+
 
 class RandomIntegral:
     @staticmethod
@@ -80,6 +103,11 @@ class RandomIntegral:
               step_ms: int = 86_400_000, seed: int = 42) -> _Gen:
         return _Gen(lambda r: int(start_ms + r.integers(0, 365) * step_ms),
                     seed)
+
+    @staticmethod
+    def datetimes(start_ms: int = 1_500_000_000_000,
+                  span_ms: int = 365 * 86_400_000, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: int(start_ms + r.integers(0, span_ms)), seed)
 
 
 class RandomBinary:
@@ -132,6 +160,88 @@ class RandomText:
     def picklists(domain: Sequence[str], seed: int = 42) -> _Gen:
         return RandomText.textFromDomain(domain, seed)
 
+    @staticmethod
+    def ids(length: int = 12, seed: int = 42) -> _Gen:
+        alphabet = np.array(list(string.ascii_uppercase + string.digits))
+        return _Gen(lambda r: "".join(r.choice(alphabet, length)), seed)
+
+    @staticmethod
+    def urls(seed: int = 42) -> _Gen:
+        def sample(r):
+            host = "".join(r.choice(list("abcdefgh"), 6))
+            tld = ["com", "org", "net", "dev"][int(r.integers(4))]
+            proto = "https" if r.uniform() < 0.8 else "http"
+            return f"{proto}://{host}.{tld}/p{int(r.integers(1000))}"
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def base64s(min_bytes: int = 4, max_bytes: int = 32,
+                seed: int = 42) -> _Gen:
+        import base64 as b64
+
+        def sample(r):
+            n = int(r.integers(min_bytes, max_bytes + 1))
+            return b64.b64encode(r.bytes(n)).decode("ascii")
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def postalCodes(seed: int = 42) -> _Gen:
+        return _Gen(lambda r: "".join(str(int(x))
+                                      for x in r.integers(0, 10, 5)), seed)
+
+    @staticmethod
+    def streets(seed: int = 42) -> _Gen:
+        names = ["Main", "Oak", "Pine", "Maple", "Cedar", "Elm", "Market",
+                 "Mission", "Valencia", "Broadway"]
+        kinds = ["St", "Ave", "Blvd", "Rd", "Ln"]
+
+        def sample(r):
+            return (f"{int(r.integers(1, 9999))} "
+                    f"{names[int(r.integers(len(names)))]} "
+                    f"{kinds[int(r.integers(len(kinds)))]}")
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def textAreas(min_words: int = 5, max_words: int = 40,
+                  seed: int = 42) -> _Gen:
+        words = ["the", "model", "feature", "pipeline", "data", "vector",
+                 "tpu", "mesh", "sweep", "metric", "column", "row", "train",
+                 "score", "label", "split", "tree", "text", "map", "hash"]
+
+        def sample(r):
+            n = int(r.integers(min_words, max_words + 1))
+            return " ".join(words[int(i)]
+                            for i in r.integers(0, len(words), n))
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def uniqueTexts(prefix: str = "item", seed: int = 42) -> _Gen:
+        # unique by construction: a shuffled counter rides in the value
+        counter = {"n": 0}
+
+        def sample(r):
+            counter["n"] += 1
+            return f"{prefix}_{counter['n']:08d}_{int(r.integers(1 << 30))}"
+        return _Gen(sample, seed)
+
+
+class RandomGeolocation:
+    """(lat, lon, accuracy) triples (reference RandomList.ofGeolocations /
+    ofGeolocationsNear)."""
+
+    @staticmethod
+    def geolocations(seed: int = 42) -> _Gen:
+        return _Gen(lambda r: [float(r.uniform(-90, 90)),
+                               float(r.uniform(-180, 180)),
+                               float(r.integers(1, 11))], seed)
+
+    @staticmethod
+    def near(lat: float, lon: float, radius_deg: float = 1.0,
+             seed: int = 42) -> _Gen:
+        return _Gen(lambda r: [float(lat + r.normal(0, radius_deg)),
+                               float(lon + r.normal(0, radius_deg)),
+                               float(r.integers(1, 11))], seed)
+
 
 class RandomList:
     @staticmethod
@@ -142,6 +252,30 @@ class RandomList:
             sub = iter(elem_gen.reseed(int(r.integers(1 << 30))))
             return [v for v in (next(sub) for _ in range(n)) if v is not None]
         return _Gen(sample, seed)
+
+    @staticmethod
+    def ofTexts(min_len: int = 0, max_len: int = 5, seed: int = 42) -> _Gen:
+        return RandomList.of(RandomText.strings(), min_len, max_len, seed)
+
+    @staticmethod
+    def ofDates(min_len: int = 0, max_len: int = 5, seed: int = 42) -> _Gen:
+        return RandomList.of(RandomIntegral.dates(), min_len, max_len, seed)
+
+    @staticmethod
+    def ofDateTimes(min_len: int = 0, max_len: int = 5,
+                    seed: int = 42) -> _Gen:
+        return RandomList.of(RandomIntegral.datetimes(), min_len, max_len,
+                             seed)
+
+    @staticmethod
+    def ofGeolocations(seed: int = 42) -> _Gen:
+        return RandomGeolocation.geolocations(seed)
+
+
+class RandomSet:
+    @staticmethod
+    def of(domain: Sequence[str], max_len: int = 3, seed: int = 42) -> _Gen:
+        return RandomMultiPickList.of(domain, max_len, seed)
 
 
 class RandomMultiPickList:
@@ -157,22 +291,74 @@ class RandomMultiPickList:
 
 class RandomMap:
     @staticmethod
-    def of(value_gen: _Gen, keys: Sequence[str], seed: int = 42) -> _Gen:
+    def of(value_gen: _Gen, keys: Sequence[str], seed: int = 42,
+           prob_key: float = 0.8) -> _Gen:
         ks = list(keys)
 
         def sample(r):
             sub = iter(value_gen.reseed(int(r.integers(1 << 30))))
             out = {}
             for k in ks:
-                if r.uniform() < 0.8:
+                if r.uniform() < prob_key:
                     v = next(sub)
                     if v is not None:
                         out[k] = v
             return out
         return _Gen(sample, seed)
 
+    # typed helpers mirroring the reference's RandomMap.of* constructors
+    @staticmethod
+    def ofReals(keys: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomReal.normal(), keys, seed)
+
+    @staticmethod
+    def ofTexts(keys: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomText.strings(), keys, seed)
+
+    @staticmethod
+    def ofBinaries(keys: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomBinary.binaries(), keys, seed)
+
+    @staticmethod
+    def ofIntegrals(keys: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomIntegral.integrals(), keys, seed)
+
+    @staticmethod
+    def ofDates(keys: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomIntegral.dates(), keys, seed)
+
+    @staticmethod
+    def ofGeolocations(keys: Sequence[str], seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomGeolocation.geolocations(), keys, seed)
+
+    @staticmethod
+    def ofMultiPickLists(keys: Sequence[str], domain: Sequence[str],
+                         seed: int = 42) -> _Gen:
+        return RandomMap.of(RandomMultiPickList.of(domain), keys, seed)
+
 
 class RandomVector:
     @staticmethod
     def dense(dim: int, seed: int = 42) -> _Gen:
         return _Gen(lambda r: r.normal(size=dim).astype(np.float32), seed)
+
+    @staticmethod
+    def sparse(dim: int, density: float = 0.1, seed: int = 42) -> _Gen:
+        def sample(r):
+            v = r.normal(size=dim).astype(np.float32)
+            return np.where(r.uniform(size=dim) < density, v,
+                            np.float32(0.0))
+        return _Gen(sample, seed)
+
+    @staticmethod
+    def binary(dim: int, prob_one: float = 0.5, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: (r.uniform(size=dim) < prob_one
+                               ).astype(np.float32), seed)
+
+    @staticmethod
+    def ones(dim: int, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: np.ones(dim, np.float32), seed)
+
+    @staticmethod
+    def zeros(dim: int, seed: int = 42) -> _Gen:
+        return _Gen(lambda r: np.zeros(dim, np.float32), seed)

@@ -1,0 +1,59 @@
+"""Analytic device-work (FLOP) accounting for MFU attribution.
+
+The reference attributes executor work via Spark's task metrics
+(``OpSparkListener.scala``); the TPU analog is achieved-FLOP/s against the
+chip's peak. XLA's per-program ``cost_analysis`` is unavailable through the
+opaque ``jax.jit`` call path without re-lowering, so each model family
+records an analytic estimate of its training FLOPs at dispatch time — exact
+for the dense linear algebra (matmul-dominated trainers), order-of-magnitude
+for scatter/gather-bound tree histogram work (where "FLOPs" counts device
+update ops and MFU is not the meaningful lens — bytes are).
+
+Usage: ``flops.reset()`` before a run; trainers call ``flops.add(kind, n)``;
+``flops.totals()`` afterward. Single-process, additive, no locking (JAX
+dispatch is single-threaded per client).
+"""
+
+from __future__ import annotations
+
+_totals: dict[str, float] = {}
+
+
+def reset() -> None:
+    _totals.clear()
+
+
+def add(kind: str, n: float) -> None:
+    _totals[kind] = _totals.get(kind, 0.0) + float(n)
+
+
+def totals() -> dict[str, float]:
+    return dict(_totals)
+
+
+def grand_total() -> float:
+    return float(sum(_totals.values()))
+
+
+#: best-effort peak dense-FLOP/s by TPU device_kind substring (bf16 MXU
+#: peak per chip, public spec sheets); None when unknown
+_PEAKS = {
+    "v5 lite": 197e12,   # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,        # v6e (Trillium)
+}
+
+
+def peak_flops_per_s() -> float | None:
+    """Peak bf16 FLOP/s of device 0, or None off-TPU/unknown kind."""
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for sub, peak in _PEAKS.items():
+        if sub in kind:
+            return peak
+    return None

@@ -1,26 +1,34 @@
-"""Phase-scoped profiling & run metrics.
+"""Phase-scoped profiling & run metrics with device-time attribution.
 
-Parity: reference ``utils/.../spark/OpSparkListener.scala`` (AppMetrics) +
-``core/.../utils/spark/JobGroupUtil.scala`` (OpStep job-group taxonomy) —
-every workflow phase is attributed to an ``OpStep``, wall/(optional) device
-trace collected, and the aggregate ``AppMetrics`` is queryable/serializable
-at the end of the run.
+Parity: reference ``utils/.../spark/OpSparkListener.scala:52-418``
+(AppMetrics) + ``core/.../utils/spark/JobGroupUtil.scala`` (OpStep
+job-group taxonomy) — every workflow phase is attributed to an ``OpStep``,
+wall time collected, and the aggregate ``AppMetrics`` is queryable/
+serializable at the end of the run.
 
-TPU-first: phases can additionally emit ``jax.profiler`` traces
-(``trace_dir``) for XProf timeline analysis — the analog of drilling into
-the Spark UI from a job group.
+TPU-first: where the reference attributes *executor* time to phases via
+Spark job groups, this attributes *device* time via one ``jax.profiler``
+trace spanning the run. Phase enter/exit wall timestamps are recorded; at
+``finalize()`` the trace's XSpace protobuf is parsed directly (the device
+plane's XLA-op timeline) and every device op interval is bucketed into the
+innermost phase whose wall interval contains its midpoint. One trace, no
+nesting restrictions, true device seconds per phase — the drill-down the
+Spark UI gives a job group.
 """
 
 from __future__ import annotations
 
 import contextlib
+import glob
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Optional
 
-__all__ = ["OpStep", "AppMetrics", "profiler", "phase"]
+__all__ = ["OpStep", "AppMetrics", "profiler", "phase",
+           "trace_device_intervals"]
 
 
 class OpStep(Enum):
@@ -40,6 +48,7 @@ class PhaseMetrics:
     wall_s: float = 0.0
     count: int = 0
     peak_hbm_bytes: int = 0   # device peak_bytes_in_use high-water mark
+    device_s: float = 0.0     # attributed device busy seconds (finalize())
 
 
 def _device_memory() -> tuple[int, int]:
@@ -54,11 +63,56 @@ def _device_memory() -> tuple[int, int]:
         return 0, 0
 
 
+def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
+    """Parse a ``jax.profiler`` trace directory into device-op intervals
+    ``[(start_epoch_s, duration_s), ...]``.
+
+    Reads the XSpace protobuf directly (``tensorflow.tsl`` proto bindings;
+    the tensorboard-plugin converter is not required). Only accelerator
+    planes (``/device:...``) count; per plane the busiest line is used so
+    module- and op-level timelines aren't double-counted. Returns [] when
+    no trace/proto support is available (e.g. pure-CPU backends expose no
+    device plane).
+    """
+    try:
+        os.environ.setdefault(
+            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        return []
+    out: list[tuple[float, float]] = []
+    for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                          recursive=True):
+        try:
+            xs = xplane_pb2.XSpace()
+            with open(path, "rb") as fh:
+                xs.ParseFromString(fh.read())
+        except Exception:
+            continue
+        for plane in xs.planes:
+            if not plane.name.startswith("/device:"):
+                continue
+            best: list[tuple[float, float]] = []
+            best_busy = 0.0
+            for line in plane.lines:
+                ivals = [(line.timestamp_ns / 1e9 + ev.offset_ps / 1e12,
+                          ev.duration_ps / 1e12)
+                         for ev in line.events]
+                busy = sum(d for _, d in ivals)
+                if busy > best_busy:
+                    best, best_busy = ivals, busy
+            out.extend(best)
+    return out
+
+
 @dataclass
 class AppMetrics:
     app_name: str = "transmogrifai_tpu"
     start_time: float = field(default_factory=time.time)
     phases: dict = field(default_factory=dict)  # step -> PhaseMetrics
+    #: phase occurrence intervals [(step, t0, t1)], enter order — the
+    #: timeline device events are attributed against at finalize()
+    spans: list = field(default_factory=list)
 
     def record(self, step: OpStep, wall_s: float,
                peak_hbm: int = 0) -> None:
@@ -66,6 +120,24 @@ class AppMetrics:
         pm.wall_s += wall_s
         pm.count += 1
         pm.peak_hbm_bytes = max(pm.peak_hbm_bytes, peak_hbm)
+
+    def attribute_device_time(self,
+                              intervals: list[tuple[float, float]]) -> float:
+        """Bucket device-op intervals into the innermost containing phase
+        span (latest-started span whose wall window contains the op's
+        midpoint). Returns total attributed device seconds."""
+        total = 0.0
+        for start, dur in intervals:
+            mid = start + dur / 2.0
+            owner = None
+            for step, t0, t1 in self.spans:
+                if t0 <= mid <= t1 and (owner is None or t0 >= owner[1]):
+                    owner = (step, t0)
+            if owner is not None:
+                pm = self.phases.setdefault(owner[0], PhaseMetrics(owner[0]))
+                pm.device_s += dur
+                total += dur
+        return total
 
     @property
     def total_wall_s(self) -> float:
@@ -76,7 +148,8 @@ class AppMetrics:
             "appName": self.app_name,
             "totalWallSeconds": self.total_wall_s,
             "phases": {k: {"wallSeconds": p.wall_s, "count": p.count,
-                           "peakHbmBytes": p.peak_hbm_bytes}
+                           "peakHbmBytes": p.peak_hbm_bytes,
+                           "deviceSeconds": p.device_s}
                        for k, p in self.phases.items()},
         }
 
@@ -86,11 +159,13 @@ class AppMetrics:
 
     def pretty(self) -> str:
         from transmogrifai_tpu.utils.table import Table
-        rows = [(k, f"{p.wall_s:.2f}", p.count,
+        rows = [(k, f"{p.wall_s:.2f}",
+                 f"{p.device_s:.2f}" if p.device_s else "-", p.count,
                  f"{p.peak_hbm_bytes / 1e6:.0f}" if p.peak_hbm_bytes
                  else "-")
                 for k, p in sorted(self.phases.items())]
-        return str(Table(["Phase", "Wall (s)", "Count", "Peak HBM (MB)"],
+        return str(Table(["Phase", "Wall (s)", "Device (s)", "Count",
+                          "Peak HBM (MB)"],
                          rows, title=f"{self.app_name} metrics"))
 
 
@@ -98,32 +173,78 @@ class _Profiler:
     def __init__(self):
         self.metrics = AppMetrics()
         self.trace_dir: Optional[str] = None
+        self._tracing = False
+        #: per-open-phase accumulated child seconds (exclusive-wall stack)
+        self._stack: list[float] = []
 
     def reset(self, app_name: str = "transmogrifai_tpu",
               trace_dir: Optional[str] = None) -> AppMetrics:
+        """New metrics object; with ``trace_dir``, starts one jax.profiler
+        trace spanning everything until ``finalize()``."""
         self.metrics = AppMetrics(app_name=app_name)
         self.trace_dir = trace_dir
+        if self._tracing:  # a previous run never finalized: stop its trace
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+        if trace_dir is not None:
+            try:
+                import jax
+                # lean trace: device timeline only (no host/python events,
+                # no HLO protos) so post-run parsing stays cheap even for
+                # multi-minute runs
+                opts = None
+                try:
+                    opts = jax.profiler.ProfileOptions()
+                    opts.host_tracer_level = 0
+                    opts.python_tracer_level = 0
+                    opts.enable_hlo_proto = False
+                except Exception:
+                    opts = None
+                jax.profiler.start_trace(trace_dir, profiler_options=opts)
+                self._tracing = True
+            except Exception:
+                self.trace_dir = None
+        return self.metrics
+
+    def finalize(self) -> AppMetrics:
+        """Stop the run trace (if any), parse it, and attribute device time
+        to phases. Idempotent; safe without a trace (device_s stays 0)."""
+        if self._tracing:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._tracing = False
+            self.metrics.attribute_device_time(
+                trace_device_intervals(self.trace_dir))
         return self.metrics
 
     @contextlib.contextmanager
     def phase(self, step: OpStep):
         t0 = time.time()
         _, peak_before = _device_memory()
-        ctx = contextlib.nullcontext()
-        if self.trace_dir is not None:
-            import jax
-            ctx = jax.profiler.trace(self.trace_dir)
+        self._stack.append(0.0)
         try:
-            with ctx:
-                yield
+            yield
         finally:
             # record on the error path too — a failed run's post-mortem
             # must still account the time spent before the failure
+            t1 = time.time()
             _, peak_after = _device_memory()
             # peak_bytes_in_use is a process-lifetime high-water mark:
             # attribute it to this phase only when THIS phase raised it
             grew = peak_after if peak_after > peak_before else 0
-            self.metrics.record(step, time.time() - t0, peak_hbm=grew)
+            child_s = self._stack.pop()
+            if self._stack:  # bubble own elapsed up to the enclosing phase
+                self._stack[-1] += t1 - t0
+            # exclusive wall: nested phases (e.g. the selector's CV inside
+            # the workflow's FeatureEngineering) don't double-count
+            self.metrics.record(step, (t1 - t0) - child_s, peak_hbm=grew)
+            self.metrics.spans.append((step.value, t0, t1))
 
 
 profiler = _Profiler()

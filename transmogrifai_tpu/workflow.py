@@ -118,6 +118,7 @@ class Workflow:
             raise ValueError("set result features before train()")
         from transmogrifai_tpu.utils.profiling import OpStep, profiler
         raw = self.raw_features()
+        filter_results = None
         with profiler.phase(OpStep.DATA_READING_AND_FILTERING):
             frame = self.reader.generate_frame(raw)
             blocklist: list[str] = []
@@ -125,6 +126,7 @@ class Workflow:
             if self._raw_feature_filter is not None:
                 frame, blocklist = self._raw_feature_filter.filter_frame(
                     frame, raw)
+                filter_results = self._raw_feature_filter.results
                 if blocklist:
                     result = _apply_blocklist(result, set(blocklist))
                     if not result:
@@ -132,6 +134,8 @@ class Workflow:
                             "RawFeatureFilter blocked every path to the "
                             f"result features (blocklist: {blocklist})")
                     raw = [f for f in raw if f.name not in set(blocklist)]
+                self._apply_map_key_blocklist(
+                    result, filter_results.map_key_blocklist)
         data = PipelineData.from_host(frame)
         executor = DagExecutor()
         cut = None
@@ -157,7 +161,27 @@ class Workflow:
             result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
             blocklisted=blocklist,
-            label_distribution=_label_distribution(frame, raw))
+            label_distribution=_label_distribution(frame, raw),
+            raw_filter_results=filter_results)
+
+    @staticmethod
+    def _apply_map_key_blocklist(result, map_key_blocklist: dict) -> None:
+        """Reference ``OpWorkflow.scala:118-167`` setBlocklist per-key map
+        exclusions: rewire every map vectorizer consuming a flagged map
+        feature so the excluded keys never expand into columns."""
+        if not map_key_blocklist:
+            return
+        from transmogrifai_tpu.ops.vectorizers.maps import _MapVectorizerBase
+        stages = {s for f in result for s in f.parent_stages()}
+        for stage in stages:
+            if not isinstance(stage, _MapVectorizerBase):
+                continue
+            for name in stage.input_names:
+                keys = map_key_blocklist.get(name)
+                if keys:
+                    cur = set(stage.block_keys_by_feature.get(name, ()))
+                    stage.block_keys_by_feature[name] = tuple(
+                        sorted(cur | set(keys)))
 
     def _fit_workflow_cv(self, data: PipelineData, cut, executor) -> Dag:
         """Reference ``OpWorkflow.scala:408-449``: fit the pre-CV DAG once,
@@ -180,7 +204,8 @@ class WorkflowModel:
                  raw_features: Sequence[FeatureLike], dag: Dag,
                  executor: Optional[DagExecutor] = None,
                  blocklisted: Sequence[str] = (),
-                 label_distribution: Optional[dict] = None):
+                 label_distribution: Optional[dict] = None,
+                 raw_filter_results=None):
         self.result_features = tuple(result_features)
         self.raw_features = list(raw_features)
         self.dag = dag
@@ -188,6 +213,9 @@ class WorkflowModel:
         self.blocklisted = list(blocklisted)
         #: bounded-bin label histogram captured at train time (ModelInsights)
         self.label_distribution = label_distribution
+        #: RawFeatureFilterResults (or None) — exclusion reasons incl.
+        #: per-key map blocklists, surfaced in summary/ModelInsights
+        self.raw_filter_results = raw_filter_results
 
     # -- scoring -------------------------------------------------------------
     def _ingest(self, reader_or_frame) -> PipelineData:
@@ -290,6 +318,8 @@ class WorkflowModel:
         }
         if s is not None:
             out["selectedModel"] = s.to_json()
+        if self.raw_filter_results is not None:
+            out["rawFeatureFilterResults"] = self.raw_filter_results.to_json()
         return out
 
     def summary_pretty(self) -> str:
