@@ -1,0 +1,108 @@
+"""Pallas histogram kernel vs XLA scatter — the on-chip decision microbench.
+
+``ops/histogram_pallas.py`` holds two implementations of the tree
+learner's hot op (per-level (node, feature, bin) grad/hess histograms):
+the compare+matmul Pallas kernel (MXU-friendly, limited to
+node*bin <= 512 by its 8-sublane VMEM one-hot tile) and the XLA
+scatter-add. This bench times BOTH standalone across the real level
+shapes a depth-12 tree visits (1 -> 4096 nodes) at ``HIST_ROWS`` rows x 28
+features x 64 bins, with block_until_ready fences and median-of-repeats,
+and writes ``benchmarks/PALLAS_HIST.json`` — the committed artifact behind
+the keep-or-delete decision the round-2 review asked for.
+
+Run on the chip: ``python benchmarks/bench_pallas_hist.py``
+(CPU runs measure the interpret path and are labeled as such).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+ROWS = int(os.environ.get("HIST_ROWS", 1_000_000))
+D = 28
+BINS = 64
+NODE_COUNTS = [1, 2, 4, 8, 16, 64, 256, 1024, 4096]
+REPEATS = int(os.environ.get("HIST_REPEATS", 5))
+
+
+def _median_time(fn, *args, **kw):
+    import jax
+    out = fn(*args, **kw)          # compile + warm
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kw))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> int:
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        try:
+            jax.config.update("jax_platforms", want)
+        except RuntimeError:
+            pass
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.ops.histogram_pallas import (
+        node_bin_histogram, node_bin_histogram_xla,
+    )
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.integers(0, BINS, size=(ROWS, D)), jnp.int32)
+    grad = jnp.asarray(rng.normal(size=ROWS), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.2, 1.0, size=ROWS), jnp.float32)
+
+    results = []
+    for n_nodes in NODE_COUNTS:
+        node = jnp.asarray(rng.integers(0, n_nodes, size=ROWS), jnp.int32)
+        t_xla = _median_time(node_bin_histogram_xla, Xb, node, grad, hess,
+                             n_nodes=n_nodes, n_bins=BINS)
+        row = {"nodes": n_nodes, "xla_scatter_ms": round(t_xla * 1e3, 3)}
+        # the kernel only lowers while the one-hot tile fits VMEM
+        # (node_bin_histogram itself falls back beyond that — time the
+        # kernel only where it genuinely runs)
+        from transmogrifai_tpu.ops.histogram_pallas import (
+            _CHUNK, _EQ_BUDGET,
+        )
+        lowers = n_nodes * BINS * _CHUNK * 4 * 8 <= _EQ_BUDGET
+        if lowers:
+            t_pal = _median_time(node_bin_histogram, Xb, node, grad, hess,
+                                 n_nodes=n_nodes, n_bins=BINS)
+            row["pallas_ms"] = round(t_pal * 1e3, 3)
+            row["pallas_speedup"] = round(t_xla / t_pal, 2)
+        else:
+            row["pallas_ms"] = None
+            row["note"] = "beyond the kernel's VMEM one-hot tile cap"
+        results.append(row)
+        print(f"# {row}", file=sys.stderr)
+
+    artifact = {
+        "metric": "node_bin_histogram_microbench",
+        "rows": ROWS, "features": D, "bins": BINS,
+        "platform": platform,
+        "interpret_mode": platform != "tpu",
+        "repeats": REPEATS,
+        "levels": results,
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "PALLAS_HIST.json")
+    with open(out_path, "w") as fh:
+        json.dump(artifact, fh, indent=2)
+    print(json.dumps(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,9 @@ def test_generate_and_run_project(tmp_path, monkeypatch):
         assert (proj / f).exists(), f
     readme = (proj / "README.md").read_text()
     assert "binary" in readme
+    # run.py wires the problem-kind-matched evaluator so `run.py evaluate`
+    # works out of the box
+    assert "OpBinaryClassificationEvaluator" in (proj / "run.py").read_text()
 
     # full cycle: import the generated modules and train
     monkeypatch.chdir(proj)

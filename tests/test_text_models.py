@@ -221,3 +221,37 @@ class TestNames:
         # lowercase 'mark' as a verb is not tagged
         assert "mark" not in ner.transform_row("please mark the date")
         assert ner.transform_row(None) == {}
+
+
+def test_porter_stemmer_canonical_pairs():
+    """Porter (1980) definition — the published example vocabulary the
+    Lucene PorterStemFilter also reproduces."""
+    from transmogrifai_tpu.ops.stemmer import porter_stem
+    for word, stem in [
+            ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+            ("plastered", "plaster"), ("motoring", "motor"),
+            ("hopping", "hop"), ("sized", "size"), ("happy", "happi"),
+            ("relational", "relat"), ("digitizer", "digit"),
+            ("vietnamization", "vietnam"), ("operator", "oper"),
+            ("decisiveness", "decis"), ("triplicate", "triplic"),
+            ("electrical", "electr"), ("adjustable", "adjust"),
+            ("replacement", "replac"), ("adoption", "adopt"),
+            ("activate", "activ"), ("effective", "effect"),
+            ("rate", "rate"), ("controll", "control")]:
+        assert porter_stem(word) == stem, word
+
+
+def test_tokenizer_stemming_and_html_strip():
+    from transmogrifai_tpu.ops.text import TextTokenizer, strip_html
+    t = TextTokenizer(stem=True, filter_stopwords=True)
+    assert t.transform_row("the runners were running happily") == \
+        ["runner", "run", "happili"]
+    # non-English text must NOT be porter-stemmed
+    t_fr = TextTokenizer(stem=True, default_language="fr")
+    assert t_fr.transform_row("manger mangee") == ["manger", "mangee"]
+    # HTML stripping: tags, script bodies and entities vanish
+    html = ("<html><script>var x = 1;</script><body><p>Hello&nbsp;"
+            "<b>world</b> &amp; friends</p><!-- note --></body></html>")
+    assert strip_html(html).split() == ["Hello", "world", "&", "friends"]
+    t_html = TextTokenizer(strip_html_tags=True)
+    assert t_html.transform_row(html) == ["hello", "world", "friends"]

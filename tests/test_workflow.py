@@ -135,3 +135,58 @@ def test_loaded_model_keeps_selector_summary(tmp_path):
     assert s is not None
     assert s.best_model_type == "OpLogisticRegression"
     assert loaded.summary_json()["selectedModel"]["validationMetric"] == "auPR"
+
+
+def test_workflow_validate_reports_unserializable_and_untraceable():
+    """Workflow.validate — the checkSerializable/jittability analog
+    (reference OpWorkflow.scala:280-324): lambda-closure stages are
+    reported unserializable; device stages must trace under eval_shape;
+    a clean workflow reports nothing."""
+    import numpy as np
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import feature_types as ft
+    import transmogrifai_tpu.dsl  # noqa: F401
+
+    n = 24
+    rng = np.random.default_rng(0)
+    frame = fr.HostFrame.from_dict({
+        "x": (ft.Real, rng.normal(size=n).tolist()),
+        "label": (ft.RealNN, rng.integers(0, 2, n).astype(float).tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    clean = feats["x"].vectorize()
+    wf = Workflow().set_input_frame(frame).set_result_features(clean)
+    report = wf.validate(sample_frame=frame)
+    assert report["unserializable"] == {}
+    assert report["untraceable"] == {}
+
+    # a closure-capturing lambda stage is flagged by uid, not raised
+    bad = feats["x"].map(lambda v: v, out_type=ft.Real)
+    wf2 = Workflow().set_input_frame(frame).set_result_features(bad)
+    report2 = wf2.validate()
+    assert bad.origin_stage.uid in report2["unserializable"]
+
+
+def test_workflow_validate_records_layer_failures():
+    """A layer that cannot even apply on the sample frame is a finding,
+    not a silent all-clear."""
+    import numpy as np
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import feature_types as ft
+    import transmogrifai_tpu.dsl  # noqa: F401
+
+    frame = fr.HostFrame.from_dict({
+        "t": (ft.Text, ["a", "bb", None, "ccc"]),
+    })
+    feats = FeatureBuilder.from_frame(frame)
+
+    def boom(v):
+        raise RuntimeError("kaboom")
+
+    bad = feats["t"].map(boom, out_type=ft.Text)
+    wf = Workflow().set_input_frame(frame).set_result_features(bad)
+    report = wf.validate(sample_frame=frame)
+    assert report["layer_failures"], report
+    assert "kaboom" in report["layer_failures"][0]

@@ -376,10 +376,12 @@ class OpLogisticRegression(_LinearPredictor):
 
     _NEWTON_MAX_D = 2048
 
-    def _newton_ok(self, params, X, y) -> bool:
+    def _newton_ok(self, params, X, y, n_classes: Optional[int] = None
+                   ) -> bool:
         return (float(params.get("elastic_net_param", 0.0)) == 0.0
                 and int(X.shape[1]) <= self._NEWTON_MAX_D
-                and self._n_classes(y) == 2)
+                and (n_classes if n_classes is not None
+                     else self._n_classes(y)) == 2)
 
     def fit_arrays(self, X, y, w, params):
         params = {**self.params, **params}
@@ -395,8 +397,9 @@ class OpLogisticRegression(_LinearPredictor):
         if not grid:
             return []
         merged = [{**self.params, **g} for g in grid]
+        n_classes = self._n_classes(y)  # ONE device sync for the whole grid
         newton_idx = [i for i, g in enumerate(merged)
-                      if self._newton_ok(g, X, y)]
+                      if self._newton_ok(g, X, y, n_classes)]
         if not newton_idx:
             return super().grid_fit_arrays(X, y, w, grid)
         adam_idx = [i for i in range(len(grid)) if i not in set(newton_idx)]

@@ -520,10 +520,13 @@ class _TreePredictor(Predictor):
             return quantile_bin_edges_device(X, max_bins=max_bins)
         return jnp.asarray(quantile_bin_edges(np.asarray(X), max_bins))
 
-    def fit_arrays(self, X, y, w, params, _binned=None):
+    def fit_arrays(self, X, y, w, params, _binned=None, _lnb=None):
         params = {self._ALIASES.get(k, k): v for k, v in params.items()}
         p = {**self.default_params, **params}
-        loss, n_out, base = self._loss_and_nout(y)
+        # (loss, n_out, base) involves blocking device->host scalar pulls
+        # (max/mean of y); grid sweeps compute it once and thread it here
+        loss, n_out, base = _lnb if _lnb is not None \
+            else self._loss_and_nout(y)
         if _binned is not None and int(p["max_bins"]) == _binned[2]:
             edges, Xb = _binned[0], _binned[1]
         else:
@@ -572,6 +575,7 @@ class _TreePredictor(Predictor):
         merged = [{self._ALIASES.get(k, k): v for k, v in g.items()}
                   for g in grid]
         binned: dict[int, tuple] = {}
+        lnb = self._loss_and_nout(y)  # ONE device sync for the whole grid
         models = []
         for g in merged:
             mb = int({**self.default_params, **self.params, **g}["max_bins"])
@@ -579,7 +583,7 @@ class _TreePredictor(Predictor):
                 edges = self._edges_of(X, mb)
                 binned[mb] = (edges, bin_data(X, edges), mb)
             models.append(self.fit_arrays(X, y, w, {**self.params, **g},
-                                          _binned=binned[mb]))
+                                          _binned=binned[mb], _lnb=lnb))
         return models
 
     def grid_predict_scores(self, models, X):
@@ -673,11 +677,12 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
     default_params = {**OpRandomForestClassifier.default_params,
                       "num_rounds": 1, "colsample": 1.0}
 
-    def fit_arrays(self, X, y, w, params, _binned=None):
+    def fit_arrays(self, X, y, w, params, _binned=None, _lnb=None):
         params = {**params, "num_rounds": 1, "colsample": 1.0}
         self.bootstrap = False  # a single tree sees the full sample
         try:
-            return super().fit_arrays(X, y, w, params, _binned=_binned)
+            return super().fit_arrays(X, y, w, params, _binned=_binned,
+                                      _lnb=_lnb)
         finally:
             self.bootstrap = True
 
@@ -686,11 +691,12 @@ class OpDecisionTreeRegressor(OpRandomForestRegressor):
     default_params = {**OpRandomForestRegressor.default_params,
                       "num_rounds": 1, "colsample": 1.0}
 
-    def fit_arrays(self, X, y, w, params, _binned=None):
+    def fit_arrays(self, X, y, w, params, _binned=None, _lnb=None):
         params = {**params, "num_rounds": 1, "colsample": 1.0}
         self.bootstrap = False
         try:
-            return super().fit_arrays(X, y, w, params, _binned=_binned)
+            return super().fit_arrays(X, y, w, params, _binned=_binned,
+                                      _lnb=_lnb)
         finally:
             self.bootstrap = True
 

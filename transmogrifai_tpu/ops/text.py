@@ -140,9 +140,26 @@ def detect_language(text: str) -> Optional[str]:
     return detect_language_ngram(text)
 
 
+_TAG_RE = re.compile(
+    r"<!--.*?-->|<script\b.*?</script\s*>|<style\b.*?</style\s*>|<[^>]*>",
+    re.IGNORECASE | re.DOTALL)
+
+
+def strip_html(text: str) -> str:
+    """Lucene HTMLStripCharFilter analog: drop tags/comments/script/style
+    bodies, decode entities (stdlib ``html.unescape``: full named/decimal/
+    hex table, single-pass so ``&amp;lt;`` stays ``&lt;``, graceful on
+    out-of-range numeric references), keep the visible text."""
+    import html as _html
+    out = _html.unescape(_TAG_RE.sub(" ", text))
+    return out.replace("\xa0", " ")  # &nbsp; decodes to NBSP; normalize
+
+
 class TextTokenizer(HostTransformer):
-    """Text -> TextList of analyzed tokens (language-aware stopword filter
-    when ``auto_detect_language``)."""
+    """Text -> TextList through the analyzer chain (reference
+    ``TextTokenizer.scala:293`` via Lucene): optional HTML stripping,
+    tokenization, language-aware stopword filter, Porter stemming for
+    English (the EnglishAnalyzer's PorterStemFilter stage)."""
 
     in_types = (ft.Text,)
     out_type = ft.TextList
@@ -151,23 +168,34 @@ class TextTokenizer(HostTransformer):
                  auto_detect_language: bool = False,
                  filter_stopwords: bool = False,
                  default_language: str = "en",
+                 strip_html_tags: bool = False,
+                 stem: bool = False,
                  uid: Optional[str] = None):
         self.lowercase = lowercase
         self.min_token_length = min_token_length
         self.auto_detect_language = auto_detect_language
         self.filter_stopwords = filter_stopwords
         self.default_language = default_language
+        self.strip_html_tags = strip_html_tags
+        self.stem = stem
         super().__init__(uid=uid)
 
     def transform_row(self, value):
         if value is None:
             return []
+        if self.strip_html_tags:
+            value = strip_html(value)
         toks = simple_tokenize(value, self.lowercase, self.min_token_length)
-        if self.filter_stopwords:
+        lang = None
+        if self.filter_stopwords or self.stem:
             lang = (detect_language(value) if self.auto_detect_language
                     else self.default_language) or self.default_language
+        if self.filter_stopwords:
             stop = STOP_WORDS.get(lang, frozenset())
             toks = [t for t in toks if t not in stop]
+        if self.stem and lang == "en":
+            from transmogrifai_tpu.ops.stemmer import porter_stem
+            toks = [porter_stem(t) for t in toks]
         return toks
 
 

@@ -88,6 +88,80 @@ class Workflow:
         return [[self._model_stage_overrides.get(s.get_output().uid, s)
                  for s in layer] for layer in dag]
 
+    def validate(self, sample_frame: Optional[fr.HostFrame] = None) -> dict:
+        """Pre-train stage validation — the TPU analog of the reference's
+        ``checkSerializable`` + ``checkCtorUIDs`` (``OpWorkflow.scala:
+        280-324``): where the reference verifies closures can ship to Spark
+        executors, the compiled-program equivalent verifies each stage (a)
+        has distinct uids and wired inputs (raised inside ``compute_dag``),
+        (b) can serialize (``config``/``fitted_state`` don't raise — a saved
+        model will round-trip), and (c) for device transformers, TRACES
+        under abstract shapes (``jax.eval_shape`` on a sample frame): a
+        stage with data-dependent Python control flow fails here with its
+        uid named, instead of deep inside a fused layer compile.
+
+        Returns {"unserializable": {uid: reason}, "untraceable":
+        {uid: reason}, "layer_failures": [reason]} — a layer that cannot
+        even APPLY on the sample is itself a finding (and stops deeper
+        tracing). Raises only on structural problems (duplicate uids).
+        Training is NOT blocked by findings — saving a model with
+        unserializable stages raises at save time, as always.
+        """
+        from transmogrifai_tpu.stages.base import (
+            DeviceTransformer, Estimator,
+        )
+        report: dict = {"unserializable": {}, "untraceable": {},
+                        "layer_failures": []}
+        dag = self._substitute_fitted(compute_dag(self.result_features))
+        stages = [s for layer in dag for s in layer]
+        for s in stages:
+            try:
+                s.config()
+                if hasattr(s, "fitted_state"):
+                    s.fitted_state()
+            except Exception as e:  # noqa: BLE001 — report, don't raise
+                report["unserializable"][s.uid] = (
+                    f"{type(s).__name__}: {e}")
+        if sample_frame is not None:
+            import jax
+            data = PipelineData.from_host(sample_frame)
+            for layer in dag:
+                fitted = []
+                for s in layer:
+                    if isinstance(s, Estimator):
+                        try:
+                            s = s.fit(data)
+                        except Exception as e:  # noqa: BLE001
+                            report["untraceable"][s.uid] = (
+                                f"{type(s).__name__} fit on sample: {e}")
+                            continue
+                    fitted.append(s)
+                for t in fitted:
+                    if not isinstance(t, DeviceTransformer):
+                        continue
+                    try:
+                        cols = [data.device_col(n)
+                                for n in t.runtime_input_names()]
+                        params = t.device_params()
+                        jax.eval_shape(
+                            lambda p, c, _t=t: _t.device_apply(p, *c),
+                            params, cols)
+                    except Exception as e:  # noqa: BLE001
+                        report["untraceable"][t.uid] = (
+                            f"{type(t).__name__}: {e}")
+                try:
+                    data = DagExecutor().apply_layer(data, fitted)
+                except Exception as e:  # noqa: BLE001
+                    # a silently-clean report for a workflow that cannot
+                    # run would be a false all-clear: record + stop (the
+                    # downstream layers lack inputs now)
+                    report["layer_failures"].append(
+                        f"layer [{', '.join(t.uid for t in fitted)}] "
+                        f"failed to apply on the sample: "
+                        f"{type(e).__name__}: {e}")
+                    break
+        return report
+
     def compute_data_up_to(self, feature: FeatureLike) -> fr.HostFrame:
         """Materialize the data with all transformations applied up to (and
         including) ``feature`` (reference ``OpWorkflow.computeDataUpTo``) —
