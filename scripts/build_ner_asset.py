@@ -1,0 +1,106 @@
+"""Build the packaged English NER asset (assets/ner_en.npz).
+
+The reference ships pretrained OpenNLP binaries under
+``models/src/main/resources/OpenNLP``; this builds the TPU repo's
+equivalent from the embedded multi-cultural name/location dictionaries
+(ops/names.py): a templated corpus is synthesized over the dictionaries
+(with held-out entries!), the averaged perceptron trains, the model's
+held-out token accuracy is printed, and the asset is written where
+``TRANSMOGRIFAI_NER_MODEL`` can point.
+
+Run: ``python scripts/build_ner_asset.py [out.npz]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from transmogrifai_tpu.ops.names import (
+    FEMALE_NAMES, LOCATIONS, MALE_NAMES, ORG_SUFFIXES, SURNAMES,
+)
+from transmogrifai_tpu.ops.ner import train_tagger
+
+TEMPLATES = [
+    (["{first}", "{last}", "visited", "{loc}", "last", "week"],
+     ["PER", "PER", "O", "LOC", "O", "O"]),
+    (["{first}", "{last}", "flew", "to", "{loc}"],
+     ["PER", "PER", "O", "O", "LOC"]),
+    (["the", "{org}", "{suffix}", "office", "in", "{loc}"],
+     ["O", "ORG", "ORG", "O", "O", "LOC"]),
+    (["{first}", "joined", "{org}", "{suffix}", "in", "{loc}"],
+     ["PER", "O", "ORG", "ORG", "O", "LOC"]),
+    (["contact", "{first}", "{last}", "at", "{org}", "{suffix}"],
+     ["O", "PER", "PER", "O", "ORG", "ORG"]),
+    (["{loc}", "is", "hiring", "for", "{org}", "{suffix}"],
+     ["LOC", "O", "O", "O", "ORG", "ORG"]),
+    (["meeting", "with", "{first}", "tomorrow"],
+     ["O", "O", "PER", "O"]),
+    (["invoice", "42", "from", "{org}", "{suffix}"],
+     ["O", "O", "O", "ORG", "ORG"]),
+    (["mark", "the", "date", "and", "sign", "here"],  # ambiguity negatives
+     ["O", "O", "O", "O", "O", "O"]),
+]
+
+#: synthetic org stems (the dictionaries carry suffixes, not stems)
+ORG_STEMS = ["acme", "initech", "globex", "umbrella", "hooli", "vandelay",
+             "cyberdyne", "tyrell", "aperture", "soylent", "wonka",
+             "duff", "oceanic", "virtucon", "gringotts", "monarch"]
+
+
+def synth(first, last, locs, n, seed):
+    rng = np.random.default_rng(seed)
+    first, last, locs = list(first), list(last), list(locs)
+    suffixes = [s.capitalize() for s in sorted(ORG_SUFFIXES)]
+    sents, tags = [], []
+    for _ in range(n):
+        toks, tg = TEMPLATES[rng.integers(len(TEMPLATES))]
+        sub = {"{first}": first[rng.integers(len(first))].capitalize(),
+               "{last}": last[rng.integers(len(last))].capitalize(),
+               "{loc}": locs[rng.integers(len(locs))].capitalize(),
+               "{org}": ORG_STEMS[rng.integers(len(ORG_STEMS))].capitalize(),
+               "{suffix}": suffixes[rng.integers(len(suffixes))]}
+        sents.append([sub.get(t, t) for t in toks])
+        tags.append(list(tg))
+    return sents, tags
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(__file__), "..", "transmogrifai_tpu", "assets",
+        "ner_en.npz")
+    firsts = sorted(MALE_NAMES | FEMALE_NAMES)
+    lasts = sorted(SURNAMES)
+    locs = sorted(LOCATIONS)
+    # hold out 20% of every dictionary: accuracy is generalization, not
+    # memorization of the training vocabulary
+    cut_f, cut_l, cut_c = (len(firsts) * 4 // 5, len(lasts) * 4 // 5,
+                           len(locs) * 4 // 5)
+    dicts = {"first": frozenset(firsts), "last": frozenset(lasts),
+             "loc": frozenset(locs)}
+    train_s, train_t = synth(firsts[:cut_f], lasts[:cut_l], locs[:cut_c],
+                             4000, seed=7)
+    tagger = train_tagger(train_s, train_t, dicts=dicts, epochs=5)
+
+    test_s, test_t = synth(firsts[cut_f:], lasts[cut_l:], locs[cut_c:],
+                           500, seed=1234)
+    correct = total = 0
+    for toks, gold in zip(test_s, test_t):
+        pred = tagger.tag(toks)
+        correct += sum(p == g for p, g in zip(pred, gold))
+        total += len(gold)
+    acc = correct / total
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    tagger.save(out)
+    size_kb = os.path.getsize(out) / 1024
+    print(f"held-out token accuracy {acc:.4f}; asset {out} "
+          f"({size_kb:.0f} KB)")
+    return 0 if acc > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""Trainable/loadable NER (parity: reference OpenNLP asset pipeline —
+OpenNLPNameEntityTagger.scala + models/src/main/resources/OpenNLP): the
+tagger must LEARN from a corpus, beat the heuristic baseline on held-out
+sentences, round-trip through the .npz asset format, and drive
+NameEntityRecognizer via the TRANSMOGRIFAI_NER_MODEL hook."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.ner import (
+    TAGS, ViterbiTagger, load_tagger, train_tagger,
+)
+
+FIRST = ["john", "mary", "robert", "linda", "james", "sarah", "kevin",
+         "nancy", "brian", "laura"]
+LAST = ["smith", "jones", "brown", "white", "miller", "davis", "clark",
+        "lewis", "walker", "hall"]
+CITY = ["paris", "london", "tokyo", "berlin", "madrid", "cairo", "sydney",
+        "toronto", "nairobi", "lima"]
+ORG = ["acme", "initech", "globex", "umbrella", "hooli", "stark", "wayne",
+       "cyberdyne", "tyrell", "aperture"]
+
+
+def _corpus(n, seed):
+    rng = np.random.default_rng(seed)
+    sents, tags = [], []
+    for _ in range(n):
+        f = FIRST[rng.integers(len(FIRST))].capitalize()
+        l = LAST[rng.integers(len(LAST))].capitalize()
+        c = CITY[rng.integers(len(CITY))].capitalize()
+        o = ORG[rng.integers(len(ORG))].capitalize()
+        form = rng.integers(3)
+        if form == 0:
+            sents.append([f, l, "flew", "to", c, "yesterday"])
+            tags.append(["PER", "PER", "O", "O", "LOC", "O"])
+        elif form == 1:
+            sents.append(["The", o, "Corp", "office", "in", c, "closed"])
+            tags.append(["O", "ORG", "ORG", "O", "O", "LOC", "O"])
+        else:
+            sents.append([f, "joined", o, "Inc", "in", c])
+            tags.append(["PER", "O", "ORG", "ORG", "O", "LOC"])
+    return sents, tags
+
+
+@pytest.fixture(scope="module")
+def trained():
+    dicts = {"first": frozenset(FIRST), "last": frozenset(LAST),
+             "city": frozenset(CITY)}
+    sents, tags = _corpus(300, seed=1)
+    return train_tagger(sents, tags, dicts=dicts, epochs=4), dicts
+
+
+def test_tagger_learns_and_generalizes(trained):
+    tagger, _ = trained
+    test_s, test_t = _corpus(80, seed=99)  # unseen combinations
+    correct = total = 0
+    for toks, gold in zip(test_s, test_t):
+        pred = tagger.tag(toks)
+        correct += sum(p == g for p, g in zip(pred, gold))
+        total += len(gold)
+    acc = correct / total
+    assert acc > 0.95, f"token accuracy {acc:.3f}"
+
+
+def test_tagger_asset_round_trip(trained, tmp_path):
+    tagger, _ = trained
+    path = str(tmp_path / "ner_model.npz")
+    tagger.save(path)
+    loaded = load_tagger(path)
+    toks = ["Mary", "Davis", "visited", "Berlin"]
+    assert loaded.tag(toks) == tagger.tag(toks)
+    assert loaded.dicts.keys() == tagger.dicts.keys()
+
+
+def test_recognizer_uses_loaded_model(trained, tmp_path, monkeypatch):
+    tagger, _ = trained
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    # direct model injection
+    rec = NameEntityRecognizer(model=tagger)
+    tags = rec.transform_row("Linda Walker joined Hooli Inc in Tokyo")
+    assert "Person" in tags.get("linda", set())
+    assert "Person" in tags.get("walker", set())
+    assert "Organization" in tags.get("hooli", set())
+    assert "Location" in tags.get("tokyo", set())
+    # env-hook autoload path
+    path = str(tmp_path / "hook_model.npz")
+    tagger.save(path)
+    import transmogrifai_tpu.ops.ner as ner_mod
+    monkeypatch.setenv("TRANSMOGRIFAI_NER_MODEL", path)
+    monkeypatch.setitem(ner_mod._loaded, "tried", False)
+    monkeypatch.setitem(ner_mod._loaded, "tagger", None)
+    rec2 = NameEntityRecognizer()
+    tags2 = rec2.transform_row("Sarah Hall flew to Madrid yesterday")
+    assert "Person" in tags2.get("sarah", set())
+    assert "Location" in tags2.get("madrid", set())
+
+
+def test_recognizer_heuristic_fallback_without_model(monkeypatch):
+    import transmogrifai_tpu.ops.ner as ner_mod
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    monkeypatch.delenv("TRANSMOGRIFAI_NER_MODEL", raising=False)
+    monkeypatch.setitem(ner_mod._loaded, "tried", False)
+    monkeypatch.setitem(ner_mod._loaded, "tagger", None)
+    rec = NameEntityRecognizer()
+    tags = rec.transform_row("John Smith works at Acme Corp in Paris")
+    assert "Person" in tags.get("john", set())
+
+
+def test_viterbi_transitions_matter():
+    """With emissions tied, the transition matrix must drive the decode —
+    the sequence structure is real, not per-token argmax."""
+    t = ViterbiTagger()
+    t.transitions[TAGS.index("PER"), TAGS.index("PER")] = 2.0
+    t.transitions[TAGS.index("O"), TAGS.index("O")] = 1.0
+    # 3 tokens, all-zero emissions: best path is the O->O->O chain unless
+    # something seeds PER; seed the first token
+    import transmogrifai_tpu.ops.ner as ner_mod
+    fs = ner_mod.token_features(["Aaa", "Bbb", "Ccc"], 0)
+    t.weights[TAGS.index("PER"), fs] = 1.0
+    assert t.tag(["Aaa", "Bbb", "Ccc"])[:2] == ["PER", "PER"]
+
+
+def test_packaged_asset_loads_and_tags():
+    """The shipped asset (scripts/build_ner_asset.py -> assets/ner_en.npz)
+    is the OpenNLP-binaries analog: it must load and tag correctly on
+    names NOT in its training split."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "transmogrifai_tpu", "assets", "ner_en.npz")
+    if not os.path.exists(path):
+        pytest.skip("packaged asset not built")
+    tagger = load_tagger(path)
+    tags = tagger.tag(["Yuki", "Yamamoto", "flew", "to", "Lagos"])
+    assert tags[2:4] == ["O", "O"]
+    assert tags[4] == "LOC" or tags[0] == "PER"  # dictionary-driven
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    rec = NameEntityRecognizer(model=tagger)
+    out = rec.transform_row("Amara Okafor joined Initech Corp in Nairobi")
+    assert "Organization" in out.get("initech", set())
+    assert "Location" in out.get("nairobi", set())
+
+
+def test_recognizer_model_path_serializes(trained, tmp_path):
+    """model_path round-trips through config(); a directly-injected model
+    refuses to serialize (review r3)."""
+    tagger, _ = trained
+    path = str(tmp_path / "m.npz")
+    tagger.save(path)
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    rec = NameEntityRecognizer(model_path=path)
+    cfg = rec.config()
+    assert cfg["model_path"] == path
+    rec2 = NameEntityRecognizer(**cfg)
+    s = "Linda Walker joined Hooli Inc in Tokyo"
+    assert rec2.transform_row(s) == rec.transform_row(s)
+    with pytest.raises(NotImplementedError):
+        NameEntityRecognizer(model=tagger).config()
+
+
+def test_recognizer_capitalization_gate_applies_to_model(trained):
+    tagger, _ = trained
+    from transmogrifai_tpu.ops.names import NameEntityRecognizer
+    rec = NameEntityRecognizer(model=tagger, require_capitalized=True)
+    tags = rec.transform_row("linda walker joined Hooli Inc in Tokyo")
+    assert "linda" not in tags  # lowercase filtered by the configured gate
+    rec2 = NameEntityRecognizer(model=tagger, require_capitalized=False)
+    assert rec2.transform_row("Linda Walker flew to Tokyo")

@@ -259,3 +259,27 @@ def test_raw_feature_filter_all_keys_dead_drops_feature():
     assert blocklist == ["m"]
     assert any("every map key excluded" in r
                for r in rff.results.exclusion_reasons["m"])
+
+
+def test_raw_feature_filter_results_reset_between_runs():
+    """filter_frame must not leak a previous run's per-key exclusions into
+    a retrain on refreshed data (review r3): a key that was sparse before
+    but healthy now must survive."""
+    n = 100
+    y = np.zeros(n)
+    sparse_maps = [({"k": 1.0} if i == 0 else {"other": 1.0})
+                   for i in range(n)]
+    healthy_maps = [{"k": float(i), "other": 1.0} for i in range(n)]
+    feats = [FeatureBuilder.RealMap("m").as_predictor(),
+             FeatureBuilder.RealNN("label").as_response()]
+    rff = RawFeatureFilter(min_fill=0.05)
+
+    frame1 = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, sparse_maps), "label": (ft.RealNN, y.tolist())})
+    rff.filter_frame(frame1, feats)
+    assert rff.results.map_key_blocklist == {"m": ["k"]}
+
+    frame2 = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, healthy_maps), "label": (ft.RealNN, y.tolist())})
+    rff.filter_frame(frame2, feats)
+    assert rff.results.map_key_blocklist == {}

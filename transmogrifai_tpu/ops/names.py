@@ -326,29 +326,69 @@ class HumanNameDetectorModel(HostTransformer):
                 "gender": gender}
 
 
+#: ViterbiTagger IO tags -> the reference's entity names
+_NER_LABELS = {"PER": "Person", "LOC": "Location", "ORG": "Organization"}
+
+
 class NameEntityRecognizer(HostTransformer):
     """Text -> MultiPickListMap token -> {entity tags}.
 
-    The reference runs OpenNLP's binary NER models per sentence; here a
-    dictionary/heuristic tagger over Person (first names + surnames, with a
-    capitalized-followed-by-surname bigram rule), Location, and Organization
-    (capitalized token preceding a corporate suffix). Capitalization
-    distinguishes 'Mark asked' from 'mark the date' — the same
-    disambiguation role the statistical model plays."""
+    The reference runs OpenNLP's binary NER models per sentence; the same
+    asset pipeline here: when a sequence model is loaded (``ops/ner.py``
+    ``TRANSMOGRIFAI_NER_MODEL`` hook, or passed directly) the tagger's
+    Viterbi decode drives the tags; otherwise a dictionary/heuristic tagger
+    over Person (first names + surnames, with a capitalized-followed-by-
+    surname bigram rule), Location, and Organization (capitalized token
+    preceding a corporate suffix). Capitalization distinguishes 'Mark
+    asked' from 'mark the date' — the same disambiguation role the
+    statistical model plays."""
 
     in_types = (ft.Text,)
     out_type = ft.MultiPickListMap
 
     def __init__(self, require_capitalized: bool = True,
+                 model=None, model_path: Optional[str] = None,
                  uid: Optional[str] = None):
         self.require_capitalized = bool(require_capitalized)
+        self.model_path = model_path
+        if model is None and model_path:
+            from transmogrifai_tpu.ops.ner import load_tagger
+            model = load_tagger(model_path)
+        self.model = model
         super().__init__(uid=uid)
+
+    def config(self) -> dict:
+        # `model` is an in-memory ViterbiTagger (numpy arrays) — persist
+        # the PATH, not the object; a directly-injected pathless model
+        # cannot round-trip (same contract as unserializable lambdas)
+        if self.model is not None and not self.model_path:
+            raise NotImplementedError(
+                "NameEntityRecognizer with a directly-injected model is "
+                "not serializable; pass model_path=... instead")
+        return {"require_capitalized": self.require_capitalized,
+                "model_path": self.model_path}
+
+    def _tagger(self):
+        if self.model is not None:
+            return self.model
+        from transmogrifai_tpu.ops.ner import default_tagger
+        return default_tagger()
 
     def transform_row(self, value):
         if not value:
             return {}
         raw_toks = _TOKEN_RE.findall(value)
         out: dict[str, set] = {}
+        tagger = self._tagger()
+        if tagger is not None:
+            for tok, io_tag in zip(raw_toks, tagger.tag(raw_toks)):
+                label = _NER_LABELS.get(io_tag)
+                # the configured capitalization gate applies on the model
+                # path too — ambient env state must not change semantics
+                if label and (not self.require_capitalized
+                              or tok[:1].isupper()):
+                    out.setdefault(tok.lower(), set()).add(label)
+            return out
 
         def tag(token: str, label: str) -> None:
             out.setdefault(token.lower(), set()).add(label)

@@ -1,0 +1,203 @@
+"""Trainable/loadable named-entity sequence tagger (asset-scale NER hook).
+
+Parity: reference ``core/.../utils/text/OpenNLPNameEntityTagger.scala`` +
+the binary MaxEnt models under ``models/src/main/resources/OpenNLP`` — the
+reference's NER quality comes from *pretrained assets* loaded at runtime.
+This module provides the TPU build's equivalent asset pipeline:
+
+- a linear-chain tagger: per-token hashed features (identity, shape,
+  affixes, context, dictionary membership) scored by per-tag weight
+  vectors + a tag-transition matrix, decoded with Viterbi;
+- averaged-perceptron training (``train_tagger``) so models can be built
+  from any token/tag corpus;
+- an ``.npz`` asset format with save/load and the
+  ``TRANSMOGRIFAI_NER_MODEL`` environment hook (mirrors the
+  ``TRANSMOGRIFAI_NAME_DICT`` dictionary hook in ops/names.py);
+- ``NameEntityRecognizer`` (ops/names.py) consumes a loaded model when one
+  is present and falls back to its dictionary/heuristic tagger otherwise.
+
+The decoder is intentionally host-side: NER happens at ingest/feature
+extraction on strings, never on the device path (SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ViterbiTagger", "train_tagger", "load_tagger", "default_tagger"]
+
+#: tagset (IO scheme — OpenNLP's person/location/organization finders)
+TAGS = ("O", "PER", "LOC", "ORG")
+_TAG_IDX = {t: i for i, t in enumerate(TAGS)}
+
+#: hashed feature space per tag
+DIM = 1 << 17
+
+
+def _h(s: str) -> int:
+    return zlib.crc32(s.encode("utf-8")) % DIM
+
+
+def _shape(tok: str) -> str:
+    out = []
+    for ch in tok[:4]:
+        out.append("X" if ch.isupper() else
+                   "x" if ch.islower() else
+                   "d" if ch.isdigit() else ch)
+    return "".join(out)
+
+
+def token_features(tokens: Sequence[str], i: int,
+                   dicts: Optional[dict] = None) -> list[int]:
+    """Hashed feature ids for position i (identity/shape/affix/context +
+    dictionary membership when dictionaries are supplied)."""
+    tok = tokens[i]
+    low = tok.lower()
+    prev = tokens[i - 1].lower() if i > 0 else "<s>"
+    nxt = tokens[i + 1].lower() if i + 1 < len(tokens) else "</s>"
+    feats = [
+        _h("w=" + low), _h("shape=" + _shape(tok)),
+        _h("pre3=" + low[:3]), _h("suf3=" + low[-3:]),
+        _h("prev=" + prev), _h("next=" + nxt),
+        _h("cap=" + str(tok[:1].isupper())),
+        _h("pos=" + ("first" if i == 0 else "in")),
+    ]
+    if dicts:
+        for name, vocab in dicts.items():
+            if low in vocab:
+                feats.append(_h("dict=" + name))
+    return feats
+
+
+class ViterbiTagger:
+    """Linear-chain tagger: emissions from hashed-feature weights, first-
+    order transitions, exact Viterbi decoding."""
+
+    def __init__(self, weights: Optional[np.ndarray] = None,
+                 transitions: Optional[np.ndarray] = None,
+                 dicts: Optional[dict] = None):
+        T = len(TAGS)
+        self.weights = (weights if weights is not None
+                        else np.zeros((T, DIM), np.float32))
+        self.transitions = (transitions if transitions is not None
+                            else np.zeros((T, T), np.float32))
+        self.dicts = dicts or {}
+
+    def _emissions(self, tokens: Sequence[str]) -> np.ndarray:
+        T = len(TAGS)
+        out = np.zeros((len(tokens), T), np.float32)
+        for i in range(len(tokens)):
+            fs = token_features(tokens, i, self.dicts)
+            out[i] = self.weights[:, fs].sum(axis=1)
+        return out
+
+    def tag(self, tokens: Sequence[str]) -> list[str]:
+        n = len(tokens)
+        if n == 0:
+            return []
+        T = len(TAGS)
+        em = self._emissions(tokens)
+        score = np.full((n, T), -np.inf, np.float32)
+        back = np.zeros((n, T), np.int32)
+        score[0] = em[0]
+        for i in range(1, n):
+            # [prev, cur] candidate scores
+            cand = score[i - 1][:, None] + self.transitions + em[i][None, :]
+            back[i] = np.argmax(cand, axis=0)
+            score[i] = cand[back[i], np.arange(T)]
+        path = [int(np.argmax(score[-1]))]
+        for i in range(n - 1, 0, -1):
+            path.append(int(back[i, path[-1]]))
+        return [TAGS[t] for t in reversed(path)]
+
+    # -- asset format --------------------------------------------------------
+    def save(self, path: str) -> None:
+        arrs = {"weights": self.weights, "transitions": self.transitions}
+        for name, vocab in self.dicts.items():
+            arrs[f"dict_{name}"] = np.array(sorted(vocab), dtype="U")
+        np.savez_compressed(path, **arrs)
+
+    @staticmethod
+    def load(path: str) -> "ViterbiTagger":
+        data = np.load(path, allow_pickle=False)
+        dicts = {k[5:]: frozenset(str(v) for v in data[k])
+                 for k in data.files if k.startswith("dict_")}
+        return ViterbiTagger(weights=data["weights"].astype(np.float32),
+                             transitions=data["transitions"].astype(
+                                 np.float32),
+                             dicts=dicts)
+
+
+def train_tagger(sentences: Sequence[Sequence[str]],
+                 tag_seqs: Sequence[Sequence[str]],
+                 dicts: Optional[dict] = None,
+                 epochs: int = 5, seed: int = 0) -> ViterbiTagger:
+    """Averaged structured perceptron over Viterbi decodes — the classic
+    Collins (2002) trainer; small, dependency-free, and good enough to
+    build real assets from any token/tag corpus."""
+    T = len(TAGS)
+    w = np.zeros((T, DIM), np.float32)
+    trans = np.zeros((T, T), np.float32)
+    w_sum = np.zeros_like(w)
+    trans_sum = np.zeros_like(trans)
+    tagger = ViterbiTagger(w, trans, dicts)
+    rng = np.random.default_rng(seed)
+    order = np.arange(len(sentences))
+    steps = 0
+    for _ in range(epochs):
+        rng.shuffle(order)
+        for si in order:
+            toks, gold = sentences[si], tag_seqs[si]
+            pred = tagger.tag(toks)
+            steps += 1
+            if pred == list(gold):
+                continue
+            for i in range(len(toks)):
+                g, p = _TAG_IDX[gold[i]], _TAG_IDX[pred[i]]
+                if g != p:
+                    fs = token_features(toks, i, dicts)
+                    w[g, fs] += 1.0
+                    w[p, fs] -= 1.0
+                if i > 0:
+                    gp, pp = _TAG_IDX[gold[i - 1]], _TAG_IDX[pred[i - 1]]
+                    if (gp, g) != (pp, p):
+                        trans[gp, g] += 1.0
+                        trans[pp, p] -= 1.0
+            w_sum += w
+            trans_sum += trans
+    if steps:  # averaged weights generalize far better than the last ones
+        tagger.weights = (w_sum / steps).astype(np.float32)
+        tagger.transitions = (trans_sum / steps).astype(np.float32)
+    return tagger
+
+
+_loaded: dict = {"tried": False, "tagger": None}
+
+
+def load_tagger(path: str) -> ViterbiTagger:
+    return ViterbiTagger.load(path)
+
+
+def default_tagger() -> Optional[ViterbiTagger]:
+    """The asset hook: loads $TRANSMOGRIFAI_NER_MODEL (.npz) once, None
+    when unset/unloadable (callers fall back to heuristics)."""
+    if not _loaded["tried"]:
+        _loaded["tried"] = True
+        path = os.environ.get("TRANSMOGRIFAI_NER_MODEL")
+        if path and os.path.exists(path):
+            try:
+                _loaded["tagger"] = ViterbiTagger.load(path)
+            except Exception as e:  # noqa: BLE001
+                # an explicitly-requested model must not fail SILENTLY
+                # into the heuristic path
+                import warnings
+                warnings.warn(
+                    f"TRANSMOGRIFAI_NER_MODEL={path!r} failed to load "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "dictionary/heuristic tagger", RuntimeWarning)
+                _loaded["tagger"] = None
+    return _loaded["tagger"]
