@@ -255,3 +255,45 @@ def test_testkit_generator_breadth():
     geo = RandomGeolocation.geolocations(seed=20).with_prob_of_empty(
         0.5).limit(40)
     assert 5 < sum(1 for g in geo if g is None) < 35
+
+
+def test_loco_strategies():
+    """Reference LOCO strategies: Avg aggregation (mean of per-column
+    deltas) vs LeaveOutVector (zero the group at once), and
+    PositiveNegative topK (k/2 each sign) vs Abs."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
+    from transmogrifai_tpu.models.linear import LinearClassificationModel
+    from transmogrifai_tpu.vector_metadata import (
+        VectorColumnMetadata, VectorMetadata,
+    )
+
+    rng = np.random.default_rng(0)
+    n, d = 16, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = np.array([[-2.0, 2.0], [1.0, -1.0], [-0.5, 0.5], [0.1, -0.1]])
+    model = LinearClassificationModel(weights=W, intercept=np.zeros(2))
+    meta = VectorMetadata("v", tuple(
+        VectorColumnMetadata(("f",), "Real", grouping="f",
+                             descriptor_value=f"h_{j}")
+        for j in range(d))).reindexed(0)
+    col = fr.HostColumn(ft.OPVector, X, meta=meta)
+
+    lov = RecordInsightsLOCO(model=model, top_k=4).host_apply(col)
+    avg = RecordInsightsLOCO(model=model, top_k=4,
+                             aggregation_strategy="Avg").host_apply(col)
+    # all d columns share one group ('f::f'): LeaveOutVector zeroes all 4
+    # at once; Avg averages 4 single-column deltas — different numbers
+    v_lov = float(list(lov.values[0].values())[0])
+    v_avg = float(list(avg.values[0].values())[0])
+    assert v_lov != v_avg
+    # PositiveNegative surfaces both signs even when |positives| dominate
+    pn = RecordInsightsLOCO(model=model, top_k=2,
+                            aggregate_groups=False,
+                            top_k_strategy="PositiveNegative").host_apply(col)
+    signs = {np.sign(float(v)) for v in pn.values[0].values()}
+    assert signs == {1.0, -1.0}
+    import pytest
+    with pytest.raises(ValueError):
+        RecordInsightsLOCO(model=model, aggregation_strategy="nope")

@@ -207,3 +207,27 @@ def test_checkpointed_sweep_restarts(tmp_path):
     calls["n"] = 0
     _train(make_sel(grid=(1.0, 10.0)), frame)
     assert calls["n"] >= 2  # fingerprint mismatch -> full sweep reruns
+
+
+def test_newton_survives_collinear_onehot_reg0():
+    """reg_param=0 on a perfectly collinear one-hot block (pivot + OTHER +
+    null indicator sum to 1): the Newton/IRLS fast path must converge with
+    finite weights instead of amplifying the singular Hessian to NaN
+    (found driving LOCO over a Titanic fit, round 3)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    rng = np.random.default_rng(8)
+    n = 400
+    cls = rng.integers(0, 3, n)
+    onehot = np.eye(3, dtype=np.float32)[cls]
+    X = np.concatenate([onehot, 1.0 - onehot,          # collinear blocks
+                        rng.normal(size=(n, 2)).astype(np.float32)], axis=1)
+    y = ((cls == 0) | (X[:, -1] > 0.5)).astype(np.float64)
+    est = OpLogisticRegression()  # defaults: reg_param=0 -> Newton path
+    model = est.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(n, jnp.float32), est.params)
+    W = np.asarray(model.weights)
+    assert np.all(np.isfinite(W))
+    pred = model.predict_arrays(jnp.asarray(X))
+    acc = float((np.asarray(pred.prediction) == y).mean())
+    assert acc > 0.85

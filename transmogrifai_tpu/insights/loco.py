@@ -37,10 +37,23 @@ class RecordInsightsLOCO(HostTransformer):
 
     def __init__(self, model: Optional[PredictionModel] = None,
                  top_k: int = 20, aggregate_groups: bool = True,
+                 aggregation_strategy: str = "LeaveOutVector",
+                 top_k_strategy: str = "Abs",
                  uid: Optional[str] = None):
+        if aggregation_strategy not in ("LeaveOutVector", "Avg"):
+            raise ValueError(
+                f"unknown aggregation_strategy {aggregation_strategy!r}")
+        if top_k_strategy not in ("Abs", "PositiveNegative"):
+            raise ValueError(f"unknown top_k_strategy {top_k_strategy!r}")
         self.model = model
         self.top_k = top_k
         self.aggregate_groups = aggregate_groups
+        #: reference VectorAggregationStrategy: LeaveOutVector zeroes the
+        #: whole group at once; Avg averages the per-column LOCO deltas
+        self.aggregation_strategy = aggregation_strategy
+        #: reference TopKStrategy: Abs = top-k by |delta|;
+        #: PositiveNegative = top k/2 positive + top k/2 negative
+        self.top_k_strategy = top_k_strategy
         super().__init__(uid=uid)
 
     # -- grouping ------------------------------------------------------------
@@ -85,19 +98,40 @@ class RecordInsightsLOCO(HostTransformer):
         n, d = X.shape
         meta = col.meta
         groups = self._groups(meta, d)
-        masks = np.ones((len(groups), d), dtype=np.float32)
-        for gi, (_, idxs) in enumerate(groups):
-            masks[gi, idxs] = 0.0
         score = self._score_fn()
         base = score(X)                                     # [n]
-        deltas = jax.vmap(lambda m: base - score(X * m))(
-            jnp.asarray(masks))                              # [G, n]
-        deltas = np.asarray(deltas).T                        # [n, G]
+        if self.aggregation_strategy == "Avg":
+            # per-COLUMN deltas, averaged within each group (reference Avg
+            # strategy); vmap over indices with an in-trace one_hot so no
+            # O(d^2) mask matrix ever materializes (d can be 10k+ hashed)
+            col_deltas = jax.vmap(
+                lambda j: base - score(
+                    X * (1.0 - jax.nn.one_hot(j, d, dtype=X.dtype))))(
+                jnp.arange(d))                               # [d, n]
+            col_deltas = np.asarray(col_deltas)
+            deltas = np.stack([col_deltas[idxs].mean(axis=0)
+                               for _, idxs in groups]).T     # [n, G]
+        else:
+            masks = np.ones((len(groups), d), dtype=np.float32)
+            for gi, (_, idxs) in enumerate(groups):
+                masks[gi, idxs] = 0.0
+            deltas = jax.vmap(lambda m: base - score(X * m))(
+                jnp.asarray(masks))                          # [G, n]
+            deltas = np.asarray(deltas).T                    # [n, G]
         names = [g for g, _ in groups]
         out = np.empty(n, dtype=object)
         for i in range(n):
             row = deltas[i]
-            top = np.argsort(-np.abs(row))[:self.top_k]
+            if self.top_k_strategy == "PositiveNegative":
+                # top k/2 of each SIGN — never pad one side with the
+                # other's leftovers
+                half = max(self.top_k // 2, 1)
+                order = np.argsort(-row)
+                pos = [j for j in order[:half] if row[j] > 0]
+                neg = [j for j in order[::-1][:half] if row[j] < 0]
+                top = np.asarray(pos + neg, dtype=int)
+            else:
+                top = np.argsort(-np.abs(row))[:self.top_k]
             out[i] = {names[j]: f"{row[j]:.6f}" for j in top
                       if row[j] != 0.0}
         return fr.HostColumn(ft.TextMap, out)

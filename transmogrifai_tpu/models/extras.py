@@ -205,13 +205,13 @@ class OpMultilayerPerceptronClassifier(Predictor):
 # Generalized linear regression
 # ---------------------------------------------------------------------------
 
-_FAMILIES = ("gaussian", "binomial", "poisson", "gamma")
+_FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie")
 
 
 @functools.partial(jax.jit, static_argnames=("family", "max_iter",
                                              "fit_intercept"))
 def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
-               reg_param):
+               reg_param, var_power=jnp.float32(1.5)):
     n, d = X.shape
     wsum = jnp.maximum(jnp.sum(w), 1.0)
     mu = jnp.sum(X * w[:, None], axis=0) / wsum
@@ -229,6 +229,14 @@ def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
             ll = y * eta - jnp.logaddexp(0.0, eta)
         elif family == "poisson":
             ll = y * eta - jnp.exp(eta)
+        elif family == "tweedie":
+            # compound-Poisson quasi-likelihood, log link, 1 < p < 2
+            # (Spark GLR tweedie): ll = y*mu^(1-p)/(1-p) - mu^(2-p)/(2-p).
+            # Computed as exp(k*eta) directly: materializing mu = exp(eta)
+            # first overflows float32 at |eta| ~ 88 and poisons the scan
+            # with inf/nan long before these forms do
+            ll = (y * jnp.exp((1.0 - var_power) * eta) / (1.0 - var_power)
+                  - jnp.exp((2.0 - var_power) * eta) / (2.0 - var_power))
         else:  # gamma with log link (shape fixed)
             ll = -y * jnp.exp(-eta) - eta
         return -jnp.sum(ll * w) / wsum + reg_param * 0.5 * jnp.sum(beta ** 2)
@@ -300,17 +308,23 @@ class GLMModel(PredictionModel):
 
 class OpGeneralizedLinearRegression(Predictor):
     default_params = {"family": "gaussian", "reg_param": 0.0,
-                      "max_iter": 300, "fit_intercept": True}
+                      "max_iter": 300, "fit_intercept": True,
+                      "variance_power": 1.5}
 
     def fit_arrays(self, X, y, w, params):
         p = {**self.default_params, **params}
         family = p["family"]
         if family not in _FAMILIES:
             raise ValueError(f"Unknown GLM family {family!r}")
+        vp = float(p["variance_power"])
+        if family == "tweedie" and not 1.0 < vp < 2.0:
+            raise ValueError(
+                f"tweedie variance_power must be in (1, 2), got {vp}")
         beta, b0 = _train_glm(X, y, w, family=family,
                               max_iter=int(p["max_iter"]),
                               fit_intercept=bool(p["fit_intercept"]),
-                              reg_param=jnp.float32(p["reg_param"]))
+                              reg_param=jnp.float32(p["reg_param"]),
+                              var_power=jnp.float32(vp))
         return GLMModel(weights=np.asarray(beta), intercept=float(b0),
                         family=family)
 

@@ -142,11 +142,17 @@ def _train_logistic_newton(X, y, w, reg_param, *, n_iter: int = 15,
         R = w * jnp.maximum(p * (1.0 - p), 1e-6) / wsum
         g = Xb.T @ r + lam * penalty_mask * uv
         H = (Xb * R[:, None]).T @ Xb
-        H = H + jnp.diag(lam * penalty_mask + 1e-8)
+        # Levenberg damping sized to the problem: with reg_param=0 a
+        # perfectly collinear one-hot block makes H singular and a 1e-8
+        # ridge amplifies float32 noise to NaN within a few iterations
+        H = H + jnp.diag(lam * penalty_mask + 1e-4)
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
         if not fit_intercept:
             delta = delta.at[-1].set(0.0)
-        return uv - delta, 0.0
+        # a non-finite step (defective solve) must not poison the carry —
+        # keep the previous iterate instead
+        new = uv - delta
+        return jnp.where(jnp.all(jnp.isfinite(new)), new, uv), 0.0
 
     uv0 = jnp.zeros(d + 1, jnp.float32)
     uv, _ = jax.lax.scan(step, uv0, None, length=n_iter)

@@ -148,25 +148,31 @@ def train_tagger(sentences: Sequence[Sequence[str]],
     rng = np.random.default_rng(seed)
     order = np.arange(len(sentences))
     steps = 0
+
+    def update(toks, gold, pred):
+        for i in range(len(toks)):
+            g, p = _TAG_IDX[gold[i]], _TAG_IDX[pred[i]]
+            if g != p:
+                fs = token_features(toks, i, dicts)
+                w[g, fs] += 1.0
+                w[p, fs] -= 1.0
+            if i > 0:
+                gp, pp = _TAG_IDX[gold[i - 1]], _TAG_IDX[pred[i - 1]]
+                if (gp, g) != (pp, p):
+                    trans[gp, g] += 1.0
+                    trans[pp, p] -= 1.0
+
     for _ in range(epochs):
         rng.shuffle(order)
         for si in order:
             toks, gold = sentences[si], tag_seqs[si]
             pred = tagger.tag(toks)
             steps += 1
-            if pred == list(gold):
-                continue
-            for i in range(len(toks)):
-                g, p = _TAG_IDX[gold[i]], _TAG_IDX[pred[i]]
-                if g != p:
-                    fs = token_features(toks, i, dicts)
-                    w[g, fs] += 1.0
-                    w[p, fs] -= 1.0
-                if i > 0:
-                    gp, pp = _TAG_IDX[gold[i - 1]], _TAG_IDX[pred[i - 1]]
-                    if (gp, g) != (pp, p):
-                        trans[gp, g] += 1.0
-                        trans[pp, p] -= 1.0
+            if pred != list(gold):
+                update(toks, gold, pred)
+            # the Collins average is over EVERY step's weights — summing
+            # only at mistake steps would bias the average toward early
+            # noisy snapshots and underweight the converged weights
             w_sum += w
             trans_sum += trans
     if steps:  # averaged weights generalize far better than the last ones
@@ -188,7 +194,13 @@ def default_tagger() -> Optional[ViterbiTagger]:
     if not _loaded["tried"]:
         _loaded["tried"] = True
         path = os.environ.get("TRANSMOGRIFAI_NER_MODEL")
-        if path and os.path.exists(path):
+        if path and not os.path.exists(path):
+            import warnings
+            warnings.warn(
+                f"TRANSMOGRIFAI_NER_MODEL={path!r} does not exist; "
+                "falling back to the dictionary/heuristic tagger",
+                RuntimeWarning)
+        elif path:
             try:
                 _loaded["tagger"] = ViterbiTagger.load(path)
             except Exception as e:  # noqa: BLE001
