@@ -231,3 +231,49 @@ def test_transmogrify_with_maps_and_text():
     assert {"age", "bio", "email", "scores", "tags", "stamps"} <= parents
     groupings = {c.grouping for c in meta.columns}
     assert {"q1", "q2", "t", "s"} <= groupings  # map keys in provenance
+
+
+def test_smart_text_map_sensitive_keys():
+    """Map-variant name detection (reference SmartTextMapVectorizer's
+    NameDetectFun): a sensitive KEY is dropped from the expansion, the
+    other keys survive, and the detection reaches ModelInsights."""
+    n = 40
+    rng = np.random.default_rng(9)
+    y = rng.integers(0, 2, n).astype(float)
+    names = ["john smith", "mary jones", "robert brown", "linda white"]
+    maps = [{"who": names[i % 4], "color": ["red", "blue"][i % 2]}
+            for i in range(n)]
+    host = fr.HostFrame.from_dict({
+        "m": (ft.TextMap, maps),
+        "num": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    label = feats.pop("label")
+    from transmogrifai_tpu.ops.vectorizers.maps import SmartTextMapVectorizer
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    from transmogrifai_tpu.ops.vectorizers.numeric import RealVectorizer
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+    from transmogrifai_tpu.workflow import Workflow
+    mv = feats["m"].transform_with(SmartTextMapVectorizer(
+        detect_names=True, min_support=1))
+    num = feats["num"].transform_with(RealVectorizer())
+    vec = mv.transform_with(VectorsCombiner(), num)
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(max_iter=20), [{}])],
+        evaluators=[OpBinaryClassificationEvaluator()])
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_frame(host)
+             .set_result_features(pred).train())
+    fitted_map = [t for t in model.stages()
+                  if type(t).__name__ == "_SmartTextMapModel"][0]
+    assert fitted_map.keys == [["color"]]  # 'who' dropped as sensitive
+    info = fitted_map.sensitive_info()
+    assert info["m.who"]["detected"] is True
+    mi = model.model_insights().to_json()
+    assert mi["sensitiveFeatures"]["m.who"]["action"] == "removedFromVector"
+    # the record survives save/load
+    state = fitted_map.fitted_state()
+    assert state["sensitive"]["m.who"]["detected"] is True

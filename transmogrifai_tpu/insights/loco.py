@@ -103,14 +103,22 @@ class RecordInsightsLOCO(HostTransformer):
         if self.aggregation_strategy == "Avg":
             # per-COLUMN deltas, averaged within each group (reference Avg
             # strategy); vmap over indices with an in-trace one_hot so no
-            # O(d^2) mask matrix ever materializes (d can be 10k+ hashed)
+            # O(d^2) mask matrix ever materializes (d can be 10k+ hashed),
+            # and segment-mean down to [G, n] ON DEVICE — pulling the raw
+            # [d, n] matrix to host would move gigabytes at hashed widths
+            group_of = np.zeros(d, np.int32)
+            sizes = np.zeros(len(groups), np.float32)
+            for gi, (_, idxs) in enumerate(groups):
+                group_of[idxs] = gi
+                sizes[gi] = len(idxs)
             col_deltas = jax.vmap(
                 lambda j: base - score(
                     X * (1.0 - jax.nn.one_hot(j, d, dtype=X.dtype))))(
                 jnp.arange(d))                               # [d, n]
-            col_deltas = np.asarray(col_deltas)
-            deltas = np.stack([col_deltas[idxs].mean(axis=0)
-                               for _, idxs in groups]).T     # [n, G]
+            summed = jax.ops.segment_sum(
+                col_deltas, jnp.asarray(group_of),
+                num_segments=len(groups))                    # [G, n]
+            deltas = np.asarray(summed / jnp.asarray(sizes)[:, None]).T
         else:
             masks = np.ones((len(groups), d), dtype=np.float32)
             for gi, (_, idxs) in enumerate(groups):
@@ -123,12 +131,14 @@ class RecordInsightsLOCO(HostTransformer):
         for i in range(n):
             row = deltas[i]
             if self.top_k_strategy == "PositiveNegative":
-                # top k/2 of each SIGN — never pad one side with the
-                # other's leftovers
-                half = max(self.top_k // 2, 1)
+                # top ceil(k/2) positives + floor(k/2) negatives, each side
+                # capped at its own sign's supply — never pad one side with
+                # the other's leftovers, never exceed top_k
+                n_pos = (self.top_k + 1) // 2
+                n_neg = self.top_k - n_pos
                 order = np.argsort(-row)
-                pos = [j for j in order[:half] if row[j] > 0]
-                neg = [j for j in order[::-1][:half] if row[j] < 0]
+                pos = [j for j in order[:n_pos] if row[j] > 0]
+                neg = [j for j in order[::-1][:n_neg] if row[j] < 0]
                 top = np.asarray(pos + neg, dtype=int)
             else:
                 top = np.argsort(-np.abs(row))[:self.top_k]

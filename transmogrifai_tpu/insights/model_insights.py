@@ -81,7 +81,6 @@ class ModelInsights:
         label_f = model._label_feature(pred_f)
 
         from transmogrifai_tpu.ops.names import HumanNameDetectorModel
-        from transmogrifai_tpu.ops.smart_text import SmartTextModel
 
         selected: Optional[SelectedModel] = None
         sanity: Optional[DropIndicesModel] = None
@@ -99,9 +98,11 @@ class ModelInsights:
                     "genderResultsByStrategy":
                         info.get("genderResultsByStrategy", {}),
                 }
-            if isinstance(t, SmartTextModel):
-                # columns the smart vectorizer silently removed as
-                # name/sensitive — the removal must reach the report
+            if hasattr(t, "sensitive_info") and callable(t.sensitive_info):
+                # columns/keys a smart vectorizer removed as name/sensitive
+                # (scalar SmartTextModel, the map variant, and any future
+                # detector share this contract) — the removal must reach
+                # the report
                 sensitive.update(t.sensitive_info())
 
         problem = "unknown"

@@ -435,6 +435,15 @@ class GeolocationMapVectorizer(_MapVectorizerBase):
 class _SmartTextMapModel(_KeyedModelBase):
     in_types = (ft.TextMap,)
 
+    def __init__(self, **kw):
+        #: "feature.key" -> detection record for keys dropped as sensitive
+        #: (SensitiveFeatureInformation analog; merged into ModelInsights)
+        self.sensitive: dict = {}
+        super().__init__(**kw)
+
+    def sensitive_info(self) -> dict:
+        return dict(self.sensitive)
+
     def key_width(self, i, key):
         t = self.treatments[i][key]
         if t["kind"] == "pivot":
@@ -480,31 +489,51 @@ class _SmartTextMapModel(_KeyedModelBase):
 
     def _extra_state(self):
         return {"treatments": self.treatments,
-                "num_hash_features": self.num_hash_features}
+                "num_hash_features": self.num_hash_features,
+                "sensitive": self.sensitive}
 
 
 class SmartTextMapVectorizer(_MapVectorizerBase):
     """Per-key cardinality-adaptive pivot/hash (reference
-    SmartTextMapVectorizer)."""
+    SmartTextMapVectorizer), with optional per-key name/sensitive detection
+    (the map variant of the scalar SmartTextVectorizer's NameDetectFun):
+    keys whose values look like human names beyond ``name_threshold`` are
+    dropped from the expansion and RECORDED (``sensitive_info()`` reaches
+    ModelInsights like the scalar path)."""
 
     in_types = (ft.TextMap,)
 
     def __init__(self, max_cardinality: int = 100, top_k: int = 20,
-                 min_support: int = 10, num_hash_features: int = 128, **kw):
+                 min_support: int = 10, num_hash_features: int = 128,
+                 detect_names: bool = False, name_threshold: float = 0.5,
+                 **kw):
         super().__init__(max_cardinality=max_cardinality, top_k=top_k,
                          min_support=min_support,
-                         num_hash_features=num_hash_features, **kw)
+                         num_hash_features=num_hash_features,
+                         detect_names=detect_names,
+                         name_threshold=name_threshold, **kw)
 
     def fit_model(self, data):
+        from transmogrifai_tpu.ops.smart_text import looks_like_name
         keys, treatments = [], []
+        sensitive: dict[str, dict] = {}
         for name in self.input_names:
             per_key = self._collect(data.host_col(name), name)
-            ks = sorted(per_key)
-            keys.append(ks)
+            ks = []
             tr = {}
-            for k in ks:
+            for k in sorted(per_key):
+                vals = per_key[k]
+                if self.detect_names and vals:
+                    hits = sum(1 for v in vals if looks_like_name(str(v)))
+                    if hits / len(vals) >= self.name_threshold:
+                        sensitive[f"{name}.{k}"] = {
+                            "detected": True,
+                            "probName": hits / len(vals),
+                            "action": "removedFromVector"}
+                        continue  # sensitive key: never expands
+                ks.append(k)
                 stats = TextStats(max_cardinality=self.max_cardinality)
-                for v in per_key[k]:
+                for v in vals:
                     stats.add(v)
                 if not stats.overflowed:
                     cats = _top_k(list(stats.counts),
@@ -513,10 +542,13 @@ class SmartTextMapVectorizer(_MapVectorizerBase):
                     tr[k] = {"kind": "pivot", "categories": cats}
                 else:
                     tr[k] = {"kind": "hash"}
+            keys.append(ks)
             treatments.append(tr)
-        return _SmartTextMapModel(keys=keys, track_nulls=self.track_nulls,
-                                  treatments=treatments,
-                                  num_hash_features=self.num_hash_features)
+        model = _SmartTextMapModel(keys=keys, track_nulls=self.track_nulls,
+                                   treatments=treatments,
+                                   num_hash_features=self.num_hash_features)
+        model.sensitive = sensitive
+        return model
 
 
 # ---------------------------------------------------------------------------
