@@ -304,15 +304,14 @@ def main():
             curve.append({"rows": N_ROWS, "wall_s": round(accel["wall"], 2)})
             curve.sort(key=lambda c: c["rows"])
 
-    fell_back = False
     if accel is None:
-        # last resort: a CPU number beats no number (round-1 postmortem)
-        fell_back = True
-        print("# accelerator unavailable; falling back to CPU measurement",
-              file=sys.stderr)
-        accel = _run_child(
-            N_ROWS, {"JAX_PLATFORMS": "cpu", "_BENCH_PLATFORM": "cpu"},
-            "cpu fallback")
+        # the tree-inclusive sweep at full N_ROWS would blow the child
+        # timeout on CPU (~743s at 250k, measured) — skip the doomed
+        # full-size CPU fallback and land in the honest extrapolation path
+        # from the CPU baseline below (round-1 postmortem: a labeled
+        # extrapolation beats no number; round-3: don't burn 3000s first)
+        print("# accelerator unavailable; extrapolating from the CPU "
+              "baseline", file=sys.stderr)
 
     # --- CPU proxy baseline (small rows, linearly extrapolated) ---
     cpu = _run_child(
@@ -325,7 +324,7 @@ def main():
         # flag it and keep vs_baseline at 0 (comparing the extrapolation to
         # itself would fabricate a vs_baseline of exactly 1.0)
         accel = {**cpu, "wall": cpu["wall"] * (N_ROWS / CPU_ROWS)}
-        fell_back = extrapolated = True
+        extrapolated = True
 
     result = {"metric": f"automl_higgs_shape_{N_ROWS // 1_000_000}m_wall",
               "value": None, "unit": "s", "vs_baseline": 0.0}
@@ -341,8 +340,6 @@ def main():
         if extrapolated:
             result["note"] = ("no full-size measurement; value extrapolated "
                               "from the small CPU baseline")
-        elif fell_back:
-            result["note"] = "accelerator init failed; CPU fallback value"
         if cpu is not None and not extrapolated:
             cpu_extrapolated = cpu["wall"] * (N_ROWS / CPU_ROWS)
             result["vs_baseline"] = round(cpu_extrapolated / accel["wall"], 3)

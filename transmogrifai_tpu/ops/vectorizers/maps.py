@@ -435,11 +435,16 @@ class GeolocationMapVectorizer(_MapVectorizerBase):
 class _SmartTextMapModel(_KeyedModelBase):
     in_types = (ft.TextMap,)
 
-    def __init__(self, **kw):
+    def __init__(self, keys: Sequence[Sequence[str]] = (),
+                 track_nulls: bool = True, uid: Optional[str] = None,
+                 **extra):
+        # signature mirrors _KeyedModelBase so ctor-reflecting config()
+        # keeps carrying keys/track_nulls through save/load
         #: "feature.key" -> detection record for keys dropped as sensitive
         #: (SensitiveFeatureInformation analog; merged into ModelInsights)
         self.sensitive: dict = {}
-        super().__init__(**kw)
+        super().__init__(keys=keys, track_nulls=track_nulls, uid=uid,
+                         **extra)
 
     def sensitive_info(self) -> dict:
         return dict(self.sensitive)
