@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -93,7 +94,21 @@ class PredictionModel(DeviceTransformer):
 
     # device_apply(params, features_col) -> PredictionColumn
     def predict_arrays(self, X) -> fr.PredictionColumn:
-        return self.device_apply(self.device_params(), fr.VectorColumn(X))
+        """One JITTED apply. In the fused layer program this path is
+        already compiled; here (sweep fallback scoring, LOCO, row path) an
+        eager device_apply would dispatch every primitive separately —
+        for tree ensembles that is thousands of eager gathers per call.
+
+        The cache keys on ``config()``: device_apply bakes structural
+        Python attributes (probabilistic/family/kind/...) into the trace,
+        and those may change via ``set_fitted_state`` after a first
+        predict — a stale trace would silently keep the OLD semantics."""
+        cfg = self.config()
+        cached = self.__dict__.get("_jit_apply")
+        if cached is None or cached[0] != cfg:
+            cached = (cfg, jax.jit(lambda p, c: self.device_apply(p, c)))
+            self.__dict__["_jit_apply"] = cached
+        return cached[1](self.device_params(), fr.VectorColumn(X))
 
     def transform_row(self, *values):
         """Row path: last value is the feature vector (label may be absent)."""

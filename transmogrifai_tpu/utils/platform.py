@@ -1,0 +1,34 @@
+"""Platform selection helper.
+
+Site accelerator plugins (axon) re-register the JAX backend at interpreter
+start and OVERRIDE the ``JAX_PLATFORMS`` environment variable; a script run
+with ``JAX_PLATFORMS=cpu`` that relies on the env var alone will still try
+to initialize the plugin's TPU backend — and hang if its tunnel is down.
+Every entry-point script (examples, generated run.py, benches) calls
+``respect_jax_platforms()`` before any JAX API use; tests do the same dance
+inline in ``tests/conftest.py`` (which must not import the package first).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["respect_jax_platforms"]
+
+
+def respect_jax_platforms() -> None:
+    """Re-assert ``JAX_PLATFORMS`` at jax-config level (no-op when unset).
+    Must run before the first backend initialization; if the backend is
+    already up the failure is LOUD — proceeding silently would hand the run
+    to the possibly-hung platform this helper exists to avoid."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", want)
+    except RuntimeError as e:
+        print(f"WARNING: could not apply JAX_PLATFORMS={want!r} "
+              f"({e}); the backend was already initialized and this run "
+              "may target a different platform", file=sys.stderr)
