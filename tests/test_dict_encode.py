@@ -78,3 +78,25 @@ def test_criteo_bench_script_smoke(monkeypatch):
     spec.loader.exec_module(mod)
     mod.N_ROWS = 2000
     assert mod.main() == 0
+
+
+def test_parity_fuzz_property():
+    """Property-style fuzz (reference OpStatisticsPropertyTest pattern):
+    random mixes of cardinality, null rate, string lengths, empty strings
+    and non-ASCII must all match the Python oracle exactly."""
+    rng = np.random.default_rng(123)
+    for trial in range(12):
+        n = int(rng.integers(1, 12_000))
+        card = int(rng.integers(1, max(n, 2)))
+        null_rate = float(rng.uniform(0, 0.4))
+        unicode_mix = trial % 3 == 0
+        width = int(rng.integers(1, 24))
+        pool = []
+        for v in range(card):
+            s = f"{'é' if unicode_mix and v % 7 == 0 else ''}v{v:0{width}d}"
+            pool.append(s)
+        vals = [None if rng.uniform() < null_rate
+                else pool[int(rng.integers(card))] for _ in range(n)]
+        if trial % 4 == 0:
+            vals[:3] = ["", "", None][: min(3, n)]
+        _check(vals)

@@ -255,3 +255,25 @@ def test_tokenizer_stemming_and_html_strip():
     assert strip_html(html).split() == ["Hello", "world", "&", "friends"]
     t_html = TextTokenizer(strip_html_tags=True)
     assert t_html.transform_row(html) == ["hello", "world", "friends"]
+
+
+def test_porter_stemmer_fuzz_invariants():
+    """Property fuzz: the stemmer must never lengthen a word, never
+    raise, and stay within [a-z] for alpha input. (Strict idempotency is
+    NOT a Porter property — e.g. step-2 outputs can re-trigger rules — so
+    it is deliberately not asserted.)"""
+    import numpy as np
+    from transmogrifai_tpu.ops.stemmer import porter_stem
+    rng = np.random.default_rng(7)
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    suffixes = ["ing", "ed", "ation", "ness", "ously", "izer", "es", "s",
+                "ful", "ment", "ity", ""]
+    for _ in range(300):
+        stemlen = int(rng.integers(1, 9))
+        word = "".join(letters[int(i)]
+                       for i in rng.integers(0, 26, stemlen))
+        word += suffixes[int(rng.integers(len(suffixes)))]
+        out = porter_stem(word)
+        assert len(out) <= len(word)
+        assert out == out.lower()
+        assert out.isalpha()
