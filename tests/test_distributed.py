@@ -46,8 +46,36 @@ count = float(jax.device_get(stats["n"]))
 assert abs(total - 36.0) < 1e-5, total
 assert count == 8.0, count  # psum of per-shard rows = global row count
 
+# distributed TREE training: the binned matrix spans both processes; the
+# per-shard histogram scatters all-reduce over DCN inside the scanned
+# boosting program (the Rabit-allreduce analog, SURVEY 2.7 P5)
+from transmogrifai_tpu.models.trees import (
+    bin_data, predict_ensemble, quantile_bin_edges, train_ensemble,
+)
+rng = np.random.default_rng(0)
+Xg = rng.normal(size=(64, 4)).astype(np.float32)       # same on both procs
+yg = ((Xg[:, 0] + 0.5 * Xg[:, 1]) > 0).astype(np.float32)
+edges = quantile_bin_edges(Xg, 16)
+Xb_all = np.asarray(bin_data(jnp.asarray(Xg), jnp.asarray(edges)))
+lo, hi = pid * 32, (pid + 1) * 32                      # local half
+Xb = D.shard_global_rows(ctx, Xb_all[lo:hi])
+y = D.shard_global_rows(ctx, yg[lo:hi])
+w = D.shard_global_rows(ctx, np.ones(32, np.float32))
+trees, _gains = train_ensemble(
+    Xb, y, w, n_rounds=4, max_depth=3, n_bins=16, n_out=1,
+    loss="logistic", learning_rate=jnp.float32(0.3),
+    reg_lambda=jnp.float32(1.0), gamma=jnp.float32(0.0),
+    min_child_weight=jnp.float32(1.0), subsample=1.0, colsample=1.0,
+    base_score=jnp.float32(0.0), bootstrap=False, seed=3)
+margin = predict_ensemble(Xb, trees, n_out=1,
+                          learning_rate=jnp.float32(0.3),
+                          base_score=jnp.float32(0.0), bootstrap=False)
+acc = float(jax.device_get(jnp.mean(
+    ((margin[:, 0] > 0) == (y > 0.5)).astype(jnp.float32))))
+assert acc > 0.9, acc
+
 D.barrier()
-print(f"proc {{pid}} OK", flush=True)
+print(f"proc {{pid}} OK acc={{acc:.3f}}", flush=True)
 """
 
 
