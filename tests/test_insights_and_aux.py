@@ -1,6 +1,7 @@
 """ModelInsights, LOCO, DSL, math transformers, testkit, params, runner,
 profiling tests."""
 
+import os
 import json
 
 import numpy as np
@@ -297,3 +298,42 @@ def test_loco_strategies():
     import pytest
     with pytest.raises(ValueError):
         RecordInsightsLOCO(model=model, aggregation_strategy="nope")
+
+
+def test_runner_score_writes_score_location(tmp_path):
+    """Reference OpWorkflowRunner writes scores to the configured location;
+    the SCORE run type must honor scoreLocation (avro, round-trippable)."""
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+    from transmogrifai_tpu.selector import ModelSelector
+
+    n = 60
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 2, n).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "x": (ft.Real, (rng.normal(size=n) + y).tolist()),
+        "label": (ft.RealNN, y.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = feats["x"].vectorize()
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(max_iter=20), [{}])],
+        evaluators=[OpBinaryClassificationEvaluator()])
+    pred = label.transform_with(sel, vec)
+    wf = Workflow().set_input_frame(frame).set_result_features(pred)
+    runner = WorkflowRunner(wf, evaluator=OpBinaryClassificationEvaluator(),
+                            scoring_reader_factory=lambda p: frame)
+    loc = str(tmp_path / "model")
+    score_dir = str(tmp_path / "scores")
+    res = runner.run(RunTypes.TRAIN, OpParams.from_json(
+        {"modelLocation": loc}))
+    assert res["status"] == "success"
+    res2 = runner.run(RunTypes.SCORE, OpParams.from_json(
+        {"modelLocation": loc, "scoreLocation": score_dir}))
+    assert res2["status"] == "success"
+    score_path = res2["scoreLocation"]
+    assert score_path == os.path.join(score_dir, "scores.avro")
+    assert os.path.exists(score_path)
+    from transmogrifai_tpu.readers.avro import AvroReader
+    rows = list(AvroReader(score_path).read())
+    assert len(rows) == n

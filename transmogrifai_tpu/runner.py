@@ -9,6 +9,7 @@ mirrors the spark-submit surface.
 
 from __future__ import annotations
 
+import os
 import argparse
 import json
 import sys
@@ -85,7 +86,6 @@ class WorkflowRunner:
                 def write_batch(frame, i):
                     if params.score_location:
                         from transmogrifai_tpu.readers.avro import save_avro
-                        import os
                         os.makedirs(params.score_location, exist_ok=True)
                         save_avro(frame, os.path.join(
                             params.score_location, f"batch_{i:06d}.avro"))
@@ -115,6 +115,21 @@ class WorkflowRunner:
                     with profiler.phase(OpStep.SCORING):
                         scores = model.score(reader)
                     result["nRows"] = scores.n_rows
+                    if params.score_location:
+                        # reference OpWorkflowRunner writes scores to the
+                        # configured location. scoreLocation is a DIRECTORY
+                        # in every run type (streaming writes batch files
+                        # into it; score writes scores.avro) — one param,
+                        # one meaning
+                        with profiler.phase(OpStep.RESULTS_SAVING):
+                            from transmogrifai_tpu.readers.avro import (
+                                save_avro,
+                            )
+                            os.makedirs(params.score_location, exist_ok=True)
+                            out_path = os.path.join(params.score_location,
+                                                    "scores.avro")
+                            save_avro(scores, out_path)
+                        result["scoreLocation"] = out_path
                     if run_type == RunTypes.EVALUATE:
                         if self.evaluator is None:
                             raise ValueError("evaluate requires an evaluator")
