@@ -88,12 +88,13 @@ def test_aggregate_reader():
     reader = DataReaders.Aggregate.custom(
         records, key_fn=lambda r: r["k"], time_fn=lambda r: r["t"], cutoff_ms=5)
     frame = reader.generate_frame([amt, resp])
-    # predictors: t<=5 -> u1: 10+20=30, u2: 5 ; response: t>5 -> u1: 1, u2: none->0? sum of none = None -> RealNN violation
+    # reference boundary semantics (FeatureAggregator.scala:108-125):
+    # predictors t < 5 -> u1: 10; responses t >= 5 -> u1: 1+1=2
     assert frame.n_rows == 2
     assert frame.key.tolist() == ["u1", "u2"]
     row_u1 = frame.row(0)
-    assert row_u1["amt"] == 30.0
-    assert row_u1["resp"] == 1.0
+    assert row_u1["amt"] == 10.0
+    assert row_u1["resp"] == 2.0
 
 
 def test_conditional_reader():
@@ -111,6 +112,7 @@ def test_conditional_reader():
     frame = reader.generate_frame([amt, resp])
     assert frame.n_rows == 1
     row = frame.row(0)
-    # cutoff at t=3: predictors t<=3 -> 1+2=3 ; response t>3 -> 1.0
-    assert row["amt"] == 3.0
+    # cutoff at t=3 (reference boundaries: predictor < cutoff <= response):
+    # predictors t<3 -> 1.0 ; responses t>=3 -> 0.0+1.0
+    assert row["amt"] == 1.0
     assert row["resp"] == 1.0

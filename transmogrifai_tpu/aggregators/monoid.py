@@ -264,12 +264,13 @@ class Event(Generic[T]):
 
 class FeatureAggregator:
     """Applies a monoid aggregator to an entity's events honoring time
-    semantics (reference ``aggregators/FeatureAggregator.scala``):
+    semantics (reference ``aggregators/FeatureAggregator.scala:108-125``
+    ``filterByDateWithCutoff`` — boundaries match it exactly):
 
-    - predictors aggregate events with ``time <= cutoff`` (and within
-      ``window_ms`` before it, when set)
-    - responses aggregate events with ``time > cutoff`` (and within
-      ``window_ms`` after it)
+    - predictors aggregate events with ``time < cutoff`` (and
+      ``time >= cutoff - window_ms`` when a window is set)
+    - responses aggregate events with ``time >= cutoff`` (and
+      ``time <= cutoff + window_ms`` when a window is set)
     """
 
     def __init__(self, aggregator: MonoidAggregator,
@@ -285,14 +286,14 @@ class FeatureAggregator:
         for e in events:
             if cutoff_ms is not None:
                 if self.is_response:
-                    if e.time <= cutoff_ms:
+                    if e.time < cutoff_ms:
                         continue
                     if self.window_ms is not None and e.time > cutoff_ms + self.window_ms:
                         continue
                 else:
-                    if e.time > cutoff_ms:
+                    if e.time >= cutoff_ms:
                         continue
-                    if self.window_ms is not None and e.time <= cutoff_ms - self.window_ms:
+                    if self.window_ms is not None and e.time < cutoff_ms - self.window_ms:
                         continue
             vals.append(e.value)
         return self.aggregator.reduce(vals)

@@ -44,12 +44,22 @@ class TypedFeatureBuilder:
         self._window = window_ms
         return self
 
+    def source(self, tag: str) -> "TypedFeatureBuilder":
+        """Bind this feature to the reader carrying the same source tag
+        (reference: features bind to a reader via FeatureBuilder's record
+        TYPE parameter; joined readers route extracted features by it —
+        here the binding is an explicit tag, see
+        DataReader.with_source_tag)."""
+        self._source_tag = tag
+        return self
+
     def _build(self, is_response: bool) -> Feature:
         stage = FeatureGeneratorStage(
             name=self._name, ftype_name=self._ftype.__name__,
             extract_fn=self._extract_fn, aggregator=self._aggregator,
             is_response=is_response)
         stage.window_ms = self._window
+        stage.source_tag = getattr(self, "_source_tag", None)
         return stage.get_output()
 
     def as_predictor(self) -> Feature:

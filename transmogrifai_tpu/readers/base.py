@@ -59,8 +59,17 @@ class DataReader:
     #: rows per ingest chunk: bounds the transient python-object footprint
     chunk_rows: int = 65536
 
+    #: matches FeatureBuilder .source(tag) bindings in joined readers
+    source_tag: Optional[str] = None
+
     def __init__(self, key_fn: Optional[Callable[[Any], str]] = None):
         self.key_fn = key_fn
+
+    def with_source_tag(self, tag: str) -> "DataReader":
+        """Tag this reader so joined readers can route explicitly-bound
+        (extracted, non-column) features to it."""
+        self.source_tag = tag
+        return self
 
     def read(self) -> Iterable[Any]:
         raise NotImplementedError

@@ -121,7 +121,10 @@ class CSVReader(DataReader):
                 return list(_csv.DictReader(fh))
             if not self.columns:
                 raise ValueError("header=False requires explicit columns")
-            return [dict(zip(self.columns, row)) for row in _csv.reader(fh)]
+            # skip blank lines (DictReader does this implicitly in header
+            # mode; a trailing newline must not become an all-None row)
+            return [dict(zip(self.columns, row))
+                    for row in _csv.reader(fh) if row]
 
     @property
     def schema(self) -> dict[str, type[ft.FeatureType]]:

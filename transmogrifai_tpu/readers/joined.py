@@ -48,8 +48,10 @@ class JoinKeys:
 @dataclass(frozen=True)
 class TimeBasedFilter:
     """Keep right-side rows whose ``primary`` timestamp falls in
-    ``(cutoff - window_ms, cutoff]`` where cutoff is the left row's
-    ``condition`` timestamp (reference ``TimeBasedFilter``)."""
+    ``[cutoff - window_ms, cutoff)`` where cutoff is the left row's
+    ``condition`` timestamp (reference ``TimeBasedFilter`` — predictor
+    boundaries follow ``FeatureAggregator.scala:108-125``: strictly before
+    the cutoff, window-start inclusive; responses are ``>= cutoff``)."""
     condition: str   # left-side Date/DateTime feature name -> per-key cutoff
     primary: str     # right-side Date/DateTime feature name -> event time
     window_ms: int = 2**62
@@ -124,12 +126,37 @@ class JoinedDataReader(DataReader):
             "JoinedDataReader produces frames, not records")
 
     # -- feature partitioning ------------------------------------------------
+    @staticmethod
+    def _has_tag(reader: DataReader, tag: str) -> bool:
+        """Does this reader (or any side of a nested join, or a grouping
+        wrapper's base) carry the source tag?"""
+        if getattr(reader, "source_tag", None) == tag:
+            return True
+        for attr in ("left", "right", "base", "joined"):
+            sub = getattr(reader, attr, None)
+            if sub is not None and JoinedDataReader._has_tag(sub, tag):
+                return True
+        return False
+
     def _split_features(self, raw_features: Sequence[FeatureLike]
                         ) -> tuple[list[FeatureLike], list[FeatureLike]]:
         lcols = self.left.available_columns()
         rcols = self.right.available_columns()
         lf, rf = [], []
         for f in raw_features:
+            # explicit binding first (reference: features bind to a reader
+            # via the record type; extracted features aren't columns)
+            tag = getattr(f.origin_stage, "source_tag", None)
+            if tag is not None:
+                if self._has_tag(self.left, tag):
+                    lf.append(f)
+                    continue
+                if self._has_tag(self.right, tag):
+                    rf.append(f)
+                    continue
+                raise KeyError(
+                    f"raw feature {f.name!r} is bound to source tag "
+                    f"{tag!r}, which neither side of the join carries")
             in_l = lcols is None or f.name in lcols
             in_r = rcols is not None and f.name in rcols
             if in_r and (not in_l or lcols is None):
@@ -138,7 +165,9 @@ class JoinedDataReader(DataReader):
                 lf.append(f)
             else:
                 raise KeyError(
-                    f"raw feature {f.name!r} not found in either side of join")
+                    f"raw feature {f.name!r} not found in either side of "
+                    "join (name not a column of either reader and no "
+                    ".source(tag) binding)")
         return lf, rf
 
     # -- the join ------------------------------------------------------------

@@ -300,14 +300,30 @@ class WorkflowModel:
         available = reader.available_columns()
         raw = list(self.raw_features)
         if available is not None:
-            # responses are optional at scoring time; predictors are not
+            # The name-presence guard applies to features read by COLUMN
+            # NAME. A predictor with a custom extract_fn computes its value
+            # from the whole record, so its name is not a source column by
+            # design (reference FeatureGeneratorStage) — exempt, UNLESS the
+            # data is a bare frame (columns are all there is to extract
+            # from). Responses stay name-ruled in every case: they are
+            # optional at scoring time and an extractor run against
+            # label-less records would crash scoring that should work.
+            frame_backed = isinstance(reader, CustomReader)                 and reader.frame is not None
+
+            def column_read(f) -> bool:
+                return (frame_backed or f.is_response
+                        or getattr(f.origin_stage, "extract_fn", None)
+                        is None)
+
             missing_required = sorted(
                 f.name for f in raw
-                if not f.is_response and f.name not in available)
+                if not f.is_response and column_read(f)
+                and f.name not in available)
             if missing_required:
                 raise KeyError(
                     f"Scoring data lacks predictor columns {missing_required}")
-            raw = [f for f in raw if f.name in available]
+            raw = [f for f in raw
+                   if not column_read(f) or f.name in available]
         frame = reader.generate_frame(raw)
         return PipelineData.from_host(frame)
 
