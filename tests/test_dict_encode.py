@@ -100,3 +100,27 @@ def test_parity_fuzz_property():
         if trial % 4 == 0:
             vals[:3] = ["", "", None][: min(3, n)]
         _check(vals)
+
+
+def test_parity_trailing_nul_bytes():
+    """Strings differing only by trailing NULs collapse in every fixed-
+    width numpy layout (review r4) — the encoder must detect them and take
+    the object-loop path, matching the oracle exactly."""
+    _check(["a", "a\x00", None] * 2000)
+    _check(["a", "a\x00\x00", "a\x00"] * 2000)
+    # non-ASCII + trailing NUL exercises the 'U'-path guard
+    _check(["é", "é\x00", "b"] * 2000)
+    # embedded (non-trailing) NULs don't collapse and may stay vectorized
+    _check(["a\x00b", "ab", None] * 2000)
+
+
+def test_non_string_objects_still_encode():
+    """Float objects leaking into a text column (pandas ingestion) must
+    not crash the NUL guard (review r4): they can't carry NULs, so the
+    vectorized path (which stringifies them — longstanding behavior for
+    out-of-contract non-text input) still encodes consistently."""
+    vals = [1.0, 2.5, None] * 3000
+    codes, vocab = dict_encode(vals)
+    assert vocab == ["1.0", "2.5"]
+    np.testing.assert_array_equal(codes[:3], [0, 1, -1])
+    assert (codes.reshape(-1, 3) == codes[:3]).all()

@@ -283,3 +283,67 @@ def test_raw_feature_filter_results_reset_between_runs():
         "m": (ft.RealMap, healthy_maps), "label": (ft.RealNN, y.tolist())})
     rff.filter_frame(frame2, feats)
     assert rff.results.map_key_blocklist == {}
+
+
+def test_workflow_map_key_blocklist_not_accumulated_across_trains():
+    """Workflow._apply_map_key_blocklist must REPLACE its own prior per-key
+    exclusions on retrain (review r4): a key sparse in run 1 but healthy in
+    run 2 must expand again, while user-configured block keys survive."""
+    n = 100
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 2, n).astype(float)
+
+    def maps(sparse):
+        return [({"k": 1.0} if i == 0 else {"good": float(rng.normal())})
+                if sparse else
+                {"k": float(rng.normal()), "good": float(rng.normal()),
+                 "user_banned": 1.0}
+                for i in range(n)]
+
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+
+    m = FeatureBuilder.RealMap("m").as_predictor()
+    label = FeatureBuilder.RealNN("label").as_response()
+    vec = transmogrify([m], min_support=1)
+    vec_stage = vec.origin_stage
+    # user config must survive workflow rewiring across both trains
+    vec_stage.block_keys_by_feature["m"] = ("user_banned",)
+    sel = ModelSelector(
+        models_and_grids=[(OpLogisticRegression(max_iter=10), [{}])],
+        evaluators=[OpBinaryClassificationEvaluator()])
+    pred = label.transform_with(sel, vec)
+    wf = (Workflow().set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill=0.05)))
+
+    frame1 = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, maps(sparse=True)),
+        "label": (ft.RealNN, y.tolist())})
+    wf.set_input_frame(frame1).train()
+    assert vec_stage.wf_block_keys_by_feature == {"m": ("k",)}
+    # user config is never touched by the workflow
+    assert vec_stage.block_keys_by_feature["m"] == ("user_banned",)
+
+    frame2 = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, maps(sparse=False)),
+        "label": (ft.RealNN, y.tolist())})
+    model2 = wf.set_input_frame(frame2).train()
+    # 'k' is healthy now: the workflow-applied exclusion is gone, the
+    # user-configured one is kept
+    assert vec_stage.wf_block_keys_by_feature == {}
+    assert vec_stage.block_keys_by_feature["m"] == ("user_banned",)
+    keyed = [t for t in model2.stages()
+             if type(t).__name__ == "_NumericMapModel"]
+    assert keyed and sorted(keyed[0].keys[0]) == ["good", "k"]
+
+    # a FILTERLESS retrain over the same feature graph must also clear a
+    # previous filtered run's exclusions (review r4)
+    wf.set_input_frame(frame1).train()
+    assert vec_stage.wf_block_keys_by_feature == {"m": ("k",)}
+    model3 = (Workflow().set_result_features(pred)
+              .set_input_frame(frame2).train())
+    assert vec_stage.wf_block_keys_by_feature == {}
+    keyed3 = [t for t in model3.stages()
+              if type(t).__name__ == "_NumericMapModel"]
+    assert keyed3 and sorted(keyed3[0].keys[0]) == ["good", "k"]

@@ -27,6 +27,11 @@ from transmogrifai_tpu.vector_metadata import VectorMetadata
 
 __all__ = ["RecordInsightsLOCO"]
 
+#: Avg-strategy column-sweep block size: peak memory is
+#: [_AVG_CHUNK_COLS, n, d] masked inputs when XLA can't fuse the mask
+#: into the score fn (gather-based tree predicts at hashed widths)
+_AVG_CHUNK_COLS = 256
+
 
 class RecordInsightsLOCO(HostTransformer):
     """OPVector -> TextMap of ``column/group name -> score delta`` (json
@@ -98,26 +103,45 @@ class RecordInsightsLOCO(HostTransformer):
         n, d = X.shape
         meta = col.meta
         groups = self._groups(meta, d)
+        if d == 0:  # zero-width vector (e.g. every key blocklisted):
+            # nothing to leave out, every row's insight map is empty
+            return fr.HostColumn(
+                ft.TextMap, np.array([{} for _ in range(n)], dtype=object))
         score = self._score_fn()
         base = score(X)                                     # [n]
         if self.aggregation_strategy == "Avg":
             # per-COLUMN deltas, averaged within each group (reference Avg
-            # strategy); vmap over indices with an in-trace one_hot so no
-            # O(d^2) mask matrix ever materializes (d can be 10k+ hashed),
-            # and segment-mean down to [G, n] ON DEVICE — pulling the raw
-            # [d, n] matrix to host would move gigabytes at hashed widths
+            # strategy). The column sweep is CHUNKED (lax.map over blocks
+            # of an inner vmap): a flat vmap over all d columns batches the
+            # masked input to [d, n, d], which only stays un-materialized
+            # if XLA fuses the mask into the score fn — for gather-based
+            # tree predicts at hashed widths (d ~10k+) it may not, and the
+            # program OOMs. Chunking caps the peak at [chunk, n, d] while
+            # the per-chunk segment-sum keeps the running result at [G, n].
             group_of = np.zeros(d, np.int32)
             sizes = np.zeros(len(groups), np.float32)
             for gi, (_, idxs) in enumerate(groups):
                 group_of[idxs] = gi
                 sizes[gi] = len(idxs)
-            col_deltas = jax.vmap(
-                lambda j: base - score(
-                    X * (1.0 - jax.nn.one_hot(j, d, dtype=X.dtype))))(
-                jnp.arange(d))                               # [d, n]
-            summed = jax.ops.segment_sum(
-                col_deltas, jnp.asarray(group_of),
-                num_segments=len(groups))                    # [G, n]
+            chunk = min(d, _AVG_CHUNK_COLS)  # d >= 1 past the early return
+            n_chunks = -(-d // chunk)
+            pad = n_chunks * chunk - d
+            # padded tail columns map to a scratch segment dropped below
+            col_ids = jnp.arange(n_chunks * chunk).reshape(n_chunks, chunk)
+            seg = jnp.concatenate(
+                [jnp.asarray(group_of),
+                 jnp.full((pad,), len(groups), jnp.int32)])
+
+            def chunk_deltas(js):                            # [chunk] ids
+                cd = jax.vmap(
+                    lambda j: base - score(
+                        X * (1.0 - jax.nn.one_hot(j, d, dtype=X.dtype))))(
+                    jnp.minimum(js, d - 1))                  # [chunk, n]
+                return jax.ops.segment_sum(
+                    cd * (js < d)[:, None].astype(X.dtype), seg[js],
+                    num_segments=len(groups) + 1)            # [G+1, n]
+
+            summed = jax.lax.map(chunk_deltas, col_ids).sum(0)[:-1]
             deltas = np.asarray(summed / jnp.asarray(sizes)[:, None]).T
         else:
             masks = np.ones((len(groups), d), dtype=np.float32)

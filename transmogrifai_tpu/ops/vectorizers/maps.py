@@ -55,6 +55,11 @@ class _MapVectorizerBase(Estimator):
         self.block_keys_by_feature = {
             str(n): tuple(ks)
             for n, ks in (block_keys_by_feature or {}).items()}
+        #: WORKFLOW-applied per-key exclusions (RawFeatureFilter results,
+        #: set by Workflow._apply_map_key_blocklist) — kept separate from
+        #: the user-owned ``block_keys_by_feature`` so each train() can
+        #: replace its own exclusions without ever touching user config
+        self.wf_block_keys_by_feature: dict = {}
         self.track_nulls = track_nulls
         for k, v in extra.items():
             setattr(self, k, v)
@@ -64,7 +69,8 @@ class _MapVectorizerBase(Estimator):
         if self.allow_keys and k not in self.allow_keys:
             return False
         if feature is not None \
-                and k in self.block_keys_by_feature.get(feature, ()):
+                and (k in self.block_keys_by_feature.get(feature, ())
+                     or k in self.wf_block_keys_by_feature.get(feature, ())):
             return False
         return k not in self.block_keys
 

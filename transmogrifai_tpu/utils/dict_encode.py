@@ -27,6 +27,30 @@ __all__ = ["dict_encode", "dict_encode_py"]
 _native_lib = None
 _native_tried = False
 
+
+class _TrailingNul(Exception):
+    """Column contains strings that differ only by trailing NUL bytes —
+    they collapse in ANY fixed-width numpy layout ('a' == 'a\\x00' once
+    zero-padded), so only the object-loop oracle encodes them correctly."""
+
+
+def _check_trailing_nul(pvals: np.ndarray, fixed: np.ndarray) -> None:
+    """Raise if zero-padding lost trailing NULs: compare true object
+    lengths (one C loop) against the fixed-width readback lengths (which
+    numpy strips trailing zeros from). Non-string objects (e.g. floats
+    leaking into a text column — astype stringifies them) can't carry
+    NULs, so they are exempt from the comparison."""
+    if len(pvals) == 0:
+        return
+
+    def _len(v):
+        return len(v) if isinstance(v, (str, bytes)) else -1
+
+    lens = np.frompyfunc(_len, 1, 1)(pvals).astype(np.int64)
+    strings = lens >= 0
+    if (np.char.str_len(fixed)[strings] != lens[strings]).any():
+        raise _TrailingNul
+
 #: below this row count the setup cost beats the native win
 _NATIVE_MIN_ROWS = 4096
 
@@ -75,10 +99,12 @@ def _encode_ascii(values, null_mask: np.ndarray
         return None
     n = len(values)
     present = null_mask == 0
+    pvals = values[present]
     try:
-        strs = values[present].astype("S")  # raises on non-ASCII
+        strs = pvals.astype("S")  # raises on non-ASCII
     except (TypeError, ValueError, UnicodeEncodeError):
         return None
+    _check_trailing_nul(pvals, strs)
     width = strs.dtype.itemsize
     if width == 0:  # all-empty column
         width = 1
@@ -115,14 +141,22 @@ def dict_encode(values) -> tuple[np.ndarray, list[str]]:
         return dict_encode_py(values)
     vals = np.asarray(values, dtype=object)
     null_mask = np.equal(vals, None).astype(np.uint8)
-    native = _encode_ascii(vals, null_mask)
+    try:
+        native = _encode_ascii(vals, null_mask)
+    except _TrailingNul:
+        return dict_encode_py(values)
     if native is not None:
         return native
     # numpy sort-based fallback (non-ASCII / no toolchain): still C-speed
     present = null_mask == 0
+    pvals = vals[present]
     try:
-        strs = vals[present].astype("U")
+        strs = pvals.astype("U")
     except (TypeError, ValueError):
+        return dict_encode_py(values)
+    try:
+        _check_trailing_nul(pvals, strs)
+    except _TrailingNul:
         return dict_encode_py(values)
     vocab, inv = np.unique(strs, return_inverse=True)
     codes = np.full(n, -1, dtype=np.int32)

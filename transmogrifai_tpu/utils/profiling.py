@@ -231,6 +231,21 @@ class _Profiler:
         try:
             yield
         finally:
+            if self._tracing:
+                # JAX dispatch is async: without a fence, device ops
+                # enqueued near phase end can execute after the wall
+                # window closes and be misattributed to the next phase.
+                # Each device executes programs in enqueue order, so
+                # blocking on one trivial computation PER local device
+                # drains everything enqueued before it (sharded runs
+                # enqueue on every mesh device, not just device 0).
+                try:
+                    import jax
+                    jax.block_until_ready(
+                        [jax.device_put(0.0, dev) + 0
+                         for dev in jax.local_devices()])
+                except Exception:
+                    pass
             # record on the error path too — a failed run's post-mortem
             # must still account the time spent before the failure
             t1 = time.time()

@@ -208,8 +208,12 @@ class Workflow:
                             "RawFeatureFilter blocked every path to the "
                             f"result features (blocklist: {blocklist})")
                     raw = [f for f in raw if f.name not in set(blocklist)]
-                self._apply_map_key_blocklist(
-                    result, filter_results.map_key_blocklist)
+            # ALWAYS replace workflow-applied per-key map exclusions —
+            # a filterless retrain must clear a previous filtered run's
+            # exclusions, not silently keep dropping healthy keys
+            self._apply_map_key_blocklist(
+                result, filter_results.map_key_blocklist
+                if filter_results is not None else {})
         data = PipelineData.from_host(frame)
         executor = DagExecutor()
         cut = None
@@ -242,20 +246,23 @@ class Workflow:
     def _apply_map_key_blocklist(result, map_key_blocklist: dict) -> None:
         """Reference ``OpWorkflow.scala:118-167`` setBlocklist per-key map
         exclusions: rewire every map vectorizer consuming a flagged map
-        feature so the excluded keys never expand into columns."""
-        if not map_key_blocklist:
-            return
+        feature so the excluded keys never expand into columns.
+
+        Workflow-applied exclusions are REPLACED per train(), never
+        accumulated: they live in the stage's separate
+        ``wf_block_keys_by_feature`` dict (consulted alongside the
+        user-owned ``block_keys_by_feature``, which is never touched), so
+        keys that are healthy again on refreshed data come back while user
+        config — including edits between trains — is always preserved."""
         from transmogrifai_tpu.ops.vectorizers.maps import _MapVectorizerBase
         stages = {s for f in result for s in f.parent_stages()}
         for stage in stages:
             if not isinstance(stage, _MapVectorizerBase):
                 continue
-            for name in stage.input_names:
-                keys = map_key_blocklist.get(name)
-                if keys:
-                    cur = set(stage.block_keys_by_feature.get(name, ()))
-                    stage.block_keys_by_feature[name] = tuple(
-                        sorted(cur | set(keys)))
+            stage.wf_block_keys_by_feature = {
+                name: tuple(sorted(map_key_blocklist[name]))
+                for name in stage.input_names
+                if map_key_blocklist.get(name)}
 
     def _fit_workflow_cv(self, data: PipelineData, cut, executor) -> Dag:
         """Reference ``OpWorkflow.scala:408-449``: fit the pre-CV DAG once,
@@ -305,15 +312,23 @@ class WorkflowModel:
             # from the whole record, so its name is not a source column by
             # design (reference FeatureGeneratorStage) — exempt, UNLESS the
             # data is a bare frame (columns are all there is to extract
-            # from). Responses stay name-ruled in every case: they are
+            # from). Responses stay name-ruled by default: they are
             # optional at scoring time and an extractor run against
-            # label-less records would crash scoring that should work.
-            frame_backed = isinstance(reader, CustomReader)                 and reader.frame is not None
+            # label-less records would crash scoring that should work —
+            # EXCEPT an extractor-backed response the caller explicitly
+            # requested as a result feature (reference aggregate readers
+            # compute response windows at score time on request,
+            # JoinsAndAggregates.scala), which must run to be returned.
+            frame_backed = isinstance(reader, CustomReader) \
+                and reader.frame is not None
+            requested = {f.name for f in self.result_features}
 
             def column_read(f) -> bool:
-                return (frame_backed or f.is_response
-                        or getattr(f.origin_stage, "extract_fn", None)
-                        is None)
+                if frame_backed:
+                    return True
+                if getattr(f.origin_stage, "extract_fn", None) is None:
+                    return True
+                return f.is_response and f.name not in requested
 
             missing_required = sorted(
                 f.name for f in raw
