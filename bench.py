@@ -22,6 +22,9 @@ vs_baseline  = cpu_wall / accel_wall for the identical pipeline at
                CPU_ROWS rows, linearly extrapolated to N_ROWS — a
                same-code host-CPU proxy for the Spark cluster baseline
                until a recorded Spark number lands in BASELINE.json.
+               ``null`` (NEVER 0.0) when not measured: extrapolated
+               values, resumed (partial-wall) runs, or a missing CPU
+               proxy all publish null.
 device_time_breakdown = per-OpStep wall + true device-busy seconds parsed
                from a jax.profiler device trace of the accelerator run
                (utils/profiling.py timeline attribution), plus analytic
@@ -94,7 +97,15 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
     """Full pipeline: frame ingest -> transmogrify -> sanity check ->
     3-fold default-candidate sweep. Returns {"wall": s, "auroc": f,
     "platform": str, "phases": {...}, "flops": {...}} (wall excludes data
-    synthesis)."""
+    synthesis).
+
+    The sweep is CHECKPOINTED (selector fold-level restart): if a previous
+    attempt died mid-sweep (tunnel drop, timeout), completed (fold, family)
+    metric batches are reloaded and only the remainder trains — a short
+    accelerator window still converts into a full artifact. A resumed run's
+    wall-clock is PARTIAL, so the result carries ``resumed: true`` and the
+    checkpoint is deleted after a completed measurement (a fresh run must
+    never silently skip families and report a fabricated wall)."""
     import jax
     import numpy as np
     from transmogrifai_tpu import frame as fr
@@ -109,6 +120,12 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
     from transmogrifai_tpu.types import feature_types as ft
 
     platform = jax.devices()[0].platform  # forces backend init up front
+
+    ckpt_base = os.environ.get(
+        "_BENCH_CKPT_BASE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_ckpt"))
+    ckpt_dir = os.path.join(ckpt_base, f"{platform}_{n_rows}_{MODELS}")
 
     X, y = make_data(n_rows)
     cols = {f"f{i}": fr.HostColumn(ft.Real, X[:, i].astype(np.float64),
@@ -132,12 +149,23 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
         checked = features
     selector = BinaryClassificationModelSelector.with_cross_validation(
         n_folds=3, seed=42, models_and_parameters=_candidates(),
-        splitter=DataSplitter(reserve_test_fraction=0.1, seed=42))
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=42),
+        checkpoint_dir=ckpt_dir)
+    # "resumed" must reflect the selector's ACTUAL reload decision, not
+    # file existence: a stale checkpoint with a mismatched config
+    # fingerprint is ignored by the sweep, and that run is complete
+    resumed = bool(selector._ckpt_load())
+    if resumed:
+        print(f"# resuming interrupted sweep from {ckpt_dir}",
+              file=sys.stderr)
     pred = label.transform_with(selector, checked)
     model = (Workflow().set_input_frame(frame)
              .set_result_features(pred).train())
     wall = time.time() - t0
     profiler.finalize()
+    # completed: drop the checkpoint so the NEXT run measures from scratch
+    import shutil
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     s = model.selector_summary()
     holdout = s.holdout_evaluation.get("binary classification", {})
@@ -154,7 +182,8 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
     return {"wall": wall, "auroc": auroc, "platform": platform,
             "best": s.best_model_name, "phases": phases,
             "flops": flops.totals(),
-            "peak_flops": flops.peak_flops_per_s()}
+            "peak_flops": flops.peak_flops_per_s(),
+            "resumed": resumed}
 
 
 def _child_main():
@@ -295,13 +324,21 @@ def main():
         accel = _run_child(N_ROWS, accel_env, "accel measurement",
                            trace=True)
         if accel is not None:
+            def curve_point(rows: int, r: dict) -> dict:
+                # a resumed (partial-wall) point must never look like a
+                # complete measurement in the published curve
+                p = {"rows": rows, "wall_s": round(r["wall"], 2)}
+                if r.get("resumed"):
+                    p["resumed"] = True
+                return p
+
             for rows in CURVE:
                 if rows == N_ROWS:
                     continue
                 r = _run_child(rows, accel_env, f"curve {rows}")
                 if r is not None:
-                    curve.append({"rows": rows, "wall_s": round(r["wall"], 2)})
-            curve.append({"rows": N_ROWS, "wall_s": round(accel["wall"], 2)})
+                    curve.append(curve_point(rows, r))
+            curve.append(curve_point(N_ROWS, accel))
             curve.sort(key=lambda c: c["rows"])
 
     if accel is None:
@@ -319,15 +356,18 @@ def main():
         "cpu baseline")
 
     extrapolated = False
-    if accel is None and cpu is not None:
+    if accel is None and cpu is not None and not cpu.get("resumed"):
         # nothing was measured at N_ROWS: report the baseline scaled up, but
-        # flag it and keep vs_baseline at 0 (comparing the extrapolation to
-        # itself would fabricate a vs_baseline of exactly 1.0)
+        # flag it and keep vs_baseline at null = NOT MEASURED (0.0 would
+        # read as "infinitely worse"; comparing the extrapolation to itself
+        # would fabricate a vs_baseline of exactly 1.0). A RESUMED cpu wall
+        # is partial — extrapolating it 16x would publish a number that is
+        # neither measured nor a valid extrapolation, so skip entirely.
         accel = {**cpu, "wall": cpu["wall"] * (N_ROWS / CPU_ROWS)}
         extrapolated = True
 
     result = {"metric": f"automl_higgs_shape_{N_ROWS // 1_000_000}m_wall",
-              "value": None, "unit": "s", "vs_baseline": 0.0}
+              "value": None, "unit": "s", "vs_baseline": None}
     if accel is not None:
         result["value"] = round(accel["wall"], 2)
         result["platform"] = accel.get("platform", "unknown")
@@ -337,10 +377,17 @@ def main():
         result["device_time_breakdown"] = _device_breakdown(accel)
         if curve:
             result["scaling_curve"] = curve
+        if accel.get("resumed"):
+            # the sweep reloaded fold checkpoints from an interrupted
+            # attempt: the wall covers only the REMAINDER of the work
+            result["resumed"] = True
         if extrapolated:
             result["note"] = ("no full-size measurement; value extrapolated "
                               "from the small CPU baseline")
-        if cpu is not None and not extrapolated:
+        if cpu is not None and not extrapolated \
+                and not accel.get("resumed") and not cpu.get("resumed"):
+            # a resumed run's partial wall would skew the ratio —
+            # publish vs_baseline only for complete measurements
             cpu_extrapolated = cpu["wall"] * (N_ROWS / CPU_ROWS)
             result["vs_baseline"] = round(cpu_extrapolated / accel["wall"], 3)
             result["cpu_proxy"] = {
