@@ -1,0 +1,206 @@
+"""External-model import parity (reference MLeapModelConverter.scala:93 —
+foreign serialized models become local scoring functions).
+
+The sklearn round-trips assert parity against the SOURCE LIBRARY's own
+predictions (sklearn ships in this environment). The XGBoost artifact is a
+committed schema-accurate JSON fixture (xgboost itself is not installed;
+the expected outputs come from an independent reference traversal in this
+file implementing xgboost's documented semantics: route left on x < t,
+leaf weight in split_conditions, margin base = logit(base_score)).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.local import import_sklearn, import_xgboost_json
+from transmogrifai_tpu.models.trees import TreeEnsembleModel
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "xgb_binary_logistic.json")
+
+rng = np.random.default_rng(11)
+X = rng.normal(size=(300, 3)).astype(np.float32)
+# include values equal to split thresholds: strict-vs-inclusive routing
+X[:7, 0] = 0.5
+X[7:12, 1] = -0.75
+y_cls = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] + rng.normal(0, .5, 300) > 0)
+y_reg = (2 * X[:, 0] - X[:, 1] + rng.normal(0, .1, 300)).astype(np.float64)
+
+
+def _score(model, X):
+    return model.device_apply(model.device_params(),
+                              fr.VectorColumn(jnp.asarray(X)))
+
+
+# -- xgboost JSON ------------------------------------------------------------
+
+def _xgb_reference_margin(doc: dict, X: np.ndarray) -> np.ndarray:
+    """Independent traversal with xgboost's documented semantics."""
+    learner = doc["learner"]
+    out = np.zeros(len(X))
+    for tree in learner["gradient_booster"]["model"]["trees"]:
+        left = tree["left_children"]
+        right = tree["right_children"]
+        cond = np.asarray(tree["split_conditions"], np.float32)
+        feat = tree["split_indices"]
+        for i, x in enumerate(X):
+            node = 0
+            while left[node] >= 0:
+                node = left[node] if np.float32(x[feat[node]]) < cond[node] \
+                    else right[node]
+            out[i] += cond[node]
+    p = float(learner["learner_model_param"]["base_score"])
+    return out + np.log(p / (1 - p))
+
+
+def test_xgboost_json_binary_parity():
+    with open(FIXTURE) as fh:
+        doc = json.load(fh)
+    model = import_xgboost_json(FIXTURE)
+    assert isinstance(model, TreeEnsembleModel)
+    assert model.kind == "gbt_classifier" and model.learning_rate == 1.0
+    expected_margin = _xgb_reference_margin(doc, X)
+    got = _score(model, X)
+    np.testing.assert_allclose(np.asarray(got.raw_prediction[:, 1]),
+                               expected_margin, rtol=1e-5, atol=1e-6)
+    expected_p1 = 1.0 / (1.0 + np.exp(-expected_margin))
+    np.testing.assert_allclose(np.asarray(got.probability[:, 1]),
+                               expected_p1, rtol=1e-5, atol=1e-6)
+    # accepts dicts and JSON strings too
+    assert import_xgboost_json(doc).kind == "gbt_classifier"
+    assert import_xgboost_json(json.dumps(doc)).kind == "gbt_classifier"
+
+
+def test_xgboost_json_rejects_unsupported():
+    with open(FIXTURE) as fh:
+        doc = json.load(fh)
+    doc["learner"]["objective"]["name"] = "rank:pairwise"
+    with pytest.raises(NotImplementedError):
+        import_xgboost_json(doc)
+    doc["learner"]["objective"]["name"] = "binary:logistic"
+    doc["learner"]["gradient_booster"]["model"]["tree_info"] = [0, 1, 2]
+    with pytest.raises(NotImplementedError):
+        import_xgboost_json(doc)
+    # categorical splits (enable_categorical) cannot map to thresholds
+    doc["learner"]["gradient_booster"]["model"]["tree_info"] = [0, 0, 0]
+    doc["learner"]["gradient_booster"]["model"]["trees"][0][
+        "split_type"] = [1, 0, 0, 0, 0, 0, 0]
+    with pytest.raises(NotImplementedError):
+        import_xgboost_json(doc)
+    # a typo'd path must surface as FileNotFoundError, not a JSON error
+    with pytest.raises(FileNotFoundError):
+        import_xgboost_json("/no/such/model.json")
+
+
+def test_sklearn_rejects_silently_wrong_configs():
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.linear_model import LogisticRegression
+    # exponential loss: sklearn maps margin via expit(2*raw) — not sigmoid
+    est = GradientBoostingClassifier(
+        loss="exponential", n_estimators=5, max_depth=2).fit(X, y_cls)
+    with pytest.raises(NotImplementedError):
+        import_sklearn(est)
+    # custom init estimator: per-row raw init, no scalar base_score
+    est2 = GradientBoostingClassifier(
+        init=LogisticRegression(), n_estimators=5, max_depth=2).fit(X, y_cls)
+    with pytest.raises(NotImplementedError):
+        import_sklearn(est2)
+
+
+# -- sklearn round-trips -----------------------------------------------------
+
+def test_sklearn_logistic_regression_parity():
+    from sklearn.linear_model import LogisticRegression
+    est = LogisticRegression(max_iter=200).fit(X, y_cls)
+    model = import_sklearn(est)
+    got = np.asarray(_score(model, X).probability)
+    np.testing.assert_allclose(got, est.predict_proba(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sklearn_linear_regression_parity():
+    from sklearn.linear_model import LinearRegression, Ridge
+    for est in (LinearRegression().fit(X, y_reg),
+                Ridge(alpha=0.5).fit(X, y_reg)):
+        model = import_sklearn(est)
+        got = np.asarray(_score(model, X).prediction)
+        np.testing.assert_allclose(got, est.predict(X), rtol=1e-4, atol=1e-4)
+
+
+def test_sklearn_gbt_classifier_parity():
+    from sklearn.ensemble import GradientBoostingClassifier
+    est = GradientBoostingClassifier(
+        n_estimators=25, max_depth=3, learning_rate=0.2, random_state=0
+    ).fit(X, y_cls)
+    model = import_sklearn(est)
+    assert model.kind == "gbt_classifier"
+    got = np.asarray(_score(model, X).probability)
+    np.testing.assert_allclose(got, est.predict_proba(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sklearn_gbt_regressor_parity():
+    from sklearn.ensemble import GradientBoostingRegressor
+    est = GradientBoostingRegressor(
+        n_estimators=20, max_depth=3, learning_rate=0.3, random_state=0
+    ).fit(X, y_reg)
+    model = import_sklearn(est)
+    got = np.asarray(_score(model, X).prediction)
+    np.testing.assert_allclose(got, est.predict(X), rtol=1e-4, atol=1e-4)
+
+
+def test_sklearn_random_forest_parity():
+    from sklearn.ensemble import RandomForestClassifier, RandomForestRegressor
+    est = RandomForestClassifier(
+        n_estimators=15, max_depth=5, random_state=0).fit(X, y_cls)
+    model = import_sklearn(est)
+    assert model.kind == "rf_classifier"
+    got = np.asarray(_score(model, X).probability)
+    np.testing.assert_allclose(got, est.predict_proba(X),
+                               rtol=1e-5, atol=1e-6)
+    est_r = RandomForestRegressor(
+        n_estimators=10, max_depth=5, random_state=0).fit(X, y_reg)
+    got_r = np.asarray(_score(import_sklearn(est_r), X).prediction)
+    np.testing.assert_allclose(got_r, est_r.predict(X), rtol=1e-4, atol=1e-4)
+
+
+def test_sklearn_decision_tree_parity():
+    from sklearn.tree import DecisionTreeClassifier, DecisionTreeRegressor
+    est = DecisionTreeClassifier(max_depth=4, random_state=0).fit(X, y_cls)
+    got = np.asarray(_score(import_sklearn(est), X).probability)
+    np.testing.assert_allclose(got, est.predict_proba(X),
+                               rtol=1e-5, atol=1e-6)
+    est_r = DecisionTreeRegressor(max_depth=4, random_state=0).fit(X, y_reg)
+    got_r = np.asarray(_score(import_sklearn(est_r), X).prediction)
+    np.testing.assert_allclose(got_r, est_r.predict(X), rtol=1e-4, atol=1e-4)
+
+
+def test_imported_model_serializes_like_native():
+    """Imported models ride the normal fitted_state round-trip."""
+    model = import_xgboost_json(FIXTURE)
+    state = model.fitted_state()
+    clone = TreeEnsembleModel.from_config(model.config())
+    clone.set_fitted_state(state)
+    a = np.asarray(_score(model, X).probability)
+    b = np.asarray(_score(clone, X).probability)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_depth_guard_and_unknown_estimator():
+    from sklearn.ensemble import RandomForestRegressor
+    from sklearn.svm import SVC
+    deep = RandomForestRegressor(n_estimators=2, random_state=0).fit(
+        np.asarray(rng.normal(size=(4000, 3)), np.float32),
+        rng.normal(size=4000))
+    # unbounded depth on 4k rows exceeds the dense-representation cap
+    if max(e.tree_.max_depth for e in deep.estimators_) > 16:
+        with pytest.raises(ValueError):
+            import_sklearn(deep)
+    with pytest.raises(NotImplementedError):
+        import_sklearn(SVC().fit(X[:50], y_cls[:50]))

@@ -1,16 +1,21 @@
 """Titanic end-to-end AutoML quality test.
 
-Parity target (BASELINE.md): reference helloworld OpTitanicSimple reaches
-holdout AuROC 0.8822 with a 3-fold CV sweep (LR + RF candidates). With the
-linear-only zoo the gate here is AuROC >= 0.83 on the reserved holdout and
->= 0.85 train AuROC; the tree models raise this to reference parity.
+Parity target (BASELINE.md / reference README.md:82-95): reference
+helloworld OpTitanicSimple publishes holdout AuROC 0.8822 / AuPR 0.8225
+with a 3-fold CV sweep (LR + RF candidates). The gated sweep here
+includes tree candidates (GBT + RF alongside the LR grid) and must reach
+AuROC >= 0.88 / AuPR >= 0.80 on the reserved holdout — at or above the
+reference's published numbers (measured: 0.8956 / 0.8627).
 """
 
 import numpy as np
 import pytest
 
 from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
-from transmogrifai_tpu.models.linear import OpLinearSVC, OpLogisticRegression
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.models.trees import (
+    OpGBTClassifier, OpRandomForestClassifier,
+)
 from transmogrifai_tpu.ops.transmogrifier import transmogrify
 from transmogrifai_tpu.selector import (
     BinaryClassificationModelSelector, DataSplitter,
@@ -30,7 +35,10 @@ def titanic_model():
             (OpLogisticRegression(),
              [{"reg_param": r, "elastic_net_param": e}
               for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]),
-            (OpLinearSVC(), [{"reg_param": r} for r in (0.001, 0.01)]),
+            (OpGBTClassifier(), [{"num_rounds": 50, "max_depth": 3},
+                                 {"num_rounds": 50, "max_depth": 6}]),
+            (OpRandomForestClassifier(),
+             [{"num_trees": 50, "max_depth": 6}]),
         ],
         splitter=DataSplitter(reserve_test_fraction=0.1, seed=42))
     pred = survived.transform_with(selector, features)
@@ -49,9 +57,10 @@ def test_titanic_quality(titanic_model):
     train = summary.train_evaluation["binary classification"]
     print("holdout:", {k: round(v, 4) for k, v in holdout.items()
                        if isinstance(v, float)})
-    assert train["au_roc"] >= 0.85
-    assert holdout["au_roc"] >= 0.83
-    assert holdout["au_pr"] >= 0.70
+    assert train["au_roc"] >= 0.88
+    # reference-parity gate (README.md:82-95 publishes 0.8822 / 0.8225)
+    assert holdout["au_roc"] >= 0.88
+    assert holdout["au_pr"] >= 0.80
 
 
 def test_titanic_sex_is_top_signal(titanic_model):

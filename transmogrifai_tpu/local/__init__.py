@@ -1,3 +1,6 @@
 from transmogrifai_tpu.local.scoring import make_score_function
+from transmogrifai_tpu.local.model_import import (
+    import_sklearn, import_xgboost_json,
+)
 
-__all__ = ["make_score_function"]
+__all__ = ["make_score_function", "import_sklearn", "import_xgboost_json"]
