@@ -74,11 +74,18 @@ class _TreeSpec:
         self.right = np.asarray(right, np.int32)
         self.value = np.asarray(value, np.float32)
 
-    def depth(self, node: int = 0, level: int = 0) -> int:
-        if self.feature[node] < 0:
-            return level
-        return max(self.depth(self.left[node], level + 1),
-                   self.depth(self.right[node], level + 1))
+    def depth(self) -> int:
+        # iterative: an unbounded sklearn tree can out-recurse Python long
+        # before the depth guard would fire
+        best, stack = 0, [(0, 0)]
+        while stack:
+            node, level = stack.pop()
+            if self.feature[node] < 0:
+                best = max(best, level)
+            else:
+                stack.append((int(self.left[node]), level + 1))
+                stack.append((int(self.right[node]), level + 1))
+        return best
 
 
 def _ensemble_from_specs(specs: Sequence[_TreeSpec], *, kind: str,
@@ -164,8 +171,9 @@ def import_xgboost_json(source) -> TreeEnsembleModel:
     objective = learner["objective"]["name"]
     booster = learner["gradient_booster"]
     if booster.get("name", "gbtree") not in ("gbtree", ""):
-        raise ValueError(f"unsupported booster {booster.get('name')!r} "
-                         "(only gbtree imports)")
+        raise NotImplementedError(
+            f"unsupported booster {booster.get('name')!r} "
+            "(only gbtree imports)")
     gb_model = booster["model"]
     tree_info = [int(t) for t in gb_model.get("tree_info", [])]
     if any(t != 0 for t in tree_info):
@@ -272,8 +280,13 @@ def import_sklearn(est):
         b = np.array([0.0, float(est.intercept_[0])])
         return LinearClassificationModel(weights=W, intercept=b)
     if name in ("LinearRegression", "Ridge", "Lasso", "ElasticNet"):
+        coef = np.asarray(est.coef_, np.float64)
+        if coef.ndim > 1 and coef.shape[0] != 1:
+            raise NotImplementedError(
+                "multi-output linear regression import is single-target "
+                f"only (coef_ shape {coef.shape})")
         return LinearRegressionModel(
-            weights=np.asarray(est.coef_, np.float64).ravel(),
+            weights=coef.ravel(),
             intercept=float(np.ravel(est.intercept_)[0]))
     if name == "GradientBoostingClassifier":
         if est.n_classes_ != 2:
