@@ -23,38 +23,82 @@ import numpy as np
 from transmogrifai_tpu.ops.names import (
     FEMALE_NAMES, LOCATIONS, MALE_NAMES, ORG_SUFFIXES, SURNAMES,
 )
-from transmogrifai_tpu.ops.ner import train_tagger
+from transmogrifai_tpu.ops.ner import (
+    evaluate_tagger, read_conll, train_tagger,
+)
 
 TEMPLATES = [
-    (["{first}", "{last}", "visited", "{loc}", "last", "week"],
+    (["{first}", "{last}", "visited", "{loc}", "last", "{day}"],
      ["PER", "PER", "O", "LOC", "O", "O"]),
-    (["{first}", "{last}", "flew", "to", "{loc}"],
-     ["PER", "PER", "O", "O", "LOC"]),
-    (["the", "{org}", "{suffix}", "office", "in", "{loc}"],
-     ["O", "ORG", "ORG", "O", "O", "LOC"]),
+    (["{first}", "{last}", "flew", "to", "{loc}", "on", "{day}"],
+     ["PER", "PER", "O", "O", "LOC", "O", "O"]),
+    (["The", "{org}", "{suffix}", "office", "in", "{loc}", "closed"],
+     ["O", "ORG", "ORG", "O", "O", "LOC", "O"]),
     (["{first}", "joined", "{org}", "{suffix}", "in", "{loc}"],
      ["PER", "O", "ORG", "ORG", "O", "LOC"]),
-    (["contact", "{first}", "{last}", "at", "{org}", "{suffix}"],
+    (["Contact", "{first}", "{last}", "at", "{org}", "{suffix}"],
      ["O", "PER", "PER", "O", "ORG", "ORG"]),
     (["{loc}", "is", "hiring", "for", "{org}", "{suffix}"],
      ["LOC", "O", "O", "O", "ORG", "ORG"]),
-    (["meeting", "with", "{first}", "tomorrow"],
-     ["O", "O", "PER", "O"]),
-    (["invoice", "42", "from", "{org}", "{suffix}"],
+    (["{org}", "{suffix}", "reported", "record", "profits", "in", "{mon}"],
+     ["ORG", "ORG", "O", "O", "O", "O", "O"]),
+    (["{org}", "{suffix}", "acquired", "a", "site", "near", "{loc}"],
+     ["ORG", "ORG", "O", "O", "O", "O", "LOC"]),
+    (["Meeting", "with", "{first}", "{last}", "on", "{day}"],
+     ["O", "O", "PER", "PER", "O", "O"]),
+    (["{first}", "{last}", "leads", "the", "division", "at", "{org}",
+      "{suffix}"],
+     ["PER", "PER", "O", "O", "O", "O", "ORG", "ORG"]),
+    (["Invoice", "42", "from", "{org}", "{suffix}"],
      ["O", "O", "O", "ORG", "ORG"]),
-    (["mark", "the", "date", "and", "sign", "here"],  # ambiguity negatives
+    (["The", "train", "from", "{loc}", "to", "{loc2}", "was", "delayed"],
+     ["O", "O", "O", "LOC", "O", "LOC", "O", "O"]),
+    (["Flights", "from", "{loc}", "resume", "in", "{mon}"],
+     ["O", "O", "LOC", "O", "O", "O"]),
+    # negatives: sentence-initial capitals, weekdays/months, common nouns —
+    # real sentences START capitalized, and a corpus without capitalized O
+    # tokens teaches the fatal rule "capitalized => entity"
+    (["Mark", "the", "date", "and", "sign", "here"],
      ["O", "O", "O", "O", "O", "O"]),
+    (["{onoun}", "gathered", "outside", "parliament", "in", "{loc}"],
+     ["O", "O", "O", "O", "O", "LOC"]),
+    (["{onoun}", "spread", "across", "the", "region", "last", "{mon}"],
+     ["O", "O", "O", "O", "O", "O", "O"]),
+    (["The", "museum", "in", "{loc}", "reopened", "on", "{day}"],
+     ["O", "O", "O", "LOC", "O", "O", "O"]),
+    (["Heavy", "rain", "is", "expected", "on", "{day}"],
+     ["O", "O", "O", "O", "O", "O"]),
+    (["Shares", "of", "{org}", "{suffix}", "fell", "in", "{mon}"],
+     ["O", "O", "ORG", "ORG", "O", "O", "O"]),
+    (["Auditors", "from", "{org}", "{suffix}", "reviewed", "the",
+      "accounts"],
+     ["O", "O", "ORG", "ORG", "O", "O", "O"]),
 ]
 
 #: synthetic org stems (the dictionaries carry suffixes, not stems)
 ORG_STEMS = ["acme", "initech", "globex", "umbrella", "hooli", "vandelay",
              "cyberdyne", "tyrell", "aperture", "soylent", "wonka",
-             "duff", "oceanic", "virtucon", "gringotts", "monarch"]
+             "duff", "oceanic", "virtucon", "gringotts", "monarch",
+             "vertex", "meridian", "pinnacle", "zenith", "apex", "nimbus",
+             "quasar", "helios", "borealis", "cascade", "keystone",
+             "summit", "atlas", "orion", "polaris", "vanguard", "citadel",
+             "horizon", "beacon", "crestline", "solstice", "ridgeway"]
+
+#: capitalized sentence-initial O nouns (negatives pool)
+O_NOUNS = ["Protesters", "Wildfires", "Tourists", "Negotiators",
+           "Delegates", "Officials", "Workers", "Students", "Investors",
+           "Residents", "Engineers", "Farmers"]
+
+DAYS = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+        "Saturday", "Sunday"]
+MONTHS = ["January", "February", "March", "April", "May", "June", "July",
+          "August", "September", "October", "November", "December"]
 
 
-def synth(first, last, locs, n, seed):
+def synth(first, last, locs, n, seed, orgs=None):
     rng = np.random.default_rng(seed)
     first, last, locs = list(first), list(last), list(locs)
+    orgs = list(orgs) if orgs is not None else list(ORG_STEMS)
     suffixes = [s.capitalize() for s in sorted(ORG_SUFFIXES)]
     sents, tags = [], []
     for _ in range(n):
@@ -62,9 +106,16 @@ def synth(first, last, locs, n, seed):
         sub = {"{first}": first[rng.integers(len(first))].capitalize(),
                "{last}": last[rng.integers(len(last))].capitalize(),
                "{loc}": locs[rng.integers(len(locs))].capitalize(),
-               "{org}": ORG_STEMS[rng.integers(len(ORG_STEMS))].capitalize(),
-               "{suffix}": suffixes[rng.integers(len(suffixes))]}
-        sents.append([sub.get(t, t) for t in toks])
+               "{loc2}": locs[rng.integers(len(locs))].capitalize(),
+               "{org}": orgs[rng.integers(len(orgs))].capitalize(),
+               "{suffix}": suffixes[rng.integers(len(suffixes))],
+               "{day}": DAYS[rng.integers(len(DAYS))],
+               "{mon}": MONTHS[rng.integers(len(MONTHS))],
+               "{onoun}": O_NOUNS[rng.integers(len(O_NOUNS))]}
+        # real sentences start capitalized: never teach "capital => entity"
+        out = [sub.get(t, t) for t in toks]
+        out[0] = out[0][:1].upper() + out[0][1:]
+        sents.append(out)
         tags.append(list(tg))
     return sents, tags
 
@@ -76,30 +127,52 @@ def main() -> int:
     firsts = sorted(MALE_NAMES | FEMALE_NAMES)
     lasts = sorted(SURNAMES)
     locs = sorted(LOCATIONS)
-    # hold out 20% of every dictionary: accuracy is generalization, not
+    orgs = sorted(ORG_STEMS)
+    # hold out 20% of every vocabulary: accuracy is generalization, not
     # memorization of the training vocabulary
-    cut_f, cut_l, cut_c = (len(firsts) * 4 // 5, len(lasts) * 4 // 5,
-                           len(locs) * 4 // 5)
+    cut_f, cut_l, cut_c, cut_o = (len(firsts) * 4 // 5, len(lasts) * 4 // 5,
+                                  len(locs) * 4 // 5, len(orgs) * 4 // 5)
     dicts = {"first": frozenset(firsts), "last": frozenset(lasts),
              "loc": frozenset(locs)}
     train_s, train_t = synth(firsts[:cut_f], lasts[:cut_l], locs[:cut_c],
-                             4000, seed=7)
+                             6000, seed=7, orgs=orgs[:cut_o])
     tagger = train_tagger(train_s, train_t, dicts=dicts, epochs=5)
 
     test_s, test_t = synth(firsts[cut_f:], lasts[cut_l:], locs[cut_c:],
-                           500, seed=1234)
-    correct = total = 0
-    for toks, gold in zip(test_s, test_t):
-        pred = tagger.tag(toks)
-        correct += sum(p == g for p, g in zip(pred, gold))
-        total += len(gold)
-    acc = correct / total
+                           500, seed=1234, orgs=orgs[cut_o:])
+    held_out = evaluate_tagger(tagger, test_s, test_t)
+
+    # the REAL quality record: hand-annotated natural sentences committed
+    # under tests/fixtures (never seen in training — different names,
+    # orgs, and constructions). These numbers ship in the asset metadata.
+    fixture = os.path.join(os.path.dirname(__file__), "..", "tests",
+                           "fixtures", "ner_annotated.conll")
+    if not os.path.exists(fixture):
+        print(f"FATAL: annotated fixture {fixture} missing — the asset "
+              "must ship with measured quality", file=sys.stderr)
+        return 1
+    sents, tags = read_conll(fixture)
+    annotated = evaluate_tagger(tagger, sents, tags)
+    tagger.metadata = {
+        "corpus": "templated synthesis over embedded multi-cultural "
+                  "dictionaries (held-out vocab eval)",
+        "held_out_synth": held_out,
+        "annotated_fixture": annotated,
+        "fixture": "tests/fixtures/ner_annotated.conll",
+    }
+    acc = held_out["token_accuracy"]
     os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
     tagger.save(out)
     size_kb = os.path.getsize(out) / 1024
-    print(f"held-out token accuracy {acc:.4f}; asset {out} "
-          f"({size_kb:.0f} KB)")
-    return 0 if acc > 0.9 else 1
+    print(f"held-out synth token accuracy {acc:.4f}; "
+          f"annotated fixture: {annotated}; asset {out} ({size_kb:.0f} KB)")
+    # gate BOTH records: synthetic generalization and the shipped-test
+    # thresholds on natural text (test_ner.py gates the same numbers)
+    ok = (acc > 0.9 and annotated["token_accuracy"] >= 0.93
+          and annotated["PER"]["f1"] >= 0.82
+          and annotated["LOC"]["f1"] >= 0.88
+          and annotated["ORG"]["f1"] >= 0.78)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -29,6 +29,31 @@ def _write_dataset(path, n=240, seed=0):
                         "color": color, "label": label})
 
 
+@pytest.fixture
+def small_default_zoo(monkeypatch):
+    """Shrink the factory default candidate zoos for the generated-project
+    train cycles: these tests exercise the generate->import->train CYCLE,
+    not model breadth, and the full zoo costs ~1 min per project on one
+    core. The generated code path (with_cross_validation(n_folds=3) with
+    factory defaults) is unchanged — only the default grids shrink."""
+    from transmogrifai_tpu.models.linear import (
+        OpLinearRegression, OpLogisticRegression,
+    )
+    from transmogrifai_tpu.selector import factories
+    monkeypatch.setattr(
+        factories, "_default_binary_candidates",
+        lambda: [(OpLogisticRegression(max_iter=30),
+                  [{"reg_param": r} for r in (0.01, 0.1)])])
+    monkeypatch.setattr(
+        factories, "_default_multi_candidates",
+        lambda: [(OpLogisticRegression(max_iter=30),
+                  [{"reg_param": r} for r in (0.01, 0.1)])])
+    monkeypatch.setattr(
+        factories, "_default_regression_candidates",
+        lambda: [(OpLinearRegression(),
+                  [{"reg_param": r} for r in (0.0, 0.1)])])
+
+
 def test_detect_problem_kind():
     assert detect_problem_kind([0, 1, 0, 1], ft.Integral) == ProblemKind.BINARY
     assert detect_problem_kind(["a", "b", "c"], ft.Text) == \
@@ -39,7 +64,7 @@ def test_detect_problem_kind():
         ProblemKind.REGRESSION
 
 
-def test_generate_and_run_project(tmp_path, monkeypatch):
+def test_generate_and_run_project(tmp_path, monkeypatch, small_default_zoo):
     data = str(tmp_path / "data.csv")
     _write_dataset(data)
     rc = main(["gen", "MyProject", "--input", data, "--id", "id",
@@ -74,7 +99,7 @@ def test_generate_and_run_project(tmp_path, monkeypatch):
         sys.modules.pop(m, None)
 
 
-def test_generate_multiclass_project(tmp_path, monkeypatch):
+def test_generate_multiclass_project(tmp_path, monkeypatch, small_default_zoo):
     data = str(tmp_path / "iris.csv")
     rng = np.random.default_rng(1)
     with open(data, "w", newline="") as fh:
@@ -103,7 +128,7 @@ def test_generate_multiclass_project(tmp_path, monkeypatch):
         sys.modules.pop(m, None)
 
 
-def test_generate_text_binary_label_project(tmp_path, monkeypatch):
+def test_generate_text_binary_label_project(tmp_path, monkeypatch, small_default_zoo):
     """A text-valued binary response (two non-boolean string labels) must
     get the string indexer: the binary selector's label input is RealNN
     (ADVICE r1). Boolean-like strings ('yes'/'no') are inferred Binary by

@@ -24,13 +24,21 @@ def test_iris_example_trains_accurately():
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     from transmogrifai_tpu import dsl  # noqa: F401
 
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+
     mod = _load("op_iris")
     frame = mod.iris_frame(300, seed=5)
     feats = FeatureBuilder.from_frame(frame, response="species")
     label = feats["species"].index_string()
     features = transmogrify([feats[c] for c in (
         "sepal_length", "sepal_width", "petal_length", "petal_width")])
-    sel = MultiClassificationModelSelector.with_train_validation_split(seed=1)
+    # pipeline-mechanics check on synthetic clusters: one small LR grid is
+    # enough (the REAL-data gate below covers model breadth; the default
+    # zoo here cost ~1 min of one-core CI for no extra coverage)
+    sel = MultiClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=30),
+             [{"reg_param": r} for r in (0.0, 0.01)])])
     pred = label.transform_with(sel, features)
     model = (Workflow().set_input_frame(frame)
              .set_result_features(pred, features).train())
@@ -46,11 +54,18 @@ def test_boston_example_trains_accurately():
     from transmogrifai_tpu.ops.transmogrifier import transmogrify
     from transmogrifai_tpu import dsl  # noqa: F401
 
+    from transmogrifai_tpu.models.linear import OpLinearRegression
+
     mod = _load("op_boston")
     frame = mod.boston_frame(400, seed=2)
     feats = FeatureBuilder.from_frame(frame, response="medv")
     features = transmogrify([feats[c] for c in mod.COLUMNS])
-    sel = RegressionModelSelector.with_train_validation_split(seed=1)
+    # pipeline-mechanics check on a linear synthetic signal: linear
+    # candidates only (the REAL-data gate below covers model breadth)
+    sel = RegressionModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLinearRegression(),
+             [{"reg_param": r} for r in (0.0, 0.01)])])
     pred = feats["medv"].transform_with(sel, features)
     model = (Workflow().set_input_frame(frame)
              .set_result_features(pred, features).train())
@@ -79,8 +94,18 @@ def test_iris_real_data_quality_gate():
     label = feats["species"].index_string()
     features = transmogrify([feats[c] for c in (
         "sepal_length", "sepal_width", "petal_length", "petal_width")])
+    # all three model families, one grid point each: quality parity with
+    # the reference demo at a fraction of the default zoo's one-core cost
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier, OpRandomForestClassifier,
+    )
     sel = MultiClassificationModelSelector.with_cross_validation(
-        n_folds=3, seed=42)
+        n_folds=3, seed=42, models_and_parameters=[
+            (OpLogisticRegression(max_iter=40), [{"reg_param": 0.01}]),
+            (OpRandomForestClassifier(num_trees=25, max_depth=6), [{}]),
+            (OpGBTClassifier(num_rounds=25, max_depth=3), [{}]),
+        ])
     pred = label.transform_with(sel, features)
     model = (Workflow().set_input_frame(frame)
              .set_result_features(pred, features).train())
@@ -108,7 +133,17 @@ def test_boston_real_data_quality_gate():
     assert frame.n_rows == 333
     feats = FeatureBuilder.from_frame(frame, response="medv")
     features = transmogrify([feats[c] for c in mod.BOSTON_COLUMNS])
-    sel = RegressionModelSelector.with_cross_validation(n_folds=3, seed=42)
+    # all three model families, one grid point each (see iris gate note)
+    from transmogrifai_tpu.models.linear import OpLinearRegression
+    from transmogrifai_tpu.models.trees import (
+        OpGBTRegressor, OpRandomForestRegressor,
+    )
+    sel = RegressionModelSelector.with_cross_validation(
+        n_folds=3, seed=42, models_and_parameters=[
+            (OpLinearRegression(), [{"reg_param": 0.0}]),
+            (OpRandomForestRegressor(num_trees=25, max_depth=6), [{}]),
+            (OpGBTRegressor(num_rounds=25, max_depth=3), [{}]),
+        ])
     pred = feats["medv"].transform_with(sel, features)
     model = (Workflow().set_input_frame(frame)
              .set_result_features(pred, features).train())
@@ -207,3 +242,32 @@ def test_dataprep_joins_and_aggregates_reference_parity():
     assert rows["456"]["numClicksTomorrow"] == 1.0
     assert rows["789"]["numSendsLastWeek"] == 1.0
     assert rows["789"]["numClicksTomorrow"] is None  # 789 never clicked
+
+
+def test_linear_regression_large_scale_targets():
+    """Regression guard (r4): squared-loss training standardizes the
+    TARGET and folds back — from 0, Adam(0.1) x max_iter steps can only
+    travel ~max_iter/10, silently under-fitting targets with large mean
+    (Boston medv ~22: r2 was NEGATIVE) or large scale (dollar prices)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.linear import OpLinearRegression
+
+    rng = np.random.default_rng(3)
+
+    def r2_of(X, y):
+        est = OpLinearRegression()
+        m = est.fit_arrays(
+            jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.float32),
+            jnp.ones(len(y), jnp.float32), {**est.default_params})
+        pred = np.asarray(m.device_apply(
+            m.device_params(),
+            type("C", (), {"values": jnp.asarray(X, jnp.float32)})()
+        ).prediction)
+        return 1 - ((pred - y) ** 2).mean() / np.var(y)
+
+    X = np.stack([rng.normal(6.3, .7, 300), rng.uniform(180, 720, 300)], 1)
+    assert r2_of(X, 22.0 + 6.0 * (X[:, 0] - 6.3)
+                 + rng.normal(0, 1.0, 300)) > 0.8   # large mean
+    Z = rng.normal(size=(300, 2))
+    assert r2_of(Z, 250e3 + 90e3 * Z[:, 0] - 40e3 * Z[:, 1]
+                 + rng.normal(0, 5e3, 300)) > 0.95  # large variance

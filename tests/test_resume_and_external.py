@@ -52,9 +52,14 @@ def test_with_model_stages_reuses_fitted(tmp_path):
     vec = transmogrify([feats["x"]])
     model1 = Workflow().set_input_frame(host).set_result_features(vec).train()
 
-    # extend the same DAG with a selector; the vectorizer must be reused
+    # extend the same DAG with a selector; the vectorizer must be reused.
+    # Small explicit candidates: this tests fitted-stage REUSE, not model
+    # breadth (the default zoo costs ~2 min per train on one core)
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
     sel = BinaryClassificationModelSelector.with_train_validation_split(
-        seed=5)
+        seed=5, models_and_parameters=[
+            (OpLogisticRegression(max_iter=25),
+             [{"reg_param": r} for r in (0.01, 0.1)])])
     pred = feats["label"].transform_with(sel, vec)
     wf2 = (Workflow().set_input_frame(host)
            .set_result_features(pred, vec)

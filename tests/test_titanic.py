@@ -33,8 +33,8 @@ def titanic_model():
         n_folds=3, seed=42, validation_metric="auPR",
         models_and_parameters=[
             (OpLogisticRegression(),
-             [{"reg_param": r, "elastic_net_param": e}
-              for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]),
+             [{"reg_param": 0.01, "elastic_net_param": e}
+              for e in (0.0, 0.5)]),
             (OpGBTClassifier(), [{"num_rounds": 50, "max_depth": 3},
                                  {"num_rounds": 50, "max_depth": 6}]),
             (OpRandomForestClassifier(),

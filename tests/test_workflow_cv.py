@@ -31,12 +31,24 @@ def _make_data(n=400, seed=0):
 
 
 def _pipeline(host, sanity_check=True):
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import OpGBTClassifier
+
     feats = FeatureBuilder.from_frame(host, response="label")
     vec = transmogrify([feats["x1"], feats["x2"]])
     if sanity_check:
         vec = feats["label"].sanity_check(vec)
+    # a small explicit candidate set: these tests exercise the CV-cut
+    # MECHANICS (before/during/after stitching), not model breadth — the
+    # full default zoo costs ~2 min per train on one CPU core. One linear
+    # grid + one tiny tree keeps both model-family code paths in the loop.
     sel = BinaryClassificationModelSelector.with_cross_validation(
-        n_folds=3, seed=7)
+        n_folds=3, seed=7,
+        models_and_parameters=[
+            (OpLogisticRegression(max_iter=25),
+             [{"reg_param": r} for r in (0.01, 0.1)]),
+            (OpGBTClassifier(num_rounds=8, max_depth=3, max_bins=16), [{}]),
+        ])
     pred = feats["label"].transform_with(sel, vec)
     return feats, vec, pred
 

@@ -63,6 +63,20 @@ def _train_linear(X, y, w, reg_param, elastic_net, *, loss_kind: str,
         mu, sd = jnp.zeros(d), jnp.ones(d)
         Xs = X
     wsum = jnp.maximum(jnp.sum(w), 1.0)
+    # squared loss: train against the STANDARDIZED target and fold back —
+    # Adam(0.1) x max_iter steps can only travel ~max_iter/10 from 0, so
+    # raw targets with large mean OR large scale (Boston medv ~22, dollar
+    # prices ~1e5) silently under-fit; in (y - ym)/ysd space the optimum
+    # is O(1) in every direction. Classification is untouched (margins
+    # live near 0 already).
+    if loss_kind == "squared" and fit_intercept:
+        ym = jnp.sum(y * w) / wsum
+        ysd = jnp.sqrt(jnp.maximum(
+            jnp.sum(((y - ym) ** 2) * w) / wsum, 1e-12))
+        y_fit = (y - ym) / ysd
+    else:
+        ym, ysd = jnp.float32(0.0), jnp.float32(1.0)
+        y_fit = y
     C = n_classes if loss_kind == "softmax" else 1
     W0 = jnp.zeros((d, C), dtype=jnp.float32)
     b0 = jnp.zeros((C,), dtype=jnp.float32)
@@ -75,11 +89,11 @@ def _train_linear(X, y, w, reg_param, elastic_net, *, loss_kind: str,
             nll = -logp[jnp.arange(n), y.astype(jnp.int32)]
             data_loss = jnp.sum(nll * w) / wsum
         elif loss_kind == "hinge":
-            s = 2.0 * y - 1.0
+            s = 2.0 * y_fit - 1.0
             margin = jnp.maximum(0.0, 1.0 - s * z[:, 0])
             data_loss = jnp.sum(margin * w) / wsum
-        else:  # squared
-            data_loss = 0.5 * jnp.sum(((z[:, 0] - y) ** 2) * w) / wsum
+        else:  # squared (y_fit is the standardized target)
+            data_loss = 0.5 * jnp.sum(((z[:, 0] - y_fit) ** 2) * w) / wsum
         l2 = 0.5 * jnp.sum(W ** 2)
         l1 = jnp.sum(jnp.abs(W))
         return data_loss + reg_param * ((1.0 - elastic_net) * l2
@@ -100,7 +114,10 @@ def _train_linear(X, y, w, reg_param, elastic_net, *, loss_kind: str,
     (params, _), losses = jax.lax.scan(step, ((W0, b0), state0), None,
                                        length=max_iter)
     W, b = params
-    # fold standardization back into original feature space
+    # fold target standardization (squared loss) then feature
+    # standardization back into original space
+    W = W * ysd
+    b = b * ysd + ym
     W_orig = W / sd[:, None]
     b_orig = b - (mu / sd) @ W
     return W_orig, b_orig, losses[-1]

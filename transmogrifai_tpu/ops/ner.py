@@ -28,7 +28,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["ViterbiTagger", "train_tagger", "load_tagger", "default_tagger"]
+__all__ = ["ViterbiTagger", "train_tagger", "load_tagger", "default_tagger",
+           "read_conll", "evaluate_tagger"]
 
 #: tagset (IO scheme — OpenNLP's person/location/organization finders)
 TAGS = ("O", "PER", "LOC", "ORG")
@@ -79,13 +80,17 @@ class ViterbiTagger:
 
     def __init__(self, weights: Optional[np.ndarray] = None,
                  transitions: Optional[np.ndarray] = None,
-                 dicts: Optional[dict] = None):
+                 dicts: Optional[dict] = None,
+                 metadata: Optional[dict] = None):
         T = len(TAGS)
         self.weights = (weights if weights is not None
                         else np.zeros((T, DIM), np.float32))
         self.transitions = (transitions if transitions is not None
                             else np.zeros((T, T), np.float32))
         self.dicts = dicts or {}
+        #: provenance + measured quality (precision/recall per class on the
+        #: committed annotated fixture), recorded by the asset builder
+        self.metadata = dict(metadata or {})
 
     def _emissions(self, tokens: Sequence[str]) -> np.ndarray:
         T = len(TAGS)
@@ -116,20 +121,27 @@ class ViterbiTagger:
 
     # -- asset format --------------------------------------------------------
     def save(self, path: str) -> None:
+        import json
         arrs = {"weights": self.weights, "transitions": self.transitions}
         for name, vocab in self.dicts.items():
             arrs[f"dict_{name}"] = np.array(sorted(vocab), dtype="U")
+        if self.metadata:
+            arrs["meta_json"] = np.array(json.dumps(self.metadata),
+                                         dtype="U")
         np.savez_compressed(path, **arrs)
 
     @staticmethod
     def load(path: str) -> "ViterbiTagger":
+        import json
         data = np.load(path, allow_pickle=False)
         dicts = {k[5:]: frozenset(str(v) for v in data[k])
                  for k in data.files if k.startswith("dict_")}
+        meta = (json.loads(str(data["meta_json"]))
+                if "meta_json" in data.files else {})
         return ViterbiTagger(weights=data["weights"].astype(np.float32),
                              transitions=data["transitions"].astype(
                                  np.float32),
-                             dicts=dicts)
+                             dicts=dicts, metadata=meta)
 
 
 def train_tagger(sentences: Sequence[Sequence[str]],
@@ -179,6 +191,67 @@ def train_tagger(sentences: Sequence[Sequence[str]],
         tagger.weights = (w_sum / steps).astype(np.float32)
         tagger.transitions = (trans_sum / steps).astype(np.float32)
     return tagger
+
+
+def read_conll(path: str) -> tuple[list[list[str]], list[list[str]]]:
+    """Read a two-column (token<TAB>tag) file with blank-line sentence
+    breaks — the format of the committed annotated evaluation fixture."""
+    sents: list[list[str]] = []
+    tags: list[list[str]] = []
+    cur_t: list[str] = []
+    cur_g: list[str] = []
+    with open(path) as fh:
+        for line in fh:
+            if not line.strip():
+                if cur_t:
+                    sents.append(cur_t)
+                    tags.append(cur_g)
+                cur_t, cur_g = [], []
+            else:
+                # token is the first column, tag the last: accepts the
+                # committed 2-column fixture AND space-separated /
+                # multi-column CoNLL-2003 files (token POS chunk NER)
+                cols = line.split()
+                cur_t.append(cols[0])
+                cur_g.append(cols[-1])
+    if cur_t:
+        sents.append(cur_t)
+        tags.append(cur_g)
+    return sents, tags
+
+
+def evaluate_tagger(tagger: "ViterbiTagger",
+                    sentences: Sequence[Sequence[str]],
+                    tag_seqs: Sequence[Sequence[str]]) -> dict:
+    """Token-level precision/recall/F1 per entity class + overall token
+    accuracy — the quality record the asset metadata carries (reference
+    OpenNLP models ship with published eval numbers; ours travel WITH the
+    asset)."""
+    tp: dict = {}
+    fp: dict = {}
+    fn: dict = {}
+    correct = total = 0
+    for toks, gold in zip(sentences, tag_seqs):
+        pred = tagger.tag(list(toks))
+        for p, g in zip(pred, gold):
+            total += 1
+            correct += p == g
+            if p == g:
+                if g != "O":
+                    tp[g] = tp.get(g, 0) + 1
+            else:
+                if p != "O":
+                    fp[p] = fp.get(p, 0) + 1
+                if g != "O":
+                    fn[g] = fn.get(g, 0) + 1
+    out = {"token_accuracy": round(correct / max(total, 1), 4),
+           "n_sentences": len(sentences), "n_tokens": total}
+    for c in TAGS[1:]:
+        p = tp.get(c, 0) / max(tp.get(c, 0) + fp.get(c, 0), 1)
+        r = tp.get(c, 0) / max(tp.get(c, 0) + fn.get(c, 0), 1)
+        out[c] = {"precision": round(p, 4), "recall": round(r, 4),
+                  "f1": round(2 * p * r / max(p + r, 1e-12), 4)}
+    return out
 
 
 _loaded: dict = {"tried": False, "tagger": None}
