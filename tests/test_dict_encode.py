@@ -124,3 +124,18 @@ def test_non_string_objects_still_encode():
     assert vocab == ["1.0", "2.5"]
     np.testing.assert_array_equal(codes[:3], [0, 1, -1])
     assert (codes.reshape(-1, 3) == codes[:3]).all()
+
+
+def test_criteo_e2e_bench_script_smoke(monkeypatch):
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "bench_criteo_e2e.py")
+    monkeypatch.setenv("CRITEO_E2E_ROWS", "3000")
+    monkeypatch.setenv("CRITEO_TRAIN_ROWS", "2000")
+    monkeypatch.setenv("CRITEO_CHUNK", "1000")
+    spec = importlib.util.spec_from_file_location("bench_criteo_e2e", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.N_ROWS, mod.TRAIN_ROWS, mod.CHUNK = 3000, 2000, 1000
+    assert mod.main() == 0
