@@ -21,6 +21,17 @@ import jax  # noqa: E402
 # before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache (same one bench.py uses): the suite's
+# wall-clock is dominated by per-stage compiles (tree/LDA/W2V training
+# programs), which are identical across runs — repeat CI runs skip them.
+try:
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -175,3 +175,17 @@ def test_generator_errors(tmp_path):
     with pytest.raises(FileExistsError):
         main(["gen", "P2", "--input", data, "--id", "id",
               "--response", "label", "--output", str(tmp_path)])
+
+
+def test_shell_namespace_and_banner():
+    """The repl-module analog: the preloaded namespace resolves the whole
+    public surface and the banner renders without an interactive loop."""
+    from transmogrifai_tpu.cli.shell import banner, make_namespace
+    ns = make_namespace()
+    for key in ("FeatureBuilder", "transmogrify", "Workflow",
+                "BinaryClassificationModelSelector", "DataReaders",
+                "SanityChecker", "RawFeatureFilter", "import_sklearn",
+                "make_score_function", "ft", "fr"):
+        assert key in ns, key
+    text = banner()
+    assert "backend" in text and "FeatureBuilder" in text

@@ -28,7 +28,17 @@ def _write_csv(path, rows):
         w.writerows(rows)
 
 
+_tiny_model_cache: dict = {}
+
+
 def _train_tiny_model(n=200, seed=0):
+    # one shared fitted model per module: the streaming tests exercise
+    # batch plumbing, not training — a 2-point LR grid is plenty and the
+    # full default zoo cost ~1 min of one-core CI per call
+    if (n, seed) in _tiny_model_cache:
+        return _tiny_model_cache[(n, seed)]
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+
     rng = np.random.default_rng(seed)
     x = rng.normal(size=n)
     y = (x > 0).astype(np.float64)
@@ -39,10 +49,13 @@ def _train_tiny_model(n=200, seed=0):
     feats = FeatureBuilder.from_frame(host, response="label")
     vec = transmogrify([feats["x"]])
     sel = BinaryClassificationModelSelector.with_train_validation_split(
-        seed=3)
+        seed=3, models_and_parameters=[
+            (OpLogisticRegression(max_iter=30),
+             [{"reg_param": r} for r in (0.01, 0.1)])])
     pred = feats["label"].transform_with(sel, vec)
     model = (Workflow().set_input_frame(host)
              .set_result_features(pred).train())
+    _tiny_model_cache[(n, seed)] = (model, pred)
     return model, pred
 
 

@@ -35,8 +35,7 @@ def titanic_model():
             (OpLogisticRegression(),
              [{"reg_param": 0.01, "elastic_net_param": e}
               for e in (0.0, 0.5)]),
-            (OpGBTClassifier(), [{"num_rounds": 50, "max_depth": 3},
-                                 {"num_rounds": 50, "max_depth": 6}]),
+            (OpGBTClassifier(), [{"num_rounds": 50, "max_depth": 3}]),
             (OpRandomForestClassifier(),
              [{"num_trees": 50, "max_depth": 6}]),
         ],

@@ -1,7 +1,9 @@
-"""Developer CLI (reference ``cli/`` module): project generation.
+"""Developer CLI (reference ``cli/`` module): project generation + shell.
 
 ``python -m transmogrifai_tpu.cli gen --input data.csv --id id
 --response label ProjectName`` emits a runnable AutoML project.
+``python -m transmogrifai_tpu.cli shell`` opens the preloaded REPL
+(reference ``repl/`` module analog).
 """
 
 from transmogrifai_tpu.cli.gen import (
@@ -27,8 +29,13 @@ def main(argv=None) -> int:
                      help="optional Avro .avsc schema path")
     gen.add_argument("--output", default=".", help="output directory")
     gen.add_argument("--overwrite", action="store_true")
+    sub.add_parser("shell", help="interactive shell with the framework "
+                                 "preloaded (reference repl analog)")
     args = ap.parse_args(argv)
 
+    if args.command == "shell":
+        from transmogrifai_tpu.cli.shell import run_shell
+        return run_shell()
     if args.command == "gen":
         path = generate_project(
             name=args.name, input_path=args.input, id_col=args.id_col,

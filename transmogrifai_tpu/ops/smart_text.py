@@ -34,14 +34,19 @@ __all__ = ["TextStats", "SmartTextVectorizer", "SmartTextModel",
            "COMMON_FIRST_NAMES", "looks_like_name"]
 
 
-def _all_strings(vals: np.ndarray) -> bool:
-    """True when every non-null element is str — the precondition for the
-    vectorized (dict-encode-backed) fit/apply paths: the encoder
-    stringifies other objects, which would skew category matching between
-    batch sizes and against transform_row."""
-    check = np.frompyfunc(
-        lambda v: v is None or isinstance(v, str), 1, 1)
-    return bool(check(vals).all())
+def _scan_column(vals: np.ndarray) -> tuple[np.ndarray, bool]:
+    """ONE Python-level pass -> (null_mask, all_strings).
+
+    ``all_strings`` is the precondition for the vectorized
+    (dict-encode-backed) fit/apply paths: the encoder stringifies other
+    objects, which would skew category matching between batch sizes and
+    against transform_row. Folding the null mask into the same pass keeps
+    the per-column object traffic to a single sweep on the Criteo-scale
+    hot path (26 columns x 10M+ rows)."""
+    kind = np.frompyfunc(
+        lambda v: 0 if v is None else (1 if type(v) is str else 2),
+        1, 1)(vals).astype(np.int8)
+    return kind == 0, not (kind == 2).any()
 
 
 @dataclass
@@ -124,12 +129,13 @@ class SmartTextVectorizer(Estimator):
                 # overflow iff total uniques exceed the cap, counts over
                 # all values otherwise.
                 vals = np.asarray(col.values, dtype=object)
-                nulls = int(np.equal(vals, None).sum())
+                null_mask, all_str = _scan_column(vals)
+                nulls = int(null_mask.sum())
                 non_null = len(vals) - nulls
                 stats = TextStats(max_cardinality=self.max_cardinality)
                 stats.n = len(vals)
                 stats.nulls = nulls
-                if non_null and not _all_strings(vals):
+                if non_null and not all_str:
                     # non-string objects leaked into the column: the
                     # vectorized encoder would stringify them and the
                     # fitted categories would no longer match raw values
@@ -276,12 +282,12 @@ class SmartTextModel(HostTransformer):
         if kind == "sensitive":
             return
         vals = np.asarray(values, dtype=object)
-        null_mask = np.equal(vals, None)
+        null_mask, all_str = _scan_column(vals)
         if kind == "ignore":
             if self.track_nulls:
                 out[:, offset] = null_mask.astype(np.float32)
             return
-        if not _all_strings(vals):
+        if not all_str:
             # non-string objects: the encoder's vocab is stringified and
             # would mis-route category matching — exact per-row semantics
             for r in range(n):
