@@ -277,3 +277,48 @@ def test_smart_text_map_sensitive_keys():
     # the record survives save/load
     state = fitted_map.fitted_state()
     assert state["sensitive"]["m.who"]["detected"] is True
+
+
+def test_keyed_map_columnar_matches_row_path():
+    """fill_key_column (vectorized numeric/pivot map fills, r4) must match
+    the per-row fill_key semantics exactly — including non-string pivot
+    values (fallback), missing keys, empty maps, and null tracking."""
+    import numpy as np
+    from transmogrifai_tpu.ops.vectorizers.maps import (
+        _NumericMapModel, _PivotMapModel,
+    )
+
+    rng = np.random.default_rng(9)
+    n = 300
+    num_maps = [None if rng.uniform() < 0.1 else
+                {k: float(rng.normal()) for k in ("a", "b")
+                 if rng.uniform() < 0.7}
+                for _ in range(n)]
+    txt_maps = [None if rng.uniform() < 0.1 else
+                {k: str(rng.choice(["x", "y", "z", "rare"]))
+                 for k in ("a", "b") if rng.uniform() < 0.7}
+                for _ in range(n)]
+
+    def both(model, maps):
+        vk = {k: [m.get(k) if m else None for m in maps]
+              for k in ("a", "b")}
+        width = sum(model.key_width(0, k) for k in ("a", "b"))
+        fast = np.zeros((n, width), np.float32)
+        slow = np.zeros((n, width), np.float32)
+        off = 0
+        for k in ("a", "b"):
+            model.fill_key_column(fast, off, 0, k, vk[k])
+            for r in range(n):
+                model.fill_key(slow[r], off, 0, k, vk[k][r])
+            off += model.key_width(0, k)
+        np.testing.assert_array_equal(fast, slow)
+
+    both(_NumericMapModel(keys=[["a", "b"]], track_nulls=True,
+                          fills=[{"a": 1.5, "b": -2.0}]), num_maps)
+    both(_PivotMapModel(keys=[["a", "b"]], track_nulls=True,
+                        categories=[{"a": ["x", "y"], "b": ["z"]}]),
+         txt_maps)
+    # non-string pivot values must take the exact fallback, not crash
+    mixed = [{"a": 1.0, "b": "x"}, {"a": "x"}, None] * 100
+    both(_PivotMapModel(keys=[["a", "b"]], track_nulls=True,
+                        categories=[{"a": ["x"], "b": ["x"]}]), mixed)

@@ -123,16 +123,27 @@ class _KeyedModelBase(HostTransformer):
                 off += self.key_width(i, k)
         return out
 
+    def fill_key_column(self, out: np.ndarray, off: int, i: int, key: str,
+                        values: list) -> None:
+        """Columnar fill for one (feature, key) block over ALL rows.
+
+        Default: the per-row ``fill_key`` loop. Hot subclasses (numeric,
+        pivot) override with vectorized fills — wide keyed maps are the
+        reference's OPMapVectorizer scale problem, and per-(row, key)
+        Python method dispatch dominates otherwise."""
+        for r, v in enumerate(values):
+            self.fill_key(out[r], off, i, key, v)
+
     def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
         n = len(cols[0])
         out = np.zeros((n, self._total_width()), dtype=np.float32)
-        for r in range(n):
-            off = 0
-            for i, ks in enumerate(self.keys):
-                m = cols[i].values[r] or {}
-                for k in ks:
-                    self.fill_key(out[r], off, i, k, m.get(k))
-                    off += self.key_width(i, k)
+        off = 0
+        for i, ks in enumerate(self.keys):
+            vals = cols[i].values
+            for k in ks:
+                vk = [m.get(k) if m else None for m in vals]
+                self.fill_key_column(out, off, i, k, vk)
+                off += self.key_width(i, k)
         return fr.HostColumn(ft.OPVector, out, meta=self._meta())
 
     def _meta(self) -> VectorMetadata:
@@ -173,6 +184,17 @@ class _NumericMapModel(_KeyedModelBase):
         out[off] = fill if missing else float(value)
         if self.track_nulls:
             out[off + 1] = 1.0 if missing else 0.0
+
+    def fill_key_column(self, out, off, i, key, values):
+        fill = float(self.fills[i].get(key, 0.0))
+        n = len(values)
+        out[:, off] = np.fromiter(
+            (fill if v is None else float(v) for v in values),
+            np.float32, count=n)
+        if self.track_nulls:
+            out[:, off + 1] = np.fromiter(
+                (1.0 if v is None else 0.0 for v in values),
+                np.float32, count=n)
 
     def key_meta(self, i, key, parent):
         cols = [VectorColumnMetadata(*parent, grouping=key)]
@@ -258,6 +280,26 @@ class _PivotMapModel(_KeyedModelBase):
         else:
             out[off + k] = 1.0
 
+    def fill_key_column(self, out, off, i, key, values):
+        from transmogrifai_tpu.utils.dict_encode import (
+            dict_encode, scan_column,
+        )
+        vals = np.asarray(values, dtype=object)
+        null_mask, all_str = scan_column(vals)
+        if not all_str:  # non-string values: exact per-row matching
+            for r, v in enumerate(values):
+                self.fill_key(out[r], off, i, key, v)
+            return
+        cats = self.categories[i][key]
+        k = len(cats)
+        cat_idx = {c: j for j, c in enumerate(cats)}
+        codes, vocab = dict_encode(vals)
+        slots = np.array([cat_idx.get(v, k) for v in vocab], dtype=np.int64)
+        rows = np.nonzero(~null_mask)[0]
+        out[rows, off + slots[codes[rows]]] = 1.0
+        if self.track_nulls:
+            out[null_mask, off + k + 1] = 1.0
+
     def key_meta(self, i, key, parent):
         cols = [VectorColumnMetadata(*parent, grouping=key, indicator_value=c)
                 for c in self.categories[i][key]]
@@ -300,6 +342,13 @@ class TextMapPivotVectorizer(_MapVectorizerBase):
 
 class _MultiPickMapModel(_PivotMapModel):
     in_types = (ft.MultiPickListMap,)
+
+    def fill_key_column(self, out, off, i, key, values):
+        # values are SETS/LISTS of picks, not scalars: the inherited pivot
+        # fast path would treat a string value as one category (and ''
+        # as a category instead of empty) — keep the exact per-row fill
+        for r, v in enumerate(values):
+            self.fill_key(out[r], off, i, key, v)
 
     def fill_key(self, out, off, i, key, value):
         cats = self.categories[i][key]

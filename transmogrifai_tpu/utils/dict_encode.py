@@ -22,7 +22,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["dict_encode", "dict_encode_py"]
+__all__ = ["dict_encode", "dict_encode_py", "scan_column"]
 
 _native_lib = None
 _native_tried = False
@@ -162,3 +162,19 @@ def dict_encode(values) -> tuple[np.ndarray, list[str]]:
     codes = np.full(n, -1, dtype=np.int32)
     codes[present] = inv.astype(np.int32)
     return codes, [str(v) for v in vocab]
+
+
+def scan_column(vals: np.ndarray) -> tuple[np.ndarray, bool]:
+    """ONE Python-level pass over an object column -> (null_mask,
+    all_strings).
+
+    ``all_strings`` gates the vectorized dict-encode-backed paths
+    (SmartText fit/apply, keyed-map pivot fills): the encoder stringifies
+    non-string objects, which would skew category matching between batch
+    sizes and against the per-row paths. Folding the null mask into the
+    same pass keeps per-column object traffic to a single sweep on the
+    Criteo-scale hot path."""
+    kind = np.frompyfunc(
+        lambda v: 0 if v is None else (1 if isinstance(v, str) else 2),
+        1, 1)(vals).astype(np.int8)
+    return kind == 0, not (kind == 2).any()
