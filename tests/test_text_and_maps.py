@@ -322,3 +322,33 @@ def test_keyed_map_columnar_matches_row_path():
     mixed = [{"a": 1.0, "b": "x"}, {"a": "x"}, None] * 100
     both(_PivotMapModel(keys=[["a", "b"]], track_nulls=True,
                         categories=[{"a": ["x"], "b": ["x"]}]), mixed)
+
+
+def test_smart_text_map_columnar_matches_row_path():
+    """_SmartTextMapModel.fill_key_column (r4) parity with fill_key for
+    both per-key treatments (pivot + hash), nulls included."""
+    import numpy as np
+    from transmogrifai_tpu.ops.vectorizers.maps import _SmartTextMapModel
+
+    rng = np.random.default_rng(4)
+    n = 250
+    maps = [None if rng.uniform() < 0.1 else
+            {"lo": str(rng.choice(["a", "b", "weird"])),
+             "hi": f"tok{int(rng.integers(200))} word{int(rng.integers(5))}"}
+            for _ in range(n)]
+    m = _SmartTextMapModel(
+        keys=[["hi", "lo"]], track_nulls=True,
+        treatments=[{"lo": {"kind": "pivot", "categories": ["a", "b"]},
+                     "hi": {"kind": "hash"}}],
+        num_hash_features=16)
+    width = m.key_width(0, "hi") + m.key_width(0, "lo")
+    fast = np.zeros((n, width), np.float32)
+    slow = np.zeros((n, width), np.float32)
+    off = 0
+    for k in ("hi", "lo"):
+        vk = [mm.get(k) if mm else None for mm in maps]
+        m.fill_key_column(fast, off, 0, k, vk)
+        for r in range(n):
+            m.fill_key(slow[r], off, 0, k, vk[r])
+        off += m.key_width(0, k)
+    np.testing.assert_array_equal(fast, slow)

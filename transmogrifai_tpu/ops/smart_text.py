@@ -37,6 +37,39 @@ __all__ = ["TextStats", "SmartTextVectorizer", "SmartTextModel",
 from transmogrifai_tpu.utils.dict_encode import \
     scan_column as _scan_column  # shared object-column scanner
 
+#: hash treatments fall back to the per-row loop when the per-unique
+#: table (uniques x num_hash_features) would exceed this many floats
+#: (true free text — no repetition to exploit)
+_UNIQUE_TABLE_CAP = 64_000_000
+
+
+def pivot_slot_fill(out: np.ndarray, off: int, cats, codes: np.ndarray,
+                    vocab, null_mask: np.ndarray,
+                    track_nulls: bool) -> None:
+    """Columnar categorical pivot: per-UNIQUE slot assignment gathered by
+    dict-encode code (categories -> own slot, unknown -> OTHER at k,
+    null -> k+1 when tracked). Shared by the scalar SmartText path and the
+    keyed-map pivot fills so the encode-gate semantics can't drift."""
+    k = len(cats)
+    cat_idx = {c: j for j, c in enumerate(cats)}
+    slots = np.array([cat_idx.get(v, k) for v in vocab], dtype=np.int64)
+    rows = np.nonzero(~null_mask)[0]
+    out[rows, off + slots[codes[rows]]] = 1.0
+    if track_nulls:
+        out[null_mask, off + k + 1] = 1.0
+
+
+def hashed_unique_table(vocab, num_hash_features: int):
+    """[uniques, H] token-count table for a vocab, or None when the table
+    would blow the memory cap (caller falls back to the per-row loop)."""
+    if len(vocab) * num_hash_features > _UNIQUE_TABLE_CAP:
+        return None
+    uvecs = np.zeros((len(vocab), num_hash_features), np.float32)
+    for u, v in enumerate(vocab):
+        for tok in tokenize(v):
+            uvecs[u, hash_token(tok, num_hash_features)] += 1.0
+    return uvecs
+
 
 @dataclass
 class TextStats:
@@ -245,11 +278,6 @@ class SmartTextModel(HostTransformer):
             offset += self._width(t)
         return out
 
-    #: hash treatment falls back to the per-row loop when the per-unique
-    #: table (uniques x num_hash_features) would exceed this many floats
-    #: (true free text — no repetition to exploit)
-    _UNIQUE_TABLE_CAP = 64_000_000
-
     def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
         n = len(cols[0])
         total = sum(self._width(t) for t in self.treatments)
@@ -286,26 +314,16 @@ class SmartTextModel(HostTransformer):
         codes, vocab = dict_encode(vals)
         present = ~null_mask
         if kind == "pivot":
-            cats = t["categories"]
-            k = len(cats)
-            cat_idx = {c: i for i, c in enumerate(cats)}
-            slots = np.array([cat_idx.get(v, k) for v in vocab],
-                             dtype=np.int64)
-            rows = np.nonzero(present)[0]
-            out[rows, offset + slots[codes[rows]]] = 1.0
-            if self.track_nulls:
-                out[null_mask, offset + k + 1] = 1.0
+            pivot_slot_fill(out, offset, t["categories"], codes, vocab,
+                            null_mask, self.track_nulls)
             return
         # hash
         H = self.num_hash_features
-        if len(vocab) * H > self._UNIQUE_TABLE_CAP:
+        uvecs = hashed_unique_table(vocab, H)
+        if uvecs is None:  # table over the memory cap: exact per-row
             for r in range(n):
                 self._fill_row(out[r], offset, t, values[r])
             return
-        uvecs = np.zeros((len(vocab), H), np.float32)
-        for u, v in enumerate(vocab):
-            for tok in tokenize(v):
-                uvecs[u, hash_token(tok, H)] += 1.0
         out[present, offset:offset + H] = uvecs[codes[present]]
         pos = offset + H
         if self.track_text_len:

@@ -281,6 +281,7 @@ class _PivotMapModel(_KeyedModelBase):
             out[off + k] = 1.0
 
     def fill_key_column(self, out, off, i, key, values):
+        from transmogrifai_tpu.ops.smart_text import pivot_slot_fill
         from transmogrifai_tpu.utils.dict_encode import (
             dict_encode, scan_column,
         )
@@ -290,15 +291,9 @@ class _PivotMapModel(_KeyedModelBase):
             for r, v in enumerate(values):
                 self.fill_key(out[r], off, i, key, v)
             return
-        cats = self.categories[i][key]
-        k = len(cats)
-        cat_idx = {c: j for j, c in enumerate(cats)}
         codes, vocab = dict_encode(vals)
-        slots = np.array([cat_idx.get(v, k) for v in vocab], dtype=np.int64)
-        rows = np.nonzero(~null_mask)[0]
-        out[rows, off + slots[codes[rows]]] = 1.0
-        if self.track_nulls:
-            out[null_mask, off + k + 1] = 1.0
+        pivot_slot_fill(out, off, self.categories[i][key], codes, vocab,
+                        null_mask, self.track_nulls)
 
     def key_meta(self, i, key, parent):
         cols = [VectorColumnMetadata(*parent, grouping=key, indicator_value=c)
@@ -528,6 +523,41 @@ class _SmartTextMapModel(_KeyedModelBase):
                 out[off + hash_token(tok, self.num_hash_features)] += 1.0
         if self.track_nulls:
             out[off + self.num_hash_features] = 1.0 if value is None else 0.0
+
+    def fill_key_column(self, out, off, i, key, values):
+        """Columnar per-key fill via the SHARED SmartText helpers (pivot
+        slot gather / per-unique hashed table — one implementation for the
+        scalar and map paths); non-string values and over-cap hash vocabs
+        fall back to the exact per-row fill."""
+        from transmogrifai_tpu.ops.smart_text import (
+            hashed_unique_table, pivot_slot_fill,
+        )
+        from transmogrifai_tpu.utils.dict_encode import (
+            dict_encode, scan_column,
+        )
+        vals = np.asarray(values, dtype=object)
+        null_mask, all_str = scan_column(vals)
+        t = self.treatments[i][key]
+        uvecs = None
+        if all_str:
+            codes, vocab = dict_encode(vals)
+            if t["kind"] != "pivot":
+                uvecs = hashed_unique_table(vocab, self.num_hash_features)
+        if not all_str or (t["kind"] != "pivot" and uvecs is None):
+            # non-strings (stringified encoding would skew matching) or an
+            # over-cap hash vocab (table would not fit): exact per-row
+            for r, v in enumerate(values):
+                self.fill_key(out[r], off, i, key, v)
+            return
+        if t["kind"] == "pivot":
+            pivot_slot_fill(out, off, t["categories"], codes, vocab,
+                            null_mask, self.track_nulls)
+            return
+        rows = np.nonzero(~null_mask)[0]
+        out[rows, off:off + self.num_hash_features] = uvecs[codes[rows]]
+        if self.track_nulls:
+            out[:, off + self.num_hash_features] = \
+                null_mask.astype(np.float32)
 
     def key_meta(self, i, key, parent):
         t = self.treatments[i][key]
