@@ -204,3 +204,91 @@ def test_depth_guard_and_unknown_estimator():
             import_sklearn(deep)
     with pytest.raises(NotImplementedError):
         import_sklearn(SVC().fit(X[:50], y_cls[:50]))
+
+
+# -- multiclass --------------------------------------------------------------
+
+y_mc = (X[:, 0] + 0.5 * X[:, 1] > 0.4).astype(int) \
+    + (X[:, 2] > 0.2).astype(int)  # 3 classes
+
+
+def test_sklearn_multinomial_logistic_parity():
+    from sklearn.linear_model import LogisticRegression
+    est = LogisticRegression(max_iter=300).fit(X, y_mc)
+    got = np.asarray(_score(import_sklearn(est), X).probability)
+    np.testing.assert_allclose(got, est.predict_proba(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sklearn_multiclass_gbt_parity():
+    from sklearn.ensemble import GradientBoostingClassifier
+    est = GradientBoostingClassifier(
+        n_estimators=12, max_depth=3, learning_rate=0.25, random_state=0
+    ).fit(X, y_mc)
+    model = import_sklearn(est)
+    assert model.n_out == 3
+    got = _score(model, X)
+    np.testing.assert_allclose(np.asarray(got.probability),
+                               est.predict_proba(X), rtol=1e-4, atol=1e-5)
+    # raw margins match decision_function exactly (centered log-prior init)
+    np.testing.assert_allclose(np.asarray(got.raw_prediction),
+                               est.decision_function(X),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sklearn_multiclass_rf_parity():
+    from sklearn.ensemble import RandomForestClassifier
+    est = RandomForestClassifier(
+        n_estimators=12, max_depth=5, random_state=1).fit(X, y_mc)
+    model = import_sklearn(est)
+    assert model.n_out == 3 and model.kind == "rf_classifier"
+    got = np.asarray(_score(model, X).probability)
+    np.testing.assert_allclose(got, est.predict_proba(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_xgboost_multiclass_softprob_parity():
+    """A hand-built multi:softprob booster (2 rounds x 3 classes, grouped
+    tree_info) vs an independent traversal + softmax."""
+    with open(FIXTURE) as fh:
+        base_doc = json.load(fh)
+
+    def stump(feat, thr, left_w, right_w):
+        return {"left_children": [1, -1, -1], "right_children": [2, -1, -1],
+                "split_indices": [feat, 0, 0],
+                "split_conditions": [thr, left_w, right_w],
+                "default_left": [1, 0, 0], "split_type": [0, 0, 0],
+                "categories": [], "categories_nodes": [],
+                "categories_segments": [], "categories_sizes": [],
+                "base_weights": [0.0, 0.0, 0.0],
+                "parents": [2147483647, 0, 0],
+                "loss_changes": [1.0, 0.0, 0.0],
+                "sum_hessian": [10.0, 5.0, 5.0], "id": 0,
+                "tree_param": {"num_deleted": "0", "num_feature": "3",
+                               "num_nodes": "3", "size_leaf_vector": "1"}}
+
+    trees = [stump(0, 0.1, 0.4, -0.2), stump(1, -0.3, -0.1, 0.3),
+             stump(2, 0.0, 0.2, -0.4),
+             stump(1, 0.5, 0.15, -0.15), stump(2, -0.2, -0.3, 0.1),
+             stump(0, -0.4, 0.05, 0.25)]
+    doc = base_doc
+    doc["learner"]["gradient_booster"]["model"]["trees"] = trees
+    doc["learner"]["gradient_booster"]["model"]["tree_info"] = \
+        [0, 1, 2, 0, 1, 2]
+    doc["learner"]["gradient_booster"]["model"]["gbtree_model_param"][
+        "num_trees"] = "6"
+    doc["learner"]["learner_model_param"]["num_class"] = "3"
+    doc["learner"]["objective"] = {"name": "multi:softprob"}
+    model = import_xgboost_json(doc)
+    assert model.n_out == 3
+
+    margins = np.full((len(X), 3), 0.3, np.float64)  # base_score 3E-1
+    for t, cls in zip(trees, [0, 1, 2, 0, 1, 2]):
+        f, thr = t["split_indices"][0], np.float32(t["split_conditions"][0])
+        lw, rw = t["split_conditions"][1], t["split_conditions"][2]
+        margins[:, cls] += np.where(
+            X[:, f].astype(np.float32) < thr, lw, rw)
+    exp = np.exp(margins - margins.max(axis=1, keepdims=True))
+    expected = exp / exp.sum(axis=1, keepdims=True)
+    got = np.asarray(_score(model, X).probability)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)

@@ -443,12 +443,19 @@ class TreeEnsembleModel(PredictionModel):
         self.trees = (tuple(feats), tuple(bins), leaves)
 
     def config(self):
+        base = self.base_score
+        if np.ndim(base):  # per-class vector (imported multiclass GBMs)
+            base = [float(b) for b in np.asarray(base)]
         return {"kind": self.kind, "n_out": self.n_out,
                 "learning_rate": self.learning_rate,
-                "base_score": self.base_score, "max_depth": self.max_depth}
+                "base_score": base, "max_depth": self.max_depth}
 
     @classmethod
     def from_config(cls, config, uid=None):
+        config = dict(config)
+        if isinstance(config.get("base_score"), (list, tuple)):
+            config["base_score"] = np.asarray(config["base_score"],
+                                              np.float32)
         return cls(uid=uid, **config)
 
     def feature_contributions(self) -> np.ndarray:
