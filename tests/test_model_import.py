@@ -292,3 +292,66 @@ def test_xgboost_multiclass_softprob_parity():
     expected = exp / exp.sum(axis=1, keepdims=True)
     got = np.asarray(_score(model, X).probability)
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_imported_model_serves_inside_workflow():
+    """The MLeap-analog end game: an imported foreign model wired as the
+    prediction stage of a normal workflow — vectorization from raw
+    features, batch scoring, row scoring closure, save/load."""
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow, load_model
+
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 200
+    frame = fr.HostFrame.from_dict({
+        "a": (ft.Real, [float(v) for v in X[:n, 0]]),
+        "b": (ft.Real, [float(v) for v in X[:n, 1]]),
+        "label": (ft.RealNN, [float(v) for v in y_cls[:n]]),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()))
+
+    # vectorize once to get the exact matrix the stage will see, train
+    # the foreign model on it, then import
+    probe = (Workflow().set_input_frame(frame)
+             .set_result_features(vec).train())
+    Xv = np.asarray(probe.score(frame, keep_raw_features=False)
+                    .columns[vec.name].values, np.float32)
+    est = GradientBoostingClassifier(
+        n_estimators=10, max_depth=2, random_state=0).fit(Xv, y_cls[:n])
+    imported = import_sklearn(est)
+
+    pred = label.transform_with(imported, vec)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred).train())
+    scored = model.score(frame)
+    p1 = np.asarray([d["probability_1"]
+                     for d in scored.columns[pred.name].values])
+    np.testing.assert_allclose(p1, est.predict_proba(Xv)[:, 1],
+                               rtol=1e-4, atol=1e-5)
+    # row path + persistence
+    fn = model.score_function()
+    row_out = fn({"a": float(X[0, 0]), "b": float(X[0, 1])})
+    row_pred = next(v for v in row_out.values() if "probability_1" in v)
+    assert abs(row_pred["probability_1"] - p1[0]) < 1e-4
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        model.save(d)
+        again = load_model(d).score(frame)
+        p2 = np.asarray([v["probability_1"]
+                         for v in again.columns[pred.name].values])
+        np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_multi_output_forest_rejected():
+    from sklearn.ensemble import RandomForestClassifier
+    Y2 = np.stack([y_mc, y_cls.astype(int)], axis=1)  # 2D target
+    est = RandomForestClassifier(n_estimators=3, max_depth=3,
+                                 random_state=0).fit(X, Y2)
+    with pytest.raises(NotImplementedError):
+        import_sklearn(est)

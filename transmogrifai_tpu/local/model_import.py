@@ -383,6 +383,11 @@ def import_sklearn(est):
     if name in ("RandomForestClassifier", "DecisionTreeClassifier"):
         trees = [e.tree_ for e in est.estimators_] \
             if name == "RandomForestClassifier" else [est.tree_]
+        if trees[0].value.shape[1] != 1:
+            # multi-output (2D y) forests carry one class block PER output;
+            # pk() reads output 0 only and would silently drop the rest
+            raise NotImplementedError(
+                "multi-output (2D-target) forest import not supported")
         n_cls = trees[0].value.shape[2]
 
         def pk(i, tr, k):  # leaf class-k probability (normalized counts)

@@ -21,7 +21,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from transmogrifai_tpu import frame as fr
-from transmogrifai_tpu.stages.base import DeviceTransformer, Estimator
+from transmogrifai_tpu.stages.base import (
+    AllowLabelAsInput, DeviceTransformer, Estimator,
+)
 from transmogrifai_tpu.types import feature_types as ft
 
 __all__ = ["Predictor", "PredictionModel"]
@@ -82,8 +84,13 @@ class Predictor(Estimator):
         return self.fit_arrays(X, y, w, self.params)
 
 
-class PredictionModel(DeviceTransformer):
-    """Fitted model: consumes only the features vector at transform time."""
+class PredictionModel(AllowLabelAsInput, DeviceTransformer):
+    """Fitted model: consumes only the features vector at transform time.
+
+    ``AllowLabelAsInput``: the optional leading label input exists for
+    lineage/naming parity only — ``runtime_input_names`` excludes it, so
+    wiring a fitted/imported model directly under a workflow (the MLeap
+    serving analog) is not label leakage."""
 
     in_types = (ft.RealNN, ft.OPVector)
     out_type = ft.Prediction
