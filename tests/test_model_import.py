@@ -355,3 +355,26 @@ def test_multi_output_forest_rejected():
                                  random_state=0).fit(X, Y2)
     with pytest.raises(NotImplementedError):
         import_sklearn(est)
+
+
+def test_label_slot_exemption_is_narrow():
+    """AllowLabelAsInput on PredictionModel covers only slot 0: a
+    response-DERIVED vector in the features slot is still leakage."""
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.vectorizers import RealVectorizer
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    leaky_vec = label.transform_with(RealVectorizer())
+    assert leaky_vec.is_response
+    model = import_xgboost_json(FIXTURE)
+    with pytest.raises(ValueError, match="leakage"):
+        label.transform_with(model, leaky_vec)
+
+
+def test_multi_output_regressor_forest_rejected():
+    from sklearn.ensemble import RandomForestRegressor
+    Y2 = np.stack([y_reg, -y_reg], axis=1)
+    est = RandomForestRegressor(n_estimators=3, max_depth=3,
+                                random_state=0).fit(X, Y2)
+    with pytest.raises(NotImplementedError):
+        import_sklearn(est)

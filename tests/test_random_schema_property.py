@@ -1,0 +1,119 @@
+"""Property-style end-to-end fuzz over random schemas (reference pattern:
+RandomDataGenerator-driven workflow tests): random mixes of feature
+families must transmogrify -> sanity-check -> train -> batch-score ->
+row-score without crashing, with finite outputs and batch==row parity.
+
+This is the integration net under the per-stage contract suite: type
+COMBINATIONS (e.g. a sparse TextMap next to a constant Real next to a
+high-cardinality PickList) exercise cross-stage seams no single-stage
+test reaches."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.preparators import SanityChecker
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, DataSplitter,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+N = 160
+
+_FAMILIES = [
+    ("real", ft.Real, lambda rng: [
+        None if rng.uniform() < 0.2 else float(rng.normal())
+        for _ in range(N)]),
+    ("real_const", ft.Real, lambda rng: [1.0] * N),
+    ("integral", ft.Integral, lambda rng: [
+        None if rng.uniform() < 0.15 else int(rng.integers(0, 9))
+        for _ in range(N)]),
+    ("binary", ft.Binary, lambda rng: [
+        None if rng.uniform() < 0.1 else bool(rng.integers(0, 2))
+        for _ in range(N)]),
+    ("picklist", ft.PickList, lambda rng: [
+        None if rng.uniform() < 0.2
+        else str(rng.choice(["a", "b", "c", "d"])) for _ in range(N)]),
+    ("highcard", ft.PickList, lambda rng: [
+        f"v{int(rng.integers(0, N))}" for _ in range(N)]),
+    ("text", ft.Text, lambda rng: [
+        None if rng.uniform() < 0.2
+        else f"w{int(rng.integers(0, 200))} x{int(rng.integers(0, 7))}"
+        for _ in range(N)]),
+    ("date", ft.Date, lambda rng: [
+        None if rng.uniform() < 0.1
+        else int(1_500_000_000_000 + rng.integers(0, 10 ** 10))
+        for _ in range(N)]),
+    ("textmap", ft.TextMap, lambda rng: [
+        None if rng.uniform() < 0.2 else
+        {k: str(rng.choice(["x", "y", "z"]))
+         for k in ("p", "q") if rng.uniform() < 0.7} for _ in range(N)]),
+    ("realmap", ft.RealMap, lambda rng: [
+        {k: float(rng.normal()) for k in ("m1", "m2")
+         if rng.uniform() < 0.8} for _ in range(N)]),
+    ("multipick", ft.MultiPickList, lambda rng: [
+        sorted(set(str(w) for w in
+                   rng.choice(["r", "g", "b"], rng.integers(0, 3))))
+        for _ in range(N)]),
+    ("geo", ft.Geolocation, lambda rng: [
+        None if rng.uniform() < 0.15 else
+        [float(rng.uniform(-60, 60)), float(rng.uniform(-170, 170)), 5.0]
+        for _ in range(N)]),
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_random_schema_end_to_end(seed):
+    rng = np.random.default_rng(100 + seed)
+    picks = rng.choice(len(_FAMILIES), size=5, replace=False)
+    cols = {}
+    for idx in picks:
+        name, t, gen = _FAMILIES[idx]
+        cols[name] = (t, gen(rng))
+    # label correlated with SOMETHING only sometimes — constant-feature,
+    # no-signal schemas must still survive the pipeline
+    y = rng.integers(0, 2, N).astype(float)
+    cols["label"] = (ft.RealNN, y.tolist())
+    frame = fr.HostFrame.from_dict(cols)
+
+    feats = FeatureBuilder.from_frame(frame, response="label")
+    label = feats.pop("label")
+    vec = transmogrify(list(feats.values()), min_support=1, top_k=5)
+    checked = label.transform_with(SanityChecker(min_variance=-1.0), vec)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, seed=7,
+        models_and_parameters=[(OpLogisticRegression(max_iter=15),
+                                [{"reg_param": 0.1}])],
+        splitter=DataSplitter(reserve_test_fraction=0.2, seed=7))
+    pred = label.transform_with(sel, checked)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred).train())
+
+    scored = model.score(frame)
+    probs = np.asarray([d["probability_1"]
+                        for d in scored.columns[pred.name].values])
+    assert probs.shape[0] == N and np.all(np.isfinite(probs))
+
+    # row closure parity on a handful of rows (batch == row contract at
+    # the WORKFLOW level, across every fitted stage in this random schema)
+    fn = model.score_function()
+    raw_names = {f.name for f in model.raw_features
+                 if not f.is_response}
+    for i in (0, 7, N - 1):
+        row = {n: v for n, v in frame.row(i).items() if n in raw_names}
+        out = fn(row)
+        row_p = next(v["probability_1"] for v in out.values()
+                     if isinstance(v, dict) and "probability_1" in v)
+        # 5e-3: float32-vs-float64 trig on epoch-ms timestamps puts a few
+        # e-4 of noise between the paths; real routing bugs measure e-1
+        assert abs(row_p - probs[i]) < 5e-3, (i, row_p, probs[i])
+
+    # evaluation runs and yields a finite metric
+    m = model.evaluate(frame, OpBinaryClassificationEvaluator())
+    assert np.isfinite(m.au_roc)

@@ -410,6 +410,11 @@ def import_sklearn(est):
     if name in ("RandomForestRegressor", "DecisionTreeRegressor"):
         trees = [e.tree_ for e in est.estimators_] \
             if name == "RandomForestRegressor" else [est.tree_]
+        if trees[0].value.shape[1] != 1:
+            # same silent-drop hazard as the classifier branch: 2D-target
+            # forests store one value block per output
+            raise NotImplementedError(
+                "multi-output (2D-target) forest import not supported")
         specs = [_sk_tree_spec(tr, lambda i, tr=tr: tr.value[i, 0, 0])
                  for tr in trees]
         return _ensemble_from_specs(

@@ -99,6 +99,18 @@ class PredictionModel(AllowLabelAsInput, DeviceTransformer):
         return (self.input_names[1],) if len(self.input_names) == 2 \
             else self.input_names
 
+    def validate_inputs(self, features) -> None:
+        super().validate_inputs(features)
+        # the AllowLabelAsInput exemption covers ONLY the designated label
+        # slot (0): a response-DERIVED features vector is still leakage
+        feat_slots = features[1:] if len(features) >= 2 else features
+        bad = [f.name for f in feat_slots if f.is_response]
+        if bad:
+            raise ValueError(
+                f"{self}: response-derived feature(s) {bad} cannot feed "
+                "the model's FEATURES slot (label leakage); only the "
+                "leading label input may be a response")
+
     # device_apply(params, features_col) -> PredictionColumn
     def predict_arrays(self, X) -> fr.PredictionColumn:
         """One JITTED apply. In the fused layer program this path is
