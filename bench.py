@@ -400,6 +400,17 @@ def main():
     cpu = _run_child(
         CPU_ROWS, {"JAX_PLATFORMS": "cpu", "_BENCH_PLATFORM": "cpu"},
         "cpu baseline")
+    if cpu is not None and cpu.get("resumed"):
+        # a resumed baseline's wall is partial (useless as a proxy), but
+        # completing it deleted the checkpoint — one fresh run now yields
+        # a complete, honest measurement within the same attempt
+        print("# cpu baseline resumed (partial wall); re-measuring fresh",
+              file=sys.stderr)
+        fresh = _run_child(
+            CPU_ROWS, {"JAX_PLATFORMS": "cpu", "_BENCH_PLATFORM": "cpu"},
+            "cpu baseline (fresh)")
+        if fresh is not None and not fresh.get("resumed"):
+            cpu = fresh
 
     extrapolated = False
     if accel is None and cpu is not None and not cpu.get("resumed"):
