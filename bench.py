@@ -90,6 +90,14 @@ def _candidates():
         return [(OpLogisticRegression(),
                  [{"reg_param": r, "elastic_net_param": e}
                   for r in (0.0, 0.01, 0.1, 0.2) for e in (0.0, 0.5)])]
+    if MODELS == "gbt":  # tree-family isolation (device-fault bisection)
+        from transmogrifai_tpu.models.trees import OpGBTClassifier
+        return [(OpGBTClassifier(),
+                 [{"num_rounds": 50, "max_depth": d} for d in (3, 6)])]
+    if MODELS == "rf":
+        from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+        return [(OpRandomForestClassifier(),
+                 [{"num_trees": 50, "max_depth": d} for d in (6, 12)])]
     return None  # factories default: LR + SVC + RF + GBT
 
 

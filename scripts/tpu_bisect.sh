@@ -1,0 +1,28 @@
+#!/bin/bash
+# TPU device-fault bisection: the full-sweep bench crashes the TPU worker
+# ("kernel fault") at 1M and 4M rows. Isolate which pipeline family is
+# responsible by running each candidate family in a fresh child process.
+# Usage: bash scripts/tpu_bisect.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_bisect}
+mkdir -p "$LOG"
+
+run_case() {
+  local name=$1 models=$2 rows=$3
+  echo "=== $name (models=$models rows=$rows) ==="
+  _BENCH_CHILD=1 _BENCH_CHILD_ROWS=$rows BENCH_MODELS=$models \
+    timeout 2400 python bench.py > "$LOG/$name.out" 2> "$LOG/$name.err"
+  local rc=$?
+  if grep -q "BENCH_CHILD_RESULT" "$LOG/$name.out"; then
+    echo "PASS $name: $(grep BENCH_CHILD_RESULT "$LOG/$name.out" | cut -c1-200)"
+  else
+    echo "FAIL $name rc=$rc: $(tail -2 "$LOG/$name.err" | head -1 | cut -c1-160)"
+  fi
+}
+
+run_case lr_250k   lr   250000
+run_case gbt_100k  gbt  100000
+run_case rf_100k   rf   100000
+run_case lr_1m     lr   1000000
+run_case full_250k full 250000

@@ -1,0 +1,102 @@
+"""Probe: which tree-program shape breaks the axon remote compile?
+
+The full-sweep bench crashes the TPU worker; bisection shows RF depth-12
+dies in `remote_compile` ("response body closed") while GBT d3/d6 and LR
+pass. This isolates (depth, rows, max_hist_nodes) so the fix can target
+the real axis: program size (depth/chunking) vs data size (rows).
+
+Each case runs in a fresh subprocess (a dead remote compile can poison the
+backend). Usage: python scripts/tpu_rf_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+CASES = [
+    # (name, rows, depth, max_hist_nodes)
+    ("d6_100k", 100_000, 6, 1024),
+    ("d12_5k", 5_000, 12, 1024),
+    ("d12_20k", 20_000, 12, 1024),
+    ("d12_100k", 100_000, 12, 1024),
+    ("d12_100k_chunk128", 100_000, 12, 128),
+    ("d10_100k", 100_000, 10, 1024),
+]
+
+
+def _child(rows: int, depth: int, max_hist_nodes: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from transmogrifai_tpu.models.trees import (
+        bin_data, quantile_bin_edges, train_ensemble,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, 28)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    edges = quantile_bin_edges(X, 64)
+    Xb = jnp.asarray(bin_data(jnp.asarray(X), jnp.asarray(edges)))
+    t0 = time.time()
+    trees, gains = train_ensemble(
+        jnp.asarray(Xb), jnp.asarray(y), jnp.ones(rows, jnp.float32),
+        n_rounds=8, max_depth=depth, n_bins=64, n_out=1, loss="squared",
+        learning_rate=jnp.float32(1.0), reg_lambda=jnp.float32(1.0),
+        gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0),
+        subsample=1.0, colsample=1.0, base_score=jnp.float32(0.0),
+        bootstrap=True, seed=1, max_hist_nodes=max_hist_nodes)
+    jax.block_until_ready(trees)
+    compile_and_run = time.time() - t0
+    t0 = time.time()
+    trees, gains = train_ensemble(
+        jnp.asarray(Xb), jnp.asarray(y), jnp.ones(rows, jnp.float32),
+        n_rounds=8, max_depth=depth, n_bins=64, n_out=1, loss="squared",
+        learning_rate=jnp.float32(1.0), reg_lambda=jnp.float32(1.0),
+        gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0),
+        subsample=1.0, colsample=1.0, base_score=jnp.float32(0.0),
+        bootstrap=True, seed=2, max_hist_nodes=max_hist_nodes)
+    jax.block_until_ready(trees)
+    print("PROBE_OK " + json.dumps({
+        "platform": jax.devices()[0].platform,
+        "compile_plus_run_s": round(compile_and_run, 1),
+        "warm_run_s": round(time.time() - t0, 2)}))
+
+
+def main() -> int:
+    if os.environ.get("_RF_PROBE_CHILD"):
+        _child(int(os.environ["_RF_ROWS"]), int(os.environ["_RF_DEPTH"]),
+               int(os.environ["_RF_HIST"]))
+        return 0
+    results = {}
+    for name, rows, depth, hist in CASES:
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "_RF_PROBE_CHILD": "1",
+                     "_RF_ROWS": str(rows), "_RF_DEPTH": str(depth),
+                     "_RF_HIST": str(hist)},
+                capture_output=True, text=True, timeout=1500)
+            line = next((l for l in out.stdout.splitlines()
+                         if l.startswith("PROBE_OK")), None)
+            if line:
+                results[name] = json.loads(line[len("PROBE_OK "):])
+            else:
+                tail = (out.stderr or "").strip().splitlines()[-2:]
+                results[name] = {"failed": True, "rc": out.returncode,
+                                 "tail": [t[:160] for t in tail]}
+        except subprocess.TimeoutExpired:
+            results[name] = {"failed": True, "timeout_s": 1500}
+        results[name]["wall_s"] = round(time.time() - t0, 1)
+        print(f"{name}: {json.dumps(results[name])}", flush=True)
+    print(json.dumps({"metric": "rf_compile_probe", "cases": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

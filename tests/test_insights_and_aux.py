@@ -361,3 +361,70 @@ def test_runner_score_writes_score_location(tmp_path):
     from transmogrifai_tpu.readers.avro import AvroReader
     rows = list(AvroReader(score_path).read())
     assert len(rows) == n
+
+
+def test_derived_column_stage_history(fitted, tmp_path):
+    """OpVectorColumnHistory analog (OpVectorMetadata.scala:216-277): every
+    derived column reports its full raw->derived stage chain, and the chain
+    survives model save/load."""
+    from transmogrifai_tpu.serialization import load_model
+
+    def chains(model):
+        js = model.model_insights().to_json()
+        by_feature = {f["featureName"]: f for f in js["features"]}
+        return {
+            name: [(d["name"], d.get("parentFeatureOrigins"),
+                    d.get("parentFeatureStages"))
+                   for d in by_feature[name]["derivedFeatures"]]
+            for name in ("x1", "cat")}
+
+    model, frame, pred = fitted
+    got = chains(model)
+    # x1's mean-fill columns ran through RealVectorizer (+ the combiner's
+    # flatten); cat's pivot columns through OneHotVectorizer
+    assert got["x1"], "x1 has derived columns"
+    for _, origins, stages in got["x1"]:
+        assert origins == ["x1"]
+        assert "RealVectorizer" in stages
+    assert any("OneHotVectorizer" in stages
+               for _, _, stages in got["cat"])
+
+    # the chain round-trips through save/load
+    path = str(tmp_path / "model")
+    model.save(path)
+    assert chains(load_model(path)) == got
+
+
+def test_sibling_blocks_do_not_cross_attribute_stages():
+    """A Real with both a mean-fill block and a label-driven tree-bucket
+    block reports each column under ITS producing chain only (reference
+    OpVectorColumnHistory is per-parent-chain, not an origin-wide union)."""
+    rng = np.random.default_rng(2)
+    n = 200
+    x = rng.normal(size=n)
+    y = (x > 0).astype(float)
+    host = fr.HostFrame.from_dict({
+        "x": (ft.Real, list(x)),
+        "label": (ft.RealNN, list(y)),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    label = feats.pop("label")
+    vec = dsl.transmogrify_features(list(feats.values()), label=label)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, seed=1,
+        models_and_parameters=[(OpLogisticRegression(), [{}])],
+        splitter=DataSplitter(reserve_test_fraction=0.2, seed=1))
+    pred = label.transform_with(sel, vec)
+    model = Workflow().set_input_frame(host).set_result_features(pred).train()
+    js = model.model_insights().to_json()
+    derived = [d for f in js["features"] if f["featureName"] == "x"
+               for d in f["derivedFeatures"]]
+    buckets = [d for d in derived if "Inf" in str(d.get("indicatorValue"))]
+    fills = [d for d in derived if d not in buckets]
+    assert buckets and fills
+    for d in buckets:
+        assert "DecisionTreeNumericBucketizer" in d["parentFeatureStages"]
+        assert "RealVectorizer" not in d["parentFeatureStages"]
+    for d in fills:
+        assert "RealVectorizer" in d["parentFeatureStages"]
+        assert "DecisionTreeNumericBucketizer" not in d["parentFeatureStages"]

@@ -101,6 +101,13 @@ _CTOR = {
 }
 
 
+#: concrete raw column types for stages whose declared in_types are
+#: abstract numeric generics (reference N <: OPNumeric, M <: OPMap[N])
+_CONCRETE_IN = {
+    "DecisionTreeNumericMapBucketizer": {ft.OPMap: ft.RealMap},
+}
+
+
 def _strings(rng, vocab, nulls=0.15):
     return [None if rng.uniform() < nulls else str(rng.choice(vocab))
             for _ in range(N)]
@@ -241,11 +248,14 @@ def _build_graph(cls, rng):
             feat_specs.append((f"__pred__{nm}", t))
         else:
             # any-typed stages get a concrete raw column (FeatureType/OPMap
-            # themselves are not constructible raw types)
-            col_t = (ft.Text if t is ft.FeatureType
-                     else ft.TextMap if t in (ft.OPMap, ft.OPCollection)
-                     else t)
-            vals = _values_for(t, rng)
+            # themselves are not constructible raw types); numeric-generic
+            # stages (tree bucketizers: OPNumeric / numeric OPMap) get Real
+            col_t = _CONCRETE_IN.get(cls.__name__, {}).get(t) or (
+                ft.Text if t is ft.FeatureType
+                else ft.Real if t is ft.OPNumeric
+                else ft.TextMap if t in (ft.OPMap, ft.OPCollection)
+                else t)
+            vals = _values_for(col_t, rng)
             if cls.__name__ in _NO_NULLS:
                 vals = ["filler" if v is None else v for v in vals]
             cols[nm] = (col_t, vals)

@@ -171,3 +171,81 @@ def test_transmogrify_end_to_end_mixed_types():
     scored = ex.transform(PipelineData.from_host(host), fitted)
     assert np.asarray(scored.device_col(combined.name).values).shape == \
         np.asarray(vec.values).shape
+
+
+def test_transmogrify_label_aware_bucketization():
+    """Parity: Transmogrifier.scala:99-104 + RichNumericFeature.scala:315-345
+    — with a label, Real/Integral scalars gain per-feature decision-tree
+    bucket blocks alongside the mean-fill block; features where the tree
+    finds no informative split add no columns; RealNN is exempt."""
+    n = 80
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=n)
+    host = fr.HostFrame.from_dict({
+        "x": (ft.Real, list(x)),
+        "cnt": (ft.Integral, [int(v * 3) for v in x]),
+        "const": (ft.Real, [1.5] * n),
+        "xnn": (ft.RealNN, list(np.abs(x) + 1.0)),
+        "label": (ft.RealNN, list((x > 0.3).astype(float))),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    label = feats.pop("label")
+
+    def bucket_cols(meta):
+        return [c for c in meta.columns
+                if c.indicator_value and "Inf" in str(c.indicator_value)]
+
+    plain = transmogrify(list(feats.values()))
+    data, _, _ = _fit_one(host, plain)
+    meta_plain = data.device_col(plain.name).metadata
+    assert bucket_cols(meta_plain) == []
+
+    smart = transmogrify(list(feats.values()), label=label)
+    data, fitted, ex = _fit_one(host, smart)
+    vec = data.device_col(smart.name)
+    meta = vec.metadata
+    bcols = bucket_cols(meta)
+    bucketized_parents = {p for c in bcols for p in c.parent_feature}
+    # informative features got buckets; constant and RealNN did not
+    assert "x" in bucketized_parents
+    assert "cnt" in bucketized_parents
+    assert "const" not in bucketized_parents
+    assert "xnn" not in bucketized_parents
+    # the mean-fill block survives alongside (x appears as a plain value col)
+    plain_x = [c for c in meta.columns
+               if "x" in c.parent_feature and not c.indicator_value]
+    assert plain_x
+    assert vec.values.shape[1] == meta.size
+    # scoring a fresh frame reproduces the fitted width
+    scored = ex.transform(PipelineData.from_host(host), fitted)
+    assert np.asarray(scored.device_col(smart.name).values).shape == \
+        np.asarray(vec.values).shape
+
+
+def test_transmogrify_label_replaces_numeric_map_vectorizer():
+    """Parity: RichMapFeature.scala:607-625 — with a label a numeric map is
+    vectorized ONLY through the per-key tree bucketizer (the mean-fill map
+    block is replaced, not combined)."""
+    n = 80
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=n)
+    host = fr.HostFrame.from_dict({
+        "m": (ft.RealMap, [{"k": float(v), "j": 2.0} for v in x]),
+        "label": (ft.RealNN, list((x > 0.0).astype(float))),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    label = feats.pop("label")
+
+    smart = transmogrify(list(feats.values()), label=label)
+    data, _, _ = _fit_one(host, smart)
+    meta = data.device_col(smart.name).metadata
+    k_buckets = [c for c in meta.columns if c.grouping == "k"
+                 and c.indicator_value and "Inf" in str(c.indicator_value)]
+    assert k_buckets  # informative key bucketized
+    # no plain mean-fill value column survives for the map
+    plain_vals = [c for c in meta.columns
+                  if "m" in c.parent_feature and not c.indicator_value]
+    assert plain_vals == []
+    # constant key "j" contributes only its null indicator
+    j_cols = [c for c in meta.columns if c.grouping == "j"]
+    assert all(c.indicator_value == NULL_INDICATOR for c in j_cols)

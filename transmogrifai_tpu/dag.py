@@ -201,6 +201,14 @@ class DagExecutor:
                        for t in dev_ts for n in t.runtime_input_names()}
             outs = fused(params, in_cols)
             data = data.with_device_cols(outs)
+            # record fitted vector metadata OUTSIDE the traced program
+            # (ModelInsights' fallback reads the last stage's out_meta;
+            # mutating self inside device_apply would tie freshness to jit
+            # cache behavior)
+            for t in dev_ts:
+                m = getattr(outs.get(t.get_output().name), "metadata", None)
+                if m is not None:
+                    t.out_meta = m
         return data
 
     def _fused_program(self, dev_ts: Sequence[Transformer]):

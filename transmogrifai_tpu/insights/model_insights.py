@@ -30,10 +30,17 @@ class DerivedColumnInsights:
     variance: Optional[float] = None
     cramers_v: Optional[float] = None
     contribution: Optional[float] = None
+    #: full raw->derived lineage (OpVectorColumnHistory analog,
+    #: OpVectorMetadata.scala:216-277): origin raw features + every stage
+    #: operation between them and this column
+    origin_features: Optional[list] = None
+    stages: Optional[list] = None
 
     def to_json(self):
         names = {"corr_label": "corrLabel", "cramers_v": "cramersV",
-                 "indicator_value": "indicatorValue"}
+                 "indicator_value": "indicatorValue",
+                 "origin_features": "parentFeatureOrigins",
+                 "stages": "parentFeatureStages"}
         return {names.get(k, k): v for k, v in self.__dict__.items()
                 if v is not None}
 
@@ -124,12 +131,21 @@ class ModelInsights:
         if sanity is not None and sanity.out_meta is not None:
             meta = sanity.out_meta
         else:
-            # fall back to the prediction model's input vector metadata if
-            # present in a fitted vectorizer chain
+            # fall back to the metadata of the vector the prediction model
+            # actually consumes (second SelectedModel input); if the name
+            # can't be resolved, last vector-producing stage wins
+            want = None
+            if selected is not None and len(selected.input_names) > 1:
+                want = selected.input_names[1]
+            exact = last = None
             for t in model.stages():
                 m = getattr(t, "out_meta", None)
-                if m is not None:
-                    meta = m
+                if m is None:
+                    continue
+                last = m
+                if want is not None and m.name == want:
+                    exact = m
+            meta = exact if exact is not None else last
 
         contributions = None
         if selected is not None and hasattr(selected.model,
@@ -154,13 +170,17 @@ class ModelInsights:
             cat_stats = dict(s.categorical_stats)
 
         if meta is not None:
+            col_hist = meta.column_history() if meta.history else None
             for i, cm in enumerate(meta.columns):
                 name = cm.make_col_name()
                 stats = col_stats.get(_strip_index(name))
                 group = cm.feature_group()
+                h = col_hist[i] if col_hist else {}
                 d = DerivedColumnInsights(
                     name=name, index=cm.index, grouping=cm.grouping,
                     indicator_value=cm.indicator_value,
+                    origin_features=h.get("parentFeatureOrigins"),
+                    stages=h.get("parentFeatureStages"),
                     corr_label=(float(stats.corr_label) if stats else None),
                     variance=(float(stats.variance) if stats else None),
                     cramers_v=(cat_stats.get(group, {}).get("cramersV")

@@ -7,6 +7,7 @@ column reindexing (``OpVectorMetadata.flatten``).
 
 from __future__ import annotations
 
+from dataclasses import replace
 from typing import Optional
 
 import jax.numpy as jnp
@@ -43,8 +44,27 @@ class VectorsCombiner(DeviceTransformer):
                     VectorColumnMetadata((name,), ("OPVector",),
                                          descriptor_value=f"col_{j}")
                     for j in range(width)))
+            # tag each block's columns with THEIR producing chain so
+            # sibling blocks over the same raw feature (mean-fill vs tree
+            # buckets of one Real) don't cross-attribute stages; inner
+            # combiners' finer tags win
+            block = self.input_names[i]
+            m = VectorMetadata(
+                m.name,
+                tuple(col if col.parent_chain is not None
+                      else replace(col, parent_chain=block)
+                      for col in m.columns),
+                m.history)
             metas.append(m)
         meta = VectorMetadata.flatten(self.get_output().name, metas)
+        # vector-level lineage map (OpVectorMetadata.history analog): each
+        # input block contributes its raw->derived stage chain, so the
+        # combined vector can answer per-column history questions
+        own = VectorMetadata.history_of(self.input_features)
+        if own:
+            merged = {e[0]: e for e in meta.history}
+            merged.update({e[0]: e for e in own})
+            meta = meta.with_history(tuple(merged.values()))
         vals = jnp.concatenate([c.values for c in cols], axis=1)
         return fr.VectorColumn(vals, meta)
 

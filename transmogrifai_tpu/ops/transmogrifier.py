@@ -46,6 +46,8 @@ class TransmogrifierDefaults:
     NUM_HASH_FEATURES = 512
     MAX_NUM_HASH_FEATURES = 2 ** 17
     TRACK_NULLS = True
+    TRACK_INVALID = False
+    MIN_INFO_GAIN = 0.01
     DATE_TIME_PERIOD = "HourOfDay"
 
 
@@ -121,8 +123,27 @@ def transmogrify(features: Sequence[FeatureLike],
                  num_hash_features: int = TransmogrifierDefaults.NUM_HASH_FEATURES,
                  track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
                  date_time_period: str = TransmogrifierDefaults.DATE_TIME_PERIOD,
+                 label: Optional[FeatureLike] = None,
+                 track_invalid: bool = TransmogrifierDefaults.TRACK_INVALID,
+                 min_info_gain: float = TransmogrifierDefaults.MIN_INFO_GAIN,
                  ) -> FeatureLike:
-    """Vectorize a heterogeneous feature set into one combined OPVector."""
+    """Vectorize a heterogeneous feature set into one combined OPVector.
+
+    ``label``: optional response feature enabling the reference's
+    label-aware smart defaults (Transmogrifier.scala:99-104 passes the
+    label through the numeric cases at :246-269):
+
+    - Real/Currency/Percent/Integral scalars (NOT RealNN/Binary/Date) keep
+      their mean/mode-fill block AND each gain a per-feature
+      DecisionTreeNumericBucketizer block with ``trackNulls=false``
+      (RichNumericFeature.scala:315-345 combines filled +: bucketized);
+      features where the tree finds no informative split (minInfoGain
+      gate) contribute no bucket columns.
+    - Real/Currency/Percent/Integral MAPS are instead REPLACED by a per-key
+      DecisionTreeNumericMapBucketizer with ``trackNulls`` kept
+      (RichMapFeature.scala:607-625: ``case Some(lbl) => autoBucketize``);
+      non-splitting keys contribute only their null-indicator column.
+    """
     if not features:
         raise ValueError("transmogrify: no features given")
     groups: dict[str, list[FeatureLike]] = {}
@@ -139,6 +160,9 @@ def transmogrify(features: Sequence[FeatureLike],
         GeolocationMapVectorizer, IntegralMapVectorizer,
         MultiPickListMapVectorizer, RealMapVectorizer, SmartTextMapVectorizer,
         TextMapPivotVectorizer,
+    )
+    from transmogrifai_tpu.ops.vectorizers.bucketizers import (
+        DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
     )
     from transmogrifai_tpu.stages.base import LambdaTransformer
 
@@ -178,6 +202,16 @@ def transmogrify(features: Sequence[FeatureLike],
     for kind in order:
         fs = groups.get(kind)
         if not fs:
+            continue
+        if label is not None and kind in ("real_map", "integral_map"):
+            # reference RichMapFeature.scala:620-625: with a label the
+            # numeric-map vectorizer is REPLACED by per-key tree buckets
+            for f in fs:
+                blocks.append(label.transform_with(
+                    DecisionTreeNumericMapBucketizer(
+                        min_info_gain=min_info_gain,
+                        track_nulls=track_nulls,
+                        track_invalid=track_invalid), f))
             continue
         if kind == "real":
             stage = RealVectorizer(track_nulls=track_nulls)
@@ -227,6 +261,18 @@ def transmogrify(features: Sequence[FeatureLike],
             blocks.extend(fs)
             continue
         blocks.append(fs[0].transform_with(stage, *fs[1:]))
+        if label is not None and kind in ("real", "integral"):
+            # reference RichNumericFeature.scala:315-345: the mean/mode-fill
+            # block stays AND each feature gains a tree-bucket block
+            # (trackNulls=false there — the fill block already tracks).
+            # RealNN takes no label in the reference case analysis (:270).
+            for f in fs:
+                if issubclass(f.ftype, ft.RealNN):
+                    continue
+                blocks.append(label.transform_with(
+                    DecisionTreeNumericBucketizer(
+                        min_info_gain=min_info_gain, track_nulls=False,
+                        track_invalid=track_invalid), f))
 
     if len(blocks) == 1:
         return blocks[0]

@@ -228,7 +228,9 @@ class DecisionTreeNumericBucketizer(Estimator, AllowLabelAsInput):
     (reference ``shouldSplit=false`` behavior).
     """
 
-    in_types = (ft.RealNN, ft.Real)
+    # reference generic is N <: OPNumeric (DecisionTreeNumericBucketizer
+    # .scala:46): Integral/Currency/Percent bucketize like Real
+    in_types = (ft.RealNN, ft.OPNumeric)
     out_type = ft.OPVector
 
     def __init__(self, max_depth: int = 2, max_bins: int = 32,
@@ -268,7 +270,7 @@ class DecisionTreeNumericBucketizer(Estimator, AllowLabelAsInput):
 class _TreeBucketizerModel(DeviceTransformer):
     """Fitted tree bucketizer; consumes only the numeric input at score."""
 
-    in_types = (ft.RealNN, ft.Real)
+    in_types = (ft.RealNN, ft.OPNumeric)  # mirror the estimator's bound
     out_type = ft.OPVector
 
     def __init__(self, splits: Sequence[float] = (), track_nulls: bool = True,
@@ -337,7 +339,8 @@ class DecisionTreeNumericMapBucketizer(Estimator, AllowLabelAsInput):
     strips key names the way map vectorizers do.
     """
 
-    in_types = (ft.RealNN, ft.RealMap)
+    # any numeric map (reference M <: OPMap[N], N <: OPNumeric)
+    in_types = (ft.RealNN, ft.OPMap)
     out_type = ft.OPVector
 
     def __init__(self, max_depth: int = 2, max_bins: int = 32,
@@ -378,7 +381,15 @@ class DecisionTreeNumericMapBucketizer(Estimator, AllowLabelAsInput):
             for i in range(len(mcol)):
                 d = mcol.python_value(i)
                 if d and k in d and ycol.mask[i]:
-                    xs.append(float(d[k]))
+                    try:
+                        xs.append(float(d[k]))
+                    except (TypeError, ValueError):
+                        # in_types is the loose OPMap bound (no common
+                        # numeric-map base); enforce N <: OPNumeric here
+                        raise TypeError(
+                            f"{self}: expects a numeric map (reference "
+                            f"OPMap[N <: OPNumeric]); key {k!r} holds "
+                            f"non-numeric value {d[k]!r}") from None
                     ys.append(y_all[i])
             splits_per_key[k] = helper.compute_splits(
                 np.asarray(xs, np.float64), np.asarray(ys, np.float64))
@@ -388,7 +399,8 @@ class DecisionTreeNumericMapBucketizer(Estimator, AllowLabelAsInput):
 
 
 class _TreeMapBucketizerModel(HostTransformer):
-    in_types = (ft.RealNN, ft.RealMap)
+    # any numeric map (reference M <: OPMap[N], N <: OPNumeric)
+    in_types = (ft.RealNN, ft.OPMap)
     out_type = ft.OPVector
 
     def __init__(self, keys: Sequence[str] = (),

@@ -12,14 +12,84 @@ retrace as long as batch shape buckets repeat).
 from __future__ import annotations
 
 import glob
+import json
 import os
 import time
 import warnings
-from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Union
 
 from transmogrifai_tpu.readers.base import CustomReader, DataReader
 
-__all__ = ["StreamingReader", "FileStreamingReader", "stream_score"]
+__all__ = ["StreamingReader", "FileStreamingReader", "StreamCheckpoint",
+           "stream_score"]
+
+
+class StreamCheckpoint:
+    """Durable per-file progress for a file stream (the recovery analog of
+    reference Spark DStream checkpointing, ``StreamingReaders.scala:40-67``:
+    a restarted stream must neither re-score completed batches nor skip
+    batches that were in flight when the process died).
+
+    One JSON file records each fully-processed source file with a
+    (mtime, size) fingerprint; writes are atomic (tmp + rename). A file is
+    marked done only AFTER its batch has been consumed downstream, so a
+    crash mid-batch replays that batch on restart (at-least-once, and
+    exactly-once when the consumer's write is idempotent per batch)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._done: dict[str, dict] = {}
+        self._skipped: list[str] = []
+        if os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    state = json.load(fh)
+                self._done = dict(state.get("done", {}))
+                self._skipped = list(state.get("skipped", []))
+            except (OSError, json.JSONDecodeError):
+                warnings.warn(f"StreamCheckpoint: unreadable state at "
+                              f"{path!r}; starting fresh", RuntimeWarning)
+
+    @staticmethod
+    def _fingerprint(f: str) -> Optional[dict]:
+        try:
+            st = os.stat(f)
+            return {"mtime": st.st_mtime, "size": st.st_size}
+        except OSError:
+            return None
+
+    def is_done(self, f: str) -> bool:
+        fp = self._done.get(f)
+        return fp is not None and fp == self._fingerprint(f)
+
+    @property
+    def skipped(self) -> list[str]:
+        return list(self._skipped)
+
+    def mark_done(self, f: str, fingerprint: Optional[dict] = None) -> None:
+        """Record ``f`` as fully processed. Pass the fingerprint captured
+        BEFORE the file was read: if a producer appended rows between read
+        and commit, the stored (pre-append) fingerprint no longer matches
+        and the file is re-processed on restart instead of silently
+        losing the appended rows."""
+        fp = fingerprint if fingerprint is not None else self._fingerprint(f)
+        if fp is not None:
+            self._done[f] = fp
+            self._save()
+
+    def mark_skipped(self, f: str) -> None:
+        if f not in self._skipped:
+            self._skipped.append(f)
+            self._save()
+
+    def _save(self) -> None:
+        tmp = self.path + ".tmp"
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump({"done": self._done, "skipped": self._skipped}, fh)
+        os.replace(tmp, self.path)
 
 
 class StreamingReader:
@@ -46,9 +116,14 @@ class FileStreamingReader(StreamingReader):
                  poll_interval_s: float = 1.0,
                  new_files_only: bool = False,
                  max_batches: Optional[int] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 checkpoint: Optional[Union[str, StreamCheckpoint]] = None):
         self.path = path
         self.pattern = pattern
+        #: optional durable progress: a restarted reader resumes after the
+        #: last file whose batch was fully consumed (see StreamCheckpoint)
+        self.checkpoint = (StreamCheckpoint(checkpoint)
+                           if isinstance(checkpoint, str) else checkpoint)
         #: {column: FeatureType} forced onto each batch file; without it the
         #: per-file readers infer their own (which can disagree with the
         #: model's raw feature types — stream_score fills it from the model)
@@ -62,6 +137,8 @@ class FileStreamingReader(StreamingReader):
         #: files abandoned after ``max_retries_per_file`` failed reads —
         #: operators should monitor this for silent data loss
         self.skipped_files: list[str] = []
+        #: source file of the most recently yielded batch
+        self.current_file: Optional[str] = None
 
     def _list_files(self) -> list[str]:
         return sorted(glob.glob(os.path.join(self.path, self.pattern)))
@@ -74,6 +151,12 @@ class FileStreamingReader(StreamingReader):
     def stream(self) -> Iterator[list[Any]]:
         seen: set[str] = set(self._list_files()) if self.new_files_only \
             else set()
+        if self.checkpoint is not None:
+            # resume: completed files (fingerprint still matching) and
+            # previously-abandoned files are not replayed
+            skipped_before = set(self.checkpoint.skipped)
+            seen.update(f for f in self._list_files()
+                        if self.checkpoint.is_done(f) or f in skipped_before)
         failures: dict[str, int] = {}
         next_retry: dict[str, float] = {}
         n_batches = 0
@@ -91,6 +174,8 @@ class FileStreamingReader(StreamingReader):
                     # schema file): skip it permanently, never retry
                     seen.add(f)
                     continue
+                read_fp = (StreamCheckpoint._fingerprint(f)
+                           if self.checkpoint is not None else None)
                 try:
                     records = list(reader.read())
                 except Exception:
@@ -102,6 +187,8 @@ class FileStreamingReader(StreamingReader):
                     if failures[f] >= self.max_retries_per_file:
                         seen.add(f)
                         self.skipped_files.append(f)
+                        if self.checkpoint is not None:
+                            self.checkpoint.mark_skipped(f)
                         warnings.warn(
                             f"FileStreamingReader: abandoning {f!r} after "
                             f"{failures[f]} failed reads — batch dropped "
@@ -111,9 +198,20 @@ class FileStreamingReader(StreamingReader):
                             self.poll_interval_s
                     continue
                 seen.add(f)
+                #: source of the batch currently in flight — consumers that
+                #: need idempotent per-batch outputs key off this
+                self.current_file = f
                 if records:
                     n_batches += 1
                     yield records
+                    # the consumer has finished this batch iff it asked for
+                    # the next one — commit AFTER resume (with the
+                    # fingerprint captured at READ time), so a crash
+                    # mid-batch replays the file on restart
+                    if self.checkpoint is not None:
+                        self.checkpoint.mark_done(f, read_fp)
+                elif self.checkpoint is not None:
+                    self.checkpoint.mark_done(f, read_fp)  # empty file
                 if self.max_batches and n_batches >= self.max_batches:
                     return
             if not new_files:

@@ -84,11 +84,31 @@ class WorkflowRunner:
                         f"{type(reader).__name__})")
 
                 def write_batch(frame, i):
-                    if params.score_location:
-                        from transmogrifai_tpu.readers.avro import save_avro
-                        os.makedirs(params.score_location, exist_ok=True)
-                        save_avro(frame, os.path.join(
-                            params.score_location, f"batch_{i:06d}.avro"))
+                    if not params.score_location:
+                        return
+                    from transmogrifai_tpu.readers.avro import save_avro
+                    os.makedirs(params.score_location, exist_ok=True)
+                    # idempotent per-source naming: a checkpoint-resumed
+                    # stream that REPLAYS the in-flight batch overwrites
+                    # the same score file instead of duplicating rows;
+                    # non-file sources fall back to the stream index
+                    src = getattr(reader, "current_file", None)
+                    if src:
+                        import hashlib
+                        # short path hash: distinct sources sharing a
+                        # basename stem (day1.csv vs day1.avro, same-named
+                        # files in sibling dirs) must not collide
+                        tag = hashlib.sha1(
+                            src.encode()).hexdigest()[:8]
+                        stem = (os.path.splitext(os.path.basename(src))[0]
+                                + "_" + tag)
+                    else:
+                        stem = f"batch_{i:06d}"
+                    out = os.path.join(params.score_location,
+                                       f"scores_{stem}.avro")
+                    tmp = out + ".tmp"
+                    save_avro(frame, tmp)   # atomic: no truncated .avro
+                    os.replace(tmp, out)    # survives a crash mid-write
 
                 n_rows = n_batches = 0
                 with profiler.phase(OpStep.SCORING):

@@ -43,14 +43,21 @@ class DataSplitter:
         self.max_training_sample = max_training_sample
         self.summary: Optional[SplitterSummary] = None
 
+    #: rows kept by split_indices; balancers return None here because they
+    #: apply the cap through sampling fractions instead of truncation
+    @property
+    def _truncation_cap(self) -> Optional[int]:
+        return self.max_training_sample
+
     def split_indices(self, n: int, y: Optional[np.ndarray] = None
                       ) -> tuple[np.ndarray, np.ndarray]:
         rng = np.random.default_rng(self.seed)
         perm = rng.permutation(n)
         n_test = int(round(n * self.reserve_test_fraction))
         test, train = perm[:n_test], perm[n_test:]
-        if self.max_training_sample and train.size > self.max_training_sample:
-            train = train[:self.max_training_sample]
+        cap = self._truncation_cap
+        if cap and train.size > cap:
+            train = train[:cap]
         self.summary = SplitterSummary(
             "DataSplitter", {"trainRows": int(train.size),
                              "testRows": int(test.size)})
@@ -64,9 +71,28 @@ class DataSplitter:
 
 
 class DataBalancer(DataSplitter):
-    """Binary down-sampler toward a target positive fraction."""
+    """Binary re-balancer toward a target minority fraction.
+
+    Parity: reference ``DataBalancer.scala:76-113`` (``getProportions``
+    computes BOTH the up-sample multiplier for the minority and the
+    down-sample fraction for the majority), ``:208-247`` (``estimate``:
+    already-balanced data is only stratified-down-sampled when it exceeds
+    ``maxTrainingSample``) and ``:279-318`` (``rebalance`` up-samples WITH
+    replacement when the multiplier > 1, keeps the minority whole at 1,
+    down-samples it without replacement below 1). Summary metadata mirrors
+    ``DataBalancerSummary`` (positiveLabels/negativeLabels/desiredFraction/
+    upSamplingFraction/downSamplingFraction).
+
+    ``max_training_sample`` participates in the proportion math (as in the
+    reference) instead of truncating the training set up front, so the
+    base-class cap is intentionally not applied here.
+    """
 
     requires_label = True
+
+    #: shadows the base property: no up-front truncation (the cap acts
+    #: through get_proportions / the already-balanced fraction instead)
+    _truncation_cap = None
 
     def __init__(self, sample_fraction: float = 0.1,
                  max_training_sample: Optional[int] = 1_000_000,
@@ -74,30 +100,75 @@ class DataBalancer(DataSplitter):
         super().__init__(reserve_test_fraction, seed, max_training_sample)
         self.sample_fraction = sample_fraction
 
+    @staticmethod
+    def get_proportions(small_count: float, big_count: float, sample_f: float,
+                        max_training_sample: int) -> tuple[float, float]:
+        """(downSample fraction for big, upSample multiplier for small) —
+        reference DataBalancer.scala:84-115."""
+        def up_ok(m: int) -> bool:
+            return (m * small_count * (1.0 - sample_f) < sample_f * big_count
+                    and max_training_sample * sample_f > small_count * m)
+
+        if small_count < max_training_sample * sample_f:
+            up = next((float(m) for m in (100, 50, 10, 5, 4, 3, 2)
+                       if up_ok(m)), 1.0)
+            down = (small_count * up / sample_f - small_count * up) / big_count
+            return down, up
+        # minority alone already exceeds the cap: shrink both classes
+        up = (max_training_sample * sample_f) / small_count
+        down = (1.0 - sample_f) * max_training_sample / big_count
+        return down, up
+
     def prepare_indices(self, train_idx, y):
         rng = np.random.default_rng(self.seed + 1)
         yt = y[train_idx]
         pos = train_idx[yt >= 0.5]
         neg = train_idx[yt < 0.5]
         n_pos, n_neg = pos.size, neg.size
-        small, big = (pos, neg) if n_pos <= n_neg else (neg, pos)
-        frac = small.size / max(train_idx.size, 1)
-        if frac >= self.sample_fraction or small.size == 0:
+        total = max(train_idx.size, 1)
+        is_pos_small = n_pos < n_neg
+        small, big = (pos, neg) if is_pos_small else (neg, pos)
+        f = self.sample_fraction
+        max_train = self.max_training_sample or total
+
+        def summarize(up: float, down: float, kept: int, balanced: bool):
             self.summary = SplitterSummary(
-                "DataBalancer", {"balanced": False,
-                                 "positiveFraction": n_pos / max(train_idx.size, 1)})
-            return train_idx, np.ones(train_idx.size, dtype=np.float32)
-        # down-sample the majority so the minority reaches sample_fraction
-        target_big = int(small.size * (1.0 - self.sample_fraction)
-                         / self.sample_fraction)
-        keep_big = rng.choice(big, size=min(target_big, big.size), replace=False)
-        out = np.sort(np.concatenate([small, keep_big]))
-        self.summary = SplitterSummary(
-            "DataBalancer",
-            {"balanced": True,
-             "downSampleFraction": keep_big.size / max(big.size, 1),
-             "positiveFraction": n_pos / max(train_idx.size, 1),
-             "keptRows": int(out.size)})
+                "DataBalancer",
+                {"balanced": balanced,
+                 "positiveLabels": int(n_pos), "negativeLabels": int(n_neg),
+                 "desiredFraction": f,
+                 "upSamplingFraction": up, "downSamplingFraction": down,
+                 "positiveFraction": n_pos / total, "keptRows": int(kept)})
+
+        def take(idx: np.ndarray, fraction: float) -> np.ndarray:
+            if fraction >= 1.0:
+                return idx
+            n = int(round(idx.size * fraction))
+            return rng.choice(idx, size=min(n, idx.size), replace=False)
+
+        if small.size == 0 or small.size / total >= f:
+            # already balanced (estimate:225-234): stratified down-sample
+            # only when the data exceeds the training cap
+            fraction = max_train / total if max_train < total else 1.0
+            if fraction >= 1.0:
+                out = train_idx
+            else:
+                out = np.concatenate([take(neg, fraction), take(pos, fraction)])
+            summarize(up=0.0, down=fraction, kept=out.size, balanced=False)
+            return np.sort(out), np.ones(out.size, dtype=np.float32)
+
+        down, up = self.get_proportions(small.size, big.size, f, max_train)
+        big_keep = take(big, down)
+        if up > 1.0:
+            # rebalance:288 — sample WITH replacement at the multiplier
+            small_keep = rng.choice(small, size=int(round(small.size * up)),
+                                    replace=True)
+        elif up == 1.0:
+            small_keep = small
+        else:
+            small_keep = take(small, up)
+        out = np.sort(np.concatenate([small_keep, big_keep]))
+        summarize(up=up, down=down, kept=out.size, balanced=True)
         return out, np.ones(out.size, dtype=np.float32)
 
 

@@ -46,6 +46,10 @@ class VectorColumnMetadata:
     indicator_value: Optional[str] = None      # pivot category value
     descriptor_value: Optional[str] = None     # e.g. "sin_HourOfDay"
     index: int = 0                             # position in the combined vector
+    #: name of the derived feature whose lineage produced THIS column (the
+    #: key into VectorMetadata.history) — set by VectorsCombiner so sibling
+    #: blocks over the same raw feature don't cross-attribute their stages
+    parent_chain: Optional[str] = None
 
     @property
     def is_null_indicator(self) -> bool:
@@ -75,7 +79,7 @@ class VectorColumnMetadata:
         return None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "parentFeature": list(self.parent_feature),
             "parentFeatureType": list(self.parent_feature_type),
             "grouping": self.grouping,
@@ -83,6 +87,9 @@ class VectorColumnMetadata:
             "descriptorValue": self.descriptor_value,
             "index": self.index,
         }
+        if self.parent_chain is not None:
+            out["parentChain"] = self.parent_chain
+        return out
 
     @staticmethod
     def from_json(d: dict) -> "VectorColumnMetadata":
@@ -93,15 +100,30 @@ class VectorColumnMetadata:
             indicator_value=d.get("indicatorValue"),
             descriptor_value=d.get("descriptorValue"),
             index=int(d.get("index", 0)),
+            parent_chain=d.get("parentChain"),
         )
+
+
+#: one vector-level lineage entry: (feature name, origin raw features,
+#: stage operation names along the chain raw -> feature) — the analog of
+#: reference ``FeatureHistory`` values in ``OpVectorMetadata.history``
+HistoryEntry = tuple[str, tuple[str, ...], tuple[str, ...]]
 
 
 @dataclass(frozen=True)
 class VectorMetadata:
-    """Metadata for a whole feature vector: ordered column provenance."""
+    """Metadata for a whole feature vector: ordered column provenance.
+
+    ``history`` is the reference's ``Map[String, FeatureHistory]``
+    (``OpVectorMetadata.scala:216-277``) as a hashable tuple: one entry per
+    contributing (possibly derived) feature, carrying its origin raw
+    features and the operation names of every stage between them. Kept at
+    the vector level and merged per column by :meth:`column_history` — the
+    ``getColumnHistory``/``OpVectorColumnHistory`` analog."""
 
     name: str
     columns: tuple[VectorColumnMetadata, ...] = field(default_factory=tuple)
+    history: tuple[HistoryEntry, ...] = ()
 
     @property
     def size(self) -> int:
@@ -110,31 +132,95 @@ class VectorMetadata:
     def col_names(self) -> list[str]:
         return [c.make_col_name() for c in self.columns]
 
+    @staticmethod
+    def history_of(features: Sequence) -> tuple[HistoryEntry, ...]:
+        """Lineage entries for the given FeatureLike objects (their
+        ``history()`` already walks the raw->derived stage chain)."""
+        entries = []
+        for f in features:
+            try:
+                h = f.history()
+            except Exception:
+                continue
+            entries.append((f.name, tuple(h["originFeatures"]),
+                            tuple(h["stages"])))
+        return tuple(entries)
+
+    def with_history(self, entries: Sequence[HistoryEntry]) -> "VectorMetadata":
+        return VectorMetadata(self.name, self.columns, tuple(entries))
+
+    def column_history(self) -> list[dict]:
+        """Per-column lineage (reference ``getColumnHistory()``): a column
+        tagged with its producing chain (``parent_chain``, set by the
+        combiner) reports exactly that entry's raw->derived stage chain;
+        untagged columns fall back to joining the entries whose origins
+        intersect their raw parents."""
+        by_name = {name: (origins, stages)
+                   for name, origins, stages in self.history}
+        out = []
+        for c in self.columns:
+            parents = set(c.parent_feature)
+            origins: set[str] = set()
+            stages: set[str] = set()
+            if c.parent_chain is not None and c.parent_chain in by_name:
+                ent_origins, ent_stages = by_name[c.parent_chain]
+                origins.update(ent_origins)
+                stages.update(ent_stages)
+            else:
+                for name, ent_origins, ent_stages in self.history:
+                    if name in parents or parents & set(ent_origins):
+                        origins.update(ent_origins)
+                        stages.update(ent_stages)
+            out.append({
+                "columnName": c.make_col_name(),
+                "parentFeatureName": list(c.parent_feature),
+                "parentFeatureOrigins": sorted(origins or parents),
+                "parentFeatureStages": sorted(stages),
+                "parentFeatureType": list(c.parent_feature_type),
+                "grouping": c.grouping,
+                "indicatorValue": c.indicator_value,
+                "descriptorValue": c.descriptor_value,
+                "index": c.index,
+            })
+        return out
+
     def reindexed(self, start: int = 0) -> "VectorMetadata":
         cols = tuple(replace(c, index=start + i) for i, c in enumerate(self.columns))
-        return VectorMetadata(self.name, cols)
+        return VectorMetadata(self.name, cols, self.history)
 
     @staticmethod
     def flatten(name: str, metas: Sequence["VectorMetadata"]) -> "VectorMetadata":
         """Concatenate vector metadatas (reference OpVectorMetadata.flatten),
-        reassigning global column indices."""
+        reassigning global column indices and merging lineage maps."""
         cols: list[VectorColumnMetadata] = []
+        hist: list[HistoryEntry] = []
+        seen: set[str] = set()
         for m in metas:
             cols.extend(m.columns)
-        out = VectorMetadata(name, tuple(cols)).reindexed(0)
+            for e in m.history:
+                if e[0] not in seen:
+                    seen.add(e[0])
+                    hist.append(e)
+        out = VectorMetadata(name, tuple(cols), tuple(hist)).reindexed(0)
         return out
 
     def select(self, keep: Sequence[int]) -> "VectorMetadata":
         """Keep a subset of columns (DropIndices rewiring), reindexed."""
         cols = tuple(self.columns[i] for i in keep)
-        return VectorMetadata(self.name, cols).reindexed(0)
+        return VectorMetadata(self.name, cols, self.history).reindexed(0)
 
     def to_json(self) -> dict:
-        return {"name": self.name, "columns": [c.to_json() for c in self.columns]}
+        return {"name": self.name,
+                "columns": [c.to_json() for c in self.columns],
+                "history": [{"feature": n, "originFeatures": list(o),
+                             "stages": list(s)} for n, o, s in self.history]}
 
     @staticmethod
     def from_json(d: dict) -> "VectorMetadata":
         return VectorMetadata(
             d["name"],
             tuple(VectorColumnMetadata.from_json(c) for c in d.get("columns", [])),
+            tuple((h["feature"], tuple(h.get("originFeatures", ())),
+                   tuple(h.get("stages", ())))
+                  for h in d.get("history", ())),
         )
