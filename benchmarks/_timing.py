@@ -1,0 +1,43 @@
+"""Shared device-timing helpers for the on-chip benches and diagnostics.
+
+On the axon-tunneled TPU, ``jax.block_until_ready`` returns before the
+computation has actually executed (measured: fresh-input 137-GFLOP
+matmuls "complete" in 0.04 ms), so any wall built on it times dispatch,
+not execution. Every timing here therefore fences by FETCHING a scalar
+of the result to the host, which cannot complete until the device value
+exists. Callers should also pass ``variants`` — a list of distinct input
+tuples longer than ``repeats`` — so a hypothetical remote result cache
+can never serve a timed repeat.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["fence", "med_fetch"]
+
+
+def fence(x) -> float:
+    """Force completion by pulling one scalar of ``x`` to the host."""
+    return float(np.asarray(x).ravel()[0])
+
+
+def med_fetch(fn, variants, repeats: int = 3) -> float:
+    """Median host-fenced wall of ``fn(*args)`` over fresh-input repeats.
+
+    ``variants``: list of argument tuples. The first is burned on
+    warmup/compile; timed repeats walk the remaining variants so no
+    timed call reuses an input that has already executed (when
+    ``len(variants) >= repeats + 1``, which callers should ensure).
+    """
+    fence(fn(*variants[0]))
+    ts = []
+    for i in range(repeats):
+        args = variants[1 + i % (len(variants) - 1)] if len(variants) > 1 \
+            else variants[0]
+        t0 = time.perf_counter()
+        fence(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))

@@ -22,6 +22,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
@@ -32,14 +33,20 @@ NODE_COUNTS = [1, 2, 4, 8, 16, 64, 256, 1024, 4096]
 REPEATS = int(os.environ.get("HIST_REPEATS", 5))
 
 
-def _median_time(fn, *args, **kw):
-    import jax
-    out = fn(*args, **kw)          # compile + warm
-    jax.block_until_ready(out)
+def _median_time(fn, variant_args, **kw):
+    """Host-fetch-fenced median over fresh node-vector variants.
+
+    block_until_ready is NOT a real fence on the axon backend (see
+    benchmarks/_timing.py), so each repeat fetches a scalar of the
+    result and uses a node vector that has not executed before.
+    """
+    from _timing import fence
+    fence(fn(*variant_args[0], **kw)[0])   # compile + warm
     times = []
-    for _ in range(REPEATS):
+    for i in range(REPEATS):
+        args = variant_args[1 + i % (len(variant_args) - 1)]
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args, **kw))
+        fence(fn(*args, **kw)[0])
         times.append(time.perf_counter() - t0)
     return float(np.median(times))
 
@@ -66,8 +73,10 @@ def main() -> int:
 
     results = []
     for n_nodes in NODE_COUNTS:
-        node = jnp.asarray(rng.integers(0, n_nodes, size=ROWS), jnp.int32)
-        t_xla = _median_time(node_bin_histogram_xla, Xb, node, grad, hess,
+        variants = [
+            (Xb, jnp.asarray(rng.integers(0, n_nodes, size=ROWS), jnp.int32),
+             grad, hess) for _ in range(REPEATS + 1)]
+        t_xla = _median_time(node_bin_histogram_xla, variants,
                              n_nodes=n_nodes, n_bins=BINS)
         row = {"nodes": n_nodes, "xla_scatter_ms": round(t_xla * 1e3, 3)}
         # the kernel only lowers while the one-hot tile fits VMEM
@@ -78,7 +87,7 @@ def main() -> int:
         )
         lowers = n_nodes * BINS * _CHUNK * 4 * 8 <= _EQ_BUDGET
         if lowers:
-            t_pal = _median_time(node_bin_histogram, Xb, node, grad, hess,
+            t_pal = _median_time(node_bin_histogram, variants,
                                  n_nodes=n_nodes, n_bins=BINS)
             row["pallas_ms"] = round(t_pal * 1e3, 3)
             row["pallas_speedup"] = round(t_xla / t_pal, 2)

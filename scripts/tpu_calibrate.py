@@ -1,0 +1,103 @@
+"""NEGATIVE CONTROL: proves block_until_ready is not a fence on axon.
+
+Every measurement here uses ``jax.block_until_ready`` as the fence and
+comes back at 0.03-0.08 ms — including fresh-input 137-GFLOP matmuls,
+which is physically impossible. That result is the point: on the
+axon-tunneled TPU, block_until_ready returns at enqueue time, so any
+benchmark fenced with it times dispatch, not execution. Real timings
+live in tpu_calibrate2/3 (host-fetch fenced via benchmarks/_timing.py).
+Usage: python scripts/tpu_calibrate.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+REPEATS = 5
+
+
+def med(fn, args_list):
+    import jax
+    jax.block_until_ready(fn(*args_list[0]))
+    ts = []
+    for i in range(REPEATS):
+        a = args_list[(i + 1) % len(args_list)]
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    res = {"platform": jax.devices()[0].platform}
+
+    def variants(shape, dtype, k=REPEATS + 1):  # no timed call reuses input
+        if np.issubdtype(dtype, np.integer):
+            return [(jnp.asarray(rng.integers(0, 64, size=shape), dtype),)
+                    for _ in range(k)]
+        return [(jnp.asarray(rng.normal(size=shape).astype(dtype)),)
+                for _ in range(k)]
+
+    add = jax.jit(lambda a: a + 1)
+    for shape, dt, name in [((100_000, 28), np.int32, "add_100kx28_i32"),
+                            ((100_000, 28), np.float32, "add_100kx28_f32"),
+                            ((100_000, 28), np.int8, "add_100kx28_i8"),
+                            ((4_000_000,), np.float32, "add_4m_f32"),
+                            ((1024, 1024), np.float32, "add_1kx1k_f32"),
+                            ((4096, 4096), np.float32, "add_4kx4k_f32")]:
+        res[name + "_ms"] = round(med(add, variants(shape, dt)) * 1e3, 2)
+
+    red = jax.jit(lambda a: jnp.sum(a))
+    res["sum_100kx28_f32_ms"] = round(
+        med(red, variants((100_000, 28), np.float32)) * 1e3, 2)
+
+    mm = jax.jit(lambda a, b: a @ b)
+    mats = [(jnp.asarray(rng.normal(size=(4096, 4096)).astype(np.float32)),
+             jnp.asarray(rng.normal(size=(4096, 4096)).astype(np.float32)))
+            for _ in range(3)]
+    res["matmul_4096_fresh_ms"] = round(med(mm, mats) * 1e3, 2)
+
+    @jax.jit
+    def loop100(x):
+        def body(i, c):
+            return c * 1.000001 + 0.5
+        return jax.lax.fori_loop(0, 100, body, x)
+    res["fori100_scalar_ms"] = round(
+        med(loop100, variants((8, 128), np.float32)) * 1e3, 2)
+
+    @jax.jit
+    def scan100(x):
+        def body(c, _):
+            return c * 1.000001 + 0.5, ()
+        out, _ = jax.lax.scan(body, x, None, length=100)
+        return out
+    res["scan100_small_ms"] = round(
+        med(scan100, variants((8, 128), np.float32)) * 1e3, 2)
+
+    # 100 chained elementwise ops on [100k, 28] in ONE executable: does
+    # per-op cost inside an executable match the 60ms dispatch-level cost?
+    @jax.jit
+    def chain100(x):
+        for _ in range(100):
+            x = x * 1.000001 + 0.5
+        return x
+    res["chain100_100kx28_ms"] = round(
+        med(chain100, variants((100_000, 28), np.float32)) * 1e3, 2)
+
+    print("CALIBRATE " + json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Axon TPU calibration with HOST-FETCH fences.
+
+``block_until_ready`` on the axon backend returns before execution
+finishes (fresh-input 137-GFLOP matmuls "measure" 0.04 ms), so every
+timing here fences by fetching a scalar of the result to the host, and
+compute is made unambiguous with 20-deep dependent chains inside one
+executable. Usage: python scripts/tpu_calibrate2.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+REPEATS = 3
+
+
+def med_fetch(fn, args_list):
+    float(np.asarray(fn(*args_list[0])).ravel()[0])   # warm/compile
+    ts = []
+    for i in range(REPEATS):
+        a = args_list[(i + 1) % len(args_list)]
+        t0 = time.perf_counter()
+        float(np.asarray(fn(*a)).ravel()[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    res = {"platform": jax.devices()[0].platform}
+
+    def fresh(shape, dtype, k=4):
+        if np.issubdtype(dtype, np.integer):
+            return [(jnp.asarray(rng.integers(0, 64, size=shape), dtype),)
+                    for _ in range(k)]
+        return [(jnp.asarray((rng.normal(size=shape) * 1e-2)
+                             .astype(dtype)),) for _ in range(k)]
+
+    # 20 chained matmuls = 2.7 TFLOP; tiny scalar out
+    @jax.jit
+    def mm20(a):
+        z = a
+        for _ in range(20):
+            z = z @ a
+        return jnp.sum(z[0, :1])
+    res["matmul20_4096_ms"] = round(
+        med_fetch(mm20, fresh((4096, 4096), np.float32)) * 1e3, 1)
+
+    # 20 chained elementwise over [100k, 28]
+    @jax.jit
+    def ew20(x):
+        for _ in range(20):
+            x = x * 1.000001 + 0.5
+        return jnp.sum(x[0, :1])
+    res["elemwise20_100kx28_ms"] = round(
+        med_fetch(ew20, fresh((100_000, 28), np.float32)) * 1e3, 1)
+
+    # 20 dependent row-gathers (the tree-routing op) over [100k, 28]
+    Xb = jnp.asarray(rng.integers(0, 64, size=(100_000, 28)), jnp.int32)
+    rows = jnp.arange(100_000)
+
+    @jax.jit
+    def rg20(f0):
+        f = f0
+        for _ in range(20):
+            x = Xb[rows, f]
+            f = (x + f) % 28
+        return jnp.sum(f[:1])
+    res["rowgather20_100kx28_ms"] = round(
+        med_fetch(rg20, fresh((100_000,), np.int32)) * 1e3, 1)
+
+    # 20 dependent scatter-hists (64 nodes x 28 x 64)
+    g = jnp.asarray(rng.normal(size=100_000).astype(np.float32))
+
+    @jax.jit
+    def sc20(node0):
+        node = node0 % 64
+        tot = jnp.float32(0.0)
+        for _ in range(20):
+            flat = ((node[:, None] * 28 + jnp.arange(28)[None, :]) * 64
+                    + Xb).reshape(-1)
+            h = jnp.zeros(64 * 28 * 64, jnp.float32).at[flat].add(
+                jnp.broadcast_to(g[:, None], (100_000, 28)).reshape(-1))
+            tot = tot + h[0]
+            node = (node + jnp.int32(1)) % 64
+        return tot
+    res["scatter20_100kx28_ms"] = round(
+        med_fetch(sc20, fresh((100_000,), np.int32)) * 1e3, 1)
+
+    # single one-hot routed level via 20 chained levels (candidate fix)
+    @jax.jit
+    def oh20(f0):
+        f = f0
+        for _ in range(20):
+            sel = (f[:, None] % 28) == jax.lax.broadcasted_iota(
+                jnp.int32, (1, 28), 1)
+            x = jnp.sum(jnp.where(sel, Xb, 0), axis=1)
+            f = (x + f) % 28
+        return jnp.sum(f[:1])
+    res["onehot20_100kx28_ms"] = round(
+        med_fetch(oh20, fresh((100_000,), np.int32)) * 1e3, 1)
+
+    print("CALIBRATE2 " + json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

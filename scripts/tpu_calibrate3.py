@@ -1,0 +1,162 @@
+"""Histogram-strategy shootout on the axon TPU (host-fetch fenced).
+
+calibrate2 showed the scatter-add histogram costs ~24 ms per stat at
+100k x 28 (0.9 GB/s — the serialized TPU scatter path) while matmuls run
+at ~35 TFLOP/s f32. This times every candidate replacement at BISECT_ROWS
+(default 1M) x 28 x 64 so the tree learner can pick with data:
+
+  a) fused g+h scatter (one [n*d, 2] update instead of two scalar ones)
+  b) scatter with sorted indices (does XLA TPU have a sorted fast path?)
+  c) jnp.argsort at n (the sort-based approaches' entry fee)
+  d) row-permute Xb[perm] (applying the sort)
+  e) cumsum-hist: per-feature weighted bin one-hot -> axis-0 cumsum ->
+     segment-boundary diff (cost independent of node count)
+  f) block-matmul hist: [n/C, C] @ one-hot contraction per row-block +
+     per-block node scatter of [d*B] partials (touches N only in step 2)
+
+Usage: python scripts/tpu_calibrate3.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS = int(os.environ.get("BISECT_ROWS", 1_000_000))
+D = 28
+B = 64
+N_NODES = 64
+REPEATS = 3
+
+
+def fence(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def med_fetch(fn, args_list):
+    fence(fn(*args_list[0]))
+    ts = []
+    for i in range(REPEATS):
+        a = args_list[(i + 1) % len(args_list)]
+        t0 = time.perf_counter()
+        fence(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    res = {"rows": ROWS, "platform": jax.devices()[0].platform}
+
+    Xb = jnp.asarray(rng.integers(0, B, size=(ROWS, D)), jnp.int32)
+    g = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.2, 1.0, size=ROWS).astype(np.float32))
+    nodes = [(jnp.asarray(rng.integers(0, N_NODES, size=ROWS), jnp.int32),)
+             for _ in range(REPEATS + 1)]
+    nodes_sorted = [(jnp.sort(n[0]),) for n in nodes]
+
+    # --- baseline: two scalar scatters (what trees.py runs today) ---
+    @jax.jit
+    def scat2(node):
+        flat = ((node[:, None] * D + jnp.arange(D)[None, :]) * B
+                + Xb).reshape(-1)
+        seg = N_NODES * D * B
+        hg = jnp.zeros(seg, jnp.float32).at[flat].add(
+            jnp.broadcast_to(g[:, None], (ROWS, D)).reshape(-1))
+        hh = jnp.zeros(seg, jnp.float32).at[flat].add(
+            jnp.broadcast_to(h[:, None], (ROWS, D)).reshape(-1))
+        return hg[0] + hh[1]
+    res["scatter_2x_ms"] = round(med_fetch(scat2, nodes) * 1e3, 1)
+
+    # --- a) one fused [n*d, 2] scatter ---
+    @jax.jit
+    def scat_fused(node):
+        flat = ((node[:, None] * D + jnp.arange(D)[None, :]) * B
+                + Xb).reshape(-1)
+        gh = jnp.stack(
+            [jnp.broadcast_to(g[:, None], (ROWS, D)).reshape(-1),
+             jnp.broadcast_to(h[:, None], (ROWS, D)).reshape(-1)], axis=1)
+        out = jnp.zeros((N_NODES * D * B, 2), jnp.float32).at[flat].add(gh)
+        return out[0, 0] + out[1, 1]
+    res["scatter_fused_ms"] = round(med_fetch(scat_fused, nodes) * 1e3, 1)
+
+    # --- b) scalar scatter fed node-ORDERED input: measures only the
+    #        data-locality effect of sortedness (the flattened per-feature
+    #        indices are not globally sorted, so XLA's indices_are_sorted
+    #        fast path cannot legally be claimed here) ---
+    @jax.jit
+    def scat_one(node):
+        flat = ((node[:, None] * D + jnp.arange(D)[None, :]) * B
+                + Xb).reshape(-1)
+        hg = jnp.zeros(N_NODES * D * B, jnp.float32).at[flat].add(
+            jnp.broadcast_to(g[:, None], (ROWS, D)).reshape(-1))
+        return hg[0]
+    res["scatter_1x_ms"] = round(med_fetch(scat_one, nodes) * 1e3, 1)
+    res["scatter_1x_nodeorder_ms"] = round(
+        med_fetch(scat_one, nodes_sorted) * 1e3, 1)
+
+    # --- c) argsort entry fee ---
+    @jax.jit
+    def asort(node):
+        return jnp.argsort(node)[:1]
+    res["argsort_ms"] = round(med_fetch(asort, nodes) * 1e3, 1)
+
+    # --- d) row permute ---
+    perm = jnp.argsort(nodes[0][0])
+
+    @jax.jit
+    def rperm(p):
+        return Xb[p][0, :1]
+    res["rowperm_ms"] = round(med_fetch(rperm, [(perm,)] * 2) * 1e3, 1)
+
+    # --- e) cumsum-hist, one feature then extrapolate x28 ---
+    starts = jnp.asarray(
+        np.searchsorted(np.sort(np.asarray(nodes[0][0])),
+                        np.arange(N_NODES)), jnp.int32)
+
+    @jax.jit
+    def cumhist1(node_sorted):
+        xb0 = Xb[:, 0]
+        oh = (xb0[:, None] == jnp.arange(B)[None, :]).astype(jnp.float32)
+        c = jnp.cumsum(oh * g[:, None], axis=0)          # [n, B]
+        ends = jnp.concatenate([starts[1:], jnp.asarray([ROWS])]) - 1
+        seg = c[ends] - jnp.where(starts[:, None] > 0, c[starts - 1], 0.0)
+        return seg[0, 0]
+    res["cumsum_hist_1feat_ms"] = round(
+        med_fetch(cumhist1, nodes_sorted) * 1e3, 1)
+
+    # --- f) block-matmul hist: per-block bin one-hot contraction + small
+    #        per-block scatter of [d*B] partials into straddled nodes ---
+    C = 512
+    nb = ROWS // C
+
+    @jax.jit
+    def blockmm(node_sorted):
+        xb_b = Xb[:nb * C].reshape(nb, C, D)
+        gb = g[:nb * C].reshape(nb, C)
+        oh = (xb_b[..., None] == jnp.arange(B)[None, None, None, :]
+              ).astype(jnp.bfloat16)                       # [nb, C, D, B]
+        part = jnp.einsum("bc,bcdk->bdk", gb.astype(jnp.bfloat16), oh,
+                          preferred_element_type=jnp.float32)  # [nb, D, B]
+        # per-block node id (blocks straddling a boundary handled by a
+        # second partial in the real impl; timing uses the dominant term)
+        bn = node_sorted[::C][:nb]
+        hist = jnp.zeros((N_NODES, D, B), jnp.float32).at[bn].add(part)
+        return hist[0, 0, 0]
+    res["blockmm_hist_ms"] = round(med_fetch(blockmm, nodes_sorted) * 1e3, 1)
+
+    print("CALIBRATE3 " + json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""On-chip A/B: gather formulations for tree routing.
+
+tpu_tree_bisect showed one level's routing (feat[node] 1D gather +
+Xb[rows, f] 2D gather) costs ~72 ms at 100k rows — the entire level
+wall. This splits the two gathers and times gather-free alternatives:
+one-hot compare+select+reduce over the d=28 feature axis (routing) and
+over node tables (lookup). Usage: python scripts/tpu_gather_bisect.py
+
+CAVEAT: fenced with block_until_ready, which on axon returns at enqueue
+time — sub-ms results are artifacts and identical-input repeats could in
+principle be cache hits. The ~60-90 ms results agreed with the
+host-fetch-fenced tpu_calibrate2/3 numbers; trust those, and use
+benchmarks/_timing.med_fetch for new measurements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS = int(os.environ.get("BISECT_ROWS", 100_000))
+D = 28
+REPEATS = 5
+
+
+def med(fn, *args):
+    import jax
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.integers(0, 64, size=(ROWS, D)), jnp.int32)
+    node = jnp.asarray(rng.integers(0, 64, size=ROWS), jnp.int32)
+    feat = jnp.asarray(rng.integers(0, D, size=64), jnp.int32)
+    rows = jnp.arange(ROWS)
+    res = {"rows": ROWS, "platform": jax.devices()[0].platform}
+
+    @jax.jit
+    def table_gather(node, feat):          # feat[node]: [n] from 64-table
+        return feat[node]
+    res["table_gather_ms"] = round(med(table_gather, node, feat) * 1e3, 2)
+
+    @jax.jit
+    def table_onehot(node, feat):          # one-hot contraction over 64
+        sel = node[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, 64), 1)
+        return jnp.sum(jnp.where(sel, feat[None, :], 0), axis=1)
+    res["table_onehot_ms"] = round(med(table_onehot, node, feat) * 1e3, 2)
+
+    f_row = jnp.asarray(rng.integers(0, D, size=ROWS), jnp.int32)
+
+    @jax.jit
+    def row_gather(f_row):                 # Xb[rows, f]: per-row column
+        return Xb[rows, f_row]
+    res["row_gather_ms"] = round(med(row_gather, f_row) * 1e3, 2)
+
+    @jax.jit
+    def row_take_along(f_row):
+        return jnp.take_along_axis(Xb, f_row[:, None], axis=1)[:, 0]
+    res["row_take_along_ms"] = round(med(row_take_along, f_row) * 1e3, 2)
+
+    @jax.jit
+    def row_onehot(f_row):                 # compare+select+reduce over d
+        sel = f_row[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, D), 1)
+        return jnp.sum(jnp.where(sel, Xb, 0), axis=1)
+    res["row_onehot_ms"] = round(med(row_onehot, f_row) * 1e3, 2)
+
+    # fused level step (what grow_tree actually runs per level):
+    bins = jnp.asarray(rng.integers(0, 64, size=64), jnp.int32)
+
+    @jax.jit
+    def level_onehot(node, feat, bins):
+        nsel = node[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, 64), 1)
+        f_row = jnp.sum(jnp.where(nsel, feat[None, :], 0), axis=1)
+        b_row = jnp.sum(jnp.where(nsel, bins[None, :], 0), axis=1)
+        fsel = f_row[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, D), 1)
+        x_row = jnp.sum(jnp.where(fsel, Xb, 0), axis=1)
+        go_left = jnp.where(f_row < 0, True, x_row <= b_row)
+        return node * 2 + jnp.where(go_left, 0, 1).astype(jnp.int32)
+    res["level_onehot_ms"] = round(
+        med(level_onehot, node, feat, bins) * 1e3, 2)
+
+    # scatter hist (the flat-index scatter grow_tree uses), isolated
+    g = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+
+    @jax.jit
+    def hist_scatter(node, g):
+        flat = ((node[:, None] * D + jnp.arange(D)[None, :]) * 64
+                + Xb).reshape(-1)
+        return jnp.zeros(64 * D * 64, jnp.float32).at[flat].add(
+            jnp.broadcast_to(g[:, None], (ROWS, D)).reshape(-1))
+    res["hist_scatter_64n_ms"] = round(med(hist_scatter, node, g) * 1e3, 2)
+
+    print("GATHER_BISECT " + json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

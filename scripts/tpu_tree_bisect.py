@@ -1,0 +1,110 @@
+"""On-chip bisect: which component of train_ensemble eats the wall?
+
+CAVEAT (learned from this script's own output): block_until_ready is not
+a real fence on the axon backend, so SUB-MILLISECOND numbers here are
+enqueue artifacts (the "0.06 ms" calibration matmul is the tell). The
+multi-hundred-ms numbers are real — dispatch backpressure makes the
+enqueue block on prior work — and they matched the host-fetch-fenced
+re-measurements in tpu_calibrate2/3. Use benchmarks/_timing.med_fetch
+for anything new. Usage: python scripts/tpu_tree_bisect.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS = int(os.environ.get("BISECT_ROWS", 100_000))
+D = 28
+B = 64
+REPEATS = 3
+
+
+def med(fn, *args):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.trees import (
+        bin_data, grow_tree, quantile_bin_edges, train_ensemble,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(ROWS, D)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    edges = quantile_bin_edges(X, B)
+    Xb = jnp.asarray(bin_data(jnp.asarray(X), jnp.asarray(edges)))
+    g = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.2, 1.0, size=ROWS).astype(np.float32))
+    node = jnp.asarray(rng.integers(0, 64, size=ROWS), jnp.int32)
+    rows = jnp.arange(ROWS)
+    ones = jnp.ones(ROWS, jnp.float32)
+    fmask = jnp.ones(D, jnp.float32)
+
+    res = {"rows": ROWS, "platform": jax.devices()[0].platform}
+
+    # calibration: known-FLOPs matmul (4096^3 f32 = 137 GFLOP)
+    A = jnp.asarray(rng.normal(size=(4096, 4096)).astype(np.float32))
+    mm = jax.jit(lambda a: a @ a)
+    res["matmul_4096_ms"] = round(med(mm, A) * 1e3, 3)
+
+    # poisson sampling at [n] (the RF bootstrap weights)
+    @jax.jit
+    def pois(k):
+        return jax.random.poisson(k, 1.0, (ROWS,))
+    res["poisson_ms"] = round(med(pois, jax.random.PRNGKey(0)) * 1e3, 3)
+
+    # per-level routing gather: Xb[rows, f[node]]
+    feat = jnp.asarray(rng.integers(0, D, size=64), jnp.int32)
+
+    @jax.jit
+    def route(node, feat):
+        f_row = feat[node]
+        x_row = Xb[rows, jnp.clip(f_row, 0)]
+        return node * 2 + jnp.where(x_row <= 32, 0, 1).astype(jnp.int32)
+    res["route_gather_ms"] = round(med(route, node, feat) * 1e3, 3)
+
+    # one full grow_tree at depth 6 / depth 12
+    for depth in (6, 12):
+        fn = functools.partial(grow_tree, max_depth=depth, n_bins=B,
+                               reg_lambda=jnp.float32(1.0),
+                               gamma=jnp.float32(0.0),
+                               min_child_weight=jnp.float32(1.0))
+        t = med(lambda: fn(Xb, g, h, fmask))
+        res[f"grow_tree_d{depth}_ms"] = round(t * 1e3, 1)
+
+    # full 8-round ensembles: RF (bootstrap+poisson) vs GBT (no sampling)
+    def ens(bootstrap):
+        trees, gains = train_ensemble(
+            Xb, jnp.asarray(y), ones, n_rounds=8, max_depth=6, n_bins=B,
+            n_out=1, loss="squared", learning_rate=jnp.float32(1.0),
+            reg_lambda=jnp.float32(1.0), gamma=jnp.float32(0.0),
+            min_child_weight=jnp.float32(1.0), subsample=1.0, colsample=1.0,
+            base_score=jnp.float32(0.0), bootstrap=bootstrap, seed=3)
+        return trees
+    res["ensemble8_d6_rf_ms"] = round(med(lambda: ens(True)) * 1e3, 1)
+    res["ensemble8_d6_gbt_ms"] = round(med(lambda: ens(False)) * 1e3, 1)
+
+    print("BISECT " + json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
