@@ -1,0 +1,83 @@
+"""On-chip A/B: hist='sorted' vs hist='scatter' grow_tree / ensembles.
+
+Host-fetch fenced (benchmarks/_timing.py). Times one depth-6 and one
+depth-12 tree plus an 8-round ensemble at SORTED_ROWS (default 1M),
+both engines. Usage: python scripts/tpu_sorted_vs_scatter.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import numpy as np
+
+ROWS = int(os.environ.get("SORTED_ROWS", 1_000_000))
+D = 28
+B = 64
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from _timing import med_fetch
+    from transmogrifai_tpu.models.trees import (
+        bin_data, grow_tree, quantile_bin_edges, train_ensemble,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(ROWS, D)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    edges = quantile_bin_edges(X, B)
+    Xb = jnp.asarray(bin_data(jnp.asarray(X), jnp.asarray(edges)))
+    ones = jnp.ones(ROWS, jnp.float32)
+    mask = jnp.ones(D, jnp.float32)
+    res = {"rows": ROWS, "platform": jax.devices()[0].platform}
+
+    kw = dict(n_bins=B, reg_lambda=jnp.float32(1.0), gamma=jnp.float32(0.0),
+              min_child_weight=jnp.float32(1.0))
+
+    def gh_variants(k=4):
+        out = []
+        for _ in range(k):
+            g = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+            h = jnp.asarray(rng.uniform(0.2, 1.0, size=ROWS)
+                            .astype(np.float32))
+            out.append((g, h))
+        return out
+
+    for depth in (6, 12):
+        for mode in ("scatter", "sorted"):
+            def one(g, h, depth=depth, mode=mode):
+                f, b, l, gn, pr = grow_tree(Xb, g, h, mask, max_depth=depth,
+                                        hist=mode, **kw)
+                return l
+            t = med_fetch(one, gh_variants())
+            res[f"tree_d{depth}_{mode}_ms"] = round(t * 1e3, 1)
+
+    ekw = dict(n_rounds=8, max_depth=6, n_bins=B, n_out=1, loss="logistic",
+               learning_rate=jnp.float32(0.3), reg_lambda=jnp.float32(1.0),
+               gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0),
+               subsample=1.0, colsample=1.0, base_score=jnp.float32(0.0),
+               bootstrap=False)
+    yj = jnp.asarray(y)
+    for mode in ("scatter", "sorted"):
+        def ens(w, mode=mode):
+            # seed is static (recompiles); vary the traced weights instead
+            trees, gains = train_ensemble(Xb, yj, w, seed=3,
+                                          hist=mode, **ekw)
+            return gains
+        t = med_fetch(ens, [(ones * s,) for s in (1.0, 0.9, 0.8, 0.7)])
+        res[f"ens8_d6_{mode}_ms"] = round(t * 1e3, 1)
+
+    print("SORTED_VS_SCATTER " + json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,8 +44,8 @@ def test_grow_tree_pallas_path_matches():
     mask = jnp.ones(d, jnp.float32)
     kw = dict(max_depth=3, n_bins=B, reg_lambda=jnp.float32(1.0),
               gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0))
-    f1, b1, l1, g1 = grow_tree(Xb, grad, hess, mask, use_pallas=False, **kw)
-    f2, b2, l2, g2 = grow_tree(Xb, grad, hess, mask, use_pallas=True, **kw)
+    f1, b1, l1, g1, p1 = grow_tree(Xb, grad, hess, mask, use_pallas=False, **kw)
+    f2, b2, l2, g2, p2 = grow_tree(Xb, grad, hess, mask, use_pallas=True, **kw)
     for a, b in zip(f1, f2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(b1, b2):

@@ -141,9 +141,9 @@ def test_grow_tree_chunked_matches_full():
     mask = jnp.ones(d, jnp.float32)
     kw = dict(max_depth=depth, n_bins=B, reg_lambda=jnp.float32(1.0),
               gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0))
-    f1, b1, l1, g1 = grow_tree(Xb, grad, hess, mask, max_hist_nodes=1024,
+    f1, b1, l1, g1, p1 = grow_tree(Xb, grad, hess, mask, max_hist_nodes=1024,
                                **kw)
-    f2, b2, l2, g2 = grow_tree(Xb, grad, hess, mask, max_hist_nodes=4, **kw)
+    f2, b2, l2, g2, p2 = grow_tree(Xb, grad, hess, mask, max_hist_nodes=4, **kw)
     for a, b in zip(f1, f2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(b1, b2):
@@ -226,3 +226,78 @@ def test_gain_based_feature_importances():
     m2 = TreeEnsembleModel.from_config(model.config())
     m2.set_fitted_state(model.fitted_state())
     np.testing.assert_allclose(m2.feature_contributions(), imp, atol=1e-6)
+
+
+def test_grow_tree_sorted_matches_scatter():
+    """The sort-based MXU histogram path (hist='sorted') must grow the
+    same tree as the scatter path: identical split structure and equal
+    leaves/gains up to float summation order (on CPU both accumulate in
+    f32, so near-ties cannot flip)."""
+    from transmogrifai_tpu.models.trees import grow_tree
+    rng = np.random.default_rng(11)
+    n, d, B, depth = 3000, 7, 16, 6
+    Xb = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    grad = jnp.asarray(rng.normal(size=n), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.2, 1.0, size=n), jnp.float32)
+    mask = jnp.ones(d, jnp.float32)
+    kw = dict(max_depth=depth, n_bins=B, reg_lambda=jnp.float32(1.0),
+              gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0))
+    f1, b1, l1, g1, p1 = grow_tree(Xb, grad, hess, mask, hist="scatter", **kw)
+    f2, b2, l2, g2, p2 = grow_tree(Xb, grad, hess, mask, hist="sorted", **kw)
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_grow_tree_sorted_weighted_and_empty_nodes():
+    """Sorted path with zero-weight rows (fold masks / Poisson bootstrap
+    zeros) and empty deep nodes: leaves and histograms must treat weight-0
+    rows as present-but-weightless and empty segments as zeros."""
+    from transmogrifai_tpu.models.trees import grow_tree
+    rng = np.random.default_rng(12)
+    n, d, B, depth = 600, 4, 8, 6  # deep: many empty nodes at level 5
+    Xb = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    w = jnp.asarray((rng.uniform(size=n) < 0.6).astype(np.float32))
+    grad = jnp.asarray(rng.normal(size=n), jnp.float32) * w
+    hess = jnp.asarray(rng.uniform(0.2, 1.0, size=n), jnp.float32) * w
+    mask = jnp.ones(d, jnp.float32)
+    kw = dict(max_depth=depth, n_bins=B, reg_lambda=jnp.float32(1.0),
+              gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0))
+    f1, b1, l1, g1, p1 = grow_tree(Xb, grad, hess, mask, hist="scatter", **kw)
+    f2, b2, l2, g2, p2 = grow_tree(Xb, grad, hess, mask, hist="sorted", **kw)
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-4)
+
+
+def test_train_ensemble_sorted_multiclass_parity():
+    """hist='sorted' must thread through the scanned ensemble under the
+    multiclass vmap (per-class independent routing) and bootstrap."""
+    from transmogrifai_tpu.models.trees import (
+        bin_data, predict_ensemble, quantile_bin_edges, train_ensemble,
+    )
+    rng = np.random.default_rng(13)
+    n, d = 2500, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64) + (X[:, 1] > 0.5)
+    edges = quantile_bin_edges(X, 16)
+    Xb = bin_data(jnp.asarray(X), jnp.asarray(edges))
+    yj = jnp.asarray(y)
+    w = jnp.ones_like(yj)
+    kw = dict(n_rounds=5, max_depth=4, n_bins=16, n_out=3,
+              loss="squared_onehot", learning_rate=jnp.float32(1.0),
+              reg_lambda=jnp.float32(1e-3), gamma=jnp.float32(0.0),
+              min_child_weight=jnp.float32(1.0), subsample=1.0,
+              colsample=1.0, base_score=jnp.float32(0.0), bootstrap=True,
+              seed=9)
+    t1, g1 = train_ensemble(Xb, yj, w, hist="scatter", **kw)
+    t2, g2 = train_ensemble(Xb, yj, w, hist="sorted", **kw)
+    p1 = predict_ensemble(Xb, t1, n_out=3, learning_rate=jnp.float32(1.0),
+                          base_score=jnp.float32(0.0), bootstrap=True)
+    p2 = predict_ensemble(Xb, t2, n_out=3, learning_rate=jnp.float32(1.0),
+                          base_score=jnp.float32(0.0), bootstrap=True)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-4)

@@ -100,6 +100,33 @@ def _use_pallas_default() -> bool:
         and jax.default_backend() == "tpu"
 
 
+def _hist_mode_for(Xb) -> str:
+    """Static histogram-engine choice for a fit: the sorted MXU path for
+    large single-shard matrices (on-chip shootout: ~7x/level at 1M rows,
+    scripts/tpu_calibrate3.py), the scatter path for small fits and for
+    sharded inputs (whose per-shard scatters GSPMD all-reduces — the
+    sorted path's global sort bookkeeping would generate cross-shard
+    collectives instead). Overridable via TRANSMOGRIFAI_TREE_HIST."""
+    import os
+    forced = os.environ.get("TRANSMOGRIFAI_TREE_HIST")
+    if forced:
+        if forced not in ("scatter", "sorted"):
+            raise ValueError(
+                f"TRANSMOGRIFAI_TREE_HIST={forced!r}: expected 'scatter' "
+                "or 'sorted'")
+        return forced
+    try:
+        single = len(Xb.devices()) == 1
+    except Exception:
+        single = True
+    # auto-select only on TPU: the einsum path trades ~B-times more
+    # (MXU-friendly) FLOPs for the serialized scatter, a trade validated
+    # on-chip; CPU/GPU keep the scatter path unless forced
+    return "sorted" if (Xb.shape[0] >= _SORT_MIN_ROWS and single
+                        and jax.default_backend() == "tpu") \
+        else "scatter"
+
+
 #: deepest level the Pallas kernel covers: Mosaic's 8-sublane feature tile
 #: puts the one-hot at [8, n_nodes*B*_CHUNK] floats in VMEM — beyond 8
 #: nodes at 64 bins that exceeds the budget; deeper levels take the scatter
@@ -110,6 +137,209 @@ _PALLAS_MAX_NODES = 8
 #: At the default (1024, d=28, B=64) that is ~14 MB; levels with more nodes
 #: compute best-splits chunk-by-chunk so HBM stays bounded at any depth.
 _MAX_HIST_NODES = 1024
+
+#: sorted-histogram path: rows per MXU contraction block. Host-fenced chip
+#: measurements (scripts/tpu_calibrate3.py, 1M x 28 x 64): the scatter-add
+#: histogram costs ~540 ms/level (serialized, ~0.9 GB/s) while the sorted
+#: block one-hot contraction runs the same level in ~80 ms and its cost is
+#: INDEPENDENT of the node count, so deep levels stop needing chunking.
+_SORT_BLOCK = 256
+#: byte budget for the materialized one-hot chunk ([blocks, C, d, B] bf16)
+_SORT_OH_BUDGET = 192 * 1024 * 1024
+#: row threshold above which single-device fits switch to the sorted path
+#: (below it the scatter path's lower fixed cost wins and stays the
+#: well-trodden mesh/GSPMD route)
+_SORT_MIN_ROWS = 150_000
+
+
+def _pow2_at_most(x: int) -> int:
+    return 1 << (max(int(x), 1).bit_length() - 1)
+
+
+def _sorted_layout(counts, n: int, C: int):
+    """Padded block layout for rows grouped by node.
+
+    ``counts``: [N] rows per node (sorted-order segments). Every node's
+    segment is padded to a multiple of the block size ``C`` so each
+    C-row block belongs to exactly one node; total padded length is the
+    static ``ceil(n/C)*C + N*C``. Returns (snode, valid, src_sorted,
+    pstarts, pends, pcounts, nb) where ``src_sorted`` maps padded slots
+    to sorted-row positions and ``valid`` masks the real rows.
+    """
+    N = counts.shape[0]
+    ends = jnp.cumsum(counts)
+    starts = ends - counts
+    pcounts = ((counts + C - 1) // C) * C
+    pends = jnp.cumsum(pcounts)
+    pstarts = pends - pcounts
+    n_pad = (-(-n // C)) * C + N * C
+    nb = n_pad // C
+    block_first = jnp.arange(nb, dtype=jnp.int32) * C
+    bnode = jnp.clip(jnp.searchsorted(pends, block_first, side="right"),
+                     0, N - 1).astype(jnp.int32)
+    snode = jnp.repeat(bnode, C, total_repeat_length=n_pad)
+    slot = jnp.arange(n_pad, dtype=jnp.int32)
+    within = slot - pstarts[snode]
+    valid = (within >= 0) & (within < counts[snode])
+    src_sorted = jnp.clip(starts[snode] + within, 0, max(n - 1, 0))
+    return snode, valid, src_sorted, pstarts, pends, pcounts, nb
+
+
+def _sorted_hist(Xp, gp, hp, layout, *, n_bins: int, C: int, acc_dtype):
+    """[N, d, B] grad/hess histograms from the padded block layout.
+
+    Per block: a [C, d*B] bin one-hot contracted with the [C, 2] (g, h)
+    rows on the MXU; per-node totals come from a block-axis cumsum and
+    one boundary diff per node — no scatter anywhere, and the work is
+    proportional to padded rows, not nodes.
+    """
+    snode, valid, src_sorted, pstarts, pends, pcounts, nb = layout
+    counts_pos = pcounts > 0
+    n_pad, d = Xp.shape
+    B = n_bins
+    Xpb = Xp.reshape(nb, C, d)
+    ghb = jnp.stack([gp, hp], axis=-1).reshape(nb, C, 2).astype(acc_dtype)
+    rows_per_chunk = max(C, _SORT_OH_BUDGET // (2 * d * B))
+    cb = max(1, rows_per_chunk // C)
+    n_chunks = -(-nb // cb)
+    if n_chunks * cb != nb:
+        pad = n_chunks * cb - nb
+        Xpb = jnp.concatenate(
+            [Xpb, jnp.zeros((pad, C, d), Xpb.dtype)])
+        ghb = jnp.concatenate(
+            [ghb, jnp.zeros((pad, C, 2), ghb.dtype)])
+    iota_b = jnp.arange(B, dtype=jnp.int32).astype(Xpb.dtype)
+
+    def chunk_part(args):
+        xc, gc = args
+        oh = (xc[..., None] == iota_b).astype(acc_dtype)  # [cb, C, d, B]
+        return jnp.einsum("bcs,bcdk->bsdk", gc, oh,
+                          preferred_element_type=jnp.float32)
+
+    part = jax.lax.map(chunk_part,
+                       (Xpb.reshape(n_chunks, cb, C, d),
+                        ghb.reshape(n_chunks, cb, C, 2)))
+    part = part.reshape(n_chunks * cb, 2, d, B)[:nb]
+    bc = jnp.cumsum(part, axis=0)
+    firstb = (pstarts // C).astype(jnp.int32)
+    lastb = jnp.clip(pends // C - 1, 0, nb - 1)
+    upper = bc[lastb]
+    lower = jnp.where((firstb > 0)[:, None, None, None],
+                      bc[jnp.clip(firstb - 1, 0, nb - 1)], 0.0)
+    hist = jnp.where(counts_pos[:, None, None, None], upper - lower, 0.0)
+    return hist[:, 0], hist[:, 1]
+
+
+def _sorted_partition(counts, layout, go_left, src_row, n: int):
+    """Stable in-segment partition: the next level's ``order`` groups rows
+    by ``2*node + go_right`` using cumsums and one unique-index scatter —
+    the incremental analog of re-sorting by node each level.
+    """
+    snode, valid, _, pstarts, pends, pcounts, _ = layout
+    n_pad = snode.shape[0]
+    N = counts.shape[0]
+    glv = (go_left & valid).astype(jnp.int32)
+    grv = ((~go_left) & valid).astype(jnp.int32)
+    cl = jnp.cumsum(glv)
+    cr = jnp.cumsum(grv)
+    pfirst = jnp.clip(pstarts - 1, 0, n_pad - 1)
+    plast = jnp.clip(pends - 1, 0, n_pad - 1)
+    base_l = jnp.where(pstarts > 0, cl[pfirst], 0)
+    base_r = jnp.where(pstarts > 0, cr[pfirst], 0)
+    nl = jnp.where(pcounts > 0, cl[plast] - base_l, 0)
+    new_counts = jnp.stack([nl, counts - nl], axis=1).reshape(2 * N)
+    new_ends = jnp.cumsum(new_counts)
+    new_starts = new_ends - new_counts
+    pl = cl - glv - base_l[snode]
+    pr = cr - grv - base_r[snode]
+    dest = jnp.where(go_left, new_starts[2 * snode] + pl,
+                     new_starts[2 * snode + 1] + pr)
+    # invalid slots get DISTINCT out-of-range sentinels (n + slot) so the
+    # unique_indices promise stays true even for dropped updates
+    dest = jnp.where(valid, dest, n + jnp.arange(n_pad, dtype=jnp.int32))
+    new_order = jnp.zeros(n, jnp.int32).at[dest].set(
+        src_row, mode="drop", unique_indices=True)
+    return new_order, new_counts
+
+
+def _segment_sums(vals_sorted, counts):
+    """[N] per-segment sums of an [n] array laid out in segment order,
+    via one cumsum + boundary diffs (no scatter)."""
+    n = vals_sorted.shape[0]
+    ends = jnp.cumsum(counts)
+    starts = ends - counts
+    c = jnp.cumsum(vals_sorted)
+    upper = c[jnp.clip(ends - 1, 0, max(n - 1, 0))]
+    lower = jnp.where(starts > 0, c[jnp.clip(starts - 1, 0, max(n - 1, 0))],
+                      0.0)
+    return jnp.where(counts > 0, upper - lower, 0.0)
+
+
+def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
+                      n_bins: int, reg_lambda, gamma, min_child_weight,
+                      block: int = _SORT_BLOCK):
+    """Sort-based level-wise histogram tree (single-shard hot path).
+
+    Same contract as the scatter-path ``grow_tree`` body: returns
+    (feats, bins, leaf_values, feat_gain, row_pred). Maintains ``order``
+    (row ids
+    grouped by node) and per-node ``counts`` across levels so each level
+    runs: one int8 row gather into the padded block layout, one MXU
+    one-hot contraction for ALL (node, feature, bin) histograms, a
+    cumsum boundary diff, and a cumsum-based stable partition. No
+    scatter-adds and no node-count-dependent chunking (see
+    scripts/tpu_calibrate3.py for the on-chip shootout this encodes).
+    """
+    n, d = Xb.shape
+    B = n_bins
+    # bin codes are < B; pack to the narrowest gatherable int so the
+    # per-level row gather moves 4x fewer bytes
+    Xb_n = Xb.astype(jnp.int8) if B <= 127 else Xb.astype(jnp.int32)
+    acc_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
+        else jnp.float32
+    split_kw = dict(n_bins=B, reg_lambda=reg_lambda, gamma=gamma,
+                    min_child_weight=min_child_weight)
+    order = jnp.arange(n, dtype=jnp.int32)
+    counts = jnp.full((1,), n, jnp.int32)
+    feats_out, bins_out = [], []
+    feat_gain = jnp.zeros(d, jnp.float32)
+    for level in range(max_depth):
+        N = 2 ** level
+        C = min(block, _pow2_at_most(max(n // (2 * N), 8)))
+        layout = _sorted_layout(counts, n, C)
+        snode, valid, src_sorted, *_ = layout
+        src_row = order[src_sorted]
+        Xp = Xb_n[src_row]
+        vf = valid.astype(grad.dtype)
+        gp = grad[src_row] * vf
+        hp = hess[src_row] * vf
+        hist_g, hist_h = _sorted_hist(Xp, gp, hp, layout, n_bins=B, C=C,
+                                      acc_dtype=acc_dtype)
+        feat, bin_, gain = _best_splits(hist_g, hist_h, feat_mask,
+                                        **split_kw)
+        feats_out.append(feat)
+        bins_out.append(bin_)
+        feat_gain = feat_gain.at[jnp.clip(feat, 0)].add(gain)
+        fp = feat[snode]
+        bp = bin_[snode]
+        xp = jnp.take_along_axis(
+            Xp, jnp.clip(fp, 0)[:, None].astype(jnp.int32),
+            axis=1)[:, 0].astype(jnp.int32)
+        go_left = jnp.where(fp < 0, True, xp <= bp)
+        order, counts = _sorted_partition(counts, layout, go_left,
+                                          src_row, n)
+    leaf_g = _segment_sums(grad[order], counts)
+    leaf_h = _segment_sums(hess[order], counts)
+    leaf_values = -leaf_g / (leaf_h + reg_lambda)
+    # per-row predictions from the maintained segment order: leaf value of
+    # each sorted row, scattered back to original row ids (unique indices)
+    ends = jnp.cumsum(counts)
+    snode_final = jnp.searchsorted(ends, jnp.arange(n), side="right"
+                                   ).astype(jnp.int32)
+    row_pred = jnp.zeros(n, leaf_values.dtype).at[order].set(
+        leaf_values[snode_final], unique_indices=True)
+    return tuple(feats_out), tuple(bins_out), leaf_values, feat_gain, \
+        row_pred
 
 
 def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
@@ -145,22 +375,43 @@ def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
-                                             "use_pallas", "max_hist_nodes"))
+                                             "use_pallas", "max_hist_nodes",
+                                             "hist"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
               reg_lambda, gamma, min_child_weight, use_pallas: bool = False,
-              max_hist_nodes: int = _MAX_HIST_NODES):
-    """Level-wise histogram tree. Returns (feats, bins, leaf_values) where
-    feats/bins are tuples of per-level [2^level] arrays and leaf_values is
-    [2^max_depth]. grad/hess already carry row weights.
+              max_hist_nodes: int = _MAX_HIST_NODES, hist: str = "scatter"):
+    """Level-wise histogram tree. Returns (feats, bins, leaf_values,
+    feat_gain, row_pred): feats/bins are tuples of per-level [2^level]
+    arrays, leaf_values is [2^max_depth], feat_gain is the [d] per-feature
+    split-gain total, and row_pred is each training row's leaf value (so
+    boosting loops skip the re-descent). grad/hess already carry row
+    weights.
+
+    ``hist`` selects the histogram engine:
+
+    - ``"scatter"`` (default): flat-index scatter-adds — the GSPMD-safe
+      path (per-shard scatters + XLA-inserted psum under a mesh) and the
+      cheapest at small n.
+    - ``"sorted"``: the sort-based MXU path (``_grow_tree_sorted``) —
+      ~7x faster per level on the real chip at 1M rows and node-count
+      independent; meant for large single-shard fits (the bench path).
 
     Memory discipline for deep trees (reference RF default depth=12,
-    README.md:60-80): while a level's [nodes, d, B] histograms fit
-    ``max_hist_nodes`` they are materialized once and the level uses the
-    classic sibling-subtraction trick — only LEFT children are scattered,
-    right = parent - left, halving scatter work; deeper levels switch to a
-    ``lax.map`` over node chunks that keeps only per-node split decisions,
-    so peak HBM is O(max_hist_nodes * d * B) at any depth.
+    README.md:60-80) on the scatter path: while a level's [nodes, d, B]
+    histograms fit ``max_hist_nodes`` they are materialized once and the
+    level uses the classic sibling-subtraction trick — only LEFT children
+    are scattered, right = parent - left, halving scatter work; deeper
+    levels switch to a ``lax.map`` over node chunks that keeps only
+    per-node split decisions, so peak HBM stays O(max_hist_nodes * d * B)
+    at any depth. The sorted path needs neither trick.
     """
+    if hist == "sorted":
+        return _grow_tree_sorted(
+            Xb, grad, hess, feat_mask, max_depth=max_depth, n_bins=n_bins,
+            reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight)
+    if hist != "scatter":
+        raise ValueError(f"hist={hist!r}: expected 'scatter' or 'sorted'")
     from transmogrifai_tpu.ops.histogram_pallas import (
         node_bin_histogram, node_bin_histogram_xla,
     )
@@ -241,7 +492,11 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
     leaf_g = jnp.zeros(n_leaves, jnp.float32).at[node].add(grad)
     leaf_h = jnp.zeros(n_leaves, jnp.float32).at[node].add(hess)
     leaf_values = -leaf_g / (leaf_h + reg_lambda)
-    return tuple(feats_out), tuple(bins_out), leaf_values, feat_gain
+    # training-row predictions come free from the final node assignment —
+    # the boosting loop must not pay a full re-descent (d more gathers)
+    row_pred = leaf_values[node]
+    return tuple(feats_out), tuple(bins_out), leaf_values, feat_gain, \
+        row_pred
 
 
 def predict_tree(Xb, feats, bins, leaf_values):
@@ -263,12 +518,14 @@ def predict_tree(Xb, feats, bins, leaf_values):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
-    "bootstrap", "subsample", "colsample", "use_pallas", "max_hist_nodes"))
+    "bootstrap", "subsample", "colsample", "use_pallas", "max_hist_nodes",
+    "hist"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
                    bootstrap: bool, seed: int, use_pallas: bool = False,
-                   max_hist_nodes: int = _MAX_HIST_NODES):
+                   max_hist_nodes: int = _MAX_HIST_NODES,
+                   hist: str = "scatter"):
     """Train a whole ensemble in one scanned program.
 
     loss: 'logistic' (n_out=1), 'softmax' (n_out=K one-vs-all), 'squared'.
@@ -320,12 +577,13 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                              reg_lambda=reg_lambda, gamma=gamma,
                              min_child_weight=min_child_weight,
                              use_pallas=use_pallas,
-                             max_hist_nodes=max_hist_nodes)
+                             max_hist_nodes=max_hist_nodes, hist=hist)
 
-        feats, bins, leaves, gains = jax.vmap(grow_one, in_axes=(1, 1))(g, h)
-        # feats/bins: tuples of [n_out, 2^level]; leaves [n_out, 2^depth]
-        preds = jax.vmap(lambda f, b, l: predict_tree(Xb, f, b, l))(
-            feats, bins, leaves)  # [n_out, n]
+        feats, bins, leaves, gains, preds = jax.vmap(
+            grow_one, in_axes=(1, 1))(g, h)
+        # feats/bins: tuples of [n_out, 2^level]; leaves [n_out, 2^depth];
+        # preds [n_out, n] come from the grower's final node assignment
+        # (no re-descent)
         if bootstrap:
             new_margin = margin  # forest trees are independent
         else:
@@ -544,11 +802,20 @@ class _TreePredictor(Predictor):
         n, d = int(Xb.shape[0]), int(Xb.shape[1])
         depth, rounds, B = int(p["max_depth"]), int(p["num_rounds"]), \
             int(p["max_bins"])
-        # per level: flat-index + 2 scatter adds ~5nd update ops, routing
-        # ~4n, split eval ~12*nodes*d*B; device update-ops, not MXU FLOPs —
-        # histogram work is bandwidth-bound (see utils/flops.py docstring)
-        per_tree = sum(5.0 * n * d + 4.0 * n + 12.0 * (2 ** lv) * d * B
-                       for lv in range(depth))
+        hist_mode = _hist_mode_for(Xb)
+        if hist_mode == "sorted":
+            # per level: padded-row one-hot contraction 4*n*d*B MXU MACs
+            # (g+h stats) + layout/partition cumsums ~10n + split eval
+            per_tree = sum(4.0 * n * d * B + 10.0 * n
+                           + 12.0 * (2 ** lv) * d * B
+                           for lv in range(depth))
+        else:
+            # per level: flat-index + 2 scatter adds ~5nd update ops,
+            # routing ~4n, split eval ~12*nodes*d*B; device update-ops,
+            # not MXU FLOPs — scatter histogram work is bandwidth-bound
+            # (see utils/flops.py docstring)
+            per_tree = sum(5.0 * n * d + 4.0 * n + 12.0 * (2 ** lv) * d * B
+                           for lv in range(depth))
         flops.add("tree", rounds * n_out * per_tree)
         trees, gains = train_ensemble(
             Xb, y, w,
@@ -563,7 +830,8 @@ class _TreePredictor(Predictor):
             base_score=jnp.float32(base),
             bootstrap=self.bootstrap, seed=int(p["seed"]),
             use_pallas=_use_pallas_default(),
-            max_hist_nodes=_MAX_HIST_NODES)
+            max_hist_nodes=_MAX_HIST_NODES,
+            hist=hist_mode)
         model = TreeEnsembleModel(
             kind=self.kind, n_out=n_out,
             learning_rate=float(p["learning_rate"]), base_score=base,
