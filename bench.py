@@ -377,6 +377,15 @@ def main():
     if accel_env is not None:
         accel = _run_child(N_ROWS, accel_env, "accel measurement",
                            trace=True)
+        if accel is None:
+            # the worker can crash mid-measurement (tunnel flake / device
+            # fault); the sweep checkpoints per fold, so one retry is
+            # cheap — it reprobes (the crash may have killed the backend)
+            # and resumes from the checkpoint instead of restarting
+            if _probe_backend(accel_env, "post-crash reprobe",
+                              timeout=120) is not None:
+                accel = _run_child(N_ROWS, accel_env,
+                                   "accel measurement (retry)", trace=True)
         if accel is not None:
             def curve_point(rows: int, r: dict) -> dict:
                 # a resumed (partial-wall) point must never look like a

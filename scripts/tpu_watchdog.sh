@@ -1,0 +1,42 @@
+#!/bin/bash
+# TPU tunnel watchdog: probe every PROBE_INTERVAL seconds; on revival run
+# the chip runlist (headline bench @ 4M + 1M/2M curve, then the fenced
+# pallas-hist decision microbench, then the Criteo ingest probe) and exit.
+# Usage: bash scripts/tpu_watchdog.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_watchdog}
+mkdir -p "$LOG"
+PROBE_INTERVAL=${PROBE_INTERVAL:-180}
+
+probe() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jax.jit(lambda a: a * 2)(jnp.ones(8)); x.block_until_ready()
+assert d[0].platform == 'tpu', d
+print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK
+}
+
+echo "$(date -u +%FT%TZ) watchdog armed (interval ${PROBE_INTERVAL}s)" \
+  >> "$LOG/watchdog.log"
+while true; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) tunnel ALIVE — running chip runlist" \
+      >> "$LOG/watchdog.log"
+    rm -f /tmp/bench_probe_dead_* 2>/dev/null
+    BENCH_CHILD_TIMEOUT=4500 timeout 12000 python bench.py \
+      > "$LOG/bench.out" 2> "$LOG/bench.err"
+    echo "$(date -u +%FT%TZ) bench rc=$? artifact: $(tail -1 "$LOG/bench.out" | head -c 200)" \
+      >> "$LOG/watchdog.log"
+    timeout 3000 python benchmarks/bench_pallas_hist.py \
+      > "$LOG/pallas.out" 2> "$LOG/pallas.err"
+    echo "$(date -u +%FT%TZ) pallas rc=$?" >> "$LOG/watchdog.log"
+    timeout 3000 python benchmarks/bench_criteo_ingest.py \
+      > "$LOG/criteo.out" 2> "$LOG/criteo.err"
+    echo "$(date -u +%FT%TZ) criteo rc=$? — runlist done, disarming" \
+      >> "$LOG/watchdog.log"
+    break
+  fi
+  sleep "$PROBE_INTERVAL"
+done

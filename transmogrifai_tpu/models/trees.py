@@ -156,6 +156,28 @@ def _pow2_at_most(x: int) -> int:
     return 1 << (max(int(x), 1).bit_length() - 1)
 
 
+def _sorted_engine_default() -> str:
+    """Histogram contraction engine for the sorted path. The XLA einsum
+    is the measured winner ON CHIP (1M x 28 x 64, host-fenced: einsum
+    440/1300 ms for d6/d12 trees vs 521/1502 ms for the fused Pallas
+    kernel — per-grid-step overhead of 28 small dots x ~4k blocks beats
+    the one-hot HBM traffic it saves), so it is the default everywhere;
+    TRANSMOGRIFAI_SORTED_HIST=pallas opts into the kernel (A/B reruns).
+
+    Consulted ONCE per fit at Python level (fit_arrays) and threaded as
+    a STATIC argument — never read inside a traced function, where the
+    jit cache would silently pin the first value seen."""
+    import os
+    forced = os.environ.get("TRANSMOGRIFAI_SORTED_HIST")
+    if forced:
+        if forced not in ("einsum", "pallas"):
+            raise ValueError(
+                f"TRANSMOGRIFAI_SORTED_HIST={forced!r}: expected 'einsum' "
+                "or 'pallas'")
+        return forced
+    return "einsum"
+
+
 def _sorted_layout(counts, n: int, C: int):
     """Padded block layout for rows grouped by node.
 
@@ -185,42 +207,58 @@ def _sorted_layout(counts, n: int, C: int):
     return snode, valid, src_sorted, pstarts, pends, pcounts, nb
 
 
-def _sorted_hist(Xp, gp, hp, layout, *, n_bins: int, C: int, acc_dtype):
+def _sorted_hist(Xp, gp, hp, layout, *, n_bins: int, C: int, acc_dtype,
+                 engine: str = "einsum"):
     """[N, d, B] grad/hess histograms from the padded block layout.
 
     Per block: a [C, d*B] bin one-hot contracted with the [C, 2] (g, h)
     rows on the MXU; per-node totals come from a block-axis cumsum and
     one boundary diff per node — no scatter anywhere, and the work is
     proportional to padded rows, not nodes.
+
+    ``engine="pallas"`` runs the fused VMEM kernel
+    (``ops/sorted_hist_pallas.py``): the one-hot never reaches HBM and
+    the block cumsum is accumulated in scratch during the same pass.
+    ``"einsum"`` is the pure-XLA oracle (and the off-TPU default).
     """
     snode, valid, src_sorted, pstarts, pends, pcounts, nb = layout
     counts_pos = pcounts > 0
     n_pad, d = Xp.shape
     B = n_bins
     Xpb = Xp.reshape(nb, C, d)
-    ghb = jnp.stack([gp, hp], axis=-1).reshape(nb, C, 2).astype(acc_dtype)
-    rows_per_chunk = max(C, _SORT_OH_BUDGET // (2 * d * B))
-    cb = max(1, rows_per_chunk // C)
-    n_chunks = -(-nb // cb)
-    if n_chunks * cb != nb:
-        pad = n_chunks * cb - nb
-        Xpb = jnp.concatenate(
-            [Xpb, jnp.zeros((pad, C, d), Xpb.dtype)])
-        ghb = jnp.concatenate(
-            [ghb, jnp.zeros((pad, C, 2), ghb.dtype)])
-    iota_b = jnp.arange(B, dtype=jnp.int32).astype(Xpb.dtype)
+    if engine == "pallas":
+        from transmogrifai_tpu.ops.sorted_hist_pallas import (
+            sorted_block_hist,
+        )
+        ghb_k = jnp.stack([gp, hp]).reshape(2, nb, C).transpose(1, 0, 2)
+        part_k = sorted_block_hist(Xpb, ghb_k, n_bins=B
+                                   ).reshape(nb, 2, d, B)
+        bc = jnp.cumsum(part_k, axis=0)
+    else:
+        ghb = jnp.stack([gp, hp], axis=-1).reshape(nb, C, 2).astype(
+            acc_dtype)
+        rows_per_chunk = max(C, _SORT_OH_BUDGET // (2 * d * B))
+        cb = max(1, rows_per_chunk // C)
+        n_chunks = -(-nb // cb)
+        if n_chunks * cb != nb:
+            pad = n_chunks * cb - nb
+            Xpb = jnp.concatenate(
+                [Xpb, jnp.zeros((pad, C, d), Xpb.dtype)])
+            ghb = jnp.concatenate(
+                [ghb, jnp.zeros((pad, C, 2), ghb.dtype)])
+        iota_b = jnp.arange(B, dtype=jnp.int32).astype(Xpb.dtype)
 
-    def chunk_part(args):
-        xc, gc = args
-        oh = (xc[..., None] == iota_b).astype(acc_dtype)  # [cb, C, d, B]
-        return jnp.einsum("bcs,bcdk->bsdk", gc, oh,
-                          preferred_element_type=jnp.float32)
+        def chunk_part(args):
+            xc, gc = args
+            oh = (xc[..., None] == iota_b).astype(acc_dtype)
+            return jnp.einsum("bcs,bcdk->bsdk", gc, oh,
+                              preferred_element_type=jnp.float32)
 
-    part = jax.lax.map(chunk_part,
-                       (Xpb.reshape(n_chunks, cb, C, d),
-                        ghb.reshape(n_chunks, cb, C, 2)))
-    part = part.reshape(n_chunks * cb, 2, d, B)[:nb]
-    bc = jnp.cumsum(part, axis=0)
+        part = jax.lax.map(chunk_part,
+                           (Xpb.reshape(n_chunks, cb, C, d),
+                            ghb.reshape(n_chunks, cb, C, 2)))
+        part = part.reshape(n_chunks * cb, 2, d, B)[:nb]
+        bc = jnp.cumsum(part, axis=0)
     firstb = (pstarts // C).astype(jnp.int32)
     lastb = jnp.clip(pends // C - 1, 0, nb - 1)
     upper = bc[lastb]
@@ -277,7 +315,8 @@ def _segment_sums(vals_sorted, counts):
 
 def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
                       n_bins: int, reg_lambda, gamma, min_child_weight,
-                      block: int = _SORT_BLOCK):
+                      block: int = _SORT_BLOCK,
+                      sorted_engine: str = "einsum"):
     """Sort-based level-wise histogram tree (single-shard hot path).
 
     Same contract as the scatter-path ``grow_tree`` body: returns
@@ -297,6 +336,7 @@ def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
     Xb_n = Xb.astype(jnp.int8) if B <= 127 else Xb.astype(jnp.int32)
     acc_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
         else jnp.float32
+    engine = sorted_engine
     split_kw = dict(n_bins=B, reg_lambda=reg_lambda, gamma=gamma,
                     min_child_weight=min_child_weight)
     order = jnp.arange(n, dtype=jnp.int32)
@@ -314,7 +354,7 @@ def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
         gp = grad[src_row] * vf
         hp = hess[src_row] * vf
         hist_g, hist_h = _sorted_hist(Xp, gp, hp, layout, n_bins=B, C=C,
-                                      acc_dtype=acc_dtype)
+                                      acc_dtype=acc_dtype, engine=engine)
         feat, bin_, gain = _best_splits(hist_g, hist_h, feat_mask,
                                         **split_kw)
         feats_out.append(feat)
@@ -376,10 +416,11 @@ def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
                                              "use_pallas", "max_hist_nodes",
-                                             "hist"))
+                                             "hist", "sorted_engine"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
               reg_lambda, gamma, min_child_weight, use_pallas: bool = False,
-              max_hist_nodes: int = _MAX_HIST_NODES, hist: str = "scatter"):
+              max_hist_nodes: int = _MAX_HIST_NODES, hist: str = "scatter",
+              sorted_engine: str = "einsum"):
     """Level-wise histogram tree. Returns (feats, bins, leaf_values,
     feat_gain, row_pred): feats/bins are tuples of per-level [2^level]
     arrays, leaf_values is [2^max_depth], feat_gain is the [d] per-feature
@@ -409,7 +450,7 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
         return _grow_tree_sorted(
             Xb, grad, hess, feat_mask, max_depth=max_depth, n_bins=n_bins,
             reg_lambda=reg_lambda, gamma=gamma,
-            min_child_weight=min_child_weight)
+            min_child_weight=min_child_weight, sorted_engine=sorted_engine)
     if hist != "scatter":
         raise ValueError(f"hist={hist!r}: expected 'scatter' or 'sorted'")
     from transmogrifai_tpu.ops.histogram_pallas import (
@@ -519,13 +560,13 @@ def predict_tree(Xb, feats, bins, leaf_values):
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
     "bootstrap", "subsample", "colsample", "use_pallas", "max_hist_nodes",
-    "hist"))
+    "hist", "sorted_engine"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
                    bootstrap: bool, seed: int, use_pallas: bool = False,
                    max_hist_nodes: int = _MAX_HIST_NODES,
-                   hist: str = "scatter"):
+                   hist: str = "scatter", sorted_engine: str = "einsum"):
     """Train a whole ensemble in one scanned program.
 
     loss: 'logistic' (n_out=1), 'softmax' (n_out=K one-vs-all), 'squared'.
@@ -577,7 +618,8 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                              reg_lambda=reg_lambda, gamma=gamma,
                              min_child_weight=min_child_weight,
                              use_pallas=use_pallas,
-                             max_hist_nodes=max_hist_nodes, hist=hist)
+                             max_hist_nodes=max_hist_nodes, hist=hist,
+                             sorted_engine=sorted_engine)
 
         feats, bins, leaves, gains, preds = jax.vmap(
             grow_one, in_axes=(1, 1))(g, h)
@@ -831,7 +873,7 @@ class _TreePredictor(Predictor):
             bootstrap=self.bootstrap, seed=int(p["seed"]),
             use_pallas=_use_pallas_default(),
             max_hist_nodes=_MAX_HIST_NODES,
-            hist=hist_mode)
+            hist=hist_mode, sorted_engine=_sorted_engine_default())
         model = TreeEnsembleModel(
             kind=self.kind, n_out=n_out,
             learning_rate=float(p["learning_rate"]), base_score=base,
