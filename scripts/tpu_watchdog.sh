@@ -29,9 +29,9 @@ while true; do
       > "$LOG/bench.out" 2> "$LOG/bench.err"
     echo "$(date -u +%FT%TZ) bench rc=$? artifact: $(tail -1 "$LOG/bench.out" | head -c 200)" \
       >> "$LOG/watchdog.log"
-    timeout 3000 python benchmarks/bench_pallas_hist.py \
-      > "$LOG/pallas.out" 2> "$LOG/pallas.err"
-    echo "$(date -u +%FT%TZ) pallas rc=$?" >> "$LOG/watchdog.log"
+    timeout 3000 python benchmarks/bench_hist_engines.py \
+      > "$LOG/hist_engines.out" 2> "$LOG/hist_engines.err"
+    echo "$(date -u +%FT%TZ) hist_engines rc=$?" >> "$LOG/watchdog.log"
     timeout 3000 python benchmarks/bench_criteo_ingest.py \
       > "$LOG/criteo.out" 2> "$LOG/criteo.err"
     echo "$(date -u +%FT%TZ) criteo rc=$? — runlist done, disarming" \

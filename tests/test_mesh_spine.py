@@ -247,7 +247,7 @@ def test_tree_histograms_row_sharded_parity(mesh8):
 
     # level-0 histograms: per-shard partials all-reduce to the same totals
     # (up to fp summation order)
-    from transmogrifai_tpu.ops.histogram_pallas import node_bin_histogram_xla
+    from transmogrifai_tpu.ops.histograms import node_bin_histogram_xla
     node0 = jnp.zeros(n, jnp.int32)
     g = yj.astype(jnp.float32)
     hg1, hh1 = node_bin_histogram_xla(Xb, node0, g, w.astype(jnp.float32),

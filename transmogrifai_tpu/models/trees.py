@@ -9,9 +9,15 @@ native engines with one device-resident histogram learner (SURVEY §2.7 P5):
 
 - features quantile-bin once into int32 codes (``max_bins``, default 64)
 - each tree level builds ALL (node, feature, bin) gradient/hessian
-  histograms in one scatter-add over the row-sharded binned matrix — the
-  analog of XGBoost's Rabit all-reduced per-worker histograms; under a mesh
-  the scatter runs per shard and the histogram psum rides ICI
+  histograms with one of two engines (``hist=``): the GSPMD-safe
+  scatter-add over the row-sharded binned matrix (the analog of XGBoost's
+  Rabit all-reduced per-worker histograms; under a mesh the scatter runs
+  per shard and the histogram psum rides ICI) or — the single-chip hot
+  path — the SORTED engine: rows kept grouped by node across levels,
+  node segments padded to block multiples, and the whole level computed
+  as blocked one-hot MXU contractions whose cost is independent of the
+  node count (host-fenced on chip: 5-7x faster per tree at 1M rows,
+  scripts/tpu_calibrate3.py + scripts/tpu_sorted_vs_scatter.py)
 - split choice is the XGBoost gain formula (lambda/gamma/min_child_weight)
   via cumulative sums along the bin axis; the whole ensemble trains inside
   one ``lax.scan`` jitted program (boosting) or a scanned loop of
@@ -90,16 +96,6 @@ def bin_data(X, edges):
 # single-tree growth (one jitted program per (n, d, depth, B) shape)
 # ---------------------------------------------------------------------------
 
-def _use_pallas_default() -> bool:
-    """Opt-in (TRANSMOGRIFAI_PALLAS_HIST=1) Pallas histogram path; the
-    scatter-add XLA path stays the default until the compiled kernel is
-    benchmarked faster on the target TPU generation. Interpret-mode parity
-    is covered by tests either way."""
-    import os
-    return os.environ.get("TRANSMOGRIFAI_PALLAS_HIST") == "1" \
-        and jax.default_backend() == "tpu"
-
-
 def _hist_mode_for(Xb) -> str:
     """Static histogram-engine choice for a fit: the sorted MXU path for
     large single-shard matrices (on-chip shootout: ~7x/level at 1M rows,
@@ -126,12 +122,6 @@ def _hist_mode_for(Xb) -> str:
                         and jax.default_backend() == "tpu") \
         else "scatter"
 
-
-#: deepest level the Pallas kernel covers: Mosaic's 8-sublane feature tile
-#: puts the one-hot at [8, n_nodes*B*_CHUNK] floats in VMEM — beyond 8
-#: nodes at 64 bins that exceeds the budget; deeper levels take the scatter
-#: path (measured ~parity on-chip anyway, histogram_pallas.py docstring)
-_PALLAS_MAX_NODES = 8
 
 #: histogram node budget per materialized array: [nodes, d, B] f32 x2 (g, h).
 #: At the default (1024, d=28, B=64) that is ~14 MB; levels with more nodes
@@ -415,10 +405,10 @@ def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
-                                             "use_pallas", "max_hist_nodes",
+                                             "max_hist_nodes",
                                              "hist", "sorted_engine"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
-              reg_lambda, gamma, min_child_weight, use_pallas: bool = False,
+              reg_lambda, gamma, min_child_weight,
               max_hist_nodes: int = _MAX_HIST_NODES, hist: str = "scatter",
               sorted_engine: str = "einsum"):
     """Level-wise histogram tree. Returns (feats, bins, leaf_values,
@@ -453,9 +443,7 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
             min_child_weight=min_child_weight, sorted_engine=sorted_engine)
     if hist != "scatter":
         raise ValueError(f"hist={hist!r}: expected 'scatter' or 'sorted'")
-    from transmogrifai_tpu.ops.histogram_pallas import (
-        node_bin_histogram, node_bin_histogram_xla,
-    )
+    from transmogrifai_tpu.ops.histograms import node_bin_histogram_xla
     n, d = Xb.shape
     B = n_bins
     # node counts are powers of two; round the budget down to one so the
@@ -466,9 +454,6 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
                     min_child_weight=min_child_weight)
 
     def hist_of(node_ids, g, h, n_nodes):
-        if use_pallas and n_nodes <= _PALLAS_MAX_NODES:
-            return node_bin_histogram(Xb, node_ids, g, h,
-                                      n_nodes=n_nodes, n_bins=B)
         return node_bin_histogram_xla(Xb, node_ids, g, h,
                                       n_nodes=n_nodes, n_bins=B)
 
@@ -559,12 +544,12 @@ def predict_tree(Xb, feats, bins, leaf_values):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
-    "bootstrap", "subsample", "colsample", "use_pallas", "max_hist_nodes",
+    "bootstrap", "subsample", "colsample", "max_hist_nodes",
     "hist", "sorted_engine"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
-                   bootstrap: bool, seed: int, use_pallas: bool = False,
+                   bootstrap: bool, seed: int,
                    max_hist_nodes: int = _MAX_HIST_NODES,
                    hist: str = "scatter", sorted_engine: str = "einsum"):
     """Train a whole ensemble in one scanned program.
@@ -617,7 +602,6 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                              max_depth=max_depth, n_bins=n_bins,
                              reg_lambda=reg_lambda, gamma=gamma,
                              min_child_weight=min_child_weight,
-                             use_pallas=use_pallas,
                              max_hist_nodes=max_hist_nodes, hist=hist,
                              sorted_engine=sorted_engine)
 
@@ -871,7 +855,6 @@ class _TreePredictor(Predictor):
             colsample=float(p["colsample"]),
             base_score=jnp.float32(base),
             bootstrap=self.bootstrap, seed=int(p["seed"]),
-            use_pallas=_use_pallas_default(),
             max_hist_nodes=_MAX_HIST_NODES,
             hist=hist_mode, sorted_engine=_sorted_engine_default())
         model = TreeEnsembleModel(

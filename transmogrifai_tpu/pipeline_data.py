@@ -146,8 +146,15 @@ class PipelineData:
             self._bulk_upload_numeric()
             return self.device[name]
         if kind == "vector":
-            dev = fr.VectorColumn(_shard(jnp.asarray(col.values, jnp.float32)),
-                                  col.meta)
+            # same chunked-transfer discipline as the numeric bulk path
+            # (wide pre-vectorized matrices are the other >GB upload);
+            # the mesh path still places in one transfer — chunked
+            # SHARDED placement is future work, and multi-chip meshes on
+            # this rig are CPU-virtual (no tunnel) anyway
+            vals = np.asarray(col.values, np.float32)
+            dval = _shard(vals) if pmesh.current_mesh() is not None \
+                else _upload_rows(vals)
+            dev = fr.VectorColumn(dval, col.meta)
             self.device[name] = dev
             return dev
         if kind in fr.TEXT_KINDS:
