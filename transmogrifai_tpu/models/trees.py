@@ -216,6 +216,8 @@ def _sorted_hist(Xp, gp, hp, layout, *, n_bins: int, C: int, acc_dtype,
     n_pad, d = Xp.shape
     B = n_bins
     Xpb = Xp.reshape(nb, C, d)
+    if engine == "pallas" and B > 256:
+        engine = "einsum"  # kernel's bf16 code broadcast is exact to 256
     if engine == "pallas":
         from transmogrifai_tpu.ops.sorted_hist_pallas import (
             sorted_block_hist,
