@@ -119,3 +119,27 @@ def test_histogram_quantiles_unit():
     assert q[0] <= q[1] <= q[2]
     empty = StreamingHistogram(max_bins=8)
     assert np.isnan(empty.quantiles(0.5)).all()
+
+
+def test_upload_rows_chunked_roundtrip(monkeypatch):
+    """_upload_rows must reassemble row chunks exactly (incl. a partial
+    last chunk) when the chunk budget forces splitting — the tunnel-crash
+    mitigation path (PERF.md round 5)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.pipeline_data import _upload_rows
+
+    monkeypatch.setenv("TRANSMOGRIFAI_UPLOAD_CHUNK_MB", "1")
+    rng = np.random.default_rng(3)
+    # 700k f32 = ~2.8 MB -> 3 chunks, last partial
+    arr = rng.normal(size=(700_000,)).astype(np.float32)
+    out = _upload_rows(arr)
+    np.testing.assert_array_equal(np.asarray(out), arr)
+    # 2D with uint8 (the mask path)
+    m = rng.integers(0, 2, size=(300_000, 7)).astype(np.uint8)
+    out2 = _upload_rows(m)
+    np.testing.assert_array_equal(np.asarray(out2), m)
+    # below-budget and non-numpy inputs pass through
+    small = np.ones((10, 2), np.float32)
+    np.testing.assert_array_equal(np.asarray(_upload_rows(small)), small)
+    dev = jnp.ones((5,))
+    assert _upload_rows(dev) is dev

@@ -98,29 +98,47 @@ def bin_data(X, edges):
 
 def _hist_mode_for(Xb) -> str:
     """Static histogram-engine choice for a fit: the sorted MXU path for
-    large single-shard matrices (on-chip shootout: ~7x/level at 1M rows,
-    scripts/tpu_calibrate3.py), the scatter path for small fits and for
-    sharded inputs (whose per-shard scatters GSPMD all-reduces — the
-    sorted path's global sort bookkeeping would generate cross-shard
-    collectives instead). Overridable via TRANSMOGRIFAI_TREE_HIST."""
+    large TPU fits (on-chip shootout: ~7x/level at 1M rows,
+    scripts/tpu_calibrate3.py) — single-shard directly, mesh-sharded via
+    the explicit shard_map wrapper (``train_ensemble_sharded``) — and
+    the scatter path for small fits and for sharded inputs without a
+    mesh context (whose per-shard scatters GSPMD all-reduces; the sorted
+    path's global-index bookkeeping would generate heavy cross-shard
+    collectives under plain GSPMD). Overridable via
+    TRANSMOGRIFAI_TREE_HIST."""
     import os
     forced = os.environ.get("TRANSMOGRIFAI_TREE_HIST")
-    if forced:
-        if forced not in ("scatter", "sorted"):
-            raise ValueError(
-                f"TRANSMOGRIFAI_TREE_HIST={forced!r}: expected 'scatter' "
-                "or 'sorted'")
-        return forced
+    if forced and forced not in ("scatter", "sorted"):
+        raise ValueError(
+            f"TRANSMOGRIFAI_TREE_HIST={forced!r}: expected 'scatter' "
+            "or 'sorted'")
+    if forced == "scatter":
+        return "scatter"
     try:
         single = len(Xb.devices()) == 1
     except Exception:
         single = True
+
+    def sharded_route() -> str:
+        # multi-device input: the sorted engine needs the explicit
+        # shard_map wrapper, which requires an active mesh and a row
+        # count divisible by the data axis (what shard_training_rows
+        # produces); anything else keeps the GSPMD scatter path, which
+        # accepts replicated/unevenly-sharded inputs
+        from transmogrifai_tpu.parallel.mesh import current_mesh
+        ctx = current_mesh()
+        if ctx is not None and Xb.shape[0] % ctx.n_data == 0:
+            return "sorted_sharded"
+        return "scatter"
+
+    if forced == "sorted":
+        return "sorted" if single else sharded_route()
     # auto-select only on TPU: the einsum path trades ~B-times more
     # (MXU-friendly) FLOPs for the serialized scatter, a trade validated
     # on-chip; CPU/GPU keep the scatter path unless forced
-    return "sorted" if (Xb.shape[0] >= _SORT_MIN_ROWS and single
-                        and jax.default_backend() == "tpu") \
-        else "scatter"
+    if Xb.shape[0] >= _SORT_MIN_ROWS and jax.default_backend() == "tpu":
+        return "sorted" if single else sharded_route()
+    return "scatter"
 
 
 #: histogram node budget per materialized array: [nodes, d, B] f32 x2 (g, h).
@@ -308,7 +326,8 @@ def _segment_sums(vals_sorted, counts):
 def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
                       n_bins: int, reg_lambda, gamma, min_child_weight,
                       block: int = _SORT_BLOCK,
-                      sorted_engine: str = "einsum"):
+                      sorted_engine: str = "einsum",
+                      data_axis=None):
     """Sort-based level-wise histogram tree (single-shard hot path).
 
     Same contract as the scatter-path ``grow_tree`` body: returns
@@ -347,6 +366,14 @@ def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
         hp = hess[src_row] * vf
         hist_g, hist_h = _sorted_hist(Xp, gp, hp, layout, n_bins=B, C=C,
                                       acc_dtype=acc_dtype, engine=engine)
+        if data_axis is not None:
+            # distributed fit (explicit shard_map): per-shard local
+            # histograms all-reduce once per level — the Rabit/MLlib
+            # executor-aggregation analog on ICI — after which every
+            # shard takes identical split decisions and routes its own
+            # rows (order/counts stay shard-local)
+            hist_g = jax.lax.psum(hist_g, data_axis)
+            hist_h = jax.lax.psum(hist_h, data_axis)
         feat, bin_, gain = _best_splits(hist_g, hist_h, feat_mask,
                                         **split_kw)
         feats_out.append(feat)
@@ -362,6 +389,9 @@ def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
                                           src_row, n)
     leaf_g = _segment_sums(grad[order], counts)
     leaf_h = _segment_sums(hess[order], counts)
+    if data_axis is not None:
+        leaf_g = jax.lax.psum(leaf_g, data_axis)
+        leaf_h = jax.lax.psum(leaf_h, data_axis)
     leaf_values = -leaf_g / (leaf_h + reg_lambda)
     # per-row predictions from the maintained segment order: leaf value of
     # each sorted row, scattered back to original row ids (unique indices)
@@ -408,11 +438,12 @@ def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
                                              "max_hist_nodes",
-                                             "hist", "sorted_engine"))
+                                             "hist", "sorted_engine",
+                                             "data_axis"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
               reg_lambda, gamma, min_child_weight,
               max_hist_nodes: int = _MAX_HIST_NODES, hist: str = "scatter",
-              sorted_engine: str = "einsum"):
+              sorted_engine: str = "einsum", data_axis=None):
     """Level-wise histogram tree. Returns (feats, bins, leaf_values,
     feat_gain, row_pred): feats/bins are tuples of per-level [2^level]
     arrays, leaf_values is [2^max_depth], feat_gain is the [d] per-feature
@@ -442,9 +473,15 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
         return _grow_tree_sorted(
             Xb, grad, hess, feat_mask, max_depth=max_depth, n_bins=n_bins,
             reg_lambda=reg_lambda, gamma=gamma,
-            min_child_weight=min_child_weight, sorted_engine=sorted_engine)
+            min_child_weight=min_child_weight, sorted_engine=sorted_engine,
+            data_axis=data_axis)
     if hist != "scatter":
         raise ValueError(f"hist={hist!r}: expected 'scatter' or 'sorted'")
+    if data_axis is not None:
+        # the scatter path has no in-body all-reduce: running it under a
+        # shard_map with data_axis would silently grow divergent
+        # per-shard trees (use GSPMD sharding for scatter instead)
+        raise ValueError("data_axis requires hist='sorted'")
     from transmogrifai_tpu.ops.histograms import node_bin_histogram_xla
     n, d = Xb.shape
     B = n_bins
@@ -547,13 +584,14 @@ def predict_tree(Xb, feats, bins, leaf_values):
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
     "bootstrap", "subsample", "colsample", "max_hist_nodes",
-    "hist", "sorted_engine"))
+    "hist", "sorted_engine", "data_axis"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
                    bootstrap: bool, seed: int,
                    max_hist_nodes: int = _MAX_HIST_NODES,
-                   hist: str = "scatter", sorted_engine: str = "einsum"):
+                   hist: str = "scatter", sorted_engine: str = "einsum",
+                   data_axis=None):
     """Train a whole ensemble in one scanned program.
 
     loss: 'logistic' (n_out=1), 'softmax' (n_out=K one-vs-all), 'squared'.
@@ -586,6 +624,12 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
         margin = carry
         g, h = grads(margin)
         k_rows, k_cols = jax.random.split(key)
+        if data_axis is not None:
+            # distributed: row-sampling draws must be INDEPENDENT per
+            # shard (fold in the shard index) while the feature mask
+            # below must stay IDENTICAL across shards (k_cols unfolded)
+            k_rows = jax.random.fold_in(k_rows,
+                                        jax.lax.axis_index(data_axis))
         if bootstrap:
             rw = jax.random.poisson(k_rows, subsample, (n,)).astype(jnp.float32)
         elif subsample < 1.0:
@@ -605,7 +649,8 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                              reg_lambda=reg_lambda, gamma=gamma,
                              min_child_weight=min_child_weight,
                              max_hist_nodes=max_hist_nodes, hist=hist,
-                             sorted_engine=sorted_engine)
+                             sorted_engine=sorted_engine,
+                             data_axis=data_axis)
 
         feats, bins, leaves, gains, preds = jax.vmap(
             grow_one, in_axes=(1, 1))(g, h)
@@ -622,6 +667,38 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
     _, (trees, gains) = jax.lax.scan(one_round, margins_zero(), keys)
     # trees: pytree with leading [n_rounds] axis; gains: [n_rounds, d]
     return trees, jnp.sum(gains, axis=0)
+
+
+def train_ensemble_sharded(ctx, Xb, y, w, **kw):
+    """Distributed ensemble fit: the SORTED engine under an explicit
+    ``shard_map`` over the mesh's data axis.
+
+    Each shard keeps its own rows' sort bookkeeping (order/counts) and
+    contributes per-level local histograms; one [N, d, B] psum per level
+    (plus one for the leaf sums) replicates the split decisions — the
+    XLA-collective analog of XGBoost's Rabit all-reduce / Spark MLlib's
+    executor histogram aggregation (SURVEY §2.7 P5), now on the engine
+    that is 5-7x faster per level than the scatter path. Row sampling
+    folds the shard index into the per-round key (independent draws);
+    the colsample mask deliberately does not (must match across shards).
+
+    ``Xb``/``y``/``w`` must be row-sharded over ``ctx.mesh``'s data axis
+    (rows padded to the shard multiple with weight 0 — what
+    ``parallel.mesh.shard_training_rows`` produces). Returns the same
+    (trees, gains) as ``train_ensemble``, replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+    from transmogrifai_tpu.parallel.mesh import DATA_AXIS
+
+    def shard_fn(Xb_s, y_s, w_s):
+        return train_ensemble(Xb_s, y_s, w_s, hist="sorted",
+                              data_axis=DATA_AXIS, **kw)
+
+    fn = jax.shard_map(
+        shard_fn, mesh=ctx.mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(), check_vma=False)
+    return fn(Xb, y, w)
 
 
 def predict_ensemble(Xb, trees, *, n_out: int, learning_rate, base_score,
@@ -831,7 +908,7 @@ class _TreePredictor(Predictor):
         depth, rounds, B = int(p["max_depth"]), int(p["num_rounds"]), \
             int(p["max_bins"])
         hist_mode = _hist_mode_for(Xb)
-        if hist_mode == "sorted":
+        if hist_mode.startswith("sorted"):
             # per level: padded-row one-hot contraction 4*n*d*B MXU MACs
             # (g+h stats) + layout/partition cumsums ~10n + split eval
             per_tree = sum(4.0 * n * d * B + 10.0 * n
@@ -845,8 +922,7 @@ class _TreePredictor(Predictor):
             per_tree = sum(5.0 * n * d + 4.0 * n + 12.0 * (2 ** lv) * d * B
                            for lv in range(depth))
         flops.add("tree", rounds * n_out * per_tree)
-        trees, gains = train_ensemble(
-            Xb, y, w,
+        ens_kw = dict(
             n_rounds=int(p["num_rounds"]), max_depth=int(p["max_depth"]),
             n_bins=int(p["max_bins"]), n_out=n_out, loss=loss,
             learning_rate=jnp.float32(p["learning_rate"]),
@@ -857,8 +933,15 @@ class _TreePredictor(Predictor):
             colsample=float(p["colsample"]),
             base_score=jnp.float32(base),
             bootstrap=self.bootstrap, seed=int(p["seed"]),
-            max_hist_nodes=_MAX_HIST_NODES,
-            hist=hist_mode, sorted_engine=_sorted_engine_default())
+            sorted_engine=_sorted_engine_default())
+        if hist_mode == "sorted_sharded":
+            from transmogrifai_tpu.parallel.mesh import current_mesh
+            trees, gains = train_ensemble_sharded(current_mesh(), Xb, y, w,
+                                                  **ens_kw)
+        else:
+            trees, gains = train_ensemble(
+                Xb, y, w, max_hist_nodes=_MAX_HIST_NODES,
+                hist=hist_mode, **ens_kw)
         model = TreeEnsembleModel(
             kind=self.kind, n_out=n_out,
             learning_rate=float(p["learning_rate"]), base_score=base,
