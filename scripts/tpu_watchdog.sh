@@ -1,7 +1,8 @@
 #!/bin/bash
 # TPU tunnel watchdog: probe every PROBE_INTERVAL seconds; on revival run
-# the chip runlist (headline bench @ 4M + 1M/2M curve, then the fenced
-# pallas-hist decision microbench, then the Criteo ingest probe) and exit.
+# the chip runlist — headline bench @ 4M + 1M/2M curve, the fenced
+# hist-engine decision microbench, the Criteo ingest probe, and the
+# HIGGS-11M single-chip tree-fit probe — then exit.
 # Usage: bash scripts/tpu_watchdog.sh [logdir]
 set -u
 cd "$(dirname "$0")/.."
@@ -34,7 +35,10 @@ while true; do
     echo "$(date -u +%FT%TZ) hist_engines rc=$?" >> "$LOG/watchdog.log"
     timeout 3000 python benchmarks/bench_criteo_ingest.py \
       > "$LOG/criteo.out" 2> "$LOG/criteo.err"
-    echo "$(date -u +%FT%TZ) criteo rc=$? — runlist done, disarming" \
+    echo "$(date -u +%FT%TZ) criteo rc=$?" >> "$LOG/watchdog.log"
+    timeout 4000 python benchmarks/bench_higgs11m_trees.py \
+      > "$LOG/higgs11m.out" 2> "$LOG/higgs11m.err"
+    echo "$(date -u +%FT%TZ) higgs11m rc=$? — runlist done, disarming" \
       >> "$LOG/watchdog.log"
     break
   fi
