@@ -72,3 +72,26 @@ def test_grow_tree_sorted_pallas_engine_matches():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-3)
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-3)
+
+
+def test_sorted_block_hist_kernel_chip_geometry():
+    """Parity at the exact block geometry the chip runs (C=256, d=28,
+    B=64) — the expander matmul and iota modulus must stay exact at
+    full width, not just at the small test shapes."""
+    from transmogrifai_tpu.ops.sorted_hist_pallas import sorted_block_hist
+
+    rng = np.random.default_rng(9)
+    nb, C, d, B = 8, 256, 28, 64
+    Xpb = jnp.asarray(rng.integers(0, B, size=(nb, C, d)), jnp.int8)
+    ghb = jnp.asarray(rng.normal(size=(nb, 2, C)), jnp.float32)
+    out = np.asarray(sorted_block_hist(Xpb, ghb, n_bins=B, interpret=True))
+    oh = (np.asarray(Xpb)[..., None] == np.arange(B)).astype(np.float32)
+    ref = np.einsum("bsc,bcdk->bsdk", np.asarray(ghb, np.float32),
+                    oh).reshape(nb, 2, d * B)
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+    # row-sums per stat must equal the gh sums exactly-ish (one-hot
+    # partition of unity per (row, feature))
+    np.testing.assert_allclose(
+        out.reshape(nb, 2, d, B).sum(-1),
+        np.repeat(np.asarray(ghb).sum(-1)[:, :, None], d, axis=2),
+        rtol=2e-2, atol=2e-2)

@@ -301,3 +301,34 @@ def test_train_ensemble_sorted_multiclass_parity():
     p2 = predict_ensemble(Xb, t2, n_out=3, learning_rate=jnp.float32(1.0),
                           base_score=jnp.float32(0.0), bootstrap=True)
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-4)
+
+
+def test_hist_mode_routing(monkeypatch):
+    """_hist_mode_for is the single source of truth for the engine route:
+    forced env values win (invalid raise), sharded inputs only go
+    sorted_sharded under an active mesh with a divisible row count."""
+    from transmogrifai_tpu.models.trees import _hist_mode_for
+    from transmogrifai_tpu.parallel.mesh import (
+        make_mesh, shard_training_rows, use_mesh,
+    )
+
+    monkeypatch.delenv("TRANSMOGRIFAI_TREE_HIST", raising=False)
+    small = jnp.zeros((64, 3), jnp.int32)
+    assert _hist_mode_for(small) == "scatter"  # tiny, cpu backend
+
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_HIST", "sorted")
+    assert _hist_mode_for(small) == "sorted"
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_HIST", "scatter")
+    assert _hist_mode_for(small) == "scatter"
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_HIST", "sort")
+    with pytest.raises(ValueError):
+        _hist_mode_for(small)
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_HIST", "sorted")
+
+    ctx = make_mesh(n_data=4, n_model=2)
+    with use_mesh(ctx):
+        Xs, ys, ws = shard_training_rows(
+            jnp.zeros((128, 3), jnp.int32), jnp.zeros(128), jnp.ones(128))
+        assert _hist_mode_for(Xs) == "sorted_sharded"
+    # sharded input but NO active mesh context -> GSPMD scatter fallback
+    assert _hist_mode_for(Xs) == "scatter"
