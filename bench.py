@@ -323,23 +323,90 @@ _PROBE_MARKER_TTL_S = 900
 
 
 
-def _load_measured_cpu_artifact() -> dict | None:
-    """The committed full-size measured CPU wall (rows/models matching
-    this invocation), or None. Tolerates any malformed content — the
-    bench must always print its JSON line."""
-    art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "benchmarks", "CPU_4M_MEASURED.json")
+def _accel_artifact_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "ACCEL_4M_MEASURED.json")
+
+
+def _code_fingerprint() -> str:
+    """Hash of the perf-relevant sources: an auto-saved accelerator
+    artifact must not outlive the code it measured."""
+    import hashlib
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in ("bench.py", "transmogrifai_tpu/models/trees.py",
+                "transmogrifai_tpu/models/linear.py",
+                "transmogrifai_tpu/ops/transmogrifier.py",
+                "transmogrifai_tpu/preparators/sanity_checker.py"):
+        try:
+            with open(os.path.join(here, rel), "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(rel.encode())
+    return h.hexdigest()[:12]
+
+
+def _save_accel_artifact(accel: dict, curve: list) -> None:
+    """Persist a COMPLETE accelerator measurement — atomically (a kill
+    mid-write must not destroy a prior good artifact), fingerprinted
+    (stale code's numbers must not be republished), best-effort."""
     try:
-        with open(art) as fh:
+        path = _accel_artifact_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({
+                "metric": f"automl_higgs_shape_{N_ROWS}_accel_measured",
+                "rows": N_ROWS, "models": MODELS,
+                "code_fingerprint": _code_fingerprint(),
+                "platform": accel.get("platform"),
+                "wall_s": round(accel["wall"], 2),
+                "holdout_auroc": round(accel.get("auroc", 0.0), 4),
+                "best_model": accel.get("best", ""),
+                "phases": accel.get("phases") or {},
+                "flops": accel.get("flops") or {},
+                "peak_flops": accel.get("peak_flops"),
+                "scaling_curve": curve,
+                "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+            }, fh, indent=2)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _load_bench_artifact(path: str, accel_only: bool) -> dict | None:
+    """A measurement artifact matching this invocation's rows+models, or
+    None. Tolerates any malformed content — the bench must always print
+    its JSON line."""
+    try:
+        with open(path) as fh:
             cand = json.load(fh)
-        if (isinstance(cand, dict)
+        if not (isinstance(cand, dict)
                 and int(cand.get("rows", -1)) == N_ROWS
                 and cand.get("models") == MODELS
                 and isinstance(cand.get("wall_s"), (int, float))):
-            return cand
+            return None
+        if accel_only:
+            if cand.get("platform") in (None, "cpu"):
+                return None
+            if cand.get("code_fingerprint") != _code_fingerprint():
+                # the measured code no longer matches the tree under test
+                return None
+        return cand
     except (OSError, ValueError, TypeError):
         pass
     return None
+
+
+def _load_accel_artifact() -> dict | None:
+    return _load_bench_artifact(_accel_artifact_path(), accel_only=True)
+
+
+def _load_measured_cpu_artifact() -> dict | None:
+    return _load_bench_artifact(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "benchmarks", "CPU_4M_MEASURED.json"),
+        accel_only=False)
 
 
 def main():
@@ -409,6 +476,15 @@ def main():
                               timeout=120) is not None:
                 accel = _run_child(N_ROWS, accel_env,
                                    "accel measurement (retry)", trace=True)
+        if accel is not None and not accel.get("resumed") \
+                and accel.get("platform") not in (None, "cpu"):
+            # persist IMMEDIATELY (curve=[full-size point]): tunnel
+            # windows are rare here, the accel child deleted its fold
+            # checkpoint on completion, and the driver may kill this
+            # parent during the curve children — a completed window must
+            # convert into a durable number before anything else runs
+            _save_accel_artifact(
+                accel, [{"rows": N_ROWS, "wall_s": round(accel["wall"], 2)}])
         if accel is not None:
             def curve_point(rows: int, r: dict) -> dict:
                 # a resumed (partial-wall) point must never look like a
@@ -426,6 +502,26 @@ def main():
                     curve.append(curve_point(rows, r))
             curve.append(curve_point(N_ROWS, accel))
             curve.sort(key=lambda c: c["rows"])
+            if not accel.get("resumed") \
+                    and accel.get("platform") not in (None, "cpu"):
+                _save_accel_artifact(accel, curve)  # re-save with curve
+
+    if accel is None:
+        prior = _load_accel_artifact()
+        if prior is not None:
+            print("# accelerator unavailable; publishing the prior "
+                  "COMPLETE accelerator measurement "
+                  "(benchmarks/ACCEL_4M_MEASURED.json)", file=sys.stderr)
+            accel = {"wall": float(prior["wall_s"]),
+                     "platform": prior.get("platform", "tpu"),
+                     "auroc": float(prior.get("holdout_auroc", 0.0)),
+                     "best": prior.get("best_model", ""),
+                     "phases": prior.get("phases") or {},
+                     "flops": prior.get("flops") or {},
+                     "peak_flops": prior.get("peak_flops"),
+                     "from_artifact": prior.get("measured_at",
+                                                 "unknown date")}
+            curve = prior.get("scaling_curve") or []
 
     # a committed MEASURED full-size CPU wall (recorded once via
     # `_BENCH_CHILD=1 _BENCH_CHILD_ROWS=<N> JAX_PLATFORMS=cpu`) beats any
@@ -506,6 +602,13 @@ def main():
                               "MEASURED full-size CPU wall "
                               "(benchmarks/CPU_4M_MEASURED.json), not an "
                               "extrapolation")
+        if accel.get("from_artifact"):
+            result["note"] = (
+                "accelerator unavailable THIS invocation; value is the "
+                "prior COMPLETE accelerator measurement of "
+                f"{accel['from_artifact']} "
+                "(benchmarks/ACCEL_4M_MEASURED.json)")
+            result["from_artifact"] = True
         measured_base = None
         if accel.get("platform") not in (None, "cpu") \
                 and not accel.get("resumed") \
