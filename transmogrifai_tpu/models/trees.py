@@ -247,7 +247,8 @@ def _sorted_hist(Xp, gp, hp, layout, *, n_bins: int, C: int, acc_dtype,
     else:
         ghb = jnp.stack([gp, hp], axis=-1).reshape(nb, C, 2).astype(
             acc_dtype)
-        rows_per_chunk = max(C, _SORT_OH_BUDGET // (2 * d * B))
+        esize = jnp.dtype(acc_dtype).itemsize  # bf16 on TPU, f32 off it
+        rows_per_chunk = max(C, _SORT_OH_BUDGET // (esize * d * B))
         cb = max(1, rows_per_chunk // C)
         n_chunks = -(-nb // cb)
         if n_chunks * cb != nb:
