@@ -21,18 +21,29 @@ print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK
 
 echo "$(date -u +%FT%TZ) watchdog armed (interval ${PROBE_INTERVAL}s)" \
   >> "$LOG/watchdog.log"
+N_PROBE=0
 while true; do
   if ! probe; then
-    # keep bench.py's probe-failure marker fresh so any concurrent or
+    # keep bench.py's probe-failure marker fresh so a concurrent or
     # subsequent bench invocation (e.g. the driver's end-of-round run)
-    # quick-probes once instead of walking the full ~12-min ladder
-    python -c "import sys; sys.path.insert(0, '.'); \
+    # quick-probes once instead of walking the full ~12-min ladder —
+    # but SKIP every 5th refresh so the marker TTL still expires
+    # periodically and bench's full ladder (incl. the JAX_PLATFORMS=""
+    # auto-choose rung and the 240s first-contact timeout) reruns, per
+    # the TTL design bench.py documents
+    N_PROBE=$((N_PROBE + 1))
+    if (( N_PROBE % 5 != 0 )); then
+      python -c "import sys; sys.path.insert(0, '.'); \
 from bench import _probe_marker_path; \
 open(_probe_marker_path(), 'w').write('watchdog')" 2>/dev/null
+    fi
   else
     echo "$(date -u +%FT%TZ) tunnel ALIVE — running chip runlist" \
       >> "$LOG/watchdog.log"
-    rm -f /tmp/bench_probe_dead_* 2>/dev/null
+    # remove via the same path bench computes (honors TMPDIR)
+    python -c "import sys, os; sys.path.insert(0, '.'); \
+from bench import _probe_marker_path; \
+p = _probe_marker_path(); os.path.exists(p) and os.remove(p)" 2>/dev/null
     BENCH_CHILD_TIMEOUT=4500 timeout 12000 python bench.py \
       > "$LOG/bench.out" 2> "$LOG/bench.err"
     echo "$(date -u +%FT%TZ) bench rc=$? artifact: $(tail -1 "$LOG/bench.out" | head -c 200)" \
