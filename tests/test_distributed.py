@@ -61,21 +61,37 @@ lo, hi = pid * 32, (pid + 1) * 32                      # local half
 Xb = D.shard_global_rows(ctx, Xb_all[lo:hi])
 y = D.shard_global_rows(ctx, yg[lo:hi])
 w = D.shard_global_rows(ctx, np.ones(32, np.float32))
-trees, _gains = train_ensemble(
-    Xb, y, w, n_rounds=4, max_depth=3, n_bins=16, n_out=1,
-    loss="logistic", learning_rate=jnp.float32(0.3),
-    reg_lambda=jnp.float32(1.0), gamma=jnp.float32(0.0),
-    min_child_weight=jnp.float32(1.0), subsample=1.0, colsample=1.0,
-    base_score=jnp.float32(0.0), bootstrap=False, seed=3)
-margin = predict_ensemble(Xb, trees, n_out=1,
-                          learning_rate=jnp.float32(0.3),
-                          base_score=jnp.float32(0.0), bootstrap=False)
+tkw = dict(n_rounds=4, max_depth=3, n_bins=16, n_out=1,
+           loss="logistic", learning_rate=jnp.float32(0.3),
+           reg_lambda=jnp.float32(1.0), gamma=jnp.float32(0.0),
+           min_child_weight=jnp.float32(1.0), subsample=1.0,
+           colsample=1.0, base_score=jnp.float32(0.0), bootstrap=False,
+           seed=3)
+pkw = dict(n_out=1, learning_rate=tkw["learning_rate"],
+           base_score=tkw["base_score"], bootstrap=tkw["bootstrap"])
+trees, _gains = train_ensemble(Xb, y, w, **tkw)
+margin = predict_ensemble(Xb, trees, **pkw)
 acc = float(jax.device_get(jnp.mean(
     ((margin[:, 0] > 0) == (y > 0.5)).astype(jnp.float32))))
 assert acc > 0.9, acc
 
+# distributed SORTED-engine trees over the same 2-process DCN mesh: the
+# explicit shard_map path (per-shard sort bookkeeping + one histogram
+# psum per level) must reproduce the unsharded sorted fit across REAL
+# process boundaries, not just the in-process virtual mesh
+from transmogrifai_tpu.models.trees import train_ensemble_sharded
+trees_s, _g = train_ensemble_sharded(ctx, Xb, y, w, **tkw)
+t_single, _g1 = train_ensemble(jnp.asarray(Xb_all),
+                               jnp.asarray(yg), jnp.ones(64),
+                               hist="sorted", **tkw)
+m_s = predict_ensemble(jnp.asarray(Xb_all), trees_s, **pkw)
+m_1 = predict_ensemble(jnp.asarray(Xb_all), t_single, **pkw)
+sorted_err = float(jax.device_get(jnp.max(jnp.abs(m_s - m_1))))
+assert sorted_err < 5e-3, sorted_err
+
 D.barrier()
-print(f"proc {{pid}} OK acc={{acc:.3f}}", flush=True)
+print(f"proc {{pid}} OK acc={{acc:.3f}} sorted_err={{sorted_err:.2e}}",
+      flush=True)
 """
 
 
