@@ -126,7 +126,7 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
         BinaryClassificationModelSelector, DataSplitter,
     )
     from transmogrifai_tpu.utils import flops
-    from transmogrifai_tpu.utils.profiling import profiler
+    from transmogrifai_tpu.utils.profiling import profiler, sweep_counters
     from transmogrifai_tpu.workflow import Workflow
     from transmogrifai_tpu.types import feature_types as ft
 
@@ -194,6 +194,7 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
             "best": s.best_model_name, "phases": phases,
             "flops": flops.totals(),
             "peak_flops": flops.peak_flops_per_s(),
+            "sweep_counters": sweep_counters.to_json(),
             "resumed": resumed}
 
 
@@ -302,6 +303,11 @@ def _device_breakdown(accel: dict) -> dict:
         peak = accel.get("peak_flops")
         if peak:
             out["mfu_vs_bf16_peak"] = round(achieved / peak, 5)
+    if accel.get("sweep_counters"):
+        # per-family sweep observability (utils/profiling.SweepCounters):
+        # mode (fold_stacked vs fold_loop), compiles, device dispatches,
+        # host syncs — the fast path reads hostSyncs == 1 per family
+        out["sweep"] = accel["sweep_counters"]
     return out
 
 
@@ -365,6 +371,7 @@ def _save_accel_artifact(accel: dict, curve: list) -> None:
                 "phases": accel.get("phases") or {},
                 "flops": accel.get("flops") or {},
                 "peak_flops": accel.get("peak_flops"),
+                "sweep_counters": accel.get("sweep_counters") or {},
                 "scaling_curve": curve,
                 "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                              time.gmtime()),
@@ -374,7 +381,8 @@ def _save_accel_artifact(accel: dict, curve: list) -> None:
         pass
 
 
-def _load_bench_artifact(path: str, accel_only: bool) -> dict | None:
+def _load_bench_artifact(path: str, accel_only: bool,
+                         require_platform: str | None = None) -> dict | None:
     """A measurement artifact matching this invocation's rows+models, or
     None. Tolerates any malformed content — the bench must always print
     its JSON line."""
@@ -385,6 +393,9 @@ def _load_bench_artifact(path: str, accel_only: bool) -> dict | None:
                 and int(cand.get("rows", -1)) == N_ROWS
                 and cand.get("models") == MODELS
                 and isinstance(cand.get("wall_s"), (int, float))):
+            return None
+        if require_platform is not None \
+                and cand.get("platform") != require_platform:
             return None
         if accel_only:
             if cand.get("platform") in (None, "cpu"):
@@ -403,10 +414,13 @@ def _load_accel_artifact() -> dict | None:
 
 
 def _load_measured_cpu_artifact() -> dict | None:
+    # platform MUST read 'cpu': an accelerator artifact dropped into the
+    # CPU slot (or one missing the field) would silently become the
+    # vs_baseline DENOMINATOR and fabricate the speedup ratio (ADVICE r5)
     return _load_bench_artifact(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "benchmarks", "CPU_4M_MEASURED.json"),
-        accel_only=False)
+        accel_only=False, require_platform="cpu")
 
 
 def main():
@@ -519,6 +533,7 @@ def main():
                      "phases": prior.get("phases") or {},
                      "flops": prior.get("flops") or {},
                      "peak_flops": prior.get("peak_flops"),
+                     "sweep_counters": prior.get("sweep_counters") or {},
                      "from_artifact": prior.get("measured_at",
                                                  "unknown date")}
             curve = prior.get("scaling_curve") or []

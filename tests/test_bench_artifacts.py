@@ -87,3 +87,33 @@ def test_code_fingerprint_tracks_sources(benchmod):
     fp = m._code_fingerprint()
     assert isinstance(fp, str) and len(fp) == 12
     assert fp == m._code_fingerprint()  # deterministic
+
+
+def test_cpu_artifact_requires_cpu_platform(benchmod, tmp_path):
+    """The vs_baseline DENOMINATOR must be a real CPU measurement: an
+    accelerator artifact (or one missing the platform field) dropped into
+    the CPU slot is rejected (ADVICE r5)."""
+    m = benchmod
+    path = str(tmp_path / "CPU.json")
+    art = {"rows": m.N_ROWS, "models": m.MODELS, "wall_s": 4253.89,
+           "platform": "cpu"}
+    json.dump(art, open(path, "w"))
+    assert m._load_bench_artifact(path, accel_only=False,
+                                  require_platform="cpu") is not None
+    json.dump({**art, "platform": "tpu"}, open(path, "w"))
+    assert m._load_bench_artifact(path, accel_only=False,
+                                  require_platform="cpu") is None
+    art.pop("platform")
+    json.dump(art, open(path, "w"))
+    assert m._load_bench_artifact(path, accel_only=False,
+                                  require_platform="cpu") is None
+
+
+def test_device_breakdown_surfaces_sweep_counters(benchmod):
+    m = benchmod
+    counters = {"OpLogisticRegression_0": {
+        "mode": "fold_stacked", "compiles": 7,
+        "deviceDispatches": 1, "hostSyncs": 1}}
+    out = m._device_breakdown({"phases": {}, "sweep_counters": counters})
+    assert out["sweep"] == counters
+    assert "sweep" not in m._device_breakdown({"phases": {}})

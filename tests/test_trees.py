@@ -332,3 +332,98 @@ def test_hist_mode_routing(monkeypatch):
         assert _hist_mode_for(Xs) == "sorted_sharded"
     # sharded input but NO active mesh context -> GSPMD scatter fallback
     assert _hist_mode_for(Xs) == "scatter"
+
+
+def test_forced_sorted_downgrade_warns_and_strict_raises(monkeypatch):
+    """A forced TRANSMOGRIFAI_TREE_HIST=sorted that the router downgrades
+    to scatter (multi-device input, no mesh) must be LOUD: silent
+    downgrades make A/B reruns time the wrong engine (ADVICE r5)."""
+    from transmogrifai_tpu.models.trees import _hist_mode_for
+    from transmogrifai_tpu.parallel.mesh import (
+        make_mesh, shard_training_rows, use_mesh,
+    )
+
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_HIST", "sorted")
+    monkeypatch.delenv("TRANSMOGRIFAI_TREE_HIST_STRICT", raising=False)
+    ctx = make_mesh(n_data=4, n_model=2)
+    with use_mesh(ctx):
+        Xs, _, _ = shard_training_rows(
+            jnp.zeros((128, 3), jnp.int32), jnp.zeros(128), jnp.ones(128))
+    # sharded input, mesh context GONE -> downgrade, warned
+    with pytest.warns(RuntimeWarning, match="downgraded to 'scatter'"):
+        assert _hist_mode_for(Xs) == "scatter"
+    # indivisible rows under an active mesh -> downgrade, warned
+    with use_mesh(make_mesh(n_data=8, n_model=1)):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        odd = jax.device_put(
+            jnp.zeros((126, 3), jnp.int32),
+            NamedSharding(make_mesh(n_data=2, n_model=4).mesh, P("data")))
+        with pytest.warns(RuntimeWarning, match="not divisible"):
+            assert _hist_mode_for(odd) == "scatter"
+    # strict mode: the downgrade is fatal
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_HIST_STRICT", "1")
+    with pytest.raises(RuntimeError, match="downgraded to 'scatter'"):
+        _hist_mode_for(Xs)
+    # single-device / successfully sharded routes never trip it
+    monkeypatch.delenv("TRANSMOGRIFAI_TREE_HIST_STRICT")
+    assert _hist_mode_for(jnp.zeros((64, 3), jnp.int32)) == "sorted"
+
+
+def test_sorted_acc_escape_hatch_cpu(monkeypatch):
+    """The f32-accumulation escape hatch for the sorted path's histogram
+    contraction: forced f32 matches the scatter engine; forced bf16 runs
+    the TPU numerics on CPU and stays finite."""
+    from transmogrifai_tpu.models.trees import (
+        _sorted_acc_default, grow_tree,
+    )
+    monkeypatch.delenv("TRANSMOGRIFAI_SORTED_ACC", raising=False)
+    assert _sorted_acc_default() == "auto"
+    monkeypatch.setenv("TRANSMOGRIFAI_SORTED_ACC", "f32")
+    assert _sorted_acc_default() == "f32"
+    monkeypatch.setenv("TRANSMOGRIFAI_SORTED_ACC", "nope")
+    with pytest.raises(ValueError, match="TRANSMOGRIFAI_SORTED_ACC"):
+        _sorted_acc_default()
+
+    X, y = _xor_data(512)
+    edges = quantile_bin_edges(np.asarray(X), 32)
+    Xb = bin_data(X, jnp.asarray(edges))
+    g = (jnp.asarray(y) - 0.5).astype(jnp.float32)
+    h = jnp.ones_like(g)
+    mask = jnp.ones(Xb.shape[1], jnp.float32)
+    kw = dict(max_depth=4, n_bins=32, reg_lambda=jnp.float32(1.0),
+              gamma=jnp.float32(0.0), min_child_weight=jnp.float32(1.0))
+    ref = grow_tree(Xb, g, h, mask, hist="scatter", **kw)
+    f32 = grow_tree(Xb, g, h, mask, hist="sorted", sorted_acc="f32", **kw)
+    np.testing.assert_allclose(np.asarray(ref[2]), np.asarray(f32[2]),
+                               atol=1e-5)  # identical leaf values
+    bf16 = grow_tree(Xb, g, h, mask, hist="sorted", sorted_acc="bf16", **kw)
+    assert np.all(np.isfinite(np.asarray(bf16[2])))
+    # bf16 stats accumulate at reduced precision but the trees still agree
+    # on this well-separated data's split structure
+    np.testing.assert_allclose(np.asarray(bf16[2]), np.asarray(ref[2]),
+                               atol=0.05)
+
+
+def test_tree_bin_once_fold_plan(monkeypatch):
+    """fold_sweep_plan computes dataset-level codes once; per-fold
+    grid_fit_arrays gathers rows from them (same edges, same models as a
+    manual gather), and the env kill-switch disables the plan."""
+    monkeypatch.delenv("TRANSMOGRIFAI_TREE_BIN_ONCE", raising=False)
+    X, y = _xor_data(400)
+    w = jnp.ones(X.shape[0], jnp.float32)
+    est = OpGBTClassifier(num_rounds=3, max_depth=3)
+    grid = [{"num_rounds": 3, "max_depth": 3}]
+    plan = est.fold_sweep_plan(X, grid)
+    assert set(plan) == {64} and plan[64][1].shape == X.shape
+    rows = jnp.arange(100)
+    m_plan = est.grid_fit_arrays(X[rows], y[rows], w[rows], grid,
+                                 _fold_plan=plan, _fold_rows=rows)[0]
+    # manual reference: same dataset-level edges, same gathered codes
+    m_ref = est.fit_arrays(X[rows], y[rows], w[rows], grid[0],
+                           _binned=(plan[64][0],
+                                    jnp.take(plan[64][1], rows, axis=0), 64))
+    np.testing.assert_allclose(np.asarray(m_plan.trees[2]),
+                               np.asarray(m_ref.trees[2]), atol=1e-6)
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_BIN_ONCE", "0")
+    assert est.fold_sweep_plan(X, grid) is None

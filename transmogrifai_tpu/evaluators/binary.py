@@ -183,6 +183,15 @@ def _metric_batch(y, scores, w, metric: str):
     return (fp + fn) / jnp.maximum(tp + fp + tn + fn, 1e-12)  # Error
 
 
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _metric_batch_folds(y, scores, w, metric: str):
+    """Fold-stacked metric batch: ``y [k, n]``, ``scores [k, G, n]`` ->
+    ``[k, G]`` — the per-fold ``_metric_batch`` vmapped over the CV axis, so
+    a whole family's (fold x grid) sweep pays exactly ONE host sync."""
+    return jax.vmap(lambda yk, sk, wk: _metric_batch(yk, sk, wk, metric))(
+        y, scores, w)
+
+
 class OpBinaryClassificationEvaluator(EvaluatorBase):
     name = "binary classification"
     default_metric = "auPR"
@@ -217,3 +226,13 @@ class OpBinaryClassificationEvaluator(EvaluatorBase):
         w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
         return np.asarray(_metric_batch(y, jnp.asarray(scores, jnp.float32),
                                         w, metric or self.default_metric))
+
+    def metric_batch_scores_folds(self, y, scores, metric=None,
+                                  w=None) -> np.ndarray:
+        """Fold-stacked sweep path: ``y [k, n]`` per-fold labels, ``scores
+        [k, G, n]`` margins -> ``[k, G]`` metric values, one host sync."""
+        y = jnp.asarray(y, jnp.float32)
+        w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
+        return np.asarray(_metric_batch_folds(
+            y, jnp.asarray(scores, jnp.float32), w,
+            metric or self.default_metric))

@@ -76,3 +76,26 @@ class OpRegressionEvaluator(EvaluatorBase):
             ss_tot = jnp.maximum(jnp.sum((y - jnp.mean(y)) ** 2), 1e-12)
             out = 1.0 - jnp.sum(err ** 2, axis=1) / ss_tot
         return np.asarray(out)
+
+    def metric_batch_scores_folds(self, y, preds, metric=None,
+                                  w=None) -> np.ndarray:
+        """Fold-stacked sweep path: ``y [k, n]`` per-fold labels, ``preds
+        [k, G, n]`` -> ``[k, G]`` metric values, one host sync. Same row
+        reductions as ``metric_batch_scores`` per fold lane."""
+        metric = metric or self.default_metric
+        y = jnp.asarray(y, jnp.float32)[:, None, :]   # [k, 1, n]
+        preds = jnp.asarray(preds, jnp.float32)       # [k, G, n]
+        err = preds - y
+        mse = jnp.mean(err ** 2, axis=2)
+        if metric == "MSE":
+            out = mse
+        elif metric == "RMSE":
+            out = jnp.sqrt(mse)
+        elif metric == "MAE":
+            out = jnp.mean(jnp.abs(err), axis=2)
+        else:  # R2
+            ss_tot = jnp.maximum(
+                jnp.sum((y - jnp.mean(y, axis=2, keepdims=True)) ** 2,
+                        axis=2), 1e-12)               # [k, 1]
+            out = 1.0 - jnp.sum(err ** 2, axis=2) / ss_tot
+        return np.asarray(out)

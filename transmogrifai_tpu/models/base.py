@@ -26,7 +26,7 @@ from transmogrifai_tpu.stages.base import (
 )
 from transmogrifai_tpu.types import feature_types as ft
 
-__all__ = ["Predictor", "PredictionModel"]
+__all__ = ["Predictor", "PredictionModel", "supports_fold_stacking"]
 
 
 class Predictor(Estimator):
@@ -79,9 +79,84 @@ class Predictor(Estimator):
         selector then falls back to per-model evaluation."""
         return None
 
+    # -- fold-stacked sweep contract -----------------------------------------
+    def grid_fit_arrays_folds(self, X, y, w, grid: Sequence[dict]
+                              ) -> Optional[list]:
+        """Fold-stacked trainer: the CV sweep's fast path. ``X: [k, n, d]``,
+        ``y/w: [k, n]`` carry a leading fold axis (``OpCrossValidation``
+        guarantees equal fold shapes precisely so this axis exists); a
+        vmappable family trains all k folds x |grid| points as ONE compiled
+        program and returns a ``[k][G]`` nested list of fitted models whose
+        parameters stay device-resident (no host pull inside the sweep).
+
+        Default: ``None`` — family has no fold axis; the selector falls back
+        to its per-fold loop. Families opt in by overriding; the selector's
+        eligibility check (``supports_fold_stacking``) additionally refuses
+        the stacked path for subclasses that override the per-fold trainers
+        below the opt-in, so custom ``fit_arrays``/``grid_fit_arrays``
+        semantics are never silently bypassed."""
+        return None
+
+    def grid_predict_scores_folds(self, models: Sequence[Sequence[
+            "PredictionModel"]], X):
+        """Fold-stacked scoring: ``models`` is the ``[k][G]`` nest from
+        ``grid_fit_arrays_folds``, ``X: [k, n_va, d]`` the stacked
+        validation folds; returns one ``[k, G, n_va]`` device score array
+        (margins for binary, predictions for regression) or None when no
+        batched scalar score exists (e.g. multiclass)."""
+        return None
+
+    def fold_stack_unit_width(self, grid: Sequence[dict]) -> int:
+        """Per-row, per-grid-lane f32 lane count the fold-stacked trainer
+        keeps live (logits/scores/residuals) — the selector's HBM guard
+        multiplies this by k x G x rows. Default 4 covers the linear/GLM/NB
+        families (<= 2 classes + gradients); families with wider per-row
+        intermediates (hidden activations) override."""
+        return 4
+
+    def grid_scores_folds(self, X, y, w, grid: Sequence[dict], Xva):
+        """One-call fold-stacked train+score — what the selector's fast
+        path actually invokes. Default composes the two contract methods;
+        families with a fully-stacked trainer override to go straight from
+        stacked parameters to stacked scores, skipping the per-(fold, grid)
+        model materialization round trip entirely (the sweep discards the
+        models anyway — the winner refits later). Returns ``[k, G, n_va]``
+        scores or None when the family can't serve the stacked path."""
+        models = self.grid_fit_arrays_folds(X, y, w, grid)
+        if models is None:
+            return None
+        return self.grid_predict_scores_folds(models, Xva)
+
     def fit_model(self, data) -> "PredictionModel":
         X, y, w = self._xyw(data)
         return self.fit_arrays(X, y, w, self.params)
+
+
+def supports_fold_stacking(est: Predictor) -> bool:
+    """True when the estimator's fold-stacked trainer is safe to use in
+    place of its per-fold one.
+
+    Two conditions: the family overrode ``grid_fit_arrays_folds`` (opted
+    in), AND no subclass overrides any per-fold trainer/scorer *below* that
+    opt-in in the MRO. The second guard is what keeps user subclasses
+    honest: a test double or wrapper that redefines ``grid_fit_arrays`` /
+    ``fit_arrays`` / ``grid_predict_scores`` (counting fits, injecting
+    failures, changing the math) must keep its semantics — the sweep routes
+    such families through the per-fold loop where the override is called."""
+    cls = type(est)
+    mro = cls.__mro__
+    stacked = ("grid_fit_arrays_folds", "grid_scores_folds",
+               "_fold_stacked_params")
+    owner_i = min((i for i, c in enumerate(mro) if c is not Predictor
+                   and any(n in vars(c) for n in stacked)), default=None)
+    if owner_i is None:
+        return False  # never opted in (base default = no fold axis)
+    for name in ("grid_fit_arrays", "fit_arrays", "grid_predict_scores",
+                 "grid_predict_scores_folds"):
+        def_i = next((i for i, c in enumerate(mro) if name in vars(c)), None)
+        if def_i is not None and def_i < owner_i:
+            return False  # more-derived per-fold override would be bypassed
+    return True
 
 
 class PredictionModel(AllowLabelAsInput, DeviceTransformer):

@@ -41,10 +41,11 @@ __all__ = [
 class NaiveBayesModel(PredictionModel):
     def __init__(self, log_prior=None, log_theta=None,
                  uid: Optional[str] = None):
-        self.log_prior = np.asarray(log_prior, np.float64) \
-            if log_prior is not None else np.zeros(2)
-        self.log_theta = np.asarray(log_theta, np.float64) \
-            if log_theta is not None else np.zeros((0, 2))
+        # may be device arrays during the CV sweep (no host pull);
+        # conversion happens lazily on serialization/introspection
+        self.log_prior = log_prior if log_prior is not None else np.zeros(2)
+        self.log_theta = log_theta if log_theta is not None \
+            else np.zeros((0, 2))
         super().__init__(uid=uid)
 
     def device_params(self):
@@ -60,7 +61,8 @@ class NaiveBayesModel(PredictionModel):
         return fr.PredictionColumn(pred, logits, prob)
 
     def fitted_state(self):
-        return {"log_prior": self.log_prior, "log_theta": self.log_theta}
+        return {"log_prior": np.asarray(self.log_prior, np.float64),
+                "log_theta": np.asarray(self.log_theta, np.float64)}
 
     def set_fitted_state(self, state):
         self.log_prior = np.asarray(state["log_prior"], np.float64)
@@ -74,8 +76,24 @@ class NaiveBayesModel(PredictionModel):
         return cls(uid=uid)
 
     def feature_contributions(self):
-        lt = self.log_theta
+        lt = np.asarray(self.log_theta)
         return lt[:, -1] - lt[:, 0] if lt.shape[1] >= 2 else lt[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def _nb_fit(X, y, w, smoothing, *, n_classes: int):
+    """One multinomial-NB closed-form fit (smoothing traced so the same
+    program serves every grid point and vmaps over folds)."""
+    Y = jax.nn.one_hot(y.astype(jnp.int32), n_classes) * w[:, None]
+    Xp = jnp.maximum(X, 0.0)
+    class_counts = jnp.sum(Y, axis=0)                      # [C]
+    feat_counts = Xp.T @ Y                                 # [d, C]
+    log_prior = jnp.log(class_counts / jnp.sum(class_counts))
+    totals = jnp.sum(feat_counts, axis=0, keepdims=True)
+    d = X.shape[1]
+    log_theta = jnp.log((feat_counts + smoothing)
+                        / (totals + smoothing * d))
+    return log_prior, log_theta
 
 
 class OpNaiveBayes(Predictor):
@@ -88,17 +106,55 @@ class OpNaiveBayes(Predictor):
     def fit_arrays(self, X, y, w, params):
         smoothing = float(params.get("smoothing", 1.0))
         n_classes = max(int(np.asarray(jnp.max(y))) + 1, 2)
-        Y = jax.nn.one_hot(y.astype(jnp.int32), n_classes) * w[:, None]
-        Xp = jnp.maximum(X, 0.0)
-        class_counts = jnp.sum(Y, axis=0)                      # [C]
-        feat_counts = Xp.T @ Y                                 # [d, C]
-        log_prior = jnp.log(class_counts / jnp.sum(class_counts))
-        totals = jnp.sum(feat_counts, axis=0, keepdims=True)
-        d = X.shape[1]
-        log_theta = jnp.log((feat_counts + smoothing)
-                            / (totals + smoothing * d))
+        log_prior, log_theta = _nb_fit(X, y, w, jnp.float32(smoothing),
+                                       n_classes=n_classes)
         return NaiveBayesModel(log_prior=np.asarray(log_prior),
                                log_theta=np.asarray(log_theta))
+
+    def grid_predict_scores(self, models, X):
+        """[G, n] binary log-odds margins (None for multiclass) — the same
+        batched metric program the fold-stacked path uses, so both sweep
+        paths score identically."""
+        if not models:
+            return None
+        lt = jnp.stack([jnp.asarray(m.log_theta, jnp.float32)
+                        for m in models])
+        lp = jnp.stack([jnp.asarray(m.log_prior, jnp.float32)
+                        for m in models])
+        if lt.shape[-1] != 2:
+            return None
+        logits = jnp.einsum("nd,gdc->gnc", jnp.maximum(X, 0.0), lt) \
+            + lp[:, None, :]
+        return logits[..., 1] - logits[..., 0]
+
+    # -- fold-stacked sweep --------------------------------------------------
+    def grid_fit_arrays_folds(self, X, y, w, grid):
+        """Closed-form fit vmapped over (fold x smoothing grid) — one
+        program for the whole family sweep; model params stay on device."""
+        if not grid:
+            return []
+        n_classes = max(int(np.asarray(jnp.max(y))) + 1, 2)  # one sync
+        sm = jnp.asarray([float({**self.params, **g}.get("smoothing", 1.0))
+                          for g in grid], jnp.float32)
+        inner = lambda Xk, yk, wk: jax.vmap(  # noqa: E731
+            lambda s: _nb_fit(Xk, yk, wk, s, n_classes=n_classes))(sm)
+        lp, lt = jax.vmap(inner)(X, y, w)  # [k, G, C], [k, G, d, C]
+        return [[NaiveBayesModel(log_prior=lp[f, j], log_theta=lt[f, j])
+                 for j in range(len(grid))] for f in range(int(X.shape[0]))]
+
+    def grid_predict_scores_folds(self, models, X):
+        """[k, G, n_va] binary log-odds margins (None for multiclass)."""
+        if not models or not models[0]:
+            return None
+        lt = jnp.stack([jnp.stack([jnp.asarray(m.log_theta, jnp.float32)
+                                   for m in row]) for row in models])
+        lp = jnp.stack([jnp.stack([jnp.asarray(m.log_prior, jnp.float32)
+                                   for m in row]) for row in models])
+        if lt.shape[-1] != 2:
+            return None
+        logits = jnp.einsum("knd,kgdc->kgnc", jnp.maximum(X, 0.0), lt) \
+            + lp[:, :, None, :]
+        return logits[..., 1] - logits[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -200,6 +256,75 @@ class OpMultilayerPerceptronClassifier(Predictor):
         return MLPModel(params=[(np.asarray(W), np.asarray(b))
                                 for W, b in trained])
 
+    def grid_predict_scores(self, models, X):
+        """[G, n] binary margins when all grid models share layer shapes
+        (None otherwise) — keeps both sweep paths on one metric program."""
+        folds = self.grid_predict_scores_folds([models], X[None])
+        return None if folds is None else folds[0]
+
+    def fold_stack_unit_width(self, grid):
+        """Hidden activations dominate the MLP's per-row residency: the
+        widest layer (x2 for forward+grad) across the grid."""
+        widths = [max(tuple({**self.default_params, **self.params, **g}
+                            ["layers"]) or (1,)) for g in grid] or [1]
+        return 2 * max(widths) + 4
+
+    # -- fold-stacked sweep --------------------------------------------------
+    def grid_fit_arrays_folds(self, X, y, w, grid):
+        """Fold-stacked MLP sweep: step_size is the traced grid axis, one
+        vmap-of-vmap Adam program per distinct (layers, max_iter, seed)
+        combo; fitted params stay device views."""
+        if not grid:
+            return []
+        merged = [{**self.default_params, **self.params, **g} for g in grid]
+        n_classes = max(int(np.asarray(jnp.max(y))) + 1, 2)  # one sync
+        k = int(X.shape[0])
+        models: list[list] = [[None] * len(grid) for _ in range(k)]
+        by_kw: dict[tuple, list[int]] = {}
+        for i, p in enumerate(merged):
+            layers = tuple(int(x) for x in p["layers"]) + (n_classes,)
+            by_kw.setdefault((layers, int(p["max_iter"]), int(p["seed"])),
+                             []).append(i)
+        for (layers, mi, seed), idxs in by_kw.items():
+            ss = jnp.asarray([float(merged[i]["step_size"]) for i in idxs],
+                             jnp.float32)
+            inner = lambda Xk, yk, wk, _l=layers, _m=mi, _s=seed: jax.vmap(  # noqa: E731,E501
+                lambda s: _train_mlp(Xk, yk, wk, layers=_l, max_iter=_m,
+                                     seed=_s, step_size=s))(ss)
+            trained = jax.vmap(inner)(X, y, w)  # leaves [k, g, ...]
+            for f in range(k):
+                for j, i in enumerate(idxs):
+                    models[f][i] = MLPModel(
+                        params=[(W[f, j], b[f, j]) for W, b in trained])
+        return models
+
+    def grid_predict_scores_folds(self, models, X):
+        """[k, G, n_va] binary margins via one stacked forward pass; None
+        when grid models have heterogeneous layer shapes or >2 classes."""
+        if not models or not models[0]:
+            return None
+        shapes = {tuple((tuple(W.shape), tuple(b.shape)) for W, b in m.params)
+                  for row in models for m in row}
+        if len(shapes) != 1:
+            return None
+        rows = [jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                       *[m.params for m in row])
+                for row in models]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
+
+        def fwd(params, Xk):
+            h = Xk
+            for (W, b) in params[:-1]:
+                h = jnp.tanh(h @ W + b)
+            W, b = params[-1]
+            return h @ W + b
+
+        z = jax.vmap(lambda p_row, Xk: jax.vmap(
+            lambda p: fwd(p, Xk))(p_row))(stacked, X)  # [k, G, n, C]
+        if z.shape[-1] != 2:
+            return None
+        return z[..., 1] - z[..., 0]
+
 
 # ---------------------------------------------------------------------------
 # Generalized linear regression
@@ -262,17 +387,18 @@ def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
 
 
 class GLMModel(PredictionModel):
-    def __init__(self, weights=None, intercept: float = 0.0,
+    def __init__(self, weights=None, intercept=0.0,
                  family: str = "gaussian", uid: Optional[str] = None):
-        self.weights = np.asarray(weights, np.float64) \
-            if weights is not None else np.zeros(0)
-        self.intercept = float(intercept)
+        # may be device arrays during the CV sweep (no host pull);
+        # conversion happens lazily on serialization/introspection
+        self.weights = weights if weights is not None else np.zeros(0)
+        self.intercept = intercept
         self.family = family
         super().__init__(uid=uid)
 
     def device_params(self):
         return (jnp.asarray(self.weights, jnp.float32),
-                jnp.float32(self.intercept))
+                jnp.asarray(self.intercept, jnp.float32))
 
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         W, b = params
@@ -288,7 +414,7 @@ class GLMModel(PredictionModel):
         return fr.PredictionColumn(mean, empty, empty)
 
     def fitted_state(self):
-        return {"weights": self.weights,
+        return {"weights": np.asarray(self.weights, np.float64),
                 "intercept": np.float64(self.intercept)}
 
     def set_fitted_state(self, state):
@@ -303,7 +429,7 @@ class GLMModel(PredictionModel):
         return cls(family=config.get("family", "gaussian"), uid=uid)
 
     def feature_contributions(self):
-        return self.weights
+        return np.asarray(self.weights)
 
 
 class OpGeneralizedLinearRegression(Predictor):
@@ -327,6 +453,71 @@ class OpGeneralizedLinearRegression(Predictor):
                               var_power=jnp.float32(vp))
         return GLMModel(weights=np.asarray(beta), intercept=float(b0),
                         family=family)
+
+    def grid_predict_scores(self, models, X):
+        """[G, n] mean predictions through the family link (None when grid
+        points mix families) — keeps both sweep paths on one metric
+        program."""
+        folds = self.grid_predict_scores_folds([models], X[None])
+        return None if folds is None else folds[0]
+
+    # -- fold-stacked sweep --------------------------------------------------
+    def grid_fit_arrays_folds(self, X, y, w, grid):
+        """Fold-stacked GLM sweep: reg_param/variance_power are the traced
+        grid axes, one vmap-of-vmap program per distinct (family, max_iter,
+        fit_intercept) combo; fitted params stay device views."""
+        if not grid:
+            return []
+        merged = [{**self.default_params, **self.params, **g} for g in grid]
+        for p in merged:
+            if p["family"] not in _FAMILIES:
+                raise ValueError(f"Unknown GLM family {p['family']!r}")
+            vp = float(p["variance_power"])
+            if p["family"] == "tweedie" and not 1.0 < vp < 2.0:
+                raise ValueError(
+                    f"tweedie variance_power must be in (1, 2), got {vp}")
+        k = int(X.shape[0])
+        models: list[list] = [[None] * len(grid) for _ in range(k)]
+        by_kw: dict[tuple, list[int]] = {}
+        for i, p in enumerate(merged):
+            by_kw.setdefault((p["family"], int(p["max_iter"]),
+                              bool(p["fit_intercept"])), []).append(i)
+        for (family, mi, fi), idxs in by_kw.items():
+            rp = jnp.asarray([float(merged[i]["reg_param"]) for i in idxs],
+                             jnp.float32)
+            vp = jnp.asarray([float(merged[i]["variance_power"])
+                              for i in idxs], jnp.float32)
+            inner = lambda Xk, yk, wk, _f=family, _m=mi, _i=fi: jax.vmap(  # noqa: E731,E501
+                lambda r, v: _train_glm(Xk, yk, wk, family=_f, max_iter=_m,
+                                        fit_intercept=_i, reg_param=r,
+                                        var_power=v))(rp, vp)
+            betas, b0s = jax.vmap(inner)(X, y, w)  # [k, g, d], [k, g]
+            for f in range(k):
+                for j, i in enumerate(idxs):
+                    models[f][i] = GLMModel(weights=betas[f, j],
+                                            intercept=b0s[f, j],
+                                            family=family)
+        return models
+
+    def grid_predict_scores_folds(self, models, X):
+        """[k, G, n_va] mean predictions through the family link (None when
+        grid points mix families — their links differ)."""
+        if not models or not models[0]:
+            return None
+        fams = {m.family for row in models for m in row}
+        if len(fams) != 1:
+            return None
+        family = fams.pop()
+        W = jnp.stack([jnp.stack([jnp.asarray(m.weights, jnp.float32)
+                                  for m in row]) for row in models])
+        b = jnp.stack([jnp.stack([jnp.asarray(m.intercept, jnp.float32)
+                                  for m in row]) for row in models])
+        eta = jnp.einsum("knd,kgd->kgn", X, W) + b[:, :, None]
+        if family == "gaussian":
+            return eta
+        if family == "binomial":
+            return jax.nn.sigmoid(eta)
+        return jnp.exp(eta)
 
 
 # ---------------------------------------------------------------------------

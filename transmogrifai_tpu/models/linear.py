@@ -211,6 +211,44 @@ def _run_grid(X, y, w, grid: Sequence[dict], defaults: dict, kw: dict):
     return f(rp, en)
 
 
+def _run_grid_folds(Xf, yf, wf, grid: Sequence[dict], defaults: dict,
+                    kw: dict):
+    """Fold-stacked grid trainer: ``Xf [k, n, d]`` — all k folds x |grid|
+    Adam descents as ONE vmap-of-vmap program (the CV axis joins the grid
+    axis, so a whole family's sweep is a single dispatch). The grid scalars
+    shard over the mesh "model" axis only when the fold axis doesn't claim
+    it (``shard_stacked_training_rows`` already placed the folds)."""
+    from transmogrifai_tpu.parallel import mesh as pmesh
+    from transmogrifai_tpu.utils import flops
+    rp = jnp.asarray([float({**defaults, **g}["reg_param"]) for g in grid],
+                     jnp.float32)
+    en = jnp.asarray([float({**defaults, **g}["elastic_net_param"])
+                      for g in grid], jnp.float32)
+    if not pmesh.fold_axis_on_model(int(Xf.shape[0])):
+        rp, en = _shard_candidates(rp, en)
+    inner = lambda Xk, yk, wk: jax.vmap(  # noqa: E731 — vmap composition
+        lambda r, e: _train_linear(Xk, yk, wk, r, e, **kw))(rp, en)
+    k, n, d = Xf.shape
+    C = kw["n_classes"] if kw["loss_kind"] == "softmax" else 1
+    flops.add("linear",
+              int(k) * len(grid) * kw["max_iter"] * 6.0 * int(n) * int(d) * C)
+    return jax.vmap(inner)(Xf, yf, wf)  # Ws [k, G, d, C], bs [k, G, C]
+
+
+def _merge_grid_parts(parts, order):
+    """Reassemble per-static-group stacked params ``[(Ws [k, g_i, d, C],
+    bs [k, g_i, C]), ...]`` into grid order along the grid axis."""
+    if len(parts) == 1:
+        Ws, bs = parts[0]
+    else:
+        Ws = jnp.concatenate([p[0] for p in parts], axis=1)
+        bs = jnp.concatenate([p[1] for p in parts], axis=1)
+    if list(order) != sorted(order):
+        inv = jnp.asarray(np.argsort(np.asarray(order)))
+        Ws, bs = Ws[:, inv], bs[:, inv]
+    return Ws, bs
+
+
 # ---------------------------------------------------------------------------
 # fitted models
 # ---------------------------------------------------------------------------
@@ -383,6 +421,74 @@ class _LinearPredictor(Predictor):
             return z[:, :, 1] - z[:, :, 0]
         return None                # multiclass: no scalar score
 
+    # -- fold-stacked sweep --------------------------------------------------
+    def _fold_stacked_params(self, X, y, w, grid):
+        """All k folds x |grid| points in one vmapped program per distinct
+        static-flag combo; returns the stacked ``(Ws [k, G, d, C],
+        bs [k, G, C])`` in grid order (device-resident)."""
+        merged = [{**self.params, **g} for g in grid]
+        by_kw: dict[tuple, list[int]] = {}
+        for i, g in enumerate(merged):
+            key = (int(g["max_iter"]), bool(g["fit_intercept"]),
+                   bool(g["standardization"]))
+            by_kw.setdefault(key, []).append(i)
+        parts, order = [], []
+        for idxs in by_kw.values():
+            kw = self._static_kw(merged[idxs[0]], self._n_classes(y))
+            Ws, bs, _ = _run_grid_folds(X, y, w, [grid[i] for i in idxs],
+                                        self.params, kw)
+            parts.append((Ws, bs))
+            order.extend(idxs)
+        return _merge_grid_parts(parts, order)
+
+    def grid_fit_arrays_folds(self, X, y, w, grid):
+        """``[k][G]`` fitted models whose weights stay device views of the
+        stacked result (no host pull in the sweep)."""
+        if not grid:
+            return []
+        Ws, bs = self._fold_stacked_params(X, y, w, grid)
+        return [[self._make_model(Ws[f, j], bs[f, j])
+                 for j in range(len(grid))] for f in range(int(X.shape[0]))]
+
+    def _scores_from_stacked(self, Ws, bs, Xva):
+        """[k, G, n_va] scores straight from stacked parameters."""
+        if self.loss_kind == "squared":
+            return jnp.einsum("knd,kgd->kgn", Xva, Ws[..., 0]) \
+                + bs[..., 0][:, :, None]
+        z = jnp.einsum("knd,kgdc->kgnc", Xva, Ws) + bs[:, :, None, :]
+        if z.shape[-1] == 1:       # margin-only (SVC)
+            return z[..., 0]
+        if z.shape[-1] == 2:       # binary margin
+            return z[..., 1] - z[..., 0]
+        return None                # multiclass: no scalar score
+
+    def grid_scores_folds(self, X, y, w, grid, Xva):
+        """Fused sweep unit: stacked parameters -> stacked scores with no
+        per-(fold, grid) model materialization in between."""
+        if not grid:
+            return None
+        Ws, bs = self._fold_stacked_params(X, y, w, grid)
+        return self._scores_from_stacked(Ws, bs, Xva)
+
+    def grid_predict_scores_folds(self, models, X):
+        """[k, G, n_va] validation scores in one einsum over the stacked
+        fold axis — the selector computes every fold's metrics from this
+        with a single host sync per family."""
+        if not models or not models[0]:
+            return None
+        W = jnp.stack([jnp.stack([jnp.asarray(m.weights, jnp.float32)
+                                  for m in row]) for row in models])
+        b = jnp.stack([jnp.stack([jnp.asarray(m.intercept, jnp.float32)
+                                  for m in row]) for row in models])
+        if self.loss_kind == "squared":
+            return jnp.einsum("knd,kgd->kgn", X, W) + b[:, :, None]
+        z = jnp.einsum("knd,kgdc->kgnc", X, W) + b[:, :, None, :]
+        if z.shape[-1] == 1:       # margin-only (SVC)
+            return z[..., 0]
+        if z.shape[-1] == 2:       # binary margin
+            return z[..., 1] - z[..., 0]
+        return None                # multiclass: no scalar score
+
 
 class OpLogisticRegression(_LinearPredictor):
     """Multinomial/binary logistic regression (softmax NLL + elastic net).
@@ -399,16 +505,14 @@ class OpLogisticRegression(_LinearPredictor):
 
     _NEWTON_MAX_D = 2048
 
-    def _newton_ok(self, params, X, y, n_classes: Optional[int] = None
-                   ) -> bool:
+    def _newton_ok(self, params, d: int, n_classes: int) -> bool:
         return (float(params.get("elastic_net_param", 0.0)) == 0.0
-                and int(X.shape[1]) <= self._NEWTON_MAX_D
-                and (n_classes if n_classes is not None
-                     else self._n_classes(y)) == 2)
+                and int(d) <= self._NEWTON_MAX_D
+                and n_classes == 2)
 
     def fit_arrays(self, X, y, w, params):
         params = {**self.params, **params}
-        if self._newton_ok(params, X, y):
+        if self._newton_ok(params, X.shape[1], self._n_classes(y)):
             W, b, _ = _train_logistic_newton(
                 X, y, w, jnp.float32(params["reg_param"]),
                 fit_intercept=bool(params["fit_intercept"]),
@@ -422,7 +526,7 @@ class OpLogisticRegression(_LinearPredictor):
         merged = [{**self.params, **g} for g in grid]
         n_classes = self._n_classes(y)  # ONE device sync for the whole grid
         newton_idx = [i for i, g in enumerate(merged)
-                      if self._newton_ok(g, X, y, n_classes)]
+                      if self._newton_ok(g, X.shape[1], n_classes)]
         if not newton_idx:
             return super().grid_fit_arrays(X, y, w, grid)
         adam_idx = [i for i in range(len(grid)) if i not in set(newton_idx)]
@@ -456,6 +560,52 @@ class OpLogisticRegression(_LinearPredictor):
             for j, i in enumerate(adam_idx):
                 models[i] = rest[j]
         return models
+
+    def _fold_stacked_params(self, X, y, w, grid):
+        """Fold-stacked LR sweep: the Newton points vmap over (fold x
+        reg_param) — one second-order program for the whole family's
+        workhorse grid across every fold — and the L1/multiclass rest rides
+        the fold-stacked Adam path. Same point-by-point routing as the
+        per-fold ``grid_fit_arrays``, so both paths pick identical
+        optimizers for every grid point (sweep-parity requirement)."""
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        merged = [{**self.params, **g} for g in grid]
+        n_classes = self._n_classes(y)  # ONE device sync for the family
+        d = int(X.shape[2])
+        k = int(X.shape[0])
+        newton_idx = [i for i, g in enumerate(merged)
+                      if self._newton_ok(g, d, n_classes)]
+        if not newton_idx:
+            return super()._fold_stacked_params(X, y, w, grid)
+        adam_idx = [i for i in range(len(grid)) if i not in set(newton_idx)]
+        parts, order = [], []
+        by_flags: dict[tuple[bool, bool], list[int]] = {}
+        for i in newton_idx:
+            key = (bool(merged[i]["fit_intercept"]),
+                   bool(merged[i]["standardization"]))
+            by_flags.setdefault(key, []).append(i)
+        for (fit_b, std_b), idxs in by_flags.items():
+            rp = jnp.asarray([merged[i]["reg_param"] for i in idxs],
+                             jnp.float32)
+            if not pmesh.fold_axis_on_model(k):
+                rp, = _shard_candidates(rp)
+            inner = lambda Xk, yk, wk: jax.vmap(  # noqa: E731
+                lambda r: _train_logistic_newton(
+                    Xk, yk, wk, r, fit_intercept=fit_b,
+                    standardize=std_b))(rp)
+            Ws, bs, _ = jax.vmap(inner)(X, y, w)  # [k, g, ...]
+            from transmogrifai_tpu.utils import flops
+            n = int(X.shape[1])
+            flops.add("linear", k * len(idxs) * 15 * (
+                4.0 * n * (d + 1) + 2.0 * n * (d + 1) ** 2
+                + (2.0 / 3.0) * (d + 1) ** 3))
+            parts.append((Ws, bs))
+            order.extend(idxs)
+        if adam_idx:
+            parts.append(super()._fold_stacked_params(
+                X, y, w, [grid[i] for i in adam_idx]))
+            order.extend(adam_idx)
+        return _merge_grid_parts(parts, order)
 
 
 class OpLinearSVC(_LinearPredictor):

@@ -119,25 +119,45 @@ def _hist_mode_for(Xb) -> str:
     except Exception:
         single = True
 
-    def sharded_route() -> str:
+    def sharded_route() -> tuple[str, str]:
         # multi-device input: the sorted engine needs the explicit
         # shard_map wrapper, which requires an active mesh and a row
         # count divisible by the data axis (what shard_training_rows
         # produces); anything else keeps the GSPMD scatter path, which
-        # accepts replicated/unevenly-sharded inputs
+        # accepts replicated/unevenly-sharded inputs. Returns
+        # (route, downgrade reason or "").
         from transmogrifai_tpu.parallel.mesh import current_mesh
         ctx = current_mesh()
-        if ctx is not None and Xb.shape[0] % ctx.n_data == 0:
-            return "sorted_sharded"
-        return "scatter"
+        if ctx is None:
+            return "scatter", "multi-device input but no active mesh context"
+        if Xb.shape[0] % ctx.n_data:
+            return "scatter", (
+                f"row count {int(Xb.shape[0])} not divisible by the mesh "
+                f"data axis ({ctx.n_data})")
+        return "sorted_sharded", ""
 
     if forced == "sorted":
-        return "sorted" if single else sharded_route()
+        if single:
+            return "sorted"
+        route, why = sharded_route()
+        if route == "scatter":
+            # a forced engine that silently downgrades poisons A/B reruns —
+            # the measurement would time the WRONG engine (ADVICE r5). Loud
+            # by default; TRANSMOGRIFAI_TREE_HIST_STRICT=1 makes it fatal.
+            import warnings
+            msg = (f"TRANSMOGRIFAI_TREE_HIST=sorted downgraded to "
+                   f"'scatter': {why}. Shard the rows via "
+                   "shard_training_rows under an active mesh to keep the "
+                   "sorted engine.")
+            if os.environ.get("TRANSMOGRIFAI_TREE_HIST_STRICT") == "1":
+                raise RuntimeError(msg)
+            warnings.warn(msg, RuntimeWarning)
+        return route
     # auto-select only on TPU: the einsum path trades ~B-times more
     # (MXU-friendly) FLOPs for the serialized scatter, a trade validated
     # on-chip; CPU/GPU keep the scatter path unless forced
     if Xb.shape[0] >= _SORT_MIN_ROWS and jax.default_backend() == "tpu":
-        return "sorted" if single else sharded_route()
+        return "sorted" if single else sharded_route()[0]
     return "scatter"
 
 
@@ -184,6 +204,27 @@ def _sorted_engine_default() -> str:
                 "or 'pallas'")
         return forced
     return "einsum"
+
+
+def _sorted_acc_default() -> str:
+    """Accumulation dtype policy for the sorted path's one-hot histogram
+    contraction. ``"auto"`` (default) keeps the measured TPU choice — bf16
+    one-hot with f32 ``preferred_element_type`` accumulation on chip, f32
+    everywhere else; ``TRANSMOGRIFAI_SORTED_ACC=f32`` forces full-f32
+    operands (the escape hatch when bf16 bin-code/stat rounding is
+    suspected in split decisions — A/B rerun knob, ADVICE r5), and
+    ``=bf16`` forces bf16 operands on any backend (lets a CPU test
+    exercise the TPU numerics). Same static-threading discipline as
+    ``_sorted_engine_default``: consulted once per fit at Python level."""
+    import os
+    forced = os.environ.get("TRANSMOGRIFAI_SORTED_ACC")
+    if forced:
+        if forced not in ("auto", "f32", "bf16"):
+            raise ValueError(
+                f"TRANSMOGRIFAI_SORTED_ACC={forced!r}: expected 'auto', "
+                "'f32' or 'bf16'")
+        return forced
+    return "auto"
 
 
 def _sorted_layout(counts, n: int, C: int):
@@ -328,6 +369,7 @@ def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
                       n_bins: int, reg_lambda, gamma, min_child_weight,
                       block: int = _SORT_BLOCK,
                       sorted_engine: str = "einsum",
+                      sorted_acc: str = "auto",
                       data_axis=None):
     """Sort-based level-wise histogram tree (single-shard hot path).
 
@@ -346,9 +388,19 @@ def _grow_tree_sorted(Xb, grad, hess, feat_mask, *, max_depth: int,
     # bin codes are < B; pack to the narrowest gatherable int so the
     # per-level row gather moves 4x fewer bytes
     Xb_n = Xb.astype(jnp.int8) if B <= 127 else Xb.astype(jnp.int32)
-    acc_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
-        else jnp.float32
+    if sorted_acc == "f32":
+        acc_dtype = jnp.float32
+    elif sorted_acc == "bf16":
+        acc_dtype = jnp.bfloat16
+    else:  # auto: the measured on-chip default
+        acc_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
+            else jnp.float32
     engine = sorted_engine
+    if engine == "pallas" and acc_dtype == jnp.float32 \
+            and jax.default_backend() == "tpu":
+        # the fused kernel's one-hot broadcast is bf16-only; a forced-f32
+        # accumulation must really accumulate in f32, so take the XLA path
+        engine = "einsum"
     split_kw = dict(n_bins=B, reg_lambda=reg_lambda, gamma=gamma,
                     min_child_weight=min_child_weight)
     order = jnp.arange(n, dtype=jnp.int32)
@@ -440,11 +492,12 @@ def _best_splits(hist_g, hist_h, feat_mask, *, n_bins, reg_lambda, gamma,
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_bins",
                                              "max_hist_nodes",
                                              "hist", "sorted_engine",
-                                             "data_axis"))
+                                             "sorted_acc", "data_axis"))
 def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
               reg_lambda, gamma, min_child_weight,
               max_hist_nodes: int = _MAX_HIST_NODES, hist: str = "scatter",
-              sorted_engine: str = "einsum", data_axis=None):
+              sorted_engine: str = "einsum", sorted_acc: str = "auto",
+              data_axis=None):
     """Level-wise histogram tree. Returns (feats, bins, leaf_values,
     feat_gain, row_pred): feats/bins are tuples of per-level [2^level]
     arrays, leaf_values is [2^max_depth], feat_gain is the [d] per-feature
@@ -475,7 +528,7 @@ def grow_tree(Xb, grad, hess, feat_mask, *, max_depth: int, n_bins: int,
             Xb, grad, hess, feat_mask, max_depth=max_depth, n_bins=n_bins,
             reg_lambda=reg_lambda, gamma=gamma,
             min_child_weight=min_child_weight, sorted_engine=sorted_engine,
-            data_axis=data_axis)
+            sorted_acc=sorted_acc, data_axis=data_axis)
     if hist != "scatter":
         raise ValueError(f"hist={hist!r}: expected 'scatter' or 'sorted'")
     if data_axis is not None:
@@ -585,14 +638,14 @@ def predict_tree(Xb, feats, bins, leaf_values):
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "max_depth", "n_bins", "n_out", "loss", "seed",
     "bootstrap", "subsample", "colsample", "max_hist_nodes",
-    "hist", "sorted_engine", "data_axis"))
+    "hist", "sorted_engine", "sorted_acc", "data_axis"))
 def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                    n_out: int, loss: str, learning_rate, reg_lambda, gamma,
                    min_child_weight, subsample, colsample, base_score,
                    bootstrap: bool, seed: int,
                    max_hist_nodes: int = _MAX_HIST_NODES,
                    hist: str = "scatter", sorted_engine: str = "einsum",
-                   data_axis=None):
+                   sorted_acc: str = "auto", data_axis=None):
     """Train a whole ensemble in one scanned program.
 
     loss: 'logistic' (n_out=1), 'softmax' (n_out=K one-vs-all), 'squared'.
@@ -651,6 +704,7 @@ def train_ensemble(Xb, y, w, *, n_rounds: int, max_depth: int, n_bins: int,
                              min_child_weight=min_child_weight,
                              max_hist_nodes=max_hist_nodes, hist=hist,
                              sorted_engine=sorted_engine,
+                             sorted_acc=sorted_acc,
                              data_axis=data_axis)
 
         feats, bins, leaves, gains, preds = jax.vmap(
@@ -689,13 +743,13 @@ def train_ensemble_sharded(ctx, Xb, y, w, **kw):
     (trees, gains) as ``train_ensemble``, replicated.
     """
     from jax.sharding import PartitionSpec as P
-    from transmogrifai_tpu.parallel.mesh import DATA_AXIS
+    from transmogrifai_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
 
     def shard_fn(Xb_s, y_s, w_s):
         return train_ensemble(Xb_s, y_s, w_s, hist="sorted",
                               data_axis=DATA_AXIS, **kw)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         shard_fn, mesh=ctx.mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(), check_vma=False)
@@ -934,7 +988,8 @@ class _TreePredictor(Predictor):
             colsample=float(p["colsample"]),
             base_score=jnp.float32(base),
             bootstrap=self.bootstrap, seed=int(p["seed"]),
-            sorted_engine=_sorted_engine_default())
+            sorted_engine=_sorted_engine_default(),
+            sorted_acc=_sorted_acc_default())
         if hist_mode == "sorted_sharded":
             from transmogrifai_tpu.parallel.mesh import current_mesh
             trees, gains = train_ensemble_sharded(current_mesh(), Xb, y, w,
@@ -953,11 +1008,41 @@ class _TreePredictor(Predictor):
         return model
 
 
-    def grid_fit_arrays(self, X, y, w, grid):
+    def fold_sweep_plan(self, X, grid):
+        """Dataset-level binning context for the selector's per-fold sweep:
+        ``{max_bins: (edges, codes [n, d], max_bins)}`` computed ONCE on the
+        full prepared training matrix; each fold's codes are then a cheap
+        row gather instead of a fresh device quantile sort + searchsorted
+        per fold (the sweep's k-fold re-binning was pure waste — edges
+        barely move between a fold's (1 - 1/k) subset and the full matrix).
+
+        Documented ``bin_once`` approximation: fold edges come from the
+        whole training matrix, the XGBoost global-sketch analog; metrics
+        shift by sub-bin-width amounts. ``TRANSMOGRIFAI_TREE_BIN_ONCE=0``
+        disables the plan and restores exact per-fold quantile edges.
+        Returns None when disabled."""
+        import os
+        if os.environ.get("TRANSMOGRIFAI_TREE_BIN_ONCE", "1") == "0":
+            return None
+        merged = [{self._ALIASES.get(k, k): v for k, v in g.items()}
+                  for g in grid]
+        plan: dict[int, tuple] = {}
+        for g in merged:
+            mb = int({**self.default_params, **self.params, **g}["max_bins"])
+            if mb not in plan:
+                edges = self._edges_of(X, mb)
+                plan[mb] = (edges, bin_data(X, edges), mb)
+        return plan
+
+    def grid_fit_arrays(self, X, y, w, grid, _fold_plan=None,
+                        _fold_rows=None):
         """Sequential grid (tree programs differ per static depth/rounds),
         but quantile-bin ONCE per (fold, family): edges depend only on X and
         max_bins, so grid points sharing max_bins reuse one binned matrix
-        instead of paying a device sort + searchsorted each."""
+        instead of paying a device sort + searchsorted each. With a
+        ``_fold_plan`` (the selector's per-dataset ``fold_sweep_plan``) the
+        binning collapses further to one row gather of the dataset-level
+        codes (``_fold_rows`` are this fold's training row ids)."""
         merged = [{self._ALIASES.get(k, k): v for k, v in g.items()}
                   for g in grid]
         binned: dict[int, tuple] = {}
@@ -966,8 +1051,15 @@ class _TreePredictor(Predictor):
         for g in merged:
             mb = int({**self.default_params, **self.params, **g}["max_bins"])
             if mb not in binned:
-                edges = self._edges_of(X, mb)
-                binned[mb] = (edges, bin_data(X, edges), mb)
+                if _fold_plan is not None and _fold_rows is not None \
+                        and mb in _fold_plan:
+                    edges, codes_full, _ = _fold_plan[mb]
+                    binned[mb] = (edges,
+                                  jnp.take(codes_full, _fold_rows, axis=0),
+                                  mb)
+                else:
+                    edges = self._edges_of(X, mb)
+                    binned[mb] = (edges, bin_data(X, edges), mb)
             models.append(self.fit_arrays(X, y, w, {**self.params, **g},
                                           _binned=binned[mb], _lnb=lnb))
         return models

@@ -24,7 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from transmogrifai_tpu.parallel.mesh import DATA_AXIS, MeshContext
+from transmogrifai_tpu.parallel.mesh import (
+    DATA_AXIS, MeshContext, shard_map_compat,
+)
 
 __all__ = ["tree_psum", "tree_pmax", "tree_pmin", "mesh_reduce_stats"]
 
@@ -65,6 +67,6 @@ def mesh_reduce_stats(ctx: MeshContext,
     def shard_fn(*args):
         return combine(local_stats_fn(*args))
 
-    fn = jax.shard_map(shard_fn, mesh=ctx.mesh, in_specs=in_specs,
-                       out_specs=P())
+    fn = shard_map_compat(shard_fn, mesh=ctx.mesh, in_specs=in_specs,
+                          out_specs=P())
     return fn(*row_sharded_args)

@@ -27,7 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "MeshContext", "make_mesh", "use_mesh", "current_mesh", "row_sharding",
     "replicated", "pad_rows", "shard_rows", "num_data_shards",
-    "pad_and_shard_rows", "shard_training_rows",
+    "pad_and_shard_rows", "shard_training_rows", "fold_axis_on_model",
+    "shard_stacked_training_rows", "shard_map_compat",
 ]
 
 DATA_AXIS = "data"
@@ -152,6 +153,69 @@ def pad_and_shard_rows(arr, pad_value=0.0):
             import jax.numpy as jnp
             arr = jnp.pad(arr, width, constant_values=pad_value)
     return shard_rows(arr)
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions: >= 0.5 exposes it top-level
+    with ``check_vma``; older releases ship it as
+    ``jax.experimental.shard_map.shard_map`` with the equivalent knob named
+    ``check_rep``. Every explicit-collective program in the framework (tree
+    histogram all-reduce, monoid stats reduction) routes through here so
+    the distributed substrate works on both."""
+    kw = {}
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def fold_axis_on_model(k: int) -> bool:
+    """True when a stacked fold axis of size ``k`` rides the mesh "model"
+    axis (it must divide it evenly). The ModelSelector's fold-stacked sweep
+    uses this to pick which of its two candidate-parallel axes (fold vs
+    grid) the "model" axis shards: folds win when they divide; otherwise the
+    grid scalars take the axis (``_shard_candidates``) and folds replicate."""
+    ctx = current_mesh()
+    return ctx is not None and ctx.n_model > 1 and k % ctx.n_model == 0
+
+
+def shard_stacked_training_rows(X, y, w):
+    """Fold-stacked ([k, n, ...]) analog of ``shard_training_rows``: the
+    ROW axis (axis 1) pads to the data-axis multiple with weight 0 and
+    shards over "data"; the leading FOLD axis shards over "model" when it
+    divides that axis (``fold_axis_on_model``), else replicates. This is
+    the 2-D placement of the ModelSelector's (fold x grid) work units:
+    rows over "data", fold/grid candidates over "model" (SURVEY §2.7
+    P1 + P3 combined). No-op without an active mesh."""
+    ctx = current_mesh()
+    if ctx is None:
+        return X, y, w
+    import jax.numpy as jnp
+    k = int(X.shape[0])
+    n = int(X.shape[1])
+    n_pad = pad_rows(n, ctx.n_data)
+
+    def pad1(a, val):
+        if n_pad == n:
+            return a
+        width = [(0, 0), (0, n_pad - n)] + [(0, 0)] * (a.ndim - 2)
+        if isinstance(a, np.ndarray):
+            return np.pad(a, width, constant_values=val)
+        return jnp.pad(a, width, constant_values=val)
+
+    fold_ax = MODEL_AXIS if fold_axis_on_model(k) else None
+
+    def put(a):
+        spec = P(fold_ax, DATA_AXIS, *([None] * (a.ndim - 2)))
+        return jax.device_put(a, NamedSharding(ctx.mesh, spec))
+
+    return (put(pad1(X, 0.0)), put(pad1(y, 0.0)), put(pad1(w, 0.0)))
 
 
 def shard_training_rows(X, y, w):

@@ -8,10 +8,15 @@ training data, evaluates train + holdout with every evaluator, and emits a
 ``ModelSelectorSummary``; the fitted stage is a ``SelectedModel`` wrapping
 the winning PredictionModel.
 
-TPU-first (SURVEY §2.7 P3): per fold, each candidate family trains its whole
-hyperparameter grid as one stacked vmapped program (``grid_fit_arrays``);
-folds iterate sequentially (their programs are identical, so compile once,
-run k times). No thread pool, no executor dispatch.
+TPU-first (SURVEY §2.7 P3): each candidate family trains its whole
+hyperparameter grid AND the whole k-fold CV axis as one stacked vmapped
+program (``grid_fit_arrays_folds``) — validation scoring and metrics batch
+over [k, G] so a family costs one dispatch and ONE host sync; the (fold x
+grid) work units shard 2-D over the mesh (rows on "data", candidates on
+"model"). Families without the fold axis (trees, custom subclasses) and
+batches that would not fit HBM fall back to a sequential per-fold loop
+(compile once, run k times). No thread pool, no executor dispatch. See
+PERF.md "Sweep execution model".
 """
 
 from __future__ import annotations
@@ -103,6 +108,12 @@ class ModelSelectorSummary:
             wall_time_s=d.get("wallTimeSeconds", 0.0),
             failures=d.get("failures", []),
         )
+
+
+class _FoldStackFallback(Exception):
+    """Internal: a family opted into the stacked path but produced no
+    batched fold scores (e.g. multiclass margins) — reroute it through the
+    per-fold loop instead of recording a failure."""
 
 
 def _jsonable(x: Any) -> Any:
@@ -214,8 +225,11 @@ class ModelSelector(Estimator):
         #: recorded as failures — provided at least one candidate scored
         self.max_wait_s = max_wait_s
         #: restartable sweep (SURVEY §5 failure-detection aux): completed
-        #: (fold, family) metric batches persist to
-        #: ``checkpoint_dir/sweep.json``; a re-run after a crash skips them.
+        #: metric batches persist to ``checkpoint_dir/sweep.json`` — one
+        #: per-family key with per-fold value vectors on the fold-stacked
+        #: fast path, one (fold, family) key per fold on the fallback loop;
+        #: a re-run after a crash skips them (either key layout resumes
+        #: under either path).
         #: The file carries a fingerprint of the sweep CONFIG (families,
         #: grids, metric, validator) and entries key on the fold's training
         #: shape — a different configuration ignores the stale file. Point
@@ -306,103 +320,355 @@ class ModelSelector(Estimator):
         return (np.arange(n), np.zeros(0, dtype=np.int64),
                 np.ones(n, dtype=np.float32), {})
 
-    def _sweep(self, fold_arrays) -> tuple[list[ModelEvaluation],
-                                           list[tuple[float, int, int]],
-                                           list[dict]]:
-        """Run every (candidate, grid point) over the fold arrays; returns
-        per-candidate evaluations, (mean metric, cand, grid) triples, and
-        recorded failures.
+    # -- sweep ---------------------------------------------------------------
+    def _family_name(self, ci: int) -> str:
+        return f"{type(self.models_and_grids[ci][0]).__name__}_{ci}"
 
-        Failure isolation (reference OpValidator.scala:108 maxWait +
-        failed-future handling): a candidate family that raises on a fold —
-        after transient device errors are retried — is recorded and skipped,
-        never aborting the sweep; families starting past the ``max_wait_s``
-        budget are skipped once at least one candidate has scored; grid
-        points whose metric comes back non-finite (diverged fit) are
-        excluded from winner selection but still reported.
-        """
+    @staticmethod
+    def _stacked_enabled() -> bool:
+        """The fold-stacked fast path defaults ON where its win lives —
+        accelerator backends and active meshes (the saving is k x fewer
+        dispatches + host syncs, which a tunneled TPU pays in round trips)
+        — and OFF on plain single-device CPU, where the microbench
+        (benchmarks/FOLD_STACKED_SWEEP.json) measures the batched program
+        ~0.9x the per-fold loop. ``TRANSMOGRIFAI_SWEEP_STACKED=1``/``0``
+        forces either way (A/B reruns, parity checks)."""
+        import os
+        env = os.environ.get("TRANSMOGRIFAI_SWEEP_STACKED")
+        if env is not None:
+            return env != "0"
         from transmogrifai_tpu.parallel import mesh as pmesh
+        if pmesh.current_mesh() is not None:
+            return True
+        import jax
+        return jax.default_backend() != "cpu"
+
+    @staticmethod
+    def _stacked_hbm_budget() -> float:
+        """Byte budget for one family's stacked fold batch.
+        ``TRANSMOGRIFAI_SWEEP_HBM_BUDGET`` overrides; otherwise half the
+        device's reported memory limit, or 4 GiB when the backend exposes
+        none (CPU)."""
+        import os
+        env = os.environ.get("TRANSMOGRIFAI_SWEEP_HBM_BUDGET")
+        if env:
+            return float(env)
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = float(stats.get("bytes_limit", 0))
+            if limit > 0:
+                return 0.5 * limit
+        except Exception:
+            pass
+        return float(4 << 30)
+
+    def _stacked_fits_memory(self, k: int, n_tr: int, n_va: int, d: int,
+                             est, grid) -> bool:
+        """HBM guard for the fold-stacked batch: the k-fold training gather
+        (plus a standardized/derived copy and the gradient residency the
+        trainers materialize), the stacked validation folds, AND the
+        per-grid-lane intermediates the vmapped trainer keeps live (scales
+        with k x G x rows x the family's per-row lane width — scores,
+        logits, activations) must fit the budget, else the sweep falls back
+        to the per-fold loop whose peak is 1/k of this."""
+        G = max(len(grid), 1)
+        width = est.fold_stack_unit_width(grid)
+        need = (4.0 * k * n_tr * max(d, 1) * 3.0
+                + 4.0 * k * n_va * max(d, 1)
+                + 4.0 * k * (n_tr + n_va) * G * width)
+        return need <= self._stacked_hbm_budget()
+
+    def _sweep(self, Xt, yt, wt, yt_np) -> tuple[list[ModelEvaluation],
+                                                 list[tuple[float, int, int]],
+                                                 list[dict]]:
+        """Run every (candidate family, grid point) over the validator's
+        fold plan; returns per-candidate evaluations, (mean metric, cand,
+        grid) triples, and recorded failures.
+
+        Execution model (PERF.md "Sweep execution"): per family, the FAST
+        path stacks the CV axis — all k folds x |grid| points train as one
+        compiled program (``grid_fit_arrays_folds``), validation scores and
+        metrics batch over [k, G], and the family costs exactly ONE host
+        sync. Work units shard 2-D over the mesh (rows on "data",
+        fold/grid candidates on "model"). A family falls back to the
+        per-fold loop when it has no fold axis (``supports_fold_stacking``
+        False — including subclasses that override the per-fold trainers),
+        when the evaluator has no fold-batched metric, when the stacked
+        batch would blow the HBM guard, or when scoring returns no batched
+        scalar (multiclass).
+
+        Semantics preserved exactly from the per-fold loop: failure
+        isolation per family, the ``max_wait_s`` budget, checkpoint/restart
+        (stacked families checkpoint one per-family key carrying per-fold
+        value vectors), and non-finite-metric exclusion.
+        """
+        from transmogrifai_tpu.models.base import supports_fold_stacking
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.profiling import sweep_counters
+        from transmogrifai_tpu.utils.retry import with_device_retry
+        n = int(Xt.shape[0])
+        d = int(Xt.shape[1])
+        try:
+            tr_idx, va_idx = self.validator.stacked_splits(n, yt_np)
+        except ValueError:
+            # custom validator with unequal fold shapes: no fold axis exists
+            return self._sweep_loop(
+                self._fold_arrays_iter(Xt, yt, wt, yt_np))
+        k, n_tr = tr_idx.shape
+        n_va = int(va_idx.shape[1])
+        ev0 = self.evaluators[0]
+        fold_metrics = getattr(ev0, "metric_batch_scores_folds", None)
+        per_candidate_scores: dict[tuple[int, int], list[float]] = {}
+        failures: list[dict] = []
+        deadline = (time.time() + self.max_wait_s
+                    if self.max_wait_s is not None else None)
+        done = self._ckpt_load()
+        n_tr_pad = pmesh.pad_rows(n_tr)
+        stacked_data = None  # built on the first stacked-capable family
+
+        for ci, (est, grid) in enumerate(self.models_and_grids):
+            fname = self._family_name(ci)
+            skey = f"{ci}:stacked:{k}x{n_tr}x{d}"
+            if skey in done and len(done[skey]) == k * len(grid):
+                # restart path: this family's whole fold batch already
+                # scored under the per-family stacked key (fold-major)
+                for f in range(k):
+                    for gj in range(len(grid)):
+                        per_candidate_scores.setdefault((ci, gj), []).append(
+                            float(done[skey][f * len(grid) + gj]))
+                sweep_counters.count(fname, mode="resumed")
+                continue
+            fold_keys = [f"{f}:{ci}:{n_tr_pad}x{d}" for f in range(k)]
+            if all(fk in done and len(done[fk]) == len(grid)
+                   for fk in fold_keys):
+                # restart path: a previous per-fold-loop run completed this
+                # family fold by fold
+                for fk in fold_keys:
+                    for gj, val in enumerate(done[fk]):
+                        per_candidate_scores.setdefault((ci, gj), []).append(
+                            float(val))
+                sweep_counters.count(fname, mode="resumed")
+                continue
+            if self._deadline_skip(ci, grid, deadline, per_candidate_scores,
+                                   failures, pop=False):
+                continue
+            use_stacked = (self._stacked_enabled()
+                           and fold_metrics is not None
+                           and supports_fold_stacking(est)
+                           and self._stacked_fits_memory(k, n_tr, n_va, d,
+                                                         est, grid))
+            if use_stacked:
+                if stacked_data is None:
+                    # one device gather builds the whole fold batch — no
+                    # per-fold Xtr materialization on host; training rows
+                    # pad+shard 2-D over the mesh (rows on "data", folds on
+                    # "model" when they divide it); validation folds stay
+                    # unpadded — metrics must see real rows only
+                    jtr = jnp.asarray(tr_idx)
+                    jva = jnp.asarray(va_idx)
+                    stacked_data = (
+                        pmesh.shard_stacked_training_rows(
+                            jnp.take(Xt, jtr, axis=0),
+                            jnp.take(yt, jtr, axis=0),
+                            jnp.take(wt, jtr, axis=0))
+                        + (jnp.take(Xt, jva, axis=0),
+                           jnp.take(yt, jva, axis=0)))
+                Xtr_s, ytr_s, wtr_s, Xva_s, yva_s = stacked_data
+                try:
+                    with sweep_counters.tracking(fname):
+                        # fused unit: stacked train + stacked scores in one
+                        # call (no per-(fold, grid) model materialization —
+                        # the sweep discards models; the winner refits)
+                        scores = with_device_retry(
+                            est.grid_scores_folds, Xtr_s, ytr_s, wtr_s,
+                            grid, Xva_s)
+                        if scores is None:
+                            raise _FoldStackFallback()
+                        # ONE host sync: metrics for every (fold, grid)
+                        # unit of the family come back as one [k, G] pull
+                        vals_kg = fold_metrics(yva_s, scores,
+                                               self.validation_metric)
+                except _FoldStackFallback:
+                    use_stacked = False  # family lacks the axis: fold loop
+                except Exception as e:  # noqa: BLE001 — isolation by design
+                    failures.append({
+                        "modelName": fname,
+                        "reason": f"stacked sweep: {type(e).__name__}: "
+                                  f"{str(e)[:300]}"})
+                    continue
+                else:
+                    flat = [float(v) for v in np.asarray(vals_kg).reshape(-1)]
+                    for f in range(k):
+                        for gj in range(len(grid)):
+                            per_candidate_scores.setdefault(
+                                (ci, gj), []).append(flat[f * len(grid) + gj])
+                    sweep_counters.count(fname, dispatches=1, host_syncs=1,
+                                         mode="fold_stacked")
+                    done[skey] = flat
+                    self._ckpt_save(done)
+                    continue
+            # ---- per-fold fallback loop for this family --------------------
+            self._family_fold_loop(
+                ci, est, grid, Xt, yt, wt, tr_idx, va_idx, done, deadline,
+                per_candidate_scores, failures)
+        return self._collect_results(per_candidate_scores, failures)
+
+    def _deadline_skip(self, ci, grid, deadline, per_candidate_scores,
+                       failures, pop: bool) -> bool:
+        """True when the family must be skipped for exceeding the
+        ``max_wait_s`` budget (reference maxWait) — never when it is the
+        only family with any chance of scoring (a winner must survive).
+        ``pop`` drops partial fold scores (a partial-fold mean must not
+        compete against full-fold means)."""
+        if deadline is None or time.time() <= deadline:
+            return False
+        if not any(kk[0] != ci for kk in per_candidate_scores):
+            return False
+        if pop:
+            for gj in range(len(grid)):
+                per_candidate_scores.pop((ci, gj), None)
+        failures.append({
+            "modelName": self._family_name(ci),
+            "reason": f"skipped: sweep exceeded max_wait_s="
+                      f"{self.max_wait_s}"})
+        return True
+
+    def _run_fold_unit(self, ci, est, grid, fold_i, Xtr, ytr, wtr, Xva, yva,
+                       done, deadline, per_candidate_scores, failures,
+                       fit_kwargs=None) -> bool:
+        """One (fold, family) train+score+record unit — the shared body of
+        the stacked sweep's fallback loop and the legacy fold-major loop:
+        checkpoint replay, the mid-family ``max_wait_s`` check (after
+        replay — replaying is free and never skipped), failure isolation,
+        counter bookkeeping. ``Xtr``/``ytr``/``wtr`` arrive mesh-sharded.
+        Returns False when the family is dropped (failed or past budget) —
+        the caller skips its remaining folds."""
+        from transmogrifai_tpu.utils.profiling import sweep_counters
         from transmogrifai_tpu.utils.retry import with_device_retry
         ev0 = self.evaluators[0]
         batch_metrics = getattr(ev0, "metric_batch_scores", None)
+        fname = self._family_name(ci)
+        ckey = f"{fold_i}:{ci}:{int(Xtr.shape[0])}x{int(Xtr.shape[1])}"
+        if ckey in done and len(done[ckey]) == len(grid):
+            # restart path: this (fold, family) batch already scored
+            for gj, val in enumerate(done[ckey]):
+                per_candidate_scores.setdefault((ci, gj), []).append(
+                    float(val))
+            return True
+        if self._deadline_skip(ci, grid, deadline, per_candidate_scores,
+                               failures, pop=True):
+            return False
+        try:
+            with sweep_counters.tracking(fname):
+                models = with_device_retry(
+                    est.grid_fit_arrays, Xtr, ytr, wtr, grid,
+                    **(fit_kwargs or {}))
+                scores = (est.grid_predict_scores(models, Xva)
+                          if batch_metrics is not None else None)
+                if scores is not None:
+                    # one device program scores + one computes the metric
+                    # for the whole grid; a single host sync per
+                    # (fold, family)
+                    vals = [float(v) for v in batch_metrics(
+                        yva, scores, self.validation_metric)]
+                    sweep_counters.count(fname, dispatches=1,
+                                         host_syncs=1, mode="fold_loop")
+                else:
+                    vals = []
+                    for model in models:
+                        pred = model.predict_arrays(Xva)
+                        # summary-only metric: evaluators skip their
+                        # deep report families inside the sweep
+                        vals.append(ev0.metric_from_arrays(
+                            yva, pred, self.validation_metric))
+                    sweep_counters.count(fname, dispatches=1,
+                                         host_syncs=max(len(grid), 1),
+                                         mode="fold_loop")
+        except Exception as e:  # noqa: BLE001 — isolation by design
+            for gj in range(len(grid)):
+                per_candidate_scores.pop((ci, gj), None)
+            failures.append({
+                "modelName": fname,
+                "reason": f"fold {fold_i}: {type(e).__name__}: "
+                          f"{str(e)[:300]}"})
+            return False
+        # bookkeeping outside the isolation try: a checkpoint I/O problem
+        # must not convert a successful fit into a candidate failure
+        # (_ckpt_save is best-effort anyway)
+        for gj, val in enumerate(vals):
+            per_candidate_scores.setdefault((ci, gj), []).append(val)
+        done[ckey] = vals
+        self._ckpt_save(done)
+        return True
+
+    def _family_fold_loop(self, ci, est, grid, Xt, yt, wt, tr_idx, va_idx,
+                          done, deadline, per_candidate_scores,
+                          failures) -> None:
+        """One family's sequential per-fold sweep (the fallback path and
+        the home of families without a fold axis — tree ensembles, custom
+        subclasses). Tree families still avoid re-binning every fold: a
+        ``fold_sweep_plan`` computes dataset-level quantile codes once and
+        each fold gathers its rows from them."""
+        import inspect
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        plan = None
+        plan_fn = getattr(est, "fold_sweep_plan", None)
+        if (plan_fn is not None and pmesh.current_mesh() is None
+                and "_fold_plan" in inspect.signature(
+                    est.grid_fit_arrays).parameters):
+            plan = plan_fn(Xt, grid)
+        for fold_i in range(tr_idx.shape[0]):
+            jtr = jnp.asarray(tr_idx[fold_i])
+            jva = jnp.asarray(va_idx[fold_i])
+            # row-parallel training over the mesh: fold rows padded to the
+            # data-axis multiple with weight 0 (validation stays unpadded —
+            # metrics must see real rows only)
+            Xtr, ytr, wtr = pmesh.shard_training_rows(
+                Xt[jtr], yt[jtr], wt[jtr])
+            fit_kwargs = ({"_fold_plan": plan, "_fold_rows": jtr}
+                          if plan is not None else None)
+            if not self._run_fold_unit(
+                    ci, est, grid, fold_i, Xtr, ytr, wtr, Xt[jva], yt[jva],
+                    done, deadline, per_candidate_scores, failures,
+                    fit_kwargs=fit_kwargs):
+                return
+
+    def _fold_arrays_iter(self, Xt, yt, wt, yt_np):
+        for tr, va in self.validator.splits(int(Xt.shape[0]), yt_np):
+            jtr, jva = jnp.asarray(tr), jnp.asarray(va)
+            yield Xt[jtr], yt[jtr], wt[jtr], Xt[jva], yt[jva]
+
+    def _sweep_loop(self, fold_arrays) -> tuple[list[ModelEvaluation],
+                                                list[tuple[float, int, int]],
+                                                list[dict]]:
+        """Fold-major sequential sweep over materialized fold arrays — the
+        legacy path, kept for workflow-level CV (``fit_with_dag`` refits
+        feature stages per fold, so fold features differ and cannot stack)
+        and for validators without equal fold shapes. Per-(fold, family)
+        semantics live in the shared ``_run_fold_unit``."""
+        from transmogrifai_tpu.parallel import mesh as pmesh
         per_candidate_scores: dict[tuple[int, int], list[float]] = {}
         failures: list[dict] = []
         failed_families: set[int] = set()
         deadline = (time.time() + self.max_wait_s
                     if self.max_wait_s is not None else None)
-
-        def family_name(ci):
-            return f"{type(self.models_and_grids[ci][0]).__name__}_{ci}"
-
         done = self._ckpt_load()
         for fold_i, (Xtr, ytr, wtr, Xva, yva) in enumerate(fold_arrays):
-            # row-parallel training over the mesh: fold rows padded to the
-            # data-axis multiple with weight 0 (validation stays unpadded —
-            # metrics must see real rows only)
             Xtr, ytr, wtr = pmesh.shard_training_rows(Xtr, ytr, wtr)
             for ci, (est, grid) in enumerate(self.models_and_grids):
                 if ci in failed_families:
                     continue
-                ckey = (f"{fold_i}:{ci}:"
-                        f"{int(Xtr.shape[0])}x{int(Xtr.shape[1])}")
-                if ckey in done and len(done[ckey]) == len(grid):
-                    # restart path: this (fold, family) batch already scored
-                    for gj, val in enumerate(done[ckey]):
-                        per_candidate_scores.setdefault((ci, gj), []).append(
-                            float(val))
-                    continue
-                if deadline is not None and time.time() > deadline:
-                    # drop the family entirely (pop partial fold scores, as
-                    # the exception path does — a partial-fold mean must not
-                    # compete against full-fold means), unless it is the
-                    # only family with any score: a winner must survive
-                    others_scored = any(k[0] != ci
-                                        for k in per_candidate_scores)
-                    if others_scored:
-                        for gj in range(len(grid)):
-                            per_candidate_scores.pop((ci, gj), None)
-                        failed_families.add(ci)
-                        failures.append({
-                            "modelName": family_name(ci),
-                            "reason": f"skipped: sweep exceeded max_wait_s="
-                                      f"{self.max_wait_s}"})
-                        continue
-                try:
-                    models = with_device_retry(
-                        est.grid_fit_arrays, Xtr, ytr, wtr, grid)
-                    scores = (est.grid_predict_scores(models, Xva)
-                              if batch_metrics is not None else None)
-                    if scores is not None:
-                        # fast path: one device program scores + one computes
-                        # the metric for the whole grid; a single host sync
-                        # per (fold, family)
-                        vals = [float(v) for v in batch_metrics(
-                            yva, scores, self.validation_metric)]
-                    else:
-                        vals = []
-                        for model in models:
-                            pred = model.predict_arrays(Xva)
-                            # summary-only metric: evaluators skip their
-                            # deep report families inside the sweep
-                            vals.append(ev0.metric_from_arrays(
-                                yva, pred, self.validation_metric))
-                except Exception as e:  # noqa: BLE001 — isolation by design
+                if not self._run_fold_unit(
+                        ci, est, grid, fold_i, Xtr, ytr, wtr, Xva, yva,
+                        done, deadline, per_candidate_scores, failures):
                     failed_families.add(ci)
-                    for gj in range(len(grid)):
-                        per_candidate_scores.pop((ci, gj), None)
-                    failures.append({
-                        "modelName": family_name(ci),
-                        "reason": f"fold {fold_i}: {type(e).__name__}: "
-                                  f"{str(e)[:300]}"})
-                else:
-                    # bookkeeping outside the isolation try: a checkpoint
-                    # I/O problem must not convert a successful fit into a
-                    # candidate failure (_ckpt_save is best-effort anyway)
-                    for gj, val in enumerate(vals):
-                        per_candidate_scores.setdefault((ci, gj), []).append(
-                            val)
-                    done[ckey] = vals
-                    self._ckpt_save(done)
+        return self._collect_results(per_candidate_scores, failures)
+
+    def _collect_results(self, per_candidate_scores, failures
+                         ) -> tuple[list[ModelEvaluation],
+                                    list[tuple[float, int, int]],
+                                    list[dict]]:
         results: list[ModelEvaluation] = []
         mean_metrics: list[tuple[float, int, int]] = []
         for (ci, gj), vals in per_candidate_scores.items():
@@ -491,13 +757,8 @@ class ModelSelector(Estimator):
                  if getattr(self.validator, "stratify", False) else None)
         t1 = time.time()
 
-        def fold_arrays():
-            for tr, va in self.validator.splits(int(Xt.shape[0]), yt_np):
-                jtr, jva = jnp.asarray(tr), jnp.asarray(va)
-                yield Xt[jtr], yt[jtr], wt[jtr], Xt[jva], yt[jva]
-
         with profiler.phase(OpStep.CROSS_VALIDATION):
-            results, mean_metrics, failures = self._sweep(fold_arrays())
+            results, mean_metrics, failures = self._sweep(Xt, yt, wt, yt_np)
         _plog("selector: CV sweep", t1)
         t1 = time.time()
         Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
@@ -553,7 +814,9 @@ class ModelSelector(Estimator):
                        d_va2.device_col(feat_name).values[:n_va],
                        d_va2.device_col(label_name).values[:n_va])
 
-        results, mean_metrics, failures = self._sweep(fold_arrays())
+        # the in-CV DAG refits per fold, so fold features differ and cannot
+        # stack: workflow-level CV keeps the fold-major loop
+        results, mean_metrics, failures = self._sweep_loop(fold_arrays())
 
         # refit the in-CV feature DAG on the full prepared training rows,
         # then push ALL rows (train + holdout) through it for downstream use

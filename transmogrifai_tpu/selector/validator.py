@@ -25,6 +25,25 @@ class _ValidatorBase:
                ) -> list[tuple[np.ndarray, np.ndarray]]:
         raise NotImplementedError
 
+    def stacked_splits(self, n: int, y: Optional[np.ndarray] = None
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """The fold plan as two stacked index matrices ``(train [k, n_tr],
+        val [k, n_va])`` — the input layout of the ModelSelector's
+        fold-stacked sweep, which gathers all k folds on device in one shot
+        instead of materializing per-fold arrays in a host loop. Relies on
+        the equal-fold-shape guarantee of ``splits`` (every validator here
+        provides it; a custom one that doesn't cannot be stacked)."""
+        splits = self.splits(n, y)
+        tr_sizes = {t.size for t, _ in splits}
+        va_sizes = {v.size for _, v in splits}
+        if len(tr_sizes) != 1 or len(va_sizes) != 1:
+            raise ValueError(
+                f"{type(self).__name__}.splits produced unequal fold shapes "
+                f"(train {sorted(tr_sizes)}, val {sorted(va_sizes)}): the "
+                "fold axis cannot be stacked")
+        return (np.stack([t for t, _ in splits]),
+                np.stack([v for _, v in splits]))
+
     @staticmethod
     def _stratified_folds(y: np.ndarray, n_folds: int, rng) -> np.ndarray:
         """Assign each row a fold id, stratified per label value."""

@@ -28,7 +28,7 @@ from enum import Enum
 from typing import Optional
 
 __all__ = ["OpStep", "AppMetrics", "profiler", "phase",
-           "trace_device_intervals"]
+           "trace_device_intervals", "SweepCounters", "sweep_counters"]
 
 
 class OpStep(Enum):
@@ -169,6 +169,88 @@ class AppMetrics:
                          rows, title=f"{self.app_name} metrics"))
 
 
+@dataclass
+class SweepFamilyCounters:
+    """Per-candidate-family sweep observability (see ``SweepCounters``)."""
+    mode: str = ""              # "fold_stacked" | "fold_loop" | "resumed"
+    compiles: int = 0           # XLA backend compiles while family active
+    device_dispatches: int = 0  # train/score/metric program invocations
+    host_syncs: int = 0         # device->host materializations (metric pulls)
+
+
+class SweepCounters:
+    """ModelSelector sweep observability: per family, how many XLA
+    compiles, device program dispatches, and host syncs the sweep paid.
+
+    Dispatches/syncs are counted at the SELECTOR's call granularity (one
+    ``grid_fit_arrays*`` / scoring call = one dispatch; one metric
+    ``np.asarray`` pull = one sync) — the contract the fold-stacked fast
+    path optimizes: k folds x |grid| points in one dispatch and ONE host
+    sync per family, vs k of each on the per-fold loop. Compiles come from
+    a ``jax.monitoring`` backend-compile listener attributed to whichever
+    family is active inside ``tracking()`` (0 when the monitoring API is
+    unavailable; cache hits from the persistent XLA cache don't count —
+    by design, a warm re-run should report 0 compiles).
+
+    Surfaced by ``bench.py`` under ``device_time_breakdown.sweep`` and
+    asserted in tests (fast path == 1 sync per family)."""
+
+    def __init__(self):
+        self.families: dict = {}  # family name -> SweepFamilyCounters
+        self._active = None
+        self._listening = False
+
+    def reset(self) -> None:
+        self.families = {}
+        self._active = None
+
+    def family(self, name: str) -> SweepFamilyCounters:
+        return self.families.setdefault(name, SweepFamilyCounters())
+
+    def count(self, name: str, *, dispatches: int = 0,
+              host_syncs: int = 0, mode: Optional[str] = None) -> None:
+        fc = self.family(name)
+        fc.device_dispatches += dispatches
+        fc.host_syncs += host_syncs
+        if mode is not None:
+            fc.mode = mode
+
+    def _on_compile(self, event: str, duration: float, **kw) -> None:
+        if (self._active is not None
+                and event == "/jax/core/compile/backend_compile_duration"):
+            self.family(self._active).compiles += 1
+
+    def _ensure_listener(self) -> None:
+        if self._listening:
+            return
+        try:
+            import jax.monitoring as monitoring
+            monitoring.register_event_duration_secs_listener(self._on_compile)
+            self._listening = True
+        except Exception:
+            self._listening = True  # API absent: compiles stay 0, don't retry
+
+    @contextlib.contextmanager
+    def tracking(self, name: str):
+        """Attribute compile events to ``name`` while the block runs."""
+        self._ensure_listener()
+        prev = self._active
+        self._active = name
+        try:
+            yield
+        finally:
+            self._active = prev
+
+    def to_json(self) -> dict:
+        return {name: {"mode": fc.mode, "compiles": fc.compiles,
+                       "deviceDispatches": fc.device_dispatches,
+                       "hostSyncs": fc.host_syncs}
+                for name, fc in self.families.items()}
+
+
+sweep_counters = SweepCounters()
+
+
 class _Profiler:
     def __init__(self):
         self.metrics = AppMetrics()
@@ -180,7 +262,9 @@ class _Profiler:
     def reset(self, app_name: str = "transmogrifai_tpu",
               trace_dir: Optional[str] = None) -> AppMetrics:
         """New metrics object; with ``trace_dir``, starts one jax.profiler
-        trace spanning everything until ``finalize()``."""
+        trace spanning everything until ``finalize()``. Sweep counters
+        reset alongside so a run's counters cover exactly that run."""
+        sweep_counters.reset()
         self.metrics = AppMetrics(app_name=app_name)
         self.trace_dir = trace_dir
         if self._tracing:  # a previous run never finalized: stop its trace
