@@ -219,6 +219,7 @@ def main() -> int:
 
     result = {
         "metric": "criteo_multihost_e2e", "unit": "s",
+        "platform": "cpu",  # this bench forces the CPU-virtual mesh
         "value": round(multi_wall, 2),
         "rows": N_ROWS, "hash_features": HASH_FEATURES,
         "workers": workers, "single_process": single,

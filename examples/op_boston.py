@@ -58,9 +58,16 @@ def boston_frame(n: int = 506, seed: int = 11) -> fr.HostFrame:
 
 
 #: the reference's copy (rowId, crim, zn, indus, chas, nox, rm, age, dis,
-#: rad, tax, ptratio, b, lstat, medv) — BostonHouse.scala field order
-BOSTON_CSV = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
-              "housingData.csv")
+#: rad, tax, ptratio, b, lstat, medv) — BostonHouse.scala field order;
+#: falls back to the committed fixture reconstruction (same format/stats,
+#: scripts/gen_test_fixtures.py) so the quality gates run without the
+#: reference checkout
+_BOSTON_REFERENCE = ("/root/reference/helloworld/src/main/resources/"
+                     "BostonDataset/housingData.csv")
+_BOSTON_FIXTURE = os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "housingData.csv"))
+BOSTON_CSV = _BOSTON_REFERENCE if os.path.exists(_BOSTON_REFERENCE) \
+    else _BOSTON_FIXTURE
 BOSTON_COLUMNS = ("crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
                   "rad", "tax", "ptratio", "b", "lstat")
 

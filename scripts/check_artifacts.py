@@ -1,0 +1,119 @@
+"""Schema-validate committed benchmark artifacts (``benchmarks/*.json``).
+
+The repo's perf story is carried by committed measurement artifacts; a
+"cited but never committed" artifact, or one missing the keys the loaders
+and docs rely on, should fail CI loudly instead of silently reading as a
+measurement. Required of every artifact:
+
+- ``metric`` — what was measured (string)
+- ``platform`` — where (``cpu``/``tpu``/...; the CPU guard in ``bench.py``
+  and ``bench_serving.py`` depends on artifacts being truthful here)
+- a size: ``rows`` or ``requests`` (positive int)
+- a timing: ``wall_s``, ``value``, any ``*_s`` key, or a latency block
+- accelerator artifacts (``platform`` != ``cpu``) must carry a
+  ``code_fingerprint`` — an accel number without provenance against the
+  code that produced it is unverifiable (CPU baselines are exempt,
+  matching ``bench.py._load_bench_artifact``'s contract: hand-committed
+  CPU walls tolerate code drift).
+
+Library use: ``validate_artifact(doc) -> [errors]``; CLI: exits 1 listing
+every violation. Wired into tier-1 via ``tests/test_bench_artifacts.py``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+__all__ = ["validate_artifact", "check_dir"]
+
+
+def _has_timing(doc: dict) -> bool:
+    if isinstance(doc.get("wall_s"), (int, float)):
+        return True
+    if isinstance(doc.get("value"), (int, float)):
+        return True
+    if any(k.endswith("_s") and isinstance(v, (int, float))
+           for k, v in doc.items()):
+        return True
+    lat = doc.get("latency_ms") or doc.get("latencyMs")
+    if isinstance(lat, dict) and any(
+            isinstance(v, (int, float)) for v in lat.values()):
+        return True
+    # rate metrics (throughput benches): *_rps
+    if any(k.endswith("_rps") and isinstance(v, (int, float))
+           for k, v in doc.items()):
+        return True
+    return False
+
+
+def validate_artifact(doc: object) -> list[str]:
+    """Returns a list of schema violations (empty = valid)."""
+    if not isinstance(doc, dict):
+        return ["artifact is not a JSON object"]
+    errors = []
+    if not isinstance(doc.get("metric"), str) or not doc.get("metric"):
+        errors.append("missing/empty 'metric' (what was measured)")
+    platform = doc.get("platform")
+    if not isinstance(platform, str) or not platform:
+        errors.append("missing 'platform' (cpu/tpu/... — the CPU-vs-accel "
+                      "guards depend on it)")
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    if not (pos_int(doc.get("rows")) or pos_int(doc.get("requests"))):
+        errors.append("missing positive int 'rows' or 'requests'")
+    if not _has_timing(doc):
+        errors.append("no timing/rate field (wall_s, value, *_s, *_rps, or "
+                      "a latency_ms block)")
+    if isinstance(platform, str) and platform not in ("", "cpu"):
+        fp = doc.get("code_fingerprint")
+        if not (isinstance(fp, str) and fp):
+            errors.append(
+                f"platform={platform!r} artifact lacks 'code_fingerprint' "
+                "(accelerator results must be traceable to the code that "
+                "produced them)")
+    return errors
+
+
+def check_dir(bench_dir: str) -> dict[str, list[str]]:
+    """{relative path: [errors]} for every ``*.json`` under ``bench_dir``;
+    unparseable files report as a violation, never raise."""
+    out: dict[str, list[str]] = {}
+    for path in sorted(glob.glob(os.path.join(bench_dir, "*.json"))):
+        rel = os.path.relpath(path, os.path.dirname(bench_dir))
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except Exception as e:  # noqa: BLE001 — malformed is a finding
+            out[rel] = [f"unparseable JSON: {type(e).__name__}: {e}"]
+            continue
+        errors = validate_artifact(doc)
+        if errors:
+            out[rel] = errors
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    bench_dir = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks")
+    findings = check_dir(bench_dir)
+    n_files = len(glob.glob(os.path.join(bench_dir, "*.json")))
+    if not findings:
+        print(f"OK: {n_files} artifact(s) under {bench_dir} pass schema "
+              "validation")
+        return 0
+    for rel, errors in findings.items():
+        for e in errors:
+            print(f"FAIL {rel}: {e}")
+    print(f"{sum(map(len, findings.values()))} violation(s) in "
+          f"{len(findings)}/{n_files} artifact(s)")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

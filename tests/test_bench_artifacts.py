@@ -2,7 +2,10 @@
 
 The driver depends on bench.py's always-print-JSON contract; these pin
 the artifact loaders' validation (rows/models match, malformed content
-tolerated, stale code fingerprints rejected) and the atomic saver.
+tolerated, stale code fingerprints rejected) and the atomic saver. The
+second half wires ``scripts/check_artifacts.py`` into tier-1: every
+COMMITTED ``benchmarks/*.json`` must pass schema validation, so a "cited
+but never committed" (or key-starved) artifact fails loudly.
 """
 
 import importlib.util
@@ -11,16 +14,26 @@ import os
 
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-@pytest.fixture()
-def benchmod():
+
+def _load_script(name):
     spec = importlib.util.spec_from_file_location(
-        "bench_under_test",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "bench.py"))
+        name.replace(".py", "").replace("/", "_"),
+        os.path.join(REPO, name))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+@pytest.fixture()
+def benchmod():
+    return _load_script("bench.py")
+
+
+@pytest.fixture()
+def checker():
+    return _load_script("scripts/check_artifacts.py")
 
 
 def _accel_art(m, **over):
@@ -107,6 +120,74 @@ def test_cpu_artifact_requires_cpu_platform(benchmod, tmp_path):
     json.dump(art, open(path, "w"))
     assert m._load_bench_artifact(path, accel_only=False,
                                   require_platform="cpu") is None
+
+
+def test_committed_artifacts_pass_schema(checker):
+    """THE gate: every artifact committed under benchmarks/ validates."""
+    findings = checker.check_dir(os.path.join(REPO, "benchmarks"))
+    assert findings == {}, findings
+    assert checker.main([os.path.join(REPO, "benchmarks")]) == 0
+
+
+def test_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = {"metric": "m", "platform": "cpu", "rows": 10, "wall_s": 1.5}
+    assert v(good) == []
+    assert v({**good, "rows": None, "requests": 4096}) == []
+    # rate-only artifacts (serving bench) validate via *_rps
+    del good["wall_s"]
+    assert v({**good, "batched_rps": 100.0}) == []
+    assert any("timing" in e for e in v(good))
+    assert any("metric" in e for e in v({"platform": "cpu", "rows": 1,
+                                         "wall_s": 1.0}))
+    assert any("platform" in e for e in v({"metric": "m", "rows": 1,
+                                           "wall_s": 1.0}))
+    assert any("rows" in e for e in v({"metric": "m", "platform": "cpu",
+                                       "wall_s": 1.0}))
+    assert any("rows" in e for e in v({"metric": "m", "platform": "cpu",
+                                       "rows": True, "wall_s": 1.0}))
+    assert v(["not", "a", "dict"]) == ["artifact is not a JSON object"]
+    # accel artifacts demand provenance; CPU baselines are exempt
+    accel = {"metric": "m", "platform": "tpu", "rows": 5, "wall_s": 2.0}
+    assert any("code_fingerprint" in e for e in v(accel))
+    assert v({**accel, "code_fingerprint": "abc123def456"}) == []
+
+
+def test_artifact_checker_cli_fails_on_bad_dir(checker, tmp_path):
+    bench = tmp_path / "benchmarks"
+    bench.mkdir()
+    (bench / "BAD.json").write_text('{"metric": "m"}')
+    (bench / "BROKEN.json").write_text("{not json")
+    findings = checker.check_dir(str(bench))
+    assert set(findings) == {os.path.join("benchmarks", "BAD.json"),
+                             os.path.join("benchmarks", "BROKEN.json")}
+    assert any("unparseable" in e
+               for e in findings[os.path.join("benchmarks", "BROKEN.json")])
+    assert checker.main([str(bench)]) == 1
+
+
+def test_serving_artifact_committed_and_healthy(checker):
+    """The serving bench's acceptance contract, pinned on the COMMITTED
+    artifact: >=10x micro-batched-jit-scorer vs row-closure throughput at
+    batch 256 (engine vs engine — neither side queues), the end-to-end
+    server number and latency percentiles recorded alongside, and 0
+    post-warmup compiles per padding bucket."""
+    path = os.path.join(REPO, "benchmarks", "SERVING.json")
+    assert os.path.exists(path), "benchmarks/SERVING.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "online_serving_microbatch"
+    assert art["max_batch"] == 256
+    assert art["ok"] is True
+    assert art["speedup"] >= 10.0               # scorer vs row closure
+    assert art["scorer_rps"] > art["row_path_rps"]
+    assert art["server_rps"] > art["row_path_rps"]  # end-to-end still wins
+    for k in ("p50", "p95", "p99"):
+        assert isinstance(art["latency_ms"][k], (int, float))
+    assert art["buckets"], "per-bucket compile accounting missing"
+    for b in art["buckets"]:
+        assert b["post_warmup_compiles"] == 0, b
+    assert art["parity_max_abs_diff"] < 1e-4
 
 
 def test_device_breakdown_surfaces_sweep_counters(benchmod):

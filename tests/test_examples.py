@@ -75,9 +75,11 @@ def test_boston_example_trains_accurately():
 
 
 def test_iris_real_data_quality_gate():
-    """REAL UCI iris (the reference's helloworld dataset): the default
-    multiclass sweep must reach reference-demo quality (OpIrisSimple.scala
-    flow). Measured holdout error 0.067 / F1 0.937 at these seeds."""
+    """Iris helloworld dataset (the real UCI copy when the reference
+    checkout exists, else the committed fixture reconstruction —
+    tests/fixtures/README.md): the multiclass sweep must reach
+    reference-demo quality (OpIrisSimple.scala flow). Measured holdout
+    error 0.067 / F1 0.937 on the real data at these seeds."""
     from transmogrifai_tpu.selector import MultiClassificationModelSelector
     from transmogrifai_tpu.workflow import Workflow
     from transmogrifai_tpu.features.builder import FeatureBuilder
@@ -116,9 +118,10 @@ def test_iris_real_data_quality_gate():
 
 
 def test_boston_real_data_quality_gate():
-    """REAL Boston housing (the reference's helloworld dataset): the
-    default regression sweep must beat the reference-demo ballpark
-    (OpBostonSimple RMSE ~4.5). Measured holdout RMSE 2.82 / R2 0.829."""
+    """Boston housing helloworld dataset (real copy when the reference
+    checkout exists, else the committed fixture reconstruction): the
+    regression sweep must beat the reference-demo ballpark (OpBostonSimple
+    RMSE ~4.5). Measured holdout RMSE 2.82 / R2 0.829 on the real data."""
     from transmogrifai_tpu.selector import RegressionModelSelector
     from transmogrifai_tpu.workflow import Workflow
     from transmogrifai_tpu.features.builder import FeatureBuilder
@@ -156,9 +159,9 @@ def test_multiclass_tree_probability_oracle():
     """The nonstandard multiclass tree probability paths (GBT one-vs-all
     sigmoid boosting -> softmax of margins; RF normalized clipped per-class
     regressions) validated against a softmax-objective oracle (multinomial
-    LR) on the real iris: accuracy within 5pp of the oracle and log-loss in
-    the same regime — the probability semantics must be usable, not just
-    argmax-correct."""
+    LR) on the iris data (real or fixture): accuracy within 5pp of the
+    oracle and log-loss in the same regime — the probability semantics
+    must be usable, not just argmax-correct."""
     import jax.numpy as jnp
     from transmogrifai_tpu.models.linear import OpLogisticRegression
     from transmogrifai_tpu.models.trees import (

@@ -1,0 +1,428 @@
+"""Online serving subsystem: compiled-path parity, compile-cache bounds,
+fault-injected degradation (zero dropped requests), backpressure, strict
+admission, and the SERVE runner/CLI surfaces."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+N = 240
+
+
+def _make_model():
+    rng = np.random.default_rng(3)
+    x1 = rng.normal(size=N)
+    x2 = rng.normal(size=N)
+    color = rng.choice(["red", "green", "blue"], size=N)
+    logit = 1.5 * x1 - x2 + (color == "red") * 1.2
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-logit))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "color": (ft.PickList, color.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"], feats["color"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=25), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "color": str(color[i])} for i in range(N)]
+    return model, rows
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _make_model()
+
+
+def _diff(a: dict, b: dict) -> float:
+    d = 0.0
+    for k, av in a.items():
+        bv = b[k]
+        if av is None or bv is None:
+            assert av is None and bv is None, (k, av, bv)
+        elif isinstance(av, dict):
+            assert set(av) == set(bv)
+            for kk in av:
+                d = max(d, abs(float(av[kk]) - float(bv[kk])))
+        elif isinstance(av, (list, tuple)):
+            assert len(av) == len(bv)
+            d = max(d, max((abs(x - z) for x, z in zip(av, bv)),
+                           default=0.0))
+        else:
+            d = max(d, abs(float(av) - float(bv)))
+    return d
+
+
+# -- compiled scorer ---------------------------------------------------------
+
+def test_batch_row_parity_and_unseen_category(served):
+    from transmogrifai_tpu.serving import CompiledScorer
+    model, rows = served
+    rows = rows[:40] + [{"x1": 0.1, "x2": -0.4, "color": "never-seen"}]
+    row_fn = model.score_function()
+    expected = [row_fn(r) for r in rows]
+    got = CompiledScorer(model, max_batch=32).score_batch(rows)
+    assert len(got) == len(expected)
+    for e, g in zip(expected, got):
+        assert set(e) == set(g)
+        assert _diff(e, g) < 1e-4
+
+
+def test_compile_cache_bounded_per_bucket(served):
+    from transmogrifai_tpu.serving import CompiledScorer
+    model, rows = served
+    scorer = CompiledScorer(model, max_batch=32, min_bucket=8)
+    assert scorer.buckets == [8, 16, 32]
+    assert [scorer.bucket_for(k) for k in (1, 8, 9, 31, 32)] == \
+        [8, 8, 16, 32, 32]
+    scorer.warmup(rows[0])
+    warm = scorer.counters.compiles_by_bucket()
+    assert set(warm) == {8, 16, 32}
+    # steady-state traffic across every bucket: ZERO new compiles
+    for k in (1, 3, 8, 11, 16, 17, 32, 5, 29):
+        scorer.score_batch(rows[:k])
+    after = scorer.counters.compiles_by_bucket()
+    assert after == warm, "steady-state serving recompiled"
+    # dispatches attributed to the right padding bucket
+    assert scorer.counters.bucket(8).dispatches >= 4
+    # counters are PER SCORER: a fresh scorer's buckets start clean, so
+    # one server's snapshot can't report another's compiles
+    assert CompiledScorer(model, max_batch=32).counters.buckets == {}
+
+
+def test_oversize_batch_splits(served):
+    from transmogrifai_tpu.serving import CompiledScorer
+    model, rows = served
+    scorer = CompiledScorer(model, max_batch=16)
+    got = scorer.score_batch(rows[:50])  # 16+16+16+2
+    assert len(got) == 50
+
+
+def test_donation_path_parity(served):
+    """donate=True exercises the last-use free plan (donate/keep split +
+    post-layer drops); on CPU donation is a no-op but the partitioning and
+    column lifetime logic run for real."""
+    import warnings as w
+
+    from transmogrifai_tpu.serving import CompiledScorer
+    model, rows = served
+    scorer = CompiledScorer(model, max_batch=16, donate=True)
+    assert scorer.donate is True
+    row_fn = model.score_function()
+    with w.catch_warnings():
+        w.simplefilter("ignore")  # cpu backends warn donation unsupported
+        got = scorer.score_batch(rows[:10])
+        again = scorer.score_batch(rows[:10])  # buffers re-upload per batch
+    for r, g, g2 in zip(rows[:10], got, again):
+        assert _diff(row_fn(r), g) < 1e-4
+        assert _diff(g, g2) == 0.0
+
+
+# -- strict validation (satellite contract test) -----------------------------
+
+def test_strict_score_function_names_missing_keys(served):
+    model, rows = served
+    strict = model.score_function(strict=True)
+    assert set(strict.required_keys) == {"x1", "x2", "color"}
+    with pytest.raises(KeyError) as ei:
+        strict({"x1": 1.0})
+    msg = str(ei.value)
+    assert "color" in msg and "x2" in msg
+    # present-but-None is an explicit null, not a malformed request
+    out = strict({"x1": 1.0, "x2": None, "color": None})
+    assert out is not None
+    # the lax closure silently scores the same row minus keys
+    lax = model.score_function()
+    assert lax({"x1": 1.0}) is not None
+
+
+def test_server_rejects_invalid_at_admission(served):
+    from transmogrifai_tpu.serving import ScoringServer
+    model, rows = served
+    with ScoringServer(model, max_batch=8, queue_capacity=16) as srv:
+        with pytest.raises(KeyError) as ei:
+            srv.submit({"x1": 2.0})
+        assert "color" in str(ei.value)
+        assert srv.metrics.rejected_invalid == 1
+        # valid requests still flow
+        assert srv.score(rows[0], timeout_s=30) is not None
+
+
+# -- fault injection ---------------------------------------------------------
+
+def test_device_failure_drops_nothing_and_recovers(served):
+    from transmogrifai_tpu.serving import ScoringServer
+    model, rows = served
+    srv = ScoringServer(model, max_batch=16, max_wait_ms=1.0,
+                        queue_capacity=512, probe_interval_s=0.05,
+                        retries=1, retry_backoff_s=0.0)
+    real = srv.scorer.score_batch
+    state = {"calls": 0, "down": True}
+
+    def flaky(batch_rows):
+        state["calls"] += 1
+        if state["down"]:
+            raise RuntimeError("UNAVAILABLE: injected device loss")
+        return real(batch_rows)
+
+    srv.scorer.score_batch = flaky
+    row_fn = model.score_function()
+    with srv:
+        futures = [srv.submit(r) for r in rows[:60]]
+        # ZERO dropped: every accepted request completes with a result
+        results = [f.result(timeout=60) for f in futures]
+        assert len(results) == 60
+        for r, row in zip(results, rows[:60]):
+            assert _diff(row_fn(row), r) < 1e-4  # row-path parity
+        snap = srv.snapshot()
+        assert snap["degraded"]["entries"] >= 1
+        assert snap["batches"]["degraded"] >= 1
+        assert snap["degraded"]["active"] is True
+        assert snap["degraded"]["dispatchRetries"] >= 1  # retried first
+        assert snap["requests"]["completed"] == 60
+        assert snap["requests"]["failed"] == 0
+        # heal the device: the probe must restore the compiled path
+        state["down"] = False
+        deadline = time.monotonic() + 30
+        while srv.degraded and time.monotonic() < deadline:
+            srv.score(rows[0], timeout_s=30)
+            time.sleep(0.02)
+        assert not srv.degraded
+        assert srv.snapshot()["degraded"]["recoveries"] >= 1
+
+
+def test_data_error_does_not_enter_degraded_mode(served):
+    """Strict admission checks key PRESENCE only; a wrong-TYPED row passes
+    the door and fails the batch's column build. That is the requester's
+    fault: the batch re-scores on the row path (poison row errors its own
+    future), but the server must NOT enter degraded mode — a trickle of
+    bad rows would otherwise pin every client on the slow path."""
+    from transmogrifai_tpu.serving import ScoringServer
+    model, rows = served
+    srv = ScoringServer(model, max_batch=8, max_wait_ms=5.0,
+                        queue_capacity=64, strict=True)
+    poison = {"x1": "not-a-number", "x2": 0.0, "color": "red"}
+    with srv:
+        futs = [srv.submit(r) for r in (rows[0], poison, rows[1])]
+        assert futs[0].result(timeout=60) is not None
+        with pytest.raises(Exception):
+            futs[1].result(timeout=60)
+        assert futs[2].result(timeout=60) is not None
+        assert not srv.degraded
+        # healthy traffic goes straight back to the compiled path
+        assert srv.score(rows[2], timeout_s=60) is not None
+        snap = srv.snapshot()
+        assert snap["degraded"]["entries"] == 0
+        assert snap["batches"]["dataErrorFallbacks"] >= 1
+
+
+def test_submit_blocking_absorbs_backpressure(served):
+    from transmogrifai_tpu.serving import ScoringServer
+    model, rows = served
+    srv = ScoringServer(model, max_batch=2, max_wait_ms=0.0,
+                        queue_capacity=2, strict=False,
+                        probe_interval_s=1e9, retries=0)
+    real = srv.scorer.score_batch
+    srv.scorer.score_batch = lambda b: (time.sleep(0.01), real(b))[1]
+    with srv:
+        futs = [srv.submit_blocking(r) for r in rows[:40]]  # never raises
+        assert all(f.result(timeout=60) is not None for f in futs)
+
+
+def test_row_level_failure_fails_only_that_row(served):
+    """A poison row must error ITS future, not its batch-mates'."""
+    from transmogrifai_tpu.serving import ScoringServer
+    model, rows = served
+    srv = ScoringServer(model, max_batch=8, max_wait_ms=5.0,
+                        queue_capacity=64, strict=False,
+                        probe_interval_s=1e9, retries=0)
+    # force the row path (compiled path "down"), where per-row isolation
+    # is the contract
+    srv.scorer.score_batch = lambda b: (_ for _ in ()).throw(
+        RuntimeError("UNAVAILABLE: injected"))
+    poison = {"x1": "not-a-number", "x2": 0.0, "color": "red"}
+    with srv:
+        futs = [srv.submit(r) for r in (rows[0], poison, rows[1])]
+        assert futs[0].result(timeout=60) is not None
+        with pytest.raises(Exception):
+            futs[1].result(timeout=60)
+        assert futs[2].result(timeout=60) is not None
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_backpressure_bounded_queue_rejects():
+    """Oversubmission must reject (bounded memory), not buffer forever,
+    and every ACCEPTED request still completes."""
+    from transmogrifai_tpu.serving.batcher import (
+        BackpressureError, MicroBatcher,
+    )
+    done = []
+
+    def slow_dispatch(batch_rows):
+        time.sleep(0.02)
+        done.extend(batch_rows)
+        return [dict(r) for r in batch_rows]
+
+    b = MicroBatcher(slow_dispatch, max_batch=4, max_wait_ms=1.0,
+                     queue_capacity=8)
+    accepted, rejected = [], 0
+    with b:
+        for i in range(200):
+            try:
+                accepted.append(b.submit({"i": i}))
+            except BackpressureError as e:
+                rejected += 1
+                assert e.retry_after_s > 0
+            assert b.queue_depth <= 8  # the bound HOLDS under fire
+    assert rejected > 0, "oversubmission never hit backpressure"
+    assert len(accepted) + rejected == 200
+    # graceful stop drained every accepted request
+    for f in accepted:
+        assert f.result(timeout=0.1) is not None
+    assert len(done) == len(accepted)
+
+
+def test_request_deadline_expires_in_queue():
+    from transmogrifai_tpu.serving.batcher import MicroBatcher, RequestTimeout
+    gate = threading.Event()
+
+    def gated_dispatch(batch_rows):
+        gate.wait(5)
+        return [dict(r) for r in batch_rows]
+
+    b = MicroBatcher(gated_dispatch, max_batch=1, max_wait_ms=0.0,
+                     queue_capacity=64)
+    with b:
+        blocker = b.submit({"i": 0})          # occupies the worker
+        doomed = b.submit({"i": 1}, timeout_ms=10.0)  # expires while queued
+        time.sleep(0.05)
+        gate.set()
+        assert blocker.result(timeout=5) is not None
+        with pytest.raises(RequestTimeout):
+            doomed.result(timeout=5)
+
+
+def test_server_backpressure_counted(served):
+    from transmogrifai_tpu.serving import BackpressureError, ScoringServer
+    model, rows = served
+    srv = ScoringServer(model, max_batch=2, max_wait_ms=0.0,
+                        queue_capacity=2, strict=False,
+                        probe_interval_s=1e9, retries=0)
+    real = srv.scorer.score_batch
+    srv.scorer.score_batch = lambda b: (time.sleep(0.05), real(b))[1]
+    saw_reject = False
+    futs = []
+    with srv:
+        for r in rows[:100]:
+            try:
+                futs.append(srv.submit(r))
+            except BackpressureError:
+                saw_reject = True
+        for f in futs:
+            assert f.result(timeout=60) is not None
+    assert saw_reject
+    snap = srv.snapshot()
+    assert snap["requests"]["rejectedBackpressure"] > 0
+    assert snap["requests"]["completed"] == len(futs)
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_metrics_snapshot_schema(served):
+    from transmogrifai_tpu.serving import ScoringServer
+    model, rows = served
+    with ScoringServer(model, max_batch=8, queue_capacity=64) as srv:
+        srv.score_many(rows[:20], timeout_s=60)
+        snap = srv.snapshot()
+    json.dumps(snap)  # JSON-able end to end
+    lat = snap["latencyMs"]
+    assert lat["count"] == 20
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    assert snap["throughputRps"] > 0
+    assert sum(snap["batches"]["sizeHistogram"].values()) \
+        == snap["batches"]["count"]
+    assert snap["config"]["maxBatch"] == 8
+    # aggregate serving wall mirrored into the process profiler (SCORING)
+    from transmogrifai_tpu.utils.profiling import profiler
+    pm = profiler.metrics.phases.get("Scoring")
+    assert pm is not None and pm.wall_s > 0
+
+
+# -- runner + cli ------------------------------------------------------------
+
+def test_runner_serve_run_type(served, tmp_path):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.readers.base import CustomReader
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+    model, rows = served
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    score_frame = fr.HostFrame.from_dict({
+        "x1": (ft.Real, [r["x1"] for r in rows[:30]]),
+        "x2": (ft.Real, [r["x2"] for r in rows[:30]]),
+        "color": (ft.PickList, [r["color"] for r in rows[:30]]),
+    })
+    wf = Workflow().set_input_frame(score_frame)
+    wf.set_result_features(*model.result_features)
+    runner = WorkflowRunner(wf)
+    params = OpParams(model_location=model_dir,
+                      score_location=str(tmp_path / "scores"),
+                      custom_params={"maxBatch": 8, "maxWaitMs": 1.0,
+                                     "queueCapacity": 64})
+    result = runner.run(RunTypes.SERVE, params)
+    assert result["status"] == "success"
+    assert result["nRows"] == 30
+    sm = result["servingMetrics"]
+    assert sm["requests"]["completed"] == 30
+    assert sm["latencyMs"]["p50"] is not None
+    out = result["scoreLocation"]
+    lines = [json.loads(l) for l in open(out)]
+    assert len(lines) == 30
+    pred_name = [f.name for f in model.result_features
+                 if issubclass(f.ftype, ft.Prediction)][0]
+    assert all("prediction" in l[pred_name] for l in lines)
+
+
+def test_cli_serve_jsonl(served, tmp_path, capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    model, rows = served
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    req = tmp_path / "req.jsonl"
+    with open(req, "w") as fh:
+        for r in rows[:12]:
+            fh.write(json.dumps(r) + "\n")
+        fh.write(json.dumps({"x1": 1.0}) + "\n")  # malformed: missing keys
+    out = tmp_path / "scores.jsonl"
+    metrics = tmp_path / "metrics.json"
+    rc = cli_main(["serve", "--model", model_dir, "--input", str(req),
+                   "--output", str(out), "--metrics", str(metrics),
+                   "--max-batch", "8", "--queue-capacity", "32"])
+    assert rc == 0
+    lines = [json.loads(l) for l in open(out)]
+    assert len(lines) == 13
+    assert sum(1 for l in lines if "error" in l) == 1
+    assert "error" in lines[12]  # order preserved: bad row's slot errors
+    snap = json.load(open(metrics))
+    assert snap["requests"]["completed"] == 12
+    assert snap["requests"]["rejectedInvalid"] == 1

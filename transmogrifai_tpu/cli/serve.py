@@ -1,0 +1,128 @@
+"""``transmogrifai_tpu serve`` — score requests through the online server.
+
+Reads request rows (JSON-lines from a file or stdin, or a CSV with schema
+inference), replays them through ``serving.ScoringServer`` (micro-batched
+compiled scoring, backpressure, row-path degradation), writes one JSON
+score line per request, and optionally dumps the serving-metrics snapshot:
+
+    python -m transmogrifai_tpu.cli serve --model model_dir \
+        --input requests.jsonl --output scores.jsonl --metrics metrics.json \
+        --max-batch 256 --max-wait-ms 2 --queue-capacity 1024
+
+Rejected rows (strict validation) and per-row scoring failures emit an
+``{"error": ...}`` line at the request's position — output line i always
+answers input line i.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Iterable, Optional
+
+__all__ = ["add_serve_args", "run_serve"]
+
+
+def add_serve_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("--model", required=True, help="saved model directory")
+    sp.add_argument("--input", default="-",
+                    help="requests: .jsonl / .csv path, or '-' for "
+                         "JSON-lines on stdin (default)")
+    sp.add_argument("--output", default="-",
+                    help="scores jsonl path, or '-' for stdout (default)")
+    sp.add_argument("--metrics", default=None,
+                    help="write the serving-metrics snapshot here")
+    sp.add_argument("--max-batch", type=int, default=256)
+    sp.add_argument("--max-wait-ms", type=float, default=2.0)
+    sp.add_argument("--queue-capacity", type=int, default=1024)
+    sp.add_argument("--timeout-ms", type=float, default=None,
+                    help="per-request deadline while queued")
+    sp.add_argument("--no-strict", action="store_true",
+                    help="skip admission-time raw-key validation")
+    sp.add_argument("--no-warmup", action="store_true",
+                    help="skip padding-bucket warmup before traffic")
+
+
+def _read_rows(path: str) -> Iterable[dict]:
+    if path == "-":
+        for line in sys.stdin:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+        return
+    if path.endswith(".csv"):
+        from transmogrifai_tpu.readers.csv import CSVReader
+        yield from CSVReader(path).read()  # schema-inferred typed rows
+        return
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def run_serve(args: argparse.Namespace) -> int:
+    from transmogrifai_tpu.serving import ScoringServer
+    from transmogrifai_tpu.workflow import load_model
+
+    model = load_model(args.model)
+    server = ScoringServer(
+        model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        queue_capacity=args.queue_capacity,
+        default_timeout_ms=args.timeout_ms, strict=not args.no_strict)
+
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    t0 = time.monotonic()
+    n = n_err = 0
+    #: (index, future | error) in submit order; drained whenever the
+    #: window exceeds the queue so output order == input order without
+    #: materializing every request first
+    window: list[tuple[int, Any]] = []
+    warmed = args.no_warmup
+
+    def drain() -> None:
+        nonlocal n_err
+        for _, item in window:
+            if isinstance(item, Exception):
+                doc = {"error": f"{type(item).__name__}: {item}"}
+                n_err += 1
+            else:
+                try:
+                    doc = item.result()
+                except Exception as e:  # noqa: BLE001 — per-row report
+                    doc = {"error": f"{type(e).__name__}: {e}"}
+                    n_err += 1
+            out.write(json.dumps(doc, default=str) + "\n")
+        window.clear()
+
+    try:
+        server.start()
+        for i, row in enumerate(_read_rows(args.input)):
+            if not warmed:
+                server.start(warmup_row=row)  # non-fatal on a bad row
+                warmed = True
+            try:
+                window.append((i, server.submit_blocking(row)))
+            except KeyError as e:  # strict admission reject
+                window.append((i, e))
+            n += 1
+            if len(window) >= args.queue_capacity:
+                drain()
+        drain()
+    finally:
+        server.stop()
+        if out is not sys.stdout:
+            out.close()
+    wall = time.monotonic() - t0
+    snap = server.snapshot()
+    if args.metrics:
+        with open(args.metrics, "w") as fh:
+            json.dump(snap, fh, indent=2)
+    lat = snap["latencyMs"]
+    print(f"# served {n} requests ({n_err} errored) in {wall:.2f}s "
+          f"({n / max(wall, 1e-9):.0f} rps), p50={lat['p50']}ms "
+          f"p95={lat['p95']}ms p99={lat['p99']}ms "
+          f"degraded={snap['degraded']['entries']}", file=sys.stderr)
+    return 0

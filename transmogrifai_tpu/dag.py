@@ -34,7 +34,8 @@ from transmogrifai_tpu.stages.base import (
     Estimator, PipelineStage, Transformer,
 )
 
-__all__ = ["compute_dag", "cut_dag", "CutDag", "DagExecutor", "Dag"]
+__all__ = ["compute_dag", "cut_dag", "CutDag", "DagExecutor", "Dag",
+           "fuse_layer_program"]
 
 Dag = list  # list[list[PipelineStage]], execution order
 
@@ -216,16 +217,31 @@ class DagExecutor:
         cached = self._fused_cache.get(key)
         if cached is not None:
             return cached
-
-        ts = list(dev_ts)
-
-        def fused(params, in_cols):
-            out = {}
-            for t in ts:
-                cols = [in_cols[n] for n in t.runtime_input_names()]
-                out[t.get_output().name] = t.device_apply(params[t.uid], *cols)
-            return out
-
-        compiled = jax.jit(fused)
+        base = fuse_layer_program(dev_ts)
+        compiled = lambda params, in_cols: base(params, {}, in_cols)  # noqa: E731
         self._fused_cache[key] = compiled
         return compiled
+
+
+def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False):
+    """One jitted XLA program applying every device transformer of a layer.
+
+    Signature: ``fused(params, donate_cols, keep_cols) -> {out name: col}``
+    where the two column dicts together hold every runtime input. With
+    ``donate=True`` the ``donate_cols`` buffers are donated to XLA (the
+    online-serving steady state: per-batch input uploads whose last consumer
+    is this layer are spent, halving resident batch memory); callers must
+    not touch a donated column afterwards. Batch scoring passes everything
+    in ``keep_cols`` — columns live in the executor's PipelineData and are
+    reread by later layers and host pulls."""
+    ts = list(dev_ts)
+
+    def fused(params, donate_cols, keep_cols):
+        in_cols = {**donate_cols, **keep_cols}
+        out = {}
+        for t in ts:
+            cols = [in_cols[n] for n in t.runtime_input_names()]
+            out[t.get_output().name] = t.device_apply(params[t.uid], *cols)
+        return out
+
+    return jax.jit(fused, donate_argnums=(1,) if donate else ())

@@ -1,6 +1,9 @@
-from transmogrifai_tpu.local.scoring import make_score_function
+from transmogrifai_tpu.local.scoring import (
+    check_row, make_score_function, required_raw_keys,
+)
 from transmogrifai_tpu.local.model_import import (
     import_sklearn, import_xgboost_json,
 )
 
-__all__ = ["make_score_function", "import_sklearn", "import_xgboost_json"]
+__all__ = ["make_score_function", "required_raw_keys", "check_row",
+           "import_sklearn", "import_xgboost_json"]

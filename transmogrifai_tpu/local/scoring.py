@@ -9,18 +9,47 @@ engine involved. The contract tests assert local scoring == batch scoring
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 from transmogrifai_tpu.types import feature_types as ft
 
-__all__ = ["make_score_function"]
+__all__ = ["make_score_function", "required_raw_keys", "check_row"]
 
 
-def make_score_function(model) -> Callable[[dict], dict]:
+def required_raw_keys(model) -> tuple[str, ...]:
+    """Raw-feature keys a scoring row must carry: every non-response raw
+    (responses are optional at scoring time, as in ``WorkflowModel._ingest``).
+    A key present with value ``None`` is an explicit null and is fine — the
+    type system models missingness; an ABSENT key is a malformed request."""
+    return tuple(sorted(f.name for f in model.raw_features
+                        if not f.is_response))
+
+
+def check_row(row: dict, required: Sequence[str]) -> None:
+    """Raise ``KeyError`` naming every missing raw-feature key in ``row``.
+
+    Serving admission control calls this at the door (before a request is
+    queued) so malformed requests are rejected immediately instead of
+    surfacing as silent ``None`` scores mid-batch."""
+    missing = [n for n in required if n not in row]
+    if missing:
+        raise KeyError(
+            f"scoring row lacks raw feature keys {missing}; required keys: "
+            f"{list(required)}")
+
+
+def make_score_function(model, strict: bool = False) -> Callable[[dict], dict]:
     """Returns ``score(row: {raw feature name: python value}) -> {result
-    feature name: python value}``."""
+    feature name: python value}``.
+
+    With ``strict=True`` every call validates the row first: a missing
+    non-response raw-feature key raises a ``KeyError`` naming the absent
+    keys instead of silently scoring ``None``s. The returned closure also
+    exposes ``required_keys`` and ``check_row(row)`` so admission-time
+    validation (the online server) can reject without scoring."""
     layers = model.dag
     raw_names = [f.name for f in model.raw_features]
+    required = required_raw_keys(model)
     result = [(f.name, f.ftype) for f in model.result_features]
 
     # precompute per-stage wiring
@@ -30,6 +59,8 @@ def make_score_function(model) -> Callable[[dict], dict]:
             plan.append((t, t.runtime_input_names(), t.get_output().name))
 
     def score(row: dict) -> dict:
+        if strict:
+            check_row(row, required)
         vals: dict[str, Any] = {n: row.get(n) for n in raw_names}
         for t, in_names, out_name in plan:
             vals[out_name] = t.transform_row(*(vals.get(n) for n in in_names))
@@ -41,4 +72,6 @@ def make_score_function(model) -> Callable[[dict], dict]:
             out[name] = v
         return out
 
+    score.required_keys = required
+    score.check_row = lambda row: check_row(row, required)
     return score

@@ -27,9 +27,10 @@ class RunTypes:
     TRAIN = "train"
     SCORE = "score"
     STREAMING_SCORE = "streaming-score"
+    SERVE = "serve"
     EVALUATE = "evaluate"
     FEATURES = "features"
-    ALL = (TRAIN, SCORE, STREAMING_SCORE, EVALUATE, FEATURES)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, SERVE, EVALUATE, FEATURES)
 
 
 class WorkflowRunner:
@@ -117,6 +118,86 @@ class WorkflowRunner:
                         n_rows += frame.n_rows
                 result["nBatches"] = n_batches
                 result["nRows"] = n_rows
+            elif run_type == RunTypes.SERVE:
+                # online-serving replay: every reader row becomes one
+                # submit() through the micro-batched server (admission,
+                # batching, degradation all exercised), metrics reported
+                # in the result json (see docs/SERVING.md)
+                if params.model_location is None:
+                    raise ValueError(f"{run_type} requires modelLocation")
+                from transmogrifai_tpu.serving import ScoringServer
+                model = load_model(params.model_location)
+                reader = (self.scoring_reader_factory(params)
+                          if self.scoring_reader_factory
+                          else self.workflow.reader)
+                # requests carry predictors only — the online contract
+                predictors = [f for f in model.raw_features
+                              if not f.is_response]
+                frame = reader.generate_frame(predictors)
+                cp = dict(params.custom_params or {})
+                timeout_ms = cp.get("timeoutMs")
+                queue_capacity = int(cp.get("queueCapacity", 1024))
+                server = ScoringServer(
+                    model,
+                    max_batch=int(cp.get("maxBatch", 256)),
+                    max_wait_ms=float(cp.get("maxWaitMs", 2.0)),
+                    queue_capacity=queue_capacity,
+                    default_timeout_ms=(float(timeout_ms)
+                                        if timeout_ms is not None else None),
+                    strict=bool(cp.get("strict", True)),
+                    retries=int(cp.get("retries", 2)))
+                out_fh = out_path = tmp = None
+                if params.score_location:
+                    os.makedirs(params.score_location, exist_ok=True)
+                    out_path = os.path.join(params.score_location,
+                                            "scores_serve.jsonl")
+                    tmp = out_path + ".tmp"
+                    out_fh = open(tmp, "w")
+                n_rows = n_errors = 0
+                window: list = []
+
+                def _drain_window() -> None:
+                    # a failed/expired request reports in ITS slot; it
+                    # must not discard the rest of the replay. Draining
+                    # per queue_capacity window keeps memory bounded —
+                    # the admission queue's bound means nothing if the
+                    # replay holds every row/future/score at once
+                    nonlocal n_rows, n_errors
+                    for f in window:
+                        try:
+                            s = f.result()
+                        except Exception as e:  # noqa: BLE001
+                            s = {"error": f"{type(e).__name__}: {e}"}
+                            n_errors += 1
+                        n_rows += 1
+                        if out_fh is not None:
+                            out_fh.write(json.dumps(s, default=str) + "\n")
+                    window.clear()
+
+                with profiler.phase(OpStep.SCORING):
+                    row_iter = frame.iter_rows()
+                    first = next(row_iter, None)
+                    server.start(warmup_row=first)
+                    try:
+                        if first is not None:
+                            import itertools
+                            for row in itertools.chain([first], row_iter):
+                                window.append(server.submit_blocking(row))
+                                if len(window) >= queue_capacity:
+                                    _drain_window()
+                        _drain_window()
+                    finally:
+                        server.stop()
+                if out_fh is not None:
+                    out_fh.close()
+                    os.replace(tmp, out_path)
+                    result["scoreLocation"] = out_path
+                result["nRows"] = n_rows
+                result["nErrors"] = n_errors
+                # the replay is already inside a SCORING phase: don't let
+                # the snapshot mirror the dispatch wall in a second time
+                result["servingMetrics"] = server.snapshot(
+                    mirror_to_profiler=False)
             elif run_type in (RunTypes.SCORE, RunTypes.EVALUATE,
                               RunTypes.FEATURES):
                 if params.model_location is None:

@@ -1,0 +1,25 @@
+"""Online serving: micro-batched jit scoring with backpressure, graceful
+degradation, and latency metrics.
+
+The TPU-native half of the serving story: where ``local/scoring.py``
+reproduces the reference's engine-free row closure
+(``OpWorkflowModelLocal``), this package serves the fitted DAG as a
+compiled batch program at production request rates. See ``docs/SERVING.md``.
+
+- ``CompiledScorer`` — padding-bucket jit cache over the fused device DAG
+- ``MicroBatcher`` — dynamic request coalescing, bounded queue, deadlines
+- ``ScoringServer`` — the service: admission, retry, row-path degradation
+- ``ServingMetrics`` — p50/p95/p99 latency, throughput, degradation counters
+"""
+
+from transmogrifai_tpu.serving.batcher import (
+    BackpressureError, MicroBatcher, RequestTimeout,
+)
+from transmogrifai_tpu.serving.compiled import UNKNOWN_TOKEN, CompiledScorer
+from transmogrifai_tpu.serving.metrics import ServingMetrics
+from transmogrifai_tpu.serving.server import ScoringServer
+
+__all__ = [
+    "BackpressureError", "CompiledScorer", "MicroBatcher", "RequestTimeout",
+    "ScoringServer", "ServingMetrics", "UNKNOWN_TOKEN",
+]

@@ -1,0 +1,262 @@
+"""Dynamic micro-batching request queue with bounded admission.
+
+The serving front door: concurrent ``submit(row) -> Future`` calls coalesce
+into one device dispatch of up to ``max_batch`` rows. The first request of
+a batch waits at most ``max_wait_ms`` for companions — the latency the
+batcher is allowed to spend buying throughput. Admission is BOUNDED: when
+``queue_capacity`` requests are already waiting, ``submit`` raises
+``BackpressureError`` (carrying a ``retry_after_s`` hint sized from the
+observed drain rate) instead of buffering without limit — overload sheds
+load at the door, it does not grow memory until the process dies. Each
+request can carry a deadline; requests that expire while queued complete
+exceptionally with ``RequestTimeout`` rather than occupying a batch slot.
+
+The dispatch function returns one result per row (an ``Exception`` instance
+marks a per-row failure); the worker settles every future either way — an
+accepted request ALWAYS completes, with a value or an error. Fault handling
+(retry, degraded mode) lives in ``serving/server.py``; the batcher treats
+``dispatch`` as infallible and fails the whole batch's futures if it raises
+anyway.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+__all__ = ["MicroBatcher", "BackpressureError", "RequestTimeout"]
+
+
+class BackpressureError(RuntimeError):
+    """Admission queue full: retry after ``retry_after_s`` (load shed)."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class RequestTimeout(TimeoutError):
+    """The request's deadline expired before a batch picked it up."""
+
+
+@dataclass
+class _Pending:
+    row: dict
+    future: Future
+    t_submit: float
+    deadline: Optional[float]  # monotonic seconds, None = no deadline
+
+
+@dataclass
+class _Stats:
+    """Rolling dispatch-rate estimate feeding the retry-after hint."""
+    batch_walls: float = 0.0
+    batch_rows: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record(self, wall_s: float, rows: int) -> None:
+        with self.lock:
+            # exponential forget so the hint tracks the current regime
+            self.batch_walls = 0.9 * self.batch_walls + wall_s
+            self.batch_rows = int(0.9 * self.batch_rows) + rows
+
+    def seconds_per_row(self) -> float:
+        with self.lock:
+            if self.batch_rows <= 0:
+                return 1e-3
+            return max(self.batch_walls / self.batch_rows, 1e-6)
+
+
+class MicroBatcher:
+    """Single-worker dynamic batcher: queue -> coalesce -> dispatch."""
+
+    def __init__(self, dispatch: Callable[[Sequence[dict]], Sequence[Any]],
+                 *, max_batch: int = 256, max_wait_ms: float = 2.0,
+                 queue_capacity: int = 1024,
+                 default_timeout_ms: Optional[float] = None,
+                 on_complete: Optional[
+                     Callable[[Sequence[tuple[float, bool]]], None]] = None,
+                 on_expired: Optional[Callable[[int], None]] = None):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+        self.dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_capacity = int(queue_capacity)
+        self.default_timeout_ms = default_timeout_ms
+        #: called once per dispatched batch with [(latency_s, ok), ...] —
+        #: one metrics update per batch, not one lock fight per request
+        self.on_complete = on_complete
+        self.on_expired = on_expired
+        self._q: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_capacity)
+        self._stats = _Stats()
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._drained.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="transmogrifai-serving-batcher",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the worker. With ``drain`` (default) every already-accepted
+        request is dispatched first — a graceful stop drops nothing."""
+        if self._thread is None:
+            return
+        if not drain:  # fail whatever is still queued, then exit
+            self._fail_queued()
+        self._stop.set()
+        self._thread.join(timeout=timeout_s)
+        self._thread = None
+        # settle anything that slipped in between the worker's final empty
+        # check and a racing submit() that had already passed the stop
+        # check — an accepted Future must never dangle unsettled forever
+        self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        try:
+            while True:
+                p = self._q.get_nowait()
+                _settle(p.future, RuntimeError("batcher stopped"))
+        except queue.Empty:
+            pass
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission -----------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def retry_after_s(self) -> float:
+        """Hint: time to drain the current backlog at the observed rate."""
+        depth = max(self._q.qsize(), 1)
+        return max(depth * self._stats.seconds_per_row(),
+                   self.max_wait_s)
+
+    def submit(self, row: dict,
+               timeout_ms: Optional[float] = None) -> Future:
+        if self._stop.is_set() or self._thread is None:
+            raise RuntimeError("batcher is not running")
+        t = time.monotonic()
+        timeout_ms = timeout_ms if timeout_ms is not None \
+            else self.default_timeout_ms
+        deadline = None if timeout_ms is None else t + timeout_ms / 1e3
+        pending = _Pending(row=row, future=Future(), t_submit=t,
+                           deadline=deadline)
+        try:
+            self._q.put_nowait(pending)
+        except queue.Full:
+            hint = self.retry_after_s()
+            raise BackpressureError(
+                f"serving queue full ({self.queue_capacity} waiting); "
+                f"retry in ~{hint:.3f}s", retry_after_s=hint) from None
+        # close the submit/stop race: if stop() completed between the
+        # entry check and the put, the worker is gone and nothing will
+        # ever serve this queue — settle it (a still-alive worker drains
+        # accepted items itself, and stop() sweeps once more after join)
+        t = self._thread
+        if self._stop.is_set() and (t is None or not t.is_alive()):
+            self._fail_queued()
+        return pending.future
+
+    # -- worker --------------------------------------------------------------
+    def _collect(self) -> list[_Pending]:
+        """Block for the first request, then coalesce companions for up to
+        ``max_wait_s`` (or until the batch is full)."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        t_end = time.monotonic() + self.max_wait_s
+        while len(batch) < self.max_batch:
+            # burst-drain whatever is already queued (no condition-variable
+            # wait per item — at saturation this is the whole batch)
+            try:
+                while len(batch) < self.max_batch:
+                    batch.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            if len(batch) >= self.max_batch:
+                break
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                if self._stop.is_set() and self._q.empty():
+                    break
+                continue
+            now = time.monotonic()
+            live: list[_Pending] = []
+            expired = 0
+            for p in batch:
+                if p.deadline is not None and now > p.deadline:
+                    expired += 1
+                    _settle(p.future, RequestTimeout(
+                        "request expired after "
+                        f"{(now - p.t_submit) * 1e3:.1f}ms in queue"))
+                else:
+                    live.append(p)
+            if expired and self.on_expired is not None:
+                self.on_expired(expired)
+            if not live:
+                continue
+            t0 = time.monotonic()
+            try:
+                results = list(self.dispatch([p.row for p in live]))
+                if len(results) != len(live):
+                    raise RuntimeError(
+                        f"dispatch returned {len(results)} results for "
+                        f"{len(live)} rows")
+            except Exception as e:  # noqa: BLE001 — server handles faults;
+                results = [e] * len(live)  # this is the belt-and-braces path
+            wall = time.monotonic() - t0
+            self._stats.record(wall, len(live))
+            done_t = time.monotonic()
+            settled = []
+            for p, r in zip(live, results):
+                ok = not isinstance(r, BaseException)
+                _settle(p.future, r, is_error=not ok)
+                settled.append((done_t - p.t_submit, ok))
+            if self.on_complete is not None:
+                self.on_complete(settled)
+        self._drained.set()
+
+
+def _settle(future: Future, value: Any, is_error: Optional[bool] = None
+            ) -> None:
+    """Resolve a future exactly once, tolerating caller-side cancellation."""
+    try:
+        if is_error or (is_error is None and isinstance(value, BaseException)):
+            future.set_exception(value)
+        else:
+            future.set_result(value)
+    except Exception:  # already cancelled/settled: the caller gave up first
+        pass

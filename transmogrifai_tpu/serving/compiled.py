@@ -1,0 +1,331 @@
+"""Compiled online batch scorer over a fitted workflow's device DAG.
+
+The offline path (``WorkflowModel.score``) jit-compiles one fused program
+per DAG layer keyed by input shapes — fine when a job scores one big frame,
+fatal for online serving where every request batch has a different row
+count and a text column's batch-local dictionary (``dict_encode``) changes
+the jit cache key on every distinct batch. ``CompiledScorer`` makes the
+compiled path servable:
+
+- **padding buckets**: batches pad (by replicating the last row) up to the
+  next power-of-two bucket ``<= max_batch``, so the whole serving lifetime
+  touches at most ``log2(max_batch / min_bucket) + 1`` shapes per layer —
+  a bounded compile cache by construction. ``warmup()`` pre-dispatches
+  every bucket so steady-state traffic never compiles (asserted via the
+  scorer's per-instance ``utils.profiling.ServingCounters``).
+- **frozen text vocab**: text-ish columns consumed by device stages encode
+  against a per-column vocabulary frozen at scorer construction (seeded
+  from the fitted stages' category sets, e.g. ``OneHotModel.categories``,
+  plus an unknown sentinel). Unseen strings map to the sentinel, which no
+  fitted category table contains, so they land in the OTHER/unseen slot —
+  exactly the row path's semantics for an unseen value — while the jit
+  cache key (vocab is static aux data) stays constant.
+- **donated input buffers**: on accelerator backends, per-batch input
+  uploads whose last consumer is a layer are donated to that layer's
+  program (``dag.fuse_layer_program(donate=True)``), so a request batch
+  holds ~1x its memory on device instead of accumulating dead columns.
+
+Row parity: ``score_batch(rows)`` == ``make_score_function(model)(row)``
+per row (up to f32 device math), asserted in tests/test_serving.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.dag import fuse_layer_program
+from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.profiling import ServingCounters
+
+__all__ = ["CompiledScorer", "UNKNOWN_TOKEN"]
+
+#: sentinel appended to every frozen serving vocab; never a fitted category,
+#: so downstream static tables route it to their OTHER/unseen slot
+UNKNOWN_TOKEN = "⟨serving-unknown⟩"
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _prediction_rows(col: fr.PredictionColumn, n: int) -> list[dict]:
+    """Bulk ``PredictionColumn -> [{prediction, rawPrediction_i,
+    probability_i}]`` matching ``ft.Prediction.make(...).value`` exactly."""
+    def as_2d(a):
+        a = np.asarray(a, np.float64)
+        return a.reshape(a.shape[0], -1)[:n]
+
+    pred = np.asarray(col.prediction, np.float64)[:n].tolist()
+    raw = as_2d(col.raw_prediction)
+    prob = as_2d(col.probability)
+    raw_keys = [f"{ft.Prediction.RawPredictionName}_{i}"
+                for i in range(raw.shape[1])]
+    prob_keys = [f"{ft.Prediction.ProbabilityName}_{i}"
+                 for i in range(prob.shape[1])]
+    raw_l, prob_l = raw.tolist(), prob.tolist()
+    out = []
+    for i in range(n):
+        d = {ft.Prediction.PredictionName: pred[i]}
+        d.update(zip(raw_keys, raw_l[i]))
+        d.update(zip(prob_keys, prob_l[i]))
+        out.append(d)
+    return out
+
+
+class CompiledScorer:
+    """Jitted columnar batch scorer for a fitted ``WorkflowModel``.
+
+    ``score_batch(rows) -> list[dict]`` where rows/results use the local
+    row-path contract ({raw feature name: python value} in, {result feature
+    name: python value} out). Thread-safe for one concurrent dispatcher
+    (the micro-batcher's worker); construction is cheap, compiles happen
+    lazily per bucket (or eagerly via ``warmup``).
+    """
+
+    def __init__(self, model, max_batch: int = 256, min_bucket: int = 8,
+                 donate: Optional[bool] = None,
+                 counters: Optional[ServingCounters] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.model = model
+        #: per-scorer compile/dispatch attribution: THIS scorer's snapshot
+        #: must not include other servers' compiles
+        self.counters = counters if counters is not None else \
+            ServingCounters()
+        self.max_batch = int(max_batch)
+        min_bucket = max(1, min(int(min_bucket), self.max_batch))
+        self.buckets: list[int] = []
+        b = _next_pow2(min_bucket)
+        while b < self.max_batch:
+            self.buckets.append(b)
+            b <<= 1
+        self.buckets.append(self.max_batch)
+        if donate is None:
+            import jax
+            donate = jax.default_backend() != "cpu"
+        self.donate = bool(donate)
+
+        self._result = [(f.name, f.ftype) for f in model.result_features]
+        #: per layer: (host transformers, device transformers)
+        self._layers = [
+            ([t for t in layer if not t.is_device],
+             [t for t in layer if t.is_device])
+            for layer in model.dag]
+        # Raw columns the fitted DAG actually reads at transform time
+        # (prediction models exclude their label input from
+        # runtime_input_names, so label-less requests serve fine). A
+        # response raw that IS consumed (e.g. a label indexer feeding the
+        # prediction's lineage) builds as its nearest nullable base type:
+        # requests legitimately omit the label, and RealNN would reject the
+        # resulting Nones.
+        runtime_needed = {n for layer in model.dag for t in layer
+                          for n in t.runtime_input_names()}
+        runtime_needed.update(n for n, _ in self._result)
+        self._raw = []
+        for f in model.raw_features:
+            if f.name not in runtime_needed:
+                continue
+            ftype = f.ftype
+            if f.is_response and not ftype.is_nullable:
+                ftype = next(b for b in ftype.__mro__
+                             if isinstance(b, type)
+                             and issubclass(b, ft.FeatureType)
+                             and b.is_nullable)
+            self._raw.append((f.name, ftype))
+        self._programs: dict[int, Any] = {}
+        self._vocabs: dict[str, tuple[tuple[str, ...], dict]] = {}
+        self._vocab_lock = threading.Lock()
+        self._seed_vocabs()
+        self._free_plan = self._plan_last_uses()
+
+    # -- static plans --------------------------------------------------------
+    def _seed_vocabs(self) -> None:
+        """Freeze a serving vocabulary for every text column a device stage
+        consumes, from the fitted category sets of its consumers. Columns
+        with no introspectable categories freeze on first sight instead
+        (``_encode_text``)."""
+        cats_by_col: dict[str, set] = {}
+        for _, dev_ts in self._layers:
+            for t in dev_ts:
+                cats = getattr(t, "categories", None)
+                if not cats:
+                    continue
+                names = t.runtime_input_names()
+                if len(cats) != len(names):
+                    continue
+                for name, cs in zip(names, cats):
+                    cats_by_col.setdefault(name, set()).update(
+                        str(c) for c in cs)
+        for name, cs in cats_by_col.items():
+            self._freeze_vocab(name, sorted(cs))
+
+    def _freeze_vocab(self, name: str, values: Sequence[str]) -> None:
+        vocab = tuple(values) + (UNKNOWN_TOKEN,)
+        self._vocabs[name] = (vocab, {v: i for i, v in enumerate(vocab)})
+
+    def _plan_last_uses(self) -> list[list[str]]:
+        """Per layer: input column names whose LAST consumer is that layer's
+        device program and which no later layer, host pull, or result
+        extraction rereads — the donation/free set."""
+        keep_after: list[set] = []
+        needed = {name for name, _ in self._result}
+        for host_ts, dev_ts in reversed(self._layers):
+            keep_after.insert(0, set(needed))
+            for t in host_ts + dev_ts:
+                needed.update(t.runtime_input_names())
+        plan: list[list[str]] = []
+        for (host_ts, dev_ts), keep in zip(self._layers, keep_after):
+            ins = {n for t in dev_ts for n in t.runtime_input_names()}
+            plan.append(sorted(ins - keep))
+        return plan
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    # -- encoding ------------------------------------------------------------
+    def _encode_text(self, name: str, col: fr.HostColumn) -> fr.CodesColumn:
+        import jax.numpy as jnp
+        entry = self._vocabs.get(name)
+        if entry is None:
+            with self._vocab_lock:
+                entry = self._vocabs.get(name)
+                if entry is None:
+                    # no fitted categories to seed from: freeze on the first
+                    # batch's values — later unseen values map to the
+                    # sentinel (OTHER semantics), the cache key stays fixed
+                    seen = sorted({str(v) for v in col.values
+                                   if v is not None})
+                    self._freeze_vocab(name, seen)
+                    entry = self._vocabs[name]
+        vocab, index = entry
+        unk = len(vocab) - 1
+        codes = np.fromiter(
+            (-1 if v is None else index.get(v, unk) for v in col.values),
+            dtype=np.int32, count=len(col.values))
+        return fr.CodesColumn(jnp.asarray(codes), vocab)
+
+    def _device_input(self, data: PipelineData, name: str):
+        if name in data.device:
+            return data.device[name]
+        if name in data.host and data.host[name].kind in fr.TEXT_KINDS:
+            col = self._encode_text(name, data.host[name])
+            data.device[name] = col
+            return col
+        return data.device_col(name)
+
+    # -- scoring -------------------------------------------------------------
+    def warmup(self, row: dict, buckets: Optional[Sequence[int]] = None
+               ) -> list[int]:
+        """Dispatch one replicated batch per padding bucket so every fused
+        layer program is compiled before traffic arrives. Returns the
+        buckets warmed."""
+        warmed = []
+        for b in (buckets if buckets is not None else self.buckets):
+            self.score_batch([dict(row)] * int(b))
+            warmed.append(int(b))
+        return warmed
+
+    def score_batch(self, rows: Sequence[dict]) -> list[dict]:
+        rows = list(rows)
+        if not rows:
+            return []
+        if len(rows) > self.max_batch:
+            out: list[dict] = []
+            for i in range(0, len(rows), self.max_batch):
+                out.extend(self.score_batch(rows[i:i + self.max_batch]))
+            return out
+        n = len(rows)
+        bucket = self.bucket_for(n)
+        # pad by replicating the last row: all transforms are row-local at
+        # scoring time, so padded slots compute real (discarded) values and
+        # can never poison statistics (there are none in a fitted DAG)
+        padded = rows + [rows[-1]] * (bucket - n)
+        cols = {name: fr.HostColumn.from_values(
+                    ftype, [r.get(name) for r in padded])
+                for name, ftype in self._raw}
+        data = PipelineData(fr.HostFrame(cols))
+        # compile accounting via this scorer's OWN fused-program jit-cache
+        # growth: exact and per-scorer (a process-global compile listener
+        # would cross-attribute concurrent servers)
+        before = self._program_cache_entries()
+        data = self._transform(data, bucket)
+        self.counters.count(
+            bucket, dispatches=1,
+            compiles=self._program_cache_entries() - before)
+        return self._extract_rows(data, n)
+
+    def _program_cache_entries(self) -> int:
+        total = 0
+        for prog in self._programs.values():
+            try:
+                total += prog._cache_size()
+            except Exception:  # jit internals moved: compiles stay 0
+                pass
+        return total
+
+    def _transform(self, data: PipelineData, bucket: int) -> PipelineData:
+        for li, (host_ts, dev_ts) in enumerate(self._layers):
+            if host_ts:
+                data = data.with_host_cols(
+                    {t.get_output().name: t.output_column(data)
+                     for t in host_ts})
+            if not dev_ts:
+                continue
+            program = self._programs.get(li)
+            if program is None:
+                program = fuse_layer_program(dev_ts, donate=self.donate)
+                self._programs[li] = program
+            params = {t.uid: t.device_params() for t in dev_ts}
+            in_cols = {n: self._device_input(data, n)
+                       for t in dev_ts for n in t.runtime_input_names()}
+            spent = set(self._free_plan[li]) if self.donate else set()
+            donate_cols = {n: c for n, c in in_cols.items() if n in spent}
+            keep_cols = {n: c for n, c in in_cols.items() if n not in spent}
+            outs = program(params, donate_cols, keep_cols)
+            # donated buffers are dead: drop the references so nothing can
+            # reread them (and the host copy frees with the batch)
+            for name in self._free_plan[li]:
+                data.device.pop(name, None)
+            data = data.with_device_cols(outs)
+            for t in dev_ts:  # fitted vector metadata, outside the trace
+                m = getattr(outs.get(t.get_output().name), "metadata", None)
+                if m is not None:
+                    t.out_meta = m
+        return data
+
+    def _extract_rows(self, data: PipelineData, n: int) -> list[dict]:
+        """Result columns -> per-row python values, matching the row
+        closure's output contract. Device prediction/vector columns
+        extract in bulk (one ``tolist`` per column, not one numpy boxing
+        per cell) — result extraction is the batched path's second-largest
+        host cost after column build."""
+        per_col: list[list] = []
+        names = []
+        for name, ftype in self._result:
+            names.append(name)
+            dev = data.device.get(name)
+            if isinstance(dev, fr.PredictionColumn):
+                per_col.append(_prediction_rows(dev, n))
+            elif isinstance(dev, fr.VectorColumn):
+                per_col.append(
+                    np.asarray(dev.values, np.float64)[:n].tolist())
+            else:
+                col = data.host_col(name)
+                vectorish = issubclass(ftype, ft.OPVector)
+                vals = [col.python_value(i) for i in range(n)]
+                if vectorish:
+                    vals = [None if v is None else list(map(float, v))
+                            for v in vals]
+                per_col.append(vals)
+        return [dict(zip(names, cells)) for cells in zip(*per_col)]

@@ -1,0 +1,217 @@
+"""Serving observability: latency percentiles, throughput, batch shape,
+queue depth, degradation counters — snapshotable as one JSON document.
+
+The analog of the training side's ``AppMetrics``/``SweepCounters`` for the
+online path. Latency samples land in a bounded reservoir (the newest
+``max_samples`` requests) so percentile queries stay O(reservoir), not
+O(lifetime). Compile counts come from the scorer's per-instance
+``utils.profiling.ServingCounters`` (a per-padding-bucket
+``jax.monitoring`` listener) — the snapshot embeds them so one document
+answers "did steady-state serving recompile?" for THIS server alone.
+Aggregate serving wall is mirrored into the process profiler under
+``OpStep.SCORING`` at snapshot time, keeping ``AppMetrics.pretty()`` the
+single place operators read phase time.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Thread-safe counters + bounded latency reservoir for one server."""
+
+    def __init__(self, max_samples: int = 8192,
+                 queue_depth_fn: Optional[Callable[[], int]] = None,
+                 queue_capacity: Optional[int] = None,
+                 compile_counters=None):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._started_at = time.time()
+        self.queue_depth_fn = queue_depth_fn
+        self.queue_capacity = queue_capacity
+        #: this server's ServingCounters (per-scorer; None = no compile
+        #: accounting in the snapshot)
+        self.compile_counters = compile_counters
+        # requests
+        self.admitted = 0
+        self.rejected_backpressure = 0
+        self.rejected_invalid = 0
+        self.completed = 0
+        self.failed = 0
+        self.expired = 0
+        # batches
+        self.batches = 0
+        self.degraded_batches = 0
+        self.data_error_batches = 0
+        self.batch_rows = 0
+        self.batch_wall_s = 0.0
+        self.batch_size_hist: collections.Counter = collections.Counter()
+        # degradation lifecycle
+        self.degraded_entries = 0
+        self.recoveries = 0
+        self.dispatch_retries = 0
+        self.degraded_active = False
+        # latency reservoir (seconds), newest max_samples
+        self._latency: collections.deque = collections.deque(
+            maxlen=max_samples)
+
+    # -- recording -----------------------------------------------------------
+    def record_admitted(self, n: int = 1) -> None:
+        with self._lock:
+            self.admitted += n
+
+    def record_rejected(self, *, invalid: bool = False, n: int = 1) -> None:
+        with self._lock:
+            if invalid:
+                self.rejected_invalid += n
+            else:
+                self.rejected_backpressure += n
+
+    def record_request_done(self, latency_s: float, ok: bool) -> None:
+        self.record_requests_done([(latency_s, ok)])
+
+    def record_requests_done(self, settled) -> None:
+        """Bulk per-batch settlement: [(latency_s, ok), ...]."""
+        with self._lock:
+            for latency_s, ok in settled:
+                if ok:
+                    self.completed += 1
+                else:
+                    self.failed += 1
+                self._latency.append(latency_s)
+
+    def record_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += n
+            self.failed += n
+
+    def record_batch(self, size: int, wall_s: float,
+                     degraded: bool = False) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += size
+            self.batch_wall_s += wall_s
+            self.batch_size_hist[int(size)] += 1
+            if degraded:
+                self.degraded_batches += 1
+
+    def record_data_error_batch(self) -> None:
+        """A batch re-scored on the row path because of a malformed ROW
+        (poison-row isolation), not a device fault — no degraded mode."""
+        with self._lock:
+            self.data_error_batches += 1
+
+    def record_degraded_entry(self) -> None:
+        with self._lock:
+            self.degraded_entries += 1
+            self.degraded_active = True
+
+    def record_recovery(self) -> None:
+        with self._lock:
+            self.recoveries += 1
+            self.degraded_active = False
+
+    def record_retry(self, n: int = 1) -> None:
+        with self._lock:
+            self.dispatch_retries += n
+
+    # -- queries -------------------------------------------------------------
+    def latency_percentiles_ms(self) -> dict:
+        with self._lock:
+            samples = np.asarray(self._latency, dtype=np.float64)
+        if samples.size == 0:
+            return {"count": 0, "p50": None, "p95": None, "p99": None,
+                    "mean": None, "max": None}
+        p50, p95, p99 = np.percentile(samples, [50.0, 95.0, 99.0])
+        return {"count": int(samples.size),
+                "p50": round(float(p50) * 1e3, 3),
+                "p95": round(float(p95) * 1e3, 3),
+                "p99": round(float(p99) * 1e3, 3),
+                "mean": round(float(samples.mean()) * 1e3, 3),
+                "max": round(float(samples.max()) * 1e3, 3)}
+
+    def throughput_rps(self) -> float:
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        with self._lock:
+            return self.completed / elapsed
+
+    def snapshot(self, mirror_to_profiler: bool = True) -> dict:
+        """One JSON-able document with everything an operator dashboards.
+
+        ``mirror_to_profiler=False`` skips publishing serving wall into
+        the process AppMetrics — for callers (runner SERVE) that already
+        wrap the replay in a ``profiler.phase(SCORING)`` block and would
+        otherwise double-count the dispatch wall."""
+        lat = self.latency_percentiles_ms()
+        with self._lock:
+            mean_size = (self.batch_rows / self.batches) if self.batches \
+                else None
+            doc = {
+                "startedAt": self._started_at,
+                "uptimeSeconds": round(time.monotonic() - self._t0, 3),
+                "requests": {
+                    "admitted": self.admitted,
+                    "completed": self.completed,
+                    "failed": self.failed,
+                    "expired": self.expired,
+                    "rejectedBackpressure": self.rejected_backpressure,
+                    "rejectedInvalid": self.rejected_invalid,
+                },
+                "batches": {
+                    "count": self.batches,
+                    "degraded": self.degraded_batches,
+                    "dataErrorFallbacks": self.data_error_batches,
+                    "rows": self.batch_rows,
+                    "wallSeconds": round(self.batch_wall_s, 6),
+                    "meanSize": round(mean_size, 3) if mean_size else None,
+                    "sizeHistogram": {str(k): v for k, v in sorted(
+                        self.batch_size_hist.items())},
+                },
+                "degraded": {
+                    "active": self.degraded_active,
+                    "entries": self.degraded_entries,
+                    "recoveries": self.recoveries,
+                    "dispatchRetries": self.dispatch_retries,
+                },
+            }
+        doc["latencyMs"] = lat
+        doc["throughputRps"] = round(self.throughput_rps(), 3)
+        queue_doc: dict = {"capacity": self.queue_capacity}
+        if self.queue_depth_fn is not None:
+            try:
+                queue_doc["depth"] = int(self.queue_depth_fn())
+            except Exception:
+                queue_doc["depth"] = None
+        doc["queue"] = queue_doc
+        doc["compileBuckets"] = self.compile_counters.to_json() \
+            if self.compile_counters is not None else {}
+        if mirror_to_profiler:
+            self._mirror_to_profiler()
+        return doc
+
+    def _mirror_to_profiler(self) -> None:
+        """Publish cumulative serving wall into the process AppMetrics under
+        SCORING — delta-recorded so repeated snapshots don't double-count."""
+        from transmogrifai_tpu.utils.profiling import OpStep, profiler
+        with self._lock:
+            delta = self.batch_wall_s - getattr(self, "_mirrored_s", 0.0)
+            if delta <= 0:
+                return
+            self._mirrored_s = self.batch_wall_s
+        profiler.metrics.record(OpStep.SCORING, delta)
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.snapshot(), fh, indent=2)
+        os.replace(tmp, path)

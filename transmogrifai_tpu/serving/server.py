@@ -1,0 +1,266 @@
+"""The online scoring service: admission -> micro-batch -> compiled DAG,
+with graceful degradation to the engine-free row path.
+
+Request lifecycle:
+
+1. **admission** (caller thread): with ``strict`` (default) the row is
+   validated against the model's required raw-feature keys
+   (``local.scoring.check_row``) — malformed requests are rejected at the
+   door with a ``KeyError`` naming the missing keys, never queued. A full
+   queue rejects with ``BackpressureError`` (+ retry-after hint).
+2. **dispatch** (batcher worker): the coalesced batch goes to the compiled
+   bucket-padded scorer. Transient device errors retry via
+   ``utils.retry.with_device_retry``; if the compiled path still fails —
+   transient or not — the batch is re-scored through the
+   ``local/scoring.py`` row closure, so an ACCEPTED request never pays for
+   a device fault with an error, let alone a drop.
+3. **degraded mode**: after a compiled-path failure the server stays on the
+   row path (correct but slow) and re-probes the compiled path with a live
+   batch every ``probe_interval_s`` — recovery is automatic and observable
+   (``metrics.degraded`` counters).
+
+Per-row scoring errors (a genuinely broken row crashing a transform) fail
+only that row's future — in BOTH paths: the compiled path falls back to
+row-scoring the batch when it raises, and the row path isolates exceptions
+per request.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from concurrent.futures import Future
+from typing import Any, Optional, Sequence
+
+from transmogrifai_tpu.local.scoring import (
+    check_row, make_score_function, required_raw_keys,
+)
+from transmogrifai_tpu.serving.batcher import BackpressureError, MicroBatcher
+from transmogrifai_tpu.serving.compiled import CompiledScorer
+from transmogrifai_tpu.serving.metrics import ServingMetrics
+from transmogrifai_tpu.utils.retry import with_device_retry
+
+__all__ = ["ScoringServer"]
+
+
+class ScoringServer:
+    """Thread-based online scorer for a fitted ``WorkflowModel``.
+
+    Usage::
+
+        with ScoringServer(model, max_batch=256, max_wait_ms=2) as srv:
+            fut = srv.submit({"age": 31.0, "sex": "female", ...})
+            scores = fut.result(timeout=1.0)
+    """
+
+    def __init__(self, model, *, max_batch: int = 256,
+                 max_wait_ms: float = 2.0, queue_capacity: int = 1024,
+                 default_timeout_ms: Optional[float] = None,
+                 strict: bool = True, min_bucket: int = 8,
+                 retries: int = 2, retry_backoff_s: float = 0.05,
+                 probe_interval_s: float = 1.0,
+                 donate: Optional[bool] = None,
+                 metrics_max_samples: int = 8192):
+        self.model = model
+        self.strict = strict
+        self.required_keys = required_raw_keys(model)
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self.scorer = CompiledScorer(model, max_batch=max_batch,
+                                     min_bucket=min_bucket, donate=donate)
+        self.row_score = make_score_function(model, strict=False)
+        self.batcher = MicroBatcher(
+            self._dispatch, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            queue_capacity=queue_capacity,
+            default_timeout_ms=default_timeout_ms,
+            on_complete=lambda settled:
+                self.metrics.record_requests_done(settled),
+            on_expired=lambda n: self.metrics.record_expired(n))
+        self.metrics = ServingMetrics(
+            max_samples=metrics_max_samples,
+            queue_depth_fn=lambda: self.batcher.queue_depth,
+            queue_capacity=queue_capacity,
+            compile_counters=self.scorer.counters)
+        self._degraded_since: Optional[float] = None
+        self._last_probe = 0.0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, warmup_row: Optional[dict] = None,
+              warmup_buckets: Optional[Sequence[int]] = None
+              ) -> "ScoringServer":
+        """Start the dispatch worker; with ``warmup_row``, pre-compile every
+        padding bucket before accepting traffic. Warmup is an optimization:
+        a bad warmup row (e.g. the first row of a replay file is the
+        malformed one) must not keep the server from starting — buckets
+        then compile lazily on first traffic."""
+        if warmup_row is not None:
+            try:
+                self.scorer.warmup(warmup_row, buckets=warmup_buckets)
+            except Exception as e:  # noqa: BLE001 — degrade to lazy compile
+                warnings.warn(
+                    f"serving: warmup failed ({type(e).__name__}: "
+                    f"{str(e)[:140]}); padding buckets will compile lazily",
+                    RuntimeWarning)
+        self.batcher.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self.batcher.stop(drain=drain)
+
+    def __enter__(self) -> "ScoringServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded_since is not None
+
+    # -- request API ---------------------------------------------------------
+    def submit(self, row: dict,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one request. Raises ``KeyError`` (strict validation) or
+        ``BackpressureError`` (queue full) instead of queueing doomed work."""
+        if self.strict:
+            try:
+                check_row(row, self.required_keys)
+            except KeyError:
+                self.metrics.record_rejected(invalid=True)
+                raise
+        try:
+            fut = self.batcher.submit(row, timeout_ms=timeout_ms)
+        except BackpressureError:
+            self.metrics.record_rejected(invalid=False)
+            raise
+        self.metrics.record_admitted()
+        return fut
+
+    def submit_blocking(self, row: dict,
+                        timeout_ms: Optional[float] = None,
+                        max_wait_s: Optional[float] = None) -> Future:
+        """``submit`` that absorbs backpressure: on a full queue, wait out
+        the retry-after hint (capped at 0.5s per attempt, ``max_wait_s``
+        overall) and retry. The shared client loop for replay drivers
+        (runner SERVE, ``cli serve``); strict-validation ``KeyError``
+        still raises immediately."""
+        deadline = None if max_wait_s is None \
+            else time.monotonic() + max_wait_s
+        while True:
+            try:
+                return self.submit(row, timeout_ms=timeout_ms)
+            except BackpressureError as e:
+                wait = min(e.retry_after_s, 0.5)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    wait = min(wait, remaining)
+                time.sleep(wait)
+
+    def score(self, row: dict, timeout_s: Optional[float] = None) -> dict:
+        return self.submit(row).result(timeout=timeout_s)
+
+    def score_many(self, rows: Sequence[dict],
+                   timeout_s: Optional[float] = None) -> list[dict]:
+        futures = [self.submit(r) for r in rows]
+        return [f.result(timeout=timeout_s) for f in futures]
+
+    # -- dispatch (batcher worker thread) ------------------------------------
+    def _dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        from transmogrifai_tpu.types.feature_types import (
+            FeatureTypeValueError,
+        )
+        t0 = time.monotonic()
+        degraded = True
+        if self._compiled_eligible():
+            try:
+                results = self._compiled_dispatch(rows)
+                degraded = False
+            except FeatureTypeValueError:
+                # a DATA error: strict admission checks key presence, not
+                # types, so a wrong-typed row can fail the batch's column
+                # build. That is the requester's fault, not the device's —
+                # row-score the batch (isolating the poison row to its own
+                # future) WITHOUT entering degraded mode, or a trickle of
+                # bad rows would pin every client on the slow path
+                degraded = False
+                self.metrics.record_data_error_batch()
+                results = self._row_dispatch(rows)
+            except Exception as e:  # noqa: BLE001 — any OTHER compiled-path
+                # failure is infrastructure: degrade, re-serve below
+                self._enter_degraded(e)
+                results = self._row_dispatch(rows)
+        else:
+            results = self._row_dispatch(rows)
+        self.metrics.record_batch(len(rows), time.monotonic() - t0,
+                                  degraded=degraded)
+        return results
+
+    def _compiled_eligible(self) -> bool:
+        if self._degraded_since is None:
+            return True
+        now = time.monotonic()
+        if now - self._last_probe >= self.probe_interval_s:
+            self._last_probe = now  # probe with the live batch
+            return True
+        return False
+
+    def _compiled_dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        attempts = {"n": 0}
+
+        def attempt():
+            attempts["n"] += 1
+            return self.scorer.score_batch(rows)
+
+        try:
+            results = with_device_retry(
+                attempt, retries=self.retries,
+                backoff_s=self.retry_backoff_s)
+        finally:
+            if attempts["n"] > 1:
+                self.metrics.record_retry(attempts["n"] - 1)
+        if self._degraded_since is not None:
+            down_s = time.monotonic() - self._degraded_since
+            self._degraded_since = None
+            self.metrics.record_recovery()
+            warnings.warn(
+                f"serving: compiled path recovered after {down_s:.1f}s "
+                "degraded", RuntimeWarning)
+        return list(results)
+
+    def _enter_degraded(self, err: BaseException) -> None:
+        if self._degraded_since is None:
+            self._degraded_since = time.monotonic()
+            self._last_probe = self._degraded_since
+            self.metrics.record_degraded_entry()
+            warnings.warn(
+                "serving: compiled scorer failed "
+                f"({type(err).__name__}: {str(err)[:140]}); degrading to "
+                "the local row path until a probe succeeds", RuntimeWarning)
+
+    def _row_dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        out: list[Any] = []
+        for r in rows:
+            try:
+                out.append(self.row_score(r))
+            except Exception as e:  # noqa: BLE001 — isolate per-row faults
+                out.append(e)
+        return out
+
+    # -- observability -------------------------------------------------------
+    def snapshot(self, mirror_to_profiler: bool = True) -> dict:
+        doc = self.metrics.snapshot(mirror_to_profiler=mirror_to_profiler)
+        doc["config"] = {
+            "maxBatch": self.scorer.max_batch,
+            "buckets": list(self.scorer.buckets),
+            "maxWaitMs": self.batcher.max_wait_s * 1e3,
+            "queueCapacity": self.batcher.queue_capacity,
+            "strict": self.strict,
+            "retries": self.retries,
+            "probeIntervalSeconds": self.probe_interval_s,
+            "donate": self.scorer.donate,
+        }
+        doc["degraded"]["active"] = self.degraded
+        return doc

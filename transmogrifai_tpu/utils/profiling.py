@@ -28,7 +28,8 @@ from enum import Enum
 from typing import Optional
 
 __all__ = ["OpStep", "AppMetrics", "profiler", "phase",
-           "trace_device_intervals", "SweepCounters", "sweep_counters"]
+           "trace_device_intervals", "SweepCounters", "sweep_counters",
+           "ServingCounters"]
 
 
 class OpStep(Enum):
@@ -169,6 +170,47 @@ class AppMetrics:
                          rows, title=f"{self.app_name} metrics"))
 
 
+class _CompileAttribution:
+    """Shared ``jax.monitoring`` backend-compile listener: while a
+    ``tracking(key)`` block runs, every XLA backend compile is attributed
+    to ``key`` via the subclass's ``_record_compile``. Counts stay 0 when
+    the monitoring API is unavailable; persistent-cache hits don't fire
+    the event — by design, a warm re-run reports 0 compiles."""
+
+    def __init__(self):
+        self._active = None
+        self._listening = False
+
+    def _record_compile(self, key) -> None:
+        raise NotImplementedError
+
+    def _on_compile(self, event: str, duration: float, **kw) -> None:
+        if (self._active is not None
+                and event == "/jax/core/compile/backend_compile_duration"):
+            self._record_compile(self._active)
+
+    def _ensure_listener(self) -> None:
+        if self._listening:
+            return
+        try:
+            import jax.monitoring as monitoring
+            monitoring.register_event_duration_secs_listener(self._on_compile)
+            self._listening = True
+        except Exception:
+            self._listening = True  # API absent: compiles stay 0, don't retry
+
+    @contextlib.contextmanager
+    def tracking(self, key):
+        """Attribute compile events to ``key`` while the block runs."""
+        self._ensure_listener()
+        prev = self._active
+        self._active = key
+        try:
+            yield
+        finally:
+            self._active = prev
+
+
 @dataclass
 class SweepFamilyCounters:
     """Per-candidate-family sweep observability (see ``SweepCounters``)."""
@@ -178,7 +220,7 @@ class SweepFamilyCounters:
     host_syncs: int = 0         # device->host materializations (metric pulls)
 
 
-class SweepCounters:
+class SweepCounters(_CompileAttribution):
     """ModelSelector sweep observability: per family, how many XLA
     compiles, device program dispatches, and host syncs the sweep paid.
 
@@ -196,9 +238,8 @@ class SweepCounters:
     asserted in tests (fast path == 1 sync per family)."""
 
     def __init__(self):
+        super().__init__()
         self.families: dict = {}  # family name -> SweepFamilyCounters
-        self._active = None
-        self._listening = False
 
     def reset(self) -> None:
         self.families = {}
@@ -215,31 +256,8 @@ class SweepCounters:
         if mode is not None:
             fc.mode = mode
 
-    def _on_compile(self, event: str, duration: float, **kw) -> None:
-        if (self._active is not None
-                and event == "/jax/core/compile/backend_compile_duration"):
-            self.family(self._active).compiles += 1
-
-    def _ensure_listener(self) -> None:
-        if self._listening:
-            return
-        try:
-            import jax.monitoring as monitoring
-            monitoring.register_event_duration_secs_listener(self._on_compile)
-            self._listening = True
-        except Exception:
-            self._listening = True  # API absent: compiles stay 0, don't retry
-
-    @contextlib.contextmanager
-    def tracking(self, name: str):
-        """Attribute compile events to ``name`` while the block runs."""
-        self._ensure_listener()
-        prev = self._active
-        self._active = name
-        try:
-            yield
-        finally:
-            self._active = prev
+    def _record_compile(self, key) -> None:
+        self.family(key).compiles += 1
 
     def to_json(self) -> dict:
         return {name: {"mode": fc.mode, "compiles": fc.compiles,
@@ -249,6 +267,56 @@ class SweepCounters:
 
 
 sweep_counters = SweepCounters()
+
+
+@dataclass
+class ServingBucketCounters:
+    """Per-padding-bucket online-serving observability (``ServingCounters``)."""
+    compiles: int = 0    # XLA backend compiles while this bucket dispatched
+    dispatches: int = 0  # fused-program invocations padded to this bucket
+
+
+class ServingCounters:
+    """Online-serving compile observability per padding bucket.
+
+    The serving compile-cache contract (``serving/compiled.py``): batches
+    pad to power-of-two buckets, so after one warmup dispatch per bucket
+    the fused layer programs are all jit-cache hits — steady-state serving
+    never recompiles. Counters here make that assertable: the bench and
+    tests snapshot per-bucket compiles after warmup and require 0 new ones
+    under traffic. Dispatches are counted at the batch granularity (one
+    ``score_batch`` = one dispatch, however many fused layers it runs).
+
+    One instance per ``CompiledScorer``, fed by the SCORER measuring its
+    own fused programs' jit-cache growth per dispatch — NOT the global
+    ``jax.monitoring`` compile listener ``SweepCounters`` uses: monitoring
+    events are process-wide, so two servers dispatching concurrently would
+    cross-attribute each other's compiles (and per-instance listeners can
+    never unregister). Cache-entry deltas are exact, per-program, and
+    leak-free; "compiles" here means new fused-program instantiations
+    (shape-keyed traces), the thing steady-state serving must not do."""
+
+    def __init__(self):
+        self.buckets: dict[int, ServingBucketCounters] = {}
+
+    def reset(self) -> None:
+        self.buckets = {}
+
+    def bucket(self, size: int) -> ServingBucketCounters:
+        return self.buckets.setdefault(int(size), ServingBucketCounters())
+
+    def count(self, size: int, *, dispatches: int = 0,
+              compiles: int = 0) -> None:
+        c = self.bucket(size)
+        c.dispatches += dispatches
+        c.compiles += compiles
+
+    def compiles_by_bucket(self) -> dict:
+        return {b: c.compiles for b, c in sorted(self.buckets.items())}
+
+    def to_json(self) -> dict:
+        return {str(b): {"compiles": c.compiles, "dispatches": c.dispatches}
+                for b, c in sorted(self.buckets.items())}
 
 
 class _Profiler:

@@ -498,9 +498,16 @@ class WorkflowModel:
         save_model(self, path, overwrite=overwrite)
 
     # -- local serving -------------------------------------------------------
-    def score_function(self):
+    def score_function(self, strict: bool = False):
         from transmogrifai_tpu.local.scoring import make_score_function
-        return make_score_function(self)
+        return make_score_function(self, strict=strict)
+
+    def serving_server(self, **kw):
+        """Online micro-batched scoring server over the compiled DAG
+        (``serving/``): ``submit(row) -> Future``, backpressure, graceful
+        degradation to the row path. See ``docs/SERVING.md``."""
+        from transmogrifai_tpu.serving import ScoringServer
+        return ScoringServer(self, **kw)
 
 
 def _frame_up_to(data, raw_features, dag) -> fr.HostFrame:
