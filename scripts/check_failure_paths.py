@@ -1,0 +1,122 @@
+"""Lint the framework's failure paths: no silent exception swallowing.
+
+A robustness subsystem is only as good as its weakest ``except`` block —
+a handler that catches ``Exception`` and silently drops it converts a
+real fault (data loss, a dead device, a corrupt checkpoint) into an
+invisible no-op. This lint walks every ``except Exception``/``except
+BaseException``/bare ``except:`` handler in ``transmogrifai_tpu/`` and
+requires each to do at least one of:
+
+- **re-raise** (``raise`` anywhere in the handler body), or
+- **surface the fault** (a ``warnings.warn`` / ``*.warn*`` / logging
+  call in the body), or
+- **declare intent** with a ``# failure-ok: <reason>`` marker on the
+  ``except`` line (the escape hatch for genuinely-optional probes —
+  backend capability sniffs, best-effort diagnostics — where silence IS
+  the contract; the marker forces the author to say so in-line), or
+- carry a rationale comment on the ``except`` line (the repo's
+  established ``# noqa: BLE001 — <reason>`` style counts: the reason is
+  the declaration).
+
+Narrow handlers (``except ValueError:`` etc.) are exempt — catching a
+specific exception is already a statement of intent; this lint targets
+the catch-everything pattern that eats faults it never anticipated.
+
+Library use: ``check_file(path) -> [violations]``; CLI: exits 1 listing
+every violation. Wired into tier-1 via ``tests/test_failure_lint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+import sys
+
+__all__ = ["check_file", "check_tree"]
+
+#: a ``failure-ok`` marker, or a ``noqa`` FOLLOWED BY a stated reason
+#: (``# noqa: BLE001 — filtered just below``). A bare ``# noqa: E501``
+#: carries no rationale and must not silence this lint.
+_OK_RE = re.compile(r"failure-ok|noqa\b[^#]*[—–-]\s*\S")
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises or reports the failure."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else ""
+            if name.startswith("warn") or name in (
+                    "error", "exception", "critical", "fatal"):
+                return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: does not parse: {e.msg}"]
+    lines = src.splitlines()
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _OK_RE.search(line):
+            continue
+        if _handler_surfaces(node):
+            continue
+        out.append(
+            f"{path}:{node.lineno}: broad `except` swallows the failure "
+            "silently — re-raise, warn, or annotate the except line with "
+            "`# failure-ok: <reason>`")
+    return out
+
+
+def check_tree(root: str) -> list[str]:
+    out: list[str] = []
+    for path in sorted(glob.glob(os.path.join(root, "**", "*.py"),
+                                 recursive=True)):
+        out.extend(check_file(path))
+    return out
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    root = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "transmogrifai_tpu")
+    violations = check_tree(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} silent failure path(s) found in {root}")
+        return 1
+    print(f"failure-path lint clean: {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""Resumable-training checkpoints: per-layer fitted-DAG persistence.
+
+The reference inherits Spark lineage recovery — a lost executor refits
+nothing because fitted stages live on the driver. Our analog of a lost
+executor is a preempted TPU job: the whole process dies, and before this
+module every fitted stage outside the ModelSelector's ``sweep.json`` died
+with it. ``Workflow.train(checkpoint_dir=...)`` now persists each fitted
+DAG layer as it completes — the same (json record, npz arrays) unit
+``serialization.save_model`` writes, plus the output-feature uid used to
+graft restored stages back onto a rebuilt workflow via the
+``_substitute_fitted`` replay seam. A restarted ``train`` replays completed
+layers from disk (no refit), composes with the sweep checkpoint (a mid-CV
+crash resumes both the before-DAG and the partially-done sweep), and
+counts ``layers_resumed``/``stages_resumed`` in ``utils.profiling.
+run_counters``.
+
+Durability contract:
+
+- every write is atomic (tmp + ``os.replace``): a crash mid-write leaves
+  the previous manifest intact, never a truncated one;
+- the manifest carries a fingerprint of the DAG structure + data shape; a
+  checkpoint from a different workflow/data is ignored with a warning
+  (fresh start), as is a corrupted or truncated file — stale state can
+  cost a refit, never correctness;
+- saving is best-effort: a checkpoint-write failure (injectable at fault
+  site ``checkpoint.write``) warns and training continues — only simulated
+  preemption propagates.
+
+Layout: ``<dir>/train_manifest.json`` + ``<dir>/layer_<key>.npz`` (one
+per layer, keyed by the layer's stable identity hash; plus the
+ModelSelector's ``<dir>/sweep.json`` when training composes the two).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import warnings
+from typing import Optional
+
+import numpy as np
+
+from transmogrifai_tpu.serialization import (
+    fitted_stage_record, restore_fitted_stage,
+)
+from transmogrifai_tpu.stages.base import Estimator, PipelineStage
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.durable import ensure_checkpoint_dir
+
+__all__ = ["TrainCheckpoint", "train_fingerprint", "TRAIN_MANIFEST"]
+
+TRAIN_MANIFEST = "train_manifest.json"
+FORMAT_VERSION = 1
+
+
+def train_fingerprint(dag, n_rows: int, raw_names) -> str:
+    """Identity of a training run for resume matching: the leveled DAG
+    structure (stage classes, uids, wiring) plus the data's coarse shape.
+    Deliberately EXCLUDES stage configs — they can hold live objects whose
+    reprs differ across processes — and deliberately cheap: it must not
+    scan the data. Same-shaped different data cannot be distinguished from
+    a restart; point each dataset at its own checkpoint directory."""
+    spec = {
+        "nRows": int(n_rows),
+        "raw": sorted(raw_names),
+        "layers": [[[type(s).__name__, s.uid, s.operation_name,
+                     s.get_output().uid,
+                     [f.uid for f in s.input_features]]
+                    for s in layer] for layer in dag],
+    }
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class TrainCheckpoint:
+    """Fingerprinted, atomically-written per-layer training checkpoint."""
+
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+        #: stable layer key -> {"index": display index, "stages": records}.
+        #: Keyed by layer IDENTITY (hash of the member stages' output
+        #: feature uids), NOT by position: the workflow-CV path
+        #: (before/during/tail) and the plain path level the same stages
+        #: into different positional indices, and a resume that switches
+        #: paths must never overwrite one layer's entry with another's
+        self._layers: dict[str, dict] = {}
+        #: unusable directory (read-only mount, permissions): training
+        #: proceeds un-checkpointed — same best-effort contract as writes
+        self._disabled = not ensure_checkpoint_dir(path, "train checkpoint")
+        if not self._disabled:
+            self._load()
+
+    # -- manifest io ---------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.path, TRAIN_MANIFEST)
+
+    def _arrays_path(self, key: str) -> str:
+        return os.path.join(self.path, f"layer_{key}.npz")
+
+    @staticmethod
+    def _layer_key(fitted_layer) -> str:
+        """Stable identity of a layer: its member stages' output features
+        (shared between estimator and fitted model, deterministic across
+        resume runs — unlike fitted-model uids, which are minted at fit
+        time)."""
+        uids = "|".join(sorted(t.get_output().uid for t in fitted_layer))
+        return hashlib.sha256(uids.encode()).hexdigest()[:12]
+
+    def _load(self) -> None:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as fh:
+                manifest = json.load(fh)
+            if manifest.get("formatVersion") != FORMAT_VERSION:
+                raise ValueError(
+                    f"format {manifest.get('formatVersion')!r} != "
+                    f"{FORMAT_VERSION}")
+            layers = {str(k): {"index": v.get("index", -1),
+                               "stages": list(v.get("stages", []))}
+                      for k, v in manifest.get("layers", {}).items()}
+        except Exception as e:  # noqa: BLE001 — corrupt checkpoint != crash
+            warnings.warn(
+                f"train checkpoint: unreadable manifest at {path!r} "
+                f"({type(e).__name__}: {e}); starting fresh", RuntimeWarning)
+            return
+        if manifest.get("fingerprint") != self.fingerprint:
+            warnings.warn(
+                f"train checkpoint at {path!r} was written by a different "
+                "workflow/data (fingerprint mismatch); starting fresh",
+                RuntimeWarning)
+            return
+        self._layers = layers
+
+    @property
+    def n_layers_done(self) -> int:
+        return len(self._layers)
+
+    # -- restore -------------------------------------------------------------
+    def restore_overrides(self, dag) -> dict[str, PipelineStage]:
+        """Rebuild fitted transformers for every checkpointed stage that
+        matches an ESTIMATOR position in the current (pre-substitution)
+        ``dag``, wired to the live feature graph. Returns
+        ``{output_feature_uid: fitted transformer}`` for
+        ``Workflow._substitute_fitted``. Non-estimator matches are skipped
+        (the live transformer is already usable); unmatched or unrestorable
+        records are skipped with a warning — they cost a refit, not a
+        crash."""
+        from transmogrifai_tpu.utils.profiling import run_counters
+        if not self._layers:
+            return {}
+        current = {s.get_output().uid: s for layer in dag for s in layer}
+        overrides: dict[str, PipelineStage] = {}
+        for key in sorted(self._layers):
+            arrays: dict = {}
+            apath = self._arrays_path(key)
+            if os.path.exists(apath):
+                try:
+                    arrays = dict(np.load(apath, allow_pickle=False))
+                except Exception as e:  # noqa: BLE001 — refit, don't crash
+                    warnings.warn(
+                        f"train checkpoint: unreadable arrays {apath!r} "
+                        f"({type(e).__name__}: {e}); refitting that layer",
+                        RuntimeWarning)
+                    continue
+            for rec in self._layers[key]["stages"]:
+                out_uid = rec.get("outputFeatureUid")
+                cur = current.get(out_uid)
+                if cur is None:
+                    warnings.warn(
+                        "train checkpoint: stage "
+                        f"{rec.get('uid')!r} has no match in the current "
+                        "DAG; ignoring its checkpoint entry", RuntimeWarning)
+                    continue
+                if not isinstance(cur, Estimator):
+                    continue  # live transformer already usable as-is
+                try:
+                    stage = restore_fitted_stage(rec, arrays)
+                except Exception as e:  # noqa: BLE001 — refit, don't crash
+                    warnings.warn(
+                        f"train checkpoint: cannot restore stage "
+                        f"{rec.get('uid')!r} ({type(e).__name__}: {e}); "
+                        "it will be refit", RuntimeWarning)
+                    continue
+                stage._inputs = cur.input_features
+                stage._output = cur.get_output()
+                # type-preserving stages resolve out_type at set_input
+                # time, which grafting bypasses (same fix as load_model)
+                if type(stage).out_type in (ft.FeatureType, ft.OPMap,
+                                            ft.OPCollection):
+                    stage.out_type = stage._output.ftype
+                stage._from_checkpoint = True
+                overrides[out_uid] = stage
+                run_counters.stages_resumed += 1
+        return overrides
+
+    # -- save ----------------------------------------------------------------
+    def save_layer(self, li: int, fitted_layer) -> None:
+        """Persist one completed layer's fitted stages (atomic +
+        best-effort via ``utils.durable``: a write failure warns and
+        training continues). Stages that cannot serialize are skipped
+        individually with a warning — the rest of the layer still
+        checkpoints and only the skipped stage refits on resume."""
+        from transmogrifai_tpu.utils.durable import (
+            atomic_json_dump, best_effort_checkpoint_write,
+        )
+        if self._disabled:
+            return
+        recs: list[dict] = []
+        arrays: dict[str, np.ndarray] = {}
+        for t in fitted_layer:
+            try:
+                rec, t_arrays = fitted_stage_record(t)
+            except Exception as e:  # noqa: BLE001 — best-effort per stage
+                warnings.warn(
+                    f"train checkpoint: stage {t.uid} does not serialize "
+                    f"({type(e).__name__}: {e}); it will refit on resume",
+                    RuntimeWarning)
+                continue
+            rec["outputFeatureUid"] = t.get_output().uid
+            recs.append(rec)
+            arrays.update(t_arrays)
+
+        key = self._layer_key(fitted_layer)
+
+        def write() -> None:
+            if arrays:
+                atmp = self._arrays_path(key) + ".tmp.npz"
+                with open(atmp, "wb") as fh:
+                    np.savez(fh, **arrays)
+                os.replace(atmp, self._arrays_path(key))
+            self._layers[key] = {"index": li, "stages": recs}
+            manifest = {
+                "formatVersion": FORMAT_VERSION,
+                "fingerprint": self.fingerprint,
+                "layers": {k: v for k, v in sorted(self._layers.items())},
+            }
+            atomic_json_dump(manifest, self._manifest_path(), indent=1,
+                             default=_np_default)
+
+        if not best_effort_checkpoint_write(
+                write, f"train checkpoint: write for layer {li} failed; "
+                       "training continues without it"):
+            self._layers.pop(key, None)
+
+
+def _np_default(o):
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"Not JSON serializable: {type(o)}")

@@ -61,7 +61,7 @@ def make_namespace() -> dict:
     try:
         from transmogrifai_tpu.testkit import random_data
         ns["random_data"] = random_data
-    except Exception:
+    except Exception:  # failure-ok: optional shell-namespace preload
         pass
     return ns
 
@@ -72,7 +72,7 @@ def banner(ns: dict | None = None) -> str:
     try:
         devs = jax.devices()
         backend = f"{devs[0].platform} x{len(devs)}"
-    except Exception as e:  # dead tunnel etc: the shell still opens
+    except Exception as e:  # dead tunnel etc: the shell still opens (failure-ok: banner reports backend unavailable)
         backend = f"unavailable ({type(e).__name__})"
     names = ", ".join(sorted(ns if ns is not None else make_namespace()))
     return (f"transmogrifai_tpu shell — backend: {backend}\n"

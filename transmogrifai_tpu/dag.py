@@ -196,11 +196,17 @@ class DagExecutor:
                         for t in host_ts}
             data = data.with_host_cols(new_host)
         if dev_ts:
+            from transmogrifai_tpu.utils.retry import with_device_retry
             fused = self._fused_program(dev_ts)
             params = {t.uid: t.device_params() for t in dev_ts}
             in_cols = {n: data.device_col(n)
                        for t in dev_ts for n in t.runtime_input_names()}
-            outs = fused(params, in_cols)
+            # the fused layer program is the training/scoring hot path's
+            # device dispatch: transient device errors (flaky tunnel, and
+            # the chaos suite's injected faults) retry with backoff instead
+            # of killing a run a checkpoint would otherwise have to resume
+            outs = with_device_retry(fused, params, in_cols,
+                                     site="dag.apply_layer")
             data = data.with_device_cols(outs)
             # record fitted vector metadata OUTSIDE the traced program
             # (ModelInsights' fallback reads the last stage's out_meta;

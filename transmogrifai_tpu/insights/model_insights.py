@@ -153,7 +153,7 @@ class ModelInsights:
             try:
                 contributions = np.asarray(
                     selected.model.feature_contributions())
-            except Exception:
+            except Exception:  # failure-ok: contributions are optional in the report
                 contributions = None
 
         def _strip_index(name: str) -> str:

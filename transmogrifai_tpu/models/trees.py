@@ -116,7 +116,7 @@ def _hist_mode_for(Xb) -> str:
         return "scatter"
     try:
         single = len(Xb.devices()) == 1
-    except Exception:
+    except Exception:  # failure-ok: device probe; default to single-device route
         single = True
 
     def sharded_route() -> tuple[str, str]:

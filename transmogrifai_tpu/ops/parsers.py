@@ -190,7 +190,7 @@ def _zip_mime(data: bytes) -> str:
     try:
         with zipfile.ZipFile(io.BytesIO(data)) as z:
             names = set(z.namelist())
-    except Exception:
+    except Exception:  # failure-ok: unreadable zip still reports the generic mime
         return "application/zip"
     if any(n.startswith("word/") for n in names):
         return ("application/vnd.openxmlformats-officedocument"
@@ -413,7 +413,7 @@ class MimeTypeDetector(HostTransformer):
             return None
         try:
             data = _b64.b64decode(value, validate=False)
-        except Exception:
+        except Exception:  # failure-ok: invalid base64 value parses as missing
             return None
         if not data:
             return None

@@ -752,7 +752,7 @@ class Base64MapMimeDetector(HostTransformer):
                 continue
             try:
                 data = base64.b64decode(v, validate=False)
-            except Exception:
+            except Exception:  # failure-ok: invalid base64 entry is skipped
                 continue
             if data:
                 out[k] = detect_mime(data)

@@ -18,7 +18,8 @@ provide their own combine.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +29,79 @@ from transmogrifai_tpu.parallel.mesh import (
     DATA_AXIS, MeshContext, shard_map_compat,
 )
 
-__all__ = ["tree_psum", "tree_pmax", "tree_pmin", "mesh_reduce_stats"]
+__all__ = ["tree_psum", "tree_pmax", "tree_pmin", "mesh_reduce_stats",
+           "CollectiveTimeoutError", "run_with_deadline",
+           "collective_timeout_s"]
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A multihost collective/barrier exceeded its deadline. One dead or
+    partitioned host makes every OTHER host block inside the collective
+    forever — this error converts the silent pod-wide hang into a fast,
+    per-host-attributed failure an orchestrator can act on (restart the
+    pod, resume from checkpoints). Carries ``DEADLINE_EXCEEDED`` in the
+    message so retry classification treats it as transient infrastructure.
+    """
+
+
+def collective_timeout_s(timeout_s: Optional[float] = None) -> float:
+    """Effective collective deadline: the explicit argument, else
+    ``TRANSMOGRIFAI_COLLECTIVE_TIMEOUT_S`` (default 600). ``0`` disables
+    the guard (legacy block-forever behavior)."""
+    if timeout_s is not None:
+        return float(timeout_s)
+    from transmogrifai_tpu.utils.retry import _env_float
+    return _env_float("TRANSMOGRIFAI_COLLECTIVE_TIMEOUT_S", 600.0)
+
+
+def _host_diagnostics() -> str:
+    try:
+        return (f"host {jax.process_index()}/{jax.process_count()}, "
+                f"{len(jax.local_devices())} local device(s), "
+                f"backend={jax.default_backend()}")
+    except Exception:  # failure-ok: diagnostics must never mask the timeout
+        return "host ?/? (jax backend unavailable)"
+
+
+def run_with_deadline(fn: Callable[[], Any], *, name: str,
+                      timeout_s: Optional[float] = None) -> Any:
+    """Run a blocking collective with a deadline: ``fn()`` executes on a
+    worker thread; if it has not returned within the timeout, raise
+    :class:`CollectiveTimeoutError` naming the collective and this host
+    instead of hanging the pod. The abandoned thread is daemonic — the
+    expected reaction to a timeout is tearing the process down and
+    resuming from checkpoints, exactly what resumable training enables.
+
+    Deliberately guarded even single-process: barrier/shard_global_rows
+    are rare, per-phase calls whose bounded-wait contract must hold (and
+    be chaos-testable) everywhere; only the per-stats-call hot path
+    (``mesh_reduce_stats``) skips the guard when no peer can be dead."""
+    timeout = collective_timeout_s(timeout_s)
+    if timeout <= 0:
+        return fn()
+    box: dict[str, Any] = {}
+    done = threading.Event()
+
+    def work() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reraised on the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, daemon=True,
+                         name=f"collective[{name}]")
+    t.start()
+    if not done.wait(timeout):
+        raise CollectiveTimeoutError(
+            f"DEADLINE_EXCEEDED: collective {name!r} timed out after "
+            f"{timeout:g}s on {_host_diagnostics()} — a peer host is "
+            "likely dead or partitioned; restart the job and resume from "
+            "checkpoints (docs/ROBUSTNESS.md)")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 def tree_psum(tree: Any, axis: str = DATA_AXIS) -> Any:
@@ -47,7 +120,8 @@ def tree_pmin(tree: Any, axis: str = DATA_AXIS) -> Any:
 def mesh_reduce_stats(ctx: MeshContext,
                       local_stats_fn: Callable[..., Any],
                       *row_sharded_args: jax.Array,
-                      reduce: Callable[[Any], Any] | None = None) -> Any:
+                      reduce: Callable[[Any], Any] | None = None,
+                      timeout_s: Optional[float] = None) -> Any:
     """Run a per-shard statistics function over row-sharded inputs and
     all-reduce the resulting monoid pytree across the data axis.
 
@@ -59,6 +133,13 @@ def mesh_reduce_stats(ctx: MeshContext,
     ``reduce`` combines the per-shard pytrees (default ``tree_psum``); pass a
     custom combiner for non-additive monoids, e.g. one that psums sums but
     pmins/pmaxes extrema — it runs inside shard_map with the data axis bound.
+
+    Multihost, the all-reduce rides DCN and a dead peer host blocks it
+    forever: the dispatch + materialization runs under a deadline
+    (``timeout_s``, default env ``TRANSMOGRIFAI_COLLECTIVE_TIMEOUT_S``)
+    and raises :class:`CollectiveTimeoutError` with per-host diagnostics
+    instead of hanging the pod. Single-process meshes skip the guard — no
+    peer can be dead, and stats calls stay thread-free on the hot path.
     """
     combine = reduce if reduce is not None else tree_psum
     in_specs = tuple(
@@ -69,4 +150,10 @@ def mesh_reduce_stats(ctx: MeshContext,
 
     fn = shard_map_compat(shard_fn, mesh=ctx.mesh, in_specs=in_specs,
                           out_specs=P())
-    return fn(*row_sharded_args)
+    if jax.process_count() <= 1:
+        return fn(*row_sharded_args)
+    # block inside the deadline: jit dispatch is async, so only a
+    # block_until_ready surfaces a cross-host hang at this seam
+    return run_with_deadline(
+        lambda: jax.block_until_ready(fn(*row_sharded_args)),
+        name="mesh_reduce_stats", timeout_s=timeout_s)

@@ -71,22 +71,61 @@ def global_mesh(n_model: int = 1):
     return make_mesh(n_model=n_model, devices=jax.devices())
 
 
-def shard_global_rows(ctx, local_rows: np.ndarray) -> jax.Array:
+def shard_global_rows(ctx, local_rows: np.ndarray,
+                      timeout_s: Optional[float] = None) -> jax.Array:
     """Assemble a GLOBAL row-sharded array from each process's local rows
     (the multi-host ingest seam: every host reads its own partition, the
     result behaves as one logical array over the whole mesh).
 
     The global row count is ``sum over processes`` of local counts; local
     row counts must be equal (pad with masked rows first if not).
-    """
+
+    The assembly is a cross-host collective (device uploads + an implicit
+    rendezvous): transient device errors retry with capped jittered
+    backoff, and the retry loop as a whole runs under a deadline — a dead
+    peer host raises ``CollectiveTimeoutError`` with per-host diagnostics
+    instead of hanging the pod (``timeout_s`` / env
+    ``TRANSMOGRIFAI_COLLECTIVE_TIMEOUT_S``). The retry sits INSIDE the
+    deadline, never around it: re-entering a collective while a timed-out
+    attempt's thread is still blocked in the old one would pair this
+    host's retry with its peers' first attempt and desynchronize the
+    pod's collective stream — a timeout here means restart-and-resume,
+    not retry."""
     from jax.experimental import multihost_utils
-    return multihost_utils.host_local_array_to_global_array(
-        local_rows, ctx.mesh,
-        jax.sharding.PartitionSpec(
-            "data", *([None] * (np.ndim(local_rows) - 1))))
+
+    from transmogrifai_tpu.parallel.collectives import run_with_deadline
+    from transmogrifai_tpu.utils.faults import fault_point
+    from transmogrifai_tpu.utils.retry import with_device_retry
+
+    def assemble():
+        fault_point("collective")
+        return multihost_utils.host_local_array_to_global_array(
+            local_rows, ctx.mesh,
+            jax.sharding.PartitionSpec(
+                "data", *([None] * (np.ndim(local_rows) - 1))))
+
+    return run_with_deadline(
+        lambda: with_device_retry(assemble),
+        name="shard_global_rows", timeout_s=timeout_s)
 
 
-def barrier(name: str = "transmogrifai") -> None:
-    """Block until every process reaches this point (DCN sync)."""
+def barrier(name: str = "transmogrifai",
+            timeout_s: Optional[float] = None) -> None:
+    """Block until every process reaches this point (DCN sync) — bounded.
+
+    A host that died before reaching the barrier used to hang every other
+    host forever; the sync now runs under a deadline (``timeout_s``,
+    default env ``TRANSMOGRIFAI_COLLECTIVE_TIMEOUT_S`` = 600s, ``0``
+    restores unbounded waiting) and raises ``CollectiveTimeoutError``
+    naming the barrier and this host so the orchestrator can restart the
+    job and resume from checkpoints."""
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+
+    from transmogrifai_tpu.parallel.collectives import run_with_deadline
+    from transmogrifai_tpu.utils.faults import fault_point
+
+    def sync():
+        fault_point("collective")
+        multihost_utils.sync_global_devices(name)
+
+    run_with_deadline(sync, name=f"barrier[{name}]", timeout_s=timeout_s)

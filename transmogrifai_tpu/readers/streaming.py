@@ -83,13 +83,24 @@ class StreamCheckpoint:
             self._save()
 
     def _save(self) -> None:
-        tmp = self.path + ".tmp"
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(tmp, "w") as fh:
-            json.dump({"done": self._done, "skipped": self._skipped}, fh)
-        os.replace(tmp, self.path)
+        """Atomic, best-effort (``utils.durable``): a checkpoint-write
+        failure must degrade to at-least-once replay on restart (batch
+        re-scored), never kill a stream whose scoring is healthy."""
+        from transmogrifai_tpu.utils.durable import (
+            atomic_json_dump, best_effort_checkpoint_write,
+        )
+
+        def write() -> None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            atomic_json_dump({"done": self._done, "skipped": self._skipped},
+                             self.path)
+
+        best_effort_checkpoint_write(
+            write,
+            f"StreamCheckpoint: write to {self.path!r} failed; progress "
+            "not persisted — a restart may replay recent batches")
 
 
 class StreamingReader:
@@ -177,8 +188,18 @@ class FileStreamingReader(StreamingReader):
                 read_fp = (StreamCheckpoint._fingerprint(f)
                            if self.checkpoint is not None else None)
                 try:
+                    # chaos seam: an injected host-IO fault here follows
+                    # the exact partially-written-file path below (retry
+                    # next poll, abandon after max_retries_per_file)
+                    from transmogrifai_tpu.utils.faults import fault_point
+                    fault_point("ingest.read")
                     records = list(reader.read())
-                except Exception:
+                except Exception as read_err:
+                    from transmogrifai_tpu.utils.faults import (
+                        FaultHarnessError,
+                    )
+                    if isinstance(read_err, FaultHarnessError):
+                        raise  # injected crash / misconfigured plan: die
                     # likely a partially-written file: retry on a later
                     # poll (one attempt per poll interval, so a slow
                     # producer gets real wall-clock time to finish), give

@@ -44,7 +44,14 @@ class WorkflowRunner:
         self.scoring_reader_factory = scoring_reader_factory
         self.on_end_handlers: list[Callable[[dict], None]] = []
 
-    def run(self, run_type: str, params: OpParams) -> dict:
+    def run(self, run_type: str, params: OpParams,
+            checkpoint_dir: Optional[str] = None) -> dict:
+        """Execute one parameterized run. ``checkpoint_dir`` (TRAIN only)
+        enables resumable training: fitted DAG layers and the selector
+        sweep checkpoint there, and re-running the same command after a
+        crash/preemption resumes instead of refitting (the run result's
+        ``appMetrics.runCounters.layersResumed`` reports how much work the
+        resume skipped)."""
         t0 = time.time()
         profiler.reset(app_name=f"transmogrifai_tpu.{run_type}")
         applied = params.apply_to_stages(
@@ -61,7 +68,10 @@ class WorkflowRunner:
         try:
             if run_type == RunTypes.TRAIN:
                 with profiler.phase(OpStep.MODEL_TRAINING):
-                    model = self.workflow.train()
+                    model = self.workflow.train(
+                        checkpoint_dir=checkpoint_dir)
+                if checkpoint_dir:
+                    result["checkpointDir"] = checkpoint_dir
                 if params.model_location:
                     with profiler.phase(OpStep.RESULTS_SAVING):
                         model.save(params.model_location)
@@ -166,7 +176,7 @@ class WorkflowRunner:
                     for f in window:
                         try:
                             s = f.result()
-                        except Exception as e:  # noqa: BLE001
+                        except Exception as e:  # noqa: BLE001 — reported in the result slot
                             s = {"error": f"{type(e).__name__}: {e}"}
                             n_errors += 1
                         n_rows += 1
@@ -263,11 +273,16 @@ def main(argv=None):
     ap.add_argument("--params", required=True, help="OpParams json path")
     ap.add_argument("--workflow", required=True,
                     help="import path to a module:attr WorkflowRunner")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="resumable training: fitted DAG layers + the "
+                         "selector sweep checkpoint here; re-running after "
+                         "a crash resumes instead of refitting (train only)")
     args = ap.parse_args(argv)
     import importlib
     mod, _, attr = args.workflow.partition(":")
     runner: WorkflowRunner = getattr(importlib.import_module(mod), attr)
-    result = runner.run(args.run_type, OpParams.from_file(args.params))
+    result = runner.run(args.run_type, OpParams.from_file(args.params),
+                        checkpoint_dir=args.checkpoint_dir)
     print(json.dumps(result, indent=2, default=str))
     return 0 if result.get("status") == "success" else 1
 

@@ -259,7 +259,11 @@ class ModelSelector(Estimator):
         if not self.checkpoint_dir:
             return None
         import os
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
+
+        from transmogrifai_tpu.utils.durable import ensure_checkpoint_dir
+        if not ensure_checkpoint_dir(self.checkpoint_dir,
+                                     "sweep checkpoint"):
+            return None
         return os.path.join(self.checkpoint_dir, "sweep.json")
 
     def _ckpt_load(self) -> dict:
@@ -278,29 +282,35 @@ class ModelSelector(Estimator):
             return {k: [float("nan") if v is None else float(v)
                         for v in vals]
                     for k, vals in raw["entries"].items()}
-        except Exception:  # malformed/wrong-shape file == absent
+        except Exception as e:  # noqa: BLE001 — malformed/truncated file
+            # must cost a fresh sweep, never a crashed run — but silently
+            # eating it would hide real corruption from operators
+            import warnings
+            warnings.warn(
+                f"sweep checkpoint: unreadable state at {path!r} "
+                f"({type(e).__name__}: {e}); starting the sweep fresh",
+                RuntimeWarning)
             return {}
 
     def _ckpt_save(self, done: dict) -> None:
-        """Best-effort: a checkpoint write failure must never fail a sweep
-        whose training actually succeeded."""
+        """Best-effort, atomic (``utils.durable``): a checkpoint write
+        failure must never fail a sweep whose training succeeded."""
         path = self._ckpt_path()
         if path is None:
             return
-        import json
-        import os
-        try:
+        from transmogrifai_tpu.utils.durable import (
+            atomic_json_dump, best_effort_checkpoint_write,
+        )
+
+        def write() -> None:
             clean = {k: [v if np.isfinite(v) else None for v in vals]
                      for k, vals in done.items()}
-            tmp = path + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump({"fingerprint": self._ckpt_fingerprint(),
-                           "entries": clean}, fh, allow_nan=False)
-            os.replace(tmp, path)  # atomic: a crash never corrupts the file
-        except Exception as e:
-            import warnings
-            warnings.warn(f"sweep checkpoint write failed ({e}); "
-                          "continuing without checkpointing", RuntimeWarning)
+            atomic_json_dump({"fingerprint": self._ckpt_fingerprint(),
+                              "entries": clean}, path, allow_nan=False)
+
+        best_effort_checkpoint_write(
+            write, "sweep checkpoint write failed; continuing without "
+                   "checkpointing")
 
     # -- shared pieces -------------------------------------------------------
     def _split_prepare(self, n: int, y) -> tuple[np.ndarray, np.ndarray,
@@ -359,7 +369,7 @@ class ModelSelector(Estimator):
             limit = float(stats.get("bytes_limit", 0))
             if limit > 0:
                 return 0.5 * limit
-        except Exception:
+        except Exception:  # failure-ok: memory-stats probe; conservative default
             pass
         return float(4 << 30)
 
@@ -482,7 +492,7 @@ class ModelSelector(Estimator):
                         # the sweep discards models; the winner refits)
                         scores = with_device_retry(
                             est.grid_scores_folds, Xtr_s, ytr_s, wtr_s,
-                            grid, Xva_s)
+                            grid, Xva_s, site="sweep.fit")
                         if scores is None:
                             raise _FoldStackFallback()
                         # ONE host sync: metrics for every (fold, grid)
@@ -492,6 +502,11 @@ class ModelSelector(Estimator):
                 except _FoldStackFallback:
                     use_stacked = False  # family lacks the axis: fold loop
                 except Exception as e:  # noqa: BLE001 — isolation by design
+                    from transmogrifai_tpu.utils.faults import (
+                        FaultHarnessError,
+                    )
+                    if isinstance(e, FaultHarnessError):
+                        raise  # a preempted process dies; it does not isolate
                     failures.append({
                         "modelName": fname,
                         "reason": f"stacked sweep: {type(e).__name__}: "
@@ -563,7 +578,7 @@ class ModelSelector(Estimator):
             with sweep_counters.tracking(fname):
                 models = with_device_retry(
                     est.grid_fit_arrays, Xtr, ytr, wtr, grid,
-                    **(fit_kwargs or {}))
+                    site="sweep.fit", **(fit_kwargs or {}))
                 scores = (est.grid_predict_scores(models, Xva)
                           if batch_metrics is not None else None)
                 if scores is not None:
@@ -586,6 +601,9 @@ class ModelSelector(Estimator):
                                          host_syncs=max(len(grid), 1),
                                          mode="fold_loop")
         except Exception as e:  # noqa: BLE001 — isolation by design
+            from transmogrifai_tpu.utils.faults import FaultHarnessError
+            if isinstance(e, FaultHarnessError):
+                raise  # a preempted process dies; it does not isolate
             for gj in range(len(grid)):
                 per_candidate_scores.pop((ci, gj), None)
             failures.append({
@@ -708,7 +726,8 @@ class ModelSelector(Estimator):
         best_params = {**best_est.params, **best_grid[best_gj]}
         best_model = with_device_retry(
             best_est.fit_arrays,
-            *pmesh.shard_training_rows(Xt, yt, wt), best_params)
+            *pmesh.shard_training_rows(Xt, yt, wt), best_params,
+            site="sweep.fit")
 
         train_eval: dict = {}
         holdout_eval: dict = {}

@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
@@ -26,7 +26,9 @@ from transmogrifai_tpu.stages.base import (
 )
 from transmogrifai_tpu.types import feature_types as ft
 
-__all__ = ["save_model", "load_model", "MODEL_JSON", "ARRAYS_NPZ"]
+__all__ = ["save_model", "load_model", "MODEL_JSON", "ARRAYS_NPZ",
+           "fitted_stage_record", "restore_fitted_stage",
+           "resolve_stage_class"]
 
 MODEL_JSON = "model.json"
 ARRAYS_NPZ = "arrays.npz"
@@ -35,6 +37,65 @@ FORMAT_VERSION = 1
 
 def _feature_json(f) -> dict:
     return f.to_transient().to_json()
+
+
+def fitted_stage_record(t) -> tuple[dict, dict[str, np.ndarray]]:
+    """One fitted transformer as a (json record, arrays) pair — the shared
+    persistence unit of full-model save (``save_model``) and per-layer
+    train checkpoints (``checkpoint.TrainCheckpoint``). Array-valued fitted
+    state splits into the npz side keyed ``uid||key``; everything else
+    rides in the record's ``stateJson``."""
+    state = t.fitted_state()
+    state_json: dict[str, Any] = {}
+    arrays: dict[str, np.ndarray] = {}
+    for k, v in state.items():
+        if isinstance(v, np.ndarray):
+            arrays[f"{t.uid}||{k}"] = v
+        else:
+            state_json[k] = v
+    rec = {
+        "class": type(t).__name__,
+        "module": type(t).__module__,
+        "uid": t.uid,
+        "operationName": t.operation_name,
+        "config": t.config(),
+        "stateJson": state_json,
+    }
+    return rec, arrays
+
+
+def resolve_stage_class(class_name: str, module: Optional[str] = None):
+    """Stage class from the registry, importing ``module`` to fill it if
+    needed (the analog of ctor reflection in the reference reader)."""
+    cls = STAGE_REGISTRY.get(class_name)
+    if cls is None and module:
+        import importlib
+        try:
+            importlib.import_module(module)
+        except ImportError:
+            pass  # fall through to the actionable KeyError below
+        cls = STAGE_REGISTRY.get(class_name)
+    if cls is None:
+        raise KeyError(f"Unknown stage class {class_name!r}; import its "
+                       "module before loading")
+    return cls
+
+
+def restore_fitted_stage(rec: dict, arrays: dict) -> PipelineStage:
+    """Rebuild a fitted transformer from a ``fitted_stage_record`` pair.
+    The stage comes back UNWIRED (no input/output features) — callers graft
+    it onto their feature graph (``load_model`` rebuilds one from the
+    manifest; the train checkpoint reuses the live workflow's)."""
+    cls = resolve_stage_class(rec["class"], rec.get("module"))
+    stage: PipelineStage = cls.from_config(rec["config"], uid=rec["uid"])
+    state: dict[str, Any] = dict(rec.get("stateJson") or {})
+    prefix = f"{rec['uid']}||"
+    for k, v in arrays.items():
+        if k.startswith(prefix):
+            state[k[len(prefix):]] = v
+    if state:
+        stage.set_fitted_state(state)
+    return stage
 
 
 def save_model(model, path: str, overwrite: bool = True) -> None:
@@ -51,24 +112,14 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
     arrays: dict[str, np.ndarray] = {}
     for li, layer in enumerate(model.dag):
         for t in layer:
-            state = t.fitted_state()
-            state_json: dict[str, Any] = {}
-            for k, v in state.items():
-                if isinstance(v, np.ndarray):
-                    arrays[f"{t.uid}||{k}"] = v
-                else:
-                    state_json[k] = v
-            stages_json.append({
-                "class": type(t).__name__,
-                "module": type(t).__module__,
-                "uid": t.uid,
-                "operationName": t.operation_name,
-                "config": t.config(),
+            rec, t_arrays = fitted_stage_record(t)
+            arrays.update(t_arrays)
+            rec.update({
                 "inputFeatures": [_feature_json(f) for f in t.input_features],
                 "outputFeature": _feature_json(t.get_output()),
                 "layer": li,
-                "stateJson": state_json,
             })
+            stages_json.append(rec)
 
     from transmogrifai_tpu.utils.version import VersionInfo
     manifest = {
@@ -133,19 +184,7 @@ def load_model(path: str):
     n_layers = 1 + max((s["layer"] for s in manifest["stages"]), default=0)
     dag = [[] for _ in range(n_layers)]
     for s in manifest["stages"]:
-        cls = STAGE_REGISTRY.get(s["class"])
-        if cls is None and s.get("module"):
-            # registry fills on import; manifests record the defining module
-            import importlib
-            try:
-                importlib.import_module(s["module"])
-            except ImportError:
-                pass  # fall through to the actionable KeyError below
-            cls = STAGE_REGISTRY.get(s["class"])
-        if cls is None:
-            raise KeyError(f"Unknown stage class {s['class']!r}; import its "
-                           "module before loading")
-        stage: PipelineStage = cls.from_config(s["config"], uid=s["uid"])
+        stage: PipelineStage = restore_fitted_stage(s, arrays)
         ins = []
         for fd in s["inputFeatures"]:
             if fd["uid"] not in features:
@@ -162,13 +201,6 @@ def load_model(path: str):
         if type(stage).out_type in (ft.FeatureType, ft.OPMap,
                                     ft.OPCollection):
             stage.out_type = out.ftype
-        state: dict[str, Any] = dict(s.get("stateJson") or {})
-        prefix = f"{s['uid']}||"
-        for k, v in arrays.items():
-            if k.startswith(prefix):
-                state[k[len(prefix):]] = v
-        if state:
-            stage.set_fitted_state(state)
         dag[s["layer"]].append(stage)
 
     result = [features[d["uid"]] for d in manifest["resultFeatures"]]

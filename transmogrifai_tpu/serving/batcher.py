@@ -258,5 +258,5 @@ def _settle(future: Future, value: Any, is_error: Optional[bool] = None
             future.set_exception(value)
         else:
             future.set_result(value)
-    except Exception:  # already cancelled/settled: the caller gave up first
+    except Exception:  # already cancelled/settled: the caller gave up first (failure-ok)
         pass

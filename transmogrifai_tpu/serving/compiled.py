@@ -270,7 +270,7 @@ class CompiledScorer:
         for prog in self._programs.values():
             try:
                 total += prog._cache_size()
-            except Exception:  # jit internals moved: compiles stay 0
+            except Exception:  # jit internals moved: compiles stay 0 (failure-ok)
                 pass
         return total
 

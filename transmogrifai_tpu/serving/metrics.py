@@ -190,7 +190,7 @@ class ServingMetrics:
         if self.queue_depth_fn is not None:
             try:
                 queue_doc["depth"] = int(self.queue_depth_fn())
-            except Exception:
+            except Exception:  # failure-ok: queue-depth probe is optional in snapshots
                 queue_doc["depth"] = None
         doc["queue"] = queue_doc
         doc["compileBuckets"] = self.compile_counters.to_json() \

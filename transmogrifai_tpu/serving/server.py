@@ -189,7 +189,13 @@ class ScoringServer:
                 self.metrics.record_data_error_batch()
                 results = self._row_dispatch(rows)
             except Exception as e:  # noqa: BLE001 — any OTHER compiled-path
-                # failure is infrastructure: degrade, re-serve below
+                # failure is infrastructure: degrade, re-serve below —
+                # EXCEPT harness errors (simulated preemption, misconfigured
+                # fault plan), which must surface (the batcher fails the
+                # batch's futures with it), never become degradation
+                from transmogrifai_tpu.utils.faults import FaultHarnessError
+                if isinstance(e, FaultHarnessError):
+                    raise
                 self._enter_degraded(e)
                 results = self._row_dispatch(rows)
         else:
@@ -208,10 +214,15 @@ class ScoringServer:
         return False
 
     def _compiled_dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        from transmogrifai_tpu.utils.faults import fault_point
         attempts = {"n": 0}
 
         def attempt():
             attempts["n"] += 1
+            # chaos seam: injected transient faults exercise the retry
+            # path, anything else the degrade-to-row-path machinery —
+            # inside attempt() so serving's own retry metrics see it
+            fault_point("serving.dispatch")
             return self.scorer.score_batch(rows)
 
         try:

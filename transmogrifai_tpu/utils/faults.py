@@ -1,0 +1,277 @@
+"""Deterministic fault injection: the failure-domain test harness.
+
+The reference inherits Spark's chaos-resilience for free and tests it on
+real clusters; our failure paths (transient-device retry, checkpoint
+resume, streaming re-read, serving degradation, collective timeouts) must
+instead be *deterministically* exercisable in CI. A :class:`FaultPlan`
+names WHERE (an instrumented site), WHEN (the Nth invocation of that
+site), WHAT (transient device error, host-IO error, slow call, simulated
+preemption) and HOW OFTEN (a consecutive count, or a seeded probability),
+so a test — or an operator reproducing an incident — replays the exact
+same failure sequence every run.
+
+Instrumented sites (grep ``fault_point(`` for the authoritative list):
+
+========================  ====================================================
+``dag.apply_layer``       fused device program of a DAG layer (via retry)
+``sweep.fit``             one ModelSelector (fold, family) fit/score unit
+``train.layer``           start of each Workflow.train layer (preemption)
+``ingest.read``           one streaming micro-batch file read
+``checkpoint.write``      any durable checkpoint write (train/sweep/stream)
+``collective``            multihost barrier / global-array assembly
+``serving.dispatch``      one compiled serving batch dispatch
+========================  ====================================================
+
+Plan syntax (env ``TRANSMOGRIFAI_FAULT_PLAN`` or programmatic), entries
+separated by ``;``::
+
+    kind@site[#at][xtimes][:delay_s][%prob]
+
+    transient@sweep.fit#1        fail the 2nd sweep unit with a transient
+                                 (retryable) XlaRuntimeError, once
+    transient@dag.apply_layer#0x2  fail the first TWO layer dispatches
+    preempt@train.layer#2        kill the process at layer 2 (SIGKILL analog)
+    io@checkpoint.write          OSError on the first checkpoint write
+    slow@collective:30           a 30s stall (dead-host analog) on the first
+                                 collective
+    transient@serving.dispatch%0.5  seeded coin-flip per dispatch
+
+``kind``: ``transient`` | ``io`` | ``slow`` | ``preempt``. ``#at`` is the
+0-based invocation index the entry starts firing at (default 0);
+``xtimes`` the number of consecutive firings (default 1, ``x*`` forever);
+``:delay_s`` the stall for ``slow``; ``%prob`` replaces the #at/xtimes
+window with a per-invocation Bernoulli draw from the plan's seeded RNG.
+
+Injection is a no-op (one dict lookup) when no plan is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import warnings
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["FaultPlan", "FaultSpec", "FaultHarnessError",
+           "SimulatedPreemption", "XlaRuntimeError", "fault_point",
+           "install_plan", "clear_plan", "active_plan", "fault_plan"]
+
+#: the instrumented site names (documentation + parse-time validation)
+KNOWN_SITES = frozenset({
+    "dag.apply_layer", "sweep.fit", "train.layer", "ingest.read",
+    "checkpoint.write", "collective", "serving.dispatch",
+})
+
+KINDS = ("transient", "io", "slow", "preempt")
+
+
+class FaultHarnessError(Exception):
+    """Base of errors the harness itself must surface — never swallowed.
+
+    Every failure-isolation handler in the framework (sweep candidate
+    isolation, streaming read retry, checkpoint best-effort writes,
+    serving degradation) re-raises this type: a harness-originated error
+    converted into graceful degradation would report a chaos run green
+    without exercising anything. Deliberately NOT a RuntimeError so
+    ``utils.retry`` never classifies it as transient."""
+
+
+class SimulatedPreemption(FaultHarnessError):
+    """An injected crash/preemption: the in-process analog of SIGKILL.
+    A preempted process does not retry or degrade — it dies and resumes
+    from its checkpoints."""
+
+
+class XlaRuntimeError(RuntimeError):
+    """Injected stand-in for ``jaxlib``'s XlaRuntimeError: same type NAME
+    and UNAVAILABLE-class status text, so ``utils.retry.
+    is_transient_device_error`` classifies it exactly like the real thing
+    observed on flaky TPU tunnels."""
+
+
+class FaultSpec:
+    """One parsed plan entry. See module docstring for the syntax."""
+
+    def __init__(self, kind: str, site: str, at: int = 0, times: int = 1,
+                 delay_s: float = 1.0, prob: Optional[float] = None):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; one of {sorted(KNOWN_SITES)}")
+        self.kind = kind
+        self.site = site
+        self.at = int(at)
+        self.times = times  # -1 == forever
+        self.delay_s = float(delay_s)
+        self.prob = prob
+
+    def should_fire(self, invocation: int, rng: random.Random) -> bool:
+        if self.prob is not None:
+            return rng.random() < self.prob
+        if invocation < self.at:
+            return False
+        return self.times < 0 or invocation < self.at + self.times
+
+    @classmethod
+    def parse(cls, entry: str) -> "FaultSpec":
+        text = entry.strip()
+        kind, sep, rest = text.partition("@")
+        if not sep or not rest:
+            raise ValueError(f"bad fault entry {entry!r}: expected kind@site")
+        prob = None
+        if "%" in rest:
+            rest, _, p = rest.partition("%")
+            prob = float(p)
+        delay_s = 1.0
+        if ":" in rest:
+            rest, _, d = rest.partition(":")
+            delay_s = float(d)
+        at, times = 0, 1
+        if "#" in rest:
+            rest, _, window = rest.partition("#")
+            if "x" in window:
+                a, _, t = window.partition("x")
+                at = int(a) if a else 0
+                times = -1 if t == "*" else int(t)
+            else:
+                at = int(window)
+        return cls(kind.strip(), rest.strip(), at=at, times=times,
+                   delay_s=delay_s, prob=prob)
+
+    def __repr__(self) -> str:
+        win = f"%{self.prob}" if self.prob is not None else \
+            f"#{self.at}x{'*' if self.times < 0 else self.times}"
+        return f"FaultSpec({self.kind}@{self.site}{win})"
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of injected faults.
+
+    Per-site invocation counters make deterministic entries exactly
+    reproducible; probabilistic entries draw from one ``random.Random``
+    seeded at construction, so the same plan + seed produces the same
+    fault sequence run after run. ``fired`` records every injection as
+    ``(site, invocation, kind)`` for post-hoc assertions."""
+
+    def __init__(self, specs, seed: int = 0):
+        self.specs = [FaultSpec.parse(s) if isinstance(s, str) else s
+                      for s in specs]
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.invocations: dict[str, int] = {}
+        self.fired: list[tuple[str, int, str]] = []
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        entries = [e for e in text.split(";") if e.strip()]
+        return cls(entries, seed=seed)
+
+    def reset(self) -> None:
+        self._rng = random.Random(self.seed)
+        self.invocations = {}
+        self.fired = []
+
+    def check(self, site: str) -> None:
+        """Count one invocation of ``site`` and inject whatever the plan
+        schedules for it. Raises / stalls in the CALLER's frame. ``fired``
+        records each injection as it is DELIVERED — when one spec raises,
+        later matching specs are neither delivered nor recorded."""
+        with self._lock:
+            inv = self.invocations.get(site, 0)
+            self.invocations[site] = inv + 1
+            to_fire = [s for s in self.specs if s.site == site
+                       and s.should_fire(inv, self._rng)]
+        for s in to_fire:
+            self.fired.append((site, inv, s.kind))
+            _inject(s, site, inv)
+
+
+def _inject(spec: FaultSpec, site: str, inv: int) -> None:
+    from transmogrifai_tpu.utils.profiling import run_counters
+    run_counters.faults_injected += 1
+    tag = f"injected fault at {site}#{inv}"
+    if spec.kind == "slow":
+        import time
+        time.sleep(spec.delay_s)
+        return
+    if spec.kind == "transient":
+        raise XlaRuntimeError(f"UNAVAILABLE: {tag} (simulated flaky device)")
+    if spec.kind == "io":
+        raise OSError(f"{tag} (simulated host-IO failure)")
+    if spec.kind == "preempt":
+        raise SimulatedPreemption(f"{tag} (simulated preemption)")
+
+
+# -- global plan registry -----------------------------------------------------
+
+_plan: Optional[FaultPlan] = None
+#: (env string, parsed plan) cache so an unset/unchanged env costs one lookup
+_env_cache: tuple[Optional[str], Optional[FaultPlan]] = (None, None)
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` process-wide (programmatic alternative to the
+    ``TRANSMOGRIFAI_FAULT_PLAN`` env var, which it overrides)."""
+    global _plan
+    _plan = plan
+    return plan
+
+
+def clear_plan() -> None:
+    global _plan
+    _plan = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one parsed from the env var (cached)."""
+    if _plan is not None:
+        return _plan
+    global _env_cache
+    env = os.environ.get("TRANSMOGRIFAI_FAULT_PLAN")
+    if env == _env_cache[0]:
+        return _env_cache[1]
+    parsed: Optional[FaultPlan] = None
+    if env:
+        try:
+            seed = int(os.environ.get("TRANSMOGRIFAI_FAULT_SEED", "0"))
+            parsed = FaultPlan.parse(env, seed=seed)
+        except Exception as e:
+            # a typo'd plan must not silently run fault-free (a chaos run
+            # would report green without injecting anything) — and because
+            # fault_point sits inside instrumented try-blocks, the error
+            # must be a FaultHarnessError so failure-isolation handlers
+            # re-raise it instead of degrading gracefully around it
+            raise FaultHarnessError(
+                f"TRANSMOGRIFAI_FAULT_PLAN={env!r} failed to parse") from e
+    _env_cache = (env, parsed)
+    return parsed
+
+
+@contextmanager
+def fault_plan(plan_or_text, seed: int = 0):
+    """Scoped plan installation for tests::
+
+        with fault_plan("transient@dag.apply_layer#0x2"):
+            model = wf.train()
+    """
+    global _plan
+    plan = (FaultPlan.parse(plan_or_text, seed=seed)
+            if isinstance(plan_or_text, str) else plan_or_text)
+    prev = _plan
+    install_plan(plan)
+    try:
+        yield plan
+    finally:
+        _plan = prev
+
+
+def fault_point(site: str) -> None:
+    """Injection hook compiled into the framework's failure seams. No-op
+    (one global read) unless a plan is active."""
+    plan = active_plan()
+    if plan is not None:
+        plan.check(site)

@@ -51,7 +51,7 @@ def peak_flops_per_s() -> float | None:
     try:
         import jax
         kind = jax.devices()[0].device_kind.lower()
-    except Exception:
+    except Exception:  # failure-ok: device-kind probe; None means unknown
         return None
     for sub, peak in _PEAKS.items():
         if sub in kind:

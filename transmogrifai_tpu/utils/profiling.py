@@ -29,7 +29,7 @@ from typing import Optional
 
 __all__ = ["OpStep", "AppMetrics", "profiler", "phase",
            "trace_device_intervals", "SweepCounters", "sweep_counters",
-           "ServingCounters"]
+           "ServingCounters", "RunCounters", "run_counters"]
 
 
 class OpStep(Enum):
@@ -60,7 +60,7 @@ def _device_memory() -> tuple[int, int]:
         stats = jax.local_devices()[0].memory_stats() or {}
         return (int(stats.get("bytes_in_use", 0)),
                 int(stats.get("peak_bytes_in_use", 0)))
-    except Exception:
+    except Exception:  # failure-ok: backend exposes no memory stats
         return 0, 0
 
 
@@ -79,7 +79,7 @@ def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
         os.environ.setdefault(
             "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:
+    except Exception:  # failure-ok: proto bindings optional; no trace parsed
         return []
     out: list[tuple[float, float]] = []
     for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
@@ -88,7 +88,7 @@ def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
             xs = xplane_pb2.XSpace()
             with open(path, "rb") as fh:
                 xs.ParseFromString(fh.read())
-        except Exception:
+        except Exception:  # failure-ok: unparseable trace file is skipped
             continue
         for plane in xs.planes:
             if not plane.name.startswith("/device:"):
@@ -152,6 +152,11 @@ class AppMetrics:
                            "peakHbmBytes": p.peak_hbm_bytes,
                            "deviceSeconds": p.device_s}
                        for k, p in self.phases.items()},
+            # fault-tolerance counters ride in every run summary — resume
+            # and retry behavior is asserted from the same json operators
+            # already collect (module global: one run's counters, reset
+            # alongside the profiler)
+            "runCounters": run_counters.to_json(),
         }
 
     def save(self, path: str) -> None:
@@ -196,7 +201,7 @@ class _CompileAttribution:
             import jax.monitoring as monitoring
             monitoring.register_event_duration_secs_listener(self._on_compile)
             self._listening = True
-        except Exception:
+        except Exception:  # failure-ok: monitoring API absent
             self._listening = True  # API absent: compiles stay 0, don't retry
 
     @contextlib.contextmanager
@@ -270,6 +275,50 @@ sweep_counters = SweepCounters()
 
 
 @dataclass
+class RunCounters:
+    """Fault-tolerance observability for one run (reset with the profiler).
+
+    The resumable-training and retry contracts are asserted through these:
+    a checkpoint-resumed ``Workflow.train`` reports how many DAG layers it
+    replayed from disk instead of refitting (``layers_resumed`` /
+    ``stages_resumed``) vs fit live (``layers_fitted``), every transient
+    device retry performed by ``utils.retry.with_device_retry`` counts in
+    ``retries``, and every fault injected by an active ``utils.faults``
+    plan counts in ``faults_injected``. Surfaced in ``AppMetrics.to_json``
+    (runner result jsons) — the chaos suite's ground truth for "resumed
+    without refitting".
+
+    Process-global, like ``sweep_counters``: a ScoringServer retrying on
+    its worker thread while a training run executes lands in the same
+    ``retries`` total (serving has its own exact per-server retry metric,
+    ``ServingMetrics.dispatch_retries`` — use that for serving). One
+    runner/workflow run per process is the accounting model."""
+
+    layers_fitted: int = 0
+    layers_resumed: int = 0
+    stages_resumed: int = 0
+    retries: int = 0
+    faults_injected: int = 0
+
+    def reset(self) -> None:
+        self.layers_fitted = 0
+        self.layers_resumed = 0
+        self.stages_resumed = 0
+        self.retries = 0
+        self.faults_injected = 0
+
+    def to_json(self) -> dict:
+        return {"layersFitted": self.layers_fitted,
+                "layersResumed": self.layers_resumed,
+                "stagesResumed": self.stages_resumed,
+                "retries": self.retries,
+                "faultsInjected": self.faults_injected}
+
+
+run_counters = RunCounters()
+
+
+@dataclass
 class ServingBucketCounters:
     """Per-padding-bucket online-serving observability (``ServingCounters``)."""
     compiles: int = 0    # XLA backend compiles while this bucket dispatched
@@ -330,16 +379,18 @@ class _Profiler:
     def reset(self, app_name: str = "transmogrifai_tpu",
               trace_dir: Optional[str] = None) -> AppMetrics:
         """New metrics object; with ``trace_dir``, starts one jax.profiler
-        trace spanning everything until ``finalize()``. Sweep counters
-        reset alongside so a run's counters cover exactly that run."""
+        trace spanning everything until ``finalize()``. Sweep and run
+        counters reset alongside so a run's counters cover exactly that
+        run."""
         sweep_counters.reset()
+        run_counters.reset()
         self.metrics = AppMetrics(app_name=app_name)
         self.trace_dir = trace_dir
         if self._tracing:  # a previous run never finalized: stop its trace
             try:
                 import jax
                 jax.profiler.stop_trace()
-            except Exception:
+            except Exception:  # failure-ok: stale-trace stop is best-effort
                 pass
             self._tracing = False
         if trace_dir is not None:
@@ -354,11 +405,11 @@ class _Profiler:
                     opts.host_tracer_level = 0
                     opts.python_tracer_level = 0
                     opts.enable_hlo_proto = False
-                except Exception:
+                except Exception:  # failure-ok: ProfileOptions API is version-dependent
                     opts = None
                 jax.profiler.start_trace(trace_dir, profiler_options=opts)
                 self._tracing = True
-            except Exception:
+            except Exception:  # failure-ok: tracing optional; run continues untraced
                 self.trace_dir = None
         return self.metrics
 
@@ -396,7 +447,7 @@ class _Profiler:
                     jax.block_until_ready(
                         [jax.device_put(0.0, dev) + 0
                          for dev in jax.local_devices()])
-                except Exception:
+                except Exception:  # failure-ok: drain fence is best-effort
                     pass
             # record on the error path too — a failed run's post-mortem
             # must still account the time spent before the failure

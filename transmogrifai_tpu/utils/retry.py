@@ -7,15 +7,29 @@ analog of a lost executor is a transient device/tunnel error surfacing as a
 ``JaxRuntimeError`` with an UNAVAILABLE/ABORTED-class status (observed on
 real hardware: identical programs fail then succeed on retry). Genuine
 program bugs (shape errors, NaN asserts, OOM) are NOT retried.
+
+Classification walks the full ``__cause__``/``__context__`` chain: JAX and
+framework layers routinely wrap the device error (``raise X from e``, or
+implicitly while handling it), and a transient root cause stays transient
+no matter how many wrappers ride on top.
+
+Backoff is capped, jittered exponential — ``base * 2**attempt`` up to
+``cap``, scaled by a uniform [0.5, 1) jitter so a pod's worth of hosts
+retrying the same dead tunnel don't stampede in lockstep. Env-tunable
+without touching call sites: ``TRANSMOGRIFAI_RETRY_MAX`` (attempts after
+the first), ``TRANSMOGRIFAI_RETRY_BASE_S``, ``TRANSMOGRIFAI_RETRY_CAP_S``.
 """
 
 from __future__ import annotations
 
+import os
+import random
 import time
 import warnings
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
 
-__all__ = ["is_transient_device_error", "with_device_retry"]
+__all__ = ["is_transient_device_error", "with_device_retry",
+           "retry_backoff_s"]
 
 T = TypeVar("T")
 
@@ -25,30 +39,101 @@ _TRANSIENT_MARKERS = (
     "infrastructure failure", "backend setup",
 )
 
+#: jitter source — deliberately NOT the global random state (seeding the
+#: framework's RNGs for reproducible sweeps must not make every host's
+#: retry schedule identical, which would defeat the jitter)
+_jitter = random.Random()
 
-def is_transient_device_error(err: BaseException) -> bool:
-    """True for runtime device errors worth retrying (flaky tunnel/device),
-    False for deterministic program errors."""
+
+def _is_transient_one(err: BaseException) -> bool:
+    # exact type names, not isinstance: RuntimeError has non-infrastructure
+    # subclasses (NotImplementedError, RecursionError) that must never
+    # match. CollectiveTimeoutError is the one subclass admitted — a
+    # timed-out collective IS transient infrastructure (a slow peer may
+    # recover; a dead one fails the retry too and the run resumes from
+    # checkpoints)
     name = type(err).__name__
-    if name not in ("JaxRuntimeError", "XlaRuntimeError", "RuntimeError"):
+    if name not in ("JaxRuntimeError", "XlaRuntimeError", "RuntimeError",
+                    "CollectiveTimeoutError"):
         return False
     msg = str(err)
     return any(m in msg for m in _TRANSIENT_MARKERS)
 
 
+def is_transient_device_error(err: BaseException) -> bool:
+    """True when ``err`` — or any exception in its ``__cause__``/
+    ``__context__`` chain — is a runtime device error worth retrying
+    (flaky tunnel/device); False for deterministic program errors."""
+    seen: set[int] = set()
+    e: Optional[BaseException] = err
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if _is_transient_one(e):
+            return True
+        if e.__cause__ is not None:
+            e = e.__cause__
+        elif not e.__suppress_context__:
+            e = e.__context__
+        else:
+            # ``raise X from None``: the raiser explicitly severed the
+            # chain — it judged the failure deterministic; honor that
+            break
+    return False
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else default
+    except ValueError:
+        warnings.warn(f"{name}={v!r} is not a number; using {default}",
+                      RuntimeWarning)
+        return default
+
+
+def retry_backoff_s(attempt: int, base_s: float,
+                    cap_s: Optional[float] = None) -> float:
+    """Capped, jittered exponential backoff for retry ``attempt`` (0-based):
+    ``min(cap, base * 2**attempt) * uniform(0.5, 1)``."""
+    if cap_s is None:
+        cap_s = _env_float("TRANSMOGRIFAI_RETRY_CAP_S", 30.0)
+    raw = min(cap_s, base_s * (2.0 ** attempt))
+    return raw * (0.5 + 0.5 * _jitter.random())
+
+
 def with_device_retry(fn: Callable[..., T], *args,
-                      retries: int = 2, backoff_s: float = 2.0,
+                      retries: Optional[int] = None,
+                      backoff_s: Optional[float] = None,
+                      site: Optional[str] = None,
                       **kwargs) -> T:
-    """Call ``fn`` retrying transient device errors with linear backoff."""
+    """Call ``fn`` retrying transient device errors (chain-aware) with
+    capped jittered exponential backoff.
+
+    ``retries``/``backoff_s`` keep their historical meaning (extra attempts
+    / base delay) and default from ``TRANSMOGRIFAI_RETRY_MAX`` /
+    ``TRANSMOGRIFAI_RETRY_BASE_S`` when not given. ``site`` names a
+    :mod:`transmogrifai_tpu.utils.faults` injection point fired before
+    every attempt, so injected transient faults exercise this exact retry
+    loop. Each performed retry is counted in ``utils.profiling.
+    run_counters.retries`` (surfaced in run summaries)."""
+    from transmogrifai_tpu.utils.faults import fault_point
+    from transmogrifai_tpu.utils.profiling import run_counters
+    if retries is None:
+        retries = int(_env_float("TRANSMOGRIFAI_RETRY_MAX", 2.0))
+    if backoff_s is None:
+        backoff_s = _env_float("TRANSMOGRIFAI_RETRY_BASE_S", 2.0)
     for attempt in range(retries + 1):
         try:
+            if site is not None:
+                fault_point(site)
             return fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — filtered just below
             if attempt >= retries or not is_transient_device_error(e):
                 raise
+            run_counters.retries += 1
             warnings.warn(
                 f"transient device error (attempt {attempt + 1}/"
                 f"{retries + 1}), retrying: {str(e)[:140]}",
                 RuntimeWarning)
-            time.sleep(backoff_s * (attempt + 1))
+            time.sleep(retry_backoff_s(attempt, backoff_s))
     raise AssertionError("unreachable")

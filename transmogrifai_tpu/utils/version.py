@@ -50,5 +50,5 @@ def _backend_or_none():
     try:
         import jax
         return jax.default_backend()
-    except Exception:
+    except Exception:  # failure-ok: backend probe; None when jax absent
         return None

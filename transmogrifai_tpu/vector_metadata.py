@@ -140,7 +140,7 @@ class VectorMetadata:
         for f in features:
             try:
                 h = f.history()
-            except Exception:
+            except Exception:  # failure-ok: feature without history is skipped
                 continue
             entries.append((f.name, tuple(h["originFeatures"]),
                             tuple(h["stages"])))

@@ -82,10 +82,20 @@ class Workflow:
                     self._model_stage_overrides[out.uid] = t
         return self
 
-    def _substitute_fitted(self, dag: Dag) -> Dag:
-        if not self._model_stage_overrides:
+    def _substitute_fitted(self, dag: Dag,
+                           extra: Optional[dict] = None) -> Dag:
+        """Replace stages whose output feature is already fitted — by
+        ``with_model_stages`` or (``extra``) a restored train checkpoint —
+        the replay seam resumable training grafts onto. An explicit
+        ``with_model_stages`` override WINS over a checkpoint restore: the
+        user handed us a newer fitted stage on purpose; the on-disk copy
+        may be stale."""
+        overrides = self._model_stage_overrides
+        if extra:
+            overrides = {**extra, **overrides}
+        if not overrides:
             return dag
-        return [[self._model_stage_overrides.get(s.get_output().uid, s)
+        return [[overrides.get(s.get_output().uid, s)
                  for s in layer] for layer in dag]
 
     def validate(self, sample_frame: Optional[fr.HostFrame] = None) -> dict:
@@ -131,7 +141,7 @@ class Workflow:
                     if isinstance(s, Estimator):
                         try:
                             s = s.fit(data)
-                        except Exception as e:  # noqa: BLE001
+                        except Exception as e:  # noqa: BLE001 — recorded in the report
                             report["untraceable"][s.uid] = (
                                 f"{type(s).__name__} fit on sample: {e}")
                             continue
@@ -146,12 +156,12 @@ class Workflow:
                         jax.eval_shape(
                             lambda p, c, _t=t: _t.device_apply(p, *c),
                             params, cols)
-                    except Exception as e:  # noqa: BLE001
+                    except Exception as e:  # noqa: BLE001 — recorded in the report
                         report["untraceable"][t.uid] = (
                             f"{type(t).__name__}: {e}")
                 try:
                     data = DagExecutor().apply_layer(data, fitted)
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 — recorded; stops below
                     # a silently-clean report for a workflow that cannot
                     # run would be a false all-clear: record + stop (the
                     # downstream layers lack inputs now)
@@ -185,7 +195,14 @@ class Workflow:
         return sorted(seen.values(), key=lambda f: f.name)
 
     # -- train ---------------------------------------------------------------
-    def train(self) -> "WorkflowModel":
+    def train(self, checkpoint_dir: Optional[str] = None) -> "WorkflowModel":
+        """Fit the workflow. With ``checkpoint_dir``, training is RESUMABLE:
+        each fitted DAG layer persists as it completes (``checkpoint.
+        TrainCheckpoint``) and any unconfigured ModelSelector checkpoints
+        its sweep into the same directory — after a crash or preemption,
+        calling ``train`` again with the same directory replays completed
+        layers (and completed sweep units) from disk instead of refitting.
+        See docs/ROBUSTNESS.md."""
         if self.reader is None:
             raise ValueError("set a reader or input frame before train()")
         if not self.result_features:
@@ -216,25 +233,74 @@ class Workflow:
                 if filter_results is not None else {})
         data = PipelineData.from_host(frame)
         executor = DagExecutor()
+        ckpt = None
+        ckpt_overrides: dict[str, Any] = {}
+        full_dag = compute_dag(result)
+        if checkpoint_dir:
+            from transmogrifai_tpu.checkpoint import (
+                TrainCheckpoint, train_fingerprint,
+            )
+            from transmogrifai_tpu.selector.model_selector import (
+                ModelSelector,
+            )
+            ckpt = TrainCheckpoint(
+                checkpoint_dir,
+                train_fingerprint(full_dag, frame.n_rows,
+                                  [f.name for f in raw]))
+            ckpt_overrides = ckpt.restore_overrides(full_dag)
+            # compose with the sweep checkpoint: a mid-CV crash resumes
+            # both the fitted before-DAG layers AND the partially-done
+            # sweep from the same directory. Patched selectors are
+            # restored after training — the directory belongs to THIS
+            # train call, not to the selector (a later train() with a
+            # different/no checkpoint_dir must not keep using it)
+            patched_selectors = [
+                s for layer in full_dag for s in layer
+                if isinstance(s, ModelSelector) and s.checkpoint_dir is None]
+            for s in patched_selectors:
+                s.checkpoint_dir = checkpoint_dir
+        else:
+            patched_selectors = []
         cut = None
         if self._workflow_cv:
             from transmogrifai_tpu.dag import cut_dag
             cut = cut_dag(result)
             if cut.selector is None or not cut.during:
                 cut = None  # nothing label-dependent to protect: plain fit
-            elif cut.selector.get_output().uid in self._model_stage_overrides:
-                # the selector itself is already fitted (with_model_stages):
-                # nothing to sweep, the plain path reuses it as-is
+            elif cut.selector.get_output().uid in {
+                    **self._model_stage_overrides, **ckpt_overrides}:
+                # the selector itself is already fitted (with_model_stages
+                # or a train checkpoint): nothing to sweep, the plain path
+                # reuses it as-is
                 cut = None
-        if cut is not None:
-            cut.before = self._substitute_fitted(cut.before)
-            cut.during = self._substitute_fitted(cut.during)
-            cut.after = self._substitute_fitted(cut.after)
-            fitted = self._fit_workflow_cv(data, cut, executor)
-        else:
-            dag = self._substitute_fitted(compute_dag(result))
-            with profiler.phase(OpStep.FEATURE_ENGINEERING):
-                _, fitted = executor.fit_transform(data, dag)
+        try:
+            if cut is not None:
+                # the selector was NOT restored, so CV will actually run:
+                # checkpoint-restored during-DAG stages must NOT be
+                # substituted — they were fitted on the FULL training data
+                # (saved after a completed sweep), and replaying them here
+                # would disable the per-fold refit that keeps label
+                # information out of fold validation features. They refit
+                # per fold as CV requires; the checkpoint entries only
+                # replay once the selector itself is restored (cut=None).
+                during_uids = {s.get_output().uid
+                               for layer in cut.during for s in layer}
+                cv_overrides = {k: v for k, v in ckpt_overrides.items()
+                                if k not in during_uids}
+                cut.before = self._substitute_fitted(cut.before,
+                                                     cv_overrides)
+                cut.during = self._substitute_fitted(cut.during,
+                                                     cv_overrides)
+                cut.after = self._substitute_fitted(cut.after,
+                                                    cv_overrides)
+                fitted = self._fit_workflow_cv(data, cut, executor, ckpt)
+            else:
+                dag = self._substitute_fitted(full_dag, ckpt_overrides)
+                with profiler.phase(OpStep.FEATURE_ENGINEERING):
+                    _, fitted = self._fit_layers(executor, data, dag, ckpt)
+        finally:
+            for s in patched_selectors:
+                s.checkpoint_dir = None
         return WorkflowModel(
             result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
@@ -264,19 +330,60 @@ class Workflow:
                 for name in stage.input_names
                 if map_key_blocklist.get(name)}
 
-    def _fit_workflow_cv(self, data: PipelineData, cut, executor) -> Dag:
+    @staticmethod
+    def _fit_layers(executor: DagExecutor, data: PipelineData, dag: Dag,
+                    ckpt=None, layer_offset: int = 0
+                    ) -> tuple[PipelineData, Dag]:
+        """Layer-at-a-time ``fit_transform`` with resume accounting and
+        per-layer checkpointing. A layer whose estimators were all replaced
+        by checkpoint-restored models counts as resumed (replayed, not
+        refit); every other completed layer is fitted and — when a
+        checkpoint is active — persisted before the next layer starts, so
+        a crash loses at most the in-flight layer. ``fault_point
+        ("train.layer")`` fires at each layer start: the deterministic
+        preemption site the chaos suite kills training at."""
+        from transmogrifai_tpu.stages.base import Estimator
+        from transmogrifai_tpu.utils.faults import fault_point
+        from transmogrifai_tpu.utils.profiling import run_counters
+        fitted_dag: Dag = []
+        for li, layer in enumerate(dag):
+            fault_point("train.layer")
+            resumed = (not any(isinstance(s, Estimator) for s in layer)
+                       and any(getattr(s, "_from_checkpoint", False)
+                               for s in layer))
+            data, fl = executor.fit_transform(data, [layer])
+            fitted_dag.extend(fl)
+            if resumed:
+                run_counters.layers_resumed += 1
+            else:
+                run_counters.layers_fitted += 1
+                if ckpt is not None:
+                    ckpt.save_layer(layer_offset + li, fl[0])
+        return data, fitted_dag
+
+    def _fit_workflow_cv(self, data: PipelineData, cut, executor,
+                         ckpt=None) -> Dag:
         """Reference ``OpWorkflow.scala:408-449``: fit the pre-CV DAG once,
         run the selector with the in-CV (label-dependent) DAG refit per
-        fold, then fit whatever remains downstream."""
+        fold, then fit whatever remains downstream. With ``ckpt``, the
+        before-DAG layers checkpoint as they complete (the selector's own
+        sweep checkpoints through ``sweep.json``), and the full-data-refit
+        during layers + selector + tail checkpoint after selection."""
         from transmogrifai_tpu.utils.profiling import OpStep, profiler
         with profiler.phase(OpStep.FEATURE_ENGINEERING):
-            data, fitted_before = executor.fit_transform(data, cut.before)
+            data, fitted_before = self._fit_layers(
+                executor, data, cut.before, ckpt)
         with profiler.phase(OpStep.CROSS_VALIDATION):
             selected, fitted_during, data = cut.selector.fit_with_dag(
                 data, cut.during, executor)
+        n_before = len(cut.before)
+        if ckpt is not None:
+            for i, layer in enumerate(fitted_during):
+                ckpt.save_layer(n_before + i, layer)
         with profiler.phase(OpStep.FEATURE_ENGINEERING):
-            _, fitted_tail = executor.fit_transform(
-                data, [[selected]] + cut.after)
+            _, fitted_tail = self._fit_layers(
+                executor, data, [[selected]] + cut.after, ckpt,
+                layer_offset=n_before + len(fitted_during))
         return fitted_before + fitted_during + fitted_tail
 
 
