@@ -75,6 +75,42 @@ def validate_artifact(doc: object) -> list[str]:
                 f"platform={platform!r} artifact lacks 'code_fingerprint' "
                 "(accelerator results must be traceable to the code that "
                 "produced them)")
+    if doc.get("metric") == "observability_overhead":
+        errors.extend(_validate_observability(doc))
+    return errors
+
+
+#: span instrumentation must stay effectively free — the acceptance bound
+#: the committed benchmarks/OBSERVABILITY.json is held to
+MAX_SPAN_OVERHEAD_PCT = 5.0
+
+
+def _validate_observability(doc: dict) -> list[str]:
+    """The ``benchmarks/OBSERVABILITY.json`` contract: the three measured
+    walls (tracing off / spans on / spans + chrome-trace export) plus the
+    derived overhead percentages, with the spans-on overhead within the
+    ``MAX_SPAN_OVERHEAD_PCT`` acceptance bound."""
+    errors = []
+    for k in ("base_wall_s", "spans_wall_s", "export_wall_s"):
+        if not (isinstance(doc.get(k), (int, float))
+                and not isinstance(doc.get(k), bool) and doc[k] > 0):
+            errors.append(f"observability artifact: missing positive {k!r}")
+    for k in ("spans_overhead_pct", "export_overhead_pct"):
+        if not isinstance(doc.get(k), (int, float)) \
+                or isinstance(doc.get(k), bool):
+            errors.append(f"observability artifact: missing numeric {k!r}")
+    ov = doc.get("spans_overhead_pct")
+    if isinstance(ov, (int, float)) and not isinstance(ov, bool) \
+            and ov > MAX_SPAN_OVERHEAD_PCT:
+        errors.append(
+            f"span instrumentation overhead {ov:.2f}% exceeds the "
+            f"{MAX_SPAN_OVERHEAD_PCT:.0f}% acceptance bound")
+    if not isinstance(doc.get("span_count"), int) \
+            or isinstance(doc.get("span_count"), bool) \
+            or doc.get("span_count", 0) <= 0:
+        errors.append("observability artifact: missing positive "
+                      "'span_count' (the spans-on run must actually have "
+                      "recorded spans)")
     return errors
 
 

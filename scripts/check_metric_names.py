@@ -1,0 +1,143 @@
+"""Lint the exported metric namespaces: JSON snapshots and Prometheus
+exposition must follow one naming contract, checked in CI.
+
+Two surfaces, two conventions (docs/OBSERVABILITY.md "Metric names"):
+
+- **JSON documents** (``AppMetrics.to_json``, ``ServingMetrics.snapshot``,
+  ``SweepCounters``/``RunCounters``/``ServingCounters.to_json``): every
+  FIELD key is camelCase. Map keys that are *data* (phase names, stage
+  labels, sweep family names, padding-bucket sizes, histogram bounds) are
+  exempt — they name measured things, not schema fields.
+- **Prometheus exposition** (``utils/prometheus.py``): every metric name
+  is ``snake_case`` with the ``transmogrifai_`` prefix, registry-unique,
+  and counters carry the monotonic ``_total`` suffix. The registry
+  enforces this at ``register()`` time; the lint builds the FULL standard
+  registry (app + serving collectors) and re-validates so a rename that
+  bypasses registration still fails CI, and renders it once so collector
+  closures actually run.
+
+Library use: ``check_json_doc(doc, where)`` / ``check_registry(reg)``
+return violation lists; ``main()`` builds the real exporters and exits 1
+listing every violation. Wired into tier-1 via
+``tests/test_observability.py`` like ``check_failure_paths.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+__all__ = ["check_json_doc", "check_registry", "collect_violations"]
+
+_CAMEL_RE = re.compile(r"^[a-z][a-zA-Z0-9]*$")
+_SNAKE_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
+
+#: JSON container fields whose keys are DATA (measured-thing names),
+#: not schema fields — their keys are exempt from camelCase
+DATA_KEYED = {"phases", "stages", "sizeHistogram", "buckets",
+              "compileBuckets", "families", "sweep", "customParams",
+              "stageOverrides", "readerOverrides"}
+
+
+def check_json_doc(doc, where: str, _parent_key: str = "") -> list[str]:
+    """camelCase violations in one exported JSON document."""
+    out: list[str] = []
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if _parent_key not in DATA_KEYED and not _CAMEL_RE.match(str(k)):
+                out.append(f"{where}: key {k!r} is not camelCase")
+            out.extend(check_json_doc(v, f"{where}.{k}", str(k)))
+    elif isinstance(doc, (list, tuple)):
+        for i, v in enumerate(doc):
+            out.extend(check_json_doc(v, f"{where}[{i}]", _parent_key))
+    return out
+
+
+def check_registry(reg) -> list[str]:
+    """Naming violations in a ``PromRegistry``: snake_case + prefix,
+    uniqueness (structurally guaranteed, re-checked for belt-and-braces),
+    counter ``_total`` suffix — and the render must succeed."""
+    out: list[str] = []
+    names = reg.names()
+    if len(names) != len(set(names)):
+        out.append("registry: duplicate metric names")
+    for name, mtype in reg.metric_types().items():
+        if not _SNAKE_RE.match(name):
+            out.append(f"registry: {name!r} is not snake_case with the "
+                       "transmogrifai_ prefix")
+        if mtype == "counter" and not name.endswith("_total"):
+            out.append(f"registry: counter {name!r} lacks _total suffix")
+        if mtype != "counter" and name.endswith("_total"):
+            out.append(f"registry: {mtype} {name!r} misuses the _total "
+                       "counter suffix")
+    try:
+        rendered = reg.render()
+        if "# collect failed" in rendered:
+            for ln in rendered.splitlines():
+                if ln.startswith("# collect failed"):
+                    out.append(f"registry: {ln}")
+    except Exception as e:  # noqa: BLE001 — a render crash is THE finding
+        out.append(f"registry: render() raised {type(e).__name__}: {e}")
+    return out
+
+
+def collect_violations() -> list[str]:
+    """Build the real exporters with representative data and lint both
+    surfaces."""
+    from transmogrifai_tpu.serving.metrics import ServingMetrics
+    from transmogrifai_tpu.utils.profiling import (
+        AppMetrics, OpStep, RunCounters, ServingCounters, SweepCounters,
+    )
+    from transmogrifai_tpu.utils.prometheus import build_registry
+
+    out: list[str] = []
+
+    app = AppMetrics()
+    app.record(OpStep.MODEL_TRAINING, 1.0, peak_hbm=1024)
+    app.stages = {"Vectorizer (uid_1)": {
+        "wallSeconds": 0.5, "deviceSeconds": 0.1, "count": 1,
+        "peakHbmBytes": 1024, "phase": "fit"}}
+    out.extend(check_json_doc(app.to_json(), "AppMetrics.to_json"))
+
+    serving = ServingMetrics(max_samples=16)
+    serving.record_admitted(3)
+    serving.record_requests_done([(0.004, True), (0.2, True), (9.0, False)])
+    serving.record_batch(3, 0.01)
+    serving.record_rejected(invalid=True)
+    sc = ServingCounters()
+    sc.count(8, dispatches=2, compiles=1)
+    serving.compile_counters = sc
+    out.extend(check_json_doc(serving.snapshot(mirror_to_profiler=False),
+                              "ServingMetrics.snapshot"))
+
+    sweep = SweepCounters()
+    sweep.count("OpLogisticRegression_0", dispatches=1, host_syncs=1,
+                mode="fold_stacked")
+    out.extend(check_json_doc({"families": sweep.to_json()},
+                              "SweepCounters.to_json"))
+    out.extend(check_json_doc(RunCounters().to_json(),
+                              "RunCounters.to_json"))
+
+    out.extend(check_registry(build_registry(serving=serving)))
+    return out
+
+
+def main(argv=None) -> int:
+    violations = collect_violations()
+    if not violations:
+        print("OK: exported metric names follow the naming contract "
+              "(camelCase JSON, snake_case transmogrifai_* exposition, "
+              "unique, counters _total-suffixed)")
+        return 0
+    for v in violations:
+        print(f"FAIL {v}")
+    print(f"{len(violations)} metric-naming violation(s)")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -150,8 +150,14 @@ class TrainCheckpoint:
         records are skipped with a warning — they cost a refit, not a
         crash."""
         from transmogrifai_tpu.utils.profiling import run_counters
+        from transmogrifai_tpu.utils.tracing import span
         if not self._layers:
             return {}
+        with span("checkpoint.restore", n_layers=len(self._layers)):
+            return self._restore_overrides(dag, run_counters)
+
+    def _restore_overrides(self, dag, run_counters
+                           ) -> dict[str, PipelineStage]:
         current = {s.get_output().uid: s for layer in dag for s in layer}
         overrides: dict[str, PipelineStage] = {}
         for key in sorted(self._layers):
@@ -207,8 +213,16 @@ class TrainCheckpoint:
         from transmogrifai_tpu.utils.durable import (
             atomic_json_dump, best_effort_checkpoint_write,
         )
+        from transmogrifai_tpu.utils.tracing import span
         if self._disabled:
             return
+        with span("checkpoint.save_layer", layer=li,
+                  n_stages=len(fitted_layer)):
+            self._save_layer(li, fitted_layer, atomic_json_dump,
+                             best_effort_checkpoint_write)
+
+    def _save_layer(self, li: int, fitted_layer, atomic_json_dump,
+                    best_effort_checkpoint_write) -> None:
         recs: list[dict] = []
         arrays: dict[str, np.ndarray] = {}
         for t in fitted_layer:

@@ -43,6 +43,14 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                     help="skip admission-time raw-key validation")
     sp.add_argument("--no-warmup", action="store_true",
                     help="skip padding-bucket warmup before traffic")
+    sp.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (Prometheus exposition) and "
+                         "/healthz on this port while scoring (0 = "
+                         "ephemeral; port printed to stderr)")
+    sp.add_argument("--metrics-host", default="127.0.0.1",
+                    help="bind address for the scrape endpoint (use "
+                         "0.0.0.0 for an external scraper; default "
+                         "loopback)")
 
 
 def _read_rows(path: str) -> Iterable[dict]:
@@ -71,7 +79,8 @@ def run_serve(args: argparse.Namespace) -> int:
     server = ScoringServer(
         model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_capacity=args.queue_capacity,
-        default_timeout_ms=args.timeout_ms, strict=not args.no_strict)
+        default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
+        metrics_port=args.metrics_port, metrics_host=args.metrics_host)
 
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     t0 = time.monotonic()
@@ -99,6 +108,9 @@ def run_serve(args: argparse.Namespace) -> int:
 
     try:
         server.start()
+        if server.metrics_http is not None:
+            print(f"# metrics: http://127.0.0.1:{server.metrics_http.port}"
+                  "/metrics (+ /healthz)", file=sys.stderr)
         for i, row in enumerate(_read_rows(args.input)):
             if not warmed:
                 server.start(warmup_row=row)  # non-fatal on a bad row

@@ -33,6 +33,7 @@ from transmogrifai_tpu.pipeline_data import PipelineData
 from transmogrifai_tpu.stages.base import (
     Estimator, PipelineStage, Transformer,
 )
+from transmogrifai_tpu.utils.tracing import device_scope, span
 
 __all__ = ["compute_dag", "cut_dag", "CutDag", "DagExecutor", "Dag",
            "fuse_layer_program"]
@@ -168,7 +169,10 @@ class DagExecutor:
             for stage in layer:
                 if isinstance(stage, Estimator):
                     t0 = time.time()
-                    fitted_layer.append(stage.fit(data))
+                    with span("stage.fit", hbm=True, stage_uid=stage.uid,
+                              stage_cls=type(stage).__name__,
+                              op=stage.operation_name, phase="fit"):
+                        fitted_layer.append(stage.fit(data))
                     _plog(f"fit {stage.operation_name}", t0)
                 elif isinstance(stage, Transformer):
                     fitted_layer.append(stage)
@@ -192,8 +196,14 @@ class DagExecutor:
         host_ts = [t for t in transformers if not t.is_device]
         dev_ts = [t for t in transformers if t.is_device]
         if host_ts:
-            new_host = {t.get_output().name: t.output_column(data)
-                        for t in host_ts}
+            # host transformers run eagerly one at a time — each gets its
+            # own stage span (the "which vectorizer is slow" answer)
+            new_host = {}
+            for t in host_ts:
+                with span("stage.transform", hbm=True, stage_uid=t.uid,
+                          stage_cls=type(t).__name__,
+                          op=t.operation_name, phase="transform"):
+                    new_host[t.get_output().name] = t.output_column(data)
             data = data.with_host_cols(new_host)
         if dev_ts:
             from transmogrifai_tpu.utils.retry import with_device_retry
@@ -205,8 +215,10 @@ class DagExecutor:
             # device dispatch: transient device errors (flaky tunnel, and
             # the chaos suite's injected faults) retry with backoff instead
             # of killing a run a checkpoint would otherwise have to resume
-            outs = with_device_retry(fused, params, in_cols,
-                                     site="dag.apply_layer")
+            with span("layer.apply_device", n_stages=len(dev_ts),
+                      stages=",".join(t.operation_name for t in dev_ts)):
+                outs = with_device_retry(fused, params, in_cols,
+                                         site="dag.apply_layer")
             data = data.with_device_cols(outs)
             # record fitted vector metadata OUTSIDE the traced program
             # (ModelInsights' fallback reads the last stage's out_meta;
@@ -247,7 +259,12 @@ def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False):
         out = {}
         for t in ts:
             cols = [in_cols[n] for n in t.runtime_input_names()]
-            out[t.get_output().name] = t.device_apply(params[t.uid], *cols)
+            # per-stage named scope: ops staged out here carry the stage's
+            # operation name + uid in their XLA metadata, so profiler-trace
+            # device slices attribute to stages, not just layers
+            with device_scope(f"{t.operation_name}[{t.uid}]"):
+                out[t.get_output().name] = t.device_apply(
+                    params[t.uid], *cols)
         return out
 
     return jax.jit(fused, donate_argnums=(1,) if donate else ())

@@ -30,8 +30,8 @@ from transmogrifai_tpu.parallel.mesh import (
 )
 
 __all__ = ["tree_psum", "tree_pmax", "tree_pmin", "mesh_reduce_stats",
-           "CollectiveTimeoutError", "run_with_deadline",
-           "collective_timeout_s"]
+           "reduce_host_metrics", "CollectiveTimeoutError",
+           "run_with_deadline", "collective_timeout_s"]
 
 
 class CollectiveTimeoutError(RuntimeError):
@@ -157,3 +157,42 @@ def mesh_reduce_stats(ctx: MeshContext,
     return run_with_deadline(
         lambda: jax.block_until_ready(fn(*row_sharded_args)),
         name="mesh_reduce_stats", timeout_s=timeout_s)
+
+
+def reduce_host_metrics(ctx: MeshContext, values: dict[str, float],
+                        timeout_s: Optional[float] = None
+                        ) -> dict[str, float]:
+    """Sum a host-local ``{name: value}`` metrics mapping across every
+    host of the mesh — the observability reduction behind one-run-summary
+    multihost metrics (``utils.profiling.aggregate_across_hosts``).
+
+    Every host MUST call this with the same sorted key set (phase/stage
+    names come from the same program on every host, so they do) — the
+    values pack into one vector, each host spreads its vector over its
+    local rows of the data axis, and the same deadline-guarded
+    ``mesh_reduce_stats`` all-reduce that serves training statistics sums
+    them. Single-process meshes reduce locally (identity sum) with no
+    deadline thread, like every other collective here.
+    """
+    import numpy as np
+
+    names = sorted(values)
+    if not names:
+        return {}
+    n_proc = jax.process_count()
+    axis = ctx.mesh.shape[DATA_AXIS]
+    rows_local = max(axis // max(n_proc, 1), 1)
+    v = jnp.asarray([float(values[n]) for n in names], jnp.float32)
+    # spread this host's vector over its local rows so the data-axis psum
+    # equals the straight sum over hosts
+    block = jnp.tile(v / rows_local, (rows_local, 1))
+    if n_proc > 1:
+        from jax.sharding import NamedSharding
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(ctx.mesh, P(DATA_AXIS)), np.asarray(block))
+    else:
+        arr = block
+    out = mesh_reduce_stats(ctx, lambda rows: jnp.sum(rows, axis=0), arr,
+                            timeout_s=timeout_s)
+    out = np.asarray(out, np.float64)
+    return {n: float(out[i]) for i, n in enumerate(names)}

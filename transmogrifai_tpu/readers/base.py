@@ -104,22 +104,25 @@ class DataReader:
 
     # -- raw data generation -------------------------------------------------
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
+        from transmogrifai_tpu.utils.tracing import span
         stages = [_origin(f) for f in raw_features]
         chunk_cols: dict[str, list[HostColumn]] = {f.name: []
                                                    for f in raw_features}
         key_chunks: Optional[list] = [] if self.key_fn is not None else None
-        for chunk in self._iter_chunks():
-            for f, stage in zip(raw_features, stages):
-                vals = [stage.extract(r) for r in chunk]
-                chunk_cols[f.name].append(
-                    HostColumn.from_values(f.ftype, vals))
-            if key_chunks is not None:
-                key_chunks.append(np.asarray(
-                    [str(self.key_fn(r)) for r in chunk], dtype=object))
-        cols = {name: HostColumn.concat(chunks)
-                for name, chunks in chunk_cols.items()}
-        key = np.concatenate(key_chunks) if key_chunks else None
-        return HostFrame(cols, key)
+        with span("reader.generate_frame", reader=type(self).__name__,
+                  n_features=len(raw_features)):
+            for chunk in self._iter_chunks():
+                for f, stage in zip(raw_features, stages):
+                    vals = [stage.extract(r) for r in chunk]
+                    chunk_cols[f.name].append(
+                        HostColumn.from_values(f.ftype, vals))
+                if key_chunks is not None:
+                    key_chunks.append(np.asarray(
+                        [str(self.key_fn(r)) for r in chunk], dtype=object))
+            cols = {name: HostColumn.concat(chunks)
+                    for name, chunks in chunk_cols.items()}
+            key = np.concatenate(key_chunks) if key_chunks else None
+            return HostFrame(cols, key)
 
     # -- streaming statistics (no frame materialization) ---------------------
     def summarize(self, raw_features: Sequence[FeatureLike],
@@ -200,9 +203,12 @@ class CustomReader(DataReader):
 
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
         if self.frame is not None:
+            from transmogrifai_tpu.utils.tracing import span
             # fast path: columns already columnar; select + validate types
             missing = [f.name for f in raw_features if f.name not in self.frame]
             if missing:
                 raise KeyError(f"Frame lacks raw feature columns {missing}")
-            return self.frame.select([f.name for f in raw_features])
+            with span("reader.generate_frame", reader=type(self).__name__,
+                      n_features=len(raw_features)):
+                return self.frame.select([f.name for f in raw_features])
         return super().generate_frame(raw_features)

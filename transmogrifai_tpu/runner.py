@@ -45,15 +45,24 @@ class WorkflowRunner:
         self.on_end_handlers: list[Callable[[dict], None]] = []
 
     def run(self, run_type: str, params: OpParams,
-            checkpoint_dir: Optional[str] = None) -> dict:
+            checkpoint_dir: Optional[str] = None,
+            trace_out: Optional[str] = None) -> dict:
         """Execute one parameterized run. ``checkpoint_dir`` (TRAIN only)
         enables resumable training: fitted DAG layers and the selector
         sweep checkpoint there, and re-running the same command after a
         crash/preemption resumes instead of refitting (the run result's
         ``appMetrics.runCounters.layersResumed`` reports how much work the
-        resume skipped)."""
+        resume skipped). ``trace_out`` profiles the whole run (one
+        ``jax.profiler`` trace when the backend supports it) and writes a
+        Perfetto/chrome://tracing JSON merging the host span tree with the
+        device timeline there (docs/OBSERVABILITY.md)."""
         t0 = time.time()
-        profiler.reset(app_name=f"transmogrifai_tpu.{run_type}")
+        trace_dir = None
+        if trace_out:
+            import tempfile
+            trace_dir = tempfile.mkdtemp(prefix="transmogrifai_trace_")
+        profiler.reset(app_name=f"transmogrifai_tpu.{run_type}",
+                       trace_dir=trace_dir)
         applied = params.apply_to_stages(
             [s for f in self.workflow.result_features
              for s in f.parent_stages()])
@@ -261,7 +270,20 @@ class WorkflowRunner:
             raise
         finally:
             result["wallSeconds"] = time.time() - t0
-            result["appMetrics"] = profiler.metrics.to_json()
+            metrics = profiler.finalize()
+            if trace_out:
+                try:
+                    result["trace"] = metrics.export_chrome_trace(trace_out)
+                    result["traceOut"] = trace_out
+                except Exception as e:  # noqa: BLE001 — a failed trace export must not fail the run
+                    result["traceError"] = f"{type(e).__name__}: {e}"
+            if trace_dir:
+                import shutil
+                # the XSpace protos are parsed at finalize(); only the
+                # merged chrome trace is the artifact — repeated profiled
+                # runs must not accumulate proto dirs in /tmp
+                shutil.rmtree(trace_dir, ignore_errors=True)
+            result["appMetrics"] = metrics.to_json()
             for h in self.on_end_handlers:
                 h(result)
         return result
@@ -277,12 +299,17 @@ def main(argv=None):
                     help="resumable training: fitted DAG layers + the "
                          "selector sweep checkpoint here; re-running after "
                          "a crash resumes instead of refitting (train only)")
+    ap.add_argument("--trace-out", default=None,
+                    help="profile the run and write a Perfetto/"
+                         "chrome://tracing JSON (host span tree + device "
+                         "timeline) here")
     args = ap.parse_args(argv)
     import importlib
     mod, _, attr = args.workflow.partition(":")
     runner: WorkflowRunner = getattr(importlib.import_module(mod), attr)
     result = runner.run(args.run_type, OpParams.from_file(args.params),
-                        checkpoint_dir=args.checkpoint_dir)
+                        checkpoint_dir=args.checkpoint_dir,
+                        trace_out=args.trace_out)
     print(json.dumps(result, indent=2, default=str))
     return 0 if result.get("status") == "success" else 1
 

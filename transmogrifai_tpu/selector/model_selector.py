@@ -485,8 +485,12 @@ class ModelSelector(Estimator):
                         + (jnp.take(Xt, jva, axis=0),
                            jnp.take(yt, jva, axis=0)))
                 Xtr_s, ytr_s, wtr_s, Xva_s, yva_s = stacked_data
+                from transmogrifai_tpu.utils.tracing import span
                 try:
-                    with sweep_counters.tracking(fname):
+                    with sweep_counters.tracking(fname), \
+                            span("sweep.family", family=fname,
+                                 mode="fold_stacked", folds=k,
+                                 grid=len(grid)):
                         # fused unit: stacked train + stacked scores in one
                         # call (no per-(fold, grid) model materialization —
                         # the sweep discards models; the winner refits)
@@ -574,8 +578,11 @@ class ModelSelector(Estimator):
         if self._deadline_skip(ci, grid, deadline, per_candidate_scores,
                                failures, pop=True):
             return False
+        from transmogrifai_tpu.utils.tracing import span
         try:
-            with sweep_counters.tracking(fname):
+            with sweep_counters.tracking(fname), \
+                    span("sweep.fold_unit", family=fname, fold=fold_i,
+                         grid=len(grid)):
                 models = with_device_retry(
                     est.grid_fit_arrays, Xtr, ytr, wtr, grid,
                     site="sweep.fit", **(fit_kwargs or {}))
@@ -776,13 +783,19 @@ class ModelSelector(Estimator):
                  if getattr(self.validator, "stratify", False) else None)
         t1 = time.time()
 
-        with profiler.phase(OpStep.CROSS_VALIDATION):
+        from transmogrifai_tpu.utils.tracing import span
+        with profiler.phase(OpStep.CROSS_VALIDATION), \
+                span("selector.sweep", hbm=True, stage_uid=self.uid,
+                     stage_cls=type(self).__name__, phase="sweep",
+                     n_families=len(self.models_and_grids)):
             results, mean_metrics, failures = self._sweep(Xt, yt, wt, yt_np)
         _plog("selector: CV sweep", t1)
         t1 = time.time()
         Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
         yh = y[jnp.asarray(holdout_idx)] if holdout_idx.size else None
-        with profiler.phase(OpStep.MODEL_TRAINING):
+        with profiler.phase(OpStep.MODEL_TRAINING), \
+                span("selector.refit", hbm=True, stage_uid=self.uid,
+                     stage_cls=type(self).__name__, phase="refit"):
             selected = self._finalize(results, mean_metrics, Xt, yt, wt,
                                       Xh, yh, prep_results, t0, failures)
         _plog("selector: refit+evaluate", t1)

@@ -28,6 +28,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
+from transmogrifai_tpu.utils.tracing import recorder, span
+
 __all__ = ["MicroBatcher", "BackpressureError", "RequestTimeout"]
 
 
@@ -229,8 +231,16 @@ class MicroBatcher:
             if not live:
                 continue
             t0 = time.monotonic()
+            # the batch's queue-wait as a retroactive span (known only
+            # now): oldest admission -> dispatch start, monotonic clock
+            # rebased onto the epoch so it aligns with the other spans
+            epoch_off = time.time() - t0
+            recorder.add("serving.queue_wait",
+                         epoch_off + min(p.t_submit for p in live),
+                         epoch_off + t0, rows=len(live))
             try:
-                results = list(self.dispatch([p.row for p in live]))
+                with span("serving.dispatch", rows=len(live)):
+                    results = list(self.dispatch([p.row for p in live]))
                 if len(results) != len(live):
                     raise RuntimeError(
                         f"dispatch returned {len(results)} results for "
@@ -241,12 +251,13 @@ class MicroBatcher:
             self._stats.record(wall, len(live))
             done_t = time.monotonic()
             settled = []
-            for p, r in zip(live, results):
-                ok = not isinstance(r, BaseException)
-                _settle(p.future, r, is_error=not ok)
-                settled.append((done_t - p.t_submit, ok))
-            if self.on_complete is not None:
-                self.on_complete(settled)
+            with span("serving.settle", rows=len(live)):
+                for p, r in zip(live, results):
+                    ok = not isinstance(r, BaseException)
+                    _settle(p.future, r, is_error=not ok)
+                    settled.append((done_t - p.t_submit, ok))
+                if self.on_complete is not None:
+                    self.on_complete(settled)
         self._drained.set()
 
 

@@ -1,0 +1,89 @@
+"""Scrape endpoint: a stdlib ``http.server`` background thread serving
+``GET /metrics`` (Prometheus text exposition over the server's live
+counters) and ``GET /healthz`` (liveness + degradation state as JSON).
+
+Deliberately dependency-free and tiny: one daemon thread, a
+``ThreadingHTTPServer`` so a slow scraper can't block a liveness probe,
+and no request body handling at all — everything but the two GET paths
+is a 404. Port 0 binds an ephemeral port (tests); the bound port is
+``MetricsServer.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from transmogrifai_tpu.utils.prometheus import CONTENT_TYPE
+
+__all__ = ["MetricsServer"]
+
+
+class MetricsServer:
+    """Background /metrics + /healthz endpoint for one ScoringServer."""
+
+    def __init__(self, render_fn: Callable[[], str],
+                 health_fn: Callable[[], dict],
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.render_fn = render_fn
+        self.health_fn = health_fn
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._host = host
+        self._requested_port = int(port)
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = outer.render_fn().encode()
+                        ctype = CONTENT_TYPE
+                    elif self.path.split("?")[0] == "/healthz":
+                        body = (json.dumps(outer.health_fn())
+                                + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404, "only /metrics and /healthz")
+                        return
+                except Exception as e:  # noqa: BLE001 — a scrape must see the failure, not a hang
+                    self.send_error(
+                        500, f"{type(e).__name__}: {str(e)[:200]}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not access-logged
+                pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="transmogrifai-metrics-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -24,7 +24,14 @@ from typing import Callable, Optional
 
 import numpy as np
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "LATENCY_BUCKETS_S"]
+
+#: fixed cumulative latency-histogram bucket bounds (seconds) — Prometheus
+#: histogram semantics: bucket[i] counts requests with latency <= bound[i],
+#: +Inf is the implicit final bucket (== count). Fixed at class level so
+#: every server exports the same series and dashboards can aggregate.
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0)
 
 
 class ServingMetrics:
@@ -33,7 +40,8 @@ class ServingMetrics:
     def __init__(self, max_samples: int = 8192,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
                  queue_capacity: Optional[int] = None,
-                 compile_counters=None):
+                 compile_counters=None,
+                 rolling_window_s: float = 30.0):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._started_at = time.time()
@@ -64,6 +72,18 @@ class ServingMetrics:
         # latency reservoir (seconds), newest max_samples
         self._latency: collections.deque = collections.deque(
             maxlen=max_samples)
+        # MONOTONIC cumulative latency histogram (Prometheus semantics) —
+        # unlike the reservoir it never forgets, so scrapes can rate() it
+        self._lat_buckets = [0] * (len(LATENCY_BUCKETS_S) + 1)  # +Inf last
+        self._lat_sum = 0.0
+        # rolling-window completion counts: the lifetime-average rps
+        # under-reports an idle-then-busy server, so steady-state rate is
+        # measured over the newest window too. Per-SECOND count buckets
+        # (not per-completion timestamps): O(1) to record, bounded by the
+        # window length at ANY throughput — a 30s window at 100k rps is
+        # ~31 (second, count) pairs, not 3M timestamps
+        self.rolling_window_s = float(rolling_window_s)
+        self._done_buckets: collections.deque = collections.deque()
 
     # -- recording -----------------------------------------------------------
     def record_admitted(self, n: int = 1) -> None:
@@ -82,13 +102,32 @@ class ServingMetrics:
 
     def record_requests_done(self, settled) -> None:
         """Bulk per-batch settlement: [(latency_s, ok), ...]."""
+        now = time.monotonic()
+        sec = int(now)
         with self._lock:
+            n_ok = sum(1 for _, ok in settled if ok)
+            if n_ok:
+                if self._done_buckets and self._done_buckets[-1][0] == sec:
+                    self._done_buckets[-1][1] += n_ok
+                else:
+                    self._done_buckets.append([sec, n_ok])
+                cutoff = sec - int(self.rolling_window_s) - 1
+                while self._done_buckets and \
+                        self._done_buckets[0][0] < cutoff:
+                    self._done_buckets.popleft()
             for latency_s, ok in settled:
                 if ok:
                     self.completed += 1
                 else:
                     self.failed += 1
                 self._latency.append(latency_s)
+                self._lat_sum += latency_s
+                for i, bound in enumerate(LATENCY_BUCKETS_S):
+                    if latency_s <= bound:
+                        self._lat_buckets[i] += 1
+                        break
+                else:
+                    self._lat_buckets[-1] += 1
 
     def record_expired(self, n: int = 1) -> None:
         with self._lock:
@@ -141,9 +180,42 @@ class ServingMetrics:
                 "max": round(float(samples.max()) * 1e3, 3)}
 
     def throughput_rps(self) -> float:
+        """LIFETIME average completions/s — under-reports steady state on
+        an idle-then-busy server; see :meth:`rolling_rps`."""
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         with self._lock:
             return self.completed / elapsed
+
+    def rolling_rps(self, window_s: Optional[float] = None) -> float:
+        """Completions/s over the newest ``window_s`` (default the
+        configured ``rolling_window_s``) — the steady-state rate an
+        operator actually wants. A server younger than the window divides
+        by its age, not the full window (no warmup under-report)."""
+        window = float(window_s if window_s is not None
+                       else self.rolling_window_s)
+        now = time.monotonic()
+        cutoff = now - window
+        with self._lock:
+            # whole second-buckets within the window (the partial oldest
+            # bucket counts fully — a <=1s edge effect on a 30s window)
+            n = sum(c for sec, c in self._done_buckets if sec + 1 > cutoff)
+        return n / max(min(window, now - self._t0), 1e-9)
+
+    def latency_histogram(self) -> dict:
+        """Cumulative Prometheus-style histogram: ``{"buckets": {le:
+        cumulative count}, "sum": seconds, "count": n}`` with ``le`` keys
+        as strings (``"0.005"`` ... ``"+Inf"``)."""
+        with self._lock:
+            per_bin = list(self._lat_buckets)
+            total_sum = self._lat_sum
+        buckets: dict = {}
+        running = 0
+        for bound, n in zip(LATENCY_BUCKETS_S, per_bin):
+            running += n
+            buckets[f"{bound:g}"] = running
+        running += per_bin[-1]
+        buckets["+Inf"] = running
+        return {"buckets": buckets, "sum": total_sum, "count": running}
 
     def snapshot(self, mirror_to_profiler: bool = True) -> dict:
         """One JSON-able document with everything an operator dashboards.
@@ -185,7 +257,13 @@ class ServingMetrics:
                 },
             }
         doc["latencyMs"] = lat
+        doc["latencyHistogram"] = self.latency_histogram()
+        # both rates snapshot together: lifetime average AND the rolling
+        # steady-state window (an idle-then-busy server's lifetime number
+        # is an artifact of its uptime, not its current capacity)
         doc["throughputRps"] = round(self.throughput_rps(), 3)
+        doc["throughputRpsRolling"] = round(self.rolling_rps(), 3)
+        doc["rollingWindowSeconds"] = self.rolling_window_s
         queue_doc: dict = {"capacity": self.queue_capacity}
         if self.queue_depth_fn is not None:
             try:

@@ -60,7 +60,9 @@ class ScoringServer:
                  retries: int = 2, retry_backoff_s: float = 0.05,
                  probe_interval_s: float = 1.0,
                  donate: Optional[bool] = None,
-                 metrics_max_samples: int = 8192):
+                 metrics_max_samples: int = 8192,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1"):
         self.model = model
         self.strict = strict
         self.required_keys = required_raw_keys(model)
@@ -84,6 +86,13 @@ class ScoringServer:
             compile_counters=self.scorer.counters)
         self._degraded_since: Optional[float] = None
         self._last_probe = 0.0
+        #: scrape endpoint (/metrics + /healthz), started with the server
+        #: when ``metrics_port`` is not None (0 = ephemeral port; the
+        #: bound port is ``self.metrics_http.port``). ``metrics_host``
+        #: defaults to loopback; bind "0.0.0.0" for an external scraper
+        self.metrics_http = None
+        self._metrics_port = metrics_port
+        self._metrics_host = metrics_host
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_row: Optional[dict] = None,
@@ -102,11 +111,28 @@ class ScoringServer:
                     f"serving: warmup failed ({type(e).__name__}: "
                     f"{str(e)[:140]}); padding buckets will compile lazily",
                     RuntimeWarning)
+        # bind the scrape endpoint BEFORE the worker starts: a port-bind
+        # failure (EADDRINUSE) must fail start() cleanly, not leave a
+        # half-started server with a running batcher thread behind it
+        if self._metrics_port is not None and self.metrics_http is None:
+            from transmogrifai_tpu.serving.http import MetricsServer
+            from transmogrifai_tpu.utils.prometheus import build_registry
+            registry = build_registry(serving=self.metrics, server=self)
+            self.metrics_http = MetricsServer(
+                render_fn=registry.render,
+                health_fn=lambda: {"status": "ok",
+                                   "degraded": self.degraded,
+                                   "queueDepth": self.batcher.queue_depth},
+                port=self._metrics_port,
+                host=self._metrics_host).start()
         self.batcher.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
         self.batcher.stop(drain=drain)
+        if self.metrics_http is not None:
+            self.metrics_http.stop()
+            self.metrics_http = None
 
     def __enter__(self) -> "ScoringServer":
         return self.start()
@@ -172,11 +198,13 @@ class ScoringServer:
         from transmogrifai_tpu.types.feature_types import (
             FeatureTypeValueError,
         )
+        from transmogrifai_tpu.utils.tracing import span
         t0 = time.monotonic()
         degraded = True
         if self._compiled_eligible():
             try:
-                results = self._compiled_dispatch(rows)
+                with span("serving.compiled_dispatch", rows=len(rows)):
+                    results = self._compiled_dispatch(rows)
                 degraded = False
             except FeatureTypeValueError:
                 # a DATA error: strict admission checks key presence, not
@@ -252,12 +280,14 @@ class ScoringServer:
                 "the local row path until a probe succeeds", RuntimeWarning)
 
     def _row_dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        from transmogrifai_tpu.utils.tracing import span
         out: list[Any] = []
-        for r in rows:
-            try:
-                out.append(self.row_score(r))
-            except Exception as e:  # noqa: BLE001 — isolate per-row faults
-                out.append(e)
+        with span("serving.row_dispatch", rows=len(rows)):
+            for r in rows:
+                try:
+                    out.append(self.row_score(r))
+                except Exception as e:  # noqa: BLE001 — isolate per-row faults
+                    out.append(e)
         return out
 
     # -- observability -------------------------------------------------------

@@ -28,7 +28,8 @@ from enum import Enum
 from typing import Optional
 
 __all__ = ["OpStep", "AppMetrics", "profiler", "phase",
-           "trace_device_intervals", "SweepCounters", "sweep_counters",
+           "trace_device_intervals", "trace_device_events",
+           "aggregate_across_hosts", "SweepCounters", "sweep_counters",
            "ServingCounters", "RunCounters", "run_counters"]
 
 
@@ -64,16 +65,19 @@ def _device_memory() -> tuple[int, int]:
         return 0, 0
 
 
-def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
-    """Parse a ``jax.profiler`` trace directory into device-op intervals
-    ``[(start_epoch_s, duration_s), ...]``.
+def trace_device_events(trace_dir: str) -> list[tuple[float, float, str]]:
+    """Parse a ``jax.profiler`` trace directory into NAMED device-op events
+    ``[(start_epoch_s, duration_s, op_name), ...]``.
 
     Reads the XSpace protobuf directly (``tensorflow.tsl`` proto bindings;
     the tensorboard-plugin converter is not required). Only accelerator
     planes (``/device:...``) count; per plane the busiest line is used so
-    module- and op-level timelines aren't double-counted. Returns [] when
-    no trace/proto support is available (e.g. pure-CPU backends expose no
-    device plane).
+    module- and op-level timelines aren't double-counted. Op names come
+    from the plane's event-metadata table — ``jax.named_scope`` prefixes
+    (the per-stage scopes ``dag.fuse_layer_program`` opens) survive into
+    them, which is what lets the merged chrome trace label device slices
+    with stage names. Returns [] when no trace/proto support is available
+    (e.g. pure-CPU backends expose no device plane).
     """
     try:
         os.environ.setdefault(
@@ -81,7 +85,7 @@ def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
     except Exception:  # failure-ok: proto bindings optional; no trace parsed
         return []
-    out: list[tuple[float, float]] = []
+    out: list[tuple[float, float, str]] = []
     for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                           recursive=True):
         try:
@@ -93,27 +97,44 @@ def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
         for plane in xs.planes:
             if not plane.name.startswith("/device:"):
                 continue
-            best: list[tuple[float, float]] = []
+            meta = {mid: m.name for mid, m in plane.event_metadata.items()}
+            best: list[tuple[float, float, str]] = []
             best_busy = 0.0
             for line in plane.lines:
                 ivals = [(line.timestamp_ns / 1e9 + ev.offset_ps / 1e12,
-                          ev.duration_ps / 1e12)
+                          ev.duration_ps / 1e12,
+                          meta.get(ev.metadata_id, ""))
                          for ev in line.events]
-                busy = sum(d for _, d in ivals)
+                busy = sum(d for _, d, _n in ivals)
                 if busy > best_busy:
                     best, best_busy = ivals, busy
             out.extend(best)
     return out
 
 
+def trace_device_intervals(trace_dir: str) -> list[tuple[float, float]]:
+    """Unnamed device-op intervals ``[(start_epoch_s, duration_s), ...]``
+    — the pre-existing surface; see :func:`trace_device_events` for the
+    named variant the chrome-trace export fuses with host spans."""
+    return [(s, d) for s, d, _ in trace_device_events(trace_dir)]
+
+
 @dataclass
 class AppMetrics:
     app_name: str = "transmogrifai_tpu"
     start_time: float = field(default_factory=time.time)
+    #: frozen at ``profiler.finalize()`` — a saved run json must report the
+    #: run's wall, not the wall at whatever moment ``to_json`` was called
+    end_time: Optional[float] = None
     phases: dict = field(default_factory=dict)  # step -> PhaseMetrics
     #: phase occurrence intervals [(step, t0, t1)], enter order — the
     #: timeline device events are attributed against at finalize()
     spans: list = field(default_factory=list)
+    #: per-DAG-stage rollup (tracing span aggregation, finalize()):
+    #: label -> {"wallSeconds", "deviceSeconds", "count", "phase"}
+    stages: dict = field(default_factory=dict)
+    #: named device-plane events retained at finalize() for trace export
+    device_events: list = field(default_factory=list)
 
     def record(self, step: OpStep, wall_s: float,
                peak_hbm: int = 0) -> None:
@@ -142,7 +163,14 @@ class AppMetrics:
 
     @property
     def total_wall_s(self) -> float:
-        return time.time() - self.start_time
+        return (self.end_time if self.end_time is not None
+                else time.time()) - self.start_time
+
+    def top_stages(self, k: int = 10) -> list[tuple[str, dict]]:
+        """The K slowest DAG stages by inclusive wall (finalize() fills
+        ``stages`` from the tracing recorder's per-stage spans)."""
+        return sorted(self.stages.items(),
+                      key=lambda kv: -kv[1].get("wallSeconds", 0.0))[:k]
 
     def to_json(self) -> dict:
         return {
@@ -152,6 +180,7 @@ class AppMetrics:
                            "peakHbmBytes": p.peak_hbm_bytes,
                            "deviceSeconds": p.device_s}
                        for k, p in self.phases.items()},
+            "stages": {k: dict(v) for k, v in self.stages.items()},
             # fault-tolerance counters ride in every run summary — resume
             # and retry behavior is asserted from the same json operators
             # already collect (module global: one run's counters, reset
@@ -163,16 +192,65 @@ class AppMetrics:
         with open(path, "w") as fh:
             json.dump(self.to_json(), fh, indent=2)
 
-    def pretty(self) -> str:
+    def pretty(self, top_k: int = 10) -> str:
         from transmogrifai_tpu.utils.table import Table
         rows = [(k, f"{p.wall_s:.2f}",
                  f"{p.device_s:.2f}" if p.device_s else "-", p.count,
                  f"{p.peak_hbm_bytes / 1e6:.0f}" if p.peak_hbm_bytes
                  else "-")
                 for k, p in sorted(self.phases.items())]
-        return str(Table(["Phase", "Wall (s)", "Device (s)", "Count",
-                          "Peak HBM (MB)"],
-                         rows, title=f"{self.app_name} metrics"))
+        out = str(Table(["Phase", "Wall (s)", "Device (s)", "Count",
+                         "Peak HBM (MB)"],
+                        rows, title=f"{self.app_name} metrics"))
+        if self.stages:
+            srows = [(label, f"{v['wallSeconds']:.3f}",
+                      f"{v['deviceSeconds']:.3f}"
+                      if v.get("deviceSeconds") else "-",
+                      f"{v['peakHbmBytes'] / 1e6:.0f}"
+                      if v.get("peakHbmBytes") else "-",
+                      v.get("count", 0), v.get("phase", "") or "-")
+                     for label, v in self.top_stages(top_k)]
+            out += "\n" + str(Table(
+                ["Stage", "Wall (s)", "Device (s)", "Peak HBM (MB)",
+                 "Count", "Phase"],
+                srows, title=f"top {len(srows)} slowest stages"))
+        return out
+
+    def export_chrome_trace(self, path: str) -> dict:
+        """Write one Perfetto/chrome://tracing-compatible JSON merging the
+        host span tree (``utils.tracing.recorder``), the coarse OpStep
+        phase timeline, and (when a device plane was traced) the named
+        device slices retained at ``finalize()``. Returns a small summary
+        {"hostSpans": n, "deviceSlices": n, "phases": n}. Open the file at
+        chrome://tracing or https://ui.perfetto.dev."""
+        from transmogrifai_tpu.utils.tracing import recorder
+        events: list[dict] = [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": f"{self.app_name} host"}},
+            {"name": "process_name", "ph": "M", "pid": 2,
+             "args": {"name": "device"}},
+        ]
+        for step, t0, t1 in self.spans:
+            events.append({"name": step, "ph": "X", "pid": 1, "tid": 0,
+                           "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                           "args": {"kind": "phase"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": 0, "args": {"name": "phases"}})
+        host_events = recorder.chrome_trace_events(pid=1)
+        events.extend(host_events)
+        for start, dur, name in self.device_events:
+            events.append({"name": name or "device-op", "ph": "X",
+                           "pid": 2, "tid": 0, "ts": start * 1e6,
+                           "dur": dur * 1e6, "args": {"kind": "device"}})
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"appName": self.app_name,
+                             "totalWallSeconds": self.total_wall_s}}
+        from transmogrifai_tpu.utils.durable import atomic_json_dump
+        atomic_json_dump(doc, path)
+        n_host = sum(1 for e in host_events if e["ph"] == "X")
+        return {"hostSpans": n_host,
+                "deviceSlices": len(self.device_events),
+                "phases": len(self.spans)}
 
 
 class _CompileAttribution:
@@ -368,6 +446,43 @@ class ServingCounters:
                 for b, c in sorted(self.buckets.items())}
 
 
+def aggregate_across_hosts(metrics: AppMetrics, ctx=None,
+                           timeout_s: Optional[float] = None) -> dict:
+    """One run summary from per-host metrics: phase and stage wall /
+    device / count totals summed across every host of the mesh through
+    ``parallel.collectives.reduce_host_metrics`` (the same deadline-guarded
+    all-reduce training statistics ride). Each host calls this with ITS
+    ``AppMetrics`` after ``finalize()``; the returned json carries the
+    pod-wide sums plus ``hosts``. With no mesh context the local summary
+    returns unchanged (``hosts`` reflects ``jax.process_count()``) —
+    single-host runs pay nothing."""
+    doc = metrics.to_json()
+    try:
+        import jax
+        doc["hosts"] = int(jax.process_count())
+    except Exception:  # failure-ok: no jax backend -> single host
+        doc["hosts"] = 1
+    if ctx is None:
+        return doc
+    from transmogrifai_tpu.parallel.collectives import reduce_host_metrics
+    flat: dict[str, float] = {}
+    for ph, p in metrics.phases.items():
+        flat[f"phase\t{ph}\twallSeconds"] = p.wall_s
+        flat[f"phase\t{ph}\tdeviceSeconds"] = p.device_s
+        flat[f"phase\t{ph}\tcount"] = float(p.count)
+    for st, v in metrics.stages.items():
+        flat[f"stage\t{st}\twallSeconds"] = v.get("wallSeconds", 0.0)
+        flat[f"stage\t{st}\tdeviceSeconds"] = v.get("deviceSeconds", 0.0)
+        flat[f"stage\t{st}\tcount"] = float(v.get("count", 0))
+    reduced = reduce_host_metrics(ctx, flat, timeout_s=timeout_s)
+    for key, val in reduced.items():
+        kind, name, field_ = key.split("\t")
+        dst = doc["phases"] if kind == "phase" else doc["stages"]
+        entry = dst.setdefault(name, {})
+        entry[field_] = int(round(val)) if field_ == "count" else val
+    return doc
+
+
 class _Profiler:
     def __init__(self):
         self.metrics = AppMetrics()
@@ -382,8 +497,10 @@ class _Profiler:
         trace spanning everything until ``finalize()``. Sweep and run
         counters reset alongside so a run's counters cover exactly that
         run."""
+        from transmogrifai_tpu.utils.tracing import recorder
         sweep_counters.reset()
         run_counters.reset()
+        recorder.reset()
         self.metrics = AppMetrics(app_name=app_name)
         self.trace_dir = trace_dir
         if self._tracing:  # a previous run never finalized: stop its trace
@@ -415,15 +532,26 @@ class _Profiler:
 
     def finalize(self) -> AppMetrics:
         """Stop the run trace (if any), parse it, and attribute device time
-        to phases. Idempotent; safe without a trace (device_s stays 0)."""
+        — to phases (coarse) AND to the innermost tracing span, so the
+        stage table reports true device seconds per stage. Freezes the
+        run's end timestamp and rolls the span recorder's per-stage
+        aggregation into ``metrics.stages``. Idempotent; safe without a
+        trace (device_s stays 0)."""
+        from transmogrifai_tpu.utils.tracing import recorder
         if self._tracing:
             import jax
             try:
                 jax.profiler.stop_trace()
             finally:
                 self._tracing = False
+            events = trace_device_events(self.trace_dir)
+            self.metrics.device_events = events
             self.metrics.attribute_device_time(
-                trace_device_intervals(self.trace_dir))
+                [(s, d) for s, d, _ in events])
+            recorder.attribute_device_events(events)
+        if self.metrics.end_time is None:
+            self.metrics.end_time = time.time()
+        self.metrics.stages = recorder.stage_table()
         return self.metrics
 
     @contextlib.contextmanager

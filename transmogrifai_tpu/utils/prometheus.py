@@ -1,0 +1,268 @@
+"""Prometheus text-exposition rendering of the process's metrics.
+
+A tiny pull-model registry (no client_golang-style dependency): every
+counter the framework already keeps — ``AppMetrics`` phases/stages,
+``RunCounters``, ``SweepCounters``, and a server's ``ServingMetrics``
+(latency-histogram buckets, queue depth, degraded gauge, per-padding-
+bucket compiles) — renders into Prometheus text exposition format 0.0.4
+on demand. ``serving/http.py`` serves the output at ``GET /metrics``.
+
+Naming contract (linted by ``scripts/check_metric_names.py``):
+
+- every metric name is ``snake_case`` with the ``transmogrifai_`` prefix,
+- names are registry-unique,
+- counters (monotonic within a run) end in ``_total``; gauges don't;
+  histograms expose the standard ``_bucket``/``_sum``/``_count`` series.
+
+Collection is lazy: each metric holds a ``collect()`` closure over the
+live objects, so a scrape always reads current values and registering
+costs nothing on the serving hot path.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+__all__ = ["PromRegistry", "build_registry", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
+_TYPES = ("counter", "gauge", "histogram")
+
+
+def _escape(v) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    def __init__(self, name: str, mtype: str, help_: str,
+                 collect: Callable[[], list]):
+        self.name = name
+        self.mtype = mtype
+        self.help = help_
+        self.collect = collect
+
+
+class PromRegistry:
+    """Named metrics + their collectors; renders text exposition."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+
+    def register(self, name: str, mtype: str, help_: str,
+                 collect: Callable[[], list]) -> None:
+        """``collect()`` returns ``[(labels_dict, value), ...]``; for
+        histograms the value is ``{"buckets": {le: cumulative}, "sum":
+        s, "count": n}``. Registration enforces the naming contract —
+        a bad name is a bug, not a formatting choice."""
+        if mtype not in _TYPES:
+            raise ValueError(f"metric type {mtype!r}: one of {_TYPES}")
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must be snake_case with the "
+                "transmogrifai_ prefix")
+        if mtype == "counter" and not name.endswith("_total"):
+            raise ValueError(
+                f"counter {name!r} must carry the _total suffix "
+                "(monotonic-counter naming convention)")
+        if mtype != "counter" and name.endswith("_total"):
+            raise ValueError(
+                f"{mtype} {name!r} must NOT end in _total (reserved for "
+                "counters)")
+        if name in self._metrics:
+            raise ValueError(f"metric {name!r} already registered")
+        self._metrics[name] = _Metric(name, mtype, help_, collect)
+
+    def names(self) -> list[str]:
+        return sorted(self._metrics)
+
+    def metric_types(self) -> dict[str, str]:
+        return {m.name: m.mtype for m in self._metrics.values()}
+
+    def render(self) -> str:
+        """The whole registry in exposition format; a collector that
+        raises is skipped with a comment line instead of failing the
+        scrape (one broken gauge must not take down /metrics)."""
+        lines: list[str] = []
+        for name in self.names():
+            m = self._metrics[name]
+            lines.append(f"# HELP {m.name} {_escape(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.mtype}")
+            try:
+                samples = m.collect()
+            except Exception as e:  # noqa: BLE001 — surfaced as a scrape comment
+                lines.append(f"# collect failed: {type(e).__name__}: "
+                             f"{_escape(e)}")
+                continue
+            for labels, value in samples:
+                if m.mtype == "histogram":
+                    for le, n in value["buckets"].items():
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_fmt_labels({**labels, 'le': le})} {int(n)}")
+                    lines.append(f"{m.name}_sum{_fmt_labels(labels)} "
+                                 f"{_fmt_value(value['sum'])}")
+                    lines.append(f"{m.name}_count{_fmt_labels(labels)} "
+                                 f"{int(value['count'])}")
+                else:
+                    lines.append(f"{m.name}{_fmt_labels(labels)} "
+                                 f"{_fmt_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _app_collectors(reg: PromRegistry) -> None:
+    from transmogrifai_tpu.utils import profiling
+
+    def phases(field: str):
+        def collect():
+            return [({"phase": k}, getattr(p, field))
+                    for k, p in profiler_metrics().phases.items()]
+        return collect
+
+    def profiler_metrics():
+        return profiling.profiler.metrics
+
+    reg.register("transmogrifai_phase_wall_seconds_total", "counter",
+                 "exclusive wall seconds per OpStep phase", phases("wall_s"))
+    reg.register("transmogrifai_phase_device_seconds_total", "counter",
+                 "attributed device-busy seconds per phase",
+                 phases("device_s"))
+    reg.register("transmogrifai_phase_runs_total", "counter",
+                 "phase occurrences", phases("count"))
+    reg.register("transmogrifai_phase_peak_hbm_bytes", "gauge",
+                 "peak device HBM high-water mark attributed to the phase",
+                 phases("peak_hbm_bytes"))
+    reg.register(
+        "transmogrifai_stage_wall_seconds_total", "counter",
+        "inclusive wall seconds per DAG stage (tracing span rollup)",
+        lambda: [({"stage": k}, v.get("wallSeconds", 0.0))
+                 for k, v in profiler_metrics().stages.items()])
+    reg.register(
+        "transmogrifai_stage_device_seconds_total", "counter",
+        "attributed device seconds per DAG stage",
+        lambda: [({"stage": k}, v.get("deviceSeconds", 0.0))
+                 for k, v in profiler_metrics().stages.items()])
+
+    rc = profiling.run_counters
+    for attr, help_ in (("layers_fitted", "DAG layers fit live"),
+                        ("layers_resumed", "DAG layers replayed from a "
+                                           "train checkpoint"),
+                        ("stages_resumed", "stages restored from a train "
+                                           "checkpoint"),
+                        ("retries", "transient device retries"),
+                        ("faults_injected", "chaos-plan faults delivered")):
+        reg.register(f"transmogrifai_run_{attr}_total", "counter", help_,
+                     lambda a=attr: [({}, getattr(rc, a))])
+
+    sc = profiling.sweep_counters
+    for attr, help_ in (("compiles", "XLA backend compiles during the "
+                                     "family's sweep"),
+                        ("device_dispatches", "sweep device program "
+                                              "dispatches"),
+                        ("host_syncs", "sweep device->host metric pulls")):
+        reg.register(
+            f"transmogrifai_sweep_{attr}_total", "counter", help_,
+            lambda a=attr: [({"family": name}, getattr(fc, a))
+                            for name, fc in sc.families.items()])
+
+
+def _serving_collectors(reg: PromRegistry, serving, server=None) -> None:
+    for attr, name, help_ in (
+            ("admitted", "requests_admitted", "requests accepted at the "
+                                              "door"),
+            ("completed", "requests_completed", "requests settled ok"),
+            ("failed", "requests_failed", "requests settled with an error"),
+            ("expired", "requests_expired", "requests whose queue deadline "
+                                            "expired"),
+            ("batches", "batches", "dispatched micro-batches"),
+            ("degraded_batches", "degraded_batches", "batches served on "
+                                                     "the row path"),
+            ("data_error_batches", "data_error_batches",
+             "batches row-scored for a malformed row (no degradation)"),
+            ("batch_rows", "batch_rows", "rows dispatched in batches"),
+            ("degraded_entries", "degraded_entries", "degraded-mode "
+                                                     "entries"),
+            ("recoveries", "recoveries", "compiled-path recoveries"),
+            ("dispatch_retries", "dispatch_retries", "transient dispatch "
+                                                     "retries")):
+        reg.register(f"transmogrifai_serving_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(serving, a))])
+    reg.register(
+        "transmogrifai_serving_rejected_total", "counter",
+        "requests rejected at admission, by reason",
+        lambda: [({"reason": "backpressure"}, serving.rejected_backpressure),
+                 ({"reason": "invalid"}, serving.rejected_invalid)])
+    reg.register(
+        "transmogrifai_serving_batch_wall_seconds_total", "counter",
+        "cumulative batch dispatch wall",
+        lambda: [({}, serving.batch_wall_s)])
+    reg.register(
+        "transmogrifai_serving_latency_seconds", "histogram",
+        "request latency, admission to settlement",
+        lambda: [({}, serving.latency_histogram())])
+    reg.register(
+        "transmogrifai_serving_queue_depth", "gauge",
+        "requests waiting in the admission queue",
+        lambda: [({}, (serving.queue_depth_fn or (lambda: 0))())])
+    reg.register(
+        "transmogrifai_serving_queue_capacity", "gauge",
+        "admission queue bound",
+        lambda: [({}, serving.queue_capacity or 0)])
+    reg.register(
+        "transmogrifai_serving_degraded", "gauge",
+        "1 while the server is on the degraded row path",
+        lambda: [({}, serving.degraded_active)])
+    reg.register(
+        "transmogrifai_serving_throughput_rolling_rps", "gauge",
+        "completions/s over the rolling window",
+        lambda: [({}, serving.rolling_rps())])
+    reg.register(
+        "transmogrifai_serving_throughput_lifetime_rps", "gauge",
+        "completions/s since server start",
+        lambda: [({}, serving.throughput_rps())])
+    cc = serving.compile_counters
+    if cc is not None:
+        reg.register(
+            "transmogrifai_serving_compiles_total", "counter",
+            "fused-program compiles per padding bucket",
+            lambda: [({"bucket": str(b)}, c.compiles)
+                     for b, c in sorted(cc.buckets.items())])
+        reg.register(
+            "transmogrifai_serving_dispatches_total", "counter",
+            "batch dispatches per padding bucket",
+            lambda: [({"bucket": str(b)}, c.dispatches)
+                     for b, c in sorted(cc.buckets.items())])
+
+
+def build_registry(serving=None, server=None,
+                   include_app: bool = True) -> PromRegistry:
+    """The standard registry: process-wide training/run/sweep series
+    (``include_app``) plus, when a ``ServingMetrics`` is given, the full
+    serving surface. ``server`` (a ``ScoringServer``) is optional extra
+    context reserved for future gauges."""
+    reg = PromRegistry()
+    if include_app:
+        _app_collectors(reg)
+    if serving is not None:
+        _serving_collectors(reg, serving, server)
+    return reg

@@ -208,9 +208,12 @@ class Workflow:
         if not self.result_features:
             raise ValueError("set result features before train()")
         from transmogrifai_tpu.utils.profiling import OpStep, profiler
+        from transmogrifai_tpu.utils.tracing import span
         raw = self.raw_features()
         filter_results = None
-        with profiler.phase(OpStep.DATA_READING_AND_FILTERING):
+        with profiler.phase(OpStep.DATA_READING_AND_FILTERING), \
+                span("workflow.ingest", reader=type(self.reader).__name__,
+                     n_raw=len(raw)):
             frame = self.reader.generate_frame(raw)
             blocklist: list[str] = []
             result = self.result_features
@@ -450,8 +453,12 @@ class WorkflowModel:
         return PipelineData.from_host(frame)
 
     def transform(self, reader_or_frame) -> PipelineData:
-        data = self._ingest(reader_or_frame)
-        return self.executor.transform(data, self.dag)
+        from transmogrifai_tpu.utils.tracing import span
+        with span("workflow.ingest",
+                  reader=type(reader_or_frame).__name__):
+            data = self._ingest(reader_or_frame)
+        with span("workflow.transform", n_layers=len(self.dag)):
+            return self.executor.transform(data, self.dag)
 
     def score(self, reader_or_frame, keep_raw_features: bool = False,
               keep_intermediate_features: bool = False) -> fr.HostFrame:
