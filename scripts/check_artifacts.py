@@ -77,6 +77,52 @@ def validate_artifact(doc: object) -> list[str]:
                 "produced them)")
     if doc.get("metric") == "observability_overhead":
         errors.extend(_validate_observability(doc))
+    if doc.get("metric") == "tree_stacked_sweep":
+        errors.extend(_validate_tree_stacked(doc))
+    return errors
+
+
+#: stacked-vs-loop metric parity bound for the tree-stacked sweep
+#: artifact: both paths bin once and draw the same PRNG streams, so any
+#: difference is pure fp accumulation noise
+MAX_TREE_STACK_PARITY = 1e-5
+
+
+def _validate_tree_stacked(doc: dict) -> list[str]:
+    """The ``benchmarks/TREE_STACKED_SWEEP.json`` contract: the three
+    measured walls (per-point loop / per-fold batched / fold x grid
+    stacked), the derived speedups, exact-parity metric deltas within fp
+    tolerance, and the structural dispatch/host-sync count blocks that
+    back the gating default."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    for k in ("tree_stacked_s", "per_fold_s", "per_point_s"):
+        if not (num(doc.get(k)) and doc[k] > 0):
+            errors.append(f"tree-stacked artifact: missing positive {k!r}")
+    for k in ("speedup_vs_per_fold", "speedup_vs_per_point"):
+        if not num(doc.get(k)):
+            errors.append(f"tree-stacked artifact: missing numeric {k!r}")
+    par = doc.get("metric_parity_stacked_vs_per_fold")
+    if not num(par):
+        errors.append("tree-stacked artifact: missing numeric "
+                      "'metric_parity_stacked_vs_per_fold'")
+    elif par > MAX_TREE_STACK_PARITY:
+        errors.append(
+            f"stacked-vs-loop metric parity {par} exceeds the fp "
+            f"tolerance {MAX_TREE_STACK_PARITY} — the stacked program "
+            "computed something different, not the same sweep faster")
+    for block in ("dispatches", "host_syncs"):
+        b = doc.get(block)
+        if not (isinstance(b, dict) and all(
+                k in b and isinstance(b[k], int) and not isinstance(
+                    b[k], bool) and b[k] > 0
+                for k in ("tree_stacked", "per_fold", "per_point"))):
+            errors.append(
+                f"tree-stacked artifact: {block!r} must map each of "
+                "tree_stacked/per_fold/per_point to a positive int")
     return errors
 
 

@@ -117,6 +117,8 @@ def collect_violations() -> list[str]:
     sweep = SweepCounters()
     sweep.count("OpLogisticRegression_0", dispatches=1, host_syncs=1,
                 mode="fold_stacked")
+    sweep.count("OpGBTClassifier_1", dispatches=2, host_syncs=2,
+                stacked_groups=2, lane_chunks=2, mode="tree_stacked")
     out.extend(check_json_doc({"families": sweep.to_json()},
                               "SweepCounters.to_json"))
     out.extend(check_json_doc(RunCounters().to_json(),

@@ -307,6 +307,92 @@ def test_transient_device_faults_retried_with_parity():
     np.testing.assert_array_equal(_probs(model, host, pred), ref)
 
 
+def _build_tree_workflow(n=200, seed=4):
+    """One stacked-capable tree family (2 same-shape lanes) behind a
+    3-fold CV selector."""
+    from transmogrifai_tpu.models.trees import OpGBTClassifier
+    from transmogrifai_tpu.selector import DataSplitter
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n)
+    y = (x + rng.normal(size=n) * 0.3 > 0).astype(np.float64)
+    host = fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "x": (ft.Real, x.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(host, response="label")
+    vec = transmogrify([feats["x"]])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3, seed=1,
+        models_and_parameters=[
+            (OpGBTClassifier(num_rounds=2, max_depth=2, max_bins=8),
+             [{"learning_rate": lr} for lr in (0.1, 0.3)]),
+        ],
+        splitter=DataSplitter(reserve_test_fraction=0.2, seed=1))
+    pred = feats["label"].transform_with(sel, vec)
+    wf = Workflow().set_input_frame(host).set_result_features(pred, vec)
+    return wf, host, pred
+
+
+def test_transient_fault_inside_stacked_tree_group(monkeypatch):
+    """A transient device error during a fold x grid-stacked tree group's
+    dispatch retries the WHOLE group (all k folds x L lanes — no fold is
+    lost, no candidate fails), the retry counters record it, and the
+    result matches the fault-free stacked run exactly."""
+    from transmogrifai_tpu.utils.profiling import sweep_counters
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_STACKED", "1")
+    UID.reset()
+    wf, host, pred = _build_tree_workflow()
+    ref = _probs(wf.train(), host, pred)
+    ref_summary = pred.origin_stage  # fault-free reference
+    profiler.reset()
+
+    UID.reset()
+    wf, host, pred = _build_tree_workflow()
+    with fault_plan("transient@sweep.fit#0x1") as plan:
+        model = wf.train()
+    assert [f[2] for f in plan.fired] == ["transient"]
+    assert run_counters.retries >= 1
+    assert run_counters.faults_injected == 1
+    np.testing.assert_array_equal(_probs(model, host, pred), ref)
+    summary = model.selector_summary()
+    assert summary.failures == []  # retried, not isolated as a failure
+    c = sweep_counters.to_json()["OpGBTClassifier_0"]
+    assert c["mode"] == "tree_stacked"
+    assert c["stackedGroups"] == 1
+    # the failed dispatch never reached its metric pull: the group still
+    # settles at one recorded sync (counted after the retried dispatch)
+    assert c["hostSyncs"] == 1
+    del ref_summary
+
+
+def test_stacked_tree_group_span_nests_under_sweep(monkeypatch):
+    """The per-group span replaces the per-(family, fold) spans on the
+    tree fast path: it carries k/lanes/depth attrs and nests under
+    selector.sweep."""
+    from transmogrifai_tpu.utils.tracing import recorder
+    monkeypatch.setenv("TRANSMOGRIFAI_TREE_STACKED", "1")
+    UID.reset()
+    wf, host, pred = _build_tree_workflow(seed=6)
+    wf.train()
+    spans = recorder.spans
+    by_id = {s.span_id: s for s in spans}
+    groups = [s for s in spans if s.name == "sweep.tree_group"]
+    assert len(groups) == 1, [s.name for s in spans]
+    g = groups[0]
+    assert g.attrs["k"] == 3
+    assert g.attrs["lanes"] == 2
+    assert g.attrs["depth"] == 2
+    assert g.attrs["family"] == "OpGBTClassifier_0"
+    ancestors = []
+    pid = g.parent_id
+    while pid is not None:
+        ancestors.append(by_id[pid].name)
+        pid = by_id[pid].parent_id
+    assert "selector.sweep" in ancestors, ancestors
+    # the fast path replaced the per-(family, fold) unit spans
+    assert not any(s.name == "sweep.fold_unit" for s in spans)
+
+
 def test_checkpoint_dir_does_not_leak_across_trains(tmp_path):
     UID.reset()
     wf, host, pred = _build_workflow(n=60)

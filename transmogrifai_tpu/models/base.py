@@ -26,7 +26,8 @@ from transmogrifai_tpu.stages.base import (
 )
 from transmogrifai_tpu.types import feature_types as ft
 
-__all__ = ["Predictor", "PredictionModel", "supports_fold_stacking"]
+__all__ = ["Predictor", "PredictionModel", "supports_fold_stacking",
+           "supports_tree_stacking"]
 
 
 class Predictor(Estimator):
@@ -132,31 +133,52 @@ class Predictor(Estimator):
         return self.fit_arrays(X, y, w, self.params)
 
 
-def supports_fold_stacking(est: Predictor) -> bool:
-    """True when the estimator's fold-stacked trainer is safe to use in
-    place of its per-fold one.
-
-    Two conditions: the family overrode ``grid_fit_arrays_folds`` (opted
-    in), AND no subclass overrides any per-fold trainer/scorer *below* that
-    opt-in in the MRO. The second guard is what keeps user subclasses
-    honest: a test double or wrapper that redefines ``grid_fit_arrays`` /
-    ``fit_arrays`` / ``grid_predict_scores`` (counting fits, injecting
-    failures, changing the math) must keep its semantics — the sweep routes
-    such families through the per-fold loop where the override is called."""
-    cls = type(est)
-    mro = cls.__mro__
-    stacked = ("grid_fit_arrays_folds", "grid_scores_folds",
-               "_fold_stacked_params")
+def _stacking_safe(est: Predictor, opt_in: tuple[str, ...],
+                   guarded: tuple[str, ...]) -> bool:
+    """Shared capability rule for both stacking contracts: the family
+    defined one of the ``opt_in`` methods somewhere below ``Predictor``
+    (opted in), AND no subclass overrides any of the ``guarded`` per-fold
+    trainers/scorers *more derived than* that opt-in in the MRO — a test
+    double or wrapper that redefines them (counting fits, injecting
+    failures, changing the math) must keep its semantics, so the sweep
+    routes such families through the per-fold loop where the override is
+    actually called."""
+    mro = type(est).__mro__
     owner_i = min((i for i, c in enumerate(mro) if c is not Predictor
-                   and any(n in vars(c) for n in stacked)), default=None)
+                   and any(n in vars(c) for n in opt_in)), default=None)
     if owner_i is None:
-        return False  # never opted in (base default = no fold axis)
-    for name in ("grid_fit_arrays", "fit_arrays", "grid_predict_scores",
-                 "grid_predict_scores_folds"):
+        return False  # never opted in (base default = no stacked axis)
+    for name in guarded:
         def_i = next((i for i, c in enumerate(mro) if name in vars(c)), None)
         if def_i is not None and def_i < owner_i:
             return False  # more-derived per-fold override would be bypassed
     return True
+
+
+def supports_fold_stacking(est: Predictor) -> bool:
+    """True when the estimator's fold-stacked trainer
+    (``grid_fit_arrays_folds``/``grid_scores_folds``) is safe to use in
+    place of its per-fold one (see ``_stacking_safe``)."""
+    return _stacking_safe(
+        est,
+        ("grid_fit_arrays_folds", "grid_scores_folds",
+         "_fold_stacked_params"),
+        ("grid_fit_arrays", "fit_arrays", "grid_predict_scores",
+         "grid_predict_scores_folds"))
+
+
+def supports_tree_stacking(est: Predictor) -> bool:
+    """True when the estimator's fold x grid-stacked TREE trainer
+    (``tree_stack_scores`` + ``tree_stack_groups``, opted in by
+    ``models.trees._TreePredictor``) is safe to use in place of its
+    per-fold loop. Same override discipline as ``supports_fold_stacking``:
+    subclasses redefining the per-fold trainers below the opt-in (e.g.
+    ``OpDecisionTree*``, which mutate ``bootstrap`` inside a custom
+    ``fit_arrays``) keep the loop where their semantics run."""
+    return _stacking_safe(
+        est,
+        ("tree_stack_scores", "tree_stack_groups"),
+        ("grid_fit_arrays", "fit_arrays", "grid_predict_scores"))
 
 
 class PredictionModel(AllowLabelAsInput, DeviceTransformer):

@@ -22,6 +22,12 @@ native engines with one device-resident histogram learner (SURVEY §2.7 P5):
   via cumulative sums along the bin axis; the whole ensemble trains inside
   one ``lax.scan`` jitted program (boosting) or a scanned loop of
   independent bootstrapped trees (forest)
+- the CV sweep stacks further (round 8): ``train_score_stacked`` vmaps
+  the grower over a leading (fold x grid-lane) batch — one compiled
+  program trains and scores a whole depth-group of the ModelSelector's
+  k-fold x hyperparameter sweep, per-lane scalars riding as batched
+  operands and the scatter histograms folding every batch axis into the
+  node axis (``ops/histograms.py``'s custom_vmap rule)
 - trees are fixed-shape: a non-splitting node stores feature -1 and routes
   rows left, so depth-d trees are dense arrays and prediction is d gathers.
 
@@ -756,6 +762,60 @@ def train_ensemble_sharded(ctx, Xb, y, w, **kw):
     return fn(Xb, y, w)
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "n_rounds", "max_depth", "n_bins", "loss", "subsample",
+    "colsample", "bootstrap", "seed", "hist", "sorted_engine", "sorted_acc",
+    "forest_margin"))
+def train_score_stacked(Xb, y, w, Xva, base, lr, lam, gam, mcw, *,
+                        n_rounds: int, max_depth: int, n_bins: int,
+                        loss: str, subsample, colsample,
+                        bootstrap: bool, seed: int, hist: str,
+                        sorted_engine: str, sorted_acc: str,
+                        forest_margin: bool):
+    """ONE compiled program for a whole (family, depth-group) of the CV
+    sweep: train all ``k`` folds x ``L`` same-shape grid lanes and score
+    their validation folds, returning ``[k, L, n_va]`` scores.
+
+    ``Xb/Xva``: ``[k, n, d]`` stacked int bin codes (one fold gather of
+    the dataset-level ``fold_sweep_plan`` codes — no re-binning);
+    ``y/w``: ``[k, n]``; ``base``: ``[k]`` per-fold base scores
+    (host-computed with the loop path's exact f32/f64 arithmetic —
+    ``tree_stack_fold_bases`` — so stacked-vs-loop parity stays bitwise);
+    ``lr/lam/gam/mcw``: ``[L]`` per-lane hyperparameter scalars riding as
+    batched operands. The fold axis is the outer ``vmap``, lanes the
+    inner one, so the existing ``lax.scan``-over-rounds grower batches:
+    the sorted engine's one-hot contraction gains MXU batch dims
+    (node-count-independent, the extra axis feeds the systolic array),
+    and the scatter engine's histograms fold every batch axis into the
+    node axis via the ``custom_vmap`` rule in ``ops/histograms.py`` —
+    one flat scatter per level for the whole (fold x lane x class)
+    batch. ``forest_margin`` re-centers forest-classifier probabilities
+    at 0, matching ``grid_predict_scores``.
+    """
+
+    def fold_fn(Xb_k, y_k, w_k, Xva_k, base_k):
+        def lane_fn(lr_i, lam_i, gam_i, mcw_i):
+            trees, _gains = train_ensemble(
+                Xb_k, y_k, w_k, n_rounds=n_rounds, max_depth=max_depth,
+                n_bins=n_bins, n_out=1, loss=loss, learning_rate=lr_i,
+                reg_lambda=lam_i, gamma=gam_i, min_child_weight=mcw_i,
+                subsample=subsample, colsample=colsample,
+                base_score=base_k, bootstrap=bootstrap, seed=seed,
+                hist=hist, sorted_engine=sorted_engine,
+                sorted_acc=sorted_acc)
+            out = predict_ensemble(Xva_k, trees, n_out=1,
+                                   learning_rate=lr_i, base_score=base_k,
+                                   bootstrap=bootstrap)
+            s = out[:, 0]
+            if forest_margin:
+                s = jnp.clip(s, 0.0, 1.0) - 0.5  # margin at 0
+            return s
+
+        return jax.vmap(lane_fn)(lr, lam, gam, mcw)
+
+    return jax.vmap(fold_fn)(Xb, y, w, Xva, base)
+
+
 def predict_ensemble(Xb, trees, *, n_out: int, learning_rate, base_score,
                      bootstrap: bool):
     feats, bins, leaves = trees
@@ -938,6 +998,18 @@ class _TreePredictor(Predictor):
             return "logistic", 1, base
         return "softmax", n_classes, 0.0
 
+    def _stacked_base_mode(self, loss: str) -> str:
+        """How the fold x grid-stacked program derives each fold's base
+        score IN-PROGRAM — must mirror ``_loss_and_nout``'s base exactly
+        (the stacked-vs-loop parity contract), so overrides pair with it:
+        ``"mean"`` = fold label mean (squared losses, forests included —
+        forests trained on a mean base fit residuals whose base is never
+        re-added at predict, the established semantics), ``"logodds"`` =
+        log-odds of the fold's positive rate, ``"zero"`` = 0."""
+        if loss == "squared":
+            return "mean"
+        return "zero" if self.bootstrap else "logodds"
+
     def _edges_of(self, X, max_bins: int):
         """Quantile edges; device path for device-resident X (no host pull),
         host percentile for plain numpy input."""
@@ -1096,6 +1168,177 @@ class _TreePredictor(Predictor):
         bases = jnp.asarray([m.base_score for m in models], jnp.float32)
         return jax.vmap(score_one)(stacked, lrs, bases)
 
+    # -- fold x grid-stacked sweep (round 8) ---------------------------------
+    def tree_stack_groups(self, grid):
+        """Group the grid by compiled-program shape — the static arguments
+        of ``train_ensemble``: ``(max_depth, num_rounds, max_bins,
+        subsample, colsample, seed)``. Each group's lanes share one
+        compiled stacked program; the per-lane scalars (learning_rate,
+        reg_lambda, gamma, min_child_weight) ride as batched operands.
+        Returns ``[{lanes, params, max_depth, num_rounds, max_bins,
+        subsample, colsample, seed}]`` in first-seen order (deterministic,
+        so checkpoint group indices are stable across runs)."""
+        merged = [{**self.default_params, **self.params,
+                   **{self._ALIASES.get(k, k): v for k, v in g.items()}}
+                  for g in grid]
+        groups: dict[tuple, dict] = {}
+        for i, p in enumerate(merged):
+            # forests ignore the subsample grid value (fit_arrays pins the
+            # Poisson rate to 1.0), so it must not split their groups
+            sub = 1.0 if self.bootstrap else float(p["subsample"])
+            key = (int(p["max_depth"]), int(p["num_rounds"]),
+                   int(p["max_bins"]), sub, float(p["colsample"]),
+                   int(p["seed"]))
+            g = groups.setdefault(key, {
+                "lanes": [], "params": [], "max_depth": key[0],
+                "num_rounds": key[1], "max_bins": key[2],
+                "subsample": key[3], "colsample": key[4], "seed": key[5]})
+            g["lanes"].append(i)
+            g["params"].append(p)
+        return list(groups.values())
+
+    def tree_stack_scalar_lnb(self, y):
+        """``(loss, n_out, base)`` when the family has a scalar stacked
+        score (binary margin / regression prediction), else None —
+        multiclass has no batched scalar and keeps the per-fold loop.
+        One blocking device sync (max of y) per FAMILY, like the linear
+        path's ``_n_classes``."""
+        lnb = self._loss_and_nout(y)
+        return lnb if lnb[1] == 1 else None
+
+    @staticmethod
+    def _tree_stack_hist_mode(n_rows: int) -> str:
+        """Histogram engine for the stacked program — ``scatter`` or
+        ``sorted``, never ``sorted_sharded``: the vmapped (fold x lane)
+        batch cannot ride the explicit per-family ``shard_map`` wrapper,
+        so under an active mesh the GSPMD scatter path (per-shard
+        scatters + XLA-inserted psum) is the safe engine. Same
+        TRANSMOGRIFAI_TREE_HIST override and loud-downgrade discipline as
+        ``_hist_mode_for``; ``n_rows`` is one fold's training rows."""
+        import os
+        import warnings
+        forced = os.environ.get("TRANSMOGRIFAI_TREE_HIST")
+        if forced and forced not in ("scatter", "sorted"):
+            raise ValueError(
+                f"TRANSMOGRIFAI_TREE_HIST={forced!r}: expected 'scatter' "
+                "or 'sorted'")
+        from transmogrifai_tpu.parallel.mesh import current_mesh
+        meshed = current_mesh() is not None
+        if forced == "scatter":
+            return "scatter"
+        if forced == "sorted":
+            if not meshed:
+                return "sorted"
+            msg = ("TRANSMOGRIFAI_TREE_HIST=sorted downgraded to 'scatter' "
+                   "for the fold x grid-stacked tree sweep: the stacked "
+                   "batch runs under GSPMD, where the sorted engine's "
+                   "global-index bookkeeping would generate heavy "
+                   "cross-shard collectives")
+            if os.environ.get("TRANSMOGRIFAI_TREE_HIST_STRICT") == "1":
+                raise RuntimeError(msg)
+            warnings.warn(msg, RuntimeWarning)
+            return "scatter"
+        if (not meshed and n_rows >= _SORT_MIN_ROWS
+                and jax.default_backend() == "tpu"):
+            return "sorted"
+        return "scatter"
+
+    def tree_stack_bytes(self, k: int, n_tr: int, n_va: int, d: int,
+                         group: dict) -> tuple[float, float]:
+        """``(shared_bytes, per_lane_bytes)`` HBM estimate for one stacked
+        depth-group — the tree-specific extension of the selector's
+        ``fold_stack_unit_width`` guard. Shared: the stacked int8/int32
+        code gathers plus labels/weights. Per lane (times k folds): the
+        boosting margins/grad/hess/row-weight residency, both levels'
+        (g, h) node-stat histograms, the sorted engine's materialized
+        one-hot chunk when that engine is selected, and the ``[k, L,
+        n_va]`` score slab. The selector divides the budget by this to
+        split a group into lane chunks instead of falling all the way
+        back to the per-fold loop."""
+        B = int(group["max_bins"])
+        depth = int(group["max_depth"])
+        csize = 1 if B <= 127 else 4
+        shared = float(k) * (float(n_tr + n_va) * d * csize
+                             + 8.0 * n_tr + 4.0 * n_va)
+        nodes = min(2 ** max(depth - 1, 0), _MAX_HIST_NODES)
+        hist_bytes = 16.0 * nodes * d * B  # (g, h) x (level, prev) f32
+        if self._tree_stack_hist_mode(n_tr) == "sorted":
+            hist_bytes += min(float(_SORT_OH_BUDGET), 4.0 * n_tr * d * B)
+        per_lane = float(k) * (28.0 * n_tr + hist_bytes + 8.0 * n_va)
+        return shared, per_lane
+
+    def tree_stack_fold_bases(self, fold_means, loss: str) -> np.ndarray:
+        """``[k]`` per-fold base scores from the folds' label means,
+        replicating ``_loss_and_nout``'s exact f32-clip + f64-log
+        arithmetic on HOST so stacked-vs-loop metric parity is bitwise
+        (an in-program f32 log differs by ~1 ulp, enough to move binned-
+        metric bucket boundaries at scale)."""
+        mode = self._stacked_base_mode(loss)
+        means = np.asarray(fold_means, np.float32)
+        if mode == "zero":
+            return np.zeros(means.shape[0], np.float32)
+        if mode == "mean":
+            return means
+        out = []
+        for m in means:
+            p = float(np.clip(m, np.float32(1e-6), np.float32(1 - 1e-6)))
+            out.append(np.log(p / (1.0 - p)))
+        return np.asarray(out, np.float32)
+
+    def tree_stack_scores(self, Xb, y, w, Xva, lane_params, lnb,
+                          fold_means=None):
+        """``[k, L, n_va]`` validation scores for one (family,
+        depth-group): the selector fast path's fused train+score unit.
+        ``Xb/Xva`` are the stacked fold gathers of the dataset-level bin
+        codes, ``lane_params`` the merged param dicts of this chunk's
+        lanes (same static shape — ``tree_stack_groups`` guarantees it),
+        ``lnb`` the family-level ``tree_stack_scalar_lnb``, and
+        ``fold_means`` the folds' label means (the selector pulls them
+        once per sweep; computed here — one sync — when absent). Returns
+        None when no scalar stacked score exists (multiclass)."""
+        loss, n_out, _base = lnb
+        if n_out != 1 or not lane_params:
+            return None
+        p0 = lane_params[0]
+        k, n_tr, d = (int(Xb.shape[0]), int(Xb.shape[1]), int(Xb.shape[2]))
+        L = len(lane_params)
+        if fold_means is None and self._stacked_base_mode(loss) != "zero":
+            # each fold's mean comes from the SAME unbatched program the
+            # loop path runs (a batched row-mean may re-associate)
+            fold_means = np.asarray(jnp.stack(
+                [jnp.mean(y[f]) for f in range(k)]))
+        bases = jnp.asarray(self.tree_stack_fold_bases(
+            fold_means if fold_means is not None else np.zeros(k), loss))
+        lrs = jnp.asarray([p["learning_rate"] for p in lane_params],
+                          jnp.float32)
+        lams = jnp.asarray([p["reg_lambda"] for p in lane_params],
+                           jnp.float32)
+        gams = jnp.asarray([p["gamma"] for p in lane_params], jnp.float32)
+        mcws = jnp.asarray([p["min_child_weight"] for p in lane_params],
+                           jnp.float32)
+        depth, rounds, B = (int(p0["max_depth"]), int(p0["num_rounds"]),
+                            int(p0["max_bins"]))
+        hist_mode = self._tree_stack_hist_mode(n_tr)
+        from transmogrifai_tpu.utils import flops
+        if hist_mode == "sorted":
+            per_tree = sum(4.0 * n_tr * d * B + 10.0 * n_tr
+                           + 12.0 * (2 ** lv) * d * B
+                           for lv in range(depth))
+        else:
+            per_tree = sum(5.0 * n_tr * d + 4.0 * n_tr
+                           + 12.0 * (2 ** lv) * d * B
+                           for lv in range(depth))
+        flops.add("tree", k * L * rounds * per_tree)
+        return train_score_stacked(
+            Xb, y, w, Xva, bases, lrs, lams, gams, mcws,
+            n_rounds=rounds, max_depth=depth, n_bins=B, loss=loss,
+            subsample=1.0 if self.bootstrap else float(p0["subsample"]),
+            colsample=float(p0["colsample"]), bootstrap=self.bootstrap,
+            seed=int(p0["seed"]), hist=hist_mode,
+            sorted_engine=_sorted_engine_default(),
+            sorted_acc=_sorted_acc_default(),
+            forest_margin=self.bootstrap and self.kind.endswith("classifier"))
+
 
 class OpGBTClassifier(_TreePredictor):
     """Gradient-boosted classification trees (Spark OpGBTClassifier parity;
@@ -1143,6 +1386,9 @@ class OpRandomForestClassifier(_ForestMixin, _TreePredictor):
         if n_classes <= 2:
             return "squared", 1, 0.0
         return "squared_onehot", n_classes, 0.0
+
+    def _stacked_base_mode(self, loss: str) -> str:
+        return "zero"  # class-probability trees grow from a zero margin
 
 
 class OpRandomForestRegressor(_ForestMixin, _TreePredictor):

@@ -16,8 +16,14 @@ so HBM traffic per level drops to reading Xp (int8 codes) + writing one
 The kernel is deliberately STATELESS per grid step (no cross-step
 scratch): ``vmap`` batching prepends a grid axis, which would silently
 break any ``program_id``-keyed accumulator reset — and the multiclass
-ensemble always calls the grower under ``vmap``. The block cumsum stays
-outside (cheap: [nb, 2, d*B] is ~1/C the one-hot size).
+ensemble always calls the grower under ``vmap``. Round 8's fold x
+grid-stacked sweep (``models/trees.train_score_stacked``) nests two
+MORE vmaps (fold x lane) on top; the same statelessness is what makes
+those legal, and CPU CI asserts interpret-mode parity for the batched
+shape against the einsum engine
+(``tests/test_tree_stacked_sweep.py::test_stacked_engines_agree``).
+The block cumsum stays outside (cheap: [nb, 2, d*B] is ~1/C the
+one-hot size).
 
 Parity: identical math to the einsum path (bf16 one-hot, f32
 accumulation); CPU CI runs the same kernel in interpret mode.

@@ -192,7 +192,11 @@ def shard_stacked_training_rows(X, y, w):
     divides that axis (``fold_axis_on_model``), else replicates. This is
     the 2-D placement of the ModelSelector's (fold x grid) work units:
     rows over "data", fold/grid candidates over "model" (SURVEY §2.7
-    P1 + P3 combined). No-op without an active mesh."""
+    P1 + P3 combined). ``X`` may be float features (the linear families'
+    stacked batch) or integer bin codes (the fold x grid-stacked tree
+    sweep's int8 code gather) — padding is dtype-preserving and padded
+    slots carry weight 0, so every weighted statistic ignores them.
+    No-op without an active mesh."""
     ctx = current_mesh()
     if ctx is None:
         return X, y, w
@@ -206,8 +210,10 @@ def shard_stacked_training_rows(X, y, w):
             return a
         width = [(0, 0), (0, n_pad - n)] + [(0, 0)] * (a.ndim - 2)
         if isinstance(a, np.ndarray):
-            return np.pad(a, width, constant_values=val)
-        return jnp.pad(a, width, constant_values=val)
+            return np.pad(a, width,
+                          constant_values=np.asarray(val, a.dtype))
+        return jnp.pad(a, width,
+                       constant_values=jnp.asarray(val, a.dtype))
 
     fold_ax = MODEL_AXIS if fold_axis_on_model(k) else None
 

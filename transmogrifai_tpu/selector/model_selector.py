@@ -13,10 +13,15 @@ hyperparameter grid AND the whole k-fold CV axis as one stacked vmapped
 program (``grid_fit_arrays_folds``) — validation scoring and metrics batch
 over [k, G] so a family costs one dispatch and ONE host sync; the (fold x
 grid) work units shard 2-D over the mesh (rows on "data", candidates on
-"model"). Families without the fold axis (trees, custom subclasses) and
-batches that would not fit HBM fall back to a sequential per-fold loop
-(compile once, run k times). No thread pool, no executor dispatch. See
-PERF.md "Sweep execution model".
+"model"). Tree families (RF/GBT) stack too (round 8): the grid groups by
+compiled-program shape and each depth-group's whole k folds x L lanes
+batch trains as ONE program over the dataset-level bin codes
+(``tree_stack_scores``), one dispatch + one sync per group, with the HBM
+guard splitting too-wide groups into lane chunks. Custom subclasses that
+override the per-fold trainers, multiclass scoring, and batches that
+would not fit HBM at even one lane fall back to a sequential per-fold
+loop (compile once, run k times). No thread pool, no executor dispatch.
+See PERF.md "Sweep execution model" and docs/SWEEP.md.
 """
 
 from __future__ import annotations
@@ -335,16 +340,17 @@ class ModelSelector(Estimator):
         return f"{type(self.models_and_grids[ci][0]).__name__}_{ci}"
 
     @staticmethod
-    def _stacked_enabled() -> bool:
-        """The fold-stacked fast path defaults ON where its win lives —
-        accelerator backends and active meshes (the saving is k x fewer
-        dispatches + host syncs, which a tunneled TPU pays in round trips)
-        — and OFF on plain single-device CPU, where the microbench
-        (benchmarks/FOLD_STACKED_SWEEP.json) measures the batched program
-        ~0.9x the per-fold loop. ``TRANSMOGRIFAI_SWEEP_STACKED=1``/``0``
-        forces either way (A/B reruns, parity checks)."""
+    def _stacking_default(env_var: str) -> bool:
+        """Shared gating policy for both stacked fast paths: the env var
+        forces either way (A/B reruns, parity checks); otherwise ON where
+        the win lives — accelerator backends and active meshes (the
+        saving is k-or-k x L fewer dispatches + host syncs, which a
+        tunneled TPU pays in round trips) — and OFF on plain
+        single-device CPU, where the microbenches measure the batched
+        programs at ~0.9x the per-fold loop (the CPU default only flips
+        if an artifact measures >= 1.0x)."""
         import os
-        env = os.environ.get("TRANSMOGRIFAI_SWEEP_STACKED")
+        env = os.environ.get(env_var)
         if env is not None:
             return env != "0"
         from transmogrifai_tpu.parallel import mesh as pmesh
@@ -352,6 +358,20 @@ class ModelSelector(Estimator):
             return True
         import jax
         return jax.default_backend() != "cpu"
+
+    @classmethod
+    def _stacked_enabled(cls) -> bool:
+        """Linear fold-stacked gating (benchmarks/FOLD_STACKED_SWEEP.json
+        measures CPU at ~0.9x -> default OFF there)."""
+        return cls._stacking_default("TRANSMOGRIFAI_SWEEP_STACKED")
+
+    @classmethod
+    def _tree_stacked_enabled(cls) -> bool:
+        """Tree fold x grid-stacked gating
+        (benchmarks/TREE_STACKED_SWEEP.json measures CPU at 0.93x ->
+        default OFF there; a tree depth-group on the fast path costs one
+        dispatch + ONE host sync instead of k x L of each)."""
+        return cls._stacking_default("TRANSMOGRIFAI_TREE_STACKED")
 
     @staticmethod
     def _stacked_hbm_budget() -> float:
@@ -400,20 +420,24 @@ class ModelSelector(Estimator):
         path stacks the CV axis — all k folds x |grid| points train as one
         compiled program (``grid_fit_arrays_folds``), validation scores and
         metrics batch over [k, G], and the family costs exactly ONE host
-        sync. Work units shard 2-D over the mesh (rows on "data",
-        fold/grid candidates on "model"). A family falls back to the
-        per-fold loop when it has no fold axis (``supports_fold_stacking``
-        False — including subclasses that override the per-fold trainers),
-        when the evaluator has no fold-batched metric, when the stacked
-        batch would blow the HBM guard, or when scoring returns no batched
-        scalar (multiclass).
+        sync. Tree families take the analogous fold x grid-stacked path
+        per depth-group (``_family_tree_stacked``). Work units shard 2-D
+        over the mesh (rows on "data", fold/grid candidates on "model").
+        A family falls back to the per-fold loop when it has no stacked
+        axis (``supports_fold_stacking``/``supports_tree_stacking`` False
+        — including subclasses that override the per-fold trainers), when
+        the evaluator has no fold-batched metric, when the stacked batch
+        would blow the HBM guard (trees first try lane chunking), or when
+        scoring returns no batched scalar (multiclass).
 
         Semantics preserved exactly from the per-fold loop: failure
         isolation per family, the ``max_wait_s`` budget, checkpoint/restart
         (stacked families checkpoint one per-family key carrying per-fold
         value vectors), and non-finite-metric exclusion.
         """
-        from transmogrifai_tpu.models.base import supports_fold_stacking
+        from transmogrifai_tpu.models.base import (
+            supports_fold_stacking, supports_tree_stacking,
+        )
         from transmogrifai_tpu.parallel import mesh as pmesh
         from transmogrifai_tpu.utils.profiling import sweep_counters
         from transmogrifai_tpu.utils.retry import with_device_retry
@@ -436,6 +460,7 @@ class ModelSelector(Estimator):
         done = self._ckpt_load()
         n_tr_pad = pmesh.pad_rows(n_tr)
         stacked_data = None  # built on the first stacked-capable family
+        tree_cache: dict = {}  # stacked code/label gathers shared by trees
 
         for ci, (est, grid) in enumerate(self.models_and_grids):
             fname = self._family_name(ci)
@@ -447,6 +472,17 @@ class ModelSelector(Estimator):
                     for gj in range(len(grid)):
                         per_candidate_scores.setdefault((ci, gj), []).append(
                             float(done[skey][f * len(grid) + gj]))
+                sweep_counters.count(fname, mode="resumed")
+                continue
+            tgroups = (est.tree_stack_groups(grid)
+                       if supports_tree_stacking(est) else None)
+            if tgroups and self._treestack_replay(ci, tgroups, k, n_tr, d,
+                                                  done,
+                                                  per_candidate_scores):
+                # restart path: every depth-group of this tree family
+                # already scored under per-group treestack keys — replays
+                # regardless of the current gating, so a stacked-written
+                # checkpoint resumes under the loop layout too
                 sweep_counters.count(fname, mode="resumed")
                 continue
             fold_keys = [f"{f}:{ci}:{n_tr_pad}x{d}" for f in range(k)]
@@ -527,11 +563,198 @@ class ModelSelector(Estimator):
                     done[skey] = flat
                     self._ckpt_save(done)
                     continue
+            if (tgroups and self._tree_stacked_enabled()
+                    and fold_metrics is not None
+                    and self._family_tree_stacked(
+                        ci, est, grid, tgroups, Xt, yt, wt, tr_idx, va_idx,
+                        done, deadline, per_candidate_scores, failures,
+                        tree_cache)):
+                continue
             # ---- per-fold fallback loop for this family --------------------
             self._family_fold_loop(
                 ci, est, grid, Xt, yt, wt, tr_idx, va_idx, done, deadline,
                 per_candidate_scores, failures)
         return self._collect_results(per_candidate_scores, failures)
+
+    # -- fold x grid-stacked tree sweep (round 8) ----------------------------
+    @staticmethod
+    def _treestack_key(ci: int, gi: int, k: int, n_tr: int, d: int,
+                       group: dict) -> str:
+        """Per-depth-group checkpoint key. Carries the fold plan AND the
+        training shape (``n_tr x d``) like the per-fold and linear
+        stacked keys do — same config against reshaped data must
+        recompute, not replay stale scores."""
+        return (f"{ci}:treestack:{gi}:{k}x{n_tr}x{d}:"
+                f"{len(group['lanes'])}x{group['max_depth']}")
+
+    @staticmethod
+    def _record_treestack(per_candidate_scores, ci: int, lanes, k: int,
+                          flat) -> None:
+        """Unpack one depth-group's fold-major ``k x L`` value vector
+        into per-candidate score lists — the ONE place the checkpoint
+        layout is decoded (replay, group resume, and fresh scoring all
+        route through here)."""
+        L = len(lanes)
+        for f in range(k):
+            for li, gj in enumerate(lanes):
+                per_candidate_scores.setdefault((ci, gj), []).append(
+                    float(flat[f * L + li]))
+
+    def _treestack_replay(self, ci, tgroups, k, n_tr, d, done,
+                          per_candidate_scores) -> bool:
+        """Replay a tree family whose EVERY depth-group checkpointed under
+        the per-group treestack keys (fold-major k x L value vectors).
+        True when the whole family was replayed."""
+        keys = [self._treestack_key(ci, gi, k, n_tr, d, g)
+                for gi, g in enumerate(tgroups)]
+        if not all(tk in done and len(done[tk]) == k * len(g["lanes"])
+                   for tk, g in zip(keys, tgroups)):
+            return False
+        for tk, g in zip(keys, tgroups):
+            self._record_treestack(per_candidate_scores, ci, g["lanes"],
+                                   k, done[tk])
+        return True
+
+    def _family_tree_stacked(self, ci, est, grid, tgroups, Xt, yt, wt,
+                             tr_idx, va_idx, done, deadline,
+                             per_candidate_scores, failures,
+                             cache: dict) -> bool:
+        """One tree family's fold x grid-stacked sweep: every depth-group
+        (grid lanes sharing one compiled-program shape) trains all
+        k folds x L lanes as ONE compiled program over the stacked gather
+        of the dataset-level bin codes (``fold_sweep_plan`` — no
+        re-binning), scores its validation folds batched, and pulls the
+        whole group's ``[k, L]`` metric block with ONE host sync. The HBM
+        guard (``tree_stack_bytes``) splits a too-wide group into lane
+        chunks (one dispatch + one sync each) instead of falling all the
+        way back. Returns True when the family was fully handled (scored,
+        group-resumed, failed-and-isolated, or deadline-skipped); False
+        routes it to the per-fold loop untouched (multiclass, bin-once
+        disabled, or a group where not even one lane fits the budget —
+        sub-grid loop units can't be expressed, so the loop keeps the
+        whole family)."""
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.profiling import sweep_counters
+        from transmogrifai_tpu.utils.retry import with_device_retry
+        from transmogrifai_tpu.utils.tracing import span
+        fname = self._family_name(ci)
+        lnb = est.tree_stack_scalar_lnb(yt)  # ONE family-level sync
+        if lnb is None:
+            return False  # multiclass: no batched scalar score
+        k, n_tr = tr_idx.shape
+        n_va = int(va_idx.shape[1])
+        d = int(Xt.shape[1])
+        budget = self._stacked_hbm_budget()
+        chunk_sizes = []
+        for g in tgroups:
+            shared, per_lane = est.tree_stack_bytes(k, n_tr, n_va, d, g)
+            max_lanes = (int((budget - shared) // per_lane)
+                         if budget > shared and per_lane > 0 else 0)
+            if max_lanes < 1:
+                return False  # not even one lane fits: loop (peak 1/k)
+            chunk_sizes.append(max_lanes)
+        import os
+        if os.environ.get("TRANSMOGRIFAI_TREE_BIN_ONCE", "1") == "0":
+            return False  # exact per-fold edges requested: nothing stacks
+        jtr = jnp.asarray(tr_idx)
+        jva = jnp.asarray(va_idx)
+        if "yva" not in cache:
+            cache["yva"] = jnp.take(yt, jva, axis=0)
+        yva_s = cache["yva"]
+        needed = [mb for mb in sorted({g["max_bins"] for g in tgroups})
+                  if mb not in cache]
+        if needed:
+            # bin codes depend only on (X, max_bins), so the dataset-level
+            # plan and its stacked gathers are shared across tree families
+            # — only missing max_bins pay the quantile sort + searchsorted
+            plan = est.fold_sweep_plan(Xt, grid)
+            if plan is None:
+                return False
+        for mb in needed:
+            # one stacked fold gather of the dataset-level codes per
+            # max_bins — int8 when the codes fit (4x fewer gathered
+            # bytes); training rows pad+shard 2-D over the mesh (rows
+            # on "data", folds on "model" when they divide it);
+            # validation codes stay unpadded — metrics must see real
+            # rows only
+            _, codes, _ = plan[mb]
+            if int(mb) <= 127:
+                codes = codes.astype(jnp.int8)
+            cache[mb] = (pmesh.shard_stacked_training_rows(
+                jnp.take(codes, jtr, axis=0),
+                jnp.take(yt, jtr, axis=0),
+                jnp.take(wt, jtr, axis=0))
+                + (jnp.take(codes, jva, axis=0),))
+        ev0 = self.evaluators[0]
+        fold_metrics = ev0.metric_batch_scores_folds
+        for gi, g in enumerate(tgroups):
+            lanes = g["lanes"]
+            L = len(lanes)
+            depth = g["max_depth"]
+            tk = self._treestack_key(ci, gi, k, n_tr, d, g)
+            if tk in done and len(done[tk]) == k * L:
+                # restart path: this depth-group already scored
+                self._record_treestack(per_candidate_scores, ci, lanes,
+                                       k, done[tk])
+                continue
+            if self._deadline_skip(ci, grid, deadline,
+                                   per_candidate_scores, failures,
+                                   pop=True):
+                return True
+            Xb_tr, ytr_s, wtr_s, Xb_va = cache[g["max_bins"]]
+            if "fold_means" not in cache:
+                # the folds' label means feed the host-computed per-fold
+                # base scores (bitwise parity with the loop's per-fold
+                # ``_loss_and_nout``); ONE uncounted family-level pull
+                # per sweep, shared across tree families — the analog of
+                # the loop path's per-fold lnb sync
+                cache["fold_means"] = np.asarray(jnp.stack(
+                    [jnp.mean(ytr_s[f]) for f in range(k)]))
+            cs = chunk_sizes[gi]
+            vals_kl = np.empty((k, L), np.float64)
+            try:
+                with sweep_counters.tracking(fname):
+                    for c0 in range(0, L, cs):
+                        chunk = g["params"][c0:c0 + cs]
+                        with span("sweep.tree_group", family=fname,
+                                  mode="tree_stacked", k=int(k),
+                                  lanes=len(chunk), depth=int(depth),
+                                  group=gi):
+                            # fused unit: stacked train + stacked scores
+                            # in one compiled program (no per-(fold, lane)
+                            # model materialization — the sweep discards
+                            # models; the winner refits)
+                            scores = with_device_retry(
+                                est.tree_stack_scores, Xb_tr, ytr_s,
+                                wtr_s, Xb_va, chunk, lnb,
+                                fold_means=cache["fold_means"],
+                                site="sweep.fit")
+                            # ONE host sync: metrics for every
+                            # (fold, lane) unit of the chunk in one pull
+                            vals = fold_metrics(yva_s, scores,
+                                                self.validation_metric)
+                        vals_kl[:, c0:c0 + len(chunk)] = np.asarray(vals)
+                        sweep_counters.count(
+                            fname, dispatches=1, host_syncs=1,
+                            lane_chunks=1, mode="tree_stacked")
+                sweep_counters.count(fname, stacked_groups=1)
+            except Exception as e:  # noqa: BLE001 — isolation by design
+                from transmogrifai_tpu.utils.faults import FaultHarnessError
+                if isinstance(e, FaultHarnessError):
+                    raise  # a preempted process dies; it does not isolate
+                for gj in range(len(grid)):
+                    per_candidate_scores.pop((ci, gj), None)
+                failures.append({
+                    "modelName": fname,
+                    "reason": f"tree stacked sweep (group {gi}): "
+                              f"{type(e).__name__}: {str(e)[:300]}"})
+                return True
+            flat = [float(v) for v in vals_kl.reshape(-1)]
+            self._record_treestack(per_candidate_scores, ci, lanes, k,
+                                   flat)
+            done[tk] = flat
+            self._ckpt_save(done)
+        return True
 
     def _deadline_skip(self, ci, grid, deadline, per_candidate_scores,
                        failures, pop: bool) -> bool:

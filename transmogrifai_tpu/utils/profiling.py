@@ -297,10 +297,17 @@ class _CompileAttribution:
 @dataclass
 class SweepFamilyCounters:
     """Per-candidate-family sweep observability (see ``SweepCounters``)."""
-    mode: str = ""              # "fold_stacked" | "fold_loop" | "resumed"
+    #: "fold_stacked" | "tree_stacked" | "fold_loop" | "resumed"
+    mode: str = ""
     compiles: int = 0           # XLA backend compiles while family active
     device_dispatches: int = 0  # train/score/metric program invocations
     host_syncs: int = 0         # device->host materializations (metric pulls)
+    #: tree depth-groups dispatched fold x grid-stacked (round 8): on the
+    #: tree fast path a group costs <= 1 dispatch + 1 sync per lane chunk
+    stacked_groups: int = 0
+    #: HBM-guard lane chunks dispatched (== stacked_groups unless the
+    #: guard split a too-wide group; each chunk is one dispatch + sync)
+    lane_chunks: int = 0
 
 
 class SweepCounters(_CompileAttribution):
@@ -309,9 +316,11 @@ class SweepCounters(_CompileAttribution):
 
     Dispatches/syncs are counted at the SELECTOR's call granularity (one
     ``grid_fit_arrays*`` / scoring call = one dispatch; one metric
-    ``np.asarray`` pull = one sync) — the contract the fold-stacked fast
-    path optimizes: k folds x |grid| points in one dispatch and ONE host
-    sync per family, vs k of each on the per-fold loop. Compiles come from
+    ``np.asarray`` pull = one sync) — the contract the stacked fast
+    paths optimize: k folds x |grid| points in one dispatch and ONE host
+    sync per family (linear fold-stacking), or per depth-group/lane
+    chunk (tree fold x grid stacking, ``stacked_groups``/``lane_chunks``),
+    vs k (or k x L) of each on the per-fold loop. Compiles come from
     a ``jax.monitoring`` backend-compile listener attributed to whichever
     family is active inside ``tracking()`` (0 when the monitoring API is
     unavailable; cache hits from the persistent XLA cache don't count —
@@ -332,10 +341,13 @@ class SweepCounters(_CompileAttribution):
         return self.families.setdefault(name, SweepFamilyCounters())
 
     def count(self, name: str, *, dispatches: int = 0,
-              host_syncs: int = 0, mode: Optional[str] = None) -> None:
+              host_syncs: int = 0, stacked_groups: int = 0,
+              lane_chunks: int = 0, mode: Optional[str] = None) -> None:
         fc = self.family(name)
         fc.device_dispatches += dispatches
         fc.host_syncs += host_syncs
+        fc.stacked_groups += stacked_groups
+        fc.lane_chunks += lane_chunks
         if mode is not None:
             fc.mode = mode
 
@@ -345,7 +357,9 @@ class SweepCounters(_CompileAttribution):
     def to_json(self) -> dict:
         return {name: {"mode": fc.mode, "compiles": fc.compiles,
                        "deviceDispatches": fc.device_dispatches,
-                       "hostSyncs": fc.host_syncs}
+                       "hostSyncs": fc.host_syncs,
+                       "stackedGroups": fc.stacked_groups,
+                       "laneChunks": fc.lane_chunks}
                 for name, fc in self.families.items()}
 
 

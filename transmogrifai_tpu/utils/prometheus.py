@@ -179,7 +179,11 @@ def _app_collectors(reg: PromRegistry) -> None:
                                      "family's sweep"),
                         ("device_dispatches", "sweep device program "
                                               "dispatches"),
-                        ("host_syncs", "sweep device->host metric pulls")):
+                        ("host_syncs", "sweep device->host metric pulls"),
+                        ("stacked_groups", "tree depth-groups dispatched "
+                                           "fold x grid-stacked"),
+                        ("lane_chunks", "HBM-guard lane chunks dispatched "
+                                        "on the stacked tree path")):
         reg.register(
             f"transmogrifai_sweep_{attr}_total", "counter", help_,
             lambda a=attr: [({"family": name}, getattr(fc, a))
