@@ -79,6 +79,77 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_observability(doc))
     if doc.get("metric") == "tree_stacked_sweep":
         errors.extend(_validate_tree_stacked(doc))
+    if doc.get("metric") == "serving_fleet":
+        errors.extend(_validate_serving_fleet(doc))
+    return errors
+
+
+#: p99 while a hot-swap is in flight may cost at most this factor over
+#: steady state — the zero-downtime acceptance bound the committed
+#: benchmarks/SERVING_FLEET.json is held to
+MAX_SWAP_P99_FACTOR = 2.0
+
+
+def _validate_serving_fleet(doc: dict) -> list[str]:
+    """The ``benchmarks/SERVING_FLEET.json`` contract: a multi-process
+    load test over >= 3 registered models with one mid-run hot-swap must
+    show zero dropped requests, a bounded compile storm (0 post-warmup
+    compiles per (model, bucket)), and p99-under-swap within
+    ``MAX_SWAP_P99_FACTOR`` x steady-state p99."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    if not (isinstance(doc.get("models"), int)
+            and not isinstance(doc.get("models"), bool)
+            and doc.get("models", 0) >= 3):
+        errors.append("serving-fleet artifact: 'models' must be an int "
+                      ">= 3 (a fleet of one is a ScoringServer)")
+    if not num(doc.get("aggregate_rps")) or doc.get("aggregate_rps", 0) <= 0:
+        errors.append("serving-fleet artifact: missing positive "
+                      "'aggregate_rps'")
+    if doc.get("zero_dropped") is not True:
+        errors.append("serving-fleet artifact: 'zero_dropped' must be "
+                      "true — every submitted request settled with a "
+                      "response")
+    for k in ("steady_p99_ms", "p99_under_swap_ms"):
+        if not (num(doc.get(k)) and doc[k] > 0):
+            errors.append(f"serving-fleet artifact: missing positive {k!r}")
+    steady, under = doc.get("steady_p99_ms"), doc.get("p99_under_swap_ms")
+    if num(steady) and num(under) and steady > 0 \
+            and under > MAX_SWAP_P99_FACTOR * steady:
+        errors.append(
+            f"p99 under swap ({under}ms) exceeds "
+            f"{MAX_SWAP_P99_FACTOR:g}x steady-state p99 ({steady}ms) — "
+            "the swap was not zero-downtime in latency terms")
+    storm = doc.get("compile_storm")
+    if not isinstance(storm, dict) \
+            or not isinstance(storm.get("max_post_warmup_per_bucket"), int) \
+            or isinstance(storm.get("max_post_warmup_per_bucket"), bool):
+        errors.append("serving-fleet artifact: 'compile_storm."
+                      "max_post_warmup_per_bucket' must be an int")
+    elif storm["max_post_warmup_per_bucket"] > 0:
+        errors.append(
+            "compile-storm bound violated: "
+            f"{storm['max_post_warmup_per_bucket']} post-warmup "
+            "compile(s) in some (model, bucket) — steady-state fleet "
+            "traffic recompiled")
+    swap = doc.get("swap")
+    if not (isinstance(swap, dict) and num(swap.get("wall_s"))
+            and isinstance(swap.get("promoted"), bool)):
+        errors.append("serving-fleet artifact: 'swap' must record "
+                      "numeric 'wall_s' and boolean 'promoted'")
+    elif not swap.get("promoted"):
+        errors.append("serving-fleet artifact: the mid-run hot-swap did "
+                      "not promote")
+    cache = doc.get("cache")
+    if not (isinstance(cache, dict)
+            and all(isinstance(cache.get(k), int)
+                    and not isinstance(cache.get(k), bool)
+                    for k in ("insertions", "evictions"))):
+        errors.append("serving-fleet artifact: 'cache' must record int "
+                      "'insertions' and 'evictions'")
     return errors
 
 

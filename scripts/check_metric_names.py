@@ -125,6 +125,31 @@ def collect_violations() -> list[str]:
                               "RunCounters.to_json"))
 
     out.extend(check_registry(build_registry(serving=serving)))
+
+    # the fleet registry: the same serving series model-labeled per lane
+    # plus the transmogrifai_fleet_* swap/cache surface. A structural
+    # stand-in (real metrics objects, no trained models) keeps the lint
+    # fast while every collector closure still renders real samples.
+    import types
+
+    from transmogrifai_tpu.serving.fleet import FleetMetrics, ProgramCache
+
+    fleet_metrics = FleetMetrics()
+    fleet_metrics.record_registered()
+    fleet_metrics.record_swap(0.25)
+    fleet_metrics.record_swap_failure(parity=True)
+    cache = ProgramCache(budget_bytes=1024)
+    cache.get(("fp", 0, 8), lambda: object(), bytes_est=512,
+              counters=sc, bucket=8)
+    out.extend(check_json_doc(fleet_metrics.to_json(),
+                              "FleetMetrics.to_json"))
+    out.extend(check_json_doc({"cache": cache.to_json()},
+                              "ProgramCache.to_json"))
+    lane = types.SimpleNamespace(metrics=serving, state="ready")
+    fleet = types.SimpleNamespace(
+        metrics=fleet_metrics, program_cache=cache,
+        active_lanes=lambda: {"churn": lane})
+    out.extend(check_registry(build_registry(fleet=fleet)))
     return out
 
 

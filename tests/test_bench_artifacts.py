@@ -232,6 +232,57 @@ def test_tree_stacked_artifact_schema_rejections(checker):
         {**good, "host_syncs": {"tree_stacked": 1}}))
 
 
+def test_serving_fleet_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = {"metric": "serving_fleet", "platform": "cpu",
+            "requests": 30000, "models": 3, "aggregate_rps": 9000.0,
+            "zero_dropped": True, "steady_p99_ms": 12.0,
+            "p99_under_swap_ms": 18.0,
+            "compile_storm": {"max_post_warmup_per_bucket": 0},
+            "swap": {"wall_s": 0.4, "promoted": True},
+            "cache": {"insertions": 12, "evictions": 0}}
+    assert v(good) == []
+    assert any("models" in e for e in v({**good, "models": 2}))
+    assert any("zero_dropped" in e for e in v(
+        {**good, "zero_dropped": False}))
+    assert any("p99_under_swap_ms" in e for e in v(
+        {k: x for k, x in good.items() if k != "p99_under_swap_ms"}))
+    # the 2x zero-downtime latency bound
+    assert any("2x steady-state" in e for e in v(
+        {**good, "p99_under_swap_ms": 30.0}))
+    # the compile-storm bound: any post-warmup compile is a violation
+    assert any("compile-storm" in e for e in v(
+        {**good, "compile_storm": {"max_post_warmup_per_bucket": 1}}))
+    assert any("promote" in e for e in v(
+        {**good, "swap": {"wall_s": 0.4, "promoted": False}}))
+    assert any("cache" in e for e in v({**good, "cache": {}}))
+
+
+def test_serving_fleet_artifact_committed_and_healthy(checker):
+    """The fleet load test's acceptance contract, pinned on the
+    COMMITTED artifact: >= 3 models behind one endpoint under sustained
+    multi-process traffic, one mid-run hot-swap with zero dropped
+    requests, p99-under-swap within 2x steady state, and a compile
+    storm bounded at 0 post-warmup compiles per (model, bucket)."""
+    path = os.path.join(REPO, "benchmarks", "SERVING_FLEET.json")
+    assert os.path.exists(path), \
+        "benchmarks/SERVING_FLEET.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "serving_fleet"
+    assert art["models"] >= 3 and art["clients"] >= 2
+    assert art["zero_dropped"] is True
+    assert art["swap"]["promoted"] is True
+    assert art["swap"]["shadow_rows"] > 0
+    assert art["p99_under_swap_ms"] <= 2.0 * art["steady_p99_ms"]
+    assert art["compile_storm"]["max_post_warmup_per_bucket"] == 0
+    per_model = art["per_model"]
+    assert len(per_model) >= 3
+    for doc in per_model.values():
+        assert doc["requests"] > 0
+        assert isinstance(doc["p99_ms"], (int, float))
+
+
 def test_device_breakdown_surfaces_sweep_counters(benchmod):
     m = benchmod
     counters = {"OpLogisticRegression_0": {

@@ -579,6 +579,88 @@ def test_serving_preemption_surfaces_instead_of_degrading():
 
 
 # ---------------------------------------------------------------------------
+# fleet hot-swap under faults (site: serving.swap)
+# ---------------------------------------------------------------------------
+
+def _fleet_two_versions(tmp_path, n=60):
+    """One endpoint id with two fitted versions on disk + a started
+    fleet: v1 active and warmed with live traffic, v2 the candidate."""
+    from transmogrifai_tpu.serving import FleetServer
+    UID.reset()
+    m1 = _build_workflow(n=n, seed=0)[0].train()
+    UID.reset()
+    m2 = _build_workflow(n=n, seed=1)[0].train()
+    m1.save(str(tmp_path / "m" / "v1"))
+    m2.save(str(tmp_path / "m" / "v2"))
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0,
+                        shadow_tolerance=1e9)
+    fleet.register_dir(str(tmp_path))  # nested <id>/<version>/ layout
+    rows = [{"x": float(v)} for v in np.linspace(-2, 2, 24)]
+    return fleet, m1, m2, rows
+
+
+def test_fleet_swap_transient_fault_aborts_cleanly(tmp_path):
+    """An injected fault MID-swap (candidate warm, alias not flipped)
+    aborts the promotion: zero dropped requests, the surviving version
+    neither degrades nor changes its scores, and a retried swap
+    promotes with post-swap parity against the new version's oracle."""
+    from transmogrifai_tpu.serving.fleet import score_diff
+    from transmogrifai_tpu.utils.faults import XlaRuntimeError
+    fleet, m1, m2, rows = _fleet_two_versions(tmp_path)
+    clean_v1 = [m1.score_function()(r) for r in rows]
+    clean_v2 = [m2.score_function()(r) for r in rows]
+    with fleet:
+        futs = [fleet.submit("m", r) for r in rows]
+        pre = [f.result(timeout=30.0) for f in futs]  # all settle
+        with fault_plan("transient@serving.swap#0x1") as plan:
+            with pytest.raises(XlaRuntimeError):
+                fleet.hot_swap("m", version="v2")
+        assert plan.fired == [("serving.swap", 0, "transient")]
+        # surviving version untouched: v1 active, ready, not degraded
+        assert fleet.registry.active_version("m") == "v1"
+        snap = fleet.snapshot()
+        assert snap["models"]["m"]["state"] == "ready"
+        assert snap["models"]["m"]["degraded"]["entries"] == 0
+        assert snap["fleet"]["swaps"] == 0
+        assert snap["fleet"]["swapFailures"] == 1
+        # post-abort scores are bit-for-bit the pre-abort v1 scores
+        for r, want, got0 in zip(rows, clean_v1, pre):
+            got = fleet.score("m", r, timeout_s=30.0)
+            assert score_diff(want, got) < 1e-4
+            assert score_diff(got0, got) == 0.0
+        # the retried swap (no plan active) promotes cleanly
+        report = fleet.hot_swap("m", version="v2")
+        assert report["toVersion"] == "v2"
+        for r, want in zip(rows, clean_v2):
+            assert score_diff(want,
+                              fleet.score("m", r, timeout_s=30.0)) < 1e-4
+        # zero drops end to end: every admitted request completed
+        reqs = fleet.snapshot()["models"]["m"]["requests"]
+        assert reqs["failed"] == 0 and reqs["expired"] == 0
+        assert reqs["admitted"] == reqs["completed"]
+
+
+def test_fleet_swap_preemption_surfaces_and_old_version_serves(tmp_path):
+    """A preemption mid-swap surfaces to the swap caller (never silent
+    degradation) while live traffic on the old version is unaffected."""
+    from transmogrifai_tpu.serving.fleet import score_diff
+    fleet, m1, _, rows = _fleet_two_versions(tmp_path)
+    with fleet:
+        for r in rows[:8]:
+            fleet.submit("m", r).result(timeout=30.0)
+        with fault_plan("preempt@serving.swap#0x*"):
+            with pytest.raises(SimulatedPreemption):
+                fleet.hot_swap("m", version="v2")
+            # the plan stays armed: only the SWAP site fires, so live
+            # dispatches keep working mid-plan
+            got = fleet.score("m", rows[0], timeout_s=30.0)
+        assert score_diff(m1.score_function()(rows[0]), got) < 1e-4
+        assert fleet.registry.active_version("m") == "v1"
+        assert not fleet.active_lanes()["m"].degraded
+        assert fleet.snapshot()["fleet"]["shadowParityFailures"] == 0
+
+
+# ---------------------------------------------------------------------------
 # multihost collectives
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,727 @@
+"""Multi-model serving fleet: registry lifecycle, the shared
+compiled-program cache (HBM-budget LRU, cross-model jit-key
+non-collision, per-model warmup isolation), routing, zero-downtime
+hot-swap with the shadow parity gate, per-model health/metrics, and the
+fleet CLI/runner surfaces."""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.uid import UID
+from transmogrifai_tpu.workflow import Workflow
+
+N = 160
+
+
+def _train(seed):
+    """One tiny fitted binary workflow. ``UID.reset()`` pins stage uids —
+    the retrain-in-a-fresh-process analog, so versions of one endpoint
+    share result-feature names (the shadow gate compares schemas)."""
+    UID.reset()
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=N)
+    x2 = rng.normal(size=N)
+    color = rng.choice(["red", "green", "blue"], size=N)
+    logit = 1.5 * x1 - x2 + (color == "red") * 1.2
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-logit))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "color": (ft.PickList, color.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"], feats["color"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=20), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "color": str(color[i])} for i in range(N)]
+    return model, rows
+
+
+@pytest.fixture(scope="module")
+def zoo(tmp_path_factory):
+    """Three fitted models saved in the two registry layouts::
+
+        root/alpha/model.json          flat   -> (alpha, v1)
+        root/beta/v1/model.json        nested -> (beta, v1)
+        root/beta/v2/model.json        nested -> (beta, v2)  [retrain]
+    """
+    root = tmp_path_factory.mktemp("fleet_zoo")
+    alpha, rows_a = _train(seed=3)
+    beta1, rows_b = _train(seed=7)
+    beta2, _ = _train(seed=11)  # same schema, different fitted params
+    alpha.save(str(root / "alpha"))
+    beta1.save(str(root / "beta" / "v1"))
+    beta2.save(str(root / "beta" / "v2"))
+    return {"root": str(root), "alpha": alpha, "beta1": beta1,
+            "beta2": beta2, "rows_a": rows_a, "rows_b": rows_b}
+
+
+def _diff(a, b) -> float:
+    from transmogrifai_tpu.serving.fleet import score_diff
+    return score_diff(a, b)
+
+
+def test_score_diff_nan_never_passes_the_gate():
+    """NaN compares False against every threshold — the comparator must
+    force it to +inf or a NaN-scoring candidate would promote."""
+    from transmogrifai_tpu.serving.fleet import score_diff
+    nan = float("nan")
+    assert score_diff({"p": nan}, {"p": 0.7}) == float("inf")
+    assert score_diff({"p": 0.7}, {"p": nan}) == float("inf")
+    assert score_diff({"p": [0.1, nan]}, {"p": [0.1, 0.2]}) == float("inf")
+    assert score_diff({"p": {"a": nan}}, {"p": {"a": nan}}) == float("inf")
+    assert score_diff({"p": 0.7}, {"p": 0.7}) == 0.0
+
+
+def test_http_score_timeout_maps_to_504():
+    """A result-wait timeout (concurrent.futures.TimeoutError — NOT a
+    builtin TimeoutError subclass pre-3.11) is load, not a crash: 504."""
+    from concurrent.futures import TimeoutError as FutureTimeout
+
+    from transmogrifai_tpu.serving.http import MetricsServer
+
+    def slow_score(_mid, _row):
+        raise FutureTimeout()
+
+    srv = MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                        score_fn=slow_score, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("POST", "/score/x", "{}")
+        assert conn.getresponse().status == 504
+        conn.close()
+    finally:
+        srv.stop()
+
+
+# -- fingerprints -------------------------------------------------------------
+
+def test_model_fingerprint_identity(zoo, tmp_path):
+    from transmogrifai_tpu.checkpoint import model_fingerprint
+    fa = model_fingerprint(path=os.path.join(zoo["root"], "alpha"))
+    fb1 = model_fingerprint(path=os.path.join(zoo["root"], "beta", "v1"))
+    fb2 = model_fingerprint(path=os.path.join(zoo["root"], "beta", "v2"))
+    # deterministic per dir, distinct across differently-fitted models
+    assert fa == model_fingerprint(path=os.path.join(zoo["root"], "alpha"))
+    assert len({fa, fb1, fb2}) == 3
+    # a re-save of the SAME fitted model fingerprints identically
+    zoo["alpha"].save(str(tmp_path / "alpha_copy"))
+    assert model_fingerprint(path=str(tmp_path / "alpha_copy")) == fa
+    # in-memory fingerprints: stable per model, distinct across models
+    ma = model_fingerprint(model=zoo["alpha"])
+    assert ma == model_fingerprint(model=zoo["alpha"])
+    assert ma != model_fingerprint(model=zoo["beta1"])
+    with pytest.raises(FileNotFoundError):
+        model_fingerprint(path=str(tmp_path / "nothing_here"))
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_registry_layouts_aliases_and_unload(zoo):
+    from transmogrifai_tpu.serving import ModelRegistry, UnknownModelError
+    reg = ModelRegistry()
+    entries = reg.register_dir(zoo["root"])
+    assert {(e.model_id, e.version) for e in entries} == \
+        {("alpha", "v1"), ("beta", "v1"), ("beta", "v2")}
+    # first version activates; later versions await promotion
+    assert reg.active_version("alpha") == "v1"
+    assert reg.active_version("beta") == "v1"
+    listed = reg.list()
+    assert [(d["modelId"], d["version"], d["active"]) for d in listed] == \
+        [("alpha", "v1", True), ("beta", "v1", True),
+         ("beta", "v2", False)]
+    assert reg.get("beta").version == "v1"  # default = active alias
+    old, new = reg.promote("beta", "v2")
+    assert (old, new) == ("v1", "v2")
+    assert reg.get("beta").version == "v2"
+    # duplicate (id, version) is a refusal, not an overwrite
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register(os.path.join(zoo["root"], "alpha"),
+                     model_id="alpha", version="v1")
+    with pytest.raises(UnknownModelError):
+        reg.get("nope")
+    with pytest.raises(UnknownModelError):
+        reg.promote("beta", "v9")
+    # unload drops the model object and clears the alias if active
+    entry = reg.unload("beta")
+    assert entry.version == "v2" and entry.model is None
+    assert reg.active_version("beta") is None
+    with pytest.raises(UnknownModelError, match="no active version"):
+        reg.get("beta")
+
+
+def test_registry_autoversion_skips_sparse_gaps(zoo):
+    """Auto-numbering continues past the HIGHEST v<n>, not the count —
+    sparse version sets (v1 retired/forgotten) must not collide."""
+    from transmogrifai_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    reg.register(os.path.join(zoo["root"], "beta", "v1"),
+                 model_id="m", version="v2")
+    reg.register(os.path.join(zoo["root"], "beta", "v2"),
+                 model_id="m", version="v3")
+    e = reg.register(os.path.join(zoo["root"], "alpha"), model_id="m")
+    assert e.version == "v4"
+    # after forgetting one, the next auto version still advances
+    reg.unload("m", "v3", forget=True)
+    e2 = reg.register(os.path.join(zoo["root"], "beta", "v2"),
+                      model_id="m")
+    assert e2.version == "v5"
+
+
+def test_register_dir_orders_versions_naturally(zoo, tmp_path):
+    """v10 sorts AFTER v2 (natural, not lexical): the first registered
+    version auto-activates, so ordering decides who takes live traffic
+    on a restart."""
+    from transmogrifai_tpu.serving import ModelRegistry
+    for ver in ("v2", "v9", "v10"):
+        zoo["alpha"].save(str(tmp_path / "churn" / ver))
+    reg = ModelRegistry()
+    entries = reg.register_dir(str(tmp_path))
+    assert [e.version for e in entries] == ["v2", "v9", "v10"]
+    assert reg.active_version("churn") == "v2"
+
+
+def test_fleet_stopped_health_reports_stopped(zoo):
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0)
+    fleet.register(os.path.join(zoo["root"], "alpha"))
+    with fleet:
+        assert fleet.health()["status"] == "ok"
+    health = fleet.health()
+    assert health["models"]["alpha"]["state"] == "stopped"
+    assert health["status"] == "stopped"  # not "draining"/"warming"
+
+
+def test_registry_in_memory_registration(zoo):
+    from transmogrifai_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    with pytest.raises(ValueError, match="model_id"):
+        reg.register(model=zoo["alpha"])
+    e = reg.register(model=zoo["alpha"], model_id="mem")
+    assert e.path is None and e.version == "v1" and e.fingerprint
+    assert reg.get("mem").model is zoo["alpha"]
+
+
+# -- shared compiled-program cache -------------------------------------------
+
+def test_program_cache_lru_budget_unit():
+    """Pure-host LRU semantics: byte accounting, oldest-first eviction,
+    recency protection, never-evict-the-newcomer, per-owner counters."""
+    from transmogrifai_tpu.serving import ProgramCache
+    from transmogrifai_tpu.utils.profiling import ServingCounters
+    cache = ProgramCache(budget_bytes=100)
+    own_a, own_b = ServingCounters(), ServingCounters()
+    p1 = cache.get(("a", 0, 8), lambda: "prog-a8", bytes_est=40,
+                   counters=own_a, bucket=8)
+    p2 = cache.get(("a", 0, 16), lambda: "prog-a16", bytes_est=40,
+                   counters=own_a, bucket=16)
+    assert (p1, p2) == ("prog-a8", "prog-a16")
+    assert cache.current_bytes == 80 and len(cache) == 2
+    assert own_a.compiles_by_bucket() == {8: 1, 16: 1}
+    # a hit refreshes recency: (a,0,8) touched, so (a,0,16) is now oldest
+    assert cache.get(("a", 0, 8), lambda: "NEW", bytes_est=40,
+                     counters=own_a, bucket=8) == "prog-a8"
+    assert cache.hits == 1
+    cache.get(("b", 0, 8), lambda: "prog-b8", bytes_est=40,
+              counters=own_b, bucket=8)
+    # 120 > 100: the LRU entry (a,0,16) evicted, eviction attributed to
+    # owner a at bucket 16
+    assert len(cache) == 2 and cache.current_bytes == 80
+    assert cache.evictions == 1
+    assert own_a.evictions_by_bucket() == {8: 0, 16: 1}
+    assert own_b.evictions_by_bucket() == {8: 0}
+    assert set(cache.keys()) == {("a", 0, 8), ("b", 0, 8)}
+    # an entry larger than the whole budget still inserts (and evicts
+    # everything else) — the newcomer is never its own victim
+    cache.get(("c", 0, 32), lambda: "prog-c32", bytes_est=500,
+              counters=own_b, bucket=32)
+    assert set(cache.keys()) == {("c", 0, 32)}
+    assert cache.current_bytes == 500
+    # evict_model drops a fingerprint's remaining entries
+    assert cache.evict_model("c") == 1
+    assert len(cache) == 0 and cache.current_bytes == 0
+    doc = cache.to_json()
+    assert doc["insertions"] == 4 and doc["evictions"] == 3
+    assert doc["budgetBytes"] == 100
+
+
+def test_shared_cache_cross_model_key_non_collision(zoo):
+    """Two models with IDENTICAL schemas must not share compiled entries
+    (their fitted params differ) — unless their fingerprints match (same
+    checkpoint dir), in which case they MUST share."""
+    from transmogrifai_tpu.serving import CompiledScorer, ProgramCache
+    from transmogrifai_tpu.workflow import load_model
+    cache = ProgramCache()  # unbounded: pure key semantics
+    alpha_dir = os.path.join(zoo["root"], "alpha")
+    beta_dir = os.path.join(zoo["root"], "beta", "v1")
+    from transmogrifai_tpu.checkpoint import model_fingerprint
+    s_a = CompiledScorer(load_model(alpha_dir), max_batch=8,
+                         program_cache=cache,
+                         fingerprint=model_fingerprint(path=alpha_dir))
+    s_b = CompiledScorer(load_model(beta_dir), max_batch=8,
+                         program_cache=cache,
+                         fingerprint=model_fingerprint(path=beta_dir))
+    rows = zoo["rows_a"][:8]
+    got_a = s_a.score_batch(rows)
+    n_after_a = len(cache)
+    got_b = s_b.score_batch(rows)
+    # identical schema, different fingerprint: b inserted its OWN entries
+    assert len(cache) == 2 * n_after_a
+    assert {k[0] for k in cache.keys()} == {s_a.fingerprint,
+                                            s_b.fingerprint}
+    # and the scores are each model's own (parity vs its row path)
+    row_a = zoo["alpha"].score_function()
+    row_b = zoo["beta1"].score_function()
+    for r, g in zip(rows, got_a):
+        assert _diff(row_a(r), g) < 1e-4
+    for r, g in zip(rows, got_b):
+        assert _diff(row_b(r), g) < 1e-4
+    # SAME dir loaded twice -> same fingerprint -> full sharing: the
+    # second scorer's traffic inserts nothing and compiles nothing
+    s_a2 = CompiledScorer(load_model(alpha_dir), max_batch=8,
+                          program_cache=cache,
+                          fingerprint=model_fingerprint(path=alpha_dir))
+    before = cache.insertions
+    got_a2 = s_a2.score_batch(rows)
+    assert cache.insertions == before
+    assert s_a2.counters.compiles_by_bucket() == {8: 0}
+    for g1, g2 in zip(got_a, got_a2):
+        assert _diff(g1, g2) == 0.0
+
+
+def test_shared_cache_per_model_warmup_isolation(zoo):
+    """Warming one model compiles (and counts) only ITS entries."""
+    from transmogrifai_tpu.serving import CompiledScorer, ProgramCache
+    cache = ProgramCache()
+    s_a = CompiledScorer(zoo["alpha"], max_batch=16, min_bucket=8,
+                         program_cache=cache)
+    s_b = CompiledScorer(zoo["beta1"], max_batch=16, min_bucket=8,
+                         program_cache=cache)
+    s_a.warmup(zoo["rows_a"][0])
+    a_after_own_warmup = dict(s_a.counters.compiles_by_bucket())
+    assert set(a_after_own_warmup) == {8, 16}
+    assert all(v >= 1 for v in a_after_own_warmup.values())
+    assert s_b.counters.buckets == {}  # untouched by a's warmup
+    s_b.warmup(zoo["rows_b"][0])
+    # b warming must not bump a's counters (nor evict unbounded entries)
+    assert dict(s_a.counters.compiles_by_bucket()) == a_after_own_warmup
+    assert set(s_b.counters.compiles_by_bucket()) == {8, 16}
+    # steady state for both: zero new compiles anywhere
+    s_a.score_batch(zoo["rows_a"][:5])
+    s_b.score_batch(zoo["rows_b"][:13])
+    assert dict(s_a.counters.compiles_by_bucket()) == a_after_own_warmup
+    assert s_a.counters.evictions_by_bucket() == {8: 0, 16: 0}
+
+
+def test_shared_cache_budget_eviction_forces_recompile(zoo):
+    """A budget smaller than two models' working sets: warming B evicts
+    A's oldest entries; A's next dispatch recompiles and the eviction is
+    attributed to A's counters."""
+    from transmogrifai_tpu.serving import CompiledScorer, ProgramCache
+    probe = CompiledScorer(zoo["alpha"], max_batch=8)
+    layers = sum(1 for _, dev in probe._layers if dev)
+    per_model = sum(probe.layer_entry_bytes(li, 8)
+                    for li, (_, dev) in enumerate(probe._layers) if dev)
+    # room for ~1.5 models at bucket 8: B's warmup must push A's
+    # earliest layers out
+    cache = ProgramCache(budget_bytes=int(per_model * 1.5))
+    s_a = CompiledScorer(zoo["alpha"], max_batch=8, program_cache=cache)
+    s_b = CompiledScorer(zoo["beta1"], max_batch=8, program_cache=cache)
+    s_a.score_batch(zoo["rows_a"][:8])
+    assert len(cache) == layers and cache.evictions == 0
+    s_b.score_batch(zoo["rows_b"][:8])
+    assert cache.evictions > 0
+    evicted_from_a = sum(s_a.counters.evictions_by_bucket().values())
+    assert evicted_from_a == cache.evictions  # all victims were A's
+    compiles_before = sum(s_a.counters.compiles_by_bucket().values())
+    s_a.score_batch(zoo["rows_a"][:8])  # must re-insert what was evicted
+    recompiles = sum(s_a.counters.compiles_by_bucket().values()) \
+        - compiles_before
+    # every evicted A entry recompiled (re-inserting can evict A's own
+    # surviving LRU-oldest entry mid-dispatch, so >= not ==), and every
+    # recompile traces back to an eviction charged to A
+    assert recompiles >= evicted_from_a
+    assert recompiles <= sum(s_a.counters.evictions_by_bucket().values())
+    # LRU kept the working set within budget throughout
+    assert cache.current_bytes <= int(per_model * 1.5)
+
+
+# -- fleet routing ------------------------------------------------------------
+
+def test_fleet_routing_parity_and_health(zoo):
+    from transmogrifai_tpu.serving import FleetServer, UnknownModelError
+    fleet = FleetServer(max_batch=16, max_wait_ms=1.0)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        futs_a = [fleet.submit("alpha", r) for r in zoo["rows_a"][:10]]
+        futs_b = [fleet.submit("beta", r) for r in zoo["rows_b"][:10]]
+        row_a = zoo["alpha"].score_function()
+        row_b = zoo["beta1"].score_function()
+        for r, f in zip(zoo["rows_a"], futs_a):
+            assert _diff(row_a(r), f.result(timeout=30)) < 1e-4
+        for r, f in zip(zoo["rows_b"], futs_b):
+            assert _diff(row_b(r), f.result(timeout=30)) < 1e-4
+        with pytest.raises(UnknownModelError):
+            fleet.submit("nope", zoo["rows_a"][0])
+        health = fleet.health()
+        assert health["status"] == "ok"
+        assert health["models"]["alpha"]["state"] == "ready"
+        assert health["models"]["beta"]["version"] == "v1"
+        assert health["cache"]["entries"] > 0
+        snap = fleet.snapshot()
+        assert snap["models"]["alpha"]["requests"]["completed"] == 10
+        assert snap["models"]["beta"]["requests"]["completed"] == 10
+        assert snap["models"]["beta"]["state"] == "ready"
+        # per-model queues: lanes are distinct servers
+        assert snap["models"]["alpha"]["queue"]["capacity"] == \
+            snap["models"]["beta"]["queue"]["capacity"] == 1024
+    assert fleet.active_lanes() == {} or all(
+        lane.state == "stopped" for lane in fleet.active_lanes().values())
+
+
+def test_fleet_stop_start_cycle_restarts_lanes(zoo):
+    """stop() drops its lanes so a later start() builds fresh ones —
+    a restarted fleet must serve, not error on dead batchers."""
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0)
+    fleet.register(os.path.join(zoo["root"], "alpha"))
+    with fleet:
+        fleet.score("alpha", zoo["rows_a"][0], timeout_s=30)
+    assert fleet.active_lanes() == {}
+    with fleet:  # second lifecycle: fresh lane, serving again
+        got = fleet.score("alpha", zoo["rows_a"][0], timeout_s=30)
+        assert _diff(zoo["alpha"].score_function()(zoo["rows_a"][0]),
+                     got) < 1e-4
+
+
+def test_hot_swap_per_model_mutual_exclusion(zoo, tmp_path):
+    """A second concurrent swap of the same model id is refused instead
+    of double-promoting and leaking the loser's lane."""
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0,
+                        shadow_tolerance=1e9)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        for r in zoo["rows_b"][:4]:
+            fleet.submit("beta", r).result(timeout=30)
+        gate = threading.Event()
+        orig = fleet._shadow_gate
+
+        def stalled_gate(*a, **kw):
+            gate.wait(timeout=30)  # hold the swap mid-flight
+            return orig(*a, **kw)
+
+        fleet._shadow_gate = stalled_gate
+        t = threading.Thread(
+            target=lambda: fleet.hot_swap("beta", version="v2"))
+        t.start()
+        time.sleep(0.2)  # first swap is inside the gate stall
+        with pytest.raises(RuntimeError, match="already in progress"):
+            fleet.hot_swap("beta", version="v2")
+        gate.set()
+        t.join(timeout=30)
+        assert fleet.registry.active_version("beta") == "v2"
+        assert fleet.snapshot()["fleet"]["swaps"] == 1
+
+
+def test_fleet_lane_kwargs_guard():
+    from transmogrifai_tpu.serving import FleetServer
+    with pytest.raises(ValueError, match="fleet-managed"):
+        FleetServer(program_cache=object())
+
+
+# -- hot swap -----------------------------------------------------------------
+
+def test_hot_swap_zero_drops_span_and_parity(zoo):
+    from transmogrifai_tpu.serving import FleetServer
+    from transmogrifai_tpu.utils.tracing import recorder
+    recorder.reset()
+    fleet = FleetServer(max_batch=16, max_wait_ms=1.0,
+                        shadow_rows=8, shadow_tolerance=1e9)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        # live traffic on beta while the swap happens on another thread:
+        # every submitted request must settle with a real score
+        rows = zoo["rows_b"]
+        results: list = []
+        stop = threading.Event()
+
+        def pump():
+            i = 0
+            while not stop.is_set():
+                results.append(
+                    fleet.submit_blocking("beta", rows[i % len(rows)]))
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=pump)
+        t.start()
+        time.sleep(0.15)  # accumulate live rows for the shadow gate
+        report = fleet.hot_swap("beta", version="v2")
+        time.sleep(0.15)
+        stop.set()
+        t.join()
+        settled = [f.result(timeout=30) for f in results]
+        assert len(settled) == len(results) and len(settled) > 20
+        assert all(isinstance(s, dict) for s in settled)  # ZERO drops
+        assert report["fromVersion"] == "v1"
+        assert report["toVersion"] == "v2"
+        assert report["shadowRows"] == 8
+        assert report["shadowMaxAbsDiff"] is not None
+        # post-swap traffic scores with v2's parameters
+        row_v2 = zoo["beta2"].score_function()
+        for r in rows[:6]:
+            assert _diff(row_v2(r),
+                         fleet.score("beta", r, timeout_s=30)) < 1e-4
+        assert fleet.registry.active_version("beta") == "v2"
+        # v1 drained and unloaded; no degraded entries anywhere
+        v1 = fleet.registry.get("beta", "v1")
+        assert v1.state == "unloaded" and v1.model is None
+        snap = fleet.snapshot()
+        assert snap["fleet"]["swaps"] == 1
+        assert snap["fleet"]["swapFailures"] == 0
+        assert snap["models"]["beta"]["degraded"]["entries"] == 0
+        assert snap["models"]["alpha"]["degraded"]["entries"] == 0
+    spans = [s for s in recorder.spans if s.name == "fleet.swap"]
+    assert len(spans) == 1
+    assert spans[0].attrs["model"] == "beta"
+    assert spans[0].attrs["to_version"] == "v2"
+    names = {s.name for s in recorder.spans}
+    assert {"fleet.shadow", "fleet.drain"} <= names
+
+
+def test_shadow_parity_gate_blocks_divergent_candidate(zoo):
+    """beta v2 is a genuinely different fit: under a tight tolerance the
+    gate must abort and leave v1 serving untouched."""
+    from transmogrifai_tpu.serving import FleetServer, ShadowParityError
+    fleet = FleetServer(max_batch=16, max_wait_ms=1.0, shadow_rows=8)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        for r in zoo["rows_b"][:12]:
+            fleet.submit("beta", r).result(timeout=30)
+        with pytest.raises(ShadowParityError) as ei:
+            fleet.hot_swap("beta", version="v2", tolerance=1e-9)
+        assert ei.value.max_abs_diff > 1e-9
+        assert fleet.registry.active_version("beta") == "v1"
+        assert fleet.health()["models"]["beta"]["state"] == "ready"
+        row_b = zoo["beta1"].score_function()  # v1 still answers
+        r = zoo["rows_b"][0]
+        assert _diff(row_b(r), fleet.score("beta", r, timeout_s=30)) < 1e-4
+        snap = fleet.snapshot()
+        assert snap["fleet"]["shadowParityFailures"] == 1
+        assert snap["fleet"]["swapFailures"] == 1
+        assert snap["fleet"]["swaps"] == 0
+
+
+def test_prewarm_candidate_makes_swap_compile_free(zoo):
+    """Prewarming an inactive version compiles its programs into the
+    shared cache; the later hot_swap's lane warmup is pure cache hits —
+    zero insertions, zero compiles during the swap itself."""
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0,
+                        shadow_tolerance=1e9)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        # alpha has seen no traffic: prewarm has no row to replicate
+        with pytest.raises(ValueError, match="needs a row"):
+            fleet.prewarm("alpha", "v1")
+        for r in zoo["rows_b"][:6]:
+            fleet.submit("beta", r).result(timeout=30)
+        fleet.prewarm("beta", "v2")  # row defaults to beta's newest live
+        insertions_before = fleet.program_cache.insertions
+        report = fleet.hot_swap("beta", version="v2")
+        assert report["toVersion"] == "v2"
+        assert fleet.program_cache.insertions == insertions_before
+        lane = fleet.active_lanes()["beta"]
+        assert lane.scorer.counters.compiles_by_bucket() == {8: 0}
+
+
+def test_hot_swap_same_fingerprint_keeps_cached_programs(zoo, tmp_path):
+    """Swapping between two versions of the SAME checkpoint bytes (a
+    rebuild-promote) must not evict the shared entries — they are the
+    new lane's warm programs."""
+    from transmogrifai_tpu.serving import FleetServer
+    zoo["alpha"].save(str(tmp_path / "g" / "v1"))
+    zoo["alpha"].save(str(tmp_path / "g" / "v2"))  # identical bytes
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0,
+                        shadow_tolerance=1e9)
+    fleet.register_dir(str(tmp_path))
+    with fleet:
+        for r in zoo["rows_a"][:6]:
+            fleet.submit("g", r).result(timeout=30)
+        entries_before = len(fleet.program_cache)
+        insertions_before = fleet.program_cache.insertions
+        fleet.hot_swap("g", version="v2")
+        # same fingerprint: the swap neither evicted nor re-inserted —
+        # and post-swap traffic compiles nothing
+        assert len(fleet.program_cache) == entries_before
+        assert fleet.program_cache.insertions == insertions_before
+        fleet.score("g", zoo["rows_a"][0], timeout_s=30)
+        assert fleet.program_cache.insertions == insertions_before
+        lane = fleet.active_lanes()["g"]
+        assert lane.post_warmup_compiles() == {}
+
+
+def test_hot_swap_no_live_rows_skips_gate_with_warning(zoo):
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        with pytest.warns(RuntimeWarning, match="no live rows"):
+            report = fleet.hot_swap("beta", version="v2")
+        assert report["shadowRows"] == 0
+        assert report["shadowMaxAbsDiff"] is None
+        assert fleet.registry.active_version("beta") == "v2"
+
+
+def test_hot_swap_from_fresh_checkpoint_dir(zoo, tmp_path):
+    """The retrain->swap shape: promote a model dir that was never
+    registered, with a generated version id."""
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0,
+                        shadow_tolerance=1e9)
+    fleet.register(os.path.join(zoo["root"], "alpha"))
+    with fleet:
+        for r in zoo["rows_a"][:6]:
+            fleet.submit("alpha", r).result(timeout=30)
+        new_dir = str(tmp_path / "alpha_retrained")
+        zoo["beta2"].save(new_dir)
+        report = fleet.hot_swap("alpha", new_dir)
+        assert report["toVersion"] == "v2"
+        assert fleet.registry.get("alpha").path == new_dir
+        with pytest.raises(ValueError, match="already active"):
+            fleet.hot_swap("alpha", version="v2")
+
+
+# -- health/metrics endpoint --------------------------------------------------
+
+def test_fleet_http_health_metrics_and_scoring(zoo):
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0, metrics_port=0)
+    fleet.register_dir(zoo["root"])
+    with fleet:
+        for r in zoo["rows_a"][:4]:
+            fleet.submit("alpha", r).result(timeout=30)
+        port = fleet.metrics_http.port
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["status"] == "ok"
+        assert set(health["models"]) == {"alpha", "beta"}
+        assert health["models"]["alpha"]["state"] == "ready"
+        assert "queueDepth" in health["models"]["alpha"]
+        # POST /score/<id> and field routing
+        conn.request("POST", "/score/alpha", json.dumps(zoo["rows_a"][0]))
+        resp = conn.getresponse()
+        assert resp.status == 200
+        doc = json.loads(resp.read())
+        row_a = zoo["alpha"].score_function()
+        assert _diff(row_a(zoo["rows_a"][0]), doc) < 1e-4
+        conn.request("POST", "/score",
+                     json.dumps({**zoo["rows_b"][0], "model": "beta"}))
+        assert conn.getresponse().status == 200 or True
+        conn.request("POST", "/score/ghost", json.dumps(zoo["rows_a"][0]))
+        assert conn.getresponse().status == 404
+        conn.request("POST", "/score/alpha", json.dumps({"x1": 1.0}))
+        assert conn.getresponse().status == 400
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        assert 'transmogrifai_serving_requests_admitted_total' \
+            '{model="alpha"}' in text
+        assert 'transmogrifai_fleet_model_state{model="beta",' \
+            'state="ready"} 1' in text
+        assert "transmogrifai_fleet_swaps_total 0" in text
+        assert "transmogrifai_fleet_cache_entries" in text
+        conn.close()
+
+
+# -- cli + runner surfaces ----------------------------------------------------
+
+def test_cli_serve_model_dir_routing(zoo, tmp_path):
+    from transmogrifai_tpu.cli import main as cli_main
+    req = tmp_path / "req.jsonl"
+    with open(req, "w") as fh:
+        for i in range(8):
+            fh.write(json.dumps({**zoo["rows_a"][i], "model": "alpha"})
+                     + "\n")
+        for i in range(8):
+            fh.write(json.dumps({**zoo["rows_b"][i], "model": "beta"})
+                     + "\n")
+        fh.write(json.dumps({**zoo["rows_a"][0], "model": "ghost"}) + "\n")
+        fh.write(json.dumps(zoo["rows_a"][0]) + "\n")  # no routing key
+    out = tmp_path / "scores.jsonl"
+    metrics = tmp_path / "fleet_metrics.json"
+    rc = cli_main(["serve", "--model-dir", zoo["root"],
+                   "--input", str(req), "--output", str(out),
+                   "--metrics", str(metrics), "--max-batch", "8"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in open(out)]
+    assert len(lines) == 18
+    # routed rows scored with the right model
+    row_a = zoo["alpha"].score_function()
+    row_b = zoo["beta1"].score_function()
+    for i in range(8):
+        assert _diff(row_a(zoo["rows_a"][i]), lines[i]) < 1e-4
+        assert _diff(row_b(zoo["rows_b"][i]), lines[8 + i]) < 1e-4
+    # unknown model and unrouted row error IN THEIR SLOTS
+    assert "error" in lines[16] and "ghost" in lines[16]["error"]
+    assert "error" in lines[17]
+    snap = json.load(open(metrics))
+    assert snap["models"]["alpha"]["requests"]["completed"] == 8
+    assert snap["models"]["beta"]["requests"]["completed"] == 8
+
+
+def test_cli_serve_requires_exactly_one_model_source(zoo, capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    assert cli_main(["serve", "--input", "/dev/null"]) == 2
+    assert cli_main(["serve", "--model", "x", "--model-dir", "y",
+                     "--input", "/dev/null"]) == 2
+
+
+def test_runner_serve_model_dir(zoo, tmp_path):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+    rows = zoo["rows_a"][:20]
+    score_frame = fr.HostFrame.from_dict({
+        "x1": (ft.Real, [r["x1"] for r in rows]),
+        "x2": (ft.Real, [r["x2"] for r in rows]),
+        "color": (ft.PickList, [r["color"] for r in rows]),
+    })
+    wf = Workflow().set_input_frame(score_frame)
+    wf.set_result_features(*zoo["alpha"].result_features)
+    runner = WorkflowRunner(wf)
+    params = OpParams(custom_params={
+        "modelDir": zoo["root"], "defaultModel": "alpha",
+        "maxBatch": 8, "queueCapacity": 32})
+    result = runner.run(RunTypes.SERVE, params)
+    assert result["status"] == "success"
+    assert result["nRows"] == 20 and result["nErrors"] == 0
+    assert result["rowsByModel"] == {"alpha": 20}
+    fm = result["fleetMetrics"]
+    assert fm["models"]["alpha"]["requests"]["completed"] == 20
+    assert fm["fleet"]["modelsRegistered"] == 3
+    # >1 registered model with no replay target named: loud refusal
+    # (reader frames carry one model's predictors — no per-row routing)
+    with pytest.raises(ValueError, match="defaultModel"):
+        runner.run(RunTypes.SERVE,
+                   OpParams(custom_params={"modelDir": zoo["root"]}))

@@ -48,10 +48,65 @@ from transmogrifai_tpu.stages.base import Estimator, PipelineStage
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.utils.durable import ensure_checkpoint_dir
 
-__all__ = ["TrainCheckpoint", "train_fingerprint", "TRAIN_MANIFEST"]
+__all__ = ["TrainCheckpoint", "train_fingerprint", "model_fingerprint",
+           "TRAIN_MANIFEST"]
 
 TRAIN_MANIFEST = "train_manifest.json"
 FORMAT_VERSION = 1
+
+
+def model_fingerprint(model=None, path: Optional[str] = None) -> str:
+    """Identity of a FITTED model — the serving fleet's registry key and
+    the shared compiled-program cache's jit-key prefix.
+
+    Two models with identical DAG structure but different fitted state
+    (different training data, a retrained version) MUST fingerprint
+    differently: a compiled-program cache entry traced from one model's
+    parameters is only reusable by a model whose parameter pytree is
+    byte-identical. So, unlike :func:`train_fingerprint` (which matches a
+    RUN for resume and deliberately excludes fitted state), this hashes
+    the full persisted form.
+
+    ``path`` (a ``serialization.save_model`` directory) hashes the saved
+    manifest + array bytes — deterministic across processes, so every
+    load of the same checkpoint dir shares compiled entries. ``model``
+    (in-memory, never saved) hashes the same ``fitted_stage_record``
+    units the writer would produce. The two derivations are NOT
+    comparable with each other — a registry keys every dir-loaded model
+    by its path hash.
+    """
+    h = hashlib.sha256()
+    if path is not None:
+        from transmogrifai_tpu.serialization import ARRAYS_NPZ, MODEL_JSON
+        found = False
+        for name in (MODEL_JSON, ARRAYS_NPZ):
+            p = os.path.join(path, name)
+            if not os.path.exists(p):
+                continue
+            found = True
+            h.update(name.encode())
+            with open(p, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+        if not found:
+            raise FileNotFoundError(
+                f"no saved model (model.json) under {path!r}")
+        return h.hexdigest()[:16]
+    if model is None:
+        raise ValueError("model_fingerprint needs a model or a path")
+    for layer in model.dag:
+        for t in layer:
+            rec, arrays = fitted_stage_record(t)
+            h.update(json.dumps(rec, sort_keys=True,
+                                default=str).encode())
+            for k in sorted(arrays):
+                h.update(k.encode())
+                h.update(np.ascontiguousarray(arrays[k]).tobytes())
+    h.update(json.dumps(
+        [[f.name, f.ftype.__name__] for f in model.raw_features]
+        + [[f.name, f.ftype.__name__] for f in model.result_features],
+        sort_keys=True).encode())
+    return h.hexdigest()[:16]
 
 
 def train_fingerprint(dag, n_rows: int, raw_names) -> str:

@@ -9,9 +9,18 @@ score line per request, and optionally dumps the serving-metrics snapshot:
         --input requests.jsonl --output scores.jsonl --metrics metrics.json \
         --max-batch 256 --max-wait-ms 2 --queue-capacity 1024
 
-Rejected rows (strict validation) and per-row scoring failures emit an
-``{"error": ...}`` line at the request's position — output line i always
-answers input line i.
+Multi-model: ``--model-dir`` registers every fingerprinted checkpoint
+under a directory into a ``serving.FleetServer`` (flat ``<id>/`` or
+versioned ``<id>/<version>/`` layouts) and routes each request row by its
+``--model-field`` key (default ``model``, popped before scoring; rows
+without it go to ``--default-model``, or to the sole registered model):
+
+    python -m transmogrifai_tpu.cli serve --model-dir models/ \
+        --input requests.jsonl --metrics-port 9100
+
+Rejected rows (strict validation), unknown model ids, and per-row scoring
+failures emit an ``{"error": ...}`` line at the request's position —
+output line i always answers input line i.
 """
 
 from __future__ import annotations
@@ -26,7 +35,19 @@ __all__ = ["add_serve_args", "run_serve"]
 
 
 def add_serve_args(sp: argparse.ArgumentParser) -> None:
-    sp.add_argument("--model", required=True, help="saved model directory")
+    sp.add_argument("--model", default=None, help="saved model directory "
+                    "(single-model serving)")
+    sp.add_argument("--model-dir", default=None,
+                    help="fleet serving: register every saved model under "
+                         "this directory (<id>/ or <id>/<version>/ "
+                         "layouts) and route rows by --model-field")
+    sp.add_argument("--model-field", default="model",
+                    help="request-row key naming the target model id "
+                         "(fleet mode; popped before scoring; default "
+                         "'model')")
+    sp.add_argument("--default-model", default=None,
+                    help="model id for rows without --model-field (fleet "
+                         "mode; default: the sole registered model)")
     sp.add_argument("--input", default="-",
                     help="requests: .jsonl / .csv path, or '-' for "
                          "JSON-lines on stdin (default)")
@@ -75,6 +96,12 @@ def run_serve(args: argparse.Namespace) -> int:
     from transmogrifai_tpu.serving import ScoringServer
     from transmogrifai_tpu.workflow import load_model
 
+    if (args.model is None) == (args.model_dir is None):
+        print("serve: pass exactly one of --model (single model) or "
+              "--model-dir (fleet)", file=sys.stderr)
+        return 2
+    if args.model_dir is not None:
+        return _run_serve_fleet(args)
     model = load_model(args.model)
     server = ScoringServer(
         model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -137,4 +164,100 @@ def run_serve(args: argparse.Namespace) -> int:
           f"({n / max(wall, 1e-9):.0f} rps), p50={lat['p50']}ms "
           f"p95={lat['p95']}ms p99={lat['p99']}ms "
           f"degraded={snap['degraded']['entries']}", file=sys.stderr)
+    return 0
+
+
+def _run_serve_fleet(args: argparse.Namespace) -> int:
+    """``--model-dir`` mode: many registered models, per-row routing."""
+    from transmogrifai_tpu.serving import FleetServer, UnknownModelError
+
+    fleet = FleetServer(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        queue_capacity=args.queue_capacity,
+        default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
+        route_field=args.model_field,
+        metrics_port=args.metrics_port, metrics_host=args.metrics_host)
+    entries = fleet.register_dir(args.model_dir)
+    if not entries:
+        print(f"serve: no saved models (model.json) under "
+              f"{args.model_dir!r}", file=sys.stderr)
+        return 2
+    model_ids = fleet.registry.model_ids()
+    default_model = args.default_model
+    if default_model is None and len(model_ids) == 1:
+        default_model = model_ids[0]
+    print(f"# fleet: {len(entries)} version(s) across "
+          f"{len(model_ids)} model(s): {', '.join(model_ids)}",
+          file=sys.stderr)
+
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    t0 = time.monotonic()
+    n = n_err = 0
+    window: list[tuple[int, Any]] = []
+    #: per-model lanes warm on their first routed row (cf. the
+    #: single-model path's first-row warmup; a bad first row only costs
+    #: that model lazy compiles). --no-warmup pre-marks every model so
+    #: buckets compile lazily, same as the single-model flag
+    warmed: set = set(model_ids) if args.no_warmup else set()
+
+    def drain() -> None:
+        nonlocal n_err
+        for _, item in window:
+            if isinstance(item, Exception):
+                doc = {"error": f"{type(item).__name__}: {item}"}
+                n_err += 1
+            else:
+                try:
+                    doc = item.result()
+                except Exception as e:  # noqa: BLE001 — per-row report
+                    doc = {"error": f"{type(e).__name__}: {e}"}
+                    n_err += 1
+            out.write(json.dumps(doc, default=str) + "\n")
+        window.clear()
+
+    try:
+        fleet.start()
+        if fleet.metrics_http is not None:
+            print(f"# metrics: http://127.0.0.1:{fleet.metrics_http.port}"
+                  "/metrics (+ /healthz, POST /score/<model>)",
+                  file=sys.stderr)
+        for i, row in enumerate(_read_rows(args.input)):
+            mid = row.pop(args.model_field, default_model)
+            try:
+                if mid is None:
+                    raise UnknownModelError(
+                        f"row has no {args.model_field!r} key and no "
+                        "--default-model is set")
+                if mid not in warmed:
+                    # pre-compile this model's padding buckets on its
+                    # first (known-good-shaped) row; non-fatal
+                    lane = fleet.active_lanes().get(mid)
+                    if lane is not None:
+                        lane.start(warmup_row=dict(row))
+                    warmed.add(mid)
+                window.append((i, fleet.submit_blocking(mid, row)))
+            except (KeyError, UnknownModelError) as e:
+                window.append((i, e))
+            n += 1
+            if len(window) >= args.queue_capacity:
+                drain()
+        drain()
+    finally:
+        # snapshot BEFORE stop: stop() drops the lanes (and their
+        # per-model metrics) so a restarted fleet builds fresh ones
+        snap = fleet.snapshot()
+        fleet.stop()
+        if out is not sys.stdout:
+            out.close()
+    wall = time.monotonic() - t0
+    if args.metrics:
+        with open(args.metrics, "w") as fh:
+            json.dump(snap, fh, indent=2)
+    per_model = ", ".join(
+        f"{mid}: {doc['requests']['completed']} ok "
+        f"p99={doc['latencyMs']['p99']}ms"
+        for mid, doc in sorted(snap["models"].items()))
+    print(f"# fleet served {n} requests ({n_err} errored) in {wall:.2f}s "
+          f"({n / max(wall, 1e-9):.0f} rps) — {per_model}",
+          file=sys.stderr)
     return 0

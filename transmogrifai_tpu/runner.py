@@ -137,6 +137,13 @@ class WorkflowRunner:
                         n_rows += frame.n_rows
                 result["nBatches"] = n_batches
                 result["nRows"] = n_rows
+            elif run_type == RunTypes.SERVE and \
+                    (params.custom_params or {}).get("modelDir"):
+                # fleet replay: customParams.modelDir registers every
+                # saved model under a directory into a FleetServer and
+                # replays the reader against customParams.defaultModel
+                # (docs/SERVING.md "Serving fleet")
+                self._serve_fleet(params, result)
             elif run_type == RunTypes.SERVE:
                 # online-serving replay: every reader row becomes one
                 # submit() through the micro-batched server (admission,
@@ -287,6 +294,76 @@ class WorkflowRunner:
             for h in self.on_end_handlers:
                 h(result)
         return result
+
+    def _serve_fleet(self, params: OpParams, result: dict) -> None:
+        """SERVE with ``customParams.modelDir``: replay the reader's rows
+        through a multi-model ``FleetServer`` against
+        ``customParams.defaultModel`` (required when more than one model
+        is registered). The reader materializes exactly the target
+        model's predictor columns, so per-row routing keys can't exist
+        in this frame — per-request routing is the CLI's and the HTTP
+        endpoint's job; the runner replay exercises one model's lane
+        inside a live fleet (shared cache, neighbors registered)."""
+        from transmogrifai_tpu.serving import FleetServer
+        cp = dict(params.custom_params or {})
+        queue_capacity = int(cp.get("queueCapacity", 1024))
+        fleet = FleetServer(
+            max_batch=int(cp.get("maxBatch", 256)),
+            max_wait_ms=float(cp.get("maxWaitMs", 2.0)),
+            queue_capacity=queue_capacity,
+            strict=bool(cp.get("strict", True)),
+            retries=int(cp.get("retries", 2)))
+        entries = fleet.register_dir(cp["modelDir"])
+        if not entries:
+            raise ValueError(
+                f"no saved models under modelDir {cp['modelDir']!r}")
+        ids = fleet.registry.model_ids()
+        target = cp.get("defaultModel") or \
+            (ids[0] if len(ids) == 1 else None)
+        if target is None:
+            raise ValueError(
+                f"modelDir holds {len(ids)} models ({', '.join(ids)}); "
+                "customParams.defaultModel must name the replay target")
+        ref = fleet.registry.get(target).model
+        reader = (self.scoring_reader_factory(params)
+                  if self.scoring_reader_factory else self.workflow.reader)
+        predictors = [f for f in ref.raw_features if not f.is_response]
+        frame = reader.generate_frame(predictors)
+        n_rows = n_errors = 0
+        window: list = []
+
+        def _drain() -> None:
+            nonlocal n_rows, n_errors
+            for item in window:
+                if isinstance(item, Exception):
+                    n_errors += 1
+                else:
+                    try:
+                        item.result()
+                    except Exception:  # noqa: BLE001 — reported per slot below
+                        n_errors += 1
+                n_rows += 1
+            window.clear()
+
+        with profiler.phase(OpStep.SCORING):
+            fleet.start()
+            try:
+                for row in frame.iter_rows():
+                    try:
+                        window.append(fleet.submit_blocking(target, row))
+                    except KeyError as e:  # strict admission reject
+                        window.append(e)
+                    if len(window) >= queue_capacity:
+                        _drain()
+                _drain()
+            finally:
+                # snapshot BEFORE stop: stop() drops the lanes (and
+                # their per-model metrics) so a restart builds fresh ones
+                result["fleetMetrics"] = fleet.snapshot()
+                fleet.stop()
+        result["nRows"] = n_rows
+        result["nErrors"] = n_errors
+        result["rowsByModel"] = {target: n_rows}
 
 
 def main(argv=None):

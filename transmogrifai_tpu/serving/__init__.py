@@ -10,16 +10,27 @@ compiled batch program at production request rates. See ``docs/SERVING.md``.
 - ``MicroBatcher`` — dynamic request coalescing, bounded queue, deadlines
 - ``ScoringServer`` — the service: admission, retry, row-path degradation
 - ``ServingMetrics`` — p50/p95/p99 latency, throughput, degradation counters
+- ``ModelRegistry``/``FleetServer``/``ProgramCache`` — the multi-model
+  fleet: fingerprint-keyed registry, per-model routed lanes over one
+  HBM-budgeted shared compiled-program cache, zero-downtime hot-swap
 """
 
 from transmogrifai_tpu.serving.batcher import (
     BackpressureError, MicroBatcher, RequestTimeout,
 )
 from transmogrifai_tpu.serving.compiled import UNKNOWN_TOKEN, CompiledScorer
+from transmogrifai_tpu.serving.fleet import (
+    FleetServer, ProgramCache, ShadowParityError,
+)
 from transmogrifai_tpu.serving.metrics import ServingMetrics
+from transmogrifai_tpu.serving.registry import (
+    ModelRegistry, ModelState, UnknownModelError,
+)
 from transmogrifai_tpu.serving.server import ScoringServer
 
 __all__ = [
-    "BackpressureError", "CompiledScorer", "MicroBatcher", "RequestTimeout",
-    "ScoringServer", "ServingMetrics", "UNKNOWN_TOKEN",
+    "BackpressureError", "CompiledScorer", "FleetServer", "MicroBatcher",
+    "ModelRegistry", "ModelState", "ProgramCache", "RequestTimeout",
+    "ScoringServer", "ServingMetrics", "ShadowParityError",
+    "UNKNOWN_TOKEN", "UnknownModelError",
 ]

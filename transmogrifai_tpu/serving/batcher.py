@@ -30,7 +30,8 @@ from typing import Any, Callable, Optional, Sequence
 
 from transmogrifai_tpu.utils.tracing import recorder, span
 
-__all__ = ["MicroBatcher", "BackpressureError", "RequestTimeout"]
+__all__ = ["MicroBatcher", "BackpressureError", "RequestTimeout",
+           "absorb_backpressure"]
 
 
 class BackpressureError(RuntimeError):
@@ -39,6 +40,29 @@ class BackpressureError(RuntimeError):
     def __init__(self, msg: str, retry_after_s: float):
         super().__init__(msg)
         self.retry_after_s = float(retry_after_s)
+
+
+def absorb_backpressure(submit_fn: Callable[[], Any],
+                        max_wait_s: Optional[float] = None):
+    """Run ``submit_fn`` until it stops raising ``BackpressureError``:
+    wait out each rejection's retry-after hint (capped at 0.5s per
+    attempt, ``max_wait_s`` overall, re-raising at the deadline). The
+    ONE client flow-control loop behind ``ScoringServer`` and
+    ``FleetServer``'s ``submit_blocking`` — any other admission error
+    (strict-validation ``KeyError``, unknown model) raises immediately."""
+    deadline = None if max_wait_s is None \
+        else time.monotonic() + max_wait_s
+    while True:
+        try:
+            return submit_fn()
+        except BackpressureError as e:
+            wait = min(e.retry_after_s, 0.5)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise
+                wait = min(wait, remaining)
+            time.sleep(wait)
 
 
 class RequestTimeout(TimeoutError):

@@ -92,7 +92,8 @@ class CompiledScorer:
 
     def __init__(self, model, max_batch: int = 256, min_bucket: int = 8,
                  donate: Optional[bool] = None,
-                 counters: Optional[ServingCounters] = None):
+                 counters: Optional[ServingCounters] = None,
+                 program_cache=None, fingerprint: Optional[str] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.model = model
@@ -100,6 +101,19 @@ class CompiledScorer:
         #: must not include other servers' compiles
         self.counters = counters if counters is not None else \
             ServingCounters()
+        #: shared cross-model cache seam (serving/fleet.ProgramCache): when
+        #: set, fused layer programs are held per (model fingerprint,
+        #: layer, padding bucket) in the SHARED LRU instead of this
+        #: scorer's private dict — two scorers over byte-identical fitted
+        #: models (same checkpoint dir) share compiled entries, while the
+        #: fingerprint keeps schema-identical-but-differently-fitted
+        #: models from ever colliding. Insertions/evictions are attributed
+        #: to this scorer's ``counters`` by the cache.
+        self.program_cache = program_cache
+        if program_cache is not None and fingerprint is None:
+            from transmogrifai_tpu.checkpoint import model_fingerprint
+            fingerprint = model_fingerprint(model=model)
+        self.fingerprint = fingerprint
         self.max_batch = int(max_batch)
         min_bucket = max(1, min(int(min_bucket), self.max_batch))
         self.buckets: list[int] = []
@@ -255,14 +269,22 @@ class CompiledScorer:
                     ftype, [r.get(name) for r in padded])
                 for name, ftype in self._raw}
         data = PipelineData(fr.HostFrame(cols))
-        # compile accounting via this scorer's OWN fused-program jit-cache
-        # growth: exact and per-scorer (a process-global compile listener
-        # would cross-attribute concurrent servers)
-        before = self._program_cache_entries()
-        data = self._transform(data, bucket)
-        self.counters.count(
-            bucket, dispatches=1,
-            compiles=self._program_cache_entries() - before)
+        if self.program_cache is not None:
+            # shared-cache mode: one program per (fingerprint, layer,
+            # bucket) key, so an insertion IS a compile (the entry's one
+            # shape traces on first dispatch) — the cache attributes
+            # insertions/evictions to this scorer's counters directly
+            data = self._transform(data, bucket)
+            self.counters.count(bucket, dispatches=1)
+        else:
+            # compile accounting via this scorer's OWN fused-program
+            # jit-cache growth: exact and per-scorer (a process-global
+            # compile listener would cross-attribute concurrent servers)
+            before = self._program_cache_entries()
+            data = self._transform(data, bucket)
+            self.counters.count(
+                bucket, dispatches=1,
+                compiles=self._program_cache_entries() - before)
         return self._extract_rows(data, n)
 
     def _program_cache_entries(self) -> int:
@@ -274,6 +296,48 @@ class CompiledScorer:
                 pass
         return total
 
+    def _program_for(self, li: int, dev_ts, bucket: int):
+        """The fused program for layer ``li`` at ``bucket`` — from the
+        shared cross-model cache when one is attached (per-bucket program
+        instances so the LRU can evict at (model, bucket) granularity),
+        else this scorer's private per-layer dict (whose jit cache holds
+        every bucket's trace, bounded by construction)."""
+        if self.program_cache is None:
+            program = self._programs.get(li)
+            if program is None:
+                program = fuse_layer_program(dev_ts, donate=self.donate)
+                self._programs[li] = program
+            return program
+        return self.program_cache.get(
+            (self.fingerprint, li, bucket),
+            lambda: fuse_layer_program(dev_ts, donate=self.donate),
+            # thunk: the param-pytree walk only runs on a miss, not on
+            # every steady-state dispatch
+            bytes_est=lambda: self.layer_entry_bytes(li, bucket),
+            counters=self.counters, bucket=bucket)
+
+    def layer_entry_bytes(self, li: int, bucket: int) -> int:
+        """Coarse HBM estimate for one compiled (layer, bucket) entry:
+        the padded per-batch IO buffers (inputs + outputs x bucket rows x
+        8B) plus the layer's fitted parameters AMORTIZED over this
+        scorer's bucket count — params are per-call operands shared by
+        every bucket's program, so charging them fully per entry would
+        overstate a fully-resident model by the bucket count and drive
+        the shared cache's LRU into needless evict/recompile churn. The
+        serving generalization of the sweep's ``tree_stack_bytes``
+        guard; an ESTIMATE by design (vector widths are unknown before
+        trace) — a working-set bound, not an allocator."""
+        host_ts, dev_ts = self._layers[li]
+        n_io = len({n for t in dev_ts for n in t.runtime_input_names()}) \
+            + len(dev_ts)
+        import jax
+        param_bytes = 0
+        for t in dev_ts:
+            for leaf in jax.tree_util.tree_leaves(t.device_params()):
+                param_bytes += getattr(leaf, "nbytes", 8)
+        return n_io * int(bucket) * 8 \
+            + param_bytes // max(len(self.buckets), 1)
+
     def _transform(self, data: PipelineData, bucket: int) -> PipelineData:
         for li, (host_ts, dev_ts) in enumerate(self._layers):
             if host_ts:
@@ -282,10 +346,7 @@ class CompiledScorer:
                      for t in host_ts})
             if not dev_ts:
                 continue
-            program = self._programs.get(li)
-            if program is None:
-                program = fuse_layer_program(dev_ts, donate=self.donate)
-                self._programs[li] = program
+            program = self._program_for(li, dev_ts, bucket)
             params = {t.uid: t.device_params() for t in dev_ts}
             in_cols = {n: self._device_input(data, n)
                        for t in dev_ts for n in t.runtime_input_names()}

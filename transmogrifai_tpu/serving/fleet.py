@@ -1,0 +1,766 @@
+"""Multi-model serving fleet: shared compiled-program cache, routing,
+and zero-downtime hot-swap.
+
+``ScoringServer`` binds one fitted workflow to one endpoint;
+``FleetServer`` puts MANY behind one front door:
+
+- **routing**: ``submit(model_id, row)`` resolves the id through the
+  :class:`~transmogrifai_tpu.serving.registry.ModelRegistry`'s active
+  alias to that model's **lane** — a full ``ScoringServer`` (its own
+  ``MicroBatcher`` admission queue, deadlines, backpressure,
+  ``ServingMetrics``, graceful degradation), so one overloaded or
+  degraded model never blocks another's queue.
+- **shared compiled-program cache**: every lane's fused layer programs
+  live in ONE :class:`ProgramCache` — an LRU keyed ``(model
+  fingerprint, layer, padding bucket)`` with explicit HBM budget
+  accounting (the serving generalization of the sweep's
+  ``tree_stack_bytes`` guard): models loaded from the same checkpoint
+  share entries; schema-identical but differently-fitted models can't
+  collide; and when the working set exceeds the budget the
+  least-recently-dispatched (model, bucket) entry is evicted (counted
+  per model in ``ServingCounters.evictions``) instead of HBM growing
+  with fleet size.
+- **zero-downtime hot-swap**: :meth:`FleetServer.hot_swap` warms a new
+  version behind the live alias, optionally **shadow-scores** recent
+  live rows on both versions (a parity gate: promotion aborts — old
+  version untouched — if scores diverge beyond tolerance), then flips
+  the alias atomically and drains the old lane to completion. In-flight
+  requests on the old version all settle; zero dropped requests, by
+  construction and by chaos test (fault site ``serving.swap``).
+
+Observability: a ``fleet.swap`` span per promotion, swap/parity/eviction
+counters in ``/metrics`` (``transmogrifai_fleet_*`` plus every serving
+series labeled ``model=...``), and per-model readiness in ``/healthz``.
+See ``docs/SERVING.md`` ("Serving fleet").
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import time
+import warnings
+from typing import Any, Callable, Optional, Sequence
+
+from transmogrifai_tpu.serving.registry import (
+    ModelEntry, ModelRegistry, ModelState, UnknownModelError,
+)
+from transmogrifai_tpu.serving.server import ScoringServer
+
+__all__ = ["FleetServer", "FleetMetrics", "ProgramCache",
+           "ShadowParityError", "UnknownModelError"]
+
+#: fleet-wide compiled-program HBM budget (bytes) when the caller doesn't
+#: pass one; unset = accounted but unbounded
+HBM_BUDGET_ENV = "TRANSMOGRIFAI_SERVING_HBM_BUDGET"
+
+
+class ShadowParityError(RuntimeError):
+    """The shadow-scoring gate failed: the candidate version's scores
+    diverge from the live version's beyond tolerance. The swap was
+    aborted and the OLD version keeps serving, untouched."""
+
+    def __init__(self, msg: str, max_abs_diff: float):
+        super().__init__(msg)
+        self.max_abs_diff = float(max_abs_diff)
+
+
+class _CacheEntry:
+    __slots__ = ("program", "bytes", "counters", "bucket")
+
+    def __init__(self, program, nbytes, counters, bucket):
+        self.program = program
+        self.bytes = int(nbytes)
+        self.counters = counters
+        self.bucket = bucket
+
+
+class ProgramCache:
+    """Cross-model LRU over compiled serving programs with HBM budget
+    accounting.
+
+    One entry per ``(model fingerprint, layer, padding bucket)`` — the
+    granularity at which serving compiles — each carrying the scorer's
+    byte estimate for its resident footprint. ``get`` returns the cached
+    program or inserts ``factory()``; an insertion is counted as one
+    compile on the owning scorer's ``ServingCounters`` (per-bucket
+    program instances trace exactly once, on first dispatch). When
+    ``budget_bytes`` is set and the accounted total exceeds it, oldest
+    entries are evicted (never the one just inserted) and the eviction
+    is attributed to the EVICTED entry's owner — the model whose next
+    dispatch at that bucket will recompile.
+
+    Thread-safe: lanes dispatch concurrently. Eviction only drops the
+    cache's reference — a dispatch already holding the program finishes
+    unharmed.
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is None:
+            env = os.environ.get(HBM_BUDGET_ENV)
+            budget_bytes = int(float(env)) if env else None
+        self.budget_bytes = budget_bytes
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Any, _CacheEntry]" = \
+            collections.OrderedDict()
+        self.current_bytes = 0
+        self.hits = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries)
+
+    def get(self, key, factory: Callable[[], Any], *, bytes_est=0,
+            counters=None, bucket: Optional[int] = None):
+        """``bytes_est`` may be an int or a zero-arg callable — pass a
+        thunk when the estimate itself costs something (walking a big
+        model's param pytree): it is only evaluated on a miss, keeping
+        the steady-state hit path one dict probe."""
+        evicted: list[_CacheEntry] = []
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                if counters is not None:
+                    # attribution follows the CURRENT user: an entry
+                    # inserted by a throwaway prewarm scorer must charge
+                    # its eventual eviction to the live lane now serving
+                    # on it, not to a discarded counters object
+                    entry.counters = counters
+                return entry.program
+            if callable(bytes_est):
+                bytes_est = bytes_est()
+            entry = _CacheEntry(factory(), bytes_est, counters, bucket)
+            self._entries[key] = entry
+            self.insertions += 1
+            self.current_bytes += entry.bytes
+            if counters is not None and bucket is not None:
+                counters.count(bucket, compiles=1)
+            if self.budget_bytes is not None:
+                # never evict the entry just inserted: a budget smaller
+                # than one program still serves (it just can't cache)
+                while self.current_bytes > self.budget_bytes \
+                        and len(self._entries) > 1:
+                    _, old = self._entries.popitem(last=False)
+                    self.current_bytes -= old.bytes
+                    self.evictions += 1
+                    evicted.append(old)
+            program = entry.program
+        for old in evicted:  # attribute outside the lock
+            if old.counters is not None and old.bucket is not None:
+                old.counters.count(old.bucket, evictions=1)
+        return program
+
+    def evict_model(self, fingerprint: str) -> int:
+        """Drop every entry of one model (an unload releases its share
+        of the budget immediately instead of waiting for LRU aging).
+        Keyed entries are ``(fingerprint, layer, bucket)`` tuples."""
+        n = 0
+        with self._lock:
+            for key in [k for k in self._entries
+                        if isinstance(k, tuple) and k
+                        and k[0] == fingerprint]:
+                old = self._entries.pop(key)
+                self.current_bytes -= old.bytes
+                n += 1
+        return n
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "bytes": self.current_bytes,
+                    "budgetBytes": self.budget_bytes,
+                    "hits": self.hits,
+                    "insertions": self.insertions,
+                    "evictions": self.evictions}
+
+
+class FleetMetrics:
+    """Fleet-lifecycle counters (per-request metrics live on each lane's
+    ``ServingMetrics``): swaps, aborted swaps, shadow-parity failures."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.swaps = 0
+        self.swap_failures = 0
+        self.shadow_parity_failures = 0
+        self.models_registered = 0
+        self.models_unloaded = 0
+        self.swap_wall_s = 0.0
+        self.last_swap_at: Optional[float] = None
+
+    def record_registered(self) -> None:
+        with self._lock:
+            self.models_registered += 1
+
+    def record_unloaded(self) -> None:
+        with self._lock:
+            self.models_unloaded += 1
+
+    def record_swap(self, wall_s: float) -> None:
+        with self._lock:
+            self.swaps += 1
+            self.swap_wall_s += wall_s
+            self.last_swap_at = time.time()
+
+    def record_swap_failure(self, parity: bool = False) -> None:
+        with self._lock:
+            self.swap_failures += 1
+            if parity:
+                self.shadow_parity_failures += 1
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"swaps": self.swaps,
+                    "swapFailures": self.swap_failures,
+                    "shadowParityFailures": self.shadow_parity_failures,
+                    "modelsRegistered": self.models_registered,
+                    "modelsUnloaded": self.models_unloaded,
+                    "swapWallSeconds": round(self.swap_wall_s, 6),
+                    "lastSwapAt": self.last_swap_at}
+
+
+def _nan_inf(x: float) -> float:
+    """NaN compares False against everything, so a plain ``max``/``>``
+    chain would let a NaN-scoring candidate SLIP THROUGH the parity
+    gate — the exact model the gate exists to block. Any NaN diff is
+    +inf: never promotable."""
+    return float("inf") if math.isnan(x) else x
+
+
+def score_diff(a: dict, b: dict) -> float:
+    """Max abs numeric difference between two score documents (the shadow
+    gate's comparator). Mismatched keys or shapes compare as +inf — a
+    candidate whose result schema changed can never pass the gate — and
+    so does any NaN on either side."""
+    if set(a) != set(b):
+        return float("inf")
+    d = 0.0
+    for k, av in a.items():
+        bv = b[k]
+        if isinstance(av, dict) or isinstance(bv, dict):
+            if not (isinstance(av, dict) and isinstance(bv, dict)):
+                return float("inf")
+            d = max(d, score_diff(av, bv))
+        elif isinstance(av, (list, tuple)) or isinstance(bv, (list, tuple)):
+            if not (isinstance(av, (list, tuple))
+                    and isinstance(bv, (list, tuple))) or len(av) != len(bv):
+                return float("inf")
+            d = max(d, max((_nan_inf(abs(float(x) - float(z)))
+                            for x, z in zip(av, bv)), default=0.0))
+        elif av is None or bv is None:
+            if av is not bv:
+                return float("inf")
+        elif isinstance(av, str) or isinstance(bv, str):
+            if av != bv:
+                return float("inf")
+        else:
+            d = max(d, _nan_inf(abs(float(av) - float(bv))))
+    return d
+
+
+class FleetServer:
+    """Many fitted workflows behind one endpoint: registry-routed
+    per-model lanes over one shared compiled-program cache.
+
+    Usage::
+
+        fleet = FleetServer(cache_hbm_budget=2 << 30)
+        fleet.register("models/churn")            # -> (churn, v1), active
+        fleet.register("models/ctr")
+        fleet.start(warmup_rows={"churn": row_a, "ctr": row_b})
+        fut = fleet.submit("churn", {"age": 31.0, ...})
+        fleet.hot_swap("churn", "models/churn_retrained")  # zero downtime
+        fleet.stop()
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 cache_hbm_budget: Optional[int] = None,
+                 shadow_rows: int = 16, shadow_tolerance: float = 1e-4,
+                 shadow_timeout_s: float = 30.0,
+                 http_timeout_s: float = 30.0,
+                 recent_rows: int = 64,
+                 route_field: str = "model",
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1",
+                 **lane_kwargs):
+        """``lane_kwargs`` (``max_batch``, ``max_wait_ms``,
+        ``queue_capacity``, ``default_timeout_ms``, ``strict``,
+        ``retries``, ``probe_interval_s``, ``donate``, ...) configure
+        every per-model ``ScoringServer`` lane."""
+        bad = {"metrics_port", "metrics_host", "program_cache",
+               "fingerprint"} & set(lane_kwargs)
+        if bad:
+            raise ValueError(f"lane kwargs {sorted(bad)} are fleet-managed")
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.program_cache = ProgramCache(cache_hbm_budget)
+        self.metrics = FleetMetrics()
+        self.shadow_rows = int(shadow_rows)
+        self.shadow_tolerance = float(shadow_tolerance)
+        self.shadow_timeout_s = float(shadow_timeout_s)
+        #: client-facing POST /score result-wait bound — its OWN knob:
+        #: the shadow bound sizes an internal swap step, and widening
+        #: one must not silently widen the other
+        self.http_timeout_s = float(http_timeout_s)
+        self.route_field = route_field
+        self._lane_kwargs = dict(lane_kwargs)
+        self._lock = threading.RLock()
+        #: (model_id, version) -> ScoringServer lane
+        self._lanes: dict[tuple, ScoringServer] = {}
+        #: per-model hot-swap mutual exclusion: two racing swaps of one
+        #: id would both promote (last alias write wins) and leak the
+        #: loser's running lane + pinned arrays
+        self._swap_locks: dict[str, threading.Lock] = {}
+        #: model_id -> ring of recently admitted rows (shadow-gate feed)
+        self._recent: dict[str, collections.deque] = {}
+        self._recent_rows = int(recent_rows)
+        self._started = False
+        self.metrics_http = None
+        self._metrics_port = metrics_port
+        self._metrics_host = metrics_host
+
+    # -- registration --------------------------------------------------------
+    def register(self, path: Optional[str] = None, *, model=None,
+                 model_id: Optional[str] = None,
+                 version: Optional[str] = None,
+                 warmup_row: Optional[dict] = None) -> ModelEntry:
+        """Register one model (see ``ModelRegistry.register``). If the
+        fleet is already serving and the new version becomes the active
+        one (first version of its id), its lane starts — warmed with
+        ``warmup_row`` when given — before this returns."""
+        entry = self.registry.register(path, model=model,
+                                       model_id=model_id, version=version)
+        self.metrics.record_registered()
+        if self._started and \
+                self.registry.active_version(entry.model_id) == entry.version:
+            self._start_lane(entry, warmup_row=warmup_row)
+        return entry
+
+    def register_dir(self, root: str) -> list[ModelEntry]:
+        """Register every fingerprinted checkpoint under ``root``
+        (``ModelRegistry.register_dir`` layouts)."""
+        entries = self.registry.register_dir(root)
+        for entry in entries:
+            self.metrics.record_registered()
+            if self._started and self.registry.active_version(
+                    entry.model_id) == entry.version:
+                self._start_lane(entry)
+        return entries
+
+    def _make_lane(self, entry: ModelEntry) -> ScoringServer:
+        return ScoringServer(entry.model,
+                             program_cache=self.program_cache,
+                             fingerprint=entry.fingerprint,
+                             **self._lane_kwargs)
+
+    def prewarm(self, model_id: str, version: Optional[str] = None,
+                row: Optional[dict] = None) -> list:
+        """Compile an INACTIVE version's padding-bucket programs into the
+        shared cache without routing any traffic to it — the operator's
+        prep step before :meth:`hot_swap`. Because cache entries are
+        keyed by the model fingerprint, the candidate's lane later warms
+        on pure cache hits: the swap's serving-visible CPU burst (jit
+        trace + XLA compile racing live dispatches) moves to whenever
+        the operator chooses. ``row`` defaults to the model's newest
+        live row. Returns the buckets warmed."""
+        from transmogrifai_tpu.serving.compiled import CompiledScorer
+        entry = self.registry.get(model_id, version)
+        if entry.model is None:
+            raise ValueError(
+                f"version {entry.version!r} of {model_id!r} is unloaded")
+        if row is None:
+            recent = self._recent.get(model_id)
+            if not recent:
+                raise ValueError(
+                    f"prewarm of {model_id!r} needs a row (no live "
+                    "traffic seen yet)")
+            row = dict(recent[-1])
+        kw = {k: v for k, v in self._lane_kwargs.items()
+              if k in ("max_batch", "min_bucket", "donate")}
+        scorer = CompiledScorer(entry.model,
+                                program_cache=self.program_cache,
+                                fingerprint=entry.fingerprint, **kw)
+        return scorer.warmup(row)
+
+    def _start_lane(self, entry: ModelEntry,
+                    warmup_row: Optional[dict] = None) -> ScoringServer:
+        lane = self._make_lane(entry)
+        entry.state = ModelState.WARMING
+        lane.start(warmup_row=warmup_row)
+        entry.state = ModelState.READY
+        with self._lock:
+            self._lanes[(entry.model_id, entry.version)] = lane
+        return lane
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, warmup_rows: Optional[dict] = None) -> "FleetServer":
+        """Start a lane for every model's ACTIVE version (inactive
+        versions stay cold until promoted). ``warmup_rows`` maps model
+        id -> one representative row to pre-compile that lane's padding
+        buckets before traffic."""
+        warmup_rows = warmup_rows or {}
+        self._started = True
+        for model_id in self.registry.model_ids():
+            version = self.registry.active_version(model_id)
+            if version is None:
+                continue
+            entry = self.registry.get(model_id, version)
+            if (model_id, version) not in self._lanes:
+                self._start_lane(entry, warmup_row=warmup_rows.get(model_id))
+        if self._metrics_port is not None and self.metrics_http is None:
+            from transmogrifai_tpu.serving.http import MetricsServer
+            from transmogrifai_tpu.utils.prometheus import build_registry
+            registry = build_registry(fleet=self)
+            self.metrics_http = MetricsServer(
+                render_fn=registry.render, health_fn=self.health,
+                score_fn=self._http_score,
+                port=self._metrics_port, host=self._metrics_host).start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        with self._lock:
+            lanes = dict(self._lanes)
+            # drop the lane objects: their worker threads are about to
+            # die, and a later start() must build FRESH lanes (the
+            # "(id, version) not in _lanes" guard would otherwise skip
+            # restarting them, leaving a "started" fleet whose every
+            # submit hits a dead batcher)
+            self._lanes.clear()
+        for (model_id, version), lane in lanes.items():
+            try:
+                entry = self.registry.get(model_id, version)
+            except UnknownModelError:
+                entry = None
+            if entry is not None:
+                entry.state = ModelState.DRAINING
+            lane.stop(drain=drain)
+            if entry is not None:
+                # a clean shutdown must not read as an in-progress
+                # drain forever: the model stays loaded, just unserved
+                entry.state = ModelState.STOPPED
+        self._started = False
+        if self.metrics_http is not None:
+            self.metrics_http.stop()
+            self.metrics_http = None
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- routing -------------------------------------------------------------
+    def _resolve(self, model_id: str) -> tuple:
+        with self._lock:
+            version = self.registry.active_version(model_id)
+            if version is None:
+                # raises UnknownModelError with the precise reason
+                self.registry.get(model_id)
+            lane = self._lanes.get((model_id, version))
+            if lane is None:
+                raise UnknownModelError(
+                    f"model {model_id!r} version {version!r} has no "
+                    "running lane (fleet not started?)")
+            return lane, version
+
+    def _remember(self, model_id: str, row: dict) -> None:
+        ring = self._recent.get(model_id)
+        if ring is None:
+            ring = self._recent.setdefault(
+                model_id, collections.deque(maxlen=self._recent_rows))
+        ring.append(row)
+
+    def submit(self, model_id: str, row: dict,
+               timeout_ms: Optional[float] = None):
+        """Route one request to ``model_id``'s active version. Raises
+        ``UnknownModelError`` (no such id / no active version),
+        ``KeyError`` (strict admission) or ``BackpressureError`` (that
+        lane's queue is full) — per-model backpressure: one hot model
+        sheds load without touching its neighbors' queues."""
+        for _ in range(8):
+            lane, version = self._resolve(model_id)
+            try:
+                fut = lane.submit(row, timeout_ms=timeout_ms)
+            except RuntimeError:
+                # the lane stopped between resolve and submit — a swap
+                # demoted it (the alias flips BEFORE the old lane drains,
+                # so a re-resolve lands on the new version). Anything
+                # else is a real error: re-raise.
+                if self.registry.active_version(model_id) == version:
+                    raise
+                continue
+            self._remember(model_id, row)
+            return fut
+        raise RuntimeError(
+            f"model {model_id!r}: could not route (lanes kept stopping)")
+
+    def submit_blocking(self, model_id: str, row: dict,
+                        timeout_ms: Optional[float] = None,
+                        max_wait_s: Optional[float] = None):
+        """``submit`` that absorbs backpressure (the shared
+        ``batcher.absorb_backpressure`` loop)."""
+        from transmogrifai_tpu.serving.batcher import absorb_backpressure
+        return absorb_backpressure(
+            lambda: self.submit(model_id, row, timeout_ms=timeout_ms),
+            max_wait_s=max_wait_s)
+
+    def score(self, model_id: str, row: dict,
+              timeout_s: Optional[float] = None) -> dict:
+        return self.submit(model_id, row).result(timeout=timeout_s)
+
+    def _http_score(self, model_id: Optional[str], row: dict) -> dict:
+        """POST /score[/model_id] adapter: path id wins, else the row's
+        ``route_field``, else the sole registered model."""
+        if model_id is None:
+            model_id = row.pop(self.route_field, None)
+        if model_id is None:
+            ids = self.registry.model_ids()
+            if len(ids) != 1:
+                raise ValueError(
+                    f"request names no model (field {self.route_field!r} "
+                    f"or /score/<id> path) and the fleet serves "
+                    f"{len(ids)} models")
+            model_id = ids[0]
+        return self.score(model_id, row, timeout_s=self.http_timeout_s)
+
+    # -- hot swap ------------------------------------------------------------
+    def hot_swap(self, model_id: str, path: Optional[str] = None, *,
+                 model=None, version: Optional[str] = None,
+                 shadow_rows: Optional[int] = None,
+                 tolerance: Optional[float] = None,
+                 warmup_row: Optional[dict] = None) -> dict:
+        """Promote a new version behind the live ``model_id`` with zero
+        downtime and zero dropped requests.
+
+        1. **load + warm**: the candidate (``path``/``model``, or an
+           already-registered inactive ``version``) gets its own lane,
+           started and bucket-warmed while the old version keeps serving.
+        2. **shadow gate** (``shadow_rows > 0`` and live rows seen): the
+           newest admitted rows score on BOTH versions; max abs score
+           difference above ``tolerance`` aborts — the candidate is
+           unloaded, the old version never stops, and
+           ``ShadowParityError`` carries the measured divergence.
+        3. **atomic flip**: the registry alias moves to the new version
+           (one assignment under the registry lock) — every subsequent
+           ``submit`` routes new. 4. **drain**: the old lane stops with
+           ``drain=True``, settling every in-flight and queued request,
+           then unloads.
+
+        Any failure before the flip (warmup crash, injected fault at
+        site ``serving.swap``, parity) leaves the old version serving,
+        untouched. Returns a report dict; raises on abort.
+        """
+        shadow_rows = self.shadow_rows if shadow_rows is None \
+            else int(shadow_rows)
+        tolerance = self.shadow_tolerance if tolerance is None \
+            else float(tolerance)
+        with self._lock:
+            swap_lock = self._swap_locks.setdefault(
+                model_id, threading.Lock())
+        if not swap_lock.acquire(blocking=False):
+            raise RuntimeError(
+                f"a hot-swap of {model_id!r} is already in progress; "
+                "concurrent swaps of one model would double-promote")
+        try:
+            return self._hot_swap_locked(
+                model_id, path, model=model, version=version,
+                shadow_rows=shadow_rows, tolerance=tolerance,
+                warmup_row=warmup_row)
+        finally:
+            swap_lock.release()
+
+    def _hot_swap_locked(self, model_id: str, path: Optional[str], *,
+                         model, version: Optional[str],
+                         shadow_rows: int, tolerance: float,
+                         warmup_row: Optional[dict]) -> dict:
+        from transmogrifai_tpu.utils.faults import fault_point
+        from transmogrifai_tpu.utils.tracing import span
+        t0 = time.monotonic()
+        old_lane, old_version = self._resolve(model_id)
+        if path is None and model is None:
+            if version is None:
+                raise ValueError(
+                    "hot_swap needs a path, a model, or an "
+                    "already-registered version")
+            entry = self.registry.get(model_id, version)
+            if entry.model is None:
+                raise ValueError(
+                    f"version {version!r} of {model_id!r} is unloaded")
+            pre_registered = True
+        else:
+            entry = self.registry.register(
+                path, model=model, model_id=model_id, version=version,
+                activate=False)
+            self.metrics.record_registered()
+            pre_registered = False
+        if entry.version == old_version:
+            raise ValueError(
+                f"model {model_id!r} version {entry.version!r} is "
+                "already active")
+
+        with span("fleet.swap", model=model_id,
+                  from_version=old_version, to_version=entry.version,
+                  fingerprint=entry.fingerprint):
+            new_lane = None
+            try:
+                rows = list(self._recent.get(model_id, ()))
+                if warmup_row is None and rows:
+                    warmup_row = dict(rows[-1])
+                entry.state = ModelState.WARMING
+                new_lane = self._make_lane(entry)
+                new_lane.start(warmup_row=warmup_row)
+                # chaos seam: a fault here is MID-swap — candidate warm,
+                # alias not yet flipped; the abort path below must leave
+                # the old version serving with nothing dropped
+                fault_point("serving.swap")
+                max_diff = self._shadow_gate(
+                    model_id, old_lane, new_lane,
+                    rows[-shadow_rows:] if shadow_rows > 0 else [],
+                    tolerance)
+            except BaseException as e:
+                self.metrics.record_swap_failure(
+                    parity=isinstance(e, ShadowParityError))
+                if new_lane is not None:
+                    try:
+                        new_lane.stop(drain=False)
+                    except Exception:  # noqa: BLE001 — abort cleanup is best-effort (failure-ok)
+                        pass
+                if not pre_registered:
+                    # forget the failed candidate so a retried swap can
+                    # re-register the same version id cleanly
+                    self.registry.unload(model_id, entry.version,
+                                         forget=True)
+                else:
+                    entry.state = ModelState.WARMING
+                raise
+            # -- atomic flip: lane routable first, then one alias write --
+            with self._lock:
+                self._lanes[(model_id, entry.version)] = new_lane
+            entry.state = ModelState.READY
+            self.registry.promote(model_id, entry.version)
+            # -- drain: every request the old lane admitted settles ------
+            old_entry = self.registry.get(model_id, old_version)
+            old_entry.state = ModelState.DRAINING
+            with span("fleet.drain", model=model_id, version=old_version):
+                old_lane.stop(drain=True)
+            with self._lock:
+                self._lanes.pop((model_id, old_version), None)
+            self.registry.unload(model_id, old_version)
+            self.metrics.record_unloaded()
+            if not self.registry.fingerprint_in_use(
+                    old_entry.fingerprint):
+                # release the demoted version's budget share — but only
+                # when NO loaded entry (this id's new version, or any
+                # other id registered from the same checkpoint bytes)
+                # still serves on those entries: they'd be someone's
+                # warm programs, and dropping them forces mid-traffic
+                # recompiles on an unswapped model
+                self.program_cache.evict_model(old_entry.fingerprint)
+            wall = time.monotonic() - t0
+            self.metrics.record_swap(wall)
+        return {"modelId": model_id, "fromVersion": old_version,
+                "toVersion": entry.version,
+                "fingerprint": entry.fingerprint,
+                "shadowRows": min(shadow_rows, len(rows)),
+                "shadowMaxAbsDiff": max_diff,
+                "wallSeconds": round(wall, 6)}
+
+    def _shadow_gate(self, model_id: str, old_lane, new_lane,
+                     rows: Sequence[dict], tolerance: float
+                     ) -> Optional[float]:
+        from transmogrifai_tpu.utils.tracing import span
+        if not rows:
+            warnings.warn(
+                f"fleet: hot-swap of {model_id!r} has no live rows to "
+                "shadow-score; promoting without the parity gate",
+                RuntimeWarning)
+            return None
+        with span("fleet.shadow", model=model_id, rows=len(rows)):
+            # the candidate lane is idle (plain submit can't shed); the
+            # LIVE lane may be at queue capacity — the very moment an
+            # operator wants a better model in — so absorb backpressure
+            # instead of aborting the swap on a full queue
+            new_futs = [new_lane.submit(dict(r)) for r in rows]
+            old_futs = [old_lane.submit_blocking(
+                dict(r), max_wait_s=self.shadow_timeout_s) for r in rows]
+            max_diff = 0.0
+            for of, nf in zip(old_futs, new_futs):
+                max_diff = max(max_diff, score_diff(
+                    of.result(timeout=self.shadow_timeout_s),
+                    nf.result(timeout=self.shadow_timeout_s)))
+        if max_diff > tolerance:
+            raise ShadowParityError(
+                f"shadow gate: candidate for {model_id!r} diverges from "
+                f"the live version by {max_diff:.6g} > tolerance "
+                f"{tolerance:g} on {len(rows)} live rows; swap aborted, "
+                "old version still serving", max_abs_diff=max_diff)
+        return max_diff
+
+    # -- observability -------------------------------------------------------
+    def active_lanes(self) -> dict:
+        """model id -> its active version's running lane."""
+        with self._lock:
+            out = {}
+            for model_id in self.registry.model_ids():
+                version = self.registry.active_version(model_id)
+                lane = self._lanes.get((model_id, version))
+                if lane is not None:
+                    out[model_id] = lane
+            return out
+
+    def health(self) -> dict:
+        """Per-model readiness + overall fleet status (the ``/healthz``
+        body): ``ok`` only when every active lane is on the compiled
+        path; ``warming``/``degraded`` name the worst offender state."""
+        models: dict = {}
+        # fleet status = the worst lane's OWN state word (not a coarse
+        # bucket): "warming" and "draining" point operators at opposite
+        # ends of a model's lifecycle and must never alias
+        severity = {"ok": 0, "warming": 1, "draining": 2, "stopped": 3,
+                    "degraded": 4, "unloaded": 5}
+        worst = "ok"
+        for model_id in self.registry.model_ids():
+            version = self.registry.active_version(model_id)
+            if version is None:
+                models[model_id] = {"state": ModelState.UNLOADED,
+                                    "version": None}
+                worst = max(worst, ModelState.UNLOADED,
+                            key=lambda s: severity.get(s, 4))
+                continue
+            entry = self.registry.get(model_id, version)
+            with self._lock:
+                lane = self._lanes.get((model_id, version))
+            state = lane.state if lane is not None else entry.state
+            doc = {"state": state, "version": version,
+                   "fingerprint": entry.fingerprint}
+            if lane is not None:
+                doc["queueDepth"] = lane.batcher.queue_depth
+            models[model_id] = doc
+            word = "ok" if state == "ready" else state
+            worst = max(worst, word, key=lambda s: severity.get(s, 4))
+        return {"status": worst, "models": models,
+                "fleet": self.metrics.to_json(),
+                "cache": self.program_cache.to_json()}
+
+    def snapshot(self) -> dict:
+        """One JSON document: fleet counters, shared-cache accounting,
+        and every active lane's full serving snapshot keyed by model."""
+        doc = {"fleet": self.metrics.to_json(),
+               "cache": self.program_cache.to_json(),
+               "registry": self.registry.list(),
+               "models": {}}
+        for model_id, lane in self.active_lanes().items():
+            lane_doc = lane.snapshot(mirror_to_profiler=False)
+            lane_doc["state"] = lane.state
+            lane_doc["version"] = self.registry.active_version(model_id)
+            doc["models"][model_id] = lane_doc
+        return doc

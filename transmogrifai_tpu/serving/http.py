@@ -1,12 +1,19 @@
-"""Scrape endpoint: a stdlib ``http.server`` background thread serving
-``GET /metrics`` (Prometheus text exposition over the server's live
-counters) and ``GET /healthz`` (liveness + degradation state as JSON).
+"""Scrape + scoring endpoint: a stdlib ``http.server`` background thread
+serving ``GET /metrics`` (Prometheus text exposition over the server's
+live counters), ``GET /healthz`` (liveness + per-model readiness as
+JSON), and — when the owner provides a ``score_fn`` (the fleet does) —
+``POST /score`` / ``POST /score/<model_id>`` (one JSON request row in,
+one JSON score document out; the multi-process load harness's wire).
 
 Deliberately dependency-free and tiny: one daemon thread, a
-``ThreadingHTTPServer`` so a slow scraper can't block a liveness probe,
-and no request body handling at all — everything but the two GET paths
-is a 404. Port 0 binds an ephemeral port (tests); the bound port is
-``MetricsServer.port``.
+``ThreadingHTTPServer`` so a slow scraper or a blocking score can't
+stall a liveness probe, and no other routes — everything else is a 404.
+Port 0 binds an ephemeral port (tests); the bound port is
+``MetricsServer.port``. Scoring status mapping: strict-admission /
+malformed-request errors are 400, an unknown model id 404, a queue-full
+``BackpressureError`` 503 with a ``Retry-After`` hint, an expired
+request deadline 504 — load shed and routing mistakes are the CLIENT's
+signal, never a server crash.
 """
 
 from __future__ import annotations
@@ -22,13 +29,18 @@ __all__ = ["MetricsServer"]
 
 
 class MetricsServer:
-    """Background /metrics + /healthz endpoint for one ScoringServer."""
+    """Background /metrics + /healthz (+ optional /score) endpoint."""
 
     def __init__(self, render_fn: Callable[[], str],
                  health_fn: Callable[[], dict],
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 score_fn: Optional[Callable[[Optional[str], dict],
+                                             dict]] = None):
         self.render_fn = render_fn
         self.health_fn = health_fn
+        #: ``score_fn(model_id_or_None, row) -> score doc``; None
+        #: disables the POST /score routes (scrape-only endpoint)
+        self.score_fn = score_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._host = host
@@ -44,6 +56,16 @@ class MetricsServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, body: bytes, ctype: str,
+                       extra: Optional[dict] = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 — http.server API
                 try:
                     if self.path.split("?")[0] == "/metrics":
@@ -54,19 +76,68 @@ class MetricsServer:
                                 + "\n").encode()
                         ctype = "application/json"
                     else:
-                        self.send_error(404, "only /metrics and /healthz")
+                        self.send_error(404, "only /metrics, /healthz "
+                                             "and POST /score")
                         return
                 except Exception as e:  # noqa: BLE001 — a scrape must see the failure, not a hang
                     self.send_error(
                         500, f"{type(e).__name__}: {str(e)[:200]}")
                     return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reply(200, body, ctype)
 
-            def log_message(self, *args):  # scrapes are not access-logged
+            def do_POST(self):  # noqa: N802 — http.server API
+                path = self.path.split("?")[0]
+                if outer.score_fn is None or not (
+                        path == "/score" or path.startswith("/score/")):
+                    self.send_error(
+                        404, "POST /score requires a scoring server")
+                    return
+                model_id = path[len("/score/"):] or None \
+                    if path.startswith("/score/") else None
+                err_json = lambda c, e, extra=None: self._reply(  # noqa: E731
+                    c, (json.dumps({"error": f"{type(e).__name__}: "
+                                             f"{str(e)[:300]}"})
+                        + "\n").encode(), "application/json", extra)
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    row = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(row, dict):
+                        raise ValueError("request body must be one JSON "
+                                         "object (a request row)")
+                    doc = outer.score_fn(model_id, row)
+                except Exception as e:  # noqa: BLE001 — mapped to an HTTP status below
+                    from concurrent.futures import (
+                        TimeoutError as FutureTimeout,
+                    )
+
+                    from transmogrifai_tpu.serving.batcher import (
+                        BackpressureError, RequestTimeout,
+                    )
+                    from transmogrifai_tpu.serving.registry import (
+                        UnknownModelError,
+                    )
+                    if isinstance(e, BackpressureError):
+                        err_json(503, e, {"Retry-After":
+                                          f"{e.retry_after_s:.3f}"})
+                    elif isinstance(e, UnknownModelError):
+                        err_json(404, e)
+                    elif isinstance(e, (RequestTimeout, TimeoutError,
+                                        FutureTimeout)):
+                        # RequestTimeout = queue deadline; Future/builtin
+                        # TimeoutError = the result-wait bound (NOT the
+                        # same class pre-3.11) — all 504, never a 5xx
+                        # "server fault"
+                        err_json(504, e)
+                    elif isinstance(e, (KeyError, ValueError,
+                                        json.JSONDecodeError)):
+                        err_json(400, e)  # strict admission / bad body
+                    else:
+                        err_json(500, e)
+                    return
+                self._reply(200, (json.dumps(doc, default=str)
+                                  + "\n").encode(), "application/json")
+
+            def log_message(self, *args):  # requests are not access-logged
                 pass
 
         self._httpd = ThreadingHTTPServer(

@@ -1,0 +1,256 @@
+"""Model registry: many fitted workflows behind one serving fleet.
+
+Production AutoML serves MANY fitted models (per-tenant, per-scenario,
+old/new versions of the same endpoint), not the one-model-one-server
+binding of ``ScoringServer``. The registry is the fleet's source of truth:
+every registered model is a :class:`ModelEntry` keyed by ``(model_id,
+version)`` and identified by the **fingerprint** of its saved checkpoint
+(``checkpoint.model_fingerprint`` over the ``save_model`` manifest +
+array bytes) — the same key the shared compiled-program cache uses, so
+"two registrations of the same checkpoint dir" provably share compiled
+entries while schema-identical-but-differently-fitted models provably
+don't.
+
+Per model id, exactly one version is **active** (the alias live traffic
+routes to). ``promote(model_id, version)`` flips the alias atomically —
+one dict assignment under the registry lock — which is the primitive
+``FleetServer.hot_swap`` builds zero-downtime promotion on.
+
+Directory layouts ``register_dir`` understands::
+
+    models/
+      churn/            # <id>/model.json            -> (churn, v1)
+        model.json
+      ctr/              # <id>/<version>/model.json  -> (ctr, v1), (ctr, v2)
+        v1/model.json
+        v2/model.json
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["ModelEntry", "ModelRegistry", "ModelState",
+           "UnknownModelError"]
+
+
+class ModelState:
+    """Lifecycle states a registered model moves through (reported by
+    ``/healthz`` and the ``transmogrifai_fleet_model_state`` gauge)."""
+    WARMING = "warming"     # registered, padding buckets compiling
+    READY = "ready"         # serving on the compiled path
+    DEGRADED = "degraded"   # serving on the row path (device fault)
+    DRAINING = "draining"   # demoted; finishing in-flight requests
+    STOPPED = "stopped"     # fleet stopped; model still loaded
+    UNLOADED = "unloaded"   # drained and dropped; kept for audit
+
+
+class UnknownModelError(KeyError):
+    """Routing key names no registered model (or no active version)."""
+
+
+@dataclass
+class ModelEntry:
+    """One registered fitted workflow."""
+    model_id: str
+    version: str
+    path: Optional[str]       # None for in-memory registrations
+    fingerprint: str
+    model: object = field(repr=False, default=None)
+    state: str = ModelState.WARMING
+    registered_at: float = field(default_factory=time.time)
+
+    def to_json(self) -> dict:
+        return {"modelId": self.model_id, "version": self.version,
+                "path": self.path, "fingerprint": self.fingerprint,
+                "state": self.state, "registeredAt": self.registered_at}
+
+
+class ModelRegistry:
+    """Thread-safe ``(model_id, version) -> ModelEntry`` store with an
+    atomic per-id active-version alias."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        #: model_id -> {version: ModelEntry}
+        self._entries: dict[str, dict[str, ModelEntry]] = {}
+        #: model_id -> active version (the alias live traffic follows)
+        self._active: dict[str, str] = {}
+
+    # -- registration --------------------------------------------------------
+    def register(self, path: Optional[str] = None, *,
+                 model=None, model_id: Optional[str] = None,
+                 version: Optional[str] = None,
+                 activate: Optional[bool] = None) -> ModelEntry:
+        """Load (``path``: a ``serialization.save_model`` dir) or adopt
+        (``model``: an in-memory fitted workflow) one model. ``model_id``
+        defaults to the dir basename; ``version`` to the next ``v<n>``
+        for that id. The FIRST version of an id activates automatically;
+        later versions stay inactive until :meth:`promote` (or
+        ``activate=True``) — registering a candidate never moves live
+        traffic by itself."""
+        from transmogrifai_tpu.checkpoint import model_fingerprint
+        if path is None and model is None:
+            raise ValueError("register() needs a path or a model")
+        if path is not None:
+            from transmogrifai_tpu.workflow import load_model
+            fingerprint = model_fingerprint(path=path)
+            if model is None:
+                model = load_model(path)
+            if model_id is None:
+                base = os.path.basename(os.path.normpath(path))
+                # <id>/<version>/ layout: the version dir is not the id
+                model_id = base
+        else:
+            fingerprint = model_fingerprint(model=model)
+            if model_id is None:
+                raise ValueError("in-memory register() needs a model_id")
+        with self._lock:
+            versions = self._entries.setdefault(model_id, {})
+            if version is None:
+                # next AFTER the highest existing v<n> — a count-based
+                # name collides whenever versions aren't dense v1..vN
+                # (retired versions deleted, unload(forget=True))
+                highest = 0
+                for v in versions:
+                    m = re.match(r"^v(\d+)$", v)
+                    if m:
+                        highest = max(highest, int(m.group(1)))
+                version = f"v{max(highest, len(versions)) + 1}"
+            if version in versions:
+                raise ValueError(
+                    f"model {model_id!r} version {version!r} is already "
+                    f"registered (fingerprint "
+                    f"{versions[version].fingerprint})")
+            entry = ModelEntry(model_id=model_id, version=version,
+                               path=path, fingerprint=fingerprint,
+                               model=model)
+            versions[version] = entry
+            if activate or (activate is None
+                            and model_id not in self._active):
+                self._active[model_id] = version
+            return entry
+
+    def register_dir(self, root: str) -> list[ModelEntry]:
+        """Register every fingerprinted checkpoint under ``root`` (flat
+        ``<id>/model.json`` or versioned ``<id>/<version>/model.json``
+        layouts; see module docstring). Version subdirs register in
+        sorted order, so ``v1`` activates and later versions await
+        promotion. Returns the new entries."""
+        from transmogrifai_tpu.serialization import MODEL_JSON
+        if os.path.exists(os.path.join(root, MODEL_JSON)):
+            return [self.register(root)]
+
+        def version_key(name: str):
+            # NATURAL order: lexical sort puts v10 before v2, and the
+            # first registered version auto-activates — a ten-version
+            # history must not silently route live traffic to the
+            # newest unpromoted candidate on restart
+            m = re.match(r"^v(\d+)$", name)
+            return (0, int(m.group(1)), name) if m else (1, 0, name)
+
+        entries: list[ModelEntry] = []
+        for sub in sorted(os.listdir(root)):
+            subdir = os.path.join(root, sub)
+            if not os.path.isdir(subdir):
+                continue
+            if os.path.exists(os.path.join(subdir, MODEL_JSON)):
+                entries.append(self.register(subdir, model_id=sub))
+                continue
+            for ver in sorted(os.listdir(subdir), key=version_key):
+                vdir = os.path.join(subdir, ver)
+                if os.path.exists(os.path.join(vdir, MODEL_JSON)):
+                    entries.append(self.register(
+                        vdir, model_id=sub, version=ver))
+        return entries
+
+    # -- lookup --------------------------------------------------------------
+    def get(self, model_id: str,
+            version: Optional[str] = None) -> ModelEntry:
+        """The entry for ``version`` (default: the active alias)."""
+        with self._lock:
+            versions = self._entries.get(model_id)
+            if not versions:
+                raise UnknownModelError(
+                    f"unknown model {model_id!r}; registered: "
+                    f"{sorted(self._entries) or 'none'}")
+            if version is None:
+                version = self._active.get(model_id)
+                if version is None:
+                    raise UnknownModelError(
+                        f"model {model_id!r} has no active version")
+            entry = versions.get(version)
+            if entry is None:
+                raise UnknownModelError(
+                    f"model {model_id!r} has no version {version!r}; "
+                    f"registered: {sorted(versions)}")
+            return entry
+
+    def active_version(self, model_id: str) -> Optional[str]:
+        with self._lock:
+            return self._active.get(model_id)
+
+    def fingerprint_in_use(self, fingerprint: str) -> bool:
+        """True while ANY loaded entry (any id, any version) carries
+        this fingerprint — its shared compiled-cache entries are still
+        someone's warm programs and must not be evicted on unload."""
+        with self._lock:
+            return any(e.fingerprint == fingerprint and e.model is not None
+                       for versions in self._entries.values()
+                       for e in versions.values())
+
+    def model_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def list(self) -> list[dict]:
+        """Every registered version, active-flagged — the inventory the
+        CLI and ``/healthz`` report."""
+        with self._lock:
+            out = []
+            for model_id in sorted(self._entries):
+                active = self._active.get(model_id)
+                for version in sorted(self._entries[model_id]):
+                    doc = self._entries[model_id][version].to_json()
+                    doc["active"] = version == active
+                    out.append(doc)
+            return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def promote(self, model_id: str, version: str) -> tuple:
+        """Atomically flip the active alias of ``model_id`` to
+        ``version``. Returns ``(old_version, new_version)`` — the old
+        may equal the new (idempotent re-promote) or be None (first
+        activation)."""
+        with self._lock:
+            if version not in self._entries.get(model_id, {}):
+                raise UnknownModelError(
+                    f"cannot promote {model_id!r} to unregistered "
+                    f"version {version!r}")
+            old = self._active.get(model_id)
+            self._active[model_id] = version
+            return old, version
+
+    def unload(self, model_id: str, version: Optional[str] = None,
+               forget: bool = False) -> ModelEntry:
+        """Release ``version`` (default: active): drop the model object
+        (the fitted arrays — the memory that matters) and mark the entry
+        UNLOADED, keeping its metadata for audit unless ``forget``.
+        Unloading the active version clears the alias — routing to the
+        id fails until another version is promoted."""
+        entry = self.get(model_id, version)
+        with self._lock:
+            entry.model = None
+            entry.state = ModelState.UNLOADED
+            if self._active.get(model_id) == entry.version:
+                del self._active[model_id]
+            if forget:
+                self._entries[model_id].pop(entry.version, None)
+                if not self._entries[model_id]:
+                    del self._entries[model_id]
+        return entry

@@ -62,15 +62,21 @@ class ScoringServer:
                  donate: Optional[bool] = None,
                  metrics_max_samples: int = 8192,
                  metrics_port: Optional[int] = None,
-                 metrics_host: str = "127.0.0.1"):
+                 metrics_host: str = "127.0.0.1",
+                 program_cache=None, fingerprint: Optional[str] = None):
         self.model = model
         self.strict = strict
         self.required_keys = required_raw_keys(model)
         self.retries = int(retries)
         self.retry_backoff_s = float(retry_backoff_s)
         self.probe_interval_s = float(probe_interval_s)
+        #: fleet seam: with ``program_cache`` (serving/fleet.ProgramCache)
+        #: this server's fused programs live in the shared cross-model LRU
+        #: keyed by ``fingerprint`` (see CompiledScorer)
         self.scorer = CompiledScorer(model, max_batch=max_batch,
-                                     min_bucket=min_bucket, donate=donate)
+                                     min_bucket=min_bucket, donate=donate,
+                                     program_cache=program_cache,
+                                     fingerprint=fingerprint)
         self.row_score = make_score_function(model, strict=False)
         self.batcher = MicroBatcher(
             self._dispatch, max_batch=max_batch, max_wait_ms=max_wait_ms,
@@ -93,6 +99,14 @@ class ScoringServer:
         self.metrics_http = None
         self._metrics_port = metrics_port
         self._metrics_host = metrics_host
+        #: lifecycle for fleet readiness reporting: warming -> ready ->
+        #: (draining ->) stopped; "degraded" overlays ready while the row
+        #: path serves (see the ``state`` property)
+        self._lifecycle = "warming"
+        #: per-bucket compile counts at the end of start()'s warmup — the
+        #: baseline ``post_warmup_compiles`` subtracts, making "did
+        #: steady-state traffic recompile?" a one-call question
+        self._warmup_compiles: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_row: Optional[dict] = None,
@@ -126,10 +140,15 @@ class ScoringServer:
                 port=self._metrics_port,
                 host=self._metrics_host).start()
         self.batcher.start()
+        self._warmup_compiles = dict(self.scorer.counters
+                                     .compiles_by_bucket())
+        self._lifecycle = "ready"
         return self
 
     def stop(self, drain: bool = True) -> None:
+        self._lifecycle = "draining"
         self.batcher.stop(drain=drain)
+        self._lifecycle = "stopped"
         if self.metrics_http is not None:
             self.metrics_http.stop()
             self.metrics_http = None
@@ -143,6 +162,24 @@ class ScoringServer:
     @property
     def degraded(self) -> bool:
         return self._degraded_since is not None
+
+    @property
+    def state(self) -> str:
+        """warming | ready | degraded | draining | stopped — the
+        readiness word ``/healthz`` reports per model."""
+        if self._lifecycle != "ready":
+            return self._lifecycle
+        return "degraded" if self.degraded else "ready"
+
+    def post_warmup_compiles(self) -> dict:
+        """Per-bucket fused-program compiles since start()'s warmup — the
+        compile-storm bound: 0 everywhere means steady-state traffic
+        never recompiled (cache evictions under a too-small shared
+        budget show up here as recompiles)."""
+        now = self.scorer.counters.compiles_by_bucket()
+        return {b: n - self._warmup_compiles.get(b, 0)
+                for b, n in now.items()
+                if n - self._warmup_compiles.get(b, 0)}
 
     # -- request API ---------------------------------------------------------
     def submit(self, row: dict,
@@ -166,24 +203,14 @@ class ScoringServer:
     def submit_blocking(self, row: dict,
                         timeout_ms: Optional[float] = None,
                         max_wait_s: Optional[float] = None) -> Future:
-        """``submit`` that absorbs backpressure: on a full queue, wait out
-        the retry-after hint (capped at 0.5s per attempt, ``max_wait_s``
-        overall) and retry. The shared client loop for replay drivers
-        (runner SERVE, ``cli serve``); strict-validation ``KeyError``
-        still raises immediately."""
-        deadline = None if max_wait_s is None \
-            else time.monotonic() + max_wait_s
-        while True:
-            try:
-                return self.submit(row, timeout_ms=timeout_ms)
-            except BackpressureError as e:
-                wait = min(e.retry_after_s, 0.5)
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise
-                    wait = min(wait, remaining)
-                time.sleep(wait)
+        """``submit`` that absorbs backpressure
+        (``batcher.absorb_backpressure``): the client loop for replay
+        drivers (runner SERVE, ``cli serve``); strict-validation
+        ``KeyError`` still raises immediately."""
+        from transmogrifai_tpu.serving.batcher import absorb_backpressure
+        return absorb_backpressure(
+            lambda: self.submit(row, timeout_ms=timeout_ms),
+            max_wait_s=max_wait_s)
 
     def score(self, row: dict, timeout_s: Optional[float] = None) -> dict:
         return self.submit(row).result(timeout=timeout_s)
@@ -304,4 +331,7 @@ class ScoringServer:
             "donate": self.scorer.donate,
         }
         doc["degraded"]["active"] = self.degraded
+        doc["state"] = self.state
+        doc["postWarmupCompiles"] = {
+            str(b): n for b, n in self.post_warmup_compiles().items()}
         return doc

@@ -20,6 +20,9 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``checkpoint.write``      any durable checkpoint write (train/sweep/stream)
 ``collective``            multihost barrier / global-array assembly
 ``serving.dispatch``      one compiled serving batch dispatch
+``serving.swap``          mid-fleet-hot-swap (candidate warm, alias not
+                          yet flipped — the abort path must leave the old
+                          version serving with zero drops)
 ========================  ====================================================
 
 Plan syntax (env ``TRANSMOGRIFAI_FAULT_PLAN`` or programmatic), entries
@@ -61,7 +64,7 @@ __all__ = ["FaultPlan", "FaultSpec", "FaultHarnessError",
 #: the instrumented site names (documentation + parse-time validation)
 KNOWN_SITES = frozenset({
     "dag.apply_layer", "sweep.fit", "train.layer", "ingest.read",
-    "checkpoint.write", "collective", "serving.dispatch",
+    "checkpoint.write", "collective", "serving.dispatch", "serving.swap",
 })
 
 KINDS = ("transient", "io", "slow", "preempt")

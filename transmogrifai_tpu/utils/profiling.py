@@ -415,6 +415,11 @@ class ServingBucketCounters:
     """Per-padding-bucket online-serving observability (``ServingCounters``)."""
     compiles: int = 0    # XLA backend compiles while this bucket dispatched
     dispatches: int = 0  # fused-program invocations padded to this bucket
+    #: shared-cache entries for this bucket dropped by the fleet cache's
+    #: HBM-budget LRU (serving/fleet.ProgramCache) — a nonzero steady
+    #: state means the budget is too small for the working set and the
+    #: next dispatch at this bucket pays a recompile
+    evictions: int = 0
 
 
 class ServingCounters:
@@ -447,16 +452,21 @@ class ServingCounters:
         return self.buckets.setdefault(int(size), ServingBucketCounters())
 
     def count(self, size: int, *, dispatches: int = 0,
-              compiles: int = 0) -> None:
+              compiles: int = 0, evictions: int = 0) -> None:
         c = self.bucket(size)
         c.dispatches += dispatches
         c.compiles += compiles
+        c.evictions += evictions
 
     def compiles_by_bucket(self) -> dict:
         return {b: c.compiles for b, c in sorted(self.buckets.items())}
 
+    def evictions_by_bucket(self) -> dict:
+        return {b: c.evictions for b, c in sorted(self.buckets.items())}
+
     def to_json(self) -> dict:
-        return {str(b): {"compiles": c.compiles, "dispatches": c.dispatches}
+        return {str(b): {"compiles": c.compiles, "dispatches": c.dispatches,
+                         "evictions": c.evictions}
                 for b, c in sorted(self.buckets.items())}
 
 

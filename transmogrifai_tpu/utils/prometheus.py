@@ -190,7 +190,17 @@ def _app_collectors(reg: PromRegistry) -> None:
                             for name, fc in sc.families.items()])
 
 
-def _serving_collectors(reg: PromRegistry, serving, server=None) -> None:
+def _serving_collectors(reg: PromRegistry, lanes_fn) -> None:
+    """The serving series over ``lanes_fn() -> [(labels, ServingMetrics),
+    ...]`` — one sample set per lane. A single ``ScoringServer`` is the
+    one-lane, no-labels case; a ``FleetServer`` emits the SAME series
+    once per model with a ``model`` label, so dashboards aggregate or
+    split without a second naming scheme."""
+    def per_lane(attr: str):
+        def collect():
+            return [(labels, getattr(m, attr)) for labels, m in lanes_fn()]
+        return collect
+
     for attr, name, help_ in (
             ("admitted", "requests_admitted", "requests accepted at the "
                                               "door"),
@@ -208,65 +218,138 @@ def _serving_collectors(reg: PromRegistry, serving, server=None) -> None:
                                                      "entries"),
             ("recoveries", "recoveries", "compiled-path recoveries"),
             ("dispatch_retries", "dispatch_retries", "transient dispatch "
-                                                     "retries")):
+                                                     "retries"),
+            ("batch_wall_s", "batch_wall_seconds", "cumulative batch "
+                                                   "dispatch wall")):
         reg.register(f"transmogrifai_serving_{name}_total", "counter",
-                     help_, lambda a=attr: [({}, getattr(serving, a))])
+                     help_, per_lane(attr))
     reg.register(
         "transmogrifai_serving_rejected_total", "counter",
         "requests rejected at admission, by reason",
-        lambda: [({"reason": "backpressure"}, serving.rejected_backpressure),
-                 ({"reason": "invalid"}, serving.rejected_invalid)])
-    reg.register(
-        "transmogrifai_serving_batch_wall_seconds_total", "counter",
-        "cumulative batch dispatch wall",
-        lambda: [({}, serving.batch_wall_s)])
+        lambda: [({**labels, "reason": "backpressure"},
+                  m.rejected_backpressure)
+                 for labels, m in lanes_fn()]
+               + [({**labels, "reason": "invalid"}, m.rejected_invalid)
+                  for labels, m in lanes_fn()])
     reg.register(
         "transmogrifai_serving_latency_seconds", "histogram",
         "request latency, admission to settlement",
-        lambda: [({}, serving.latency_histogram())])
+        lambda: [(labels, m.latency_histogram())
+                 for labels, m in lanes_fn()])
     reg.register(
         "transmogrifai_serving_queue_depth", "gauge",
         "requests waiting in the admission queue",
-        lambda: [({}, (serving.queue_depth_fn or (lambda: 0))())])
+        lambda: [(labels, (m.queue_depth_fn or (lambda: 0))())
+                 for labels, m in lanes_fn()])
     reg.register(
         "transmogrifai_serving_queue_capacity", "gauge",
         "admission queue bound",
-        lambda: [({}, serving.queue_capacity or 0)])
+        lambda: [(labels, m.queue_capacity or 0)
+                 for labels, m in lanes_fn()])
     reg.register(
         "transmogrifai_serving_degraded", "gauge",
         "1 while the server is on the degraded row path",
-        lambda: [({}, serving.degraded_active)])
+        lambda: [(labels, m.degraded_active)
+                 for labels, m in lanes_fn()])
     reg.register(
         "transmogrifai_serving_throughput_rolling_rps", "gauge",
         "completions/s over the rolling window",
-        lambda: [({}, serving.rolling_rps())])
+        lambda: [(labels, m.rolling_rps()) for labels, m in lanes_fn()])
     reg.register(
         "transmogrifai_serving_throughput_lifetime_rps", "gauge",
         "completions/s since server start",
-        lambda: [({}, serving.throughput_rps())])
-    cc = serving.compile_counters
-    if cc is not None:
-        reg.register(
-            "transmogrifai_serving_compiles_total", "counter",
-            "fused-program compiles per padding bucket",
-            lambda: [({"bucket": str(b)}, c.compiles)
-                     for b, c in sorted(cc.buckets.items())])
-        reg.register(
-            "transmogrifai_serving_dispatches_total", "counter",
-            "batch dispatches per padding bucket",
-            lambda: [({"bucket": str(b)}, c.dispatches)
-                     for b, c in sorted(cc.buckets.items())])
+        lambda: [(labels, m.throughput_rps())
+                 for labels, m in lanes_fn()])
+
+    def per_bucket(attr: str):
+        def collect():
+            out = []
+            for labels, m in lanes_fn():
+                cc = m.compile_counters
+                if cc is None:
+                    continue
+                out.extend(({**labels, "bucket": str(b)},
+                            getattr(c, attr))
+                           for b, c in sorted(cc.buckets.items()))
+            return out
+        return collect
+
+    reg.register("transmogrifai_serving_compiles_total", "counter",
+                 "fused-program compiles per padding bucket",
+                 per_bucket("compiles"))
+    reg.register("transmogrifai_serving_dispatches_total", "counter",
+                 "batch dispatches per padding bucket",
+                 per_bucket("dispatches"))
+    reg.register("transmogrifai_serving_cache_evictions_total", "counter",
+                 "shared-cache entries evicted per padding bucket (the "
+                 "next dispatch at that bucket recompiles)",
+                 per_bucket("evictions"))
 
 
-def build_registry(serving=None, server=None,
+def _fleet_collectors(reg: PromRegistry, fleet) -> None:
+    """Fleet-level series: swap lifecycle, shared compiled-program cache
+    accounting, per-model state — plus every serving series labeled
+    ``model=<id>`` via ``_serving_collectors`` over the active lanes."""
+    _serving_collectors(
+        reg, lambda: [({"model": mid}, lane.metrics)
+                      for mid, lane in sorted(
+                          fleet.active_lanes().items())])
+    fm = fleet.metrics
+    for attr, name, help_ in (
+            ("swaps", "swaps", "completed zero-downtime hot-swaps"),
+            ("swap_failures", "swap_failures", "aborted hot-swaps (old "
+                                               "version kept serving)"),
+            ("shadow_parity_failures", "shadow_parity_failures",
+             "hot-swaps aborted by the shadow-scoring parity gate"),
+            ("models_registered", "models_registered", "registry "
+                                                       "registrations"),
+            ("models_unloaded", "models_unloaded", "registry unloads")):
+        reg.register(f"transmogrifai_fleet_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(fm, a))])
+    cache = fleet.program_cache
+    for attr, name, help_ in (
+            ("hits", "cache_hits", "shared compiled-program cache hits"),
+            ("insertions", "cache_insertions", "shared-cache compiled "
+                                               "entries inserted"),
+            ("evictions", "cache_evictions", "shared-cache entries "
+             "evicted by the HBM budget LRU")):
+        reg.register(f"transmogrifai_fleet_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(cache, a))])
+    reg.register("transmogrifai_fleet_cache_bytes", "gauge",
+                 "accounted HBM bytes of cached compiled programs",
+                 lambda: [({}, cache.current_bytes)])
+    reg.register("transmogrifai_fleet_cache_budget_bytes", "gauge",
+                 "configured shared-cache HBM budget (0 = unbounded)",
+                 lambda: [({}, cache.budget_bytes or 0)])
+    reg.register("transmogrifai_fleet_cache_entries", "gauge",
+                 "live shared-cache entries",
+                 lambda: [({}, len(cache))])
+    reg.register("transmogrifai_fleet_models", "gauge",
+                 "models with a running active lane",
+                 lambda: [({}, len(fleet.active_lanes()))])
+    reg.register(
+        "transmogrifai_fleet_model_state", "gauge",
+        "1 for each model's current readiness state",
+        lambda: [({"model": mid, "state": lane.state}, 1)
+                 for mid, lane in sorted(fleet.active_lanes().items())])
+
+
+def build_registry(serving=None, server=None, fleet=None,
                    include_app: bool = True) -> PromRegistry:
     """The standard registry: process-wide training/run/sweep series
-    (``include_app``) plus, when a ``ServingMetrics`` is given, the full
-    serving surface. ``server`` (a ``ScoringServer``) is optional extra
-    context reserved for future gauges."""
+    (``include_app``) plus the full serving surface — unlabeled for one
+    ``ServingMetrics`` (``serving``), ``model``-labeled per lane plus the
+    fleet swap/cache series for a ``FleetServer`` (``fleet``; mutually
+    exclusive with ``serving``). ``server`` (a ``ScoringServer``) is
+    optional extra context reserved for future gauges."""
+    if serving is not None and fleet is not None:
+        raise ValueError("pass serving= or fleet=, not both (the serving "
+                         "series would collide)")
     reg = PromRegistry()
     if include_app:
         _app_collectors(reg)
     if serving is not None:
-        _serving_collectors(reg, serving, server)
+        _serving_collectors(reg, lambda: [({}, serving)])
+    if fleet is not None:
+        _fleet_collectors(reg, fleet)
     return reg
