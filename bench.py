@@ -195,6 +195,7 @@ def run_pipeline(n_rows: int, trace: bool = False) -> dict:
             "flops": flops.totals(),
             "peak_flops": flops.peak_flops_per_s(),
             "sweep_counters": sweep_counters.to_json(),
+            "sweep_run_counters": sweep_counters.run_to_json(),
             "resumed": resumed}
 
 

@@ -81,6 +81,74 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_tree_stacked(doc))
     if doc.get("metric") == "serving_fleet":
         errors.extend(_validate_serving_fleet(doc))
+    if doc.get("metric") == "one_sync_sweep":
+        errors.extend(_validate_one_sync(doc))
+    return errors
+
+
+#: warm-vs-cold winner-refit metric tolerance for the one-sync sweep
+#: artifact: a converged convex refit must land on the cold optimum
+MAX_REFIT_PARITY = 1e-5
+
+
+def _validate_one_sync(doc: dict) -> list[str]:
+    """The ``benchmarks/ONE_SYNC_SWEEP.json`` contract (round 9): three
+    measured whole-train walls (per-family settle / one-sync / one-sync +
+    warm refit), counter-backed sync structure — the async stacked path
+    must record exactly ONE blocking host sync for the entire sweep while
+    the per-family path records one per family — at least one warm-
+    started refit, and metric parity: the sweep's validation metrics
+    identical across modes, the warm refit's train/holdout metrics within
+    ``MAX_REFIT_PARITY`` of the cold serial refit."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    for k in ("per_family_settle_s", "one_sync_s", "one_sync_warm_refit_s"):
+        if not (num(doc.get(k)) and doc[k] > 0):
+            errors.append(f"one-sync artifact: missing positive {k!r}")
+    if not num(doc.get("speedup_vs_per_family")):
+        errors.append("one-sync artifact: missing numeric "
+                      "'speedup_vs_per_family'")
+    syncs = doc.get("total_host_syncs")
+    if not (isinstance(syncs, dict) and all(
+            isinstance(syncs.get(k), int) and not isinstance(
+                syncs.get(k), bool)
+            for k in ("per_family_settle", "one_sync", "one_sync_warm"))):
+        errors.append("one-sync artifact: 'total_host_syncs' must map "
+                      "per_family_settle/one_sync/one_sync_warm to ints")
+    else:
+        if syncs["one_sync"] != 1 or syncs["one_sync_warm"] != 1:
+            errors.append(
+                f"one-sync contract violated: the async stacked sweep "
+                f"recorded {syncs['one_sync']}/{syncs['one_sync_warm']} "
+                "blocking host syncs (must be exactly 1)")
+        fams = doc.get("families")
+        if isinstance(fams, int) and syncs["per_family_settle"] < fams:
+            errors.append(
+                "one-sync artifact: the per-family-settle leg must record "
+                "at least one sync per family (the baseline being beaten)")
+    if not (isinstance(doc.get("refit_warm_starts"), int)
+            and doc.get("refit_warm_starts", 0) >= 1):
+        errors.append("one-sync artifact: 'refit_warm_starts' must be "
+                      ">= 1 — the warm leg must actually warm-start")
+    vp = doc.get("validation_parity")
+    if not num(vp):
+        errors.append("one-sync artifact: missing numeric "
+                      "'validation_parity'")
+    elif vp != 0.0:
+        errors.append(
+            f"one-sync artifact: validation metrics drifted ({vp}) across "
+            "settle modes — async settling must not change values")
+    rp = doc.get("refit_parity")
+    if not num(rp):
+        errors.append("one-sync artifact: missing numeric 'refit_parity'")
+    elif rp > MAX_REFIT_PARITY:
+        errors.append(
+            f"warm-refit metric parity {rp} exceeds {MAX_REFIT_PARITY} — "
+            "the warm-started winner landed on a different model, not the "
+            "same refit faster")
     return errors
 
 

@@ -232,6 +232,53 @@ def test_tree_stacked_artifact_schema_rejections(checker):
         {**good, "host_syncs": {"tree_stacked": 1}}))
 
 
+def test_one_sync_artifact_committed_and_healthy(checker):
+    """Round 9's acceptance contract, pinned on the COMMITTED artifact:
+    the async stacked sweep records exactly ONE blocking host sync for
+    the whole train() (vs >= one per family on the per-family-settle
+    leg), at least one refit actually warm-started, validation metrics
+    are bit-equal across settle modes, and the warm refit's metrics are
+    within 1e-5 of the cold serial refit."""
+    path = os.path.join(REPO, "benchmarks", "ONE_SYNC_SWEEP.json")
+    assert os.path.exists(path), \
+        "benchmarks/ONE_SYNC_SWEEP.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "one_sync_sweep"
+    syncs = art["total_host_syncs"]
+    assert syncs["one_sync"] == 1 and syncs["one_sync_warm"] == 1
+    assert syncs["per_family_settle"] >= art["families"] >= 2
+    assert art["async_families"] == art["families"]
+    assert art["refit_warm_starts"] >= 1
+    assert art["validation_parity"] == 0.0
+    assert art["refit_parity"] <= checker.MAX_REFIT_PARITY
+
+
+def test_one_sync_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = {"metric": "one_sync_sweep", "platform": "cpu", "rows": 60000,
+            "families": 2, "per_family_settle_s": 2.0, "one_sync_s": 1.0,
+            "one_sync_warm_refit_s": 1.0, "speedup_vs_per_family": 2.0,
+            "total_host_syncs": {"per_family_settle": 2, "one_sync": 1,
+                                 "one_sync_warm": 1},
+            "refit_warm_starts": 1, "validation_parity": 0.0,
+            "refit_parity": 0.0}
+    assert v(good) == []
+    assert any("exactly 1" in e for e in v(
+        {**good, "total_host_syncs": {"per_family_settle": 2,
+                                      "one_sync": 2, "one_sync_warm": 1}}))
+    assert any("per family" in e for e in v(
+        {**good, "total_host_syncs": {"per_family_settle": 1,
+                                      "one_sync": 1, "one_sync_warm": 1}}))
+    assert any("warm" in e for e in v({**good, "refit_warm_starts": 0}))
+    assert any("drifted" in e for e in v(
+        {**good, "validation_parity": 1e-6}))
+    assert any("parity" in e for e in v({**good, "refit_parity": 1e-3}))
+    bad = dict(good)
+    del bad["one_sync_warm_refit_s"]
+    assert any("one_sync_warm_refit_s" in e for e in v(bad))
+
+
 def test_serving_fleet_artifact_schema_rejections(checker):
     v = checker.validate_artifact
     good = {"metric": "serving_fleet", "platform": "cpu",

@@ -365,6 +365,67 @@ def test_transient_fault_inside_stacked_tree_group(monkeypatch):
     del ref_summary
 
 
+def test_async_dispatch_transient_retries_only_affected_family(monkeypatch):
+    """Round 9: a transient fault injected mid-async-dispatch (the 2nd
+    family's ``sweep.fit`` site) retries ONLY that family's program —
+    every family still dispatches exactly once (zero duplicate work), the
+    whole sweep settles behind its single barrier, and metrics match the
+    fault-free async run bitwise."""
+    from transmogrifai_tpu.utils.profiling import sweep_counters
+    monkeypatch.setenv("TRANSMOGRIFAI_SWEEP_STACKED", "1")
+    ref = _reference_scores(families=2)
+
+    UID.reset()
+    wf, host, pred = _build_workflow(families=2)
+    with fault_plan("transient@sweep.fit#1x1") as plan:
+        model = wf.train()
+    assert [f[2] for f in plan.fired] == ["transient"]
+    assert run_counters.retries >= 1
+    np.testing.assert_array_equal(_probs(model, host, pred), ref)
+    assert model.selector_summary().failures == []
+    c = sweep_counters.to_json()
+    # zero duplicate work: the un-faulted family was not re-dispatched
+    assert c["OpLogisticRegression_0"]["deviceDispatches"] == 1
+    assert c["OpLogisticRegression_1"]["deviceDispatches"] == 1
+    run = sweep_counters.run_to_json()
+    assert run["asyncFamilies"] == 2
+    assert run["sweepHostSyncs"] == 1, run
+
+
+def test_refit_preemption_resumes_from_refit_checkpoint(tmp_path,
+                                                        monkeypatch):
+    """Round 9: a preemption at the ``selector.refit`` seam (after the
+    refit checkpoint write, before evaluation) kills the run; the rerun
+    replays the sweep from ``sweep.json`` AND restores the winner from
+    its shape-keyed refit entry — the winner is never retrained, and
+    scores match the uninterrupted run bitwise."""
+    monkeypatch.setenv("TRANSMOGRIFAI_SWEEP_STACKED", "1")
+    ckpt = str(tmp_path / "ck")
+    ref = _reference_scores()
+
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    with fault_plan("preempt@selector.refit#0"):
+        with pytest.raises(SimulatedPreemption):
+            wf.train(checkpoint_dir=ckpt)
+    assert os.path.exists(os.path.join(ckpt, "refit.json"))
+    assert os.path.exists(os.path.join(ckpt, "refit.npz"))
+
+    fits = {"n": 0}
+    orig = OpLogisticRegression.fit_arrays
+
+    def counting(self, *a, **kw):
+        fits["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(OpLogisticRegression, "fit_arrays", counting)
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    model = wf.train(checkpoint_dir=ckpt)
+    assert fits["n"] == 0  # sweep replayed + refit restored: zero fits
+    np.testing.assert_array_equal(_probs(model, host, pred), ref)
+
+
 def test_stacked_tree_group_span_nests_under_sweep(monkeypatch):
     """The per-group span replaces the per-(family, fold) spans on the
     tree fast path: it carries k/lanes/depth attrs and nests under

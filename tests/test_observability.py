@@ -280,7 +280,79 @@ def test_sweep_and_ingest_spans_recorded():
     runner.run(RunTypes.TRAIN, OpParams())
     names = {s.name for s in recorder.spans}
     assert {"workflow.ingest", "reader.generate_frame", "stage.fit",
-            "selector.sweep", "sweep.fold_unit"} <= names
+            "selector.sweep", "sweep.dispatch", "sweep.fold_unit"} <= names
+
+
+def test_one_sync_sweep_span_nesting(monkeypatch):
+    """Round 9 span topology: the dispatch/settle phases nest under
+    ``selector.sweep`` with every ``sweep.family`` a child of
+    ``sweep.dispatch`` (families overlap; the chrome trace shows one
+    dispatch burst then one settle instead of serialized family blocks),
+    and the stacked winner refit opens ``selector.refit_stacked`` under
+    ``selector.refit``."""
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.models.linear import (
+        OpLinearSVC, OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.utils.profiling import profiler
+    from transmogrifai_tpu.utils.tracing import recorder
+    from transmogrifai_tpu.workflow import Workflow
+
+    monkeypatch.setenv("TRANSMOGRIFAI_SWEEP_STACKED", "1")
+    profiler.reset()
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=N)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-1.5 * x))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x": (ft.Real, x.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x"]])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, seed=2, models_and_parameters=[
+            (OpLogisticRegression(max_iter=10),
+             [{"reg_param": r} for r in (0.01, 0.1)]),
+            (OpLinearSVC(max_iter=10), [{"reg_param": 0.01}]),
+        ])
+    pred = feats["y"].transform_with(sel, features)
+    (Workflow().set_input_frame(frame)
+     .set_result_features(pred, features).train())
+
+    spans = recorder.spans
+    by_id = {s.span_id: s for s in spans}
+
+    def ancestors(s):
+        out, pid = [], s.parent_id
+        while pid is not None:
+            out.append(by_id[pid].name)
+            pid = by_id[pid].parent_id
+        return out
+
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    assert {"sweep.dispatch", "sweep.settle", "sweep.family",
+            "selector.refit_stacked"} <= set(by_name), sorted(by_name)
+    for s in by_name["sweep.dispatch"] + by_name["sweep.settle"]:
+        assert "selector.sweep" in ancestors(s), ancestors(s)
+    fams = by_name["sweep.family"]
+    assert len(fams) == 2
+    for s in fams:
+        assert by_id[s.parent_id].name == "sweep.dispatch"
+    # the settle span accounts every dispatched family
+    settle = by_name["sweep.settle"][0]
+    assert settle.attrs["families"] == 2
+    # both families' dispatch spans CLOSE before the settle opens —
+    # the overlap the chrome trace renders
+    assert max(s.t1 for s in fams) <= settle.t0
+    for s in by_name["selector.refit_stacked"]:
+        assert "selector.refit" in ancestors(s), ancestors(s)
+        assert "selector.sweep" not in ancestors(s)
 
 
 # -- serving /metrics end-to-end ----------------------------------------------

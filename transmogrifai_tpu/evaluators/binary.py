@@ -227,12 +227,20 @@ class OpBinaryClassificationEvaluator(EvaluatorBase):
         return np.asarray(_metric_batch(y, jnp.asarray(scores, jnp.float32),
                                         w, metric or self.default_metric))
 
+    def metric_batch_scores_folds_device(self, y, scores, metric=None,
+                                         w=None):
+        """Fold-stacked metric batch WITHOUT the host pull: returns the
+        ``[k, G]`` metric values as a device array future. The one-sync
+        sweep dispatches every family's metric program through this and
+        settles them all behind a single ``jax.block_until_ready``."""
+        y = jnp.asarray(y, jnp.float32)
+        w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
+        return _metric_batch_folds(y, jnp.asarray(scores, jnp.float32), w,
+                                   metric or self.default_metric)
+
     def metric_batch_scores_folds(self, y, scores, metric=None,
                                   w=None) -> np.ndarray:
         """Fold-stacked sweep path: ``y [k, n]`` per-fold labels, ``scores
         [k, G, n]`` margins -> ``[k, G]`` metric values, one host sync."""
-        y = jnp.asarray(y, jnp.float32)
-        w = jnp.ones_like(y) if w is None else jnp.asarray(w, jnp.float32)
-        return np.asarray(_metric_batch_folds(
-            y, jnp.asarray(scores, jnp.float32), w,
-            metric or self.default_metric))
+        return np.asarray(self.metric_batch_scores_folds_device(
+            y, scores, metric, w))

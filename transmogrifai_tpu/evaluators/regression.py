@@ -77,10 +77,10 @@ class OpRegressionEvaluator(EvaluatorBase):
             out = 1.0 - jnp.sum(err ** 2, axis=1) / ss_tot
         return np.asarray(out)
 
-    def metric_batch_scores_folds(self, y, preds, metric=None,
-                                  w=None) -> np.ndarray:
-        """Fold-stacked sweep path: ``y [k, n]`` per-fold labels, ``preds
-        [k, G, n]`` -> ``[k, G]`` metric values, one host sync. Same row
+    def metric_batch_scores_folds_device(self, y, preds, metric=None,
+                                         w=None):
+        """Fold-stacked metric batch WITHOUT the host pull (``[k, G]``
+        device array) — the one-sync sweep's dispatch unit; same row
         reductions as ``metric_batch_scores`` per fold lane."""
         metric = metric or self.default_metric
         y = jnp.asarray(y, jnp.float32)[:, None, :]   # [k, 1, n]
@@ -98,4 +98,11 @@ class OpRegressionEvaluator(EvaluatorBase):
                 jnp.sum((y - jnp.mean(y, axis=2, keepdims=True)) ** 2,
                         axis=2), 1e-12)               # [k, 1]
             out = 1.0 - jnp.sum(err ** 2, axis=2) / ss_tot
-        return np.asarray(out)
+        return out
+
+    def metric_batch_scores_folds(self, y, preds, metric=None,
+                                  w=None) -> np.ndarray:
+        """Fold-stacked sweep path: ``y [k, n]`` per-fold labels, ``preds
+        [k, G, n]`` -> ``[k, G]`` metric values, one host sync."""
+        return np.asarray(self.metric_batch_scores_folds_device(
+            y, preds, metric, w))

@@ -27,7 +27,22 @@ from transmogrifai_tpu.stages.base import (
 from transmogrifai_tpu.types import feature_types as ft
 
 __all__ = ["Predictor", "PredictionModel", "supports_fold_stacking",
-           "supports_tree_stacking"]
+           "supports_tree_stacking", "compile_refit"]
+
+
+def compile_refit(fn, *, donate_argnums: tuple[int, ...] = (),
+                  static_argnames: tuple[str, ...] = ()):
+    """Compile a warm-refit program with its initial-parameter buffers
+    DONATED (round 9): the stacked fold parameters feeding the winner's
+    warm start are dead after the refit consumes them, so donation lets
+    XLA reuse their device storage for the refit's own parameter arrays
+    in place instead of holding both copies live. Donation is a no-op
+    (and a warning) on backends without buffer aliasing — plain CPU — so
+    it is applied only where the runtime honors it."""
+    import jax
+    donate = donate_argnums if jax.default_backend() != "cpu" else ()
+    return jax.jit(fn, donate_argnums=donate,
+                   static_argnames=static_argnames)
 
 
 class Predictor(Estimator):
@@ -115,18 +130,71 @@ class Predictor(Estimator):
         intermediates (hidden activations) override."""
         return 4
 
-    def grid_scores_folds(self, X, y, w, grid: Sequence[dict], Xva):
+    def grid_scores_folds(self, X, y, w, grid: Sequence[dict], Xva,
+                          _n_classes: Optional[int] = None):
         """One-call fold-stacked train+score — what the selector's fast
         path actually invokes. Default composes the two contract methods;
         families with a fully-stacked trainer override to go straight from
         stacked parameters to stacked scores, skipping the per-(fold, grid)
         model materialization round trip entirely (the sweep discards the
         models anyway — the winner refits later). Returns ``[k, G, n_va]``
-        scores or None when the family can't serve the stacked path."""
-        models = self.grid_fit_arrays_folds(X, y, w, grid)
+        scores or None when the family can't serve the stacked path.
+        ``_n_classes`` threads the selector's once-per-sweep class count
+        to stacked trainers that accept it (signature-gated so custom
+        overrides with the old arity keep working)."""
+        import inspect
+        kw = {}
+        if _n_classes is not None and "_n_classes" in \
+                inspect.signature(self.grid_fit_arrays_folds).parameters:
+            kw["_n_classes"] = _n_classes
+        models = self.grid_fit_arrays_folds(X, y, w, grid, **kw)
         if models is None:
             return None
         return self.grid_predict_scores_folds(models, Xva)
+
+    def grid_scores_folds_retained(self, X, y, w, grid: Sequence[dict],
+                                   Xva, _n_classes: Optional[int] = None):
+        """One-sync sweep dispatch unit (round 9): like
+        ``grid_scores_folds`` but additionally returns an opaque
+        warm-start handle — the family's stacked fold parameters, kept
+        device-resident so the winner refit can initialize from them
+        (``refit_winner``) — as ``(scores, warm)``. ``warm`` is ``None``
+        when the family has nothing reusable (closed-form fits, custom
+        overrides). ``_n_classes`` threads the selector's once-per-sweep
+        label-class count so the dispatch phase issues no per-family
+        blocking device pull; families whose stacked trainers accept it
+        receive it, others compute their own (the pre-round-9 behavior).
+
+        Default: delegate to ``grid_scores_folds`` (honoring subclass
+        overrides of it) with no warm handle."""
+        import inspect
+        kw = {}
+        if _n_classes is not None and "_n_classes" in \
+                inspect.signature(self.grid_scores_folds).parameters:
+            kw["_n_classes"] = _n_classes
+        return self.grid_scores_folds(X, y, w, grid, Xva, **kw), None
+
+    # -- winner refit (round 9) ----------------------------------------------
+    def refit_winner(self, X, y, w, params: dict, *, warm=None,
+                     lane: Optional[int] = None, hints: Optional[dict] = None
+                     ) -> tuple["PredictionModel", bool]:
+        """Refit the sweep winner on the full prepared training data.
+        ``warm`` is the handle ``grid_scores_folds_retained`` returned for
+        this family (stacked fold parameters), ``lane`` the winning grid
+        index into it, ``hints`` selector-provided reuse state (trees: the
+        dataset-level ``bin_plans``). Returns ``(model, warm_used)`` —
+        families that can initialize from the fold parameters (or skip
+        recomputing sweep byproducts) override; the default is the exact
+        cold refit the serial path always ran, so refit results without an
+        override stay bitwise-identical."""
+        return self.fit_arrays(X, y, w, params), False
+
+    def supports_warm_refit(self) -> bool:
+        """True when ``refit_winner`` can actually use a ``warm`` handle —
+        the selector retains a family's stacked fold parameters past the
+        sweep ONLY then (holding them until the refit costs HBM, so
+        families with cold refits must not opt in)."""
+        return False
 
     def fit_model(self, data) -> "PredictionModel":
         X, y, w = self._xyw(data)

@@ -128,12 +128,17 @@ class OpNaiveBayes(Predictor):
         return logits[..., 1] - logits[..., 0]
 
     # -- fold-stacked sweep --------------------------------------------------
-    def grid_fit_arrays_folds(self, X, y, w, grid):
+    def grid_fit_arrays_folds(self, X, y, w, grid, _n_classes=None):
         """Closed-form fit vmapped over (fold x smoothing grid) — one
-        program for the whole family sweep; model params stay on device."""
+        program for the whole family sweep; model params stay on device.
+        ``_n_classes`` elides the class-count sync on the one-sync
+        dispatch path (the selector's once-per-sweep hint). NB's refit
+        stays the cold closed form — a one-matmul fit has nothing to warm
+        start."""
         if not grid:
             return []
-        n_classes = max(int(np.asarray(jnp.max(y))) + 1, 2)  # one sync
+        n_classes = (int(_n_classes) if _n_classes is not None
+                     else max(int(np.asarray(jnp.max(y))) + 1, 2))
         sm = jnp.asarray([float({**self.params, **g}.get("smoothing", 1.0))
                           for g in grid], jnp.float32)
         inner = lambda Xk, yk, wk: jax.vmap(  # noqa: E731
@@ -161,18 +166,10 @@ class OpNaiveBayes(Predictor):
 # Multilayer perceptron
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("layers", "max_iter", "seed"))
-def _train_mlp(X, y, w, *, layers: tuple, max_iter: int, seed: int,
-               step_size):
-    n, d = X.shape
-    sizes = (d,) + layers
-    key = jax.random.PRNGKey(seed)
-    keys = jax.random.split(key, len(sizes) - 1)
-    params0 = []
-    for i, k in enumerate(keys):
-        scale = jnp.sqrt(2.0 / sizes[i])
-        params0.append((jax.random.normal(k, (sizes[i], sizes[i + 1]))
-                        * scale, jnp.zeros(sizes[i + 1])))
+def _mlp_descent(X, y, w, params0, *, max_iter: int, step_size):
+    """Adam descent from explicit layer parameters (shared by the cold
+    ``_train_mlp`` and the warm-started winner refit)."""
+    n = X.shape[0]
     wsum = jnp.maximum(jnp.sum(w), 1.0)
 
     def forward(params, x):
@@ -200,6 +197,44 @@ def _train_mlp(X, y, w, *, layers: tuple, max_iter: int, seed: int,
     (params, _), _ = jax.lax.scan(step, (params0, state0), None,
                                   length=max_iter)
     return params
+
+
+@functools.partial(jax.jit, static_argnames=("layers", "max_iter", "seed"))
+def _train_mlp(X, y, w, *, layers: tuple, max_iter: int, seed: int,
+               step_size):
+    d = X.shape[1]
+    sizes = (d,) + layers
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, len(sizes) - 1)
+    params0 = []
+    for i, k in enumerate(keys):
+        scale = jnp.sqrt(2.0 / sizes[i])
+        params0.append((jax.random.normal(k, (sizes[i], sizes[i + 1]))
+                        * scale, jnp.zeros(sizes[i + 1])))
+    return _mlp_descent(X, y, w, params0, max_iter=max_iter,
+                        step_size=step_size)
+
+
+def _train_mlp_from(X, y, w, params0, *, max_iter: int, step_size):
+    """Warm-started MLP refit (round 9): the same descent initialized
+    from the fold-averaged winning-lane parameters instead of a fresh
+    PRNG draw."""
+    return _mlp_descent(X, y, w, params0, max_iter=max_iter,
+                        step_size=step_size)
+
+
+_MLP_WARM = None
+
+
+def _mlp_warm_program():
+    """Donated-buffer compiled warm MLP refit (argnum 3 = the init
+    parameter pytree, consumed exactly once)."""
+    global _MLP_WARM
+    if _MLP_WARM is None:
+        from transmogrifai_tpu.models.base import compile_refit
+        _MLP_WARM = compile_refit(_train_mlp_from, donate_argnums=(3,),
+                                  static_argnames=("max_iter",))
+    return _MLP_WARM
 
 
 class MLPModel(PredictionModel):
@@ -270,14 +305,16 @@ class OpMultilayerPerceptronClassifier(Predictor):
         return 2 * max(widths) + 4
 
     # -- fold-stacked sweep --------------------------------------------------
-    def grid_fit_arrays_folds(self, X, y, w, grid):
+    def grid_fit_arrays_folds(self, X, y, w, grid, _n_classes=None):
         """Fold-stacked MLP sweep: step_size is the traced grid axis, one
         vmap-of-vmap Adam program per distinct (layers, max_iter, seed)
-        combo; fitted params stay device views."""
+        combo; fitted params stay device views. ``_n_classes`` elides the
+        class-count sync (the selector's once-per-sweep hint)."""
         if not grid:
             return []
         merged = [{**self.default_params, **self.params, **g} for g in grid]
-        n_classes = max(int(np.asarray(jnp.max(y))) + 1, 2)  # one sync
+        n_classes = (int(_n_classes) if _n_classes is not None
+                     else max(int(np.asarray(jnp.max(y))) + 1, 2))
         k = int(X.shape[0])
         models: list[list] = [[None] * len(grid) for _ in range(k)]
         by_kw: dict[tuple, list[int]] = {}
@@ -325,6 +362,61 @@ class OpMultilayerPerceptronClassifier(Predictor):
             return None
         return z[..., 1] - z[..., 0]
 
+    def grid_scores_folds_retained(self, X, y, w, grid, Xva,
+                                   _n_classes=None):
+        """One-sync dispatch unit: stacked scores plus the ``[k][G]``
+        fitted-model nest retained as the warm-refit handle (the layer
+        parameters are device views of the stacked result). A subclass
+        overriding ``grid_scores_folds`` keeps its semantics (delegate,
+        no warm handle)."""
+        if type(self).grid_scores_folds is not Predictor.grid_scores_folds:
+            return super().grid_scores_folds_retained(
+                X, y, w, grid, Xva, _n_classes=_n_classes)
+        if not grid:
+            return None, None
+        import inspect
+        kw = {}
+        if _n_classes is not None and "_n_classes" in \
+                inspect.signature(self.grid_fit_arrays_folds).parameters:
+            kw["_n_classes"] = _n_classes
+        models = self.grid_fit_arrays_folds(X, y, w, grid, **kw)
+        if models is None:
+            return None, None
+        scores = self.grid_predict_scores_folds(models, Xva)
+        if scores is None:
+            return None, None
+        return scores, models
+
+    def supports_warm_refit(self) -> bool:
+        return True
+
+    def refit_winner(self, X, y, w, params, *, warm=None, lane=None,
+                     hints=None):
+        """Full-data refit warm-started from the fold-AVERAGED layer
+        parameters of the winning lane (donated-buffer program). Falls
+        back to the cold PRNG init when the refit's layer shapes differ
+        from the sweep's (class count shifted between fold and full
+        data)."""
+        p = {**self.default_params, **self.params, **params}
+        if warm is None or lane is None:
+            return self.fit_arrays(X, y, w, p), False
+        n_classes = max(int(np.asarray(jnp.max(y))) + 1, 2)
+        sizes = (int(X.shape[1]),) + tuple(int(x) for x in p["layers"]) \
+            + (n_classes,)
+        expect = [(sizes[i], sizes[i + 1]) for i in range(len(sizes) - 1)]
+        lane_params = [row[int(lane)].params for row in warm]
+        if [tuple(np.shape(W)) for W, _ in lane_params[0]] != expect:
+            return self.fit_arrays(X, y, w, p), False
+        params0 = jax.tree_util.tree_map(
+            lambda *xs: jnp.mean(jnp.stack(
+                [jnp.asarray(x, jnp.float32) for x in xs]), axis=0),
+            *lane_params)
+        trained = _mlp_warm_program()(
+            X, y, w, params0, max_iter=int(p["max_iter"]),
+            step_size=jnp.float32(p["step_size"]))
+        return MLPModel(params=[(np.asarray(W), np.asarray(b))
+                                for W, b in trained]), True
+
 
 # ---------------------------------------------------------------------------
 # Generalized linear regression
@@ -333,16 +425,10 @@ class OpMultilayerPerceptronClassifier(Predictor):
 _FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie")
 
 
-@functools.partial(jax.jit, static_argnames=("family", "max_iter",
-                                             "fit_intercept"))
-def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
-               reg_param, var_power=jnp.float32(1.5)):
-    n, d = X.shape
-    wsum = jnp.maximum(jnp.sum(w), 1.0)
-    mu = jnp.sum(X * w[:, None], axis=0) / wsum
-    sd = jnp.sqrt(jnp.maximum(
-        jnp.sum(((X - mu) ** 2) * w[:, None], axis=0) / wsum, 1e-12))
-    Xs = (X - mu) / sd
+def _glm_descent(Xs, y, w, wsum, params0, *, family: str, max_iter: int,
+                 fit_intercept: bool, reg_param, var_power):
+    """Family-NLL Adam descent from an explicit fit-space init (shared by
+    the cold ``_train_glm`` and the warm-started winner refit)."""
 
     def nll(params):
         beta, b0 = params
@@ -367,7 +453,6 @@ def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
         return -jnp.sum(ll * w) / wsum + reg_param * 0.5 * jnp.sum(beta ** 2)
 
     opt = optax.adam(0.1)
-    params0 = (jnp.zeros(d, jnp.float32), jnp.float32(0.0))
     state0 = opt.init(params0)
 
     def step(carry, _):
@@ -380,10 +465,61 @@ def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
 
     (params, _), _ = jax.lax.scan(step, (params0, state0), None,
                                   length=max_iter)
-    beta, b0 = params
+    return params
+
+
+def _glm_fit_space(X, w):
+    wsum = jnp.maximum(jnp.sum(w), 1.0)
+    mu = jnp.sum(X * w[:, None], axis=0) / wsum
+    sd = jnp.sqrt(jnp.maximum(
+        jnp.sum(((X - mu) ** 2) * w[:, None], axis=0) / wsum, 1e-12))
+    return (X - mu) / sd, mu, sd, wsum
+
+
+@functools.partial(jax.jit, static_argnames=("family", "max_iter",
+                                             "fit_intercept"))
+def _train_glm(X, y, w, *, family: str, max_iter: int, fit_intercept: bool,
+               reg_param, var_power=jnp.float32(1.5)):
+    d = X.shape[1]
+    Xs, mu, sd, wsum = _glm_fit_space(X, w)
+    params0 = (jnp.zeros(d, jnp.float32), jnp.float32(0.0))
+    beta, b0 = _glm_descent(Xs, y, w, wsum, params0, family=family,
+                            max_iter=max_iter, fit_intercept=fit_intercept,
+                            reg_param=reg_param, var_power=var_power)
     beta_orig = beta / sd
     b_orig = b0 - jnp.sum(beta * mu / sd)
     return beta_orig, b_orig
+
+
+def _train_glm_from(X, y, w, beta_init, b_init, *, family: str,
+                    max_iter: int, fit_intercept: bool, reg_param,
+                    var_power):
+    """Warm-started GLM refit (round 9): init given in ORIGINAL feature
+    space (the fold-back space the stacked sweep parameters live in),
+    mapped into the refit data's own standardized space."""
+    Xs, mu, sd, wsum = _glm_fit_space(X, w)
+    params0 = (beta_init * sd, b_init + mu @ beta_init)
+    beta, b0 = _glm_descent(Xs, y, w, wsum, params0, family=family,
+                            max_iter=max_iter, fit_intercept=fit_intercept,
+                            reg_param=reg_param, var_power=var_power)
+    beta_orig = beta / sd
+    b_orig = b0 - jnp.sum(beta * mu / sd)
+    return beta_orig, b_orig
+
+
+_GLM_WARM = None
+
+
+def _glm_warm_program():
+    """Donated-buffer compiled warm GLM refit (argnums 3/4 = the init
+    arrays, consumed exactly once)."""
+    global _GLM_WARM
+    if _GLM_WARM is None:
+        from transmogrifai_tpu.models.base import compile_refit
+        _GLM_WARM = compile_refit(
+            _train_glm_from, donate_argnums=(3, 4),
+            static_argnames=("family", "max_iter", "fit_intercept"))
+    return _GLM_WARM
 
 
 class GLMModel(PredictionModel):
@@ -518,6 +654,59 @@ class OpGeneralizedLinearRegression(Predictor):
         if family == "binomial":
             return jax.nn.sigmoid(eta)
         return jnp.exp(eta)
+
+    def grid_scores_folds_retained(self, X, y, w, grid, Xva,
+                                   _n_classes=None):
+        """One-sync dispatch unit: stacked scores plus the ``[k][G]``
+        fitted-model nest retained as the warm-refit handle (model
+        weights are device views of the stacked result). A subclass
+        overriding ``grid_scores_folds`` keeps its semantics (delegate,
+        no warm handle)."""
+        if type(self).grid_scores_folds is not Predictor.grid_scores_folds:
+            return super().grid_scores_folds_retained(
+                X, y, w, grid, Xva, _n_classes=_n_classes)
+        if not grid:
+            return None, None
+        models = self.grid_fit_arrays_folds(X, y, w, grid)
+        if models is None:
+            return None, None
+        scores = self.grid_predict_scores_folds(models, Xva)
+        if scores is None:
+            return None, None
+        return scores, models
+
+    def supports_warm_refit(self) -> bool:
+        return True
+
+    def refit_winner(self, X, y, w, params, *, warm=None, lane=None,
+                     hints=None):
+        """Full-data refit warm-started from the fold-AVERAGED winning-
+        lane coefficients through the donated-buffer program; cold
+        ``fit_arrays`` (the serial path, bitwise) without a handle."""
+        p = {**self.default_params, **self.params, **params}
+        if warm is None or lane is None:
+            return self.fit_arrays(X, y, w, p), False
+        family = p["family"]
+        if family not in _FAMILIES:
+            raise ValueError(f"Unknown GLM family {family!r}")
+        vp = float(p["variance_power"])
+        if family == "tweedie" and not 1.0 < vp < 2.0:
+            raise ValueError(
+                f"tweedie variance_power must be in (1, 2), got {vp}")
+        lane_models = [row[int(lane)] for row in warm]
+        beta_init = jnp.mean(jnp.stack(
+            [jnp.asarray(m.weights, jnp.float32) for m in lane_models]),
+            axis=0)
+        b_init = jnp.mean(jnp.stack(
+            [jnp.asarray(m.intercept, jnp.float32) for m in lane_models]))
+        beta, b0 = _glm_warm_program()(
+            X, y, w, beta_init, b_init, family=family,
+            max_iter=int(p["max_iter"]),
+            fit_intercept=bool(p["fit_intercept"]),
+            reg_param=jnp.float32(p["reg_param"]),
+            var_power=jnp.float32(vp))
+        return GLMModel(weights=np.asarray(beta), intercept=float(b0),
+                        family=family), True
 
 
 # ---------------------------------------------------------------------------

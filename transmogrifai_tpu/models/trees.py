@@ -988,12 +988,23 @@ class _TreePredictor(Predictor):
         params = {self._ALIASES.get(k, k): v for k, v in params.items()}
         super().__init__(uid=uid, **params)
 
-    def _loss_and_nout(self, y) -> tuple[str, int, float]:
+    def _loss_and_nout(self, y, _stats=None) -> tuple[str, int, float]:
+        """(loss, n_out, base score). ``_stats`` is the selector's
+        once-per-sweep host pull of ``(max(y), mean(y),
+        clip(mean(y), 1e-6, 1-1e-6))`` — each value produced by the SAME
+        device expression this method would run, so the threaded route is
+        bitwise-identical to the per-family blocking pull it elides on
+        the one-sync dispatch path."""
         if self.loss == "squared":
-            return "squared", 1, float(jnp.mean(y))
-        n_classes = int(np.asarray(jnp.max(y))) + 1
+            mean = _stats[1] if _stats is not None else jnp.mean(y)
+            return "squared", 1, float(mean)
+        y_max = (_stats[0] if _stats is not None
+                 else np.asarray(jnp.max(y)))
+        n_classes = int(y_max) + 1
         if n_classes <= 2:
-            p = float(jnp.clip(jnp.mean(y), 1e-6, 1 - 1e-6))
+            clipped = (_stats[2] if _stats is not None
+                       else jnp.clip(jnp.mean(y), 1e-6, 1 - 1e-6))
+            p = float(clipped)
             base = 0.0 if self.bootstrap else float(np.log(p / (1 - p)))
             return "logistic", 1, base
         return "softmax", n_classes, 0.0
@@ -1197,13 +1208,20 @@ class _TreePredictor(Predictor):
             g["params"].append(p)
         return list(groups.values())
 
-    def tree_stack_scalar_lnb(self, y):
+    def tree_stack_scalar_lnb(self, y, _stats=None):
         """``(loss, n_out, base)`` when the family has a scalar stacked
         score (binary margin / regression prediction), else None —
         multiclass has no batched scalar and keeps the per-fold loop.
-        One blocking device sync (max of y) per FAMILY, like the linear
-        path's ``_n_classes``."""
-        lnb = self._loss_and_nout(y)
+        One blocking device sync (max of y) per FAMILY, elided by the
+        selector's once-per-sweep ``_stats`` hint on the one-sync
+        dispatch path (signature-gated: a subclass overriding
+        ``_loss_and_nout`` with the old arity keeps its own probe)."""
+        import inspect
+        if _stats is not None and "_stats" in \
+                inspect.signature(self._loss_and_nout).parameters:
+            lnb = self._loss_and_nout(y, _stats=_stats)
+        else:
+            lnb = self._loss_and_nout(y)
         return lnb if lnb[1] == 1 else None
 
     @staticmethod
@@ -1339,6 +1357,26 @@ class _TreePredictor(Predictor):
             sorted_acc=_sorted_acc_default(),
             forest_margin=self.bootstrap and self.kind.endswith("classifier"))
 
+    # -- winner refit (round 9) ----------------------------------------------
+    def refit_winner(self, X, y, w, params, *, warm=None, lane=None,
+                     hints=None):
+        """Full-data winner refit reusing the sweep's dataset-level bin
+        codes: ``hints["bin_plans"]`` carries ``fold_sweep_plan``'s
+        ``{max_bins: (edges, codes, max_bins)}`` computed on this SAME
+        full training matrix, so the refit's duplicate quantile sort +
+        searchsorted pass is deleted outright — ``fit_arrays`` would
+        recompute byte-identical edges and codes from the identical
+        ``X``, making the reuse bitwise-exact, not approximate. Loss/
+        n_out/base are recomputed exactly as the serial refit always did
+        (an O(1) scalar pull). Trees have no parameter warm start —
+        ensemble growth cannot resume from fold trees."""
+        merged = {self._ALIASES.get(k, k): v for k, v in params.items()}
+        mb = int({**self.default_params, **self.params, **merged}
+                 ["max_bins"])
+        binned = ((hints or {}).get("bin_plans") or {}).get(mb)
+        model = self.fit_arrays(X, y, w, params, _binned=binned)
+        return model, binned is not None
+
 
 class OpGBTClassifier(_TreePredictor):
     """Gradient-boosted classification trees (Spark OpGBTClassifier parity;
@@ -1381,8 +1419,10 @@ class OpRandomForestClassifier(_ForestMixin, _TreePredictor):
     kind = "rf_classifier"
     loss = "squared"      # CART variance-reduction on the 0/1 target
 
-    def _loss_and_nout(self, y):
-        n_classes = int(np.asarray(jnp.max(y))) + 1
+    def _loss_and_nout(self, y, _stats=None):
+        y_max = (_stats[0] if _stats is not None
+                 else np.asarray(jnp.max(y)))
+        n_classes = int(y_max) + 1
         if n_classes <= 2:
             return "squared", 1, 0.0
         return "squared_onehot", n_classes, 0.0

@@ -11,17 +11,25 @@ the winning PredictionModel.
 TPU-first (SURVEY §2.7 P3): each candidate family trains its whole
 hyperparameter grid AND the whole k-fold CV axis as one stacked vmapped
 program (``grid_fit_arrays_folds``) — validation scoring and metrics batch
-over [k, G] so a family costs one dispatch and ONE host sync; the (fold x
-grid) work units shard 2-D over the mesh (rows on "data", candidates on
-"model"). Tree families (RF/GBT) stack too (round 8): the grid groups by
-compiled-program shape and each depth-group's whole k folds x L lanes
-batch trains as ONE program over the dataset-level bin codes
-(``tree_stack_scores``), one dispatch + one sync per group, with the HBM
-guard splitting too-wide groups into lane chunks. Custom subclasses that
-override the per-fold trainers, multiclass scoring, and batches that
-would not fit HBM at even one lane fall back to a sequential per-fold
-loop (compile once, run k times). No thread pool, no executor dispatch.
-See PERF.md "Sweep execution model" and docs/SWEEP.md.
+over [k, G]; the (fold x grid) work units shard 2-D over the mesh (rows on
+"data", candidates on "model"). Tree families (RF/GBT) stack too (round
+8): the grid groups by compiled-program shape and each depth-group's
+whole k folds x L lanes batch trains as ONE program over the dataset-level
+bin codes (``tree_stack_scores``), with the HBM guard splitting too-wide
+groups into lane chunks. Round 9 collapses the remaining host syncs: the
+sweep DISPATCHES every family's stacked program first, holding each
+``[k, G]`` metric batch as a device future, then SETTLES them all behind
+a single ``jax.block_until_ready`` — families overlap on device and the
+entire sweep costs ONE blocking host sync (asserted end-to-end via
+``SweepCounters.sweep_host_syncs``) — and the winner refit rides the same
+machinery: a G=1 full-data program warm-started from the retained stacked
+fold parameters (linear/GLM/MLP; trees reuse the dataset-level bin codes
+bitwise) with donated init buffers, checkpointed under a shape-keyed
+refit entry. Custom subclasses that override the per-fold trainers,
+multiclass scoring, and batches that would not fit HBM at even one lane
+fall back to a sequential per-fold loop (compile once, run k times). No
+thread pool, no executor dispatch. See PERF.md "Sweep execution model"
+and docs/SWEEP.md.
 """
 
 from __future__ import annotations
@@ -374,6 +382,33 @@ class ModelSelector(Estimator):
         return cls._stacking_default("TRANSMOGRIFAI_TREE_STACKED")
 
     @staticmethod
+    def _async_enabled() -> bool:
+        """One-sync overlapped dispatch gating (round 9): default ON.
+        With it, every stacked family's/depth-group's metric batch is
+        held as a DEVICE FUTURE at dispatch and the whole sweep settles
+        behind a single ``jax.block_until_ready`` — families overlap on
+        device instead of serializing on per-family metric pulls, and
+        the entire sweep costs ONE blocking host sync.
+        ``TRANSMOGRIFAI_SWEEP_ASYNC=0`` restores the per-family settle
+        (A/B reruns, and the behavior every fallback path keeps). Only
+        meaningful where a stacked path runs at all (the per-fold loop
+        is inherently synchronous)."""
+        import os
+        return os.environ.get("TRANSMOGRIFAI_SWEEP_ASYNC", "1") != "0"
+
+    @staticmethod
+    def _refit_warm_enabled() -> bool:
+        """Warm winner-refit gating (round 9): default ON. The selector
+        then retains warm-capable families' stacked fold parameters past
+        the sweep and the winner refit initializes from them (metrics
+        within the artifact-gated 1e-5 of the cold refit; trees reuse
+        bin codes bitwise regardless of this knob).
+        ``TRANSMOGRIFAI_REFIT_WARM=0`` forces every refit cold —
+        bitwise-identical to the pre-round-9 serial refit."""
+        import os
+        return os.environ.get("TRANSMOGRIFAI_REFIT_WARM", "1") != "0"
+
+    @staticmethod
     def _stacked_hbm_budget() -> float:
         """Byte budget for one family's stacked fold batch.
         ``TRANSMOGRIFAI_SWEEP_HBM_BUDGET`` overrides; otherwise half the
@@ -411,170 +446,344 @@ class ModelSelector(Estimator):
 
     def _sweep(self, Xt, yt, wt, yt_np) -> tuple[list[ModelEvaluation],
                                                  list[tuple[float, int, int]],
-                                                 list[dict]]:
+                                                 list[dict], dict]:
         """Run every (candidate family, grid point) over the validator's
         fold plan; returns per-candidate evaluations, (mean metric, cand,
-        grid) triples, and recorded failures.
+        grid) triples, recorded failures, and the refit-reuse state
+        (retained warm-start parameters + tree bin plans) for
+        ``_finalize``.
 
-        Execution model (PERF.md "Sweep execution"): per family, the FAST
-        path stacks the CV axis — all k folds x |grid| points train as one
-        compiled program (``grid_fit_arrays_folds``), validation scores and
-        metrics batch over [k, G], and the family costs exactly ONE host
-        sync. Tree families take the analogous fold x grid-stacked path
-        per depth-group (``_family_tree_stacked``). Work units shard 2-D
+        Execution model (PERF.md "Sweep execution", round 9): the sweep
+        is TWO phases. The DISPATCH phase walks the families and launches
+        every stacked program — linear/NB/GLM/MLP fold-stacks
+        (``grid_scores_folds_retained``) and tree depth-groups
+        (``_family_tree_stacked``) alike — handing each family's ``[k, G]``
+        metric batch back as a DEVICE FUTURE; no family blocks the host,
+        so their programs overlap on device. The SETTLE phase
+        (``_settle``) then materializes every future behind a single
+        ``jax.block_until_ready`` — the whole sweep costs ONE blocking
+        host sync (``SweepCounters.sweep_host_syncs``), not one per
+        family/depth-group. The once-per-sweep label statistics (class
+        count, tree base-score stats) are pulled up front so no family
+        pays a blocking scalar sync at dispatch.
+
+        ``TRANSMOGRIFAI_SWEEP_ASYNC=0``, a custom evaluator without the
+        device metric variant, and every fallback route (per-fold loop,
+        HBM-guard refusal under ``TRANSMOGRIFAI_SWEEP_STACKED`` gating)
+        keep the pre-round-9 per-family settle. Work units shard 2-D
         over the mesh (rows on "data", fold/grid candidates on "model").
-        A family falls back to the per-fold loop when it has no stacked
-        axis (``supports_fold_stacking``/``supports_tree_stacking`` False
-        — including subclasses that override the per-fold trainers), when
-        the evaluator has no fold-batched metric, when the stacked batch
-        would blow the HBM guard (trees first try lane chunking), or when
-        scoring returns no batched scalar (multiclass).
 
         Semantics preserved exactly from the per-fold loop: failure
-        isolation per family, the ``max_wait_s`` budget, checkpoint/restart
-        (stacked families checkpoint one per-family key carrying per-fold
-        value vectors), and non-finite-metric exclusion.
+        isolation per family (dispatch-time errors isolate immediately;
+        settle-time errors re-settle family by family to isolate the
+        poisoned program), the ``max_wait_s`` budget (checked at
+        dispatch), checkpoint/restart (stacked families checkpoint one
+        per-family key carrying per-fold value vectors, written at
+        settle), and non-finite-metric exclusion.
         """
-        from transmogrifai_tpu.models.base import (
-            supports_fold_stacking, supports_tree_stacking,
-        )
         from transmogrifai_tpu.parallel import mesh as pmesh
-        from transmogrifai_tpu.utils.profiling import sweep_counters
-        from transmogrifai_tpu.utils.retry import with_device_retry
+        refit_state: dict = {"warm": {}, "bin_plans": {}}
         n = int(Xt.shape[0])
         d = int(Xt.shape[1])
         try:
             tr_idx, va_idx = self.validator.stacked_splits(n, yt_np)
         except ValueError:
             # custom validator with unequal fold shapes: no fold axis exists
-            return self._sweep_loop(
+            results, mean_metrics, failures = self._sweep_loop(
                 self._fold_arrays_iter(Xt, yt, wt, yt_np))
+            return results, mean_metrics, failures, refit_state
         k, n_tr = tr_idx.shape
         n_va = int(va_idx.shape[1])
         ev0 = self.evaluators[0]
         fold_metrics = getattr(ev0, "metric_batch_scores_folds", None)
+        fold_metrics_dev = getattr(ev0, "metric_batch_scores_folds_device",
+                                   None)
+        async_on = self._async_enabled() and fold_metrics_dev is not None
         per_candidate_scores: dict[tuple[int, int], list[float]] = {}
         failures: list[dict] = []
+        pending: list[dict] = []  # device futures awaiting the one settle
         deadline = (time.time() + self.max_wait_s
                     if self.max_wait_s is not None else None)
         done = self._ckpt_load()
         n_tr_pad = pmesh.pad_rows(n_tr)
-        stacked_data = None  # built on the first stacked-capable family
         tree_cache: dict = {}  # stacked code/label gathers shared by trees
 
-        for ci, (est, grid) in enumerate(self.models_and_grids):
-            fname = self._family_name(ci)
-            skey = f"{ci}:stacked:{k}x{n_tr}x{d}"
-            if skey in done and len(done[skey]) == k * len(grid):
-                # restart path: this family's whole fold batch already
-                # scored under the per-family stacked key (fold-major)
-                for f in range(k):
-                    for gj in range(len(grid)):
-                        per_candidate_scores.setdefault((ci, gj), []).append(
-                            float(done[skey][f * len(grid) + gj]))
-                sweep_counters.count(fname, mode="resumed")
-                continue
-            tgroups = (est.tree_stack_groups(grid)
-                       if supports_tree_stacking(est) else None)
-            if tgroups and self._treestack_replay(ci, tgroups, k, n_tr, d,
-                                                  done,
-                                                  per_candidate_scores):
-                # restart path: every depth-group of this tree family
-                # already scored under per-group treestack keys — replays
-                # regardless of the current gating, so a stacked-written
-                # checkpoint resumes under the loop layout too
-                sweep_counters.count(fname, mode="resumed")
-                continue
-            fold_keys = [f"{f}:{ci}:{n_tr_pad}x{d}" for f in range(k)]
-            if all(fk in done and len(done[fk]) == len(grid)
-                   for fk in fold_keys):
-                # restart path: a previous per-fold-loop run completed this
-                # family fold by fold
-                for fk in fold_keys:
-                    for gj, val in enumerate(done[fk]):
-                        per_candidate_scores.setdefault((ci, gj), []).append(
-                            float(val))
-                sweep_counters.count(fname, mode="resumed")
-                continue
-            if self._deadline_skip(ci, grid, deadline, per_candidate_scores,
-                                   failures, pop=False):
-                continue
-            use_stacked = (self._stacked_enabled()
-                           and fold_metrics is not None
-                           and supports_fold_stacking(est)
-                           and self._stacked_fits_memory(k, n_tr, n_va, d,
-                                                         est, grid))
-            if use_stacked:
-                if stacked_data is None:
-                    # one device gather builds the whole fold batch — no
-                    # per-fold Xtr materialization on host; training rows
-                    # pad+shard 2-D over the mesh (rows on "data", folds on
-                    # "model" when they divide it); validation folds stay
-                    # unpadded — metrics must see real rows only
-                    jtr = jnp.asarray(tr_idx)
-                    jva = jnp.asarray(va_idx)
-                    stacked_data = (
-                        pmesh.shard_stacked_training_rows(
-                            jnp.take(Xt, jtr, axis=0),
-                            jnp.take(yt, jtr, axis=0),
-                            jnp.take(wt, jtr, axis=0))
-                        + (jnp.take(Xt, jva, axis=0),
-                           jnp.take(yt, jva, axis=0)))
-                Xtr_s, ytr_s, wtr_s, Xva_s, yva_s = stacked_data
-                from transmogrifai_tpu.utils.tracing import span
+        try:
+            self._dispatch(
+                Xt, yt, wt, tr_idx, va_idx, k, n_tr, n_va, d, n_tr_pad,
+                done, deadline, per_candidate_scores, failures, pending,
+                refit_state, async_on, fold_metrics, fold_metrics_dev,
+                tree_cache)
+        except BaseException:
+            # mid-sweep crash (KeyboardInterrupt, preemption, ...): settle
+            # whatever was already dispatched so completed families reach
+            # the checkpoint before the crash propagates — the same
+            # crash granularity the per-family settle always had (a real
+            # SIGKILL can't salvage; it just re-runs those families)
+            if pending:
                 try:
-                    with sweep_counters.tracking(fname), \
-                            span("sweep.family", family=fname,
-                                 mode="fold_stacked", folds=k,
-                                 grid=len(grid)):
-                        # fused unit: stacked train + stacked scores in one
-                        # call (no per-(fold, grid) model materialization —
-                        # the sweep discards models; the winner refits)
-                        scores = with_device_retry(
-                            est.grid_scores_folds, Xtr_s, ytr_s, wtr_s,
-                            grid, Xva_s, site="sweep.fit")
-                        if scores is None:
-                            raise _FoldStackFallback()
-                        # ONE host sync: metrics for every (fold, grid)
-                        # unit of the family come back as one [k, G] pull
-                        vals_kg = fold_metrics(yva_s, scores,
-                                               self.validation_metric)
-                except _FoldStackFallback:
-                    use_stacked = False  # family lacks the axis: fold loop
-                except Exception as e:  # noqa: BLE001 — isolation by design
-                    from transmogrifai_tpu.utils.faults import (
-                        FaultHarnessError,
-                    )
-                    if isinstance(e, FaultHarnessError):
-                        raise  # a preempted process dies; it does not isolate
-                    failures.append({
-                        "modelName": fname,
-                        "reason": f"stacked sweep: {type(e).__name__}: "
-                                  f"{str(e)[:300]}"})
-                    continue
-                else:
-                    flat = [float(v) for v in np.asarray(vals_kg).reshape(-1)]
+                    self._settle(pending, done, per_candidate_scores,
+                                 failures)
+                except Exception:  # noqa: BLE001 failure-ok: salvage is best-effort
+                    pass
+            raise
+        if pending:
+            self._settle(pending, done, per_candidate_scores, failures)
+        results, mean_metrics, failures = self._collect_results(
+            per_candidate_scores, failures)
+        return results, mean_metrics, failures, refit_state
+
+    def _dispatch(self, Xt, yt, wt, tr_idx, va_idx, k, n_tr, n_va, d,
+                  n_tr_pad, done, deadline, per_candidate_scores, failures,
+                  pending, refit_state, async_on, fold_metrics,
+                  fold_metrics_dev, tree_cache) -> None:
+        """The sweep's dispatch phase (see ``_sweep``): walk the families,
+        replay checkpointed ones, launch every stacked program, and queue
+        device metric futures on ``pending``; per-family-settle and loop
+        fallbacks record their values inline."""
+        from transmogrifai_tpu.models.base import (
+            supports_fold_stacking, supports_tree_stacking,
+        )
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.profiling import sweep_counters
+        from transmogrifai_tpu.utils.retry import with_device_retry
+        from transmogrifai_tpu.utils.tracing import span
+        stacked_data = None  # built on the first stacked-capable family
+        n_classes_hint = None  # once-per-sweep label pulls (O(1), uncounted)
+        tree_stats = None
+        with span("sweep.dispatch", families=len(self.models_and_grids),
+                  mode="async" if async_on else "per_family"):
+            for ci, (est, grid) in enumerate(self.models_and_grids):
+                fname = self._family_name(ci)
+                skey = f"{ci}:stacked:{k}x{n_tr}x{d}"
+                if skey in done and len(done[skey]) == k * len(grid):
+                    # restart path: this family's whole fold batch already
+                    # scored under the per-family stacked key (fold-major)
                     for f in range(k):
                         for gj in range(len(grid)):
                             per_candidate_scores.setdefault(
-                                (ci, gj), []).append(flat[f * len(grid) + gj])
-                    sweep_counters.count(fname, dispatches=1, host_syncs=1,
-                                         mode="fold_stacked")
-                    done[skey] = flat
-                    self._ckpt_save(done)
+                                (ci, gj), []).append(
+                                float(done[skey][f * len(grid) + gj]))
+                    sweep_counters.count(fname, mode="resumed")
                     continue
-            if (tgroups and self._tree_stacked_enabled()
-                    and fold_metrics is not None
-                    and self._family_tree_stacked(
-                        ci, est, grid, tgroups, Xt, yt, wt, tr_idx, va_idx,
-                        done, deadline, per_candidate_scores, failures,
-                        tree_cache)):
-                continue
-            # ---- per-fold fallback loop for this family --------------------
-            self._family_fold_loop(
-                ci, est, grid, Xt, yt, wt, tr_idx, va_idx, done, deadline,
-                per_candidate_scores, failures)
-        return self._collect_results(per_candidate_scores, failures)
+                tgroups = (est.tree_stack_groups(grid)
+                           if supports_tree_stacking(est) else None)
+                if tgroups and self._treestack_replay(ci, tgroups, k, n_tr,
+                                                      d, done,
+                                                      per_candidate_scores):
+                    # restart path: every depth-group of this tree family
+                    # already scored under per-group treestack keys —
+                    # replays regardless of the current gating, so a
+                    # stacked-written checkpoint resumes under the loop
+                    # layout too
+                    sweep_counters.count(fname, mode="resumed")
+                    continue
+                fold_keys = [f"{f}:{ci}:{n_tr_pad}x{d}" for f in range(k)]
+                if all(fk in done and len(done[fk]) == len(grid)
+                       for fk in fold_keys):
+                    # restart path: a previous per-fold-loop run completed
+                    # this family fold by fold
+                    for fk in fold_keys:
+                        for gj, val in enumerate(done[fk]):
+                            per_candidate_scores.setdefault(
+                                (ci, gj), []).append(float(val))
+                    sweep_counters.count(fname, mode="resumed")
+                    continue
+                if self._deadline_skip(ci, grid, deadline,
+                                       per_candidate_scores, failures,
+                                       pop=False):
+                    continue
+                use_stacked = (self._stacked_enabled()
+                               and fold_metrics is not None
+                               and supports_fold_stacking(est)
+                               and self._stacked_fits_memory(
+                                   k, n_tr, n_va, d, est, grid))
+                if use_stacked:
+                    if stacked_data is None:
+                        # one device gather builds the whole fold batch — no
+                        # per-fold Xtr materialization on host; training
+                        # rows pad+shard 2-D over the mesh (rows on "data",
+                        # folds on "model" when they divide it); validation
+                        # folds stay unpadded — metrics must see real rows
+                        # only
+                        jtr = jnp.asarray(tr_idx)
+                        jva = jnp.asarray(va_idx)
+                        stacked_data = (
+                            pmesh.shard_stacked_training_rows(
+                                jnp.take(Xt, jtr, axis=0),
+                                jnp.take(yt, jtr, axis=0),
+                                jnp.take(wt, jtr, axis=0))
+                            + (jnp.take(Xt, jva, axis=0),
+                               jnp.take(yt, jva, axis=0)))
+                    Xtr_s, ytr_s, wtr_s, Xva_s, yva_s = stacked_data
+                    if n_classes_hint is None:
+                        # the ONE class-count pull every softmax/NB/MLP
+                        # family would otherwise block on at dispatch —
+                        # same expression on the same stacked labels, so
+                        # threading it is value-identical
+                        n_classes_hint = max(
+                            int(np.asarray(jnp.max(ytr_s))) + 1, 2)
+                    try:
+                        with sweep_counters.tracking(fname), \
+                                span("sweep.family", family=fname,
+                                     mode="fold_stacked", folds=k,
+                                     grid=len(grid)):
+                            # fused unit: stacked train + stacked scores in
+                            # one call (no per-(fold, grid) model
+                            # materialization — the sweep discards models;
+                            # the winner refits), retaining the stacked
+                            # parameters as the refit's warm-start handle
+                            retain = (self._refit_warm_enabled()
+                                      and est.supports_warm_refit())
+                            scores, warm = with_device_retry(
+                                est.grid_scores_folds_retained, Xtr_s,
+                                ytr_s, wtr_s, grid, Xva_s,
+                                _n_classes=n_classes_hint, site="sweep.fit")
+                            if scores is None:
+                                raise _FoldStackFallback()
+                            if retain and warm is not None:
+                                refit_state["warm"][ci] = warm
+                            # the family's [k, G] metric batch: a device
+                            # FUTURE on the async path (settled once for
+                            # the whole sweep), a host pull otherwise
+                            vals_kg = (fold_metrics_dev if async_on
+                                       else fold_metrics)(
+                                yva_s, scores, self.validation_metric)
+                    except _FoldStackFallback:
+                        use_stacked = False  # no stacked axis: fold loop
+                    except Exception as e:  # noqa: BLE001 — isolation by design
+                        from transmogrifai_tpu.utils.faults import (
+                            FaultHarnessError,
+                        )
+                        if isinstance(e, FaultHarnessError):
+                            raise  # a preempted process dies, not isolates
+                        failures.append({
+                            "modelName": fname,
+                            "reason": f"stacked sweep: {type(e).__name__}: "
+                                      f"{str(e)[:300]}"})
+                        continue
+                    else:
+                        sweep_counters.count(fname, dispatches=1,
+                                             mode="fold_stacked")
+                        if async_on:
+                            pending.append({
+                                "kind": "stacked", "ci": ci, "fname": fname,
+                                "key": skey, "k": k, "grid_len": len(grid),
+                                "chunks": [(0, len(grid), vals_kg)]})
+                            sweep_counters.count_run(async_families=1)
+                            continue
+                        # per-family settle (TRANSMOGRIFAI_SWEEP_ASYNC=0 or
+                        # a host-only evaluator): the pre-round-9 behavior
+                        flat = [float(v)
+                                for v in np.asarray(vals_kg).reshape(-1)]
+                        for f in range(k):
+                            for gj in range(len(grid)):
+                                per_candidate_scores.setdefault(
+                                    (ci, gj), []).append(
+                                    flat[f * len(grid) + gj])
+                        sweep_counters.count(fname, host_syncs=1)
+                        sweep_counters.count_run(host_syncs=1)
+                        done[skey] = flat
+                        self._ckpt_save(done)
+                        continue
+                if (tgroups and self._tree_stacked_enabled()
+                        and fold_metrics is not None):
+                    if tree_stats is None:
+                        # the tree families' (max, mean, clipped-mean)
+                        # label pull, once per sweep — each value produced
+                        # by the same device expression the per-family
+                        # ``_loss_and_nout`` probe runs, so threading it
+                        # is bitwise-identical
+                        tree_stats = tuple(np.asarray(jnp.stack(
+                            [jnp.max(yt), jnp.mean(yt),
+                             jnp.clip(jnp.mean(yt), 1e-6, 1 - 1e-6)])))
+                    if self._family_tree_stacked(
+                            ci, est, grid, tgroups, Xt, yt, wt, tr_idx,
+                            va_idx, done, deadline, per_candidate_scores,
+                            failures, tree_cache, async_on=async_on,
+                            pending=pending, tree_stats=tree_stats,
+                            refit_state=refit_state):
+                        continue
+                # ---- per-fold fallback loop for this family ----------------
+                self._family_fold_loop(
+                    ci, est, grid, Xt, yt, wt, tr_idx, va_idx, done,
+                    deadline, per_candidate_scores, failures,
+                    refit_state=refit_state)
+
+    def _settle(self, pending, done, per_candidate_scores,
+                failures) -> None:
+        """The ONE settle of the async sweep: block until every dispatched
+        family's metric futures are ready — a single
+        ``jax.block_until_ready`` over the whole sweep, counted as ONE
+        run-level host sync — then materialize, record, and checkpoint
+        each family's values (the per-family ``host_syncs`` counter keeps
+        its metric-pull meaning: one per family / per tree lane chunk).
+
+        If the barrier itself raises (an async runtime failure inside
+        some family's program), families re-settle one by one so the
+        poisoned program isolates into ITS family's failure record — the
+        same per-family isolation the dispatch phase applies — at the
+        cost of per-family barriers for that (already failing) sweep."""
+        import jax
+        from transmogrifai_tpu.utils.faults import FaultHarnessError
+        from transmogrifai_tpu.utils.profiling import sweep_counters
+        from transmogrifai_tpu.utils.tracing import span
+        with span("sweep.settle",
+                  families=len({e["ci"] for e in pending}),
+                  units=sum(len(e["chunks"]) for e in pending)):
+            barrier_ok = True
+            try:
+                jax.block_until_ready(
+                    [a for e in pending for _c0, _ln, a in e["chunks"]])
+                sweep_counters.count_run(host_syncs=1)
+            except FaultHarnessError:
+                raise  # a preempted process dies; it does not isolate
+            except Exception:  # noqa: BLE001 — re-settled per family below
+                barrier_ok = False
+            failed_cis: set[int] = set()
+            for e in pending:
+                ci = e["ci"]
+                if ci in failed_cis:
+                    continue
+                try:
+                    if not barrier_ok:
+                        jax.block_until_ready(
+                            [a for _c0, _ln, a in e["chunks"]])
+                        sweep_counters.count_run(host_syncs=1)
+                    if e["kind"] == "stacked":
+                        vals = np.asarray(e["chunks"][0][2])
+                    else:  # tree depth-group: reassemble lane chunks
+                        vals = np.empty((e["k"], len(e["lanes"])),
+                                        np.float64)
+                        for c0, ln, arr in e["chunks"]:
+                            vals[:, c0:c0 + ln] = np.asarray(arr)
+                except FaultHarnessError:
+                    raise
+                except Exception as err:  # noqa: BLE001 — isolation by design
+                    failed_cis.add(ci)
+                    grid = self.models_and_grids[ci][1]
+                    for gj in range(len(grid)):
+                        per_candidate_scores.pop((ci, gj), None)
+                    failures.append({
+                        "modelName": e["fname"],
+                        "reason": f"async settle: {type(err).__name__}: "
+                                  f"{str(err)[:300]}"})
+                    continue
+                flat = [float(v) for v in vals.reshape(-1)]
+                if e["kind"] == "stacked":
+                    for f in range(e["k"]):
+                        for gj in range(e["grid_len"]):
+                            per_candidate_scores.setdefault(
+                                (ci, gj), []).append(
+                                flat[f * e["grid_len"] + gj])
+                    sweep_counters.count(e["fname"], host_syncs=1)
+                else:
+                    self._record_treestack(per_candidate_scores, ci,
+                                           e["lanes"], e["k"], flat)
+                    sweep_counters.count(e["fname"],
+                                         host_syncs=len(e["chunks"]))
+                done[e["key"]] = flat
+                self._ckpt_save(done)
 
     # -- fold x grid-stacked tree sweep (round 8) ----------------------------
     @staticmethod
@@ -618,7 +827,10 @@ class ModelSelector(Estimator):
     def _family_tree_stacked(self, ci, est, grid, tgroups, Xt, yt, wt,
                              tr_idx, va_idx, done, deadline,
                              per_candidate_scores, failures,
-                             cache: dict) -> bool:
+                             cache: dict, *, async_on: bool = False,
+                             pending: Optional[list] = None,
+                             tree_stats=None,
+                             refit_state: Optional[dict] = None) -> bool:
         """One tree family's fold x grid-stacked sweep: every depth-group
         (grid lanes sharing one compiled-program shape) trains all
         k folds x L lanes as ONE compiled program over the stacked gather
@@ -633,12 +845,20 @@ class ModelSelector(Estimator):
         disabled, or a group where not even one lane fits the budget —
         sub-grid loop units can't be expressed, so the loop keeps the
         whole family)."""
+        import inspect
         from transmogrifai_tpu.parallel import mesh as pmesh
         from transmogrifai_tpu.utils.profiling import sweep_counters
         from transmogrifai_tpu.utils.retry import with_device_retry
         from transmogrifai_tpu.utils.tracing import span
         fname = self._family_name(ci)
-        lnb = est.tree_stack_scalar_lnb(yt)  # ONE family-level sync
+        # the selector's once-per-sweep label stats elide what was ONE
+        # blocking family-level sync here (signature-gated: a subclass
+        # overriding the lnb probe with the old arity keeps its own pull)
+        if tree_stats is not None and "_stats" in inspect.signature(
+                est.tree_stack_scalar_lnb).parameters:
+            lnb = est.tree_stack_scalar_lnb(yt, _stats=tree_stats)
+        else:
+            lnb = est.tree_stack_scalar_lnb(yt)
         if lnb is None:
             return False  # multiclass: no batched scalar score
         k, n_tr = tr_idx.shape
@@ -670,6 +890,11 @@ class ModelSelector(Estimator):
             plan = est.fold_sweep_plan(Xt, grid)
             if plan is None:
                 return False
+            if refit_state is not None:
+                # retained for the winner refit: the SAME codes fit_arrays
+                # would recompute from the identical full matrix, so the
+                # refit's duplicate quantization pass is deleted bitwise
+                refit_state["bin_plans"].update(plan)
         for mb in needed:
             # one stacked fold gather of the dataset-level codes per
             # max_bins — int8 when the codes fit (4x fewer gathered
@@ -711,7 +936,14 @@ class ModelSelector(Estimator):
                 cache["fold_means"] = np.asarray(jnp.stack(
                     [jnp.mean(ytr_s[f]) for f in range(k)]))
             cs = chunk_sizes[gi]
+            ev0_f = self.evaluators[0]
+            fold_metrics_dev = getattr(ev0_f,
+                                       "metric_batch_scores_folds_device",
+                                       None)
+            use_async = (async_on and pending is not None
+                         and fold_metrics_dev is not None)
             vals_kl = np.empty((k, L), np.float64)
+            chunks: list[tuple[int, int, Any]] = []  # async device futures
             try:
                 with sweep_counters.tracking(fname):
                     for c0 in range(0, L, cs):
@@ -729,14 +961,22 @@ class ModelSelector(Estimator):
                                 wtr_s, Xb_va, chunk, lnb,
                                 fold_means=cache["fold_means"],
                                 site="sweep.fit")
-                            # ONE host sync: metrics for every
-                            # (fold, lane) unit of the chunk in one pull
-                            vals = fold_metrics(yva_s, scores,
-                                                self.validation_metric)
-                        vals_kl[:, c0:c0 + len(chunk)] = np.asarray(vals)
+                            # the chunk's [k, Lc] metric batch: a device
+                            # FUTURE on the async path (settled once for
+                            # the whole sweep), one host pull otherwise
+                            vals = (fold_metrics_dev if use_async
+                                    else fold_metrics)(
+                                yva_s, scores, self.validation_metric)
+                        if use_async:
+                            chunks.append((c0, len(chunk), vals))
+                        else:
+                            vals_kl[:, c0:c0 + len(chunk)] = \
+                                np.asarray(vals)
+                            sweep_counters.count(fname, host_syncs=1)
+                            sweep_counters.count_run(host_syncs=1)
                         sweep_counters.count(
-                            fname, dispatches=1, host_syncs=1,
-                            lane_chunks=1, mode="tree_stacked")
+                            fname, dispatches=1, lane_chunks=1,
+                            mode="tree_stacked")
                 sweep_counters.count(fname, stacked_groups=1)
             except Exception as e:  # noqa: BLE001 — isolation by design
                 from transmogrifai_tpu.utils.faults import FaultHarnessError
@@ -749,6 +989,14 @@ class ModelSelector(Estimator):
                     "reason": f"tree stacked sweep (group {gi}): "
                               f"{type(e).__name__}: {str(e)[:300]}"})
                 return True
+            if use_async:
+                first_entry = not any(p["ci"] == ci for p in pending)
+                pending.append({"kind": "tree", "ci": ci, "fname": fname,
+                                "key": tk, "k": k, "lanes": lanes,
+                                "chunks": chunks})
+                if first_entry:
+                    sweep_counters.count_run(async_families=1)
+                continue
             flat = [float(v) for v in vals_kl.reshape(-1)]
             self._record_treestack(per_candidate_scores, ci, lanes, k,
                                    flat)
@@ -819,6 +1067,7 @@ class ModelSelector(Estimator):
                         yva, scores, self.validation_metric)]
                     sweep_counters.count(fname, dispatches=1,
                                          host_syncs=1, mode="fold_loop")
+                    sweep_counters.count_run(host_syncs=1)
                 else:
                     vals = []
                     for model in models:
@@ -830,6 +1079,8 @@ class ModelSelector(Estimator):
                     sweep_counters.count(fname, dispatches=1,
                                          host_syncs=max(len(grid), 1),
                                          mode="fold_loop")
+                    sweep_counters.count_run(
+                        host_syncs=max(len(grid), 1))
         except Exception as e:  # noqa: BLE001 — isolation by design
             from transmogrifai_tpu.utils.faults import FaultHarnessError
             if isinstance(e, FaultHarnessError):
@@ -852,12 +1103,13 @@ class ModelSelector(Estimator):
 
     def _family_fold_loop(self, ci, est, grid, Xt, yt, wt, tr_idx, va_idx,
                           done, deadline, per_candidate_scores,
-                          failures) -> None:
+                          failures, refit_state=None) -> None:
         """One family's sequential per-fold sweep (the fallback path and
         the home of families without a fold axis — tree ensembles, custom
         subclasses). Tree families still avoid re-binning every fold: a
         ``fold_sweep_plan`` computes dataset-level quantile codes once and
-        each fold gathers its rows from them."""
+        each fold gathers its rows from them (and the winner refit reuses
+        the same codes via ``refit_state``)."""
         import inspect
         from transmogrifai_tpu.parallel import mesh as pmesh
         plan = None
@@ -866,6 +1118,8 @@ class ModelSelector(Estimator):
                 and "_fold_plan" in inspect.signature(
                     est.grid_fit_arrays).parameters):
             plan = plan_fn(Xt, grid)
+            if plan is not None and refit_state is not None:
+                refit_state["bin_plans"].update(plan)
         for fold_i in range(tr_idx.shape[0]):
             jtr = jnp.asarray(tr_idx[fold_i])
             jva = jnp.asarray(va_idx[fold_i])
@@ -941,23 +1195,156 @@ class ModelSelector(Estimator):
                 f"failures: {failures}")
         return results, mean_metrics, failures
 
+    # -- winner refit (round 9) ----------------------------------------------
+    def _refit_ckpt_paths(self) -> Optional[tuple[str, str]]:
+        """(json path, npz path) of the refit checkpoint, or None when
+        checkpointing is off/unusable."""
+        if not self.checkpoint_dir:
+            return None
+        import os
+
+        from transmogrifai_tpu.utils.durable import ensure_checkpoint_dir
+        if not ensure_checkpoint_dir(self.checkpoint_dir,
+                                     "refit checkpoint"):
+            return None
+        return (os.path.join(self.checkpoint_dir, "refit.json"),
+                os.path.join(self.checkpoint_dir, "refit.npz"))
+
+    def _refit_ckpt_save(self, rkey: str, model) -> None:
+        """Persist the refitted winner (best-effort, atomic): a run
+        preempted AFTER the refit but before/while evaluating resumes
+        without retraining the winner. Keyed on the sweep-config
+        fingerprint plus a shape-carrying refit key (``{ci}:{gj}:refit:
+        {n}x{d}``) — same staleness rules as ``sweep.json``."""
+        paths = self._refit_ckpt_paths()
+        if paths is None:
+            return
+        from transmogrifai_tpu.serialization import fitted_stage_record
+        from transmogrifai_tpu.utils.durable import (
+            atomic_json_dump, best_effort_checkpoint_write,
+        )
+
+        def write() -> None:
+            rec, arrays = fitted_stage_record(model)
+            import os
+            import tempfile
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(paths[1]),
+                                       suffix=".npz.tmp")
+            try:
+                # a file OBJECT: np.savez appends ".npz" to bare paths,
+                # which would leave the mkstemp file empty
+                with os.fdopen(fd, "wb") as fh:
+                    np.savez(fh, **arrays)
+                os.replace(tmp, paths[1])
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)  # failure-ok: leftover tmp cleanup
+            atomic_json_dump({"fingerprint": self._ckpt_fingerprint(),
+                              "key": rkey, "record": rec}, paths[0])
+
+        best_effort_checkpoint_write(
+            write, "refit checkpoint write failed; continuing without it")
+
+    def _refit_ckpt_load(self, rkey: str):
+        """The checkpointed refit winner when fingerprint AND refit key
+        match, else None (stale/missing/corrupt files cost a fresh refit,
+        never a crash)."""
+        paths = self._refit_ckpt_paths()
+        if paths is None:
+            return None
+        import json
+        import os
+        if not (os.path.exists(paths[0]) and os.path.exists(paths[1])):
+            return None
+        try:
+            with open(paths[0]) as fh:
+                doc = json.load(fh)
+            if doc.get("fingerprint") != self._ckpt_fingerprint() \
+                    or doc.get("key") != rkey:
+                return None
+            from transmogrifai_tpu.serialization import restore_fitted_stage
+            with np.load(paths[1], allow_pickle=False) as npz:
+                arrays = {k: npz[k] for k in npz.files}
+            return restore_fitted_stage(doc["record"], arrays)
+        except Exception as e:  # noqa: BLE001 — corrupt ckpt costs a refit
+            import warnings
+            warnings.warn(
+                f"refit checkpoint: unreadable state at {paths[0]!r} "
+                f"({type(e).__name__}: {e}); refitting the winner fresh",
+                RuntimeWarning)
+            return None
+
+    def _refit(self, best_ci: int, best_gj: int, best_params: dict, Xt,
+               yt, wt, refit_state: dict):
+        """Train the winner on the full prepared data through the stacked
+        refit machinery (round 9): resume from the refit checkpoint when
+        one matches; otherwise hand the family its retained warm-start
+        handle (the sweep's stacked fold parameters, G=1 lane selected by
+        ``best_gj``) and the dataset-level tree bin plans via
+        ``refit_winner``. Families without reuse run the exact cold
+        ``fit_arrays`` the serial path always ran (bitwise). The
+        ``selector.refit`` fault site fires after the checkpoint write —
+        the preemption seam the chaos suite resumes across."""
+        import contextlib
+
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.faults import fault_point
+        from transmogrifai_tpu.utils.profiling import sweep_counters
+        from transmogrifai_tpu.utils.retry import with_device_retry
+        from transmogrifai_tpu.utils.tracing import span
+        best_est = self.models_and_grids[best_ci][0]
+        fname = self._family_name(best_ci)
+        n, d = int(Xt.shape[0]), int(Xt.shape[1])
+        rkey = f"{best_ci}:{best_gj}:refit:{n}x{d}"
+        restored = self._refit_ckpt_load(rkey)
+        if restored is not None:
+            fault_point("selector.refit")
+            return restored
+        Xs, ys, ws = pmesh.shard_training_rows(Xt, yt, wt)
+        warm = (refit_state.get("warm", {}).get(best_ci)
+                if self._refit_warm_enabled() else None)
+        hints = {}
+        bin_plans = refit_state.get("bin_plans")
+        if bin_plans and int(Xs.shape[0]) == n:
+            # mesh padding grows the refit rows past the dataset-level
+            # codes; the reuse only holds row-for-row
+            hints["bin_plans"] = bin_plans
+        stacked_refit = warm is not None or bool(hints)
+        cm = (span("selector.refit_stacked", family=fname, lane=best_gj,
+                   warm=warm is not None)
+              if stacked_refit else contextlib.nullcontext())
+        with sweep_counters.tracking(fname), cm:
+            best_model, warm_used = with_device_retry(
+                best_est.refit_winner, Xs, ys, ws, best_params,
+                warm=warm, lane=best_gj, hints=hints or None,
+                site="sweep.fit")
+        if warm_used:
+            sweep_counters.count_run(refit_warm_starts=1)
+        self._refit_ckpt_save(rkey, best_model)
+        fault_point("selector.refit")
+        return best_model
+
     def _finalize(self, results, mean_metrics, Xt, yt, wt, Xh, yh,
                   prep_results: dict, t0: float,
-                  failures: Optional[list] = None) -> SelectedModel:
+                  failures: Optional[list] = None,
+                  refit_state: Optional[dict] = None) -> SelectedModel:
         """Refit the winning candidate on the full prepared training data,
         evaluate train + holdout, assemble the summary."""
-        from transmogrifai_tpu.parallel import mesh as pmesh
-        from transmogrifai_tpu.utils.retry import with_device_retry
         ev0 = self.evaluators[0]
         bigger = ev0.larger_is_better(self.validation_metric)
         _, best_ci, best_gj = (max if bigger else min)(
             mean_metrics, key=lambda t: t[0])
         best_est, best_grid = self.models_and_grids[best_ci]
         best_params = {**best_est.params, **best_grid[best_gj]}
-        best_model = with_device_retry(
-            best_est.fit_arrays,
-            *pmesh.shard_training_rows(Xt, yt, wt), best_params,
-            site="sweep.fit")
+        warm_all = (refit_state or {}).get("warm")
+        if warm_all:
+            # only the winner's handle is ever read — release the losing
+            # families' stacked fold parameters before the full-data refit
+            # program peaks HBM
+            for ci in [c for c in warm_all if c != best_ci]:
+                del warm_all[ci]
+        best_model = self._refit(best_ci, best_gj, best_params, Xt, yt, wt,
+                                 refit_state or {})
 
         train_eval: dict = {}
         holdout_eval: dict = {}
@@ -1011,7 +1398,8 @@ class ModelSelector(Estimator):
                 span("selector.sweep", hbm=True, stage_uid=self.uid,
                      stage_cls=type(self).__name__, phase="sweep",
                      n_families=len(self.models_and_grids)):
-            results, mean_metrics, failures = self._sweep(Xt, yt, wt, yt_np)
+            results, mean_metrics, failures, refit_state = \
+                self._sweep(Xt, yt, wt, yt_np)
         _plog("selector: CV sweep", t1)
         t1 = time.time()
         Xh = X[jnp.asarray(holdout_idx)] if holdout_idx.size else None
@@ -1020,7 +1408,8 @@ class ModelSelector(Estimator):
                 span("selector.refit", hbm=True, stage_uid=self.uid,
                      stage_cls=type(self).__name__, phase="refit"):
             selected = self._finalize(results, mean_metrics, Xt, yt, wt,
-                                      Xh, yh, prep_results, t0, failures)
+                                      Xh, yh, prep_results, t0, failures,
+                                      refit_state=refit_state)
         _plog("selector: refit+evaluate", t1)
         return selected
 

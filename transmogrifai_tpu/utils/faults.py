@@ -15,6 +15,10 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ========================  ====================================================
 ``dag.apply_layer``       fused device program of a DAG layer (via retry)
 ``sweep.fit``             one ModelSelector (fold, family) fit/score unit
+``selector.refit``        after the winner refit's checkpoint write, before
+                          train/holdout evaluation — a preemption here must
+                          resume from the refit checkpoint without
+                          retraining the winner
 ``train.layer``           start of each Workflow.train layer (preemption)
 ``ingest.read``           one streaming micro-batch file read
 ``checkpoint.write``      any durable checkpoint write (train/sweep/stream)
@@ -63,8 +67,9 @@ __all__ = ["FaultPlan", "FaultSpec", "FaultHarnessError",
 
 #: the instrumented site names (documentation + parse-time validation)
 KNOWN_SITES = frozenset({
-    "dag.apply_layer", "sweep.fit", "train.layer", "ingest.read",
-    "checkpoint.write", "collective", "serving.dispatch", "serving.swap",
+    "dag.apply_layer", "sweep.fit", "selector.refit", "train.layer",
+    "ingest.read", "checkpoint.write", "collective", "serving.dispatch",
+    "serving.swap",
 })
 
 KINDS = ("transient", "io", "slow", "preempt")

@@ -327,14 +327,36 @@ class SweepCounters(_CompileAttribution):
     by design, a warm re-run should report 0 compiles).
 
     Surfaced by ``bench.py`` under ``device_time_breakdown.sweep`` and
-    asserted in tests (fast path == 1 sync per family)."""
+    asserted in tests (fast path == 1 sync per family).
+
+    Run-level fields (round 9, the one-sync sweep): per-family
+    ``host_syncs`` counts each family's metric PULL (the materialization
+    that family paid for), while ``sweep_host_syncs`` counts blocking
+    device->host settle BARRIERS for the whole sweep — on the async
+    overlapped path every family's metrics settle behind ONE
+    ``jax.block_until_ready``, so the run-level count stays 1 however
+    many families/depth-groups dispatched (the tentpole assertion:
+    O(1) syncs per ``train()``, not O(families + depth-groups)).
+    ``async_families`` counts families whose metrics were held as device
+    futures past their dispatch; ``refit_warm_starts`` counts winner
+    refits that reused sweep state (stacked fold parameters or the
+    dataset-level tree bin codes) instead of cold-starting. The O(1)
+    scalar label-stat pull at dispatch start (max/mean of y, shared by
+    every family) stays uncounted, as the per-family lnb pulls always
+    were."""
 
     def __init__(self):
         super().__init__()
         self.families: dict = {}  # family name -> SweepFamilyCounters
+        self.sweep_host_syncs = 0   # blocking settle barriers, whole sweep
+        self.async_families = 0     # families overlapped past dispatch
+        self.refit_warm_starts = 0  # winner refits reusing sweep state
 
     def reset(self) -> None:
         self.families = {}
+        self.sweep_host_syncs = 0
+        self.async_families = 0
+        self.refit_warm_starts = 0
         self._active = None
 
     def family(self, name: str) -> SweepFamilyCounters:
@@ -351,6 +373,14 @@ class SweepCounters(_CompileAttribution):
         if mode is not None:
             fc.mode = mode
 
+    def count_run(self, *, host_syncs: int = 0, async_families: int = 0,
+                  refit_warm_starts: int = 0) -> None:
+        """Run-level accounting (see class docstring): settle barriers,
+        overlapped families, warm-started refits."""
+        self.sweep_host_syncs += host_syncs
+        self.async_families += async_families
+        self.refit_warm_starts += refit_warm_starts
+
     def _record_compile(self, key) -> None:
         self.family(key).compiles += 1
 
@@ -361,6 +391,13 @@ class SweepCounters(_CompileAttribution):
                        "stackedGroups": fc.stacked_groups,
                        "laneChunks": fc.lane_chunks}
                 for name, fc in self.families.items()}
+
+    def run_to_json(self) -> dict:
+        """The run-level one-sync counters (separate from the per-family
+        ``to_json`` map so existing consumers keep their shape)."""
+        return {"sweepHostSyncs": self.sweep_host_syncs,
+                "asyncFamilies": self.async_families,
+                "refitWarmStarts": self.refit_warm_starts}
 
 
 sweep_counters = SweepCounters()

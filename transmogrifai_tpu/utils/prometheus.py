@@ -188,6 +188,21 @@ def _app_collectors(reg: PromRegistry) -> None:
             f"transmogrifai_sweep_{attr}_total", "counter", help_,
             lambda a=attr: [({"family": name}, getattr(fc, a))
                             for name, fc in sc.families.items()])
+    # run-level one-sync counters (round 9): unlabeled — they describe the
+    # WHOLE sweep (the per-family host_syncs above count each family's
+    # metric pull; run_host_syncs counts blocking settle barriers, 1 on
+    # the async overlapped path however many families dispatched)
+    for attr, name, help_ in (
+            ("sweep_host_syncs", "run_host_syncs",
+             "blocking device->host settle barriers for the whole sweep"),
+            ("async_families", "async_families",
+             "families dispatched asynchronously (metrics held as device "
+             "futures until the single settle)"),
+            ("refit_warm_starts", "refit_warm_starts",
+             "winner refits warm-started from sweep state (stacked fold "
+             "parameters / reused tree bin codes)")):
+        reg.register(f"transmogrifai_sweep_{name}_total", "counter", help_,
+                     lambda a=attr: [({}, getattr(sc, a))])
 
 
 def _serving_collectors(reg: PromRegistry, lanes_fn) -> None:
