@@ -83,6 +83,70 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_serving_fleet(doc))
     if doc.get("metric") == "one_sync_sweep":
         errors.extend(_validate_one_sync(doc))
+    if doc.get("metric") == "continuous_loop":
+        errors.extend(_validate_continuous_loop(doc))
+    return errors
+
+
+def _validate_continuous_loop(doc: dict) -> list[str]:
+    """The ``benchmarks/CONTINUOUS_LOOP.json`` contract: one long-running
+    closed-loop run — injected mid-stream distribution shift -> drift
+    trigger -> checkpoint-resumed retrain -> shadow-gated hot-swap —
+    with counter-asserted zero dropped requests, zero lost/duplicated
+    stream rows, and promotion staleness within the recorded bound."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    if doc.get("drift_detected") is not True:
+        errors.append("continuous-loop artifact: 'drift_detected' must be "
+                      "true — the injected shift must actually trigger")
+    if doc.get("zero_dropped") is not True:
+        errors.append("continuous-loop artifact: 'zero_dropped' must be "
+                      "true — every live scoring request settled, "
+                      "including through the swap")
+    if doc.get("zero_lost_rows") is not True:
+        errors.append("continuous-loop artifact: 'zero_lost_rows' must be "
+                      "true — every produced stream row was consumed")
+    if not (isinstance(doc.get("windows"), int)
+            and not isinstance(doc.get("windows"), bool)
+            and doc.get("windows", 0) >= 2):
+        errors.append("continuous-loop artifact: 'windows' must be an int "
+                      ">= 2 (pre-shift and post-shift windows)")
+    for k in ("retrain_wall_s", "swap_wall_s", "staleness_s",
+              "staleness_bound_s"):
+        if not (num(doc.get(k)) and doc[k] > 0):
+            errors.append(f"continuous-loop artifact: missing positive "
+                          f"{k!r}")
+    stale, bound = doc.get("staleness_s"), doc.get("staleness_bound_s")
+    if num(stale) and num(bound) and stale > bound:
+        errors.append(
+            f"staleness bound violated: drift-to-promotion took {stale}s "
+            f"> the {bound}s bound — the loop is not keeping the model "
+            "fresh")
+    if not num(doc.get("drift_score")) or doc.get("drift_score", 0) <= 0:
+        errors.append("continuous-loop artifact: missing positive "
+                      "'drift_score' (the triggering window's measured "
+                      "divergence)")
+    promoted = doc.get("promoted")
+    if not (isinstance(promoted, dict)
+            and isinstance(promoted.get("version"), str)
+            and promoted.get("version")):
+        errors.append("continuous-loop artifact: 'promoted' must record "
+                      "the promoted 'version' string")
+    counters = doc.get("counters")
+    if not (isinstance(counters, dict) and all(
+            isinstance(counters.get(k), int)
+            and not isinstance(counters.get(k), bool)
+            for k in ("driftTriggers", "retrains", "promotions",
+                      "rollbacks"))):
+        errors.append("continuous-loop artifact: 'counters' must map "
+                      "driftTriggers/retrains/promotions/rollbacks to "
+                      "ints")
+    elif counters["driftTriggers"] < 1 or counters["promotions"] < 1:
+        errors.append("continuous-loop artifact: counters must record at "
+                      "least one driftTrigger and one promotion")
     return errors
 
 

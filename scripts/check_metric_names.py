@@ -150,6 +150,28 @@ def collect_violations() -> list[str]:
         metrics=fleet_metrics, program_cache=cache,
         active_lanes=lambda: {"churn": lane})
     out.extend(check_registry(build_registry(fleet=fleet)))
+
+    # the continuous-loop registry: lifecycle counters + per-feature
+    # drift-score gauges. Same structural-stub approach — real metrics
+    # objects, no live loop — so every collector closure renders.
+    from transmogrifai_tpu.continuous.loop import ContinuousMetrics
+
+    cm = ContinuousMetrics()
+    cm.record_batch(128)
+    cm.record_trigger()
+    cm.record_retrain()
+    cm.record_promotion()
+    cm.record_rollback()
+    out.extend(check_json_doc(cm.to_json(), "ContinuousMetrics.to_json"))
+    cont = types.SimpleNamespace(
+        metrics=cm,
+        drift_scores=lambda: {"age": 0.31, "__label__": 0.02},
+        staleness_s=lambda: 12.5,
+        window_seq=lambda: 7,
+        buffer_rows=lambda: 512)
+    out.extend(check_registry(build_registry(fleet=fleet,
+                                             continuous=cont,
+                                             include_app=False)))
     return out
 
 

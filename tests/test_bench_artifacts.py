@@ -338,3 +338,59 @@ def test_device_breakdown_surfaces_sweep_counters(benchmod):
     out = m._device_breakdown({"phases": {}, "sweep_counters": counters})
     assert out["sweep"] == counters
     assert "sweep" not in m._device_breakdown({"phases": {}})
+
+
+def test_continuous_loop_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = {"metric": "continuous_loop", "platform": "cpu", "rows": 600,
+            "requests": 1500, "windows": 6, "drift_detected": True,
+            "drift_score": 0.93, "retrain_wall_s": 2.1,
+            "swap_wall_s": 0.7, "staleness_s": 2.8,
+            "staleness_bound_s": 600.0, "zero_dropped": True,
+            "zero_lost_rows": True,
+            "promoted": {"version": "v2", "fromVersion": "v1"},
+            "counters": {"driftTriggers": 1, "retrains": 1,
+                         "promotions": 1, "rollbacks": 0}}
+    assert v(good) == []
+    assert any("drift_detected" in e for e in v(
+        {**good, "drift_detected": False}))
+    assert any("zero_dropped" in e for e in v(
+        {**good, "zero_dropped": False}))
+    assert any("zero_lost_rows" in e for e in v(
+        {**good, "zero_lost_rows": False}))
+    assert any("windows" in e for e in v({**good, "windows": 1}))
+    assert any("staleness bound violated" in e for e in v(
+        {**good, "staleness_s": 700.0}))
+    assert any("retrain_wall_s" in e for e in v(
+        {k: x for k, x in good.items() if k != "retrain_wall_s"}))
+    assert any("drift_score" in e for e in v({**good, "drift_score": 0}))
+    assert any("promoted" in e for e in v(
+        {**good, "promoted": {"version": ""}}))
+    assert any("counters" in e for e in v({**good, "counters": {}}))
+    assert any("at least one" in e for e in v(
+        {**good, "counters": {**good["counters"], "promotions": 0}}))
+
+
+def test_continuous_loop_artifact_committed_and_healthy(checker):
+    """The closed-loop acceptance contract, pinned on the COMMITTED
+    artifact: an injected mid-stream covariate shift was detected, the
+    retrain resumed serving traffic throughout, the hot-swap promoted a
+    new version with zero dropped requests and zero lost/duplicated
+    stream rows, within the staleness bound."""
+    path = os.path.join(REPO, "benchmarks", "CONTINUOUS_LOOP.json")
+    assert os.path.exists(path), \
+        "benchmarks/CONTINUOUS_LOOP.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "continuous_loop"
+    assert art["drift_detected"] is True
+    assert art["zero_dropped"] is True and art["zero_lost_rows"] is True
+    assert art["staleness_s"] <= art["staleness_bound_s"]
+    assert art["promoted"]["version"] == "v2"
+    assert art["promoted"]["fromVersion"] == "v1"
+    assert art["promoted"]["shadowRows"] > 0  # the gate actually ran
+    c = art["counters"]
+    assert c["driftTriggers"] >= 1 and c["promotions"] >= 1
+    assert c["rollbacks"] == 0
+    assert art["requests"] > 0 and art["serving"]["errors"] == 0
+    assert art["stream"]["rows"] == art["rows"]

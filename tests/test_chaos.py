@@ -945,3 +945,218 @@ def test_titanic_fault_injected_train_resume_smoke(tmp_path):
     got = np.asarray([d["probability_1"] for d in model.score(
         titanic_reader()).columns[pred.name].values])
     np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# the continuous closed loop under faults
+# ---------------------------------------------------------------------------
+
+def _continuous_batch(d, i, seed, shift=0.0, rows=20):
+    rng = np.random.default_rng(20_000 + seed)
+    x = rng.normal(loc=shift, size=rows)
+    y = (x > 0).astype(float)
+    lines = ["label,x"] + [f"{yi},{xi}" for xi, yi in zip(x, y)]
+    path = os.path.join(d, f"b{i:03d}.csv")
+    with open(path + ".tmp", "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def _continuous_loop(wf, stream, state, **kw):
+    from transmogrifai_tpu.continuous import ContinuousLoop, DriftConfig
+    kw.setdefault("drift", DriftConfig(js_threshold=0.35,
+                                       consecutive_windows=1,
+                                       cooldown_windows=2))
+    kw.setdefault("window_batches", 2)
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("timeout_s", 1.0)
+    return ContinuousLoop(wf, str(stream), str(state), **kw)
+
+
+def test_continuous_retrain_preemption_resumes_zero_duplicate_fits(
+        tmp_path, monkeypatch):
+    """A preemption mid-retrain (inside the retrain's ``train.layer``)
+    kills the loop with the pendingRetrain manifest durable; the
+    restarted loop re-runs the SAME retrain resuming from the per-window
+    fitted-DAG checkpoints — completed layers are restored, not refit —
+    and promotes. Serving state machinery is untouched throughout."""
+    monkeypatch.setenv("TRANSMOGRIFAI_SWEEP_STACKED", "1")
+    stream = tmp_path / "stream"
+    state = tmp_path / "state"
+    stream.mkdir()
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    model = wf.train()
+    profiler.reset()
+    for i in range(4):
+        _continuous_batch(str(stream), i, seed=i, shift=4.0)
+
+    loop = _continuous_loop(wf, stream, state, initial_model=model,
+                            reference_frame=host)
+    with fault_plan("preempt@train.layer#1"):
+        with pytest.raises(SimulatedPreemption):
+            loop.run()
+    fitted_before_crash = run_counters.layers_fitted
+    assert fitted_before_crash >= 1
+    from transmogrifai_tpu.continuous import LoopState
+    st = LoopState(str(state), "live")
+    pending = st.pending_retrain
+    assert pending is not None and pending["attempt"] == 1
+    assert os.path.isdir(pending["checkpointDir"])  # durable resume root
+
+    profiler.reset()
+    loop2 = _continuous_loop(wf, stream, state, initial_model=model,
+                             reference_frame=None)
+    with pytest.warns(RuntimeWarning, match="resuming pending retrain"):
+        report = loop2.run()
+    # the crashed attempt's completed layers came back from checkpoint
+    assert run_counters.layers_resumed == fitted_before_crash
+    assert report["counters"]["promotions"] == 1
+    assert report["activeVersion"] == "v2"
+    assert report["pendingRetrain"] is None
+    assert LoopState(str(state), "live").pending_retrain is None
+
+
+def test_continuous_promote_preemption_resumes_with_zero_fits(tmp_path,
+                                                              monkeypatch):
+    """Preempt at ``continuous.promote``: the retrain COMPLETED (all
+    checkpoints written) but the swap never started. The restarted loop
+    re-runs the pending retrain fully from checkpoints — counter-asserted
+    ZERO model fits — and promotes the identical model."""
+    monkeypatch.setenv("TRANSMOGRIFAI_SWEEP_STACKED", "1")
+    stream = tmp_path / "stream"
+    state = tmp_path / "state"
+    stream.mkdir()
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    model = wf.train()
+    profiler.reset()
+    for i in range(4):
+        _continuous_batch(str(stream), i, seed=i, shift=4.0)
+
+    loop = _continuous_loop(wf, stream, state, initial_model=model,
+                            reference_frame=host)
+    with fault_plan("preempt@continuous.promote#0"):
+        with pytest.raises(SimulatedPreemption):
+            loop.run()
+
+    fits = {"n": 0}
+    orig = OpLogisticRegression.fit_arrays
+
+    def counting(self, *a, **kw):
+        fits["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(OpLogisticRegression, "fit_arrays", counting)
+    profiler.reset()
+    loop2 = _continuous_loop(wf, stream, state, initial_model=model,
+                             reference_frame=None)
+    with pytest.warns(RuntimeWarning, match="resuming pending retrain"):
+        report = loop2.run()
+    assert fits["n"] == 0  # sweep + refit + layers all restored
+    assert report["counters"]["promotions"] == 1
+    assert report["activeVersion"] == "v2"
+
+
+def test_continuous_shadow_gate_rejection_leaves_old_serving(tmp_path):
+    """The parity gate rejects a drift-retrained candidate (tolerance 0
+    against genuinely shifted training data): the rollback is counted,
+    the old version keeps serving with BIT-IDENTICAL scores on the same
+    rows, and not one live request was dropped."""
+    stream = tmp_path / "stream"
+    state = tmp_path / "state"
+    stream.mkdir()
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    model = wf.train()
+    for i in range(4):
+        _continuous_batch(str(stream), i, seed=i, shift=4.0)
+
+    live_rows = [{"x": 0.25 * k - 1.0} for k in range(8)]
+    pre_scores = {}
+
+    def seed_traffic(lp):
+        for k, row in enumerate(live_rows):
+            pre_scores[k] = lp.fleet.score("live", dict(row),
+                                           timeout_s=30)
+
+    loop = _continuous_loop(
+        wf, stream, state, initial_model=model, reference_frame=host,
+        shadow_rows=8, shadow_tolerance=0.0, on_started=seed_traffic,
+        stop_fleet_on_exit=False)
+    with pytest.warns(RuntimeWarning, match="rolled back by the shadow"):
+        report = loop.run()
+    try:
+        c = report["counters"]
+        assert c["driftTriggers"] == 1 and c["retrains"] == 1
+        assert c["rollbacks"] == 1 and c["promotions"] == 0
+        assert report["activeVersion"] == "v1"  # old version untouched
+        # bit-identical scores from the never-swapped v1 lane
+        for k, row in enumerate(live_rows):
+            got = loop.fleet.score("live", dict(row), timeout_s=30)
+            assert got == pre_scores[k]
+        snap = loop._serving_snapshot()
+        assert snap["failed"] == 0
+        # zero drops: every admitted request settled (ours twice over,
+        # plus the gate's own shadow submissions to the live lane)
+        assert snap["admitted"] == snap["completed"] >= 2 * len(live_rows)
+        from transmogrifai_tpu.continuous import LoopState
+        st = LoopState(str(state), "live")
+        assert st.totals["rollbacks"] == 1
+        assert st.pending_retrain is None  # abandoned, not retried hot
+    finally:
+        loop.fleet.stop(drain=True)
+
+
+def test_continuous_kill_restart_loses_zero_rows(tmp_path, monkeypatch):
+    """Kill the loop mid-ingest and restart it: every produced stream row
+    is consumed at least once (the in-flight file replays via the stream
+    checkpoint; committed files never re-yield) and the retrain buffer
+    holds no duplicate file entries."""
+    from transmogrifai_tpu.continuous import ContinuousLoop, DriftConfig
+    stream = tmp_path / "stream"
+    state = tmp_path / "state"
+    stream.mkdir()
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    model = wf.train()
+    produced = {}
+    for i in range(4):
+        _continuous_batch(str(stream), i, seed=i)
+        produced[str(stream / f"b{i:03d}.csv")] = 20
+
+    consumed: list[tuple] = []
+    orig_consume = ContinuousLoop._consume_batch
+
+    def spying(self, source, records):
+        consumed.append((source, len(records)))
+        return orig_consume(self, source, records)
+
+    monkeypatch.setattr(ContinuousLoop, "_consume_batch", spying)
+    quiet = DriftConfig(js_threshold=10.0, consecutive_windows=5)
+
+    loop = _continuous_loop(wf, stream, state, initial_model=model,
+                            reference_frame=host, drift=quiet,
+                            max_buffer_batches=8)
+    # die on the THIRD batch's ingest tick: two committed, one in flight
+    with fault_plan("preempt@continuous.ingest#2"):
+        with pytest.raises(SimulatedPreemption):
+            loop.run()
+    assert len(consumed) == 2
+
+    loop2 = _continuous_loop(wf, stream, state, initial_model=model,
+                             reference_frame=None, drift=quiet,
+                             max_buffer_batches=8)
+    report = loop2.run()
+    # zero lost rows: every produced file was consumed at least once...
+    seen_files = {src for src, _ in consumed}
+    assert seen_files == set(produced)
+    assert all(n == produced[src] for src, n in consumed)
+    # ...at-least-once, not at-most-once: only the in-flight file may
+    # replay, and the durable buffer dedupes it per file
+    assert len(consumed) <= len(produced) + 1
+    buffer_files = [b["file"] for b in loop2.state.buffer]
+    assert len(buffer_files) == len(set(buffer_files)) == 4
+    assert loop2.buffer_rows() == 80
+    assert report["counters"]["skippedBatches"] == 0

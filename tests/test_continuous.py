@@ -1,0 +1,960 @@
+"""Closed-loop continuous AutoML: drift monitoring units, loop-state
+durability, the stream->drift->retrain->hot-swap loop end to end, the
+streaming hardening satellites (nanosecond checkpoint fingerprints,
+mid-stream file rotation), the Prometheus surface, and the CLI/runner
+entry points. Chaos coverage (preemption mid-retrain, gate rollback,
+kill-and-restart row accounting) lives in tests/test_chaos.py.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401 — installs operators
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.continuous import (
+    ContinuousLoop, ContinuousMetrics, DriftConfig, DriftMonitor, LoopState,
+)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.uid import UID
+from transmogrifai_tpu.workflow import Workflow
+
+N = 150
+
+
+def _frame(n=400, seed=0, shift=0.0, fill=1.0, label_one=False):
+    """One labeled 2-feature frame; ``shift`` moves x1's location,
+    ``fill`` drops x1 values to None, ``label_one`` forces the label to
+    1.0 (a pure label-rate shift: predictors stay in distribution)."""
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(loc=shift, size=n)
+    x2 = rng.normal(size=n)
+    logit = 1.5 * x1 - x2
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(float)
+    if label_one:
+        y = np.ones_like(y)
+    x1_vals = [float(v) if rng.uniform() < fill else None for v in x1]
+    return fr.HostFrame.from_dict({
+        "label": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1_vals),
+        "x2": (ft.Real, x2.tolist()),
+    })
+
+
+def _build_workflow(n=N, seed=0):
+    host = _frame(n=n, seed=seed)
+    feats = FeatureBuilder.from_frame(host, response="label")
+    vec = transmogrify([feats["x1"], feats["x2"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=20), [{}])])
+    pred = feats["label"].transform_with(sel, vec)
+    wf = Workflow().set_input_frame(host).set_result_features(pred, vec)
+    return wf, host, pred
+
+
+def _write_batch(d, i, seed, shift=0.0, rows=20):
+    """One atomic stream micro-batch CSV (rename-into-place, the
+    recommended producer convention)."""
+    rng = np.random.default_rng(10_000 + seed)
+    lines = ["label,x1,x2"]
+    for _ in range(rows):
+        x1 = rng.normal(loc=shift)
+        x2 = rng.normal()
+        p = 1 / (1 + np.exp(-(1.5 * x1 - x2)))
+        lines.append(f"{float(rng.uniform() < p)},{x1},{x2}")
+    path = os.path.join(d, f"b{i:03d}.csv")
+    with open(path + ".tmp", "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One fitted workflow shared by the e2e tests (UID pinned so every
+    retrain in the module keeps the same result-feature schema)."""
+    UID.reset()
+    wf, host, pred = _build_workflow()
+    model = wf.train()
+    return {"wf": wf, "host": host, "pred": pred, "model": model}
+
+
+# ---------------------------------------------------------------------------
+# drift monitor
+# ---------------------------------------------------------------------------
+
+def test_psi_zero_for_identical_and_large_for_shifted():
+    from transmogrifai_tpu.continuous.drift import psi
+    from transmogrifai_tpu.filters.raw_feature_filter import (
+        FeatureDistribution,
+    )
+    a = FeatureDistribution("x", 100, 0, np.array([50.0, 30.0, 20.0]), {})
+    b = FeatureDistribution("x", 100, 0, np.array([50.0, 30.0, 20.0]), {})
+    assert psi(a, b) == pytest.approx(0.0, abs=1e-9)
+    c = FeatureDistribution("x", 100, 0, np.array([5.0, 15.0, 80.0]), {})
+    assert psi(a, c) > 0.25
+    # zero-mass / shape-mismatch guards
+    z = FeatureDistribution("x", 0, 0, np.zeros(3), {})
+    assert psi(a, z) == 0.0
+    w = FeatureDistribution("x", 10, 0, np.ones(5), {})
+    assert psi(a, w) == 0.0
+
+
+def test_monitor_no_drift_on_same_distribution():
+    m = DriftMonitor(DriftConfig(consecutive_windows=1))
+    m.set_reference(_frame(seed=0), ["x1", "x2"], response="label")
+    m.observe(_frame(seed=1))
+    d = m.close_window()
+    assert not d.breached and not d.triggered
+    assert d.scores["x1"]["js"] < 0.1
+    assert d.scores["x1"]["psi"] < 0.25
+
+
+def test_monitor_detects_covariate_shift():
+    m = DriftMonitor(DriftConfig(consecutive_windows=1,
+                                 js_threshold=0.2))
+    m.set_reference(_frame(seed=0), ["x1", "x2"], response="label")
+    m.observe(_frame(seed=1, shift=4.0))
+    d = m.close_window()
+    assert d.breached and d.triggered
+    assert d.scores["x1"]["js"] > 0.2
+    assert d.scores["x1"]["breached"]
+    assert any("x1" in r for r in d.reasons)
+    # the gauge feed carries the driving metric
+    assert m.drift_scores()["x1"] > 0.2
+
+
+def test_monitor_psi_metric_drives_trigger():
+    m = DriftMonitor(DriftConfig(metric="psi", psi_threshold=0.5,
+                                 consecutive_windows=1))
+    m.set_reference(_frame(seed=0), ["x1", "x2"])
+    m.observe(_frame(seed=1, shift=4.0))
+    d = m.close_window()
+    assert d.triggered
+    assert any("PSI" in r for r in d.reasons)
+
+
+def test_monitor_fill_rate_delta_breaches():
+    m = DriftMonitor(DriftConfig(consecutive_windows=1,
+                                 fill_delta_threshold=0.3))
+    m.set_reference(_frame(seed=0), ["x1", "x2"])
+    m.observe(_frame(seed=1, fill=0.4))  # ~60% of x1 goes null
+    d = m.close_window()
+    assert d.triggered
+    assert d.scores["x1"]["fillDelta"] > 0.3
+    assert any("fill delta" in r for r in d.reasons)
+
+
+def test_monitor_label_rate_delta_breaches():
+    m = DriftMonitor(DriftConfig(consecutive_windows=1,
+                                 label_delta_threshold=0.2))
+    m.set_reference(_frame(seed=0), ["x1", "x2"], response="label")
+    m.observe(_frame(seed=1, label_one=True))
+    d = m.close_window()
+    assert d.triggered
+    assert d.scores["__label__"]["labelDelta"] > 0.2
+    assert m.drift_scores()["__label__"] > 0.2
+
+
+def test_monitor_hysteresis_needs_consecutive_windows():
+    m = DriftMonitor(DriftConfig(consecutive_windows=2, js_threshold=0.2,
+                                 cooldown_windows=0))
+    m.set_reference(_frame(seed=0), ["x1", "x2"])
+    m.observe(_frame(seed=1, shift=4.0))
+    d1 = m.close_window()
+    assert d1.breached and not d1.triggered  # one noisy window: no fire
+    m.observe(_frame(seed=2))  # back in distribution: streak resets
+    assert not m.close_window().breached
+    m.observe(_frame(seed=3, shift=4.0))
+    assert not m.close_window().triggered
+    m.observe(_frame(seed=4, shift=4.0))
+    assert m.close_window().triggered  # second consecutive breach fires
+
+
+def test_monitor_cooldown_suppresses_retrain_storm():
+    m = DriftMonitor(DriftConfig(consecutive_windows=1, js_threshold=0.2,
+                                 cooldown_windows=2))
+    m.set_reference(_frame(seed=0), ["x1", "x2"])
+    m.observe(_frame(seed=1, shift=4.0))
+    assert m.close_window().triggered
+    for seed in (2, 3):  # cooldown: still breached, never triggered
+        m.observe(_frame(seed=seed, shift=4.0))
+        with pytest.warns(RuntimeWarning, match="cooldown"):
+            d = m.close_window()
+        assert d.breached and not d.triggered
+    m.observe(_frame(seed=4, shift=4.0))
+    assert m.close_window().triggered  # re-armed
+
+
+def test_monitor_empty_window_never_breaches():
+    m = DriftMonitor(DriftConfig(consecutive_windows=1))
+    m.set_reference(_frame(seed=0), ["x1", "x2"])
+    d = m.close_window()
+    assert not d.breached and not d.triggered and d.rows == 0
+
+
+def test_monitor_reference_roundtrip():
+    m1 = DriftMonitor(DriftConfig(consecutive_windows=1, js_threshold=0.2))
+    m1.set_reference(_frame(seed=0), ["x1", "x2"], response="label")
+    doc = json.loads(json.dumps(m1.reference_to_json()))  # survives JSON
+    m2 = DriftMonitor(DriftConfig(consecutive_windows=1, js_threshold=0.2))
+    assert m2.restore_reference(doc)
+    live = _frame(seed=1, shift=4.0)
+    m1.observe(live)
+    m2.observe(live)
+    s1, s2 = m1.close_window().scores, m2.close_window().scores
+    assert s1["x1"]["js"] == s2["x1"]["js"]
+    assert s1["__label__"]["labelDelta"] == s2["__label__"]["labelDelta"]
+
+
+def test_monitor_malformed_reference_warns_and_rebases():
+    m = DriftMonitor()
+    with pytest.warns(RuntimeWarning, match="unreadable reference"):
+        assert not m.restore_reference(
+            {"features": {"x": {"count": "NaN-ish"}}})
+    assert not m.has_reference
+
+
+def test_drift_config_validation():
+    with pytest.raises(ValueError, match="metric"):
+        DriftConfig(metric="kl")
+    with pytest.raises(ValueError, match="consecutive_windows"):
+        DriftConfig(consecutive_windows=0)
+
+
+# ---------------------------------------------------------------------------
+# loop state durability
+# ---------------------------------------------------------------------------
+
+def test_loop_state_roundtrip_and_buffer_bound(tmp_path):
+    s = LoopState(str(tmp_path), "live")
+    for i in range(6):
+        s.record_batch(f"f{i}.csv", 10, max_buffer_batches=4)
+    assert [b["file"] for b in s.buffer] == [f"f{i}.csv" for i in
+                                             range(2, 6)]
+    s.record_decision({"window": 1, "triggered": True})
+    s.begin_retrain(["drift"], str(tmp_path / "ck"))
+    s2 = LoopState(str(tmp_path), "live")
+    assert s2.window_seq == 1
+    assert s2.pending_retrain["files"] == [b["file"] for b in s.buffer]
+    assert s2.pending_retrain["attempt"] == 1
+    assert s2.totals["driftTriggers"] == 1
+    assert s2.totals["retrains"] == 1
+
+
+def test_loop_state_retry_backoff_and_promotion_reset(tmp_path):
+    s = LoopState(str(tmp_path), "live")
+    s.record_batch("f.csv", 10, 4)
+    s.begin_retrain(["drift"], str(tmp_path / "ck"))
+    assert s.retrain_eligible()
+    s.record_retrain_failure("boom")
+    assert s.backoff_windows == 1
+    s.record_retrain_failure("boom again")
+    assert s.backoff_windows == 2  # exponential, in windows
+    assert not s.retrain_eligible()
+    s.window_seq = s.backoff_until_window
+    assert s.retrain_eligible()
+    s.begin_retrain([], None)
+    assert s.pending_retrain["attempt"] == 2  # retry keeps the record
+    s.record_promotion("v2", {"toVersion": "v2"}, staleness_s=3.5)
+    assert s.pending_retrain is None and s.buffer == []
+    assert s.backoff_windows == 0
+    assert s.totals["promotions"] == 1
+    s3 = LoopState(str(tmp_path), "live")
+    assert s3.promotions[-1]["stalenessSeconds"] == 3.5
+    assert s3.last_promoted_at is not None
+
+
+def test_loop_state_corrupt_and_foreign_manifests_start_fresh(tmp_path):
+    s = LoopState(str(tmp_path), "live")
+    s.record_batch("f.csv", 5, 4)
+    manifest = tmp_path / "continuous_manifest.json"
+    with pytest.warns(RuntimeWarning, match="belongs to model"):
+        other = LoopState(str(tmp_path), "other-model")
+    assert other.buffer == []
+    manifest.write_text("{not json")
+    with pytest.warns(RuntimeWarning, match="unreadable manifest"):
+        fresh = LoopState(str(tmp_path), "live")
+    assert fresh.window_seq == 0 and fresh.buffer == []
+
+
+def test_loop_state_abandon_records_history(tmp_path):
+    s = LoopState(str(tmp_path), "live")
+    s.record_batch("f.csv", 5, 4)
+    s.begin_retrain(["drift"], None)
+    s.record_rollback({"error": "ShadowParityError: diverged"})
+    assert s.pending_retrain is None
+    assert s.totals["rollbacks"] == 1
+    assert s.retrain_failures[-1]["abandoned"]
+
+
+# ---------------------------------------------------------------------------
+# streaming hardening satellites
+# ---------------------------------------------------------------------------
+
+def test_stream_checkpoint_fingerprint_uses_mtime_ns(tmp_path):
+    """Regression: a file REWRITTEN in place with the same size inside
+    the float st_mtime's granularity must not stay marked done. A 1ns
+    bump is invisible to the float (1e-9 of ~1.7e9s is far below f64
+    resolution) but must invalidate the fingerprint."""
+    from transmogrifai_tpu.readers.streaming import StreamCheckpoint
+    f = tmp_path / "a.csv"
+    f.write_text("k,v\n1,2\n")
+    st = os.stat(f)
+    ckpt = StreamCheckpoint(str(tmp_path / "ckpt.json"))
+    ckpt.mark_done(str(f))
+    assert ckpt.is_done(str(f))
+    f.write_text("k,v\n9,8\n")  # same byte length, different rows
+    os.utime(f, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    # the float mtime cannot see the rewrite — the old fingerprint would
+    # have wrongly treated the new content as already processed
+    assert os.stat(f).st_mtime == st.st_mtime
+    assert os.stat(f).st_size == st.st_size
+    assert not ckpt.is_done(str(f))
+
+
+def test_stream_checkpoint_pre_ns_entries_replay_once(tmp_path):
+    """Entries persisted by the pre-mtime_ns format no longer match and
+    replay once — at-least-once, the documented degradation."""
+    from transmogrifai_tpu.readers.streaming import StreamCheckpoint
+    f = tmp_path / "a.csv"
+    f.write_text("k,v\n1,2\n")
+    st = os.stat(f)
+    ckpt = StreamCheckpoint(str(tmp_path / "ckpt.json"))
+    ckpt._done[str(f)] = {"mtime": st.st_mtime, "size": st.st_size}
+    assert not ckpt.is_done(str(f))
+
+
+def test_stream_file_deleted_mid_stream_is_skipped_not_fatal(tmp_path):
+    """A file deleted/rotated between ``_list_files`` and the read warns
+    and skips (durably recorded) instead of crashing the loop."""
+    from transmogrifai_tpu.readers.streaming import (
+        FileStreamingReader, StreamCheckpoint,
+    )
+    for i in range(3):
+        (tmp_path / f"f{i}.csv").write_text(
+            "k\n" + "\n".join(f"r{i}-{j}" for j in range(3)) + "\n")
+    ckpt_path = str(tmp_path / "ckpt" / "stream.json")
+    reader = FileStreamingReader(
+        str(tmp_path), pattern="*.csv", poll_interval_s=0.01,
+        timeout_s=0.5, checkpoint=ckpt_path)
+    got = []
+    stream = reader.stream()
+    first = next(stream)  # f0 consumed; generator paused pre-f1
+    got.extend(r["k"] for r in first)
+    os.unlink(tmp_path / "f1.csv")  # rotated away mid-stream
+    with pytest.warns(RuntimeWarning, match="disappeared mid-stream"):
+        for batch in stream:
+            got.extend(r["k"] for r in batch)
+    assert sorted(got) == sorted(f"r{i}-{j}" for i in (0, 2)
+                                 for j in range(3))
+    assert reader.skipped_files == [str(tmp_path / "f1.csv")]
+    # durable: a restarted reader won't wait on the vanished file either
+    assert StreamCheckpoint(ckpt_path).skipped == [str(tmp_path / "f1.csv")]
+
+
+def test_stream_skipped_path_recreated_is_reread(tmp_path):
+    """Regression: a durable skip holds by (path, fingerprint), not by
+    name — a file RECREATED at a skipped path (the rotation pattern:
+    rename away, write fresh) is new data a restarted stream must read,
+    not a path silently ignored forever."""
+    from transmogrifai_tpu.readers.streaming import (
+        FileStreamingReader, StreamCheckpoint,
+    )
+    for i in range(2):
+        (tmp_path / f"f{i}.csv").write_text(f"k\nr{i}\n")
+    ckpt_path = str(tmp_path / "ckpt" / "stream.json")
+    reader = FileStreamingReader(
+        str(tmp_path), pattern="*.csv", poll_interval_s=0.01,
+        timeout_s=0.3, checkpoint=ckpt_path)
+    stream = reader.stream()
+    next(stream)  # f0 consumed; generator paused pre-f1
+    os.unlink(tmp_path / "f1.csv")  # rotated away mid-stream
+    with pytest.warns(RuntimeWarning, match="disappeared mid-stream"):
+        list(stream)
+    f1 = str(tmp_path / "f1.csv")
+    assert StreamCheckpoint(ckpt_path).is_skipped(f1)  # gone: skip holds
+    # the rotation completes: fresh rows land at the same path
+    (tmp_path / "f1.csv").write_text("k\nfresh\n")
+    assert not StreamCheckpoint(ckpt_path).is_skipped(f1)
+    reader2 = FileStreamingReader(
+        str(tmp_path), pattern="*.csv", poll_interval_s=0.01,
+        timeout_s=0.3, checkpoint=ckpt_path)
+    got = [r["k"] for batch in reader2.stream() for r in batch]
+    assert got == ["fresh"]  # f0 stays done; recreated f1 is new data
+
+
+# ---------------------------------------------------------------------------
+# the closed loop end to end
+# ---------------------------------------------------------------------------
+
+def _loop(trained, stream_dir, state_dir, **kw):
+    # threshold 0.35: comfortably above the ~0.2 JS noise floor of a
+    # 40-row window against the 150-row reference, far below the ~0.9
+    # a shift=4.0 window measures
+    kw.setdefault("drift", DriftConfig(js_threshold=0.35,
+                                       consecutive_windows=1,
+                                       cooldown_windows=2))
+    kw.setdefault("window_batches", 2)
+    kw.setdefault("max_buffer_batches", 4)
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("timeout_s", 1.0)
+    kw.setdefault("initial_model", trained["model"])
+    kw.setdefault("reference_frame", trained["host"])
+    return ContinuousLoop(trained["wf"], str(stream_dir), str(state_dir),
+                          **kw)
+
+
+def test_closed_loop_shift_triggers_retrain_and_promotes(tmp_path,
+                                                         trained):
+    """The tentpole demo in miniature: in-distribution windows leave v1
+    serving; an injected covariate shift triggers, retrains on the
+    accumulated window, and hot-swaps v2 — and the drift reference
+    rebases so the (still shifted) next window doesn't re-trigger."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)  # window 1: in-distribution
+    for i in range(2, 6):
+        _write_batch(str(stream), i, seed=i, shift=4.0)  # shifted
+    loop = _loop(trained, stream, tmp_path / "state", max_windows=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        report = loop.run()
+    c = report["counters"]
+    assert c["driftTriggers"] == 1
+    assert c["retrains"] == 1
+    assert c["promotions"] == 1
+    assert c["rollbacks"] == 0
+    assert c["skippedBatches"] == 0
+    assert report["activeVersion"] == "v2"
+    assert c["rows"] == 6 * 20 and c["batches"] == 6
+    assert report["pendingRetrain"] is None
+    assert report["promotions"][-1]["version"] == "v2"
+    # durable manifest carries the promotion + rebased reference
+    state = LoopState(str(tmp_path / "state"), "live")
+    assert state.totals["promotions"] == 1
+    assert state.drift_reference is not None
+    m = DriftMonitor(loop.monitor.config)
+    assert m.restore_reference(state.drift_reference)
+    # the promoted version persisted durably; superseded v1 pruned
+    assert os.listdir(tmp_path / "state" / "models" / "live") == ["v2"]
+
+
+def test_loop_in_distribution_stream_never_retrains(tmp_path, trained):
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(4):
+        _write_batch(str(stream), i, seed=i)
+    loop = _loop(trained, stream, tmp_path / "state")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        report = loop.run()
+    c = report["counters"]
+    assert report["windows"] == 2
+    assert c["driftTriggers"] == 0 and c["retrains"] == 0
+    assert report["activeVersion"] == "v1"
+    assert report["lastDecision"]["breached"] is False
+
+
+def test_loop_hysteresis_one_shifted_window_does_not_trigger(tmp_path,
+                                                             trained):
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    _write_batch(str(stream), 0, seed=0, shift=4.0)
+    _write_batch(str(stream), 1, seed=1, shift=4.0)  # one shifted window
+    _write_batch(str(stream), 2, seed=2)
+    _write_batch(str(stream), 3, seed=3)             # back in distribution
+    loop = _loop(trained, stream, tmp_path / "state",
+                 drift=DriftConfig(js_threshold=0.2,
+                                   consecutive_windows=2,
+                                   cooldown_windows=2))
+    report = loop.run()
+    assert report["counters"]["driftTriggers"] == 0
+    assert report["activeVersion"] == "v1"
+    decisions = LoopState(str(tmp_path / "state"), "live").decisions
+    assert decisions[0]["breached"] and not decisions[0]["triggered"]
+
+
+def test_loop_bootstraps_from_first_window_without_model(tmp_path):
+    """No initial model: the first full window trains v1 and serving
+    starts from it (the cold-start path of the flagship demo)."""
+    UID.reset()
+    wf, _, _ = _build_workflow(seed=9)
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    loop = ContinuousLoop(
+        wf, str(stream), str(tmp_path / "state"),
+        window_batches=2, poll_interval_s=0.02, timeout_s=1.0,
+        max_windows=1)
+    report = loop.run()
+    assert report["activeVersion"] == "v1"
+    assert report["counters"]["promotions"] == 1
+    assert report["promotions"][-1]["swap"]["bootstrap"] is True
+
+
+def test_loop_adopts_first_window_as_reference_with_external_model(
+        tmp_path, trained):
+    """Initial model but no reference frame: the first window becomes
+    the drift baseline (warned) instead of crashing or mis-triggering."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    loop = _loop(trained, stream, tmp_path / "state",
+                 reference_frame=None)
+    with pytest.warns(RuntimeWarning, match="adopted the first"):
+        report = loop.run()
+    assert loop.monitor.has_reference
+    assert report["counters"]["driftTriggers"] == 0
+
+
+def test_loop_poison_batch_skipped_not_fatal(tmp_path, trained):
+    """A batch that parses but cannot build a frame is dropped from
+    training (counted + warned) without killing the loop: serving and
+    subsequent ingest stay healthy."""
+    loop = _loop(trained, tmp_path / "stream", tmp_path / "state")
+    loop.monitor.set_reference(trained["host"], ["x1", "x2"],
+                               response="label")
+    with pytest.warns(RuntimeWarning, match="dropping unreadable batch"):
+        loop._consume_batch("bad.csv", [
+            {"label": 1.0, "x1": object(), "x2": 0.1}])
+    assert loop.metrics.skipped_batches == 1
+    assert loop.metrics.batches == 0
+    assert loop._batches_in_window == 0  # dropped batches don't count
+    # the next healthy batch flows through untouched
+    loop._consume_batch("ok.csv", [
+        {"label": 1.0, "x1": 0.4, "x2": 0.1}])
+    assert loop.metrics.batches == 1
+    assert loop.buffer_rows() == 1
+
+
+def test_loop_startup_failure_tears_down(tmp_path, trained):
+    """Regression: a failing ``on_started`` hook (or any startup step
+    after the fleet/metrics endpoint came up) must still tear down the
+    lanes and release the scrape port — an embedding supervisor's retry
+    would otherwise inherit bound ports and live worker threads."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+
+    def boom(_loop):
+        raise RuntimeError("announce hook failed")
+
+    loop = _loop(trained, stream, tmp_path / "state",
+                 metrics_port=0, on_started=boom)
+    with pytest.raises(RuntimeError, match="announce hook failed"), \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loop.run()
+    assert not loop._fleet_started  # lanes stopped, not leaked
+    assert loop.metrics_http is None  # scrape port released
+
+
+def test_loop_failed_retrain_keeps_old_model_and_backs_off(tmp_path,
+                                                           trained,
+                                                           monkeypatch):
+    """Every retrain attempt fails: the old model keeps serving, the
+    attempt budget is honored, backoff recorded, the loop stays alive."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(8):
+        _write_batch(str(stream), i, seed=i, shift=4.0)
+    loop = _loop(trained, stream, tmp_path / "state",
+                 drift=DriftConfig(js_threshold=0.2,
+                                   consecutive_windows=1,
+                                   cooldown_windows=0),
+                 max_retrain_attempts=2)
+    monkeypatch.setattr(loop.workflow, "train",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("synthetic trainer crash")))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        report = loop.run()
+    c = report["counters"]
+    assert c["retrainFailures"] == c["retrains"] >= 1
+    assert c["promotions"] == 0
+    assert report["activeVersion"] == "v1"  # old model never stopped
+    state = LoopState(str(tmp_path / "state"), "live")
+    assert state.retrain_failures
+    assert report["retrainFailures"][-1]["error"].startswith("RuntimeError")
+
+
+def test_bootstrap_failed_retrain_backs_off_not_storms(tmp_path, trained,
+                                                       monkeypatch):
+    """Regression: a bootstrap loop (no model, no reference) whose train
+    keeps failing honors the exponential backoff + attempt budget like
+    the drift-trigger path does, instead of re-running the full failing
+    train every single window forever; and an abandoned retrain deletes
+    its checkpoint tree instead of leaking one dir per abandonment under
+    the durable state root."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(14):
+        _write_batch(str(stream), i, seed=i)
+    loop = _loop(trained, stream, tmp_path / "state",
+                 initial_model=None, reference_frame=None,
+                 max_retrain_attempts=3, max_windows=7)
+    monkeypatch.setattr(loop.workflow, "train",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("synthetic trainer crash")))
+    # the attempt-1 checkpoint dir as the (interrupted) trainer would
+    # have left it: the abandon path must delete it
+    leak = tmp_path / "state" / "retrain_w0"
+    leak.mkdir()
+    (leak / "dag.json").write_text("{}")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        report = loop.run()
+    c = report["counters"]
+    # 7 windows, 3 attempts (w1, w3, w6 — backoff 1 then 2 windows
+    # between them), then abandoned: NOT one full train per window
+    assert report["windows"] == 7
+    assert c["retrains"] == 3 == c["retrainFailures"]
+    assert report["pendingRetrain"] is None  # attempt budget exhausted
+    assert report["activeVersion"] is None
+    assert not leak.exists()  # abandoned checkpoint tree removed
+
+
+def test_loop_reference_path_pins_drift_reference(tmp_path, trained):
+    """``reference_path`` (cli ``--reference`` / runner
+    ``referencePath``) pins the drift reference from a batch file
+    sampling the model's training data, instead of silently adopting
+    the first stream window."""
+    ref = _write_batch(str(tmp_path), 99, seed=99, rows=60)
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    loop = _loop(trained, stream, tmp_path / "state",
+                 reference_frame=None, reference_path=ref, max_windows=1)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        report = loop.run()
+    assert loop.monitor.has_reference
+    assert report["counters"]["driftTriggers"] == 0  # in-distribution
+    assert not any("adopted the first" in str(w.message) for w in caught)
+    # a bad reference file is startup config: fail fast, not fall through
+    # to adopt-first-window (which would blind the monitor)
+    bad = _loop(trained, stream, tmp_path / "state2",
+                reference_frame=None,
+                reference_path=str(tmp_path / "nope.csv"))
+    with pytest.raises(Exception), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bad.run()
+    assert not bad._fleet_started  # teardown still ran
+
+
+def test_loop_restart_resumes_manifest_and_reference(tmp_path, trained):
+    """Kill-and-restart: the second loop picks up window_seq, totals and
+    the SAME drift reference (no silent rebase onto post-drift data)."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    loop1 = _loop(trained, stream, tmp_path / "state")
+    r1 = loop1.run()
+    assert r1["windows"] == 1
+    ref_before = LoopState(str(tmp_path / "state"),
+                           "live").drift_reference
+    for i in range(2, 4):
+        _write_batch(str(stream), i, seed=i)
+    loop2 = _loop(trained, stream, tmp_path / "state",
+                  reference_frame=None)  # restart: reference from disk
+    r2 = loop2.run()
+    assert r2["windows"] == 2  # window counter continued, not reset
+    assert r2["totals"]["batches"] == 4
+    assert r2["counters"]["batches"] == 2  # process-lifetime vs loop-lifetime
+    assert loop2.monitor.has_reference
+    assert LoopState(str(tmp_path / "state"),
+                     "live").drift_reference["features"].keys() \
+        == ref_before["features"].keys()
+
+
+def test_loop_restart_serves_last_promoted_version(tmp_path):
+    """Kill-and-restart durability for the SERVING side: the promoted
+    model is persisted under the state root and a restarted loop serves
+    it immediately — not nothing-until-the-next-drift-trigger."""
+    UID.reset()
+    wf, _, _ = _build_workflow(seed=9)
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    loop = ContinuousLoop(
+        wf, str(stream), str(tmp_path / "state"),
+        window_batches=2, poll_interval_s=0.02, timeout_s=1.0,
+        max_windows=1)
+    r1 = loop.run()
+    assert r1["activeVersion"] == "v1"
+    assert os.path.isdir(tmp_path / "state" / "models" / "live" / "v1")
+    loop2 = ContinuousLoop(
+        wf, str(stream), str(tmp_path / "state"),
+        window_batches=2, poll_interval_s=0.02, timeout_s=0.5,
+        stop_fleet_on_exit=False)
+    r2 = loop2.run()
+    try:
+        assert r2["activeVersion"] == "v1"  # serving survived the restart
+        got = loop2.fleet.score("live", {"x1": 0.1, "x2": 0.2},
+                                timeout_s=30)
+        assert "probability_1" in json.dumps(got)
+    finally:
+        loop2.fleet.stop(drain=True)
+
+
+def test_loop_requires_result_features():
+    with pytest.raises(ValueError, match="raw features"):
+        ContinuousLoop(Workflow(), "stream", "state")
+
+
+# ---------------------------------------------------------------------------
+# observability: prometheus + spans + health
+# ---------------------------------------------------------------------------
+
+def test_continuous_metrics_to_json_camel_case():
+    cm = ContinuousMetrics()
+    cm.record_batch(64)
+    cm.record_trigger()
+    cm.record_rollback()
+    doc = cm.to_json()
+    assert doc["batches"] == 1 and doc["rows"] == 64
+    assert doc["driftTriggers"] == 1 and doc["rollbacks"] == 1
+
+
+def test_prometheus_registry_renders_continuous_series(tmp_path, trained):
+    from transmogrifai_tpu.utils.prometheus import build_registry
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i, shift=4.0)
+    loop = _loop(trained, stream, tmp_path / "state",
+                 drift=DriftConfig(js_threshold=0.2,
+                                   consecutive_windows=1))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loop.run()
+    text = build_registry(fleet=loop.fleet, continuous=loop,
+                          include_app=False).render()
+    assert "transmogrifai_continuous_batches_total 2" in text
+    assert "transmogrifai_continuous_rows_total 40" in text
+    assert "transmogrifai_continuous_drift_triggers_total 1" in text
+    assert 'transmogrifai_continuous_drift_score{feature="x1"}' in text
+    assert "transmogrifai_continuous_window 1" in text
+    assert "transmogrifai_continuous_staleness_seconds" in text
+    # the fleet series ride along on the same scrape
+    assert "transmogrifai_fleet_swaps_total" in text
+
+
+def test_loop_spans_cover_every_transition(tmp_path, trained):
+    from transmogrifai_tpu.utils.tracing import recorder
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(4):
+        _write_batch(str(stream), i, seed=i, shift=4.0)
+    recorder.reset()
+    loop = _loop(trained, stream, tmp_path / "state",
+                 drift=DriftConfig(js_threshold=0.2,
+                                   consecutive_windows=1))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loop.run()
+    spans = recorder.spans
+    names = {s.name for s in spans}
+    for expected in ("continuous.loop", "continuous.ingest",
+                     "continuous.drift", "continuous.retrain",
+                     "continuous.promote", "fleet.swap"):
+        assert expected in names, f"missing span {expected}"
+    by_id = {s.span_id: s for s in spans}
+    loop_ids = {s.span_id for s in spans if s.name == "continuous.loop"}
+    for s in spans:
+        if s.name in ("continuous.ingest", "continuous.retrain",
+                      "continuous.promote"):
+            # every transition nests under the loop span
+            cur = s
+            while cur.parent_id is not None and cur.parent_id in by_id:
+                cur = by_id[cur.parent_id]
+            assert cur.span_id in loop_ids
+
+
+def test_loop_health_and_http_surface(tmp_path, trained):
+    """The loop's scrape endpoint: /healthz carries loop + fleet state,
+    /metrics renders the continuous series, POST /score serves live."""
+    import http.client
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    seen = {}
+
+    def probe(lp):
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          lp.metrics_http.port, timeout=10)
+        conn.request("GET", "/healthz")
+        seen["health"] = json.loads(conn.getresponse().read())
+        conn.request("GET", "/metrics")
+        seen["metrics"] = conn.getresponse().read().decode()
+        row = {"x1": 0.1, "x2": -0.3}
+        conn.request("POST", "/score/live", json.dumps(row),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        seen["score_status"] = resp.status
+        seen["score"] = json.loads(resp.read())
+        conn.close()
+
+    loop = _loop(trained, stream, tmp_path / "state", metrics_port=0,
+                 on_started=probe)
+    report = loop.run()
+    assert seen["health"]["status"] == "ok"
+    assert seen["health"]["loop"]["window"] == 0
+    assert "counters" in seen["health"]["loop"]
+    assert "transmogrifai_continuous_batches_total" in seen["metrics"]
+    assert seen["score_status"] == 200
+    assert "probability_1" in json.dumps(seen["score"])
+    assert report["serving"]["completed"] == 1
+    assert report["serving"]["failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cli + runner surfaces
+# ---------------------------------------------------------------------------
+
+WORKFLOW_MODULE = """\
+import numpy as np
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.uid import UID
+from transmogrifai_tpu.workflow import Workflow
+
+UID.reset()
+rng = np.random.default_rng(0)
+x1 = rng.normal(size=120)
+x2 = rng.normal(size=120)
+y = (rng.uniform(size=120) < 1 / (1 + np.exp(-(1.5 * x1 - x2)))) * 1.0
+host = fr.HostFrame.from_dict({
+    "label": (ft.RealNN, y.tolist()),
+    "x1": (ft.Real, x1.tolist()),
+    "x2": (ft.Real, x2.tolist()),
+})
+feats = FeatureBuilder.from_frame(host, response="label")
+vec = transmogrify([feats["x1"], feats["x2"]])
+sel = BinaryClassificationModelSelector.with_train_validation_split(
+    seed=1, models_and_parameters=[
+        (OpLogisticRegression(max_iter=20), [{}])])
+pred = feats["label"].transform_with(sel, vec)
+wf = Workflow().set_input_frame(host).set_result_features(pred, vec)
+"""
+
+
+def test_cli_continuous_bootstrap_end_to_end(tmp_path, monkeypatch,
+                                             capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    (tmp_path / "contwf.py").write_text(WORKFLOW_MODULE)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    report_path = tmp_path / "report.json"
+    rc = cli_main([
+        "continuous", "--workflow", "contwf:wf",
+        "--stream-dir", str(stream), "--pattern", "*.csv",
+        "--state-dir", str(tmp_path / "state"),
+        "--window-batches", "2", "--max-windows", "1",
+        "--poll-interval-s", "0.02", "--timeout-s", "1.0",
+        "--report", str(report_path)])
+    assert rc == 0
+    out = capsys.readouterr()
+    report = json.loads(report_path.read_text())
+    assert report["activeVersion"] == "v1"
+    assert report["counters"]["promotions"] == 1
+    assert json.loads(out.out)["activeVersion"] == "v1"
+    assert "1 promotion(s)" in out.err
+
+
+def test_cli_continuous_rejects_bad_workflow_spec(tmp_path, monkeypatch):
+    from transmogrifai_tpu.cli.continuous import _load_workflow
+    with pytest.raises(ValueError, match="module:attr"):
+        _load_workflow("no_colon_here")
+    (tmp_path / "notwf.py").write_text("thing = 42\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    with pytest.raises(TypeError, match="expected a Workflow"):
+        _load_workflow("notwf:thing")
+
+
+def test_runner_continuous_mode(tmp_path, trained):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    for i in range(2):
+        _write_batch(str(stream), i, seed=i)
+    runner = WorkflowRunner(trained["wf"])
+    model_dir = tmp_path / "model"
+    trained["model"].save(str(model_dir))
+    params = OpParams(
+        model_location=str(model_dir),
+        custom_params={"streamDir": str(stream),
+                       "stateDir": str(tmp_path / "state"),
+                       "pattern": "*.csv",
+                       "windowBatches": 2, "maxWindows": 1,
+                       "pollIntervalS": 0.02, "timeoutS": 1.0,
+                       "consecutiveWindows": 1})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result = runner.run(RunTypes.CONTINUOUS, params)
+    assert result["status"] == "success"
+    rep = result["continuous"]
+    assert rep["windows"] == 1
+    assert rep["activeVersion"] == "v1"
+    assert result["stateDir"] == str(tmp_path / "state")
+
+
+def test_runner_continuous_requires_stream_and_state(trained):
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.runner import RunTypes, WorkflowRunner
+    runner = WorkflowRunner(trained["wf"])
+    with pytest.raises(ValueError, match="streamDir"):
+        runner.run(RunTypes.CONTINUOUS, OpParams())
+    with pytest.raises(ValueError, match="state"):
+        runner.run(RunTypes.CONTINUOUS,
+                   OpParams(custom_params={"streamDir": "x"}))
+
+
+def test_loop_restart_preserves_hysteresis_streak(tmp_path, trained):
+    """A kill between two breaching windows must not reset the breach
+    streak: the restarted loop's very next breaching window triggers
+    (consecutive_windows=2 satisfied across the restart)."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    drift = DriftConfig(js_threshold=0.35, consecutive_windows=2,
+                        cooldown_windows=2)
+    _write_batch(str(stream), 0, seed=0, shift=4.0)
+    _write_batch(str(stream), 1, seed=1, shift=4.0)
+    loop1 = _loop(trained, stream, tmp_path / "state", drift=drift)
+    r1 = loop1.run()  # one breaching window: streak 1, no trigger
+    assert r1["counters"]["driftTriggers"] == 0
+    assert r1["lastDecision"]["breached"] is True
+    _write_batch(str(stream), 2, seed=2, shift=4.0)
+    _write_batch(str(stream), 3, seed=3, shift=4.0)
+    loop2 = _loop(trained, stream, tmp_path / "state", drift=drift,
+                  reference_frame=None)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r2 = loop2.run()
+    assert r2["counters"]["driftTriggers"] == 1  # streak survived
+    assert r2["counters"]["promotions"] == 1
+    assert r2["activeVersion"] == "v2"

@@ -31,11 +31,18 @@ def main(argv=None) -> int:
     gen.add_argument("--overwrite", action="store_true")
     sub.add_parser("shell", help="interactive shell with the framework "
                                  "preloaded (reference repl analog)")
+    from transmogrifai_tpu.cli.continuous import (
+        add_continuous_args, run_continuous,
+    )
     from transmogrifai_tpu.cli.profile import add_profile_args, run_profile
     from transmogrifai_tpu.cli.serve import add_serve_args, run_serve
     add_serve_args(sub.add_parser(
         "serve", help="online micro-batched scoring over a saved model "
                       "(jsonl/csv in, jsonl scores out)"))
+    add_continuous_args(sub.add_parser(
+        "continuous", help="closed-loop daemon: stream ingest + drift "
+                           "detection + checkpoint-resumed retrain + "
+                           "zero-downtime hot-swap"))
     add_profile_args(sub.add_parser(
         "profile", help="score a dataset under full tracing; emit a "
                         "Perfetto/chrome://tracing JSON + slowest-stages "
@@ -47,6 +54,8 @@ def main(argv=None) -> int:
         return run_shell()
     if args.command == "serve":
         return run_serve(args)
+    if args.command == "continuous":
+        return run_continuous(args)
     if args.command == "profile":
         return run_profile(args)
     if args.command == "gen":

@@ -1,0 +1,162 @@
+"""``transmogrifai_tpu continuous`` — the closed-loop AutoML daemon.
+
+One long-running process that watches a stream directory, serves the
+current model (``POST /score`` on ``--metrics-port``), detects feature
+drift against the serving model's training distribution, retrains on
+the accumulated window when drift triggers (resuming from checkpoints
+if interrupted), and hot-swaps the new version behind the live endpoint
+through the shadow-parity gate::
+
+    python -m transmogrifai_tpu.cli continuous \
+        --workflow myproj.pipeline:runner \
+        --stream-dir incoming/ --pattern '*.csv' \
+        --model models/churn --state-dir loop_state/ \
+        --window-batches 4 --js-threshold 0.2 --metrics-port 9100
+
+``--workflow module:attr`` imports the retrain template: a ``Workflow``
+(result features wired) or a ``WorkflowRunner`` (its ``.workflow`` is
+used). ``--model`` loads the initial serving model; omit it to
+BOOTSTRAP — the first full window trains v1 before serving starts.
+Stream files must carry the response column (labeled data arriving
+continuously). The loop's manifest, stream checkpoint, and per-retrain
+training checkpoints all live under ``--state-dir``: kill the process
+at any point and re-run the same command to resume with zero lost rows.
+See docs/CONTINUOUS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+
+__all__ = ["add_continuous_args", "run_continuous"]
+
+
+def add_continuous_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("--workflow", required=True,
+                    help="module:attr of the retrain template (a Workflow "
+                         "or WorkflowRunner)")
+    sp.add_argument("--stream-dir", required=True,
+                    help="directory watched for micro-batch files")
+    sp.add_argument("--pattern", default="*",
+                    help="stream file glob (default '*')")
+    sp.add_argument("--state-dir", required=True,
+                    help="loop manifest + stream checkpoint + retrain "
+                         "checkpoints (the resume root)")
+    sp.add_argument("--model", default=None,
+                    help="initial saved model dir; omit to bootstrap "
+                         "from the first stream window")
+    sp.add_argument("--reference", default=None,
+                    help="batch file (csv/avro/parquet) sampling the "
+                         "initial model's TRAINING data; pins the drift "
+                         "reference. Without it a loop given --model "
+                         "adopts the first stream window — which reads "
+                         "drift ~0 on an already-shifted stream")
+    sp.add_argument("--model-id", default="live",
+                    help="serving endpoint id (default 'live')")
+    sp.add_argument("--window-batches", type=int, default=4,
+                    help="micro-batches per drift window (default 4)")
+    sp.add_argument("--max-buffer-batches", type=int, default=8,
+                    help="retrain-buffer bound in batches (default 8)")
+    sp.add_argument("--poll-interval-s", type=float, default=1.0)
+    sp.add_argument("--timeout-s", type=float, default=None,
+                    help="stop after this long without new files "
+                         "(default: run forever)")
+    sp.add_argument("--max-windows", type=int, default=None,
+                    help="stop after closing this many windows "
+                         "(default: run forever)")
+    sp.add_argument("--drift-metric", choices=("js", "psi"), default="js")
+    sp.add_argument("--js-threshold", type=float, default=0.25)
+    sp.add_argument("--psi-threshold", type=float, default=0.25)
+    sp.add_argument("--fill-delta-threshold", type=float, default=0.25)
+    sp.add_argument("--label-delta-threshold", type=float, default=0.25)
+    sp.add_argument("--consecutive-windows", type=int, default=2,
+                    help="hysteresis: breaching windows required to "
+                         "trigger (default 2)")
+    sp.add_argument("--cooldown-windows", type=int, default=2,
+                    help="windows after a trigger/promotion with "
+                         "triggers suppressed (default 2)")
+    sp.add_argument("--shadow-tolerance", type=float, default=1.0,
+                    help="hot-swap shadow-gate max abs score diff "
+                         "(default 1.0: schema/NaN sanity — drift "
+                         "retrains legitimately change scores)")
+    sp.add_argument("--staleness-bound-s", type=float, default=None,
+                    help="warn when drift-to-promotion exceeds this")
+    sp.add_argument("--max-retrain-attempts", type=int, default=3)
+    sp.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics, /healthz and POST /score "
+                         "on this port (0 = ephemeral; port printed to "
+                         "stderr)")
+    sp.add_argument("--metrics-host", default="127.0.0.1")
+    sp.add_argument("--report", default=None,
+                    help="write the final loop report JSON here "
+                         "(always printed to stdout)")
+
+
+def _load_workflow(spec: str):
+    from transmogrifai_tpu.runner import WorkflowRunner
+    from transmogrifai_tpu.workflow import Workflow
+    mod, _, attr = spec.partition(":")
+    if not attr:
+        raise ValueError(f"--workflow {spec!r}: expected module:attr")
+    obj = getattr(importlib.import_module(mod), attr)
+    if isinstance(obj, WorkflowRunner):
+        return obj.workflow
+    if isinstance(obj, Workflow):
+        return obj
+    raise TypeError(f"--workflow {spec!r} resolved to "
+                    f"{type(obj).__name__}; expected a Workflow or "
+                    "WorkflowRunner")
+
+
+def run_continuous(args: argparse.Namespace) -> int:
+    from transmogrifai_tpu.continuous import ContinuousLoop, DriftConfig
+    from transmogrifai_tpu.workflow import load_model
+
+    workflow = _load_workflow(args.workflow)
+    initial_model = load_model(args.model) if args.model else None
+    drift = DriftConfig(
+        metric=args.drift_metric,
+        js_threshold=args.js_threshold,
+        psi_threshold=args.psi_threshold,
+        fill_delta_threshold=args.fill_delta_threshold,
+        label_delta_threshold=args.label_delta_threshold,
+        consecutive_windows=args.consecutive_windows,
+        cooldown_windows=args.cooldown_windows)
+    def announce(lp):
+        if lp.metrics_http is not None:
+            print(f"# serving: http://127.0.0.1:{lp.metrics_http.port}"
+                  "/score (+ /metrics, /healthz)", file=sys.stderr)
+
+    loop = ContinuousLoop(
+        workflow, args.stream_dir, args.state_dir,
+        model_id=args.model_id, pattern=args.pattern,
+        initial_model=initial_model, reference_path=args.reference,
+        drift=drift,
+        window_batches=args.window_batches,
+        max_buffer_batches=args.max_buffer_batches,
+        poll_interval_s=args.poll_interval_s,
+        timeout_s=args.timeout_s, max_windows=args.max_windows,
+        max_retrain_attempts=args.max_retrain_attempts,
+        shadow_tolerance=args.shadow_tolerance,
+        staleness_bound_s=args.staleness_bound_s,
+        metrics_port=args.metrics_port, metrics_host=args.metrics_host,
+        on_started=announce)
+    print(f"# continuous loop: watching {args.stream_dir!r} "
+          f"(pattern {args.pattern!r}), serving model id "
+          f"{args.model_id!r}, state under {args.state_dir!r}",
+          file=sys.stderr)
+    report = loop.run()
+    print(json.dumps(report, indent=2, default=str))
+    if args.report:
+        with open(args.report, "w") as fh:
+            json.dump(report, fh, indent=2, default=str)
+    c = report["counters"]
+    print(f"# {report['windows']} window(s): {c['driftTriggers']} "
+          f"trigger(s), {c['retrains']} retrain(s), "
+          f"{c['promotions']} promotion(s), {c['rollbacks']} "
+          f"rollback(s); active version "
+          f"{report['activeVersion']}", file=sys.stderr)
+    return 0

@@ -1,0 +1,38 @@
+"""Closed-loop continuous AutoML: stream -> drift -> retrain -> hot-swap.
+
+One long-running supervised control loop (the flagship "millions of
+users" scenario, ROADMAP item 3) built from pieces the framework already
+has:
+
+- **ingest**: ``readers.streaming.FileStreamingReader`` micro-batches
+  with durable ``StreamCheckpoint`` progress (at-least-once replay);
+- **drift monitoring** (:mod:`~transmogrifai_tpu.continuous.drift`):
+  rolling per-feature reference-vs-live statistics reusing the
+  RawFeatureFilter distribution machinery (fill rates, binned
+  histograms, JS divergence / PSI, label rate), with hysteresis and
+  cooldown so one noisy batch can't trigger a retrain storm;
+- **retrain orchestration** (:mod:`~transmogrifai_tpu.continuous.loop`):
+  a drift trigger launches a retrain on the accumulated window that
+  resumes from the fitted-DAG + sweep + refit checkpoints on
+  interruption instead of cold-starting, registers the result in the
+  serving ``ModelRegistry``, and promotes it through
+  ``FleetServer.hot_swap``'s shadow-parity gate — a failed gate or
+  failed retrain leaves the old model serving and backs off;
+- **lifecycle + durability** (:mod:`~transmogrifai_tpu.continuous.
+  state`): one durable loop manifest (atomic JSON) recording window
+  boundaries, trigger decisions, retrain attempts, and promotions, so a
+  killed-and-restarted loop resumes with zero lost rows and bounded
+  staleness.
+
+Chaos sites ``continuous.ingest|trigger|retrain|promote`` make every
+transition injectable (``utils/faults.py``). See docs/CONTINUOUS.md.
+"""
+
+from transmogrifai_tpu.continuous.drift import (
+    DriftConfig, DriftDecision, DriftMonitor,
+)
+from transmogrifai_tpu.continuous.loop import ContinuousLoop, ContinuousMetrics
+from transmogrifai_tpu.continuous.state import LoopState
+
+__all__ = ["ContinuousLoop", "ContinuousMetrics", "DriftConfig",
+           "DriftDecision", "DriftMonitor", "LoopState"]

@@ -1,0 +1,399 @@
+"""Drift monitoring: rolling reference-vs-live feature statistics.
+
+The pre-training ``RawFeatureFilter`` already knows how to summarize a
+feature as a :class:`~transmogrifai_tpu.filters.raw_feature_filter.
+FeatureDistribution` (fill rate + binned histogram: numeric bins over a
+fixed range, hashed-token bins for text) and how to compare two of them
+(Jensen-Shannon divergence). The drift monitor reuses exactly that
+machinery ONLINE: a **reference** distribution per feature (captured
+from the data the serving model was trained on) against a **live**
+distribution accumulated over the current micro-batch window. Because
+the reference's numeric range pins the live binning, histograms from
+different batches merge by simple addition (the monoid the reference's
+map-reduce design already guarantees), and out-of-range live mass lands
+in the edge bins — which *is* the covariate shift being measured.
+
+Per-feature scores each window:
+
+- ``js`` — JS divergence of the binned distributions (0..1, log2);
+- ``psi`` — population stability index over the same bins (the industry
+  drift score; unbounded, > 0.25 conventionally "major shift");
+- ``fillDelta`` — |reference fill rate - live fill rate|;
+- ``labelDelta`` — |reference label mean - live label mean| (when the
+  response is numeric and present in the stream).
+
+Trigger policy = thresholds + **hysteresis** (``consecutive_windows``
+breaching windows required — one noisy batch cannot fire) + **cooldown**
+(``cooldown_windows`` after any trigger/promotion during which no new
+trigger fires — a slow retrain cannot be re-triggered into a storm).
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu.filters.raw_feature_filter import (
+    FeatureDistribution, _distribution,
+)
+from transmogrifai_tpu.frame import HostFrame, NUMERIC_KINDS
+
+__all__ = ["DriftConfig", "DriftDecision", "DriftMonitor", "psi"]
+
+
+def psi(ref: FeatureDistribution, live: FeatureDistribution,
+        eps: float = 1e-4) -> float:
+    """Population stability index over aligned histogram bins.
+    Zero-mass bins are floored at ``eps`` (the standard smoothing) so a
+    bin that appears only in production contributes a large-but-finite
+    term instead of infinity."""
+    p, q = ref.distribution, live.distribution
+    ps, qs = p.sum(), q.sum()
+    if ps == 0 or qs == 0 or p.shape != q.shape:
+        return 0.0
+    p = np.maximum(p / ps, eps)
+    q = np.maximum(q / qs, eps)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+@dataclass
+class DriftConfig:
+    """Thresholds and trigger policy for :class:`DriftMonitor`."""
+
+    #: histogram bins (numeric ranges / hashed token buckets)
+    bins: int = 32
+    #: drift metric driving the trigger: "js" or "psi"
+    metric: str = "js"
+    #: per-feature JS divergence above this breaches (metric="js")
+    js_threshold: float = 0.25
+    #: per-feature PSI above this breaches (metric="psi")
+    psi_threshold: float = 0.25
+    #: |train fill - live fill| above this breaches
+    fill_delta_threshold: float = 0.25
+    #: |train label mean - live label mean| above this breaches (numeric
+    #: response only; None disables)
+    label_delta_threshold: Optional[float] = 0.25
+    #: hysteresis: consecutive breaching windows required to trigger
+    consecutive_windows: int = 2
+    #: windows after a trigger/promotion during which triggers are
+    #: suppressed (the retrain-storm guard)
+    cooldown_windows: int = 2
+    #: monitor only these features (default: every non-response raw)
+    features: Optional[Sequence[str]] = None
+
+    def __post_init__(self):
+        if self.metric not in ("js", "psi"):
+            raise ValueError(f"drift metric {self.metric!r}: 'js' or 'psi'")
+        if self.consecutive_windows < 1:
+            raise ValueError("consecutive_windows must be >= 1")
+
+
+@dataclass
+class DriftDecision:
+    """One window's evaluation: per-feature scores + the trigger verdict."""
+
+    window: int
+    #: breaching thresholds this window (pre-hysteresis)
+    breached: bool
+    #: breached for ``consecutive_windows`` in a row and not cooling down
+    triggered: bool
+    #: feature -> {"js": .., "psi": .., "fillDelta": .., "breached": ..}
+    scores: dict = field(default_factory=dict)
+    #: human-readable breach reasons (feature: metric value > threshold)
+    reasons: list = field(default_factory=list)
+    #: live rows the window aggregated
+    rows: int = 0
+    #: windows left before triggers re-arm (0 = armed)
+    cooldown_left: int = 0
+
+    def to_json(self) -> dict:
+        return {"window": self.window, "breached": self.breached,
+                "triggered": self.triggered, "rows": self.rows,
+                "cooldownLeft": self.cooldown_left,
+                "reasons": list(self.reasons),
+                "scores": {k: dict(v) for k, v in self.scores.items()}}
+
+
+class _Accum:
+    """Mergeable live accumulation of one feature's window distribution."""
+
+    __slots__ = ("count", "nulls", "hist")
+
+    def __init__(self):
+        self.count = 0
+        self.nulls = 0
+        self.hist: Optional[np.ndarray] = None
+
+    def add(self, dist: FeatureDistribution) -> None:
+        self.count += dist.count
+        self.nulls += dist.nulls
+        if self.hist is None:
+            self.hist = dist.distribution.astype(float).copy()
+        elif self.hist.shape == dist.distribution.shape:
+            self.hist += dist.distribution
+        # shape mismatch (a column changed kind mid-stream): keep the
+        # existing accumulation — fill rates still track, and the next
+        # reference rebase realigns the histograms
+
+    def as_distribution(self, name: str) -> FeatureDistribution:
+        hist = self.hist if self.hist is not None else np.zeros(1)
+        return FeatureDistribution(name, self.count, self.nulls, hist, {})
+
+
+class DriftMonitor:
+    """Reference-vs-live drift scoring over micro-batch windows.
+
+    Usage::
+
+        monitor = DriftMonitor(DriftConfig(js_threshold=0.2))
+        monitor.set_reference(train_frame, feature_names, response="label")
+        ...
+        monitor.observe(batch_frame)        # every micro-batch
+        decision = monitor.close_window()   # every window_batches batches
+        if decision.triggered: ...          # launch retrain
+    """
+
+    def __init__(self, config: Optional[DriftConfig] = None):
+        self.config = config or DriftConfig()
+        #: feature -> reference FeatureDistribution
+        self.reference: dict[str, FeatureDistribution] = {}
+        #: reference numeric (min, max) pinning live binning per feature
+        self._ranges: dict[str, tuple[float, float]] = {}
+        self._response: Optional[str] = None
+        self._ref_label_mean: Optional[float] = None
+        self._accum: dict[str, _Accum] = {}
+        self._label_sum = 0.0
+        self._label_n = 0
+        self._rows = 0
+        self._window = 0
+        self._breach_streak = 0
+        self._cooldown_left = 0
+        #: last close_window() scores (the Prometheus gauge feed)
+        self.last_scores: dict[str, dict] = {}
+
+    # -- reference -----------------------------------------------------------
+    def set_reference(self, frame: HostFrame,
+                      feature_names: Optional[Sequence[str]] = None,
+                      response: Optional[str] = None) -> None:
+        """(Re)base the reference on ``frame`` — the data the currently
+        serving model was trained on. Called at loop start and again on
+        every promotion, so drift is always measured against the live
+        model's own training distribution."""
+        cfg = self.config
+        names = list(feature_names if feature_names is not None
+                     else frame.names())
+        if cfg.features is not None:
+            allowed = set(cfg.features)
+            names = [n for n in names if n in allowed]
+        self._response = response
+        self.reference = {}
+        self._ranges = {}
+        self._ref_label_mean = None
+        for name in names:
+            if name == response or name not in frame:
+                continue
+            dist = _distribution(frame[name], name, cfg.bins)
+            self.reference[name] = dist
+            if "min" in dist.summary:
+                self._ranges[name] = (dist.summary["min"],
+                                      dist.summary["max"])
+        if response is not None and response in frame \
+                and frame[response].kind in NUMERIC_KINDS:
+            col = frame[response]
+            vals = col.values[col.mask] if col.mask is not None \
+                else col.values
+            if len(vals):
+                self._ref_label_mean = float(np.mean(vals))
+        self.reset_window()
+
+    @property
+    def has_reference(self) -> bool:
+        return bool(self.reference)
+
+    # -- live accumulation ---------------------------------------------------
+    def observe(self, frame: HostFrame) -> None:
+        """Fold one micro-batch into the current window's accumulators."""
+        if not self.reference:
+            return
+        for name, ref in self.reference.items():
+            if name not in frame:
+                continue
+            dist = _distribution(frame[name], name, self.config.bins,
+                                 self._ranges.get(name))
+            self._accum.setdefault(name, _Accum()).add(dist)
+        resp = self._response
+        if self._ref_label_mean is not None and resp is not None \
+                and resp in frame and frame[resp].kind in NUMERIC_KINDS:
+            col = frame[resp]
+            vals = col.values[col.mask] if col.mask is not None \
+                else col.values
+            self._label_sum += float(np.sum(vals))
+            self._label_n += int(len(vals))
+        self._rows += frame.n_rows
+
+    def reset_window(self) -> None:
+        self._accum = {}
+        self._label_sum = 0.0
+        self._label_n = 0
+        self._rows = 0
+
+    # -- evaluation ----------------------------------------------------------
+    def window_scores(self) -> dict[str, dict]:
+        """Per-feature scores of the CURRENT (possibly partial) window."""
+        cfg = self.config
+        out: dict[str, dict] = {}
+        for name, ref in self.reference.items():
+            acc = self._accum.get(name)
+            if acc is None or acc.count == 0:
+                continue
+            live = acc.as_distribution(name)
+            js = ref.js_divergence(live) if ref.distribution.size > 1 \
+                else 0.0
+            p = psi(ref, live) if ref.distribution.size > 1 else 0.0
+            fill_delta = abs(ref.fill_rate - live.fill_rate)
+            breached, why = self._feature_breach(name, js, p, fill_delta)
+            out[name] = {"js": round(js, 6), "psi": round(p, 6),
+                         "fillDelta": round(fill_delta, 6),
+                         "breached": breached}
+            if why:
+                out[name]["reason"] = why
+        if self._ref_label_mean is not None and self._label_n > 0 \
+                and cfg.label_delta_threshold is not None:
+            delta = abs(self._label_sum / self._label_n
+                        - self._ref_label_mean)
+            breached = delta > cfg.label_delta_threshold
+            doc = {"js": 0.0, "psi": 0.0, "fillDelta": 0.0,
+                   "labelDelta": round(delta, 6), "breached": breached}
+            if breached:
+                doc["reason"] = (f"label mean delta {delta:.4f} > "
+                                 f"{cfg.label_delta_threshold}")
+            out["__label__"] = doc
+        return out
+
+    def _feature_breach(self, name: str, js: float, p: float,
+                        fill_delta: float) -> tuple[bool, Optional[str]]:
+        cfg = self.config
+        if cfg.metric == "js" and js > cfg.js_threshold:
+            return True, (f"{name}: JS divergence {js:.4f} > "
+                          f"{cfg.js_threshold}")
+        if cfg.metric == "psi" and p > cfg.psi_threshold:
+            return True, f"{name}: PSI {p:.4f} > {cfg.psi_threshold}"
+        if fill_delta > cfg.fill_delta_threshold:
+            return True, (f"{name}: fill delta {fill_delta:.4f} > "
+                          f"{cfg.fill_delta_threshold}")
+        return False, None
+
+    def close_window(self) -> DriftDecision:
+        """Evaluate the accumulated window, apply hysteresis + cooldown,
+        and reset the accumulators for the next window."""
+        from transmogrifai_tpu.utils.tracing import span
+        cfg = self.config
+        self._window += 1
+        with span("continuous.drift", window=self._window,
+                  rows=self._rows, metric=cfg.metric):
+            scores = self.window_scores()
+            reasons = [d["reason"] for d in scores.values()
+                       if d.get("reason")]
+            breached = any(d["breached"] for d in scores.values())
+            if self._rows == 0:
+                breached = False  # an empty window measures nothing
+            self._breach_streak = self._breach_streak + 1 if breached \
+                else 0
+            cooling = self._cooldown_left > 0
+            if cooling:
+                self._cooldown_left -= 1
+            triggered = (not cooling
+                         and self._breach_streak >= cfg.consecutive_windows)
+            if triggered:
+                self._breach_streak = 0
+                self.start_cooldown()
+            if breached and cooling:
+                warnings.warn(
+                    f"drift: window {self._window} breached during "
+                    f"cooldown ({self._cooldown_left + 1} window(s) "
+                    "left); trigger suppressed", RuntimeWarning)
+            decision = DriftDecision(
+                window=self._window, breached=breached,
+                triggered=triggered, scores=scores, reasons=reasons,
+                rows=self._rows, cooldown_left=self._cooldown_left)
+        self.last_scores = scores
+        self.reset_window()
+        return decision
+
+    def start_cooldown(self) -> None:
+        """Arm the cooldown (called on trigger and on promotion): no
+        trigger fires for the next ``cooldown_windows`` windows."""
+        self._cooldown_left = max(self._cooldown_left,
+                                  self.config.cooldown_windows)
+
+    # -- durability ----------------------------------------------------------
+    def reference_to_json(self) -> dict:
+        """Serializable reference state (persisted in the loop manifest so
+        a restarted loop measures drift against the SAME baseline instead
+        of silently rebasing on post-drift data)."""
+        return {
+            "response": self._response,
+            "refLabelMean": self._ref_label_mean,
+            "window": self._window,
+            "breachStreak": self._breach_streak,
+            "cooldownLeft": self._cooldown_left,
+            "features": {
+                name: {"count": d.count, "nulls": d.nulls,
+                       "hist": d.distribution.tolist(),
+                       "summary": {k: float(v)
+                                   for k, v in d.summary.items()}}
+                for name, d in self.reference.items()},
+        }
+
+    def restore_reference(self, doc: dict) -> bool:
+        """Rebuild the reference from :meth:`reference_to_json` output.
+        Malformed state warns and returns False (the loop rebases on the
+        next window instead of crashing)."""
+        try:
+            reference = {}
+            ranges = {}
+            for name, d in dict(doc.get("features", {})).items():
+                dist = FeatureDistribution(
+                    name, int(d["count"]), int(d["nulls"]),
+                    np.asarray(d["hist"], dtype=float),
+                    dict(d.get("summary", {})))
+                reference[name] = dist
+                if "min" in dist.summary:
+                    ranges[name] = (dist.summary["min"],
+                                    dist.summary["max"])
+        except Exception as e:  # noqa: BLE001 — stale state costs a rebase, never a crash
+            warnings.warn(f"drift: unreadable reference state "
+                          f"({type(e).__name__}: {e}); rebasing on the "
+                          "next window", RuntimeWarning)
+            return False
+        self.reference = reference
+        self._ranges = ranges
+        self._response = doc.get("response")
+        self._ref_label_mean = doc.get("refLabelMean")
+        self._window = int(doc.get("window", 0))
+        self._breach_streak = int(doc.get("breachStreak", 0))
+        self._cooldown_left = int(doc.get("cooldownLeft", 0))
+        self.reset_window()
+        return bool(reference)
+
+    # -- observability -------------------------------------------------------
+    def drift_scores(self) -> dict[str, float]:
+        """feature -> last closed window's driving metric value (the
+        ``transmogrifai_continuous_drift_score`` gauge feed)."""
+        key = self.config.metric
+        out = {}
+        for name, d in self.last_scores.items():
+            out[name] = d.get("labelDelta", d.get(key, 0.0)) \
+                if name == "__label__" else d.get(key, 0.0)
+        return out
+
+    def to_json(self) -> dict:
+        return {"window": self._window,
+                "breachStreak": self._breach_streak,
+                "cooldownLeft": self._cooldown_left,
+                "referenceFeatures": sorted(self.reference),
+                "lastScores": {k: dict(v)
+                               for k, v in self.last_scores.items()}}

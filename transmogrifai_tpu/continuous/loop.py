@@ -1,0 +1,720 @@
+"""The closed-loop controller: ingest -> drift -> retrain -> hot-swap.
+
+One long-running process (``cli continuous`` / runner ``CONTINUOUS``)
+that keeps a serving model fresh against a live stream:
+
+1. **ingest**: ``FileStreamingReader`` micro-batches (durable
+   ``StreamCheckpoint`` progress) accumulate into a bounded retrain
+   buffer, and every batch folds into the :class:`~transmogrifai_tpu.
+   continuous.drift.DriftMonitor`'s live window statistics.
+2. **trigger**: every ``window_batches`` batches the window closes and
+   is scored against the reference (the serving model's own training
+   distribution). Hysteresis + cooldown keep one noisy batch from
+   triggering; a trigger writes a durable ``pendingRetrain`` record
+   BEFORE any training starts.
+3. **retrain**: the workflow refits on the buffered window with a
+   per-window ``checkpoint_dir``, so an interrupted attempt resumes
+   from the fitted-DAG + sweep + refit checkpoints (PR 3/PR 7) instead
+   of cold-starting — a preemption mid-retrain costs only the in-flight
+   layer. A failed retrain backs off exponentially (in windows) and the
+   old model keeps serving.
+4. **promote**: the new model registers as the next version in the
+   fleet's ``ModelRegistry`` and promotes through ``FleetServer.
+   hot_swap`` — candidate warmup, shadow-parity gate on live rows,
+   atomic alias flip, old-lane drain: zero dropped requests by
+   construction. A gate rejection ROLLS BACK (old version untouched,
+   rollback counted, cooldown armed). On success the drift reference
+   rebases onto the retrain window and the buffer clears.
+
+Fault sites ``continuous.ingest`` / ``continuous.trigger`` /
+``continuous.retrain`` / ``continuous.promote`` make each transition
+chaos-testable; ``serving.swap`` (inside ``hot_swap``) and the reader's
+``ingest.read`` compose with them. Durability lives in
+:class:`~transmogrifai_tpu.continuous.state.LoopState`: a
+killed-and-restarted loop resumes the pending retrain on the SAME rows
+(buffer files re-read from the manifest) and loses zero stream rows
+(files not yet committed replay via the stream checkpoint).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+import warnings
+from typing import Optional
+
+from transmogrifai_tpu.continuous.drift import DriftConfig, DriftMonitor
+from transmogrifai_tpu.continuous.state import LoopState
+from transmogrifai_tpu.readers.base import CustomReader
+from transmogrifai_tpu.readers.streaming import (
+    FileStreamingReader, reader_for_file,
+)
+
+__all__ = ["ContinuousLoop", "ContinuousMetrics"]
+
+
+class ContinuousMetrics:
+    """Process-lifetime loop counters (the Prometheus
+    ``transmogrifai_continuous_*`` feed; loop-LIFETIME totals that
+    survive restarts live in the durable ``LoopState.totals``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batches = 0
+        self.rows = 0
+        self.skipped_batches = 0
+        self.drift_triggers = 0
+        self.retrains = 0
+        self.retrain_failures = 0
+        self.promotions = 0
+        self.rollbacks = 0
+
+    def record_batch(self, rows: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows += int(rows)
+
+    def record_skipped_batch(self) -> None:
+        with self._lock:
+            self.skipped_batches += 1
+
+    def record_trigger(self) -> None:
+        with self._lock:
+            self.drift_triggers += 1
+
+    def record_retrain(self) -> None:
+        with self._lock:
+            self.retrains += 1
+
+    def record_retrain_failure(self) -> None:
+        with self._lock:
+            self.retrain_failures += 1
+
+    def record_promotion(self) -> None:
+        with self._lock:
+            self.promotions += 1
+
+    def record_rollback(self) -> None:
+        with self._lock:
+            self.rollbacks += 1
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"batches": self.batches, "rows": self.rows,
+                    "skippedBatches": self.skipped_batches,
+                    "driftTriggers": self.drift_triggers,
+                    "retrains": self.retrains,
+                    "retrainFailures": self.retrain_failures,
+                    "promotions": self.promotions,
+                    "rollbacks": self.rollbacks}
+
+
+class ContinuousLoop:
+    """Supervised stream -> drift -> retrain -> hot-swap control loop.
+
+    Usage::
+
+        loop = ContinuousLoop(workflow, stream_dir="incoming/",
+                              state_dir="loop_state/",
+                              initial_model=model, model_id="live",
+                              drift=DriftConfig(js_threshold=0.2),
+                              window_batches=4, timeout_s=30.0)
+        report = loop.run()
+
+    ``workflow`` is the retrain template: a wired ``Workflow`` whose
+    result features define the model; its reader is replaced per retrain
+    with the accumulated window. With ``initial_model=None`` the loop
+    BOOTSTRAPS: the first full window trains v1 before serving starts.
+    Stream files must carry the response column (labeled training data
+    arriving continuously); scoring traffic is served concurrently by
+    the loop's ``FleetServer`` (``fleet`` / ``metrics_port``).
+    """
+
+    def __init__(self, workflow, stream_dir: str, state_dir: str, *,
+                 model_id: str = "live",
+                 pattern: str = "*",
+                 initial_model=None,
+                 reference_frame=None,
+                 reference_path: Optional[str] = None,
+                 drift: Optional[DriftConfig] = None,
+                 window_batches: int = 4,
+                 max_buffer_batches: int = 8,
+                 poll_interval_s: float = 0.5,
+                 timeout_s: Optional[float] = None,
+                 max_windows: Optional[int] = None,
+                 max_retrain_attempts: int = 3,
+                 shadow_rows: int = 16,
+                 shadow_tolerance: float = 1.0,
+                 staleness_bound_s: Optional[float] = None,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1",
+                 fleet=None,
+                 stop_fleet_on_exit: bool = True,
+                 on_started=None,
+                 on_stopping=None,
+                 **lane_kwargs):
+        """``shadow_tolerance`` defaults LOOSE (1.0): a drift-retrained
+        model legitimately scores shifted traffic differently, so the
+        gate's default job here is schema/NaN sanity (mismatched keys
+        and NaN diffs are +inf, never promotable) — tighten it when
+        retrains are expected to be refinements."""
+        from transmogrifai_tpu.serving.fleet import FleetServer
+        self.workflow = workflow
+        self.stream_dir = stream_dir
+        self.pattern = pattern
+        self.state_dir = state_dir
+        self.model_id = model_id
+        self.initial_model = initial_model
+        self.reference_frame = reference_frame
+        #: batch file (csv/avro/parquet) sampling the serving model's
+        #: TRAINING data — the file-surface twin of ``reference_frame``
+        #: for the CLI/runner, which cannot pass a frame. Without either,
+        #: a loop given an initial model ADOPTS the first stream window
+        #: as the reference, which reads drift ~0 on a stream that is
+        #: already shifted relative to the model
+        self.reference_path = reference_path
+        self.window_batches = int(window_batches)
+        self.max_buffer_batches = max(int(max_buffer_batches),
+                                      self.window_batches)
+        self.poll_interval_s = float(poll_interval_s)
+        self.timeout_s = timeout_s
+        self.max_windows = max_windows
+        self.max_retrain_attempts = int(max_retrain_attempts)
+        self.staleness_bound_s = staleness_bound_s
+        self.stop_fleet_on_exit = stop_fleet_on_exit
+        #: called once after startup (fleet + scrape endpoint live,
+        #: pending retrain resumed) — the CLI's announce hook
+        self.on_started = on_started
+        #: called once when the stream ends, BEFORE the endpoint/fleet
+        #: tear down — lets live-traffic clients quiesce instead of
+        #: seeing connection errors from a vanished endpoint
+        self.on_stopping = on_stopping
+
+        self.raw_features = workflow.raw_features()
+        if not self.raw_features:
+            raise ValueError("workflow has no raw features (set result "
+                             "features before building the loop)")
+        responses = [f.name for f in self.raw_features if f.is_response]
+        self.response = responses[0] if responses else None
+        #: stream files parse under the MODEL's raw types (the
+        #: stream_score schema-pinning rule): per-file inference must
+        #: not disagree with the fitted pipeline, and a restart must
+        #: re-read buffer files to the exact same rows
+        self.schema = {f.name: f.ftype for f in self.raw_features}
+
+        self.metrics = ContinuousMetrics()
+        self.monitor = DriftMonitor(drift)
+        self.state = LoopState(state_dir, model_id)
+        self.fleet = fleet if fleet is not None else FleetServer(
+            shadow_rows=shadow_rows, shadow_tolerance=shadow_tolerance,
+            **lane_kwargs)
+        self._fleet_started = False
+        self._metrics_port = metrics_port
+        self._metrics_host = metrics_host
+        self.metrics_http = None
+        #: source file -> in-memory records of the live buffer (restart
+        #: rebuilds from the manifest's file list instead)
+        self._rows_by_source: dict[str, list] = {}
+        self._batches_in_window = 0
+        self._windows_this_run = 0
+        self._serving_totals: Optional[dict] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> dict:
+        """Drive the loop until the stream times out, ``max_windows``
+        close, or the process dies. Returns :meth:`report`."""
+        from transmogrifai_tpu.utils.faults import fault_point
+        from transmogrifai_tpu.utils.tracing import span
+        with span("continuous.loop", model=self.model_id,
+                  stream=self.stream_dir):
+            reader = None
+            # _startup's side effects (fleet lanes, metrics port, resumed
+            # retrain) are inside the try: a failing startup step or
+            # on_started hook must still tear down what DID start, or an
+            # embedding supervisor's retry inherits bound ports and live
+            # lane threads
+            try:
+                self._startup()
+                if self.on_started is not None:
+                    self.on_started(self)
+                reader = self._make_stream_reader()
+                for records in reader.stream():
+                    fault_point("continuous.ingest")
+                    self._consume_batch(reader.current_file, records)
+                    if self._batches_in_window >= self.window_batches:
+                        self._close_window()
+                        if self.max_windows is not None and \
+                                self._windows_this_run >= self.max_windows:
+                            break
+            finally:
+                if reader is not None:
+                    self._stream_skipped = list(reader.skipped_files)
+                self._shutdown()
+        return self.report()
+
+    def _startup(self) -> None:
+        if self.state.drift_reference:
+            self.monitor.restore_reference(self.state.drift_reference)
+        if self.reference_frame is None and self.reference_path \
+                and not self.monitor.has_reference:
+            # fail FAST on a bad reference file: it is startup config,
+            # and silently falling through to adopt-first-window would
+            # blind the monitor to exactly the drift being pinned for
+            records = list(reader_for_file(self.reference_path,
+                                           self.schema).read())
+            self.reference_frame = CustomReader(
+                records=records).generate_frame(
+                    self._frame_features(records))
+        if self.reference_frame is not None \
+                and not self.monitor.has_reference:
+            self.monitor.set_reference(
+                self.reference_frame,
+                [f.name for f in self.raw_features],
+                response=self.response)
+            self.state.drift_reference = self.monitor.reference_to_json()
+            self.state.save()
+        if not self._has_active():
+            # the durable last-promoted version outranks initial_model:
+            # after a kill-and-restart the loop must keep serving what
+            # it promoted, not regress to the (older) bootstrap model
+            self._restore_promoted_model()
+        if self.initial_model is not None and not self._has_active():
+            self.fleet.register(model=self.initial_model,
+                                model_id=self.model_id)
+        self._start_fleet_if_serveable()
+        if self._metrics_port is not None and self.metrics_http is None:
+            from transmogrifai_tpu.serving.http import MetricsServer
+            from transmogrifai_tpu.utils.prometheus import build_registry
+            registry = build_registry(fleet=self.fleet, continuous=self)
+            self.metrics_http = MetricsServer(
+                render_fn=registry.render, health_fn=self.health,
+                score_fn=self.fleet._http_score,
+                port=self._metrics_port, host=self._metrics_host).start()
+        # resume: a pending retrain recorded before the crash re-runs on
+        # the SAME rows (manifest file list), resuming from its own
+        # fitted-DAG/sweep/refit checkpoints — zero duplicate fits
+        if self.state.pending_retrain is not None:
+            warnings.warn(
+                "continuous loop: resuming pending retrain of window "
+                f"{self.state.pending_retrain.get('windowSeq')} "
+                f"(attempt {self.state.pending_retrain.get('attempt')})",
+                RuntimeWarning)
+            self._execute_retrain()
+
+    def _shutdown(self) -> None:
+        if self.on_stopping is not None:
+            try:
+                self.on_stopping(self)
+            except Exception as e:  # noqa: BLE001 — a quiesce hook must not block teardown
+                warnings.warn(
+                    f"continuous loop: on_stopping hook failed "
+                    f"({type(e).__name__}: {e})", RuntimeWarning)
+        if self._fleet_started:
+            # settle counters BEFORE lanes drop (stop() clears them)
+            self._serving_totals = self._serving_snapshot()
+        if self.metrics_http is not None:
+            self.metrics_http.stop()
+            self.metrics_http = None
+        if self.stop_fleet_on_exit and self._fleet_started:
+            self.fleet.stop(drain=True)
+            self._fleet_started = False
+
+    def _has_active(self) -> bool:
+        return self.fleet.registry.active_version(self.model_id) is not None
+
+    def _models_root(self) -> str:
+        return os.path.join(self.state_dir, "models")
+
+    def _restore_promoted_model(self) -> None:
+        """Re-register the durably saved promoted version(s) (written by
+        :meth:`_persist_promoted`) and re-activate the one the manifest
+        last promoted. Best-effort: a corrupt saved model costs serving
+        until the next promotion, never the loop."""
+        root = self._models_root()
+        if not os.path.isdir(os.path.join(root, self.model_id)):
+            return
+        try:
+            entries = self.fleet.register_dir(root)
+            last = self.state.promotions[-1]["version"] \
+                if self.state.promotions else None
+            if last and any(e.model_id == self.model_id
+                            and e.version == last for e in entries):
+                self.fleet.registry.promote(self.model_id, last)
+        except Exception as e:  # noqa: BLE001 — stale saved model != dead loop
+            warnings.warn(
+                f"continuous loop: could not restore the promoted model "
+                f"from {root!r} ({type(e).__name__}: {e}); serving "
+                "resumes at the next promotion", RuntimeWarning)
+
+    def _persist_promoted(self, model, version: str) -> None:
+        """Save the just-promoted version under the durable state root
+        (and prune superseded version dirs — the fleet unloaded them) so
+        a restarted loop keeps serving it. Best-effort."""
+        parent = os.path.join(self._models_root(), self.model_id)
+        try:
+            model.save(os.path.join(parent, version))
+            for d in os.listdir(parent):
+                if d != version:
+                    shutil.rmtree(os.path.join(parent, d),
+                                  ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — persistence is redundancy, not the swap
+            warnings.warn(
+                f"continuous loop: could not persist promoted version "
+                f"{version!r} under {parent!r} ({type(e).__name__}: {e});"
+                " a restart will not serve it", RuntimeWarning)
+
+    def _start_fleet_if_serveable(self) -> None:
+        if not self._fleet_started and self._has_active():
+            self.fleet.start()
+            self._fleet_started = True
+
+    def _make_stream_reader(self) -> FileStreamingReader:
+        return FileStreamingReader(
+            self.stream_dir, pattern=self.pattern, schema=self.schema,
+            poll_interval_s=self.poll_interval_s,
+            timeout_s=self.timeout_s,
+            checkpoint=os.path.join(self.state_dir, "stream.json"))
+
+    # -- ingest --------------------------------------------------------------
+    def _consume_batch(self, source: Optional[str], records: list) -> None:
+        from transmogrifai_tpu.utils.faults import FaultHarnessError
+        from transmogrifai_tpu.utils.tracing import span
+        try:
+            with span("continuous.ingest", source=source,
+                      rows=len(records)):
+                frame = CustomReader(records=records).generate_frame(
+                    self._frame_features(records))
+                if self.monitor.has_reference:
+                    self.monitor.observe(frame)
+        except FaultHarnessError:
+            raise  # injected crash / misconfigured plan: die and resume
+        except Exception as e:  # noqa: BLE001 — isolate one poison batch
+            # a malformed batch must not kill a loop whose serving is
+            # healthy: drop it FROM TRAINING (counted + warned — operators
+            # watch skippedBatches for silent data loss), keep streaming
+            self.metrics.record_skipped_batch()
+            warnings.warn(
+                f"continuous loop: dropping unreadable batch from "
+                f"{source!r} ({type(e).__name__}: {e})", RuntimeWarning)
+            return
+        self.metrics.record_batch(len(records))
+        if source is not None:
+            # at-least-once replay: a restarted stream may re-yield the
+            # in-flight file — replace its buffer entry, never duplicate
+            self._rows_by_source[source] = list(records)
+            self.state.buffer = [b for b in self.state.buffer
+                                 if b.get("file") != source]
+            for stale in set(self._rows_by_source) - {
+                    b.get("file") for b in self.state.buffer} - {source}:
+                self._rows_by_source.pop(stale, None)
+        self.state.record_batch(source, len(records),
+                                self.max_buffer_batches)
+        self._batches_in_window += 1
+
+    def _frame_features(self, records: list) -> list:
+        """Raw features present in this batch (the response is optional
+        on a pure scoring stream; predictors are required)."""
+        if records and isinstance(records[0], dict) \
+                and self.response is not None \
+                and self.response not in records[0]:
+            return [f for f in self.raw_features if not f.is_response]
+        return list(self.raw_features)
+
+    # -- window + trigger ----------------------------------------------------
+    def _close_window(self) -> None:
+        from transmogrifai_tpu.utils.faults import fault_point
+        self._batches_in_window = 0
+        self._windows_this_run += 1
+        fault_point("continuous.trigger")
+        if not self.monitor.has_reference:
+            self._baseline_window()
+            return
+        decision = self.monitor.close_window()
+        # refresh the persisted monitor state (breach streak, cooldown,
+        # window counter) so a kill between two breaching windows
+        # doesn't reset hysteresis and delay the trigger
+        self.state.drift_reference = self.monitor.reference_to_json()
+        self.state.record_decision(decision.to_json())
+        if decision.triggered:
+            self.metrics.record_trigger()
+            warnings.warn(
+                f"continuous loop: drift trigger at window "
+                f"{self.state.window_seq}: {'; '.join(decision.reasons)}",
+                RuntimeWarning)
+            if self.state.pending_retrain is None:
+                ckpt = os.path.join(
+                    self.state_dir, f"retrain_w{self.state.window_seq}")
+                self.state.begin_retrain(decision.reasons, ckpt)
+                self._execute_retrain()
+                return
+        if self.state.pending_retrain is not None \
+                and self.state.retrain_eligible():
+            # a previously failed retrain retries (resuming from its
+            # checkpoints) once its backoff expires
+            self.state.begin_retrain([], None)
+            self._execute_retrain()
+
+    def _baseline_window(self) -> None:
+        """First window with no reference: bootstrap-train the initial
+        model from it (no model yet), or adopt it as the reference for
+        an externally supplied model."""
+        rows = self._buffer_rows_list()
+        if not rows:
+            return
+        if not self._has_active():
+            if not self.state.retrain_eligible():
+                # a failed bootstrap train is backing off: count the
+                # window (backoff is measured in windows — skipping the
+                # increment would deadlock eligibility) and keep
+                # buffering instead of re-running the failing train
+                # every window
+                self.state.window_seq += 1
+                self.state.save()
+                return
+            ckpt = os.path.join(
+                self.state_dir, f"retrain_w{self.state.window_seq}")
+            self.state.window_seq += 1
+            self.state.begin_retrain(["bootstrap"], ckpt)
+            self._execute_retrain()
+            return
+        frame = CustomReader(records=rows).generate_frame(
+            self.raw_features)
+        self.monitor.set_reference(frame,
+                                   [f.name for f in self.raw_features],
+                                   response=self.response)
+        self.state.drift_reference = self.monitor.reference_to_json()
+        self.state.window_seq += 1
+        self.state.save()
+        warnings.warn(
+            "continuous loop: adopted the first stream window as the "
+            "drift reference (pass reference_frame= to pin the training "
+            "distribution instead)", RuntimeWarning)
+
+    # -- retrain -------------------------------------------------------------
+    def _buffer_rows_list(self) -> list:
+        rows: list = []
+        for b in self.state.buffer:
+            src = b.get("file")
+            if src is not None and src in self._rows_by_source:
+                rows.extend(self._rows_by_source[src])
+        return rows
+
+    def _window_rows(self, pending: dict) -> list:
+        """The pending retrain's rows: the in-memory buffer when it
+        covers the recorded files, else a re-read of the manifest's file
+        list (the restart path — same files, same schema, same rows)."""
+        files = [f for f in pending.get("files", []) if f]
+        rows: list = []
+        for f in files:
+            if f in self._rows_by_source:
+                rows.extend(self._rows_by_source[f])
+                continue
+            try:
+                file_rows = list(reader_for_file(f, self.schema).read())
+            except Exception as e:  # noqa: BLE001 — a rotated file costs rows, not the loop
+                warnings.warn(
+                    f"continuous loop: retrain window file {f!r} is "
+                    f"unreadable on resume ({type(e).__name__}: {e}); "
+                    "retraining without it", RuntimeWarning)
+                continue
+            self._rows_by_source[f] = file_rows
+            rows.extend(file_rows)
+        return rows
+
+    def _execute_retrain(self) -> bool:
+        from transmogrifai_tpu.utils.faults import (
+            FaultHarnessError, fault_point,
+        )
+        from transmogrifai_tpu.utils.profiling import OpStep, profiler
+        from transmogrifai_tpu.utils.tracing import span
+        pending = self.state.pending_retrain
+        if pending is None:
+            return False
+        self.metrics.record_retrain()
+        with span("continuous.retrain",
+                  window=pending.get("windowSeq"),
+                  attempt=pending.get("attempt"),
+                  rows=pending.get("rows")):
+            rows = self._window_rows(pending)
+            if not rows:
+                warnings.warn(
+                    "continuous loop: pending retrain has no recoverable "
+                    "rows (buffer files gone); abandoning it",
+                    RuntimeWarning)
+                self.state.abandon_retrain("no recoverable window rows")
+                self._cleanup_retrain_dir(pending)
+                return False
+            try:
+                # chaos seam: a preemption here dies with the
+                # pendingRetrain manifest already durable — the restarted
+                # loop re-runs this retrain on the same rows, resuming
+                # from its checkpoints; an io/transient fault follows the
+                # failed-attempt backoff path below
+                fault_point("continuous.retrain")
+                self.workflow.set_input_records(rows)
+                with profiler.phase(OpStep.MODEL_TRAINING):
+                    model = self.workflow.train(
+                        checkpoint_dir=pending.get("checkpointDir"))
+            except FaultHarnessError:
+                raise  # preemption dies; the pending record resumes it
+            except Exception as e:  # noqa: BLE001 — a failed retrain must not stop serving
+                self._retrain_failed(pending, e)
+                return False
+        return self._promote(model, pending, rows)
+
+    def _retrain_failed(self, pending: dict, err: BaseException) -> None:
+        self.metrics.record_retrain_failure()
+        warnings.warn(
+            f"continuous loop: retrain attempt "
+            f"{pending.get('attempt')} failed ({type(err).__name__}: "
+            f"{str(err)[:200]}); old model keeps serving",
+            RuntimeWarning)
+        self.state.record_retrain_failure(
+            f"{type(err).__name__}: {str(err)[:300]}")
+        if pending.get("attempt", 1) >= self.max_retrain_attempts:
+            self.state.abandon_retrain(
+                f"attempt budget ({self.max_retrain_attempts}) exhausted")
+            self.monitor.start_cooldown()
+            # the pending record is gone, so nothing will ever resume
+            # from (or clean up) its checkpoint tree — delete it now or
+            # a forever-running daemon leaks one dir per abandoned
+            # retrain under the durable state root
+            self._cleanup_retrain_dir(pending)
+
+    # -- promote -------------------------------------------------------------
+    def _promote(self, model, pending: dict, rows: list) -> bool:
+        from transmogrifai_tpu.serving.fleet import ShadowParityError
+        from transmogrifai_tpu.utils.faults import (
+            FaultHarnessError, fault_point,
+        )
+        from transmogrifai_tpu.utils.tracing import span
+        fault_point("continuous.promote")
+        with span("continuous.promote", model=self.model_id,
+                  window=pending.get("windowSeq")):
+            try:
+                if not self._has_active():
+                    # bootstrap: first version of the endpoint — nothing
+                    # to swap, registration activates and serving starts
+                    entry = self.fleet.register(model=model,
+                                                model_id=self.model_id)
+                    self._start_fleet_if_serveable()
+                    version = entry.version
+                    swap_report = {"modelId": self.model_id,
+                                   "toVersion": version,
+                                   "bootstrap": True}
+                else:
+                    swap_report = self.fleet.hot_swap(self.model_id,
+                                                      model=model)
+                    version = swap_report["toVersion"]
+            except ShadowParityError as e:
+                # the parity gate REJECTED the candidate: the old version
+                # never stopped serving; count the rollback, cool down
+                self.metrics.record_rollback()
+                self.state.record_rollback(
+                    {"error": f"ShadowParityError: {e}"})
+                self.monitor.start_cooldown()
+                warnings.warn(
+                    f"continuous loop: promotion rolled back by the "
+                    f"shadow parity gate ({e}); old version keeps "
+                    "serving", RuntimeWarning)
+                self._cleanup_retrain_dir(pending)
+                return False
+            except FaultHarnessError:
+                raise
+            except Exception as e:  # noqa: BLE001 — an aborted swap leaves the old version serving
+                self._retrain_failed(pending, e)
+                return False
+            staleness = None
+            if pending.get("triggeredAt"):
+                staleness = time.time() - float(pending["triggeredAt"])
+            if self.staleness_bound_s is not None and staleness is not None \
+                    and staleness > self.staleness_bound_s:
+                warnings.warn(
+                    f"continuous loop: promotion staleness "
+                    f"{staleness:.1f}s exceeds the "
+                    f"{self.staleness_bound_s:.1f}s bound", RuntimeWarning)
+            self._persist_promoted(model, version)
+            # rebase drift on the data the NEW serving model saw
+            frame = CustomReader(records=rows).generate_frame(
+                self.raw_features)
+            self.monitor.set_reference(
+                frame, [f.name for f in self.raw_features],
+                response=self.response)
+            self.monitor.start_cooldown()
+            self.state.drift_reference = self.monitor.reference_to_json()
+            self.state.record_promotion(version, swap_report, staleness)
+            self.metrics.record_promotion()
+            self._rows_by_source = {}
+            self._cleanup_retrain_dir(pending)
+        return True
+
+    @staticmethod
+    def _cleanup_retrain_dir(pending: dict) -> None:
+        ckpt = pending.get("checkpointDir")
+        if ckpt and os.path.isdir(ckpt):
+            shutil.rmtree(ckpt, ignore_errors=True)
+
+    # -- observability -------------------------------------------------------
+    def drift_scores(self) -> dict:
+        return self.monitor.drift_scores()
+
+    def staleness_s(self) -> float:
+        """Age of the serving model's training data (seconds since the
+        last promotion; 0 before any promotion)."""
+        if self.state.last_promoted_at is None:
+            return 0.0
+        return max(0.0, time.time() - self.state.last_promoted_at)
+
+    def window_seq(self) -> int:
+        return self.state.window_seq
+
+    def buffer_rows(self) -> int:
+        return sum(int(b.get("rows", 0)) for b in self.state.buffer)
+
+    def _serving_snapshot(self) -> dict:
+        admitted = completed = failed = 0
+        for lane in self.fleet.active_lanes().values():
+            doc = lane.metrics.snapshot(mirror_to_profiler=False)
+            admitted += doc["requests"]["admitted"]
+            completed += doc["requests"]["completed"]
+            failed += doc["requests"]["failed"]
+        return {"admitted": admitted, "completed": completed,
+                "failed": failed}
+
+    def health(self) -> dict:
+        doc = self.fleet.health() if self._fleet_started else {
+            "status": "warming", "models": {}}
+        doc["loop"] = {"window": self.state.window_seq,
+                       "bufferRows": self.buffer_rows(),
+                       "pendingRetrain": self.state.pending_retrain
+                       is not None,
+                       "counters": self.metrics.to_json()}
+        return doc
+
+    def report(self) -> dict:
+        """One JSON document summarizing the run (the runner/CLI result
+        body and the bench harness's source of truth)."""
+        doc = {
+            "modelId": self.model_id,
+            "activeVersion": self.fleet.registry.active_version(
+                self.model_id),
+            "windows": self.state.window_seq,
+            "counters": self.metrics.to_json(),
+            "totals": dict(self.state.totals),
+            "promotions": list(self.state.promotions),
+            "retrainFailures": list(self.state.retrain_failures),
+            "pendingRetrain": self.state.pending_retrain,
+            "driftScores": self.drift_scores(),
+            "lastDecision": (self.state.decisions[-1]
+                             if self.state.decisions else None),
+            "stalenessSeconds": round(self.staleness_s(), 3),
+            "streamSkippedFiles": list(
+                getattr(self, "_stream_skipped", [])),
+        }
+        if self._serving_totals is not None:
+            doc["serving"] = dict(self._serving_totals)
+        elif self._fleet_started:
+            doc["serving"] = self._serving_snapshot()
+        return doc

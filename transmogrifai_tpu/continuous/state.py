@@ -1,0 +1,244 @@
+"""Loop lifecycle + durability: one manifest for the whole control loop.
+
+``continuous_manifest.json`` (atomic via ``utils.durable``, best-effort
+like every other checkpoint format) is the single source of truth a
+killed-and-restarted loop resumes from:
+
+- **window boundaries**: the buffer of micro-batch files currently
+  accumulated toward the next retrain (each with its committed row
+  count), plus the running window sequence number;
+- **trigger decisions**: the last drift decisions (bounded history) and
+  the serialized drift REFERENCE, so a restarted loop keeps measuring
+  against the pre-drift baseline;
+- **retrain attempts**: a ``pendingRetrain`` record written BEFORE the
+  retrain starts — window id, exact file list, attempt count, the
+  per-window checkpoint directory — so a preemption mid-retrain resumes
+  the SAME retrain (same rows, same fitted-DAG/sweep/refit checkpoints)
+  instead of losing it;
+- **promotions**: every promoted version with its trigger window and
+  measured staleness.
+
+Composition with the stream checkpoint: rows live either in files the
+``StreamCheckpoint`` has NOT marked done (replayed by the reader on
+restart) or in buffer files this manifest lists (re-read directly on
+restart) — so a crash at any point loses zero rows (at-least-once).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+from typing import Optional
+
+__all__ = ["LoopState", "LOOP_MANIFEST"]
+
+LOOP_MANIFEST = "continuous_manifest.json"
+FORMAT_VERSION = 1
+
+#: bounded history kept in the manifest (the loop runs forever; the
+#: manifest must not grow with it)
+MAX_HISTORY = 50
+
+
+class LoopState:
+    """Durable, resumable state of one :class:`~transmogrifai_tpu.
+    continuous.loop.ContinuousLoop`."""
+
+    def __init__(self, path: str, model_id: str):
+        from transmogrifai_tpu.utils.durable import ensure_checkpoint_dir
+        self.path = path
+        self.model_id = model_id
+        self.window_seq = 0
+        #: [{"file": path, "rows": n}] — the accumulated retrain window
+        self.buffer: list[dict] = []
+        #: in-flight retrain record (None when idle); see begin_retrain
+        self.pending_retrain: Optional[dict] = None
+        self.promotions: list[dict] = []
+        self.retrain_failures: list[dict] = []
+        self.decisions: list[dict] = []
+        #: serialized DriftMonitor reference (reference_to_json)
+        self.drift_reference: Optional[dict] = None
+        #: loop-lifetime totals (survive restarts, unlike ContinuousMetrics)
+        self.totals: dict = {k: 0 for k in (
+            "batches", "rows", "driftTriggers", "retrains",
+            "retrainFailures", "promotions", "rollbacks")}
+        self.last_promoted_at: Optional[float] = None
+        #: windows to skip retrying a failed retrain (exponential backoff)
+        self.backoff_windows = 0
+        self.backoff_until_window = 0
+        self._disabled = not ensure_checkpoint_dir(path, "continuous loop")
+        if not self._disabled:
+            self._load()
+
+    # -- io ------------------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.path, LOOP_MANIFEST)
+
+    def _load(self) -> None:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            if doc.get("formatVersion") != FORMAT_VERSION:
+                raise ValueError(
+                    f"format {doc.get('formatVersion')!r} != "
+                    f"{FORMAT_VERSION}")
+        except Exception as e:  # noqa: BLE001 — corrupt manifest != crash
+            warnings.warn(
+                f"continuous loop: unreadable manifest at {path!r} "
+                f"({type(e).__name__}: {e}); starting fresh",
+                RuntimeWarning)
+            return
+        if doc.get("modelId") != self.model_id:
+            warnings.warn(
+                f"continuous loop: manifest at {path!r} belongs to model "
+                f"{doc.get('modelId')!r}, not {self.model_id!r}; "
+                "starting fresh", RuntimeWarning)
+            return
+        self.window_seq = int(doc.get("windowSeq", 0))
+        self.buffer = [dict(b) for b in doc.get("buffer", [])]
+        self.pending_retrain = doc.get("pendingRetrain")
+        self.promotions = list(doc.get("promotions", []))
+        self.retrain_failures = list(doc.get("retrainFailures", []))
+        self.decisions = list(doc.get("decisions", []))
+        self.drift_reference = doc.get("driftReference")
+        self.totals.update(doc.get("totals", {}))
+        self.last_promoted_at = doc.get("lastPromotedAt")
+        self.backoff_windows = int(doc.get("backoffWindows", 0))
+        self.backoff_until_window = int(doc.get("backoffUntilWindow", 0))
+
+    def to_json(self) -> dict:
+        return {
+            "formatVersion": FORMAT_VERSION,
+            "modelId": self.model_id,
+            "windowSeq": self.window_seq,
+            "buffer": [dict(b) for b in self.buffer],
+            "pendingRetrain": self.pending_retrain,
+            "promotions": self.promotions[-MAX_HISTORY:],
+            "retrainFailures": self.retrain_failures[-MAX_HISTORY:],
+            "decisions": self.decisions[-MAX_HISTORY:],
+            "driftReference": self.drift_reference,
+            "totals": dict(self.totals),
+            "lastPromotedAt": self.last_promoted_at,
+            "backoffWindows": self.backoff_windows,
+            "backoffUntilWindow": self.backoff_until_window,
+        }
+
+    def save(self) -> bool:
+        """Persist the manifest (atomic + best-effort: the loop whose
+        actual work is healthy never dies for bookkeeping)."""
+        from transmogrifai_tpu.utils.durable import (
+            atomic_json_dump, best_effort_checkpoint_write,
+        )
+        if self._disabled:
+            return False
+        return best_effort_checkpoint_write(
+            lambda: atomic_json_dump(self.to_json(), self._manifest_path()),
+            f"continuous loop: manifest write to "
+            f"{self._manifest_path()!r} failed; a restart may replay "
+            "recent windows")
+
+    # -- transitions ---------------------------------------------------------
+    def record_batch(self, source: Optional[str], rows: int,
+                     max_buffer_batches: int) -> None:
+        """One consumed micro-batch: append to the retrain buffer (bounded
+        — the oldest batch falls off a full buffer) and bump totals."""
+        self.totals["batches"] += 1
+        self.totals["rows"] += rows
+        self.buffer.append({"file": source, "rows": int(rows)})
+        if len(self.buffer) > max_buffer_batches:
+            self.buffer = self.buffer[-max_buffer_batches:]
+        self.save()
+
+    def record_decision(self, decision_doc: dict) -> None:
+        self.window_seq += 1
+        if decision_doc.get("triggered"):
+            self.totals["driftTriggers"] += 1
+        self.decisions.append(decision_doc)
+        self.decisions = self.decisions[-MAX_HISTORY:]
+        self.save()
+
+    def begin_retrain(self, reason: list, checkpoint_dir: str) -> dict:
+        """Record the retrain BEFORE it starts: the exact buffer file
+        list + per-window checkpoint dir are what a preempted process
+        needs to resume the same retrain on the same rows."""
+        if self.pending_retrain is not None:
+            pending = self.pending_retrain
+            pending["attempt"] = int(pending.get("attempt", 1)) + 1
+        else:
+            pending = {
+                "windowSeq": self.window_seq,
+                "files": [b["file"] for b in self.buffer
+                          if b.get("file")],
+                "rows": sum(int(b.get("rows", 0)) for b in self.buffer),
+                "reason": list(reason),
+                "attempt": 1,
+                "checkpointDir": checkpoint_dir,
+                "triggeredAt": time.time(),
+            }
+            self.pending_retrain = pending
+        self.totals["retrains"] += 1
+        self.save()
+        return pending
+
+    def record_retrain_failure(self, error: str) -> None:
+        """A failed attempt: keep the pending record (the next eligible
+        window retries, resuming from the same checkpoints) and back off
+        exponentially in windows."""
+        self.totals["retrainFailures"] += 1
+        self.retrain_failures.append({
+            "windowSeq": self.window_seq, "error": error,
+            "at": time.time(),
+            "attempt": (self.pending_retrain or {}).get("attempt", 1)})
+        self.retrain_failures = self.retrain_failures[-MAX_HISTORY:]
+        self.backoff_windows = max(1, self.backoff_windows * 2) \
+            if self.backoff_windows else 1
+        self.backoff_until_window = self.window_seq + self.backoff_windows
+        self.save()
+
+    def abandon_retrain(self, why: str) -> None:
+        """Give up on the pending retrain (attempt budget exhausted or a
+        parity-gate rollback): the old model keeps serving."""
+        if self.pending_retrain is not None:
+            self.retrain_failures.append({
+                "windowSeq": self.window_seq, "error": why,
+                "abandoned": True, "at": time.time(),
+                "attempt": self.pending_retrain.get("attempt", 1)})
+            self.retrain_failures = self.retrain_failures[-MAX_HISTORY:]
+        self.pending_retrain = None
+        self.save()
+
+    def record_rollback(self, detail: dict) -> None:
+        self.totals["rollbacks"] += 1
+        self.abandon_retrain(detail.get("error", "rollback"))
+
+    def record_promotion(self, version: str, swap_report: dict,
+                         staleness_s: Optional[float]) -> dict:
+        """A successful hot-swap: clear the pending retrain + buffer (its
+        rows are IN the new model), reset backoff, stamp staleness."""
+        doc = {"version": version,
+               "windowSeq": (self.pending_retrain or {}).get(
+                   "windowSeq", self.window_seq),
+               "at": time.time(),
+               "stalenessSeconds": (round(staleness_s, 3)
+                                    if staleness_s is not None else None),
+               "swap": dict(swap_report)}
+        self.totals["promotions"] += 1
+        self.promotions.append(doc)
+        self.promotions = self.promotions[-MAX_HISTORY:]
+        self.pending_retrain = None
+        self.buffer = []
+        self.backoff_windows = 0
+        self.backoff_until_window = 0
+        self.last_promoted_at = doc["at"]
+        self.save()
+        return doc
+
+    def retrain_eligible(self) -> bool:
+        """True when a pending retrain may (re)run this window (attempt
+        budget is the loop's call; backoff is ours)."""
+        return self.window_seq >= self.backoff_until_window

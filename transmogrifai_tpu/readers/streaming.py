@@ -39,22 +39,42 @@ class StreamCheckpoint:
     def __init__(self, path: str):
         self.path = path
         self._done: dict[str, dict] = {}
-        self._skipped: list[str] = []
+        #: file -> fingerprint-at-skip-time (None: the file was GONE when
+        #: skipped). A skip only holds while the path's content matches —
+        #: a file recreated at a skipped path is new data, not the skip
+        self._skipped: dict[str, Optional[dict]] = {}
         if os.path.exists(path):
             try:
                 with open(path) as fh:
                     state = json.load(fh)
                 self._done = dict(state.get("done", {}))
-                self._skipped = list(state.get("skipped", []))
+                raw_skipped = state.get("skipped", [])
+                # pre-fingerprint format stored a bare name list: load as
+                # fingerprint-None (re-examined if the path has a file)
+                self._skipped = dict(raw_skipped) \
+                    if isinstance(raw_skipped, dict) \
+                    else {f: None for f in raw_skipped}
             except (OSError, json.JSONDecodeError):
                 warnings.warn(f"StreamCheckpoint: unreadable state at "
                               f"{path!r}; starting fresh", RuntimeWarning)
 
     @staticmethod
     def _fingerprint(f: str) -> Optional[dict]:
+        """(mtime_ns, size) identity of one file. Nanosecond mtime, not
+        the float ``st_mtime``: a file REWRITTEN in place within the
+        float's granularity (same size, same truncated mtime — exactly
+        what a fast producer's overwrite does) must not be treated as
+        already processed. Falls back to the float where the platform
+        lacks ``st_mtime_ns``. Entries recorded by the pre-``mtime_ns``
+        format no longer match and replay once — at-least-once, the
+        checkpoint's documented degradation."""
         try:
             st = os.stat(f)
-            return {"mtime": st.st_mtime, "size": st.st_size}
+            fp = {"mtime": st.st_mtime, "size": st.st_size}
+            ns = getattr(st, "st_mtime_ns", None)
+            if ns is not None:
+                fp["mtime_ns"] = int(ns)
+            return fp
         except OSError:
             return None
 
@@ -66,6 +86,19 @@ class StreamCheckpoint:
     def skipped(self) -> list[str]:
         return list(self._skipped)
 
+    def is_skipped(self, f: str) -> bool:
+        """True while the durable skip still applies: the path has no
+        file (a disappeared/rotated source stays skipped) or the file is
+        byte-identical to when it was abandoned. A file RECREATED at a
+        skipped path (the rotation pattern: rename away, write fresh) no
+        longer matches and is read as new data."""
+        if f not in self._skipped:
+            return False
+        cur = self._fingerprint(f)
+        if cur is None:
+            return True
+        return self._skipped[f] == cur
+
     def mark_done(self, f: str, fingerprint: Optional[dict] = None) -> None:
         """Record ``f`` as fully processed. Pass the fingerprint captured
         BEFORE the file was read: if a producer appended rows between read
@@ -75,11 +108,13 @@ class StreamCheckpoint:
         fp = fingerprint if fingerprint is not None else self._fingerprint(f)
         if fp is not None:
             self._done[f] = fp
+            self._skipped.pop(f, None)
             self._save()
 
     def mark_skipped(self, f: str) -> None:
-        if f not in self._skipped:
-            self._skipped.append(f)
+        fp = self._fingerprint(f)
+        if f not in self._skipped or self._skipped[f] != fp:
+            self._skipped[f] = fp
             self._save()
 
     def _save(self) -> None:
@@ -164,10 +199,11 @@ class FileStreamingReader(StreamingReader):
             else set()
         if self.checkpoint is not None:
             # resume: completed files (fingerprint still matching) and
-            # previously-abandoned files are not replayed
-            skipped_before = set(self.checkpoint.skipped)
+            # previously-abandoned files (skip fingerprint still matching —
+            # a file RECREATED at a skipped path is new data) not replayed
             seen.update(f for f in self._list_files()
-                        if self.checkpoint.is_done(f) or f in skipped_before)
+                        if self.checkpoint.is_done(f)
+                        or self.checkpoint.is_skipped(f))
         failures: dict[str, int] = {}
         next_retry: dict[str, float] = {}
         n_batches = 0
@@ -200,6 +236,21 @@ class FileStreamingReader(StreamingReader):
                     )
                     if isinstance(read_err, FaultHarnessError):
                         raise  # injected crash / misconfigured plan: die
+                    if not os.path.exists(f):
+                        # deleted/rotated between _list_files and the
+                        # read: the rows are GONE — retrying would only
+                        # delay the stream. Warn-and-skip (durably, so a
+                        # restart doesn't wait on it either); operators
+                        # monitor skipped_files for rotation-induced loss
+                        seen.add(f)
+                        self.skipped_files.append(f)
+                        if self.checkpoint is not None:
+                            self.checkpoint.mark_skipped(f)
+                        warnings.warn(
+                            f"FileStreamingReader: {f!r} disappeared "
+                            "mid-stream (deleted/rotated between listing "
+                            "and read); skipping it", RuntimeWarning)
+                        continue
                     # likely a partially-written file: retry on a later
                     # poll (one attempt per poll interval, so a slow
                     # producer gets real wall-clock time to finish), give

@@ -28,9 +28,11 @@ class RunTypes:
     SCORE = "score"
     STREAMING_SCORE = "streaming-score"
     SERVE = "serve"
+    CONTINUOUS = "continuous"
     EVALUATE = "evaluate"
     FEATURES = "features"
-    ALL = (TRAIN, SCORE, STREAMING_SCORE, SERVE, EVALUATE, FEATURES)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, SERVE, CONTINUOUS, EVALUATE,
+           FEATURES)
 
 
 class WorkflowRunner:
@@ -137,6 +139,15 @@ class WorkflowRunner:
                         n_rows += frame.n_rows
                 result["nBatches"] = n_batches
                 result["nRows"] = n_rows
+            elif run_type == RunTypes.CONTINUOUS:
+                # closed-loop continuous AutoML: stream ingest + drift
+                # detection + checkpoint-resumed retrain + zero-downtime
+                # hot-swap, one long-running supervised process
+                # (docs/CONTINUOUS.md). The runner's workflow is the
+                # retrain template; customParams.streamDir names the
+                # watched directory and checkpoint_dir (or
+                # customParams.stateDir) the durable resume root.
+                self._run_continuous(params, result, checkpoint_dir)
             elif run_type == RunTypes.SERVE and \
                     (params.custom_params or {}).get("modelDir"):
                 # fleet replay: customParams.modelDir registers every
@@ -294,6 +305,65 @@ class WorkflowRunner:
             for h in self.on_end_handlers:
                 h(result)
         return result
+
+    def _run_continuous(self, params: OpParams, result: dict,
+                        checkpoint_dir: Optional[str]) -> None:
+        """CONTINUOUS: drive a ``continuous.ContinuousLoop`` from
+        OpParams. ``customParams``: ``streamDir`` (required), ``pattern``,
+        ``stateDir`` (default: ``--checkpoint-dir``), ``modelId``,
+        ``windowBatches``, ``maxBufferBatches``, ``maxWindows``,
+        ``timeoutS``, ``pollIntervalS``, drift knobs (``driftMetric``,
+        ``jsThreshold``, ``psiThreshold``, ``fillDeltaThreshold``,
+        ``labelDeltaThreshold``, ``consecutiveWindows``,
+        ``cooldownWindows``), ``shadowTolerance``, ``stalenessBoundS``,
+        ``metricsPort``. ``modelLocation`` loads the initial serving
+        model; without it the loop bootstraps from the first window.
+        ``referencePath`` names a batch file sampling that model's
+        training data to pin the drift reference (else the first stream
+        window is adopted)."""
+        from transmogrifai_tpu.continuous import ContinuousLoop, DriftConfig
+        cp = dict(params.custom_params or {})
+        stream_dir = cp.get("streamDir")
+        if not stream_dir:
+            raise ValueError("continuous requires customParams.streamDir")
+        state_dir = cp.get("stateDir") or checkpoint_dir
+        if not state_dir:
+            raise ValueError(
+                "continuous requires a durable state root: pass "
+                "--checkpoint-dir or customParams.stateDir")
+        initial_model = (load_model(params.model_location)
+                         if params.model_location else None)
+        drift = DriftConfig(
+            metric=cp.get("driftMetric", "js"),
+            js_threshold=float(cp.get("jsThreshold", 0.25)),
+            psi_threshold=float(cp.get("psiThreshold", 0.25)),
+            fill_delta_threshold=float(cp.get("fillDeltaThreshold", 0.25)),
+            label_delta_threshold=float(cp.get("labelDeltaThreshold",
+                                               0.25)),
+            consecutive_windows=int(cp.get("consecutiveWindows", 2)),
+            cooldown_windows=int(cp.get("cooldownWindows", 2)))
+        loop = ContinuousLoop(
+            self.workflow, stream_dir, state_dir,
+            model_id=cp.get("modelId", "live"),
+            pattern=cp.get("pattern", "*"),
+            initial_model=initial_model,
+            reference_path=cp.get("referencePath"),
+            drift=drift,
+            window_batches=int(cp.get("windowBatches", 4)),
+            max_buffer_batches=int(cp.get("maxBufferBatches", 8)),
+            poll_interval_s=float(cp.get("pollIntervalS", 1.0)),
+            timeout_s=(float(cp["timeoutS"]) if "timeoutS" in cp
+                       else None),
+            max_windows=(int(cp["maxWindows"]) if "maxWindows" in cp
+                         else None),
+            max_retrain_attempts=int(cp.get("maxRetrainAttempts", 3)),
+            shadow_tolerance=float(cp.get("shadowTolerance", 1.0)),
+            staleness_bound_s=(float(cp["stalenessBoundS"])
+                               if "stalenessBoundS" in cp else None),
+            metrics_port=(int(cp["metricsPort"]) if "metricsPort" in cp
+                          else None))
+        result["continuous"] = loop.run()
+        result["stateDir"] = state_dir
 
     def _serve_fleet(self, params: OpParams, result: dict) -> None:
         """SERVE with ``customParams.modelDir``: replay the reader's rows

@@ -27,6 +27,14 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``serving.swap``          mid-fleet-hot-swap (candidate warm, alias not
                           yet flipped — the abort path must leave the old
                           version serving with zero drops)
+``continuous.ingest``     one continuous-loop micro-batch consumption
+``continuous.trigger``    a drift-window close / trigger evaluation
+``continuous.retrain``    after the pendingRetrain manifest write, before
+                          the retrain's train() — a preemption here must
+                          resume the SAME retrain from its checkpoints
+``continuous.promote``    before the retrained model's registration /
+                          hot-swap — the abort path must leave the old
+                          version serving with zero drops
 ========================  ====================================================
 
 Plan syntax (env ``TRANSMOGRIFAI_FAULT_PLAN`` or programmatic), entries
@@ -69,7 +77,8 @@ __all__ = ["FaultPlan", "FaultSpec", "FaultHarnessError",
 KNOWN_SITES = frozenset({
     "dag.apply_layer", "sweep.fit", "selector.refit", "train.layer",
     "ingest.read", "checkpoint.write", "collective", "serving.dispatch",
-    "serving.swap",
+    "serving.swap", "continuous.ingest", "continuous.trigger",
+    "continuous.retrain", "continuous.promote",
 })
 
 KINDS = ("transient", "io", "slow", "preempt")

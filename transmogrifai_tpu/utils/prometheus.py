@@ -349,14 +349,61 @@ def _fleet_collectors(reg: PromRegistry, fleet) -> None:
                  for mid, lane in sorted(fleet.active_lanes().items())])
 
 
-def build_registry(serving=None, server=None, fleet=None,
+def _continuous_collectors(reg: PromRegistry, cont) -> None:
+    """The continuous-loop series over a ``ContinuousLoop``-shaped
+    object: lifecycle counters from its ``metrics``
+    (``ContinuousMetrics``), per-feature drift-score gauges from
+    ``drift_scores()``, and window/staleness/buffer gauges."""
+    cm = cont.metrics
+    for attr, name, help_ in (
+            ("batches", "batches", "stream micro-batches consumed"),
+            ("rows", "rows", "stream rows consumed"),
+            ("skipped_batches", "skipped_batches",
+             "unreadable micro-batches dropped from training"),
+            ("drift_triggers", "drift_triggers",
+             "drift-window triggers (post hysteresis/cooldown)"),
+            ("retrains", "retrains", "retrain attempts launched"),
+            ("retrain_failures", "retrain_failures",
+             "retrain attempts that failed (old model kept serving)"),
+            ("promotions", "promotions",
+             "retrained versions promoted through the hot-swap gate"),
+            ("rollbacks", "rollbacks",
+             "promotions rolled back by the shadow parity gate")):
+        reg.register(f"transmogrifai_continuous_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(cm, a))])
+    reg.register(
+        "transmogrifai_continuous_drift_score", "gauge",
+        "per-feature drift score of the last closed window (the "
+        "configured metric: JS divergence or PSI; __label__ = label "
+        "mean delta)",
+        lambda: [({"feature": k}, v)
+                 for k, v in sorted(cont.drift_scores().items())])
+    reg.register(
+        "transmogrifai_continuous_staleness_seconds", "gauge",
+        "age of the serving model's training data (seconds since the "
+        "last promotion)",
+        lambda: [({}, cont.staleness_s())])
+    reg.register(
+        "transmogrifai_continuous_window", "gauge",
+        "drift windows closed over the loop's lifetime",
+        lambda: [({}, cont.window_seq())])
+    reg.register(
+        "transmogrifai_continuous_buffer_rows", "gauge",
+        "rows accumulated in the retrain buffer",
+        lambda: [({}, cont.buffer_rows())])
+
+
+def build_registry(serving=None, server=None, fleet=None, continuous=None,
                    include_app: bool = True) -> PromRegistry:
     """The standard registry: process-wide training/run/sweep series
     (``include_app``) plus the full serving surface — unlabeled for one
     ``ServingMetrics`` (``serving``), ``model``-labeled per lane plus the
     fleet swap/cache series for a ``FleetServer`` (``fleet``; mutually
-    exclusive with ``serving``). ``server`` (a ``ScoringServer``) is
-    optional extra context reserved for future gauges."""
+    exclusive with ``serving``). ``continuous`` (a ``ContinuousLoop``)
+    adds the ``transmogrifai_continuous_*`` drift/retrain/promotion
+    series and composes with ``fleet`` — the loop's scrape endpoint
+    exposes both. ``server`` (a ``ScoringServer``) is optional extra
+    context reserved for future gauges."""
     if serving is not None and fleet is not None:
         raise ValueError("pass serving= or fleet=, not both (the serving "
                          "series would collide)")
@@ -367,4 +414,6 @@ def build_registry(serving=None, server=None, fleet=None,
         _serving_collectors(reg, lambda: [({}, serving)])
     if fleet is not None:
         _fleet_collectors(reg, fleet)
+    if continuous is not None:
+        _continuous_collectors(reg, continuous)
     return reg
