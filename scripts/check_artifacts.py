@@ -77,6 +77,8 @@ def validate_artifact(doc: object) -> list[str]:
                 "produced them)")
     if doc.get("metric") == "observability_overhead":
         errors.extend(_validate_observability(doc))
+    if doc.get("metric") == "tracing_overhead":
+        errors.extend(_validate_tracing_overhead(doc))
     if doc.get("metric") == "tree_stacked_sweep":
         errors.extend(_validate_tree_stacked(doc))
     if doc.get("metric") == "serving_fleet":
@@ -326,6 +328,56 @@ def _validate_tree_stacked(doc: dict) -> list[str]:
             errors.append(
                 f"tree-stacked artifact: {block!r} must map each of "
                 "tree_stacked/per_fold/per_point to a positive int")
+    return errors
+
+
+#: request-scoped tracing + flight-recorder emission must stay cheap on
+#: the serving hot path — the acceptance bound the committed
+#: benchmarks/TRACING_OVERHEAD.json is held to (round 10)
+MAX_TRACING_OVERHEAD_PCT = 5.0
+
+
+def _validate_tracing_overhead(doc: dict) -> list[str]:
+    """The ``benchmarks/TRACING_OVERHEAD.json`` contract: the serving
+    throughput bench driven twice through the SAME server path —
+    baseline (no trace context) and traced (a trace id minted per
+    request, flight-recorder events + JSONL spill enabled) — with the
+    derived overhead within ``MAX_TRACING_OVERHEAD_PCT``, and proof the
+    traced leg actually traced (events emitted, spill written, trace ids
+    observable in the ring)."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    for k in ("base_rps", "traced_rps"):
+        if not (num(doc.get(k)) and doc[k] > 0):
+            errors.append(f"tracing-overhead artifact: missing positive "
+                          f"{k!r}")
+    ov = doc.get("overhead_pct")
+    if not num(ov):
+        errors.append("tracing-overhead artifact: missing numeric "
+                      "'overhead_pct'")
+    elif ov > MAX_TRACING_OVERHEAD_PCT:
+        errors.append(
+            f"tracing overhead {ov:.2f}% exceeds the "
+            f"{MAX_TRACING_OVERHEAD_PCT:.0f}% acceptance bound — "
+            "trace-id minting + event emission is not hot-path free")
+    if not pos_int(doc.get("events_emitted")):
+        errors.append("tracing-overhead artifact: missing positive int "
+                      "'events_emitted' (the traced leg must actually "
+                      "emit flight-recorder events)")
+    if not pos_int(doc.get("spill_lines")):
+        errors.append("tracing-overhead artifact: missing positive int "
+                      "'spill_lines' (the traced leg must exercise the "
+                      "durable JSONL spill)")
+    if doc.get("path_reconstructed") is not True:
+        errors.append("tracing-overhead artifact: 'path_reconstructed' "
+                      "must be true — a sampled trace id must grep to "
+                      "admit/batch/dispatch/reply events in the spill")
     return errors
 
 

@@ -24,6 +24,7 @@ listing every violation. Wired into tier-1 via
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import sys
@@ -37,10 +38,13 @@ _CAMEL_RE = re.compile(r"^[a-z][a-zA-Z0-9]*$")
 _SNAKE_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
 
 #: JSON container fields whose keys are DATA (measured-thing names),
-#: not schema fields — their keys are exempt from camelCase
+#: not schema fields — their keys are exempt from camelCase.
+#: "objectives"/"alerts" are keyed by operator-chosen SLO/alert names;
+#: "attrs" holds span attributes (python identifiers, snake_case)
 DATA_KEYED = {"phases", "stages", "sizeHistogram", "buckets",
               "compileBuckets", "families", "sweep", "customParams",
-              "stageOverrides", "readerOverrides"}
+              "stageOverrides", "readerOverrides", "objectives",
+              "alerts", "attrs"}
 
 
 def check_json_doc(doc, where: str, _parent_key: str = "") -> list[str]:
@@ -172,6 +176,62 @@ def collect_violations() -> list[str]:
     out.extend(check_registry(build_registry(fleet=fleet,
                                              continuous=cont,
                                              include_app=False)))
+
+    # the SLO registry (round 10): transmogrifai_slo_* burn-rate gauges
+    # over a real engine fed a synthetic timeline (every collector
+    # closure renders real samples), plus the camelCase contract on the
+    # engine's status doc — the /healthz "slo" block and `cli slo` feed.
+    from transmogrifai_tpu.utils.slo import SLObjective, SLOEngine
+
+    engine = SLOEngine()
+    counts = {"v": (100, 1)}
+    engine.add(SLObjective(name="availability"),
+               counts_fn=lambda: counts["v"])
+    engine.add(SLObjective(name="p99-latency", kind="latency",
+                           threshold_s=0.25),
+               counts_fn=lambda: (90, 10))
+    engine.add(SLObjective(name="freshness", kind="staleness",
+                           bound_s=3600.0), value_fn=lambda: 120.5)
+    engine.observe(t=1000.0)
+    counts["v"] = (200, 5)
+    engine.observe(t=1060.0)
+    out.extend(check_registry(build_registry(serving=serving,
+                                             slo=engine,
+                                             include_app=False)))
+    out.extend(check_json_doc(engine.status(t=1060.0),
+                              "SLOEngine.status"))
+
+    # the flight recorder's exported surfaces: event JSONL documents and
+    # the dump-on-incident snapshot are JSON exports too — camelCase
+    # field keys (event kinds and trace ids are values, never keys)
+    import tempfile
+
+    from transmogrifai_tpu.utils.events import EventRing, dump_incident
+    from transmogrifai_tpu.utils import events as events_mod
+
+    ring = EventRing(maxlen=16)
+    ring.emit("serve.batch", trace_id="t1", rows=3,
+              traceIds=["t1", "t2"])
+    ring.emit("continuous.promoted", model="live", version="v2",
+              fingerprint="fp", window=3, stalenessSeconds=5.8)
+    for doc in ring.tail():
+        out.extend(check_json_doc(doc, "EventRing.event"))
+    out.extend(check_json_doc(ring.to_json(), "EventRing.to_json"))
+    with tempfile.TemporaryDirectory() as td:
+        saved = events_mod.events
+        try:
+            events_mod.events = ring
+            path = dump_incident(td, "lint_check",
+                                 scrape_fn=lambda: "# scrape",
+                                 extra={"windowSeq": 3})
+        finally:
+            events_mod.events = saved
+        if path is None:
+            out.append("dump_incident: write failed in lint")
+        else:
+            with open(path) as fh:
+                out.extend(check_json_doc(json.load(fh),
+                                          "dump_incident"))
     return out
 
 

@@ -394,3 +394,44 @@ def test_continuous_loop_artifact_committed_and_healthy(checker):
     assert c["rollbacks"] == 0
     assert art["requests"] > 0 and art["serving"]["errors"] == 0
     assert art["stream"]["rows"] == art["rows"]
+
+
+def test_tracing_overhead_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = {"metric": "tracing_overhead", "platform": "cpu",
+            "requests": 24576, "base_rps": 50000.0,
+            "traced_rps": 48500.0, "overhead_pct": 3.0,
+            "events_emitted": 2000, "spill_lines": 1990,
+            "path_reconstructed": True}
+    assert v(good) == []
+    assert any("5% acceptance bound" in e for e in v(
+        {**good, "overhead_pct": 5.1}))
+    assert v({**good, "overhead_pct": -1.2}) == []  # traced leg faster
+    assert any("overhead_pct" in e for e in v(
+        {k: x for k, x in good.items() if k != "overhead_pct"}))
+    assert any("base_rps" in e for e in v({**good, "base_rps": 0}))
+    assert any("events_emitted" in e for e in v(
+        {**good, "events_emitted": 0}))
+    assert any("spill_lines" in e for e in v(
+        {**good, "spill_lines": True}))
+    assert any("path_reconstructed" in e for e in v(
+        {**good, "path_reconstructed": False}))
+
+
+def test_tracing_overhead_artifact_committed_and_healthy(checker):
+    """The round-10 acceptance contract on the COMMITTED artifact:
+    request tracing + flight-recorder emission + durable spill cost the
+    serving hot path <= 5%, and the traced leg demonstrably traced (a
+    sampled id greps to its full batch -> dispatch -> reply path)."""
+    path = os.path.join(REPO, "benchmarks", "TRACING_OVERHEAD.json")
+    assert os.path.exists(path), \
+        "benchmarks/TRACING_OVERHEAD.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "tracing_overhead"
+    assert art["ok"] is True and art["notes"] == []
+    assert art["overhead_pct"] <= 5.0
+    assert art["traced_rps"] > 0 and art["base_rps"] > 0
+    assert len(art["overhead_trials_pct"]) == art["trials"] >= 3
+    assert art["events_emitted"] > 0 and art["spill_lines"] > 0
+    assert art["path_reconstructed"] is True

@@ -1105,6 +1105,34 @@ def test_continuous_shadow_gate_rejection_leaves_old_serving(tmp_path):
         st = LoopState(str(state), "live")
         assert st.totals["rollbacks"] == 1
         assert st.pending_retrain is None  # abandoned, not retried hot
+        # round 10 acceptance: the rejection froze the black box — ONE
+        # incident dump under state_dir holding the gate rejection, the
+        # drift trigger that caused the retrain, and the retrain lineage
+        inc_dir = state / "incidents"
+        dumps = sorted(os.listdir(inc_dir))
+        assert len(dumps) == 1 and "gate_rejected" in dumps[0]
+        with open(inc_dir / dumps[0]) as fh:
+            dump = json.load(fh)
+        assert dump["reason"] == "gate_rejected"
+        kinds = [e["kind"] for e in dump["events"]]
+        assert "fleet.gate_rejected" in kinds
+        assert "continuous.drift_trigger" in kinds
+        assert "continuous.retrain" in kinds
+        # newest matching event: the process-global ring may retain a
+        # gate rejection from an earlier test in the same process
+        gate = [e for e in dump["events"]
+                if e["kind"] == "fleet.gate_rejected"][-1]
+        assert gate["model"] == "live" and gate["maxAbsDiff"] > 0
+        assert dump["extra"]["retrain"]["windowSeq"] >= 1
+        assert dump["extra"]["maxAbsDiff"] == gate["maxAbsDiff"]
+        # the scrape snapshot rode along (fleet + continuous series)
+        assert "transmogrifai_continuous_rollbacks_total" \
+            in dump["metrics"]
+        # the durable spill holds the same story for a dead process:
+        # grep reconstructs it without any live ring
+        spill = (state / "events.jsonl").read_text()
+        assert '"fleet.gate_rejected"' in spill
+        assert '"continuous.drift_trigger"' in spill
     finally:
         loop.fleet.stop(drain=True)
 

@@ -1,0 +1,748 @@
+"""Round 10 observability: request-scoped trace propagation, the
+flight-recorder event ring (bounds / durable spill / dump-on-incident),
+and SLO burn-rate state transitions on synthetic timelines.
+
+The serving-path integration (a live fleet scoring over HTTP with trace
+headers and lineage) is covered in ``test_serving_fleet.py``; the
+forced shadow-gate incident dump rides the chaos suite's gate-rejection
+test. This module owns the unit/contract layer those e2e tests stand
+on.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils.events import EventRing, dump_incident
+from transmogrifai_tpu.utils import events as events_mod
+from transmogrifai_tpu.utils.tracing import new_trace_id, sanitize_trace_id
+
+
+@pytest.fixture()
+def ring():
+    """A clean PROCESS-GLOBAL ring per test (the serving code paths emit
+    into ``events_mod.events``), restored afterwards so other modules'
+    tests never see this module's history."""
+    saved_enabled = events_mod.events.enabled
+    events_mod.events.configure(spill_path=None)
+    events_mod.events.reset()
+    events_mod.events.enabled = True
+    yield events_mod.events
+    events_mod.events.configure(spill_path=None)
+    events_mod.events.reset()
+    events_mod.events.enabled = saved_enabled
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+def test_trace_ids_unique_and_well_formed():
+    ids = {new_trace_id() for _ in range(512)}
+    assert len(ids) == 512
+    for tid in list(ids)[:8]:
+        assert sanitize_trace_id(tid) == tid
+
+
+def test_sanitize_trace_id_rejects_hostile_input():
+    assert sanitize_trace_id("abc-123.X_z") == "abc-123.X_z"
+    assert sanitize_trace_id("  padded  ") == "padded"
+    for bad in (None, 17, "", "a" * 65, "with space", "crlf\r\ninject",
+                'quote"break', "semi;colon"):
+        assert sanitize_trace_id(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# trace propagation through the micro-batcher
+# ---------------------------------------------------------------------------
+
+def _drain_batcher(batcher, rows_with_ids, timeout_s=30):
+    futs = [batcher.submit(row, trace_id=tid)
+            for row, tid in rows_with_ids]
+    out = []
+    for f in futs:
+        try:
+            out.append(f.result(timeout=timeout_s))
+        except Exception as e:  # noqa: BLE001 — failure paths under test
+            out.append(e)
+    return out
+
+
+def test_batcher_records_batch_dispatch_reply_for_traced(ring):
+    from transmogrifai_tpu.serving.batcher import MicroBatcher
+
+    with MicroBatcher(lambda rows: [dict(r) for r in rows],
+                      max_batch=8, max_wait_ms=1.0) as b:
+        tids = [new_trace_id() for _ in range(6)]
+        _drain_batcher(b, [({"k": i}, t) for i, t in enumerate(tids)])
+    probe = tids[3]
+    kinds = [d["kind"] for d in ring.find(probe)]
+    # the acceptance path: fan-in -> dispatch -> reply, one grep each
+    assert {"serve.batch", "serve.dispatch", "serve.reply"} <= set(kinds)
+    reply = [d for d in ring.find(probe) if d["kind"] == "serve.reply"][0]
+    # columnar alignment: latenciesMs[i] belongs to traceIds[i]
+    assert len(reply["traceIds"]) == len(reply["latenciesMs"])
+    assert reply["failedIds"] == []
+    i = reply["traceIds"].index(probe)
+    assert reply["latenciesMs"][i] > 0
+    batch = [d for d in ring.find(probe) if d["kind"] == "serve.batch"][0]
+    assert batch["rows"] >= len(batch["traceIds"]) >= 1
+
+
+def test_batcher_untraced_requests_emit_nothing(ring):
+    from transmogrifai_tpu.serving.batcher import MicroBatcher
+
+    with MicroBatcher(lambda rows: list(rows), max_batch=4,
+                      max_wait_ms=1.0) as b:
+        futs = [b.submit({"k": i}) for i in range(5)]
+        for f in futs:
+            f.result(timeout=30)
+    assert [d for d in ring.tail()
+            if d["kind"].startswith("serve.")] == []
+
+
+def test_batcher_failed_dispatch_lands_in_failed_ids(ring):
+    from transmogrifai_tpu.serving.batcher import MicroBatcher
+
+    def explode(rows):
+        raise RuntimeError("injected batch failure")
+
+    with MicroBatcher(explode, max_batch=4, max_wait_ms=1.0) as b:
+        tid = new_trace_id()
+        results = _drain_batcher(b, [({"k": 1}, tid)])
+    assert isinstance(results[0], RuntimeError)
+    reply = [d for d in ring.find(tid) if d["kind"] == "serve.reply"][0]
+    assert tid in reply["failedIds"]
+
+
+def test_batcher_expired_traced_request_emits_expiry(ring):
+    from transmogrifai_tpu.serving.batcher import MicroBatcher
+
+    release = threading.Event()
+
+    def slow(rows):
+        release.wait(10)
+        return list(rows)
+
+    b = MicroBatcher(slow, max_batch=1, max_wait_ms=0.0,
+                     queue_capacity=8)
+    with b:
+        first = b.submit({"k": 0})           # occupies the worker
+        tid = new_trace_id()
+        doomed = b.submit({"k": 1}, timeout_ms=1.0, trace_id=tid)
+        time.sleep(0.05)                     # deadline passes in queue
+        release.set()
+        first.result(timeout=30)
+        with pytest.raises(Exception):
+            doomed.result(timeout=30)
+    expired = [d for d in ring.tail() if d["kind"] == "serve.expired"]
+    assert expired and tid in expired[0]["traceIds"]
+
+
+def _tiny_model():
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector,
+    )
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(5)
+    n = 120
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = (x1 - 0.5 * x2 + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=7, models_and_parameters=[
+            (OpLogisticRegression(max_iter=10), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i])} for i in range(16)]
+    return model, rows
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_model()
+
+
+def test_degraded_row_path_keeps_trace_flow(tiny, ring):
+    """The compiled path dies; requests fall back to the row path with
+    zero drops — and their trace events keep flowing exactly as on the
+    healthy path (an incident is when tracing matters MOST)."""
+    import warnings
+
+    from transmogrifai_tpu.serving import ScoringServer
+
+    model, rows = tiny
+    srv = ScoringServer(model, max_batch=8, max_wait_ms=1.0,
+                        queue_capacity=64, retries=0,
+                        probe_interval_s=60.0)
+    srv.scorer.score_batch = lambda _rows: (_ for _ in ()).throw(
+        RuntimeError("UNAVAILABLE: injected"))
+    tids = [new_trace_id() for _ in range(6)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with srv:
+            futs = [srv.submit(r, trace_id=t)
+                    for r, t in zip(rows, tids)]
+            results = [f.result(timeout=60) for f in futs]
+    assert all(r is not None for r in results)
+    assert srv.metrics.degraded_entries >= 1
+    entered = [d for d in ring.tail()
+               if d["kind"] == "serving.degraded_enter"]
+    assert entered and "injected" in entered[0]["error"]
+    probe = tids[-1]
+    kinds = {d["kind"] for d in ring.find(probe)}
+    assert {"serve.batch", "serve.dispatch", "serve.reply"} <= kinds
+    reply = [d for d in ring.find(probe)
+             if d["kind"] == "serve.reply"][0]
+    assert probe not in reply["failedIds"]  # degraded still answered
+
+
+# ---------------------------------------------------------------------------
+# trace context at HTTP ingress
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def http_server(ring):
+    """A MetricsServer over a stub score_fn that records the trace id it
+    was handed (the fleet adapter contract)."""
+    from transmogrifai_tpu.serving.http import MetricsServer
+
+    seen = {}
+
+    def score_fn(model_id, row, trace_id=None):
+        seen["model_id"], seen["trace_id"] = model_id, trace_id
+        if row.get("boom"):
+            raise ValueError("bad row")
+        return {"p": 0.5, "traceId": trace_id}
+
+    srv = MetricsServer(render_fn=lambda: "# empty\n",
+                        health_fn=lambda: {"status": "ok"},
+                        score_fn=score_fn, port=0,
+                        access_log_sample=1.0).start()
+    yield srv, seen
+    srv.stop()
+
+
+def _post(port, path, doc, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(), method="POST",
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_mints_trace_id_and_echoes_header(http_server):
+    srv, seen = http_server
+    status, headers, doc = _post(srv.port, "/score", {"x": 1})
+    assert status == 200
+    minted = headers["X-Trace-Id"]
+    assert sanitize_trace_id(minted) == minted
+    assert seen["trace_id"] == minted     # score_fn saw the same id
+    assert doc["traceId"] == minted
+
+
+def test_http_honors_inbound_trace_header(http_server):
+    srv, seen = http_server
+    status, headers, doc = _post(srv.port, "/score", {"x": 1},
+                                 {"X-Trace-Id": "caller-trace.01"})
+    assert status == 200
+    assert headers["X-Trace-Id"] == "caller-trace.01"
+    assert seen["trace_id"] == "caller-trace.01"
+
+
+def test_http_replaces_hostile_inbound_trace_header(http_server):
+    srv, seen = http_server
+    status, headers, _ = _post(srv.port, "/score", {"x": 1},
+                               {"X-Trace-Id": "evil header"})
+    assert status == 200
+    minted = headers["X-Trace-Id"]
+    assert minted != "evil header"
+    assert sanitize_trace_id(minted) == minted
+
+
+def test_http_error_replies_carry_trace_context(http_server):
+    srv, _ = http_server
+    status, headers, doc = _post(srv.port, "/score", {"boom": 1},
+                                 {"X-Trace-Id": "err-trace"})
+    assert status == 400
+    assert headers["X-Trace-Id"] == "err-trace"
+    assert doc["traceId"] == "err-trace"
+    assert "bad row" in doc["error"]
+
+
+def test_http_access_log_sampled_events(http_server, ring):
+    srv, _ = http_server
+    _post(srv.port, "/score", {"x": 1}, {"X-Trace-Id": "acc-1"})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=10):
+        pass
+    access = [d for d in ring.tail() if d["kind"] == "http.access"]
+    assert any(d.get("traceId") == "acc-1" and d["method"] == "POST"
+               and d["status"] == 200 and d["durationMs"] >= 0
+               for d in access)
+    assert any(d["path"] == "/healthz" and d["method"] == "GET"
+               for d in access)
+
+
+def test_http_access_log_off_by_default(ring):
+    from transmogrifai_tpu.serving.http import MetricsServer
+
+    srv = MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                        port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10):
+            pass
+    finally:
+        srv.stop()
+    assert [d for d in ring.tail() if d["kind"] == "http.access"] == []
+
+
+# ---------------------------------------------------------------------------
+# event ring: bounds, spill, rate limiting, incident dumps
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_keeps_newest_and_counts_drops():
+    r = EventRing(maxlen=4)
+    for i in range(10):
+        r.emit("k", seq=i)
+    assert len(r) == 4
+    assert [d["seq"] for d in r.tail()] == [6, 7, 8, 9]
+    assert r.emitted == 10 and r.dropped == 6
+    assert [d["seq"] for d in r.tail(2)] == [8, 9]
+    r.reset()
+    assert len(r) == 0 and r.emitted == 0 and r.dropped == 0
+
+
+def test_ring_disabled_emits_nothing():
+    r = EventRing(maxlen=4)
+    r.enabled = False
+    r.emit("k", x=1)
+    assert len(r) == 0 and r.emitted == 0
+
+
+def test_ring_spill_is_greppable_jsonl(tmp_path):
+    r = EventRing(maxlen=8)
+    spill = str(tmp_path / "state" / "events.jsonl")
+    r.configure(spill_path=spill)  # parent dirs created on demand
+    r.emit("fleet.swap", model="live", toVersion="v2")
+    r.emit("serve.batch", traceIds=["t-abc", "t-def"], rows=2)
+    r.flush()
+    lines = [json.loads(ln) for ln in open(spill)]
+    assert [d["kind"] for d in lines] == ["fleet.swap", "serve.batch"]
+    assert all("ts" in d for d in lines)
+    assert r.spilled == 2
+    # ring eviction never touches what already spilled
+    for i in range(20):
+        r.emit("filler", seq=i)
+    r.close()
+    assert sum(1 for _ in open(spill)) == 22
+    # the acceptance grep: one id finds its record post-process
+    assert any("t-abc" in ln for ln in open(spill))
+
+
+def test_ring_spill_background_writer_drains_without_flush(tmp_path):
+    spill = str(tmp_path / "ev.jsonl")
+    r = EventRing(maxlen=64)
+    r.configure(spill_path=spill, flush_every=4)
+    for i in range(8):   # two full writer batches
+        r.emit("k", seq=i)
+    deadline = time.monotonic() + 5
+    while r.spilled < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert r.spilled >= 8          # spilled by the WRITER thread
+    r.close()
+
+
+def test_ring_find_matches_ids_inside_member_lists():
+    r = EventRing(maxlen=16)
+    r.emit("serve.batch", traceIds=["a1", "b2"])
+    r.emit("serve.reply", traceIds=["a1"], latenciesMs=[3.5],
+           failedIds=[])
+    r.emit("serve.admitted", trace_id="a1")
+    r.emit("other", traceIds=["zz"])
+    kinds = sorted(d["kind"] for d in r.find("a1"))
+    assert kinds == ["serve.admitted", "serve.batch", "serve.reply"]
+    assert r.find("nope") == []
+
+
+def test_emit_limited_suppresses_and_reports_volume():
+    r = EventRing(maxlen=16)
+    assert r.emit_limited("bp", 60.0, "serving.backpressure_reject",
+                          queueDepth=9)
+    for _ in range(5):
+        assert not r.emit_limited("bp", 60.0,
+                                  "serving.backpressure_reject")
+    assert r.suppressed == 5
+    assert len(r) == 1
+    # a different key has its own budget
+    assert r.emit_limited("other", 60.0, "k")
+    # when the window reopens, the next event carries the count
+    r._limits["bp"][0] -= 120.0
+    assert r.emit_limited("bp", 60.0, "serving.backpressure_reject")
+    last = r.tail()[-1]
+    assert last["suppressedSince"] == 5
+
+
+def test_dump_incident_freezes_events_spans_and_scrape(tmp_path, ring):
+    from transmogrifai_tpu.utils.tracing import recorder, span
+
+    ring.emit("continuous.drift_trigger", model="live", window=3)
+    ring.emit("fleet.gate_rejected", model="live", maxAbsDiff=0.5)
+    recorder.reset()
+    with span("continuous.retrain", window=3):
+        pass
+    path = dump_incident(str(tmp_path), "gate_rejected",
+                         scrape_fn=lambda: "# HELP x\nx 1\n",
+                         extra={"modelId": "live"})
+    assert path is not None and os.path.exists(path)
+    assert os.sep + "incidents" + os.sep in path
+    doc = json.load(open(path))
+    assert doc["reason"] == "gate_rejected"
+    assert doc["extra"]["modelId"] == "live"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "continuous.drift_trigger" in kinds
+    assert "fleet.gate_rejected" in kinds
+    assert any(s["name"] == "continuous.retrain" for s in doc["spans"])
+    assert doc["metrics"].startswith("# HELP")
+
+
+def test_dump_incident_survives_broken_scrape(tmp_path, ring):
+    ring.emit("k")
+
+    def broken():
+        raise RuntimeError("collector died")
+
+    path = dump_incident(str(tmp_path), "weird reason/with:chars",
+                         scrape_fn=broken)
+    doc = json.load(open(path))
+    assert "collector died" in doc["metricsError"]
+    assert "/" not in os.path.basename(path).replace(".json", "")
+
+
+def test_dump_incident_returns_none_on_unwritable_dir(tmp_path, ring):
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    # dir_path/incidents cannot be created under a regular file
+    assert dump_incident(str(blocker), "r") is None
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: burn-rate alerts over synthetic timelines
+# ---------------------------------------------------------------------------
+
+def _availability_engine(windows=None):
+    from transmogrifai_tpu.utils.slo import SLObjective, SLOEngine
+
+    state = {"good": 0, "bad": 0}
+    obj = SLObjective(name="avail", target=0.999,
+                      **({"windows": windows} if windows else {}))
+    eng = SLOEngine().add(obj, counts_fn=lambda: (state["good"],
+                                                  state["bad"]))
+    return eng, state
+
+
+def test_burn_rate_fast_alert_fires_and_clears():
+    eng, state = _availability_engine()
+    t = 1000.0
+    # an hour of healthy traffic fills the long window with good deltas
+    for k in range(60):
+        state["good"] += 1000
+        eng.observe(t=t + k * 60.0)
+    t += 3600.0
+    doc = eng.evaluate(t=t)["avail"]
+    assert doc["firing"] is False
+    assert doc["alerts"]["fast"]["burn"]["short"] == 0.0
+    # a 5-minute 100% outage: burn >> 14.4 on the short window, and the
+    # hour-long window crosses too (300/3600 > 1.44% >> budget 0.1%)
+    for k in range(5):
+        state["bad"] += 1000
+        eng.observe(t=t + k * 60.0)
+    t += 300.0
+    doc = eng.evaluate(t=t)["avail"]
+    assert doc["alerts"]["fast"]["firing"] is True
+    assert doc["alerts"]["fast"]["burn"]["short"] > 14.4
+    assert doc["firing"] is True
+    # recovery: half an hour of clean traffic drains the short window;
+    # fast stops paging even though the long window still remembers
+    for k in range(30):
+        state["good"] += 1000
+        eng.observe(t=t + k * 60.0)
+    t += 1800.0
+    doc = eng.evaluate(t=t)["avail"]
+    assert doc["alerts"]["fast"]["firing"] is False
+
+
+def test_burn_rate_needs_both_windows_over():
+    """A single bad scrape spikes the short window but not the long one:
+    no page (the whole point of multi-window burn rates)."""
+    eng, state = _availability_engine()
+    t = 5000.0
+    for k in range(60):
+        state["good"] += 1000
+        eng.observe(t=t + k * 60.0)
+    t += 3600.0
+    state["bad"] += 30          # one blip: 30 errors in one minute
+    eng.observe(t=t + 60.0)
+    doc = eng.evaluate(t=t + 120.0)["avail"]
+    assert doc["alerts"]["fast"]["burn"]["short"] > 14.4
+    assert doc["alerts"]["fast"]["firing"] is False  # long window calm
+    assert doc["firing"] is False
+
+
+def test_no_traffic_means_no_alert():
+    eng, _ = _availability_engine()
+    doc = eng.evaluate(t=123.0)["avail"]
+    assert doc["firing"] is False
+    assert doc["alerts"]["fast"]["burn"] == {"short": 0.0, "long": 0.0}
+
+
+def test_counter_reset_reads_as_zero_not_negative_traffic():
+    eng, state = _availability_engine()
+    state["good"], state["bad"] = 5000, 10
+    eng.observe(t=100.0)
+    # hot-swap rebases the sum: good drops, bad "survives" at 15 — the
+    # interval must record NO traffic, not a phantom error-only sample
+    state["good"], state["bad"] = 40, 15
+    eng.observe(t=160.0)
+    b = eng._bound[0]
+    assert list(b.samples)[-1] == (160.0, 0, 0)
+    assert all(dg >= 0 and db >= 0 for _, dg, db in b.samples)
+    # the rebased totals are the new baseline: traffic resumes normally
+    state["good"], state["bad"] = 140, 16
+    eng.observe(t=220.0)
+    assert list(b.samples)[-1] == (220.0, 100, 1)
+
+
+def test_latency_objective_judged_at_bucket_boundary():
+    from transmogrifai_tpu.utils.slo import _histogram_counts
+
+    hist = {"count": 100,
+            "buckets": {"0.005": 60, "0.01": 90, "0.025": 97,
+                        "+Inf": 100}}
+    # threshold 0.008 snaps UP to the 0.01 bucket: 90 good / 10 bad
+    assert _histogram_counts(hist, 0.008) == (90, 10)
+    assert _histogram_counts(hist, 0.025) == (97, 3)
+    # threshold above every finite bucket: judged at the LARGEST finite
+    # bound — the +Inf tail is unmeasured, not compliant-by-default
+    assert _histogram_counts(hist, 10.0) == (97, 3)
+    assert _histogram_counts({"count": 5, "buckets": {}}, 1.0) == (5, 0)
+
+
+def test_staleness_objective_fires_past_bound():
+    from transmogrifai_tpu.utils.slo import SLObjective, SLOEngine
+
+    val = {"s": 100.0}
+    eng = SLOEngine().add(
+        SLObjective(name="fresh", kind="staleness", bound_s=3600.0),
+        value_fn=lambda: val["s"])
+    doc = eng.evaluate(t=0.0)["fresh"]
+    assert doc["firing"] is False
+    val["s"] = 4000.0
+    doc = eng.evaluate(t=1.0)["fresh"]
+    assert doc["firing"] is True
+    health = eng.health(t=2.0)
+    assert health["ok"] is False and health["fastBurnFiring"] is True
+
+
+def test_objectives_from_json_parses_config_shapes():
+    from transmogrifai_tpu.utils.slo import objectives_from_json
+
+    objs = objectives_from_json({"objectives": [
+        {"name": "availability", "kind": "availability",
+         "target": 0.999},
+        {"name": "p99", "kind": "latency", "target": 0.99,
+         "thresholdMs": 250,
+         "windows": {"fast": [60, 600, 10.0]}},
+        {"name": "fresh", "kind": "staleness", "boundS": 3600},
+    ]})
+    assert [o.name for o in objs] == ["availability", "p99", "fresh"]
+    assert objs[1].threshold_s == pytest.approx(0.25)
+    assert objs[1].windows["fast"].factor == 10.0
+    assert objs[2].bound_s == 3600.0
+    with pytest.raises(ValueError, match="kind"):
+        objectives_from_json([{"name": "x", "kind": "nonsense"}])
+    with pytest.raises(ValueError, match="threshold_s"):
+        objectives_from_json([{"name": "x", "kind": "latency"}])
+    with pytest.raises(ValueError, match="target"):
+        objectives_from_json([{"name": "x", "target": 1.5}])
+
+
+def test_slo_gauges_render_on_metrics_endpoint():
+    from transmogrifai_tpu.utils.prometheus import build_registry
+
+    eng, state = _availability_engine()
+    state["good"] = 100
+    eng.observe(t=10.0)
+    state["good"], state["bad"] = 190, 10
+    eng.observe(t=70.0)
+    body = build_registry(slo=eng, include_app=False).render()
+    assert 'transmogrifai_slo_target{slo="avail"} 0.999' in body
+    assert 'transmogrifai_slo_burn_rate{alert="fast",slo="avail",' \
+           'window="short"}' in body
+    assert 'transmogrifai_slo_alert_firing{alert="fast",slo="avail"}' \
+           in body
+    assert "transmogrifai_slo_evaluations_total" in body
+    # every registry now also carries build provenance + uptime + the
+    # flight recorder's own accounting (satellite: fleet correlation)
+    assert "transmogrifai_build_info{" in body
+    assert "transmogrifai_process_uptime_seconds" in body
+    assert "transmogrifai_events_emitted_total" in body
+
+
+def test_server_healthz_readiness_flips_on_fast_burn(tiny):
+    """A firing fast-burn alert drops ``ready`` (load-balancer signal)
+    even while the server itself is healthy."""
+    from transmogrifai_tpu.serving import ScoringServer
+    from transmogrifai_tpu.utils.slo import SLObjective, SLOEngine
+
+    model, rows = tiny
+    state = {"good": 0, "bad": 0}
+    eng = SLOEngine().add(
+        SLObjective(name="avail", target=0.999),
+        counts_fn=lambda: (state["good"], state["bad"]))
+    srv = ScoringServer(model, max_batch=4, queue_capacity=16, slo=eng)
+    assert srv.slo_engine is eng
+    with srv:
+        srv.score(rows[0], timeout_s=30)
+        # health() evaluates at wall-clock now, so the synthetic
+        # timeline anchors to it; the engine's own throttled
+        # self-observe is parked so it can't append a live sample
+        eng.min_observe_interval_s = 1e9
+        eng._last_observe = time.monotonic()
+        now = time.time()
+        for k in range(60):     # a healthy hour ending just now
+            state["good"] += 500
+            eng.observe(t=now - 3600.0 + k * 60.0)
+        h = srv.health()
+        assert h["ready"] is True and h["status"] == "ok"
+        for k in range(4):      # 100%-error burst inside the 5m window
+            state["bad"] += 500
+            eng.observe(t=now - 240.0 + k * 60.0)
+        h = srv.health()
+        assert h["slo"]["fastBurnFiring"] is True
+        assert h["ready"] is False and h["status"] == "slo_burning"
+
+
+def test_for_serving_skips_staleness_without_source():
+    """A staleness objective in a plain serving daemon's --slo config is
+    skipped with a warning, not a startup crash — one objectives file
+    stays shareable between `cli serve` and `cli continuous`."""
+    from transmogrifai_tpu.utils.slo import SLOEngine
+
+    with pytest.warns(RuntimeWarning, match="staleness objective ignored"):
+        eng = SLOEngine.for_serving(
+            [{"name": "avail", "kind": "availability"},
+             {"name": "fresh", "kind": "staleness", "boundS": 60}],
+            lambda: [])
+    assert [o.name for o in eng.objectives] == ["avail"]
+
+
+def test_wall_clock_evaluate_memoized_until_new_observation():
+    """Health probes (t=None) must not re-walk the sample windows per
+    hit: the result is memoized until an observation records."""
+    eng, state = _availability_engine()
+    eng.min_observe_interval_s = 1e9     # park the self-observe
+    eng._last_observe = time.monotonic()
+    d1 = eng.evaluate()
+    n = eng.evaluations
+    assert eng.evaluate() is d1 and eng.evaluations == n
+    state["good"] += 10
+    eng.observe(t=time.time())           # new data invalidates the memo
+    assert eng.evaluate() is not d1
+
+
+def test_custom_named_alert_still_flips_readiness():
+    """Page severity is positional (the objective's fastest-detection
+    alert), not keyed to the literal name 'fast' — an operator-named
+    window set must shed traffic the same way."""
+    from transmogrifai_tpu.utils.slo import (
+        BurnWindow, SLObjective, SLOEngine,
+    )
+
+    state = {"good": 0, "bad": 0}
+    eng = SLOEngine().add(
+        SLObjective(name="avail", target=0.999,
+                    windows={"page": BurnWindow(300.0, 3600.0, 14.4),
+                             "ticket": BurnWindow(1800.0, 21600.0, 6.0)}),
+        counts_fn=lambda: (state["good"], state["bad"]))
+    t = 1000.0
+    for k in range(60):
+        state["good"] += 1000
+        eng.observe(t=t + k * 60.0)
+    t += 3600.0
+    for k in range(5):           # hard outage: both page windows burn
+        state["bad"] += 1000
+        eng.observe(t=t + k * 60.0)
+    s = eng.status(t=t + 300.0)
+    assert s["objectives"]["avail"]["alerts"]["page"]["firing"] is True
+    assert s["fastBurnFiring"] is True and s["fastFiring"] == ["avail"]
+
+
+def test_first_observation_baselines_without_backlog_sample():
+    """Hours of pre-monitoring history must not land as one delta
+    stamped 'now' — a long-resolved outage would fire the burn alerts
+    and shed a currently-healthy endpoint."""
+    eng, state = _availability_engine()
+    state["good"], state["bad"] = 1000, 900   # ugly history, resolved
+    eng.observe(t=50_000.0)                   # first contact: baseline
+    doc = eng.evaluate(t=50_001.0)["avail"]
+    assert doc["firing"] is False
+    assert doc["alerts"]["fast"]["burn"]["short"] == 0.0
+    # live traffic from here on is measured normally
+    state["good"] += 100
+    eng.observe(t=50_060.0)
+    b = eng._bound[0]
+    assert list(b.samples)[-1] == (50_060.0, 100, 0)
+    # a scrape outage longer than every window rebaselines too
+    state["good"] += 5000
+    state["bad"] += 5000
+    eng.observe(t=50_060.0 + 25_000.0)        # > 6h slow long window
+    assert list(b.samples)[-1] == (75_060.0, 0, 0)
+
+
+def test_retired_model_does_not_flip_fleet_readiness(tiny):
+    """An unloaded (audit-only) registry entry colors the fleet status
+    word but must not shed traffic from healthy lanes."""
+    from transmogrifai_tpu.serving import FleetServer
+
+    model, rows = tiny
+    fleet = FleetServer(max_batch=4, queue_capacity=16)
+    fleet.register(model=model, model_id="alpha")
+    fleet.register(model=model, model_id="retired")
+    fleet.start(warmup_rows={"alpha": rows[0], "retired": rows[0]})
+    try:
+        fleet.registry.unload("retired")     # keeps the audit entry
+        h = fleet.health()
+        assert h["models"]["retired"]["state"] == "unloaded"
+        assert h["status"] == "unloaded"     # status names the worst
+        assert h["ready"] is True            # but alpha still serves
+        fleet.registry.unload("alpha")
+        assert fleet.health()["ready"] is False   # nothing active left
+    finally:
+        fleet.stop(drain=False)

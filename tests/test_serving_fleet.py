@@ -99,7 +99,7 @@ def test_http_score_timeout_maps_to_504():
 
     from transmogrifai_tpu.serving.http import MetricsServer
 
-    def slow_score(_mid, _row):
+    def slow_score(_mid, _row, _trace_id=None):
         raise FutureTimeout()
 
     srv = MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
@@ -634,7 +634,14 @@ def test_fleet_http_health_metrics_and_scoring(zoo):
         conn.request("POST", "/score/alpha", json.dumps(zoo["rows_a"][0]))
         resp = conn.getresponse()
         assert resp.status == 200
+        assert resp.getheader("X-Trace-Id")  # minted at ingress
         doc = json.loads(resp.read())
+        # round 10: responses carry trace context + model lineage on top
+        # of the score fields — strip them before the parity diff
+        assert doc.pop("traceId") == resp.getheader("X-Trace-Id")
+        lineage = doc.pop("lineage")
+        assert lineage["modelId"] == "alpha" \
+            and lineage["version"] == "v1" and lineage["fingerprint"]
         row_a = zoo["alpha"].score_function()
         assert _diff(row_a(zoo["rows_a"][0]), doc) < 1e-4
         conn.request("POST", "/score",

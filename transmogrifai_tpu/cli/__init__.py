@@ -36,6 +36,7 @@ def main(argv=None) -> int:
     )
     from transmogrifai_tpu.cli.profile import add_profile_args, run_profile
     from transmogrifai_tpu.cli.serve import add_serve_args, run_serve
+    from transmogrifai_tpu.cli.slo import add_slo_args, run_slo
     add_serve_args(sub.add_parser(
         "serve", help="online micro-batched scoring over a saved model "
                       "(jsonl/csv in, jsonl scores out)"))
@@ -47,6 +48,9 @@ def main(argv=None) -> int:
         "profile", help="score a dataset under full tracing; emit a "
                         "Perfetto/chrome://tracing JSON + slowest-stages "
                         "table"))
+    add_slo_args(sub.add_parser(
+        "slo", help="SLO burn-rate status of a running serve/continuous "
+                    "daemon (scrapes its /healthz + /metrics)"))
     args = ap.parse_args(argv)
 
     if args.command == "shell":
@@ -58,6 +62,8 @@ def main(argv=None) -> int:
         return run_continuous(args)
     if args.command == "profile":
         return run_profile(args)
+    if args.command == "slo":
+        return run_slo(args)
     if args.command == "gen":
         path = generate_project(
             name=args.name, input_path=args.input, id_col=args.id_col,

@@ -93,6 +93,20 @@ def add_continuous_args(sp: argparse.ArgumentParser) -> None:
     sp.add_argument("--report", default=None,
                     help="write the final loop report JSON here "
                          "(always printed to stdout)")
+    sp.add_argument("--trace-out", default=None,
+                    help="export the daemon's span ring as a Perfetto/"
+                         "chrome://tracing JSON on shutdown")
+    sp.add_argument("--access-log-sample", type=float, default=0.0,
+                    help="fraction of HTTP requests emitted as "
+                         "structured http.access events (0 = off)")
+    sp.add_argument("--slo", default=None, dest="slo_path",
+                    help="SLO objectives JSON; a staleness objective is "
+                         "implied by --staleness-bound-s. Exports "
+                         "transmogrifai_slo_* and folds fast-burn "
+                         "alerts into /healthz readiness")
+    sp.add_argument("--no-events-spill", action="store_true",
+                    help="disable the durable flight-recorder spill "
+                         "(state_dir/events.jsonl; on by default)")
 
 
 def _load_workflow(spec: str):
@@ -112,9 +126,13 @@ def _load_workflow(spec: str):
 
 
 def run_continuous(args: argparse.Namespace) -> int:
+    from transmogrifai_tpu.cli.serve import (
+        _observability_setup, _observability_teardown,
+    )
     from transmogrifai_tpu.continuous import ContinuousLoop, DriftConfig
     from transmogrifai_tpu.workflow import load_model
 
+    slo = _observability_setup(args, "transmogrifai_tpu.continuous")
     workflow = _load_workflow(args.workflow)
     initial_model = load_model(args.model) if args.model else None
     drift = DriftConfig(
@@ -143,12 +161,17 @@ def run_continuous(args: argparse.Namespace) -> int:
         shadow_tolerance=args.shadow_tolerance,
         staleness_bound_s=args.staleness_bound_s,
         metrics_port=args.metrics_port, metrics_host=args.metrics_host,
+        access_log_sample=args.access_log_sample, slo=slo,
+        events_spill=not args.no_events_spill,
         on_started=announce)
     print(f"# continuous loop: watching {args.stream_dir!r} "
           f"(pattern {args.pattern!r}), serving model id "
           f"{args.model_id!r}, state under {args.state_dir!r}",
           file=sys.stderr)
-    report = loop.run()
+    try:
+        report = loop.run()
+    finally:
+        _observability_teardown(args)
     print(json.dumps(report, indent=2, default=str))
     if args.report:
         with open(args.report, "w") as fh:

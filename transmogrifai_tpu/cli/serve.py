@@ -72,6 +72,24 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                     help="bind address for the scrape endpoint (use "
                          "0.0.0.0 for an external scraper; default "
                          "loopback)")
+    sp.add_argument("--trace-out", default=None,
+                    help="export the span ring as a Perfetto/"
+                         "chrome://tracing JSON on shutdown (the "
+                         "long-running-daemon analog of runner "
+                         "--trace-out)")
+    sp.add_argument("--access-log-sample", type=float, default=0.0,
+                    help="fraction of HTTP requests emitted as "
+                         "structured http.access events through the "
+                         "flight recorder (0 = off, default)")
+    sp.add_argument("--slo", default=None, dest="slo_path",
+                    help="SLO objectives JSON (docs/OBSERVABILITY.md "
+                         "'SLOs'): exports transmogrifai_slo_* burn-rate "
+                         "series and folds firing fast-burn alerts into "
+                         "/healthz readiness")
+    sp.add_argument("--events-out", default=None,
+                    help="spill flight-recorder events to this JSONL "
+                         "file (grep a trace id to reconstruct a "
+                         "request's path)")
 
 
 def _read_rows(path: str) -> Iterable[dict]:
@@ -92,6 +110,42 @@ def _read_rows(path: str) -> Iterable[dict]:
                 yield json.loads(line)
 
 
+def _observability_setup(args, app_name: str):
+    """Shared serve/continuous daemon observability plumbing: start a
+    profiled session for ``--trace-out``, point the flight-recorder
+    spill at ``--events-out``, load ``--slo`` objectives. Returns the
+    parsed objectives (or None)."""
+    if getattr(args, "trace_out", None):
+        from transmogrifai_tpu.utils.profiling import profiler
+        profiler.reset(app_name=app_name)
+    if getattr(args, "events_out", None):
+        from transmogrifai_tpu.utils.events import events
+        events.configure(spill_path=args.events_out)
+    slo = None
+    if getattr(args, "slo_path", None):
+        from transmogrifai_tpu.utils.slo import load_objectives
+        slo = load_objectives(args.slo_path)
+    return slo
+
+
+def _observability_teardown(args) -> None:
+    """Flush the spill; export the daemon's span ring as a chrome trace."""
+    if getattr(args, "events_out", None):
+        from transmogrifai_tpu.utils.events import events
+        events.flush()
+    if getattr(args, "trace_out", None):
+        from transmogrifai_tpu.utils.profiling import profiler
+        try:
+            summary = profiler.finalize().export_chrome_trace(
+                args.trace_out)
+            print(f"# trace -> {args.trace_out} ({json.dumps(summary)}); "
+                  "open at chrome://tracing or https://ui.perfetto.dev",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — a failed export must not fail the run
+            print(f"# trace export failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
 def run_serve(args: argparse.Namespace) -> int:
     from transmogrifai_tpu.serving import ScoringServer
     from transmogrifai_tpu.workflow import load_model
@@ -100,14 +154,16 @@ def run_serve(args: argparse.Namespace) -> int:
         print("serve: pass exactly one of --model (single model) or "
               "--model-dir (fleet)", file=sys.stderr)
         return 2
+    slo = _observability_setup(args, "transmogrifai_tpu.serve")
     if args.model_dir is not None:
-        return _run_serve_fleet(args)
+        return _run_serve_fleet(args, slo)
     model = load_model(args.model)
     server = ScoringServer(
         model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_capacity=args.queue_capacity,
         default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
-        metrics_port=args.metrics_port, metrics_host=args.metrics_host)
+        metrics_port=args.metrics_port, metrics_host=args.metrics_host,
+        access_log_sample=args.access_log_sample, slo=slo)
 
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     t0 = time.monotonic()
@@ -154,6 +210,7 @@ def run_serve(args: argparse.Namespace) -> int:
         server.stop()
         if out is not sys.stdout:
             out.close()
+        _observability_teardown(args)
     wall = time.monotonic() - t0
     snap = server.snapshot()
     if args.metrics:
@@ -167,7 +224,7 @@ def run_serve(args: argparse.Namespace) -> int:
     return 0
 
 
-def _run_serve_fleet(args: argparse.Namespace) -> int:
+def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
     """``--model-dir`` mode: many registered models, per-row routing."""
     from transmogrifai_tpu.serving import FleetServer, UnknownModelError
 
@@ -176,7 +233,8 @@ def _run_serve_fleet(args: argparse.Namespace) -> int:
         queue_capacity=args.queue_capacity,
         default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
         route_field=args.model_field,
-        metrics_port=args.metrics_port, metrics_host=args.metrics_host)
+        metrics_port=args.metrics_port, metrics_host=args.metrics_host,
+        access_log_sample=args.access_log_sample, slo=slo)
     entries = fleet.register_dir(args.model_dir)
     if not entries:
         print(f"serve: no saved models (model.json) under "
@@ -249,6 +307,7 @@ def _run_serve_fleet(args: argparse.Namespace) -> int:
         fleet.stop()
         if out is not sys.stdout:
             out.close()
+        _observability_teardown(args)
     wall = time.monotonic() - t0
     if args.metrics:
         with open(args.metrics, "w") as fh:

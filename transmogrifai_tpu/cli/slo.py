@@ -1,0 +1,141 @@
+"""``transmogrifai_tpu slo`` — SLO burn-rate status of a running daemon.
+
+Scrapes a live ``cli serve`` / ``cli continuous`` endpoint (its
+``/healthz`` readiness doc and the ``transmogrifai_slo_*`` series on
+``/metrics``) and renders one status table: per objective and alert the
+short/long-window burn rates, the configured factor, and whether the
+alert FIRES (both windows over the factor) — plus the endpoint's overall
+readiness, which a firing fast-burn alert flips::
+
+    python -m transmogrifai_tpu.cli slo --url http://127.0.0.1:9100
+    python -m transmogrifai_tpu.cli slo --port 9100 --watch 5
+
+Exit status: 0 all quiet, 1 an alert is firing (scriptable:
+``cli slo || page-someone``), 2 the endpoint is unreachable or exports
+no SLO series (the daemon was started without ``--slo`` /
+``--staleness-bound-s``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["add_slo_args", "run_slo"]
+
+#: one exposition label: name="value" with escaped chars allowed in the
+#: value — operator-chosen SLO names may contain ',' or '=' and must
+#: not crash the parser
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def add_slo_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("--url", default=None,
+                    help="scrape endpoint base url "
+                         "(e.g. http://127.0.0.1:9100)")
+    sp.add_argument("--port", type=int, default=None,
+                    help="shorthand for --url http://<host>:<port>")
+    sp.add_argument("--host", default="127.0.0.1",
+                    help="host for --port (default loopback)")
+    sp.add_argument("--timeout-s", type=float, default=5.0)
+    sp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="re-render every SECONDS until interrupted")
+    sp.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the raw /healthz slo block as JSON")
+
+
+def _fetch(url: str, timeout_s: float):
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode()
+
+
+def _render(health: dict, metrics_text: str) -> tuple[str, bool, bool]:
+    """(table text, any_alert_firing, has_series) from the scraped
+    surfaces."""
+    from transmogrifai_tpu.utils.table import Table
+    slo = health.get("slo") or {}
+    rows = []
+    # burn rates + per-alert firing states come from the gauge series
+    # (the authoritative export — /healthz only carries the
+    # objective-level rollup, which would paint a quiet fast alert
+    # FIRING whenever its objective's slow alert tickets)
+    burns: dict = {}
+    alert_firing: dict = {}
+    for line in metrics_text.splitlines():
+        if not line.startswith(("transmogrifai_slo_burn_rate{",
+                                "transmogrifai_slo_alert_firing{")):
+            continue
+        labels_part = line[line.index("{") + 1:line.rindex("}")]
+        labels = dict(_LABEL_RE.findall(labels_part))
+        value = line.rsplit(" ", 1)[-1]
+        key = (labels.get("slo"), labels.get("alert"))
+        if line.startswith("transmogrifai_slo_alert_firing{"):
+            alert_firing[key] = float(value) > 0
+        else:
+            burns[key + (labels.get("window"),)] = value
+    firing_names = set(slo.get("firing", []))
+    seen = sorted({(s, a) for s, a, _w in burns})
+    for name, alert in seen:
+        short = burns.get((name, alert, "short"),
+                          burns.get((name, alert, "current"), "-"))
+        long_ = burns.get((name, alert, "long"), "-")
+        firing = alert_firing.get((name, alert),
+                                  name in firing_names)
+        rows.append((name, alert, short, long_,
+                     "FIRING" if firing else "ok"))
+    status = health.get("status", "?")
+    ready = health.get("ready")
+    title = (f"SLO status — endpoint {status!r}, "
+             f"ready={'yes' if ready else 'no'}")
+    if not rows:
+        return (f"{title}\n(no transmogrifai_slo_* series: daemon "
+                "started without --slo/--staleness-bound-s)",
+                bool(firing_names), False)
+    table = Table(["objective", "alert", "burn(short)", "burn(long)",
+                   "state"], rows, title=title)
+    return str(table), bool(firing_names), True
+
+
+def run_slo(args: argparse.Namespace) -> int:
+    url = args.url
+    if url is None and args.port is not None:
+        url = f"http://{args.host}:{args.port}"
+    if url is None:
+        print("slo: pass --url or --port (the daemon's --metrics-port)",
+              file=sys.stderr)
+        return 2
+    url = url.rstrip("/")
+    while True:
+        try:
+            health = json.loads(_fetch(f"{url}/healthz", args.timeout_s))
+            # --json renders /healthz only: don't force the daemon to
+            # build the full exposition just to throw it away
+            metrics_text = "" if args.as_json else \
+                _fetch(f"{url}/metrics", args.timeout_s)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"slo: cannot scrape {url}: {e}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps({"status": health.get("status"),
+                              "ready": health.get("ready"),
+                              "slo": health.get("slo")}, indent=2))
+            firing = bool((health.get("slo") or {}).get("firing"))
+            has_series = health.get("slo") is not None
+        else:
+            text, firing, has_series = _render(health, metrics_text)
+            print(text)
+        # the documented scriptable contract: 0 quiet, 1 firing, 2 no
+        # SLO surface at all (a misconfigured daemon must not read as
+        # "all quiet" to `cli slo || page-someone`)
+        code = 1 if firing else (0 if has_series else 2)
+        if args.watch is None:
+            return code
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return code

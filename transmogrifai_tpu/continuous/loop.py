@@ -51,6 +51,7 @@ from transmogrifai_tpu.readers.base import CustomReader
 from transmogrifai_tpu.readers.streaming import (
     FileStreamingReader, reader_for_file,
 )
+from transmogrifai_tpu.utils.events import dump_incident, events
 
 __all__ = ["ContinuousLoop", "ContinuousMetrics"]
 
@@ -150,6 +151,9 @@ class ContinuousLoop:
                  staleness_bound_s: Optional[float] = None,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "127.0.0.1",
+                 access_log_sample: float = 0.0,
+                 slo=None,
+                 events_spill: bool = True,
                  fleet=None,
                  stop_fleet_on_exit: bool = True,
                  on_started=None,
@@ -213,13 +217,38 @@ class ContinuousLoop:
         self._fleet_started = False
         self._metrics_port = metrics_port
         self._metrics_host = metrics_host
+        self._access_log_sample = float(access_log_sample)
         self.metrics_http = None
+        #: durable flight-recorder spill: events.jsonl under state_dir
+        #: (the black box a postmortem greps by trace id / event kind)
+        self._events_spill = bool(events_spill)
+        self._events_spill_configured = False
+        #: SLO engine over the loop's fleet + its own staleness; built
+        #: from ``slo`` (objectives list / config path / engine), with a
+        #: staleness objective implied by ``staleness_bound_s``
+        self.slo_engine = self._build_slo_engine(slo)
         #: source file -> in-memory records of the live buffer (restart
         #: rebuilds from the manifest's file list instead)
         self._rows_by_source: dict[str, list] = {}
         self._batches_in_window = 0
         self._windows_this_run = 0
         self._serving_totals: Optional[dict] = None
+
+    def _build_slo_engine(self, slo):
+        if slo is None and self.staleness_bound_s is None:
+            return None
+        from transmogrifai_tpu.utils.slo import SLObjective, SLOEngine
+        engine = SLOEngine.for_serving(
+            slo if slo is not None else [],
+            lambda: [lane.metrics
+                     for lane in self.fleet.active_lanes().values()],
+            staleness_fn=self.staleness_s)
+        if self.staleness_bound_s is not None and not any(
+                o.kind == "staleness" for o in engine.objectives):
+            engine.add(SLObjective(name="staleness", kind="staleness",
+                                   bound_s=float(self.staleness_bound_s)),
+                       value_fn=self.staleness_s)
+        return engine
 
     # -- lifecycle -----------------------------------------------------------
     def run(self) -> dict:
@@ -248,6 +277,18 @@ class ContinuousLoop:
                         if self.max_windows is not None and \
                                 self._windows_this_run >= self.max_windows:
                             break
+            except BaseException as e:
+                # the daemon is dying with an error (a real crash OR an
+                # injected preemption): freeze the black box first —
+                # the dump IS the postmortem a restarted-and-healthy
+                # process can no longer produce. A graceful Ctrl-C /
+                # SystemExit shutdown is NOT an incident: routine
+                # restarts must not accumulate fake postmortems.
+                if not isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    self._incident_dump(
+                        "loop_error",
+                        {"error": f"{type(e).__name__}: {str(e)[:300]}"})
+                raise
             finally:
                 if reader is not None:
                     self._stream_skipped = list(reader.skipped_files)
@@ -255,6 +296,11 @@ class ContinuousLoop:
         return self.report()
 
     def _startup(self) -> None:
+        if self._events_spill and not self._events_spill_configured \
+                and not self.state._disabled:
+            events.configure(spill_path=os.path.join(
+                self.state_dir, "events.jsonl"))
+            self._events_spill_configured = True
         if self.state.drift_reference:
             self.monitor.restore_reference(self.state.drift_reference)
         if self.reference_frame is None and self.reference_path \
@@ -286,12 +332,11 @@ class ContinuousLoop:
         self._start_fleet_if_serveable()
         if self._metrics_port is not None and self.metrics_http is None:
             from transmogrifai_tpu.serving.http import MetricsServer
-            from transmogrifai_tpu.utils.prometheus import build_registry
-            registry = build_registry(fleet=self.fleet, continuous=self)
             self.metrics_http = MetricsServer(
-                render_fn=registry.render, health_fn=self.health,
+                render_fn=self._registry().render, health_fn=self.health,
                 score_fn=self.fleet._http_score,
-                port=self._metrics_port, host=self._metrics_host).start()
+                port=self._metrics_port, host=self._metrics_host,
+                access_log_sample=self._access_log_sample).start()
         # resume: a pending retrain recorded before the crash re-runs on
         # the SAME rows (manifest file list), resuming from its own
         # fitted-DAG/sweep/refit checkpoints — zero duplicate fits
@@ -302,6 +347,37 @@ class ContinuousLoop:
                 f"(attempt {self.state.pending_retrain.get('attempt')})",
                 RuntimeWarning)
             self._execute_retrain()
+
+    def _registry(self):
+        """The loop's full scrape registry (fleet + continuous + slo
+        series) — built once, shared by the HTTP endpoint and incident
+        dumps (a dump without ``--metrics-port`` still carries a scrape)."""
+        if getattr(self, "_registry_obj", None) is None:
+            from transmogrifai_tpu.utils.prometheus import build_registry
+            self._registry_obj = build_registry(
+                fleet=self.fleet, continuous=self, slo=self.slo_engine)
+        return self._registry_obj
+
+    def _incident_dump(self, reason: str,
+                       extra: Optional[dict] = None) -> Optional[str]:
+        """Write the dump-on-incident snapshot (``utils.events.
+        dump_incident``) under ``state_dir/incidents/``. Best-effort by
+        construction — observability must never compound the incident."""
+        try:
+            doc = dict(extra or {})
+            doc.setdefault("modelId", self.model_id)
+            doc.setdefault("window", self.state.window_seq)
+            if self.state.pending_retrain is not None:
+                doc.setdefault("pendingRetrain",
+                               dict(self.state.pending_retrain))
+            return dump_incident(self.state_dir, reason,
+                                 scrape_fn=self._registry().render,
+                                 extra=doc)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            warnings.warn(
+                f"continuous loop: incident dump failed "
+                f"({type(e).__name__}: {e})", RuntimeWarning)
+            return None
 
     def _shutdown(self) -> None:
         if self.on_stopping is not None:
@@ -320,6 +396,12 @@ class ContinuousLoop:
         if self.stop_fleet_on_exit and self._fleet_started:
             self.fleet.stop(drain=True)
             self._fleet_started = False
+        if self._events_spill_configured:
+            # flush the black box and release the spill file: the NEXT
+            # loop (tests, supervisor restarts into a new state dir)
+            # must not keep appending into this one's history
+            events.configure(spill_path=None)
+            self._events_spill_configured = False
 
     def _has_active(self) -> bool:
         return self.fleet.registry.active_version(self.model_id) is not None
@@ -439,6 +521,10 @@ class ContinuousLoop:
         self.state.record_decision(decision.to_json())
         if decision.triggered:
             self.metrics.record_trigger()
+            events.emit("continuous.drift_trigger",
+                        model=self.model_id,
+                        window=self.state.window_seq,
+                        reasons=list(decision.reasons))
             warnings.warn(
                 f"continuous loop: drift trigger at window "
                 f"{self.state.window_seq}: {'; '.join(decision.reasons)}",
@@ -533,6 +619,11 @@ class ContinuousLoop:
         if pending is None:
             return False
         self.metrics.record_retrain()
+        events.emit("continuous.retrain", model=self.model_id,
+                    window=pending.get("windowSeq"),
+                    attempt=pending.get("attempt"),
+                    rows=pending.get("rows"),
+                    reasons=list(pending.get("reason", [])))
         with span("continuous.retrain",
                   window=pending.get("windowSeq"),
                   attempt=pending.get("attempt"),
@@ -544,6 +635,14 @@ class ContinuousLoop:
                     "rows (buffer files gone); abandoning it",
                     RuntimeWarning)
                 self.state.abandon_retrain("no recoverable window rows")
+                events.emit("continuous.retrain_failed",
+                            model=self.model_id,
+                            window=pending.get("windowSeq"),
+                            abandoned=True,
+                            error="no recoverable window rows")
+                self._incident_dump("retrain_abandoned",
+                                    {"why": "no recoverable window rows",
+                                     "retrain": dict(pending)})
                 self._cleanup_retrain_dir(pending)
                 return False
             try:
@@ -566,6 +665,12 @@ class ContinuousLoop:
 
     def _retrain_failed(self, pending: dict, err: BaseException) -> None:
         self.metrics.record_retrain_failure()
+        abandoned = pending.get("attempt", 1) >= self.max_retrain_attempts
+        events.emit("continuous.retrain_failed", model=self.model_id,
+                    window=pending.get("windowSeq"),
+                    attempt=pending.get("attempt"),
+                    abandoned=abandoned,
+                    error=f"{type(err).__name__}: {str(err)[:200]}")
         warnings.warn(
             f"continuous loop: retrain attempt "
             f"{pending.get('attempt')} failed ({type(err).__name__}: "
@@ -573,10 +678,16 @@ class ContinuousLoop:
             RuntimeWarning)
         self.state.record_retrain_failure(
             f"{type(err).__name__}: {str(err)[:300]}")
-        if pending.get("attempt", 1) >= self.max_retrain_attempts:
+        if abandoned:
             self.state.abandon_retrain(
                 f"attempt budget ({self.max_retrain_attempts}) exhausted")
             self.monitor.start_cooldown()
+            self._incident_dump(
+                "retrain_abandoned",
+                {"why": f"attempt budget ({self.max_retrain_attempts}) "
+                        "exhausted",
+                 "error": f"{type(err).__name__}: {str(err)[:300]}",
+                 "retrain": dict(pending)})
             # the pending record is gone, so nothing will ever resume
             # from (or clean up) its checkpoint tree — delete it now or
             # a forever-running daemon leaks one dir per abandoned
@@ -619,6 +730,14 @@ class ContinuousLoop:
                     f"continuous loop: promotion rolled back by the "
                     f"shadow parity gate ({e}); old version keeps "
                     "serving", RuntimeWarning)
+                # the fleet already emitted fleet.gate_rejected; the
+                # dump freezes it together with the triggering drift
+                # event and the retrain lineage still in the ring
+                self._incident_dump(
+                    "gate_rejected",
+                    {"maxAbsDiff": e.max_abs_diff,
+                     "retrain": dict(pending),
+                     "error": str(e)[:300]})
                 self._cleanup_retrain_dir(pending)
                 return False
             except FaultHarnessError:
@@ -646,6 +765,26 @@ class ContinuousLoop:
             self.state.drift_reference = self.monitor.reference_to_json()
             self.state.record_promotion(version, swap_report, staleness)
             self.metrics.record_promotion()
+            # the LINEAGE event: any scored response stamped with this
+            # (model, version, fingerprint) traces back through it to the
+            # drift window, the retrain attempt, and the exact stream
+            # files whose rows trained the serving model
+            try:
+                fingerprint = self.fleet.registry.get(
+                    self.model_id, version).fingerprint
+            except Exception:  # noqa: BLE001 — lineage is best-effort metadata
+                fingerprint = swap_report.get("fingerprint")
+            events.emit(
+                "continuous.promoted", model=self.model_id,
+                version=version, fingerprint=fingerprint,
+                window=pending.get("windowSeq"),
+                reasons=list(pending.get("reason", [])),
+                attempt=pending.get("attempt"),
+                rows=len(rows),
+                files=[f for f in pending.get("files", []) if f],
+                stalenessSeconds=(round(staleness, 3)
+                                  if staleness is not None else None),
+                fromVersion=swap_report.get("fromVersion"))
             self._rows_by_source = {}
             self._cleanup_retrain_dir(pending)
         return True
@@ -685,12 +824,16 @@ class ContinuousLoop:
 
     def health(self) -> dict:
         doc = self.fleet.health() if self._fleet_started else {
-            "status": "warming", "models": {}}
+            "status": "warming", "models": {}, "ready": False}
         doc["loop"] = {"window": self.state.window_seq,
                        "bufferRows": self.buffer_rows(),
                        "pendingRetrain": self.state.pending_retrain
                        is not None,
                        "counters": self.metrics.to_json()}
+        # the loop's engine outranks the fleet's (the fleet only has one
+        # when constructed with slo=; the loop composes staleness in)
+        from transmogrifai_tpu.utils.slo import fold_health
+        fold_health(self.slo_engine, doc)
         return doc
 
     def report(self) -> dict:

@@ -316,7 +316,10 @@ class WorkflowRunner:
         ``jsThreshold``, ``psiThreshold``, ``fillDeltaThreshold``,
         ``labelDeltaThreshold``, ``consecutiveWindows``,
         ``cooldownWindows``), ``shadowTolerance``, ``stalenessBoundS``,
-        ``metricsPort``. ``modelLocation`` loads the initial serving
+        ``metricsPort``, ``accessLogSample`` (sampled http.access
+        events), ``sloConfig`` (objectives JSON path), ``eventsSpill``
+        (durable flight-recorder JSONL under the state dir, default
+        on). ``modelLocation`` loads the initial serving
         model; without it the loop bootstraps from the first window.
         ``referencePath`` names a batch file sampling that model's
         training data to pin the drift reference (else the first stream
@@ -361,7 +364,10 @@ class WorkflowRunner:
             staleness_bound_s=(float(cp["stalenessBoundS"])
                                if "stalenessBoundS" in cp else None),
             metrics_port=(int(cp["metricsPort"]) if "metricsPort" in cp
-                          else None))
+                          else None),
+            access_log_sample=float(cp.get("accessLogSample", 0.0)),
+            slo=cp.get("sloConfig"),
+            events_spill=bool(cp.get("eventsSpill", True)))
         result["continuous"] = loop.run()
         result["stateDir"] = state_dir
 

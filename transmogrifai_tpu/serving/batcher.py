@@ -28,6 +28,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
+from transmogrifai_tpu.utils.events import events
 from transmogrifai_tpu.utils.tracing import recorder, span
 
 __all__ = ["MicroBatcher", "BackpressureError", "RequestTimeout",
@@ -75,6 +76,7 @@ class _Pending:
     future: Future
     t_submit: float
     deadline: Optional[float]  # monotonic seconds, None = no deadline
+    trace_id: Optional[str] = None  # request-scoped trace context
 
 
 @dataclass
@@ -178,7 +180,12 @@ class MicroBatcher:
                    self.max_wait_s)
 
     def submit(self, row: dict,
-               timeout_ms: Optional[float] = None) -> Future:
+               timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
+        """``trace_id`` (optional) rides the request through the queue:
+        the worker stamps it into the batch's flight-recorder events and
+        the dispatch span's member list, so one id greps the request's
+        whole path (admission -> batch -> dispatch -> reply)."""
         if self._stop.is_set() or self._thread is None:
             raise RuntimeError("batcher is not running")
         t = time.monotonic()
@@ -186,7 +193,7 @@ class MicroBatcher:
             else self.default_timeout_ms
         deadline = None if timeout_ms is None else t + timeout_ms / 1e3
         pending = _Pending(row=row, future=Future(), t_submit=t,
-                           deadline=deadline)
+                           deadline=deadline, trace_id=trace_id)
         try:
             self._q.put_nowait(pending)
         except queue.Full:
@@ -242,9 +249,12 @@ class MicroBatcher:
             now = time.monotonic()
             live: list[_Pending] = []
             expired = 0
+            expired_traced: list[str] = []
             for p in batch:
                 if p.deadline is not None and now > p.deadline:
                     expired += 1
+                    if p.trace_id is not None:
+                        expired_traced.append(p.trace_id)
                     _settle(p.future, RequestTimeout(
                         "request expired after "
                         f"{(now - p.t_submit) * 1e3:.1f}ms in queue"))
@@ -252,6 +262,8 @@ class MicroBatcher:
                     live.append(p)
             if expired and self.on_expired is not None:
                 self.on_expired(expired)
+            if expired_traced and events.enabled:
+                events.emit("serve.expired", traceIds=expired_traced)
             if not live:
                 continue
             t0 = time.monotonic()
@@ -262,8 +274,28 @@ class MicroBatcher:
             recorder.add("serving.queue_wait",
                          epoch_off + min(p.t_submit for p in live),
                          epoch_off + t0, rows=len(live))
+            # request-scoped trace context: requests carrying a trace id
+            # get their path recorded as batch-scope wide events (one
+            # batch/dispatch/reply event per batch, members listed —
+            # per-request emission would cost the hot path ~tens of
+            # percent at saturation; amortized member lists stay well
+            # under 1us/req). serve.batch carries ONLY the id list (a
+            # C-speed comprehension): per-request timing rides in
+            # serve.reply's members, built inside the settle loop that
+            # already iterates per-pending anyway — admission epoch
+            # reconstructs as reply ts - latencyMs, and queue wait as
+            # reply latency minus the batch's dispatch wallMs
+            traced = [p.trace_id for p in live if p.trace_id is not None]
+            if traced and events.enabled:
+                events.emit("serve.batch", t=epoch_off + t0,
+                            rows=len(live), traceIds=traced)
+            span_attrs = {"rows": len(live)}
+            if traced:
+                # the batch span records its member trace ids: a span
+                # drill-down names exactly which requests shared the batch
+                span_attrs["trace_ids"] = traced
             try:
-                with span("serving.dispatch", rows=len(live)):
+                with span("serving.dispatch", **span_attrs):
                     results = list(self.dispatch([p.row for p in live]))
                 if len(results) != len(live):
                     raise RuntimeError(
@@ -273,6 +305,9 @@ class MicroBatcher:
                 results = [e] * len(live)  # this is the belt-and-braces path
             wall = time.monotonic() - t0
             self._stats.record(wall, len(live))
+            if traced and events.enabled:
+                events.emit("serve.dispatch", rows=len(live),
+                            wallMs=round(wall * 1e3, 3), traceIds=traced)
             done_t = time.monotonic()
             settled = []
             with span("serving.settle", rows=len(live)):
@@ -282,6 +317,25 @@ class MicroBatcher:
                     settled.append((done_t - p.t_submit, ok))
                 if self.on_complete is not None:
                     self.on_complete(settled)
+            if traced and events.enabled:
+                # columnar (traceIds[i] <-> latenciesMs[i]), built after
+                # the settle loop, reusing the fan-in id list and raw
+                # float ms: per-member [id, ok, round(ms)] rows would
+                # triple the list allocations and pay ~150ns/round on
+                # this worker thread (digits only cost the background
+                # spill writer). The all-traced batch — every HTTP
+                # request carries an id — skips the alignment filter.
+                if len(traced) == len(live):
+                    lats = [s[0] * 1e3 for s in settled]
+                else:
+                    lats = [lat * 1e3 for p, (lat, ok)
+                            in zip(live, settled)
+                            if p.trace_id is not None]
+                failed = [p.trace_id for p, (lat, ok)
+                          in zip(live, settled)
+                          if not ok and p.trace_id is not None]
+                events.emit("serve.reply", traceIds=traced,
+                            latenciesMs=lats, failedIds=failed)
         self._drained.set()
 
 

@@ -282,9 +282,16 @@ class CompiledScorer:
             # compile listener would cross-attribute concurrent servers)
             before = self._program_cache_entries()
             data = self._transform(data, bucket)
-            self.counters.count(
-                bucket, dispatches=1,
-                compiles=self._program_cache_entries() - before)
+            grew = self._program_cache_entries() - before
+            self.counters.count(bucket, dispatches=1, compiles=grew)
+            if grew:
+                # cold path only: steady-state traffic never gets here —
+                # a compile event under load is the flight-recorder
+                # symptom of a bucket/cache misconfiguration
+                from transmogrifai_tpu.utils.events import events
+                events.emit("serving.compile", bucket=bucket,
+                            programs=grew,
+                            fingerprint=self.fingerprint)
         return self._extract_rows(data, n)
 
     def _program_cache_entries(self) -> int:

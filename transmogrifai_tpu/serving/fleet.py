@@ -48,6 +48,7 @@ from transmogrifai_tpu.serving.registry import (
     ModelEntry, ModelRegistry, ModelState, UnknownModelError,
 )
 from transmogrifai_tpu.serving.server import ScoringServer
+from transmogrifai_tpu.utils.events import events
 
 __all__ = ["FleetServer", "FleetMetrics", "ProgramCache",
            "ShadowParityError", "UnknownModelError"]
@@ -292,13 +293,19 @@ class FleetServer:
                  route_field: str = "model",
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "127.0.0.1",
+                 access_log_sample: float = 0.0,
+                 slo=None,
                  **lane_kwargs):
         """``lane_kwargs`` (``max_batch``, ``max_wait_ms``,
         ``queue_capacity``, ``default_timeout_ms``, ``strict``,
         ``retries``, ``probe_interval_s``, ``donate``, ...) configure
-        every per-model ``ScoringServer`` lane."""
+        every per-model ``ScoringServer`` lane. ``slo`` (a list of
+        ``utils.slo.SLObjective``/dicts, a config path, or a prebuilt
+        ``SLOEngine``) evaluates burn-rate objectives over the whole
+        fleet's lanes; firing fast-burn alerts flip ``/healthz``
+        readiness."""
         bad = {"metrics_port", "metrics_host", "program_cache",
-               "fingerprint"} & set(lane_kwargs)
+               "fingerprint", "event_label", "slo"} & set(lane_kwargs)
         if bad:
             raise ValueError(f"lane kwargs {sorted(bad)} are fleet-managed")
         self.registry = registry if registry is not None else ModelRegistry()
@@ -327,6 +334,16 @@ class FleetServer:
         self.metrics_http = None
         self._metrics_port = metrics_port
         self._metrics_host = metrics_host
+        self._access_log_sample = float(access_log_sample)
+        #: fleet-wide SLO engine: availability/latency objectives sum
+        #: over every ACTIVE lane (counter resets at hot-swap lane drops
+        #: are clamped by the engine's delta accounting)
+        self.slo_engine = None
+        if slo is not None:
+            from transmogrifai_tpu.utils.slo import SLOEngine
+            self.slo_engine = SLOEngine.for_serving(
+                slo, lambda: [lane.metrics
+                              for lane in self.active_lanes().values()])
 
     # -- registration --------------------------------------------------------
     def register(self, path: Optional[str] = None, *, model=None,
@@ -360,6 +377,7 @@ class FleetServer:
         return ScoringServer(entry.model,
                              program_cache=self.program_cache,
                              fingerprint=entry.fingerprint,
+                             event_label=entry.model_id,
                              **self._lane_kwargs)
 
     def prewarm(self, model_id: str, version: Optional[str] = None,
@@ -419,11 +437,12 @@ class FleetServer:
         if self._metrics_port is not None and self.metrics_http is None:
             from transmogrifai_tpu.serving.http import MetricsServer
             from transmogrifai_tpu.utils.prometheus import build_registry
-            registry = build_registry(fleet=self)
+            registry = build_registry(fleet=self, slo=self.slo_engine)
             self.metrics_http = MetricsServer(
                 render_fn=registry.render, health_fn=self.health,
                 score_fn=self._http_score,
-                port=self._metrics_port, host=self._metrics_host).start()
+                port=self._metrics_port, host=self._metrics_host,
+                access_log_sample=self._access_log_sample).start()
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -480,16 +499,28 @@ class FleetServer:
         ring.append(row)
 
     def submit(self, model_id: str, row: dict,
-               timeout_ms: Optional[float] = None):
+               timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None):
         """Route one request to ``model_id``'s active version. Raises
         ``UnknownModelError`` (no such id / no active version),
         ``KeyError`` (strict admission) or ``BackpressureError`` (that
         lane's queue is full) — per-model backpressure: one hot model
         sheds load without touching its neighbors' queues."""
+        return self._submit_routed(model_id, row, timeout_ms,
+                                   trace_id)[0]
+
+    def _submit_routed(self, model_id: str, row: dict,
+                       timeout_ms: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> tuple:
+        """``submit`` that also returns which version admitted the
+        request — the lineage a reply must carry is the version that
+        SCORED it, which during a hot swap is not necessarily the
+        version that is active when the reply is assembled."""
         for _ in range(8):
             lane, version = self._resolve(model_id)
             try:
-                fut = lane.submit(row, timeout_ms=timeout_ms)
+                fut = lane.submit(row, timeout_ms=timeout_ms,
+                                  trace_id=trace_id)
             except RuntimeError:
                 # the lane stopped between resolve and submit — a swap
                 # demoted it (the alias flips BEFORE the old lane drains,
@@ -499,27 +530,50 @@ class FleetServer:
                     raise
                 continue
             self._remember(model_id, row)
-            return fut
+            return fut, version
         raise RuntimeError(
             f"model {model_id!r}: could not route (lanes kept stopping)")
 
     def submit_blocking(self, model_id: str, row: dict,
                         timeout_ms: Optional[float] = None,
-                        max_wait_s: Optional[float] = None):
+                        max_wait_s: Optional[float] = None,
+                        trace_id: Optional[str] = None):
         """``submit`` that absorbs backpressure (the shared
         ``batcher.absorb_backpressure`` loop)."""
         from transmogrifai_tpu.serving.batcher import absorb_backpressure
         return absorb_backpressure(
-            lambda: self.submit(model_id, row, timeout_ms=timeout_ms),
+            lambda: self.submit(model_id, row, timeout_ms=timeout_ms,
+                                trace_id=trace_id),
             max_wait_s=max_wait_s)
 
     def score(self, model_id: str, row: dict,
-              timeout_s: Optional[float] = None) -> dict:
-        return self.submit(model_id, row).result(timeout=timeout_s)
+              timeout_s: Optional[float] = None,
+              trace_id: Optional[str] = None) -> dict:
+        return self.submit(model_id, row,
+                           trace_id=trace_id).result(timeout=timeout_s)
 
-    def _http_score(self, model_id: Optional[str], row: dict) -> dict:
+    def lineage(self, model_id: str,
+                version: Optional[str] = None) -> dict:
+        """A serving model's lineage — ``(modelId, version,
+        fingerprint)`` of ``version`` (default: the ACTIVE one): which
+        exact fitted checkpoint scored the request. With the continuous
+        loop's ``continuous.promoted`` lineage events, this links any
+        response back to the drift window and retrain that produced its
+        model."""
+        if version is None:
+            version = self.registry.active_version(model_id)
+            if version is None:
+                self.registry.get(model_id)  # raises the precise reason
+        entry = self.registry.get(model_id, version)
+        return {"modelId": model_id, "version": version,
+                "fingerprint": entry.fingerprint}
+
+    def _http_score(self, model_id: Optional[str], row: dict,
+                    trace_id: Optional[str] = None) -> dict:
         """POST /score[/model_id] adapter: path id wins, else the row's
-        ``route_field``, else the sole registered model."""
+        ``route_field``, else the sole registered model. The returned
+        document is stamped with the trace id and the scoring model's
+        lineage (the response-side half of request-scoped tracing)."""
         if model_id is None:
             model_id = row.pop(self.route_field, None)
         if model_id is None:
@@ -530,7 +584,27 @@ class FleetServer:
                     f"or /score/<id> path) and the fleet serves "
                     f"{len(ids)} models")
             model_id = ids[0]
-        return self.score(model_id, row, timeout_s=self.http_timeout_s)
+        fut, version = self._submit_routed(model_id, row,
+                                           trace_id=trace_id)
+        doc = dict(fut.result(timeout=self.http_timeout_s))
+        if trace_id is not None:
+            doc["traceId"] = trace_id
+        # lineage of the version that ADMITTED the request (a hot swap
+        # may have flipped the active alias while it was in flight)
+        try:
+            doc["lineage"] = self.lineage(model_id, version)
+        except UnknownModelError:
+            # the scoring version was unloaded before the reply was
+            # assembled (swap/unregister race). A SCORED request must
+            # never turn into an error reply over missing metadata:
+            # fall back to active lineage, else version-only
+            try:
+                doc["lineage"] = self.lineage(model_id)
+            except UnknownModelError:
+                doc["lineage"] = {"modelId": model_id,
+                                  "version": version,
+                                  "fingerprint": None}
+        return doc
 
     # -- hot swap ------------------------------------------------------------
     def hot_swap(self, model_id: str, path: Optional[str] = None, *,
@@ -627,8 +701,23 @@ class FleetServer:
                     rows[-shadow_rows:] if shadow_rows > 0 else [],
                     tolerance)
             except BaseException as e:
-                self.metrics.record_swap_failure(
-                    parity=isinstance(e, ShadowParityError))
+                parity = isinstance(e, ShadowParityError)
+                self.metrics.record_swap_failure(parity=parity)
+                if parity:
+                    # the gate REJECTION is its own flight-recorder
+                    # event: incident dumps key on it
+                    events.emit(
+                        "fleet.gate_rejected", model=model_id,
+                        fromVersion=old_version,
+                        candidateVersion=entry.version,
+                        maxAbsDiff=getattr(e, "max_abs_diff", None),
+                        tolerance=tolerance)
+                else:
+                    events.emit(
+                        "fleet.swap_failed", model=model_id,
+                        fromVersion=old_version,
+                        candidateVersion=entry.version,
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
                 if new_lane is not None:
                     try:
                         new_lane.stop(drain=False)
@@ -667,6 +756,10 @@ class FleetServer:
                 self.program_cache.evict_model(old_entry.fingerprint)
             wall = time.monotonic() - t0
             self.metrics.record_swap(wall)
+            events.emit("fleet.swap", model=model_id,
+                        fromVersion=old_version, toVersion=entry.version,
+                        fingerprint=entry.fingerprint,
+                        wallSeconds=round(wall, 6))
         return {"modelId": model_id, "fromVersion": old_version,
                 "toVersion": entry.version,
                 "fingerprint": entry.fingerprint,
@@ -727,15 +820,21 @@ class FleetServer:
         # ends of a model's lifecycle and must never alias
         severity = {"ok": 0, "warming": 1, "draining": 2, "stopped": 3,
                     "degraded": 4, "unloaded": 5}
-        worst = "ok"
+        worst = serving_worst = "ok"
+        any_active = False
         for model_id in self.registry.model_ids():
             version = self.registry.active_version(model_id)
             if version is None:
+                # a retired model kept for audit: it colors the status
+                # word but must NOT drag readiness down — a deliberately
+                # unloaded entry would otherwise shed traffic from every
+                # healthy lane forever
                 models[model_id] = {"state": ModelState.UNLOADED,
                                     "version": None}
                 worst = max(worst, ModelState.UNLOADED,
                             key=lambda s: severity.get(s, 4))
                 continue
+            any_active = True
             entry = self.registry.get(model_id, version)
             with self._lock:
                 lane = self._lanes.get((model_id, version))
@@ -747,9 +846,20 @@ class FleetServer:
             models[model_id] = doc
             word = "ok" if state == "ready" else state
             worst = max(worst, word, key=lambda s: severity.get(s, 4))
-        return {"status": worst, "models": models,
-                "fleet": self.metrics.to_json(),
-                "cache": self.program_cache.to_json()}
+            serving_worst = max(serving_worst, word,
+                                key=lambda s: severity.get(s, 4))
+        from transmogrifai_tpu.utils.slo import fold_health
+
+        # readiness: the load-balancer bit, over ACTIVE lanes only.
+        # Degraded still serves (slowly); a firing fast-burn SLO alert
+        # flips it (fold_health); a fleet with nothing active isn't ready
+        doc = {"status": worst, "models": models,
+               "fleet": self.metrics.to_json(),
+               "cache": self.program_cache.to_json(),
+               "ready": any_active
+               and serving_worst in ("ok", "degraded")}
+        fold_health(self.slo_engine, doc)
+        return doc
 
     def snapshot(self) -> dict:
         """One JSON document: fleet counters, shared-cache accounting,

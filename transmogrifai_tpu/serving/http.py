@@ -5,6 +5,13 @@ JSON), and — when the owner provides a ``score_fn`` (the fleet does) —
 ``POST /score`` / ``POST /score/<model_id>`` (one JSON request row in,
 one JSON score document out; the multi-process load harness's wire).
 
+Request-scoped tracing starts HERE: every scoring request gets a trace
+id — the inbound ``X-Trace-Id`` header when present (sanitized), else a
+freshly minted one — that is passed to ``score_fn``, carried through the
+batcher into the flight recorder, echoed back as the response's
+``X-Trace-Id`` header (success AND error replies), and stamped into the
+score document alongside the serving model's lineage.
+
 Deliberately dependency-free and tiny: one daemon thread, a
 ``ThreadingHTTPServer`` so a slow scraper or a blocking score can't
 stall a liveness probe, and no other routes — everything else is a 404.
@@ -14,18 +21,36 @@ malformed-request errors are 400, an unknown model id 404, a queue-full
 ``BackpressureError`` 503 with a ``Retry-After`` hint, an expired
 request deadline 504 — load shed and routing mistakes are the CLIENT's
 signal, never a server crash.
+
+Access logging: ``BaseHTTPRequestHandler``'s per-request stderr line is
+suppressed (a daemon's stderr is not a log pipeline); instead, with
+``access_log_sample > 0``, every Nth completed request emits a
+structured ``http.access`` event into the flight recorder (method, path,
+status, duration, trace id), additionally capped at
+``ACCESS_LOG_MAX_PER_S`` events/second so a scrape storm cannot evict
+the incident history the ring exists to keep.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from transmogrifai_tpu.utils.events import events
 from transmogrifai_tpu.utils.prometheus import CONTENT_TYPE
+from transmogrifai_tpu.utils.tracing import new_trace_id, sanitize_trace_id
 
-__all__ = ["MetricsServer"]
+__all__ = ["MetricsServer", "TRACE_HEADER"]
+
+#: the request/response trace-context header (Dapper/B3-style: honor an
+#: inbound id so a caller's trace continues through this hop)
+TRACE_HEADER = "X-Trace-Id"
+
+#: hard ceiling on sampled access-log events per second
+ACCESS_LOG_MAX_PER_S = 100
 
 
 class MetricsServer:
@@ -34,13 +59,21 @@ class MetricsServer:
     def __init__(self, render_fn: Callable[[], str],
                  health_fn: Callable[[], dict],
                  port: int = 0, host: str = "127.0.0.1",
-                 score_fn: Optional[Callable[[Optional[str], dict],
-                                             dict]] = None):
+                 score_fn: Optional[Callable[
+                     [Optional[str], dict, Optional[str]], dict]] = None,
+                 access_log_sample: float = 0.0):
         self.render_fn = render_fn
         self.health_fn = health_fn
-        #: ``score_fn(model_id_or_None, row) -> score doc``; None
-        #: disables the POST /score routes (scrape-only endpoint)
+        #: ``score_fn(model_id_or_None, row, trace_id) -> score doc``;
+        #: None disables the POST /score routes (scrape-only endpoint)
         self.score_fn = score_fn
+        #: sampled structured access log: 0 (default) = off, else the
+        #: fraction of requests evented (1.0 = every request, 0.01 =
+        #: every 100th — deterministic stride, not a coin flip)
+        self.access_log_sample = float(access_log_sample)
+        self._access_n = 0
+        self._access_window = [0.0, 0]   # [window second, emits in it]
+        self._access_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._host = host
@@ -49,6 +82,33 @@ class MetricsServer:
     @property
     def port(self) -> Optional[int]:
         return self._httpd.server_address[1] if self._httpd else None
+
+    # -- access log ----------------------------------------------------------
+    def _access(self, method: str, path: str, status: int, t0: float,
+                trace_id: Optional[str] = None) -> None:
+        """Emit a sampled ``http.access`` event (see module docstring)."""
+        if self.access_log_sample <= 0 or not events.enabled:
+            return
+        stride = max(int(round(1.0 / self.access_log_sample)), 1)
+        now = time.monotonic()
+        with self._access_lock:
+            self._access_n += 1
+            if (self._access_n - 1) % stride:
+                return
+            sec = int(now)
+            if self._access_window[0] != sec:
+                self._access_window = [sec, 0]
+            if self._access_window[1] >= ACCESS_LOG_MAX_PER_S:
+                suppressed = True
+            else:
+                suppressed = False
+                self._access_window[1] += 1
+        if suppressed:
+            events.count_suppressed()
+            return
+        events.emit("http.access", trace_id=trace_id, method=method,
+                    path=path, status=int(status),
+                    durationMs=round((time.monotonic() - t0) * 1e3, 3))
 
     def start(self) -> "MetricsServer":
         if self._httpd is not None:
@@ -67,44 +127,60 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 — http.server API
+                t0 = time.monotonic()
+                path = self.path.split("?")[0]
                 try:
-                    if self.path.split("?")[0] == "/metrics":
+                    if path == "/metrics":
                         body = outer.render_fn().encode()
                         ctype = CONTENT_TYPE
-                    elif self.path.split("?")[0] == "/healthz":
+                    elif path == "/healthz":
                         body = (json.dumps(outer.health_fn())
                                 + "\n").encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404, "only /metrics, /healthz "
                                              "and POST /score")
+                        outer._access("GET", path, 404, t0)
                         return
                 except Exception as e:  # noqa: BLE001 — a scrape must see the failure, not a hang
                     self.send_error(
                         500, f"{type(e).__name__}: {str(e)[:200]}")
+                    outer._access("GET", path, 500, t0)
                     return
                 self._reply(200, body, ctype)
+                outer._access("GET", path, 200, t0)
 
             def do_POST(self):  # noqa: N802 — http.server API
+                t0 = time.monotonic()
                 path = self.path.split("?")[0]
                 if outer.score_fn is None or not (
                         path == "/score" or path.startswith("/score/")):
                     self.send_error(
                         404, "POST /score requires a scoring server")
+                    outer._access("POST", path, 404, t0)
                     return
                 model_id = path[len("/score/"):] or None \
                     if path.startswith("/score/") else None
-                err_json = lambda c, e, extra=None: self._reply(  # noqa: E731
-                    c, (json.dumps({"error": f"{type(e).__name__}: "
-                                             f"{str(e)[:300]}"})
-                        + "\n").encode(), "application/json", extra)
+                # trace context: continue the caller's trace or start one
+                trace_id = sanitize_trace_id(
+                    self.headers.get(TRACE_HEADER)) or new_trace_id()
+                traced = {TRACE_HEADER: trace_id}
+
+                def err_json(c, e, extra=None):
+                    self._reply(
+                        c, (json.dumps(
+                            {"error": f"{type(e).__name__}: "
+                                      f"{str(e)[:300]}",
+                             "traceId": trace_id}) + "\n").encode(),
+                        "application/json", {**traced, **(extra or {})})
+                    outer._access("POST", path, c, t0, trace_id)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     row = json.loads(self.rfile.read(n) or b"{}")
                     if not isinstance(row, dict):
                         raise ValueError("request body must be one JSON "
                                          "object (a request row)")
-                    doc = outer.score_fn(model_id, row)
+                    doc = outer.score_fn(model_id, row, trace_id)
                 except Exception as e:  # noqa: BLE001 — mapped to an HTTP status below
                     from concurrent.futures import (
                         TimeoutError as FutureTimeout,
@@ -135,9 +211,13 @@ class MetricsServer:
                         err_json(500, e)
                     return
                 self._reply(200, (json.dumps(doc, default=str)
-                                  + "\n").encode(), "application/json")
+                                  + "\n").encode(), "application/json",
+                            traced)
+                outer._access("POST", path, 200, t0, trace_id)
 
-            def log_message(self, *args):  # requests are not access-logged
+            def log_message(self, *args):
+                # stderr access lines are suppressed; the structured,
+                # sampled http.access event stream replaces them
                 pass
 
         self._httpd = ThreadingHTTPServer(

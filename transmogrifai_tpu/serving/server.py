@@ -38,6 +38,7 @@ from transmogrifai_tpu.local.scoring import (
 from transmogrifai_tpu.serving.batcher import BackpressureError, MicroBatcher
 from transmogrifai_tpu.serving.compiled import CompiledScorer
 from transmogrifai_tpu.serving.metrics import ServingMetrics
+from transmogrifai_tpu.utils.events import events
 from transmogrifai_tpu.utils.retry import with_device_retry
 
 __all__ = ["ScoringServer"]
@@ -63,8 +64,22 @@ class ScoringServer:
                  metrics_max_samples: int = 8192,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "127.0.0.1",
+                 access_log_sample: float = 0.0,
+                 slo=None, event_label: Optional[str] = None,
                  program_cache=None, fingerprint: Optional[str] = None):
         self.model = model
+        #: label stamped on this server's flight-recorder events (the
+        #: fleet sets the model id; a standalone server has none)
+        self.event_label = event_label
+        #: SLO objectives (utils/slo.py): a list of SLObjective/dicts, a
+        #: config path, or a prebuilt SLOEngine — evaluated over this
+        #: server's own metrics, exported as transmogrifai_slo_* and
+        #: folded into /healthz readiness
+        self.slo_engine = None
+        if slo is not None:
+            from transmogrifai_tpu.utils.slo import SLOEngine
+            self.slo_engine = SLOEngine.for_serving(
+                slo, lambda: [self.metrics])
         self.strict = strict
         self.required_keys = required_raw_keys(model)
         self.retries = int(retries)
@@ -99,6 +114,7 @@ class ScoringServer:
         self.metrics_http = None
         self._metrics_port = metrics_port
         self._metrics_host = metrics_host
+        self._access_log_sample = float(access_log_sample)
         #: lifecycle for fleet readiness reporting: warming -> ready ->
         #: (draining ->) stopped; "degraded" overlays ready while the row
         #: path serves (see the ``state`` property)
@@ -131,14 +147,14 @@ class ScoringServer:
         if self._metrics_port is not None and self.metrics_http is None:
             from transmogrifai_tpu.serving.http import MetricsServer
             from transmogrifai_tpu.utils.prometheus import build_registry
-            registry = build_registry(serving=self.metrics, server=self)
+            registry = build_registry(serving=self.metrics, server=self,
+                                      slo=self.slo_engine)
             self.metrics_http = MetricsServer(
                 render_fn=registry.render,
-                health_fn=lambda: {"status": "ok",
-                                   "degraded": self.degraded,
-                                   "queueDepth": self.batcher.queue_depth},
+                health_fn=self.health,
                 port=self._metrics_port,
-                host=self._metrics_host).start()
+                host=self._metrics_host,
+                access_log_sample=self._access_log_sample).start()
         self.batcher.start()
         self._warmup_compiles = dict(self.scorer.counters
                                      .compiles_by_bucket())
@@ -171,6 +187,19 @@ class ScoringServer:
             return self._lifecycle
         return "degraded" if self.degraded else "ready"
 
+    def health(self) -> dict:
+        """The ``/healthz`` body: liveness + readiness. ``ready`` is the
+        load-balancer bit — it drops when the server leaves the ready
+        state OR a fast-burn SLO alert fires (an endpoint burning its
+        error budget at page rate should shed traffic before it pages)."""
+        from transmogrifai_tpu.utils.slo import fold_health
+        doc = {"status": "ok" if self.state == "ready" else self.state,
+               "degraded": self.degraded,
+               "queueDepth": self.batcher.queue_depth,
+               "ready": self.state in ("ready", "degraded")}
+        fold_health(self.slo_engine, doc)
+        return doc
+
     def post_warmup_compiles(self) -> dict:
         """Per-bucket fused-program compiles since start()'s warmup — the
         compile-storm bound: 0 everywhere means steady-state traffic
@@ -183,9 +212,12 @@ class ScoringServer:
 
     # -- request API ---------------------------------------------------------
     def submit(self, row: dict,
-               timeout_ms: Optional[float] = None) -> Future:
+               timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Admit one request. Raises ``KeyError`` (strict validation) or
-        ``BackpressureError`` (queue full) instead of queueing doomed work."""
+        ``BackpressureError`` (queue full) instead of queueing doomed
+        work. ``trace_id`` carries the request-scoped trace context
+        through the batcher into the flight recorder."""
         if self.strict:
             try:
                 check_row(row, self.required_keys)
@@ -193,27 +225,39 @@ class ScoringServer:
                 self.metrics.record_rejected(invalid=True)
                 raise
         try:
-            fut = self.batcher.submit(row, timeout_ms=timeout_ms)
-        except BackpressureError:
+            fut = self.batcher.submit(row, timeout_ms=timeout_ms,
+                                      trace_id=trace_id)
+        except BackpressureError as e:
             self.metrics.record_rejected(invalid=False)
+            # the flight recorder sees overload, rate-limited: sustained
+            # backpressure fires at request rate and must not flood the
+            # ring it exists to fill with useful history
+            events.emit_limited(
+                f"bp:{id(self)}", 1.0, "serving.backpressure_reject",
+                trace_id=trace_id, model=self.event_label,
+                queueDepth=self.batcher.queue_depth,
+                retryAfterS=round(e.retry_after_s, 4))
             raise
         self.metrics.record_admitted()
         return fut
 
     def submit_blocking(self, row: dict,
                         timeout_ms: Optional[float] = None,
-                        max_wait_s: Optional[float] = None) -> Future:
+                        max_wait_s: Optional[float] = None,
+                        trace_id: Optional[str] = None) -> Future:
         """``submit`` that absorbs backpressure
         (``batcher.absorb_backpressure``): the client loop for replay
         drivers (runner SERVE, ``cli serve``); strict-validation
         ``KeyError`` still raises immediately."""
         from transmogrifai_tpu.serving.batcher import absorb_backpressure
         return absorb_backpressure(
-            lambda: self.submit(row, timeout_ms=timeout_ms),
+            lambda: self.submit(row, timeout_ms=timeout_ms,
+                                trace_id=trace_id),
             max_wait_s=max_wait_s)
 
-    def score(self, row: dict, timeout_s: Optional[float] = None) -> dict:
-        return self.submit(row).result(timeout=timeout_s)
+    def score(self, row: dict, timeout_s: Optional[float] = None,
+              trace_id: Optional[str] = None) -> dict:
+        return self.submit(row, trace_id=trace_id).result(timeout=timeout_s)
 
     def score_many(self, rows: Sequence[dict],
                    timeout_s: Optional[float] = None) -> list[dict]:
@@ -291,6 +335,8 @@ class ScoringServer:
             down_s = time.monotonic() - self._degraded_since
             self._degraded_since = None
             self.metrics.record_recovery()
+            events.emit("serving.degraded_exit", model=self.event_label,
+                        downSeconds=round(down_s, 3))
             warnings.warn(
                 f"serving: compiled path recovered after {down_s:.1f}s "
                 "degraded", RuntimeWarning)
@@ -301,6 +347,8 @@ class ScoringServer:
             self._degraded_since = time.monotonic()
             self._last_probe = self._degraded_since
             self.metrics.record_degraded_entry()
+            events.emit("serving.degraded_enter", model=self.event_label,
+                        error=f"{type(err).__name__}: {str(err)[:200]}")
             warnings.warn(
                 "serving: compiled scorer failed "
                 f"({type(err).__name__}: {str(err)[:140]}); degrading to "

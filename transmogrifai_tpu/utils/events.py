@@ -1,0 +1,380 @@
+"""Flight recorder: a bounded wide-event ring with durable JSONL spill
+and dump-on-incident snapshots.
+
+Spans (``utils/tracing.py``) answer "where did the time go"; the flight
+recorder answers "what HAPPENED" — the black-box log every production
+inference stack keeps so the 30 seconds before an incident can be
+reconstructed after the fact. One process-global :class:`EventRing`
+collects wide events from every subsystem:
+
+==============================  =============================================
+``serve.batch``                 micro-batch fan-in: one event per batch,
+                                member trace ids (``traceIds``)
+``serve.dispatch``              one batch dispatch (wall, rows, trace ids)
+``serve.reply``                 per-batch settlement, columnar:
+                                ``traceIds[i]`` <-> ``latenciesMs[i]``,
+                                failures in ``failedIds`` (a member's
+                                admission epoch = event ts - latencyMs;
+                                queue wait = latencyMs - the batch's
+                                dispatch wallMs)
+``serve.expired``               queue-deadline expiries of traced requests
+``serving.degraded_enter/exit`` compiled-path degradation lifecycle
+``serving.backpressure_reject`` admission-queue rejections (rate-limited)
+``serving.compile``             a padding bucket compiled a fused program
+``fleet.swap`` / ``fleet.swap_failed`` / ``fleet.gate_rejected``
+                                hot-swap lifecycle + shadow parity gate
+``continuous.drift_trigger``    a drift window breached + triggered
+``continuous.retrain`` / ``continuous.retrain_failed``
+                                retrain attempts and their failures
+``continuous.promoted``         the LINEAGE event: promoted version ->
+                                drift window + retrain that produced it
+``fault.injected``              a chaos-plan fault fired at a site
+``http.access``                 sampled structured access log
+==============================  =============================================
+
+Design constraints (the serving hot path pays for this):
+
+- **cheap**: ``emit`` is one ``time.time()``, a tuple build, and a
+  deque append under a lock — no serialization. A disabled ring costs
+  one attribute check.
+- **bounded**: the ring keeps the newest ``maxlen`` events (evictions
+  counted in ``dropped``); the JSONL spill is the durable record.
+- **durable**: with ``configure(spill_path=...)`` every event is also
+  appended to a JSONL file under the daemon's state dir, so ``grep
+  <trace_id>`` reconstructs any request's path after the process is
+  gone. Serialization + writes happen on a background writer thread
+  (woken every ``flush_every`` pending events and on a short timer) —
+  an inline flush would stall the batcher worker mid-settle and cost
+  the hot path an order of magnitude more than the emit itself.
+  ``flush()`` forces a synchronous drain (tests, incident dumps,
+  interpreter exit).
+- **incident snapshots**: :func:`dump_incident` freezes the recent event
+  tail, the span-ring tail, and a metrics scrape into one JSON document
+  — written automatically by the continuous loop on gate rejections,
+  retrain abandonment, and unhandled loop errors.
+
+Event documents are camelCase-keyed (the exported-JSON naming contract,
+linted by ``scripts/check_metric_names.py``): ``{"ts": epoch_seconds,
+"kind": ..., "traceId": ... , **attrs}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+__all__ = ["EventRing", "events", "emit", "dump_incident"]
+
+#: default bounded-ring capacity (a long-lived daemon keeps the newest)
+DEFAULT_MAXLEN = 4096
+#: spill serialization batch: events buffer in memory and hit the file
+#: every this-many emits (amortizing json + write off the hot path)
+DEFAULT_FLUSH_EVERY = 128
+
+
+def _event_doc(ev: tuple) -> dict:
+    ts, kind, trace_id, attrs = ev
+    doc = {"ts": ts, "kind": kind}
+    if trace_id is not None:
+        doc["traceId"] = trace_id
+    if attrs:
+        doc.update(attrs)
+    return doc
+
+
+class EventRing:
+    """Thread-safe bounded wide-event ring with optional JSONL spill."""
+
+    def __init__(self, maxlen: int = DEFAULT_MAXLEN):
+        self.enabled = True
+        self._lock = threading.Lock()
+        #: serializes actual file writes (writer thread vs sync flush)
+        self._write_lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(maxlen))
+        self._pending: list = []
+        self._spill_path: Optional[str] = None
+        self._spill_fh = None
+        self._writer: Optional[threading.Thread] = None
+        self._writer_wake = threading.Event()
+        self._writer_stop = threading.Event()
+        self.flush_every = DEFAULT_FLUSH_EVERY
+        # counters (exported as transmogrifai_events_* series)
+        self.emitted = 0
+        self.dropped = 0
+        self.spilled = 0
+        self.spill_lost = 0
+        self.suppressed = 0
+        #: per-key state for emit_limited: key -> [last_ts, suppressed_n]
+        self._limits: dict = {}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, *, spill_path: Optional[str] = None,
+                  maxlen: Optional[int] = None,
+                  flush_every: Optional[int] = None) -> "EventRing":
+        """(Re)configure the ring. ``spill_path`` turns on the durable
+        JSONL spill (parent dirs created; file appended — restarts keep
+        the history) and starts the background writer; ``None`` turns
+        both off. ``maxlen`` resizes the ring keeping the newest
+        events."""
+        self.flush()
+        self._stop_writer()
+        with self._lock:
+            if self._spill_fh is not None:
+                try:
+                    self._spill_fh.close()
+                except OSError:
+                    pass
+                self._spill_fh = None
+            self._spill_path = spill_path
+            if flush_every is not None:
+                self.flush_every = max(int(flush_every), 1)
+            if maxlen is not None and maxlen != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=int(maxlen))
+        if spill_path is not None:
+            self._writer_stop.clear()
+            self._writer_wake.clear()
+            self._writer = threading.Thread(
+                target=self._writer_loop,
+                name="transmogrifai-events-spill", daemon=True)
+            self._writer.start()
+        return self
+
+    def _stop_writer(self) -> None:
+        writer = self._writer
+        if writer is None:
+            return
+        self._writer_stop.set()
+        self._writer_wake.set()
+        writer.join(timeout=5.0)
+        self._writer = None
+
+    @property
+    def spill_path(self) -> Optional[str]:
+        return self._spill_path
+
+    def reset(self) -> None:
+        """Drop every buffered event and counter (tests; ``configure``
+        keeps history on purpose — a daemon's restart must not)."""
+        with self._lock:
+            self._ring.clear()
+            self._pending = []
+            self.emitted = self.dropped = 0
+            self.spilled = self.spill_lost = self.suppressed = 0
+            self._limits = {}
+
+    # -- emission ------------------------------------------------------------
+    def emit(self, kind: str, trace_id: Optional[str] = None,
+             t: Optional[float] = None, **attrs) -> None:
+        """Record one wide event. ``attrs`` keys are camelCase (they land
+        verbatim in the JSONL). ``t`` backdates the event (epoch seconds)
+        for retroactively recorded facts (e.g. admission times known only
+        at batch pickup)."""
+        if not self.enabled:
+            return
+        ev = (t if t is not None else time.time(), kind, trace_id,
+              attrs or None)
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+            self.emitted += 1
+            if self._spill_path is not None:
+                self._pending.append(ev)
+                wake = len(self._pending) >= self.flush_every
+            else:
+                wake = False
+        if wake:
+            # hand the batch to the writer thread — NEVER serialize or
+            # write inline: an emit on the batcher worker would stall
+            # the whole serving pipeline for the flush's duration
+            self._writer_wake.set()
+
+    def count_suppressed(self, n: int = 1) -> None:
+        """Account events a caller withheld by its own rate limiting
+        (e.g. the HTTP access-log per-second cap) — under the ring
+        lock, so ``reset()`` and the exported counter stay coherent."""
+        with self._lock:
+            self.suppressed += n
+
+    def emit_limited(self, key: str, min_interval_s: float, kind: str,
+                     trace_id: Optional[str] = None, **attrs) -> bool:
+        """``emit`` at most once per ``min_interval_s`` per ``key`` —
+        for events a pathological regime fires at request rate (e.g.
+        backpressure rejections under sustained overload). Suppressed
+        occurrences are counted and reported on the next emitted event
+        (``suppressedSince``), so the record shows volume, bounded."""
+        now = time.monotonic()
+        with self._lock:
+            state = self._limits.get(key)
+            if state is not None and now - state[0] < min_interval_s:
+                state[1] += 1
+                self.suppressed += 1
+                return False
+            since = state[1] if state is not None else 0
+            self._limits[key] = [now, 0]
+        if since:
+            attrs["suppressedSince"] = since
+        self.emit(kind, trace_id=trace_id, **attrs)
+        return True
+
+    # -- reading -------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def tail(self, n: Optional[int] = None) -> list[dict]:
+        """The newest ``n`` events (all retained when ``None``), oldest
+        first, as JSON-able documents."""
+        with self._lock:
+            evs = list(self._ring)
+        if n is not None:
+            evs = evs[-n:]
+        return [_event_doc(e) for e in evs]
+
+    def find(self, trace_id: str) -> list[dict]:
+        """Every retained event mentioning ``trace_id`` — as the event's
+        own id or inside a member/id list attr (the in-memory analog of
+        grepping the spill JSONL)."""
+        out = []
+        for doc in self.tail():
+            if doc.get("traceId") == trace_id:
+                out.append(doc)
+                continue
+            for v in doc.values():
+                if isinstance(v, (list, tuple)) and any(
+                        trace_id == m or (isinstance(m, (list, tuple))
+                                          and trace_id in m) for m in v):
+                    out.append(doc)
+                    break
+        return out
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"emitted": self.emitted, "dropped": self.dropped,
+                    "spilled": self.spilled,
+                    "spillLost": self.spill_lost,
+                    "suppressed": self.suppressed,
+                    "ringSize": len(self._ring),
+                    "spillPath": self._spill_path}
+
+    # -- spill ---------------------------------------------------------------
+    def _writer_loop(self) -> None:
+        while not self._writer_stop.is_set():
+            self._writer_wake.wait(timeout=0.5)
+            self._writer_wake.clear()
+            self._drain()
+        self._drain()
+
+    def _drain(self) -> None:
+        """Serialize + write whatever is pending. Takes the write lock
+        first, the ring lock only for the list swap — serialization and
+        IO never block emits."""
+        with self._write_lock:
+            with self._lock:
+                if not self._pending:
+                    return
+                pending, self._pending = self._pending, []
+                spill_path = self._spill_path
+            if spill_path is None:
+                return
+            try:
+                if self._spill_fh is None:
+                    parent = os.path.dirname(spill_path)
+                    if parent:
+                        os.makedirs(parent, exist_ok=True)
+                    self._spill_fh = open(spill_path, "a")
+                # serialize one event at a time, yielding the GIL
+                # between lines: a single join over a big batch would
+                # hold the GIL in ~5ms slices and visibly starve the
+                # batcher worker + submit loop on small hosts (the spill
+                # is background work — it must LOSE every GIL race)
+                write = self._spill_fh.write
+                for e in pending:
+                    write(json.dumps(_event_doc(e), default=str) + "\n")
+                    time.sleep(0)
+                self._spill_fh.flush()
+                with self._lock:
+                    self.spilled += len(pending)
+            except OSError:
+                # failure-ok: the spill is redundancy over the in-memory
+                # ring; a full disk must not take the serving path down.
+                # But the loss is ACCOUNTED — the exported counters must
+                # say the JSONL has holes, not claim a complete record
+                self._spill_fh = None
+                with self._lock:
+                    self.spill_lost += len(pending)
+
+    def flush(self) -> None:
+        """Synchronously drain the pending spill (tests, incident dumps,
+        shutdown)."""
+        self._drain()
+
+    def close(self) -> None:
+        self._stop_writer()
+        self._drain()
+        with self._lock:
+            if self._spill_fh is not None:
+                try:
+                    self._spill_fh.close()
+                except OSError:
+                    pass
+                self._spill_fh = None
+
+
+#: process-global flight recorder (like ``tracing.recorder``); the
+#: continuous loop points its spill under state_dir at startup
+events = EventRing()
+emit = events.emit
+
+import atexit  # noqa: E402 — after the global exists
+
+atexit.register(events.close)
+
+
+def dump_incident(dir_path: str, reason: str, *,
+                  scrape_fn: Optional[Callable[[], str]] = None,
+                  extra: Optional[dict] = None,
+                  max_events: int = 1024,
+                  max_spans: int = 512) -> Optional[str]:
+    """Freeze the black box: write one JSON snapshot — the newest
+    ``max_events`` flight-recorder events, the newest ``max_spans``
+    closed spans, a metrics scrape (``scrape_fn()``, best-effort), the
+    reason, and caller ``extra`` — under ``dir_path`` (an ``incidents/``
+    subdir is created). Returns the written path, or ``None`` if the
+    write failed (an incident dump must never compound the incident)."""
+    from transmogrifai_tpu.utils.tracing import recorder
+    events.flush()
+    spans = recorder.spans[-max_spans:]
+    doc = {
+        "reason": reason,
+        "at": time.time(),
+        "events": events.tail(max_events),
+        "eventCounters": events.to_json(),
+        "spans": [{"spanId": s.span_id, "parentId": s.parent_id,
+                   "name": s.name, "t0": s.t0, "t1": s.t1,
+                   "wallSeconds": round(s.wall_s, 6),
+                   "thread": s.thread, "attrs": dict(s.attrs)}
+                  for s in spans],
+        "extra": extra or {},
+    }
+    if scrape_fn is not None:
+        try:
+            doc["metrics"] = scrape_fn()
+        except Exception as e:  # noqa: BLE001 — a broken collector must not lose the dump
+            doc["metricsError"] = f"{type(e).__name__}: {e}"
+    try:
+        from transmogrifai_tpu.utils.durable import atomic_json_dump
+        inc_dir = os.path.join(dir_path, "incidents")
+        os.makedirs(inc_dir, exist_ok=True)
+        slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason)[:60]
+        path = os.path.join(
+            inc_dir, f"incident_{int(time.time() * 1e3):013d}_{slug}.json")
+        atomic_json_dump(doc, path, indent=1, default=str)
+        return path
+    except OSError:
+        return None

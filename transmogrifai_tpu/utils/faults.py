@@ -208,8 +208,14 @@ class FaultPlan:
 
 
 def _inject(spec: FaultSpec, site: str, inv: int) -> None:
+    from transmogrifai_tpu.utils.events import events
     from transmogrifai_tpu.utils.profiling import run_counters
     run_counters.faults_injected += 1
+    # the flight recorder marks injections so an incident dump produced
+    # DURING a chaos run is self-explaining: the fault event sits right
+    # before the failure cascade it caused
+    events.emit("fault.injected", site=site, invocation=inv,
+                faultKind=spec.kind)
     tag = f"injected fault at {site}#{inv}"
     if spec.kind == "slow":
         import time

@@ -21,12 +21,20 @@ costs nothing on the serving hot path.
 
 from __future__ import annotations
 
+import functools
 import re
+import time
 from typing import Callable, Optional
 
 __all__ = ["PromRegistry", "build_registry", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: process birth (this module imports with the package): the uptime
+#: gauge's zero — restarts reset it, which is exactly what makes fleet
+#: scrapes correlatable across restarts (a counter that dropped AND
+#: uptime near zero = the process bounced, not the workload)
+_PROCESS_T0 = time.monotonic()
 
 _NAME_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
 _TYPES = ("counter", "gauge", "histogram")
@@ -128,6 +136,96 @@ class PromRegistry:
                     lines.append(f"{m.name}{_fmt_labels(labels)} "
                                  f"{_fmt_value(value)}")
         return "\n".join(lines) + "\n"
+
+
+@functools.lru_cache(maxsize=1)
+def _build_info_labels() -> dict:
+    """One stable label set per process (version/platform provenance);
+    cached — VersionInfo shells out to git on first call."""
+    try:
+        from transmogrifai_tpu.utils.version import VersionInfo
+        info = VersionInfo.to_json()
+    except Exception:  # noqa: BLE001 — build info must never break a scrape
+        info = {}
+    import platform as _platform
+    return {"version": str(info.get("version") or "unknown"),
+            "git_commit": str(info.get("gitCommit") or "unknown"),
+            "jax_version": str(info.get("jaxVersion") or "unknown"),
+            "backend": str(info.get("backend") or "unknown"),
+            "python_version": _platform.python_version()}
+
+
+def _process_collectors(reg: PromRegistry) -> None:
+    """Series every registry carries: build provenance + uptime, so any
+    fleet member's scrape is correlatable across restarts and versions
+    (the Prometheus ``*_build_info`` convention: constant 1, labels
+    carry the facts, dashboards ``join`` on them)."""
+    reg.register(
+        "transmogrifai_build_info", "gauge",
+        "constant 1; labels carry version/git/jax/backend provenance",
+        lambda: [(_build_info_labels(), 1)])
+    reg.register(
+        "transmogrifai_process_uptime_seconds", "gauge",
+        "seconds since this process imported the framework",
+        lambda: [({}, time.monotonic() - _PROCESS_T0)])
+
+
+def _event_collectors(reg: PromRegistry) -> None:
+    """The flight recorder's own accounting (``utils/events.py``): how
+    much history the black box holds and whether it is losing any."""
+    from transmogrifai_tpu.utils.events import events
+
+    for attr, name, help_ in (
+            ("emitted", "emitted", "wide events recorded"),
+            ("dropped", "dropped", "events evicted from the bounded "
+                                   "ring (oldest-first)"),
+            ("spilled", "spilled", "events written to the durable JSONL "
+                                   "spill"),
+            ("spill_lost", "spill_lost", "events lost to spill write "
+                                         "failures (the JSONL has "
+                                         "holes)"),
+            ("suppressed", "suppressed", "events withheld by rate "
+                                         "limiting")):
+        reg.register(f"transmogrifai_events_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(events, a))])
+    reg.register("transmogrifai_events_ring_size", "gauge",
+                 "events currently retained in the ring",
+                 lambda: [({}, len(events))])
+
+
+def _slo_collectors(reg: PromRegistry, engine) -> None:
+    """The ``transmogrifai_slo_*`` surface over a ``utils.slo.SLOEngine``:
+    targets, per-(alert, window) burn rates, and 0/1 alert states —
+    enough for dashboards to chart budget burn and for an external
+    alertmanager to mirror the engine's own firing decisions. The three
+    gauge collectors share one short-lived memo so a single scrape runs
+    a single engine evaluation (not three)."""
+    memo = {"t": 0.0, "v": None}
+
+    def samples(key):
+        now = time.monotonic()
+        if memo["v"] is None or now - memo["t"] > 0.25:
+            memo["v"] = engine.gauge_samples()
+            memo["t"] = now
+        return memo["v"][key]
+
+    reg.register(
+        "transmogrifai_slo_target", "gauge",
+        "configured good-fraction target per ratio objective",
+        lambda: samples("targets"))
+    reg.register(
+        "transmogrifai_slo_burn_rate", "gauge",
+        "error-budget burn rate per objective, alert and window "
+        "(1.0 = exactly sustainable)",
+        lambda: samples("burns"))
+    reg.register(
+        "transmogrifai_slo_alert_firing", "gauge",
+        "1 while the objective's multi-window alert fires",
+        lambda: samples("firing"))
+    reg.register(
+        "transmogrifai_slo_evaluations_total", "counter",
+        "SLO engine evaluations",
+        lambda: [({}, engine.evaluations)])
 
 
 def _app_collectors(reg: PromRegistry) -> None:
@@ -394,7 +492,7 @@ def _continuous_collectors(reg: PromRegistry, cont) -> None:
 
 
 def build_registry(serving=None, server=None, fleet=None, continuous=None,
-                   include_app: bool = True) -> PromRegistry:
+                   slo=None, include_app: bool = True) -> PromRegistry:
     """The standard registry: process-wide training/run/sweep series
     (``include_app``) plus the full serving surface — unlabeled for one
     ``ServingMetrics`` (``serving``), ``model``-labeled per lane plus the
@@ -402,12 +500,19 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     exclusive with ``serving``). ``continuous`` (a ``ContinuousLoop``)
     adds the ``transmogrifai_continuous_*`` drift/retrain/promotion
     series and composes with ``fleet`` — the loop's scrape endpoint
-    exposes both. ``server`` (a ``ScoringServer``) is optional extra
-    context reserved for future gauges."""
+    exposes both. ``slo`` (a ``utils.slo.SLOEngine``) adds the
+    ``transmogrifai_slo_*`` burn-rate surface. ``server`` (a
+    ``ScoringServer``) is optional extra context reserved for future
+    gauges. EVERY registry carries ``transmogrifai_build_info``, the
+    process-uptime gauge, and the flight recorder's
+    ``transmogrifai_events_*`` accounting, so any scrape is correlatable
+    across restarts."""
     if serving is not None and fleet is not None:
         raise ValueError("pass serving= or fleet=, not both (the serving "
                          "series would collide)")
     reg = PromRegistry()
+    _process_collectors(reg)
+    _event_collectors(reg)
     if include_app:
         _app_collectors(reg)
     if serving is not None:
@@ -416,4 +521,6 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
         _fleet_collectors(reg, fleet)
     if continuous is not None:
         _continuous_collectors(reg, continuous)
+    if slo is not None:
+        _slo_collectors(reg, slo)
     return reg

@@ -1,0 +1,477 @@
+"""SLO burn-rate engine: declarative objectives over the live metrics.
+
+The SRE-workbook alerting layer on top of the counters the process
+already keeps: an operator declares objectives —
+
+- ``availability``: fraction of requests settling OK >= ``target``
+  (errors = failed + expired settlements),
+- ``latency``: fraction of requests at or under ``threshold_s`` >=
+  ``target`` (measured from the monotonic cumulative latency histogram,
+  so the bound snaps to a ``LATENCY_BUCKETS_S`` bucket boundary),
+- ``staleness``: the continuous loop's drift-to-promotion staleness
+  stays under ``bound_s`` (a freshness bound, not a ratio),
+
+and the engine turns them into **multi-window burn rates**: the error
+budget is ``1 - target``; the burn rate over a window is ``observed
+error ratio / budget`` (1.0 = burning exactly the sustainable rate). An
+alert fires only when BOTH its short and long windows burn above the
+factor — the short window gives fast detection, the long window keeps a
+single bad scrape from paging. Defaults are the SRE-workbook pair:
+``fast`` = 14.4x over (5m, 1h) — budget gone in ~2 days — and ``slow`` =
+6x over (30m, 6h).
+
+Sampling: :meth:`SLOEngine.observe` snapshots the cumulative counters
+and stores DELTAS; an interval in which any summed counter moved
+backwards (a hot-swap dropped a lane's metrics) is recorded as zero
+traffic — never as negative traffic, and never as a phantom error-only
+sample — so window sums survive fleet topology changes. ``evaluate``/``status`` are
+what the ``transmogrifai_slo_*`` gauges, ``/healthz`` readiness, and
+``cli slo`` render; tests drive the same engine with synthetic
+timelines by passing explicit ``t`` values.
+
+Config file format (``--slo`` on ``cli serve`` / ``cli continuous``)::
+
+    {"objectives": [
+      {"name": "availability", "kind": "availability", "target": 0.999},
+      {"name": "p99-latency", "kind": "latency",
+       "target": 0.99, "thresholdMs": 250},
+      {"name": "freshness", "kind": "staleness", "boundS": 3600}
+    ]}
+
+See docs/OBSERVABILITY.md ("SLOs and burn-rate alerts").
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+__all__ = ["BurnWindow", "SLObjective", "SLOEngine", "fold_health",
+           "objectives_from_json", "load_objectives", "DEFAULT_WINDOWS"]
+
+KINDS = ("availability", "latency", "staleness")
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    """One multi-window burn-rate alert: fires when the burn exceeds
+    ``factor`` over BOTH the short and the long window."""
+    short_s: float
+    long_s: float
+    factor: float
+
+
+#: SRE-workbook defaults: "fast" pages (budget exhausted in ~2 days at
+#: this rate), "slow" tickets
+DEFAULT_WINDOWS: dict = {
+    "fast": BurnWindow(short_s=300.0, long_s=3600.0, factor=14.4),
+    "slow": BurnWindow(short_s=1800.0, long_s=21600.0, factor=6.0),
+}
+
+
+@dataclass
+class SLObjective:
+    """One declarative objective (see module docstring for kinds)."""
+    name: str
+    kind: str = "availability"
+    target: float = 0.999            # good-fraction target (ratio kinds)
+    threshold_s: Optional[float] = None   # latency bound (kind=latency)
+    bound_s: Optional[float] = None       # freshness bound (staleness)
+    windows: dict = field(default_factory=lambda: dict(DEFAULT_WINDOWS))
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"SLO {self.name!r}: kind {self.kind!r} "
+                             f"must be one of {KINDS}")
+        if self.kind in ("availability", "latency") \
+                and not 0.0 < self.target < 1.0:
+            raise ValueError(f"SLO {self.name!r}: target {self.target} "
+                             "must be in (0, 1)")
+        if self.kind == "latency" and not self.threshold_s:
+            raise ValueError(f"SLO {self.name!r}: latency objectives "
+                             "need threshold_s")
+        if self.kind == "staleness" and not self.bound_s:
+            raise ValueError(f"SLO {self.name!r}: staleness objectives "
+                             "need bound_s")
+        fixed = {}
+        for alert, w in self.windows.items():
+            fixed[alert] = w if isinstance(w, BurnWindow) \
+                else BurnWindow(*w)
+        self.windows = fixed
+
+
+def objectives_from_json(doc) -> list[SLObjective]:
+    """Parse objectives from the config-file shape: a list of objective
+    dicts, or ``{"objectives": [...]}``. Keys are camelCase in the file
+    (``thresholdMs``/``thresholdS``, ``boundS``, ``windows`` mapping
+    alert name to ``[shortS, longS, factor]``)."""
+    if isinstance(doc, dict):
+        doc = doc.get("objectives", [])
+    out = []
+    for i, o in enumerate(doc):
+        if isinstance(o, SLObjective):
+            out.append(o)
+            continue
+        if not isinstance(o, dict):
+            raise ValueError(f"objective #{i} is not an object: {o!r}")
+        threshold_s = o.get("thresholdS")
+        if threshold_s is None and o.get("thresholdMs") is not None:
+            threshold_s = float(o["thresholdMs"]) / 1e3
+        windows = None
+        if "windows" in o:
+            windows = {alert: BurnWindow(float(w[0]), float(w[1]),
+                                         float(w[2]))
+                       for alert, w in o["windows"].items()}
+        kwargs = dict(
+            name=o.get("name", f"slo{i}"),
+            kind=o.get("kind", "availability"),
+            target=float(o.get("target", 0.999)),
+            threshold_s=threshold_s,
+            bound_s=(float(o["boundS"]) if o.get("boundS") is not None
+                     else None))
+        if windows is not None:
+            kwargs["windows"] = windows
+        out.append(SLObjective(**kwargs))
+    return out
+
+
+def load_objectives(path: str) -> list[SLObjective]:
+    with open(path) as fh:
+        return objectives_from_json(json.load(fh))
+
+
+def fold_health(engine: Optional["SLOEngine"], doc: dict) -> None:
+    """Fold an engine's alert state into an endpoint ``/healthz`` doc
+    (shared by ``ScoringServer``/``FleetServer``/``ContinuousLoop``):
+    attaches the ``slo`` block, and a firing fast-burn alert — the
+    error budget burning at page rate — drops ``ready`` and marks the
+    status ``slo_burning`` so an upstream load-balancer sheds traffic
+    before anyone pages. No-op when ``engine`` is None."""
+    if engine is None:
+        return
+    slo = engine.health()
+    doc["slo"] = slo
+    if slo["fastBurnFiring"]:
+        doc["ready"] = False
+        doc["status"] = "slo_burning"
+
+
+class _Bound:
+    """One objective bound to its live data source."""
+
+    def __init__(self, obj: SLObjective, cap: int,
+                 counts_fn: Optional[Callable[[], tuple]] = None,
+                 value_fn: Optional[Callable[[], float]] = None):
+        self.obj = obj
+        self.cap = cap                # sample retention (see observe)
+        self.longest_s = 3600.0       # longest window (gap rebaseline)
+        self.counts_fn = counts_fn    # () -> cumulative (good, bad)
+        self.value_fn = value_fn      # () -> current gauge value
+        self.samples: collections.deque = collections.deque()
+        self.last: Optional[tuple] = None
+        self.value: float = 0.0
+
+
+def _histogram_counts(hist: dict, threshold_s: float) -> tuple:
+    """(good, bad) from one cumulative Prometheus-style histogram doc:
+    good = requests at or under the smallest bucket bound >= threshold
+    (conservative: the objective is judged at a real bucket boundary).
+    A threshold ABOVE every finite bucket is judged at the largest
+    finite bound — the +Inf tail is unmeasured latency and must not
+    silently count as meeting the SLO (which would make the objective
+    unfireable)."""
+    total = int(hist.get("count", 0))
+    best_bound, best_n = None, None
+    largest = None
+    for le, n in hist.get("buckets", {}).items():
+        if le == "+Inf":
+            continue
+        bound = float(le)
+        if largest is None or bound > largest[0]:
+            largest = (bound, int(n))
+        if bound >= threshold_s and (best_bound is None
+                                     or bound < best_bound):
+            best_bound, best_n = bound, int(n)
+    if best_n is None:
+        if largest is None:
+            return total, 0
+        return largest[1], total - largest[1]
+    return best_n, total - best_n
+
+
+class SLOEngine:
+    """Evaluates bound objectives into multi-window burn-rate alert
+    states (see module docstring)."""
+
+    def __init__(self, max_samples: Optional[int] = None,
+                 min_observe_interval_s: float = 1.0):
+        """``max_samples`` (per objective) defaults to covering the
+        objective's LONGEST configured window at the observe throttle
+        rate — a fixed cap would silently truncate the slow alert's 6h
+        long window under 1/s health probes, degenerating the smoothing
+        it exists for. ~21600 samples (6h at 1/s) cost ~2 MB per
+        objective. Pass an explicit cap to override (tests)."""
+        self._bound: list[_Bound] = []
+        self.max_samples = None if max_samples is None else int(max_samples)
+        self.min_observe_interval_s = float(min_observe_interval_s)
+        self._last_observe = 0.0     # monotonic throttle clock
+        #: wall-clock evaluate() memo — a load balancer probing /healthz
+        #: at a few Hz must not re-walk ~20k window samples per probe;
+        #: invalidated by any recorded observation
+        self._eval_cache: Optional[tuple] = None
+        self.evaluations = 0
+
+    # -- construction --------------------------------------------------------
+    def add(self, obj: SLObjective,
+            counts_fn: Optional[Callable[[], tuple]] = None,
+            value_fn: Optional[Callable[[], float]] = None) -> "SLOEngine":
+        if obj.kind == "staleness":
+            if value_fn is None:
+                raise ValueError(f"SLO {obj.name!r}: staleness needs a "
+                                 "value_fn")
+        elif counts_fn is None:
+            raise ValueError(f"SLO {obj.name!r}: {obj.kind} needs a "
+                             "counts_fn")
+        longest = max((w.long_s for w in obj.windows.values()),
+                      default=3600.0)
+        if self.max_samples is not None:
+            cap = self.max_samples
+        else:
+            cap = int(longest / self.min_observe_interval_s) + 16
+        bound = _Bound(obj, cap, counts_fn, value_fn)
+        bound.longest_s = longest
+        self._bound.append(bound)
+        return self
+
+    @classmethod
+    def for_serving(cls, spec, metrics_list_fn,
+                    staleness_fn: Optional[Callable[[], float]] = None
+                    ) -> "SLOEngine":
+        """Bind objectives to live ``ServingMetrics``: ``spec`` is a
+        prebuilt engine (returned as-is), a config path, or a list of
+        ``SLObjective``/dicts; ``metrics_list_fn()`` returns the
+        ``ServingMetrics`` to sum over (one for a ``ScoringServer``,
+        every active lane's for a fleet); ``staleness_fn`` backs
+        staleness objectives (the continuous loop's)."""
+        if isinstance(spec, SLOEngine):
+            return spec
+        if isinstance(spec, str):
+            objectives = load_objectives(spec)
+        else:
+            objectives = objectives_from_json(spec)
+        engine = cls()
+        for obj in objectives:
+            if obj.kind == "availability":
+                def counts(fn=metrics_list_fn):
+                    good = bad = 0
+                    for m in fn():
+                        good += m.completed
+                        bad += m.failed
+                    return good, bad
+                engine.add(obj, counts_fn=counts)
+            elif obj.kind == "latency":
+                def counts(fn=metrics_list_fn, thr=obj.threshold_s):
+                    good = bad = 0
+                    for m in fn():
+                        g, b = _histogram_counts(m.latency_histogram(),
+                                                 thr)
+                        good += g
+                        bad += b
+                    return good, bad
+                engine.add(obj, counts_fn=counts)
+            else:
+                if staleness_fn is None:
+                    # a plain serving daemon has no drift/staleness
+                    # source; the objective belongs to the continuous
+                    # loop. Skip-with-warning keeps one objectives file
+                    # shareable between `cli serve` and `cli continuous`
+                    # (the documented config does exactly that) instead
+                    # of killing the server at startup
+                    import warnings
+                    warnings.warn(
+                        f"SLO {obj.name!r}: staleness objective ignored "
+                        "— no staleness source here (continuous loop "
+                        "only)", RuntimeWarning)
+                    continue
+                engine.add(obj, value_fn=staleness_fn)
+        return engine
+
+    @property
+    def objectives(self) -> list[SLObjective]:
+        return [b.obj for b in self._bound]
+
+    # -- sampling ------------------------------------------------------------
+    def observe(self, t: Optional[float] = None) -> bool:
+        """Snapshot the cumulative sources into delta samples. Throttled
+        (``min_observe_interval_s``) when ``t`` is None — scrapes and
+        health probes may call at any rate; explicit ``t`` (tests,
+        synthetic timelines) always records."""
+        if t is None:
+            now_m = time.monotonic()
+            if now_m - self._last_observe < self.min_observe_interval_s:
+                return False
+            self._last_observe = now_m
+            t = time.time()
+        self._eval_cache = None      # new data: memoized state is stale
+        for b in self._bound:
+            if b.counts_fn is not None:
+                good, bad = b.counts_fn()
+                if b.last is None or (
+                        b.samples
+                        and t - b.samples[-1][0] > b.longest_s):
+                    # first observation, or a sampling gap longer than
+                    # every window: the accumulated history must NOT
+                    # land as one delta stamped "now" — a long-resolved
+                    # error burst would fire the burn alerts and shed a
+                    # currently-healthy endpoint. Baseline and move on.
+                    b.last = (good, bad)
+                    b.samples.append((float(t), 0, 0))
+                    continue
+                if good < b.last[0] or bad < b.last[1]:
+                    # ANY component moving backwards means the summed
+                    # sources rebased (a hot-swap dropped a lane): the
+                    # whole interval's deltas are meaningless, so record
+                    # no traffic. Clamping per component instead would
+                    # fabricate an error-only sample at every promotion
+                    # (old lane's good counts vanish, new lane's bad
+                    # counts survive) and spike the very burn windows
+                    # the readiness flip reads.
+                    dg = db = 0
+                else:
+                    dg = good - b.last[0]
+                    db = bad - b.last[1]
+                b.last = (good, bad)
+                b.samples.append((float(t), dg, db))
+                while len(b.samples) > b.cap:
+                    b.samples.popleft()
+            elif b.value_fn is not None:
+                b.value = float(b.value_fn())
+        return True
+
+    @staticmethod
+    def _window_ratio(samples, now: float, window_s: float
+                      ) -> Optional[float]:
+        good = bad = 0
+        for ts, dg, db in reversed(samples):
+            if ts <= now - window_s:
+                break
+            good += dg
+            bad += db
+        total = good + bad
+        if total <= 0:
+            return None     # no traffic in the window: no data
+        return bad / total
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, t: Optional[float] = None) -> dict:
+        """Burn-rate state of every objective. Observes first (throttled
+        unless ``t`` given), so a scrape is self-updating. Wall-clock
+        evaluations (``t=None`` — health probes, scrapes) are memoized
+        until the next recorded observation, so probe rate doesn't
+        multiply the window-sum cost; explicit-``t`` timelines (tests)
+        always compute."""
+        self.observe(t)
+        if t is None and self._eval_cache is not None:
+            return self._eval_cache[1]
+        now = float(t) if t is not None else time.time()
+        self.evaluations += 1
+        out: dict = {}
+        for b in self._bound:
+            obj = b.obj
+            if obj.kind == "staleness":
+                # b.value was refreshed by the observe() above (or is at
+                # most one throttle interval old) — evaluation reads the
+                # cache rather than re-calling value_fn a second time
+                v = b.value
+                burn = v / obj.bound_s if obj.bound_s else 0.0
+                out[obj.name] = {
+                    "kind": obj.kind,
+                    "boundSeconds": obj.bound_s,
+                    "stalenessSeconds": round(v, 3),
+                    "alerts": {"bound": {
+                        "burn": {"current": round(burn, 4)},
+                        "firing": v > obj.bound_s}},
+                    "firing": v > obj.bound_s,
+                }
+                continue
+            budget = 1.0 - obj.target
+            alerts: dict = {}
+            firing_any = False
+            for alert, w in obj.windows.items():
+                burns: dict = {}
+                over = []
+                for label, win_s in (("short", w.short_s),
+                                     ("long", w.long_s)):
+                    ratio = self._window_ratio(b.samples, now, win_s)
+                    burn = 0.0 if ratio is None else ratio / budget
+                    burns[label] = round(burn, 4)
+                    over.append(ratio is not None and burn > w.factor)
+                firing = all(over)
+                firing_any = firing_any or firing
+                alerts[alert] = {"burn": burns, "factor": w.factor,
+                                 "firing": firing}
+            doc = {"kind": obj.kind, "target": obj.target,
+                   "alerts": alerts, "firing": firing_any}
+            if obj.kind == "latency":
+                doc["thresholdSeconds"] = obj.threshold_s
+            out[obj.name] = doc
+        if t is None:
+            self._eval_cache = (time.monotonic(), out)
+        return out
+
+    def status(self, t: Optional[float] = None) -> dict:
+        """The one-call view ``cli slo`` and ``/healthz`` consume.
+        Page severity is an alert's POSITION, not its name: the
+        objective's fastest-detection alert (smallest short window —
+        ``fast`` in the default pair, the sole alert of a staleness
+        bound or a custom single-window set) is the one that flips
+        readiness, so operator-named windows behave identically."""
+        objectives = self.evaluate(t)
+        firing = sorted(n for n, d in objectives.items() if d["firing"])
+        fast = []
+        for b in self._bound:
+            d = objectives.get(b.obj.name)
+            if d is None:
+                continue
+            alerts = d.get("alerts", {})
+            live = {a for a, ad in alerts.items() if ad.get("firing")}
+            if not live:
+                continue
+            if b.obj.kind == "staleness" or len(alerts) == 1:
+                fast.append(b.obj.name)
+                continue
+            page = min(b.obj.windows, key=lambda a: b.obj.windows[a].short_s)
+            if page in live:
+                fast.append(b.obj.name)
+        fast.sort()
+        return {"objectives": objectives, "firing": firing,
+                "fastBurnFiring": bool(fast), "fastFiring": fast}
+
+    def health(self, t: Optional[float] = None) -> dict:
+        """The compact ``/healthz`` block: which objectives fire, and
+        whether any at page severity (the ``fast`` alert, or a breached
+        staleness bound) — the bit that flips endpoint readiness."""
+        s = self.status(t)
+        return {"firing": s["firing"],
+                "fastBurnFiring": s["fastBurnFiring"],
+                "ok": not s["firing"]}
+
+    # -- export --------------------------------------------------------------
+    def gauge_samples(self) -> dict:
+        """Label/value sample lists for the ``transmogrifai_slo_*``
+        gauges (consumed by ``utils/prometheus.py``)."""
+        doc = self.evaluate()
+        targets, burns, firing = [], [], []
+        for name, d in doc.items():
+            if "target" in d:
+                targets.append(({"slo": name}, d["target"]))
+            for alert, a in d.get("alerts", {}).items():
+                for window, burn in a.get("burn", {}).items():
+                    burns.append(({"slo": name, "alert": alert,
+                                   "window": window}, burn))
+                firing.append(({"slo": name, "alert": alert},
+                               1 if a.get("firing") else 0))
+        return {"targets": targets, "burns": burns, "firing": firing}
