@@ -87,6 +87,86 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_one_sync(doc))
     if doc.get("metric") == "continuous_loop":
         errors.extend(_validate_continuous_loop(doc))
+    if doc.get("metric") == "resource_resilience":
+        errors.extend(_validate_resource_resilience(doc))
+    return errors
+
+
+#: faulted-vs-clean winner-metric parity bound for the resource-
+#: resilience artifact: a degraded rung re-trains the same math at a
+#: smaller shape, so any difference is pure fp accumulation noise
+MAX_RESILIENCE_PARITY = 1e-5
+
+
+def _validate_resource_resilience(doc: dict) -> list[str]:
+    """The ``benchmarks/RESOURCE_RESILIENCE.json`` contract: injected
+    ``oom`` faults mid-sweep and mid-serving on CPU must produce (a) a
+    COMPLETED training run whose winner metrics match the un-faulted run
+    within ``MAX_RESILIENCE_PARITY``, with >= 1 degradation rung
+    counted; (b) a serving stream with zero dropped requests and >= 1
+    shed rung; and (c) proof the ladder is additive — with it disabled
+    the same fault still fails fast (recorded candidate failure /
+    row-path degradation), no silent behavior change."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    sweep = doc.get("sweep")
+    if not isinstance(sweep, dict):
+        errors.append("resource-resilience artifact: missing 'sweep' "
+                      "block")
+    else:
+        if sweep.get("completed") is not True:
+            errors.append("resource-resilience artifact: sweep."
+                          "completed must be true — the OOM-faulted run "
+                          "must finish")
+        par = sweep.get("winner_parity")
+        if not num(par):
+            errors.append("resource-resilience artifact: missing "
+                          "numeric sweep.winner_parity")
+        elif par > MAX_RESILIENCE_PARITY:
+            errors.append(
+                f"resource-resilience parity {par} exceeds "
+                f"{MAX_RESILIENCE_PARITY} — the degraded rung trained a "
+                "different model, not the same sweep at a smaller shape")
+        if not pos_int(sweep.get("degradations")):
+            errors.append("resource-resilience artifact: sweep."
+                          "degradations must be >= 1 (a rung must "
+                          "actually have been taken)")
+    serving = doc.get("serving")
+    if not isinstance(serving, dict):
+        errors.append("resource-resilience artifact: missing 'serving' "
+                      "block")
+    else:
+        if serving.get("zero_dropped") is not True:
+            errors.append("resource-resilience artifact: serving."
+                          "zero_dropped must be true — every request "
+                          "settled through the OOM")
+        if not pos_int(serving.get("requests")):
+            errors.append("resource-resilience artifact: serving."
+                          "requests must be a positive int")
+        if not pos_int(serving.get("degradations")):
+            errors.append("resource-resilience artifact: serving."
+                          "degradations must be >= 1 (the shed rung "
+                          "must actually have fired)")
+        if not pos_int(serving.get("buckets_shed")):
+            errors.append("resource-resilience artifact: serving."
+                          "buckets_shed must be >= 1")
+    if doc.get("ladder_disabled_fails_fast") is not True:
+        errors.append("resource-resilience artifact: "
+                      "'ladder_disabled_fails_fast' must be true — the "
+                      "ladder must be additive, never a silent change "
+                      "to the disabled path")
+    counters = doc.get("counters")
+    if not (isinstance(counters, dict)
+            and pos_int(counters.get("degradations"))
+            and pos_int(counters.get("oomEvents"))):
+        errors.append("resource-resilience artifact: 'counters' must "
+                      "record positive int degradations and oomEvents")
     return errors
 
 

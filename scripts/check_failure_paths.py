@@ -22,6 +22,15 @@ Narrow handlers (``except ValueError:`` etc.) are exempt — catching a
 specific exception is already a statement of intent; this lint targets
 the catch-everything pattern that eats faults it never anticipated.
 
+Second rule — **one error classifier**: resource-exhaustion handling
+routes through ``utils.resources.is_resource_exhausted`` /
+``is_disk_full`` (which walk the shared ``__cause__``/``__context__``
+chain), never through ad-hoc string probes. Any ``"RESOURCE_EXHAUSTED"
+in str(e)`` / ``"Out of memory" in ...`` membership test outside
+``utils/resources.py`` is flagged: a handler classifying by local
+string match misses wrapped causes and silently drifts from the ladder
+everyone else rides.
+
 Library use: ``check_file(path) -> [violations]``; CLI: exits 1 listing
 every violation. Wired into tier-1 via ``tests/test_failure_lint.py``.
 """
@@ -42,6 +51,14 @@ __all__ = ["check_file", "check_tree"]
 _OK_RE = re.compile(r"failure-ok|noqa\b[^#]*[—–-]\s*\S")
 
 _BROAD = ("Exception", "BaseException")
+
+#: OOM/ENOSPC message markers whose `in`-comparison outside the shared
+#: classifier is an ad-hoc classification (the thing this lint forbids)
+_CLASSIFIER_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
+                      "out of memory", "No space left")
+#: the one module allowed to string-match those markers (it IS the
+#: classifier)
+_CLASSIFIER_HOME = "resources.py"
 
 
 def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
@@ -80,6 +97,21 @@ def check_file(path: str) -> list[str]:
         return [f"{path}:{e.lineno}: does not parse: {e.msg}"]
     lines = src.splitlines()
     out: list[str] = []
+    if os.path.basename(path) != _CLASSIFIER_HOME:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Compare)
+                    and any(isinstance(op, (ast.In, ast.NotIn))
+                            for op in node.ops)):
+                continue
+            left = node.left
+            if isinstance(left, ast.Constant) \
+                    and isinstance(left.value, str) \
+                    and any(m in left.value for m in _CLASSIFIER_MARKERS):
+                out.append(
+                    f"{path}:{node.lineno}: ad-hoc resource-exhaustion "
+                    "classification (string membership test) — route "
+                    "through utils.resources.is_resource_exhausted / "
+                    "is_disk_full, which walk the full cause chain")
     for node in ast.walk(tree):
         if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
             continue

@@ -41,10 +41,12 @@ _SNAKE_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
 #: not schema fields — their keys are exempt from camelCase.
 #: "objectives"/"alerts" are keyed by operator-chosen SLO/alert names;
 #: "attrs" holds span attributes (python identifiers, snake_case)
+#: "degradationsBySite" is keyed by fault-site names (dotted identifiers
+#: like "sweep.tree_group") — measured things, not schema fields
 DATA_KEYED = {"phases", "stages", "sizeHistogram", "buckets",
               "compileBuckets", "families", "sweep", "customParams",
               "stageOverrides", "readerOverrides", "objectives",
-              "alerts", "attrs"}
+              "alerts", "attrs", "degradationsBySite"}
 
 
 def check_json_doc(doc, where: str, _parent_key: str = "") -> list[str]:
@@ -200,6 +202,29 @@ def collect_violations() -> list[str]:
                                              include_app=False)))
     out.extend(check_json_doc(engine.status(t=1060.0),
                               "SLOEngine.status"))
+
+    # the resource-pressure surfaces (round 11): the counters block every
+    # run json carries, the /healthz pressure state, and the
+    # transmogrifai_resource_* series (already rendered by every
+    # build_registry call above — this block makes sure they render with
+    # NON-ZERO representative data so the collector closures run hot)
+    from transmogrifai_tpu.utils import resources
+
+    rcounters = resources.ResourceCounters()
+    rcounters.note_degradation("sweep.tree_group")
+    rcounters.note_oom()
+    rcounters.note_enospc(cooldown_s=0.0)
+    rcounters.note_write_skipped()
+    out.extend(check_json_doc(rcounters.to_json(),
+                              "ResourceCounters.to_json"))
+    out.extend(check_json_doc(resources.pressure_state(),
+                              "resources.pressure_state"))
+    saved_counters = resources.resource_counters
+    try:
+        resources.resource_counters = rcounters
+        out.extend(check_registry(build_registry(include_app=False)))
+    finally:
+        resources.resource_counters = saved_counters
 
     # the flight recorder's exported surfaces: event JSONL documents and
     # the dump-on-incident snapshot are JSON exports too — camelCase

@@ -435,3 +435,58 @@ def test_tracing_overhead_artifact_committed_and_healthy(checker):
     assert len(art["overhead_trials_pct"]) == art["trials"] >= 3
     assert art["events_emitted"] > 0 and art["spill_lines"] > 0
     assert art["path_reconstructed"] is True
+
+
+def test_resource_resilience_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = {"metric": "resource_resilience", "platform": "cpu",
+            "rows": 4000, "requests": 400, "wall_s": 5.0,
+            "sweep": {"completed": True, "winner_parity": 0.0,
+                      "degradations": 2, "oom_injected": 2},
+            "serving": {"requests": 400, "zero_dropped": True,
+                        "degradations": 1, "buckets_shed": 1},
+            "ladder_disabled_fails_fast": True,
+            "counters": {"degradations": 3, "oomEvents": 3}}
+    assert v(good) == []
+    assert any("completed" in e for e in v(
+        {**good, "sweep": {**good["sweep"], "completed": False}}))
+    assert any("parity" in e for e in v(
+        {**good, "sweep": {**good["sweep"], "winner_parity": 1e-3}}))
+    assert any("degradations" in e for e in v(
+        {**good, "sweep": {**good["sweep"], "degradations": 0}}))
+    assert any("zero_dropped" in e for e in v(
+        {**good, "serving": {**good["serving"], "zero_dropped": False}}))
+    assert any("buckets_shed" in e for e in v(
+        {**good, "serving": {**good["serving"], "buckets_shed": 0}}))
+    assert any("fails_fast" in e.replace("fails fast", "fails_fast")
+               or "ladder" in e for e in v(
+        {**good, "ladder_disabled_fails_fast": False}))
+    assert any("counters" in e for e in v(
+        {**good, "counters": {"degradations": 3}}))
+    assert any("'sweep' block" in e for e in v(
+        {k: x for k, x in good.items() if k != "sweep"}))
+
+
+def test_resource_resilience_artifact_committed_and_healthy(checker):
+    """The round-11 acceptance contract on the COMMITTED artifact:
+    injected OOMs mid-sweep and mid-serving cost degradation rungs, not
+    the run — completed training with winner-metric parity <= 1e-5 vs
+    the un-faulted run, zero dropped serving requests, and the
+    ladder-off leg still failing fast (the ladder is additive)."""
+    path = os.path.join(REPO, "benchmarks", "RESOURCE_RESILIENCE.json")
+    assert os.path.exists(path), \
+        "benchmarks/RESOURCE_RESILIENCE.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "resource_resilience"
+    assert art["sweep"]["completed"] is True
+    assert art["sweep"]["winner_parity"] <= 1e-5
+    assert art["sweep"]["degradations"] >= 2  # both sweep rungs taken
+    assert set(art["sweep"]["rungs"]) == {"sweep.stacked",
+                                          "sweep.tree_group"}
+    assert art["serving"]["zero_dropped"] is True
+    assert art["serving"]["failed"] == 0
+    assert art["serving"]["buckets_shed"] >= 1
+    assert art["ladder_disabled_fails_fast"] is True
+    assert art["counters"]["degradations"] >= 3
+    assert art["counters"]["oomEvents"] >= 3

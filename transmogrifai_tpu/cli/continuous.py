@@ -107,6 +107,14 @@ def add_continuous_args(sp: argparse.ArgumentParser) -> None:
     sp.add_argument("--no-events-spill", action="store_true",
                     help="disable the durable flight-recorder spill "
                          "(state_dir/events.jsonl; on by default)")
+    sp.add_argument("--resource-ladder", choices=("on", "off"),
+                    default=None,
+                    help="override the adaptive degradation ladder "
+                         "(docs/ROBUSTNESS.md 'Resource exhaustion'): "
+                         "OOM-failed retrains halve the row window and "
+                         "back off instead of burning the attempt "
+                         "budget at the same shape. Default: on "
+                         "(TRANSMOGRIFAI_RESOURCE_LADDER)")
 
 
 def _load_workflow(spec: str):

@@ -90,6 +90,14 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                     help="spill flight-recorder events to this JSONL "
                          "file (grep a trace id to reconstruct a "
                          "request's path)")
+    sp.add_argument("--resource-ladder", choices=("on", "off"),
+                    default=None,
+                    help="override the adaptive degradation ladder "
+                         "(docs/ROBUSTNESS.md 'Resource exhaustion'): "
+                         "on OOM the server sheds padding buckets / "
+                         "evicts cold cache entries instead of pinning "
+                         "the row path. Default: on "
+                         "(TRANSMOGRIFAI_RESOURCE_LADDER)")
 
 
 def _read_rows(path: str) -> Iterable[dict]:
@@ -115,12 +123,22 @@ def _observability_setup(args, app_name: str):
     profiled session for ``--trace-out``, point the flight-recorder
     spill at ``--events-out``, load ``--slo`` objectives. Returns the
     parsed objectives (or None)."""
+    if getattr(args, "resource_ladder", None):
+        import os
+        from transmogrifai_tpu.utils.resources import LADDER_ENV
+        os.environ[LADDER_ENV] = \
+            "1" if args.resource_ladder == "on" else "0"
     if getattr(args, "trace_out", None):
         from transmogrifai_tpu.utils.profiling import profiler
         profiler.reset(app_name=app_name)
     if getattr(args, "events_out", None):
+        import os
         from transmogrifai_tpu.utils.events import events
+        from transmogrifai_tpu.utils.resources import set_watch_path
         events.configure(spill_path=args.events_out)
+        # the spill dir is this daemon's write root: point the default
+        # disk-pressure probes at its filesystem instead of the cwd's
+        set_watch_path(os.path.dirname(os.path.abspath(args.events_out)))
     slo = None
     if getattr(args, "slo_path", None):
         from transmogrifai_tpu.utils.slo import load_objectives

@@ -233,6 +233,14 @@ class ContinuousLoop:
         self._batches_in_window = 0
         self._windows_this_run = 0
         self._serving_totals: Optional[dict] = None
+        #: degradation ladder (utils/resources.py): after an OOM-failed
+        #: retrain the window shrinks to this many NEWEST rows for the
+        #: backed-off retry (halved again per OOM) instead of abandoning
+        #: the model; reset on the next successful promotion
+        self._retrain_row_cap: Optional[int] = None
+        #: background host-pressure sampler (RSS + free disk under
+        #: state_dir), started with the loop
+        self._watchdog = None
 
     def _build_slo_engine(self, slo):
         if slo is None and self.staleness_bound_s is None:
@@ -296,6 +304,15 @@ class ContinuousLoop:
         return self.report()
 
     def _startup(self) -> None:
+        from transmogrifai_tpu.utils.resources import (
+            ResourceWatchdog, set_watch_path,
+        )
+        # the daemon WRITES under state_dir (manifest, checkpoints,
+        # spill): point every default pressure probe — /healthz blocks,
+        # the disk gauges — at that filesystem, not the cwd's
+        set_watch_path(self.state_dir)
+        if self._watchdog is None:
+            self._watchdog = ResourceWatchdog(self.state_dir).start()
         if self._events_spill and not self._events_spill_configured \
                 and not self.state._disabled:
             events.configure(spill_path=os.path.join(
@@ -380,6 +397,9 @@ class ContinuousLoop:
             return None
 
     def _shutdown(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         if self.on_stopping is not None:
             try:
                 self.on_stopping(self)
@@ -607,6 +627,12 @@ class ContinuousLoop:
                 continue
             self._rows_by_source[f] = file_rows
             rows.extend(file_rows)
+        if self._retrain_row_cap is not None \
+                and len(rows) > self._retrain_row_cap:
+            # degradation ladder: a previous attempt OOMed — train the
+            # retry on the NEWEST cap rows (freshest data wins when the
+            # window must shrink)
+            rows = rows[-self._retrain_row_cap:]
         return rows
 
     def _execute_retrain(self) -> bool:
@@ -659,9 +685,30 @@ class ContinuousLoop:
             except FaultHarnessError:
                 raise  # preemption dies; the pending record resumes it
             except Exception as e:  # noqa: BLE001 — a failed retrain must not stop serving
+                self._maybe_shrink_retrain_window(len(rows), e)
                 self._retrain_failed(pending, e)
                 return False
         return self._promote(model, pending, rows)
+
+    def _maybe_shrink_retrain_window(self, n_rows: int,
+                                     err: BaseException) -> None:
+        """Degradation ladder (utils/resources.py): an OOM-failed retrain
+        halves the row window for the backed-off retry — the loop keeps
+        working toward a fresh model on the freshest half instead of
+        re-OOMing the identical shape until the attempt budget abandons
+        it. The old model keeps serving throughout (the existing failed-
+        retrain contract); the cap resets on the next promotion."""
+        from transmogrifai_tpu.utils.resources import (
+            is_resource_exhausted, ladder_enabled, record_degradation,
+        )
+        if not ladder_enabled() or not is_resource_exhausted(err):
+            return
+        cap = max(n_rows // 2, 1)
+        if self._retrain_row_cap is not None:
+            cap = min(cap, max(self._retrain_row_cap // 2, 1))
+        self._retrain_row_cap = cap
+        record_degradation("continuous.retrain", f"rows_{cap}", error=err,
+                           model=self.model_id, windowRows=n_rows)
 
     def _retrain_failed(self, pending: dict, err: BaseException) -> None:
         self.metrics.record_retrain_failure()
@@ -765,6 +812,9 @@ class ContinuousLoop:
             self.state.drift_reference = self.monitor.reference_to_json()
             self.state.record_promotion(version, swap_report, staleness)
             self.metrics.record_promotion()
+            #: a successful promotion clears the OOM row cap — the next
+            #: retrain starts from the full buffer window again
+            self._retrain_row_cap = None
             # the LINEAGE event: any scored response stamped with this
             # (model, version, fingerprint) traces back through it to the
             # drift window, the retrain attempt, and the exact stream
@@ -830,6 +880,12 @@ class ContinuousLoop:
                        "pendingRetrain": self.state.pending_retrain
                        is not None,
                        "counters": self.metrics.to_json()}
+        # host pressure on the loop's /healthz watches the LOOP's write
+        # root (state_dir) — overriding the fleet's default-path block:
+        # the disk that matters is the one the manifest/checkpoints/
+        # spill land on
+        from transmogrifai_tpu.utils.resources import pressure_state
+        doc["resources"] = pressure_state(self.state_dir)
         # the loop's engine outranks the fleet's (the fleet only has one
         # when constructed with slo=; the loop composes staleness in)
         from transmogrifai_tpu.utils.slo import fold_health

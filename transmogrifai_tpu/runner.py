@@ -302,6 +302,13 @@ class WorkflowRunner:
                 # runs must not accumulate proto dirs in /tmp
                 shutil.rmtree(trace_dir, ignore_errors=True)
             result["appMetrics"] = metrics.to_json()
+            # host-pressure snapshot at run end (utils/resources.py):
+            # pairs with appMetrics.resourceCounters so a result json
+            # shows both WHAT rungs the run took and the pressure state
+            # it finished under
+            from transmogrifai_tpu.utils.resources import pressure_state
+            result["resourcePressure"] = pressure_state(
+                checkpoint_dir or ".")
             for h in self.on_end_handlers:
                 h(result)
         return result

@@ -249,6 +249,11 @@ class ModelSelector(Estimator):
         #: each distinct dataset at its own directory: same-shaped different
         #: DATA cannot be distinguished from a restart.
         self.checkpoint_dir = checkpoint_dir
+        #: degradation-ladder rungs taken this sweep (utils/resources.py):
+        #: [{"site", "rung", ...shape}] — persisted into ``sweep.json`` so
+        #: a checkpoint records WHICH shapes ran degraded, and a resumed
+        #: run's operator can see why replayed values exist at a rung
+        self._sweep_degradations: list[dict] = []
         super().__init__(uid=uid)
 
     # -- sweep checkpointing -------------------------------------------------
@@ -319,11 +324,34 @@ class ModelSelector(Estimator):
             clean = {k: [v if np.isfinite(v) else None for v in vals]
                      for k, vals in done.items()}
             atomic_json_dump({"fingerprint": self._ckpt_fingerprint(),
-                              "entries": clean}, path, allow_nan=False)
+                              "entries": clean,
+                              "degradations":
+                                  list(self._sweep_degradations)},
+                             path, allow_nan=False)
 
         best_effort_checkpoint_write(
             write, "sweep checkpoint write failed; continuing without "
                    "checkpointing")
+
+    def _degrade(self, site: str, rung: str,
+                 error: Optional[BaseException] = None, **shape) -> None:
+        """Take one degradation-ladder rung (utils/resources.py): count +
+        flight-recorder event + warning, and append to the sweep's rung
+        log so the next checkpoint write records it."""
+        from transmogrifai_tpu.utils.resources import record_degradation
+        record_degradation(site, rung, error=error, **shape)
+        self._sweep_degradations.append({"site": site, "rung": rung,
+                                         **shape})
+
+    @staticmethod
+    def _oom_ladder(err: BaseException) -> bool:
+        """True when ``err`` is an allocation failure AND the ladder is
+        on — the condition under which a failing unit retries one rung
+        down instead of recording a candidate failure."""
+        from transmogrifai_tpu.utils.resources import (
+            is_resource_exhausted, ladder_enabled,
+        )
+        return ladder_enabled() and is_resource_exhausted(err)
 
     # -- shared pieces -------------------------------------------------------
     def _split_prepare(self, n: int, y) -> tuple[np.ndarray, np.ndarray,
@@ -483,6 +511,7 @@ class ModelSelector(Estimator):
         """
         from transmogrifai_tpu.parallel import mesh as pmesh
         refit_state: dict = {"warm": {}, "bin_plans": {}}
+        self._sweep_degradations = []
         n = int(Xt.shape[0])
         d = int(Xt.shape[1])
         try:
@@ -528,7 +557,29 @@ class ModelSelector(Estimator):
                     pass
             raise
         if pending:
-            self._settle(pending, done, per_candidate_scores, failures)
+            oom_retry: list[int] = []
+            self._settle(pending, done, per_candidate_scores, failures,
+                         oom_retry=oom_retry)
+            # degradation ladder: a family whose stacked program OOMed at
+            # settle re-dispatches down the ladder on the per-fold loop
+            # (peak HBM 1/k of the stacked batch) instead of recording a
+            # candidate failure — completed families' checkpoints are
+            # untouched
+            for ci in oom_retry:
+                est, grid = self.models_and_grids[ci]
+                # release the FAILED stacked program's retained fold
+                # parameters: they are that program's output buffers —
+                # holding them keeps the OOMed program's memory resident
+                # through the retry, and a winner refit warm-started
+                # from them could materialize a poisoned buffer
+                refit_state.get("warm", {}).pop(ci, None)
+                from transmogrifai_tpu.utils.tracing import span
+                with span("resource.degrade", site="sweep.settle",
+                          family=self._family_name(ci), rung="fold_loop"):
+                    self._family_fold_loop(
+                        ci, est, grid, Xt, yt, wt, tr_idx, va_idx, done,
+                        deadline, per_candidate_scores, failures,
+                        refit_state=refit_state)
         results, mean_metrics, failures = self._collect_results(
             per_candidate_scores, failures)
         return results, mean_metrics, failures, refit_state
@@ -657,11 +708,29 @@ class ModelSelector(Estimator):
                         )
                         if isinstance(e, FaultHarnessError):
                             raise  # a preempted process dies, not isolates
-                        failures.append({
-                            "modelName": fname,
-                            "reason": f"stacked sweep: {type(e).__name__}: "
-                                      f"{str(e)[:300]}"})
-                        continue
+                        if self._oom_ladder(e):
+                            # degradation ladder: the k-fold stacked batch
+                            # exceeded real device memory (the HBM guard's
+                            # estimate was optimistic) — retry this family
+                            # one rung down on the per-fold loop, whose
+                            # peak is 1/k of the stacked gather, instead
+                            # of failing the candidate. Any warm handle
+                            # the failed unit already retained is the
+                            # failed program's output — release it.
+                            refit_state["warm"].pop(ci, None)
+                            self._degrade(
+                                "sweep.stacked", "fold_loop", error=e,
+                                family=fname, folds=int(k),
+                                grid=len(grid), rows=int(n_tr),
+                                cols=int(d))
+                            use_stacked = False
+                        else:
+                            failures.append({
+                                "modelName": fname,
+                                "reason": f"stacked sweep: "
+                                          f"{type(e).__name__}: "
+                                          f"{str(e)[:300]}"})
+                            continue
                     else:
                         sweep_counters.count(fname, dispatches=1,
                                              mode="fold_stacked")
@@ -711,7 +780,7 @@ class ModelSelector(Estimator):
                     refit_state=refit_state)
 
     def _settle(self, pending, done, per_candidate_scores,
-                failures) -> None:
+                failures, oom_retry: Optional[list] = None) -> None:
         """The ONE settle of the async sweep: block until every dispatched
         family's metric futures are ready — a single
         ``jax.block_until_ready`` over the whole sweep, counted as ONE
@@ -723,7 +792,11 @@ class ModelSelector(Estimator):
         some family's program), families re-settle one by one so the
         poisoned program isolates into ITS family's failure record — the
         same per-family isolation the dispatch phase applies — at the
-        cost of per-family barriers for that (already failing) sweep."""
+        cost of per-family barriers for that (already failing) sweep.
+        A settle-time failure classified as an allocation OOM (device
+        pressure materialized only when the overlapped programs actually
+        ran) collects its family into ``oom_retry`` instead — the caller
+        re-dispatches those one rung down the degradation ladder."""
         import jax
         from transmogrifai_tpu.utils.faults import FaultHarnessError
         from transmogrifai_tpu.utils.profiling import sweep_counters
@@ -764,6 +837,14 @@ class ModelSelector(Estimator):
                     grid = self.models_and_grids[ci][1]
                     for gj in range(len(grid)):
                         per_candidate_scores.pop((ci, gj), None)
+                    if oom_retry is not None and self._oom_ladder(err):
+                        # NB: "kind" would collide with emit()'s own
+                        # positional — the event attr is unitKind
+                        self._degrade(
+                            "sweep.settle", "fold_loop", error=err,
+                            family=e["fname"], unitKind=e["kind"])
+                        oom_retry.append(ci)
+                        continue
                     failures.append({
                         "modelName": e["fname"],
                         "reason": f"async settle: {type(err).__name__}: "
@@ -944,29 +1025,57 @@ class ModelSelector(Estimator):
                          and fold_metrics_dev is not None)
             vals_kl = np.empty((k, L), np.float64)
             chunks: list[tuple[int, int, Any]] = []  # async device futures
+            cs_cur = cs  # degradation ladder may narrow it mid-group
             try:
                 with sweep_counters.tracking(fname):
-                    for c0 in range(0, L, cs):
-                        chunk = g["params"][c0:c0 + cs]
-                        with span("sweep.tree_group", family=fname,
-                                  mode="tree_stacked", k=int(k),
-                                  lanes=len(chunk), depth=int(depth),
-                                  group=gi):
-                            # fused unit: stacked train + stacked scores
-                            # in one compiled program (no per-(fold, lane)
-                            # model materialization — the sweep discards
-                            # models; the winner refits)
-                            scores = with_device_retry(
-                                est.tree_stack_scores, Xb_tr, ytr_s,
-                                wtr_s, Xb_va, chunk, lnb,
-                                fold_means=cache["fold_means"],
-                                site="sweep.fit")
-                            # the chunk's [k, Lc] metric batch: a device
-                            # FUTURE on the async path (settled once for
-                            # the whole sweep), one host pull otherwise
-                            vals = (fold_metrics_dev if use_async
-                                    else fold_metrics)(
-                                yva_s, scores, self.validation_metric)
+                    c0 = 0
+                    while c0 < L:
+                        chunk = g["params"][c0:c0 + cs_cur]
+                        try:
+                            with span("sweep.tree_group", family=fname,
+                                      mode="tree_stacked", k=int(k),
+                                      lanes=len(chunk), depth=int(depth),
+                                      group=gi):
+                                # fused unit: stacked train + stacked
+                                # scores in one compiled program (no
+                                # per-(fold, lane) model materialization
+                                # — the sweep discards models; the
+                                # winner refits)
+                                scores = with_device_retry(
+                                    est.tree_stack_scores, Xb_tr, ytr_s,
+                                    wtr_s, Xb_va, chunk, lnb,
+                                    fold_means=cache["fold_means"],
+                                    site="sweep.fit")
+                                # the chunk's [k, Lc] metric batch: a
+                                # device FUTURE on the async path
+                                # (settled once for the whole sweep),
+                                # one host pull otherwise
+                                vals = (fold_metrics_dev if use_async
+                                        else fold_metrics)(
+                                    yva_s, scores, self.validation_metric)
+                        except Exception as oom_e:  # noqa: BLE001 — re-raised unless an OOM rung applies
+                            from transmogrifai_tpu.utils.faults import (
+                                FaultHarnessError,
+                            )
+                            if isinstance(oom_e, FaultHarnessError):
+                                raise
+                            if not self._oom_ladder(oom_e) or cs_cur <= 1:
+                                raise
+                            # degradation ladder: this chunk's k x Lc
+                            # stacked program exceeded device memory —
+                            # halve the lane-chunk width and retry the
+                            # SAME lanes (per-lane values are
+                            # vmap-independent: chunk width cannot change
+                            # them), leaving every other group/chunk
+                            # untouched
+                            cs_cur = max(1, cs_cur // 2)
+                            self._degrade(
+                                "sweep.tree_group",
+                                f"lane_chunk_{cs_cur}", error=oom_e,
+                                family=fname, group=gi,
+                                depth=int(depth), folds=int(k),
+                                lanes=len(chunk))
+                            continue
                         if use_async:
                             chunks.append((c0, len(chunk), vals))
                         else:
@@ -977,6 +1086,7 @@ class ModelSelector(Estimator):
                         sweep_counters.count(
                             fname, dispatches=1, lane_chunks=1,
                             mode="tree_stacked")
+                        c0 += len(chunk)
                 sweep_counters.count(fname, stacked_groups=1)
             except Exception as e:  # noqa: BLE001 — isolation by design
                 from transmogrifai_tpu.utils.faults import FaultHarnessError
@@ -984,6 +1094,18 @@ class ModelSelector(Estimator):
                     raise  # a preempted process dies; it does not isolate
                 for gj in range(len(grid)):
                     per_candidate_scores.pop((ci, gj), None)
+                if self._oom_ladder(e):
+                    # bottom of the stacked rungs: even one lane at a
+                    # time OOMs — the whole family falls to the per-fold
+                    # loop (peak 1/k). Drop any pending async futures of
+                    # this family so the settle can't double-record it.
+                    self._degrade("sweep.tree_group", "fold_loop",
+                                  error=e, family=fname, group=gi,
+                                  depth=int(depth))
+                    if pending is not None:
+                        pending[:] = [p for p in pending
+                                      if p["ci"] != ci]
+                    return False
                 failures.append({
                     "modelName": fname,
                     "reason": f"tree stacked sweep (group {gi}): "
@@ -1313,11 +1435,31 @@ class ModelSelector(Estimator):
         cm = (span("selector.refit_stacked", family=fname, lane=best_gj,
                    warm=warm is not None)
               if stacked_refit else contextlib.nullcontext())
-        with sweep_counters.tracking(fname), cm:
-            best_model, warm_used = with_device_retry(
-                best_est.refit_winner, Xs, ys, ws, best_params,
-                warm=warm, lane=best_gj, hints=hints or None,
-                site="sweep.fit")
+        try:
+            with sweep_counters.tracking(fname), cm:
+                best_model, warm_used = with_device_retry(
+                    best_est.refit_winner, Xs, ys, ws, best_params,
+                    warm=warm, lane=best_gj, hints=hints or None,
+                    site="sweep.fit")
+        except Exception as e:  # noqa: BLE001 — re-raised unless an OOM rung applies
+            from transmogrifai_tpu.utils.faults import FaultHarnessError
+            if isinstance(e, FaultHarnessError) or warm is None \
+                    or not self._oom_ladder(e):
+                raise
+            # degradation ladder: the warm-started refit holds the
+            # retained stacked fold parameters live alongside the
+            # full-data program's peak — release them and refit COLD
+            # (bitwise the pre-round-9 serial refit) instead of dying
+            self._degrade("selector.refit", "cold_refit", error=e,
+                          family=fname, lane=int(best_gj),
+                          rows=int(n), cols=int(d))
+            warm = None
+            refit_state.get("warm", {}).pop(best_ci, None)
+            with sweep_counters.tracking(fname):
+                best_model, warm_used = with_device_retry(
+                    best_est.refit_winner, Xs, ys, ws, best_params,
+                    warm=None, lane=best_gj, hints=hints or None,
+                    site="sweep.fit")
         if warm_used:
             sweep_counters.count_run(refit_warm_starts=1)
         self._refit_ckpt_save(rkey, best_model)

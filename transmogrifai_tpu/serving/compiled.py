@@ -207,6 +207,25 @@ class CompiledScorer:
                 return b
         return self.max_batch
 
+    def shed_largest_bucket(self) -> Optional[int]:
+        """Degradation-ladder rung (utils/resources.py): drop the largest
+        padding bucket so every future batch pads (and splits) to smaller
+        shapes — the serving analog of the sweep's lane-chunk halving.
+        Called by the server's OOM handler on the dispatcher thread (the
+        only mutator of ``buckets``/``max_batch``). Shared-cache entries
+        for the shed bucket are evicted so their accounted HBM is
+        actually released; the private-dict jit caches keep their (now
+        never-dispatched) traces — an accounting estimate, like every
+        HBM guard here. Returns the shed bucket, or None when only one
+        bucket remains (the floor: below it the row path serves)."""
+        if len(self.buckets) <= 1:
+            return None
+        shed = self.buckets.pop()
+        self.max_batch = self.buckets[-1]
+        if self.program_cache is not None:
+            self.program_cache.evict_bucket(self.fingerprint, shed)
+        return shed
+
     # -- encoding ------------------------------------------------------------
     def _encode_text(self, name: str, col: fr.HostColumn) -> fr.CodesColumn:
         import jax.numpy as jnp

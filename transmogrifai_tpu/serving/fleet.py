@@ -161,6 +161,47 @@ class ProgramCache:
                 old.counters.count(old.bucket, evictions=1)
         return program
 
+    def evict_bucket(self, fingerprint: Optional[str], bucket: int) -> int:
+        """Drop every entry of one (model, padding bucket) — the shed
+        rung of the serving degradation ladder releases the bucket's
+        accounted HBM immediately. ``fingerprint=None`` sheds the bucket
+        across ALL models (fleet-wide pressure)."""
+        evicted: list[_CacheEntry] = []
+        with self._lock:
+            for key in [k for k in self._entries
+                        if isinstance(k, tuple) and len(k) == 3
+                        and k[2] == bucket
+                        and (fingerprint is None or k[0] == fingerprint)]:
+                old = self._entries.pop(key)
+                self.current_bytes -= old.bytes
+                self.evictions += 1
+                evicted.append(old)
+        for old in evicted:
+            if old.counters is not None and old.bucket is not None:
+                old.counters.count(old.bucket, evictions=1)
+        return len(evicted)
+
+    def evict_cold(self, bytes_to_free: int) -> int:
+        """Evict least-recently-dispatched entries until at least
+        ``bytes_to_free`` accounted bytes are released (or one entry
+        remains — the cache never empties itself under pressure: the
+        live lane's current program must survive). The under-pressure
+        analog of the budget LRU, callable without a budget configured.
+        Returns the bytes actually freed."""
+        freed = 0
+        evicted: list[_CacheEntry] = []
+        with self._lock:
+            while freed < bytes_to_free and len(self._entries) > 1:
+                _, old = self._entries.popitem(last=False)
+                self.current_bytes -= old.bytes
+                self.evictions += 1
+                freed += old.bytes
+                evicted.append(old)
+        for old in evicted:
+            if old.counters is not None and old.bucket is not None:
+                old.counters.count(old.bucket, evictions=1)
+        return freed
+
     def evict_model(self, fingerprint: str) -> int:
         """Drop every entry of one model (an unload releases its share
         of the budget immediately instead of waiting for LRU aging).
@@ -853,9 +894,11 @@ class FleetServer:
         # readiness: the load-balancer bit, over ACTIVE lanes only.
         # Degraded still serves (slowly); a firing fast-burn SLO alert
         # flips it (fold_health); a fleet with nothing active isn't ready
+        from transmogrifai_tpu.utils.resources import pressure_state
         doc = {"status": worst, "models": models,
                "fleet": self.metrics.to_json(),
                "cache": self.program_cache.to_json(),
+               "resources": pressure_state(),
                "ready": any_active
                and serving_worst in ("ok", "degraded")}
         fold_health(self.slo_engine, doc)

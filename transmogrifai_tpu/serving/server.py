@@ -192,11 +192,13 @@ class ScoringServer:
         load-balancer bit — it drops when the server leaves the ready
         state OR a fast-burn SLO alert fires (an endpoint burning its
         error budget at page rate should shed traffic before it pages)."""
+        from transmogrifai_tpu.utils.resources import pressure_state
         from transmogrifai_tpu.utils.slo import fold_health
         doc = {"status": "ok" if self.state == "ready" else self.state,
                "degraded": self.degraded,
                "queueDepth": self.batcher.queue_depth,
-               "ready": self.state in ("ready", "degraded")}
+               "ready": self.state in ("ready", "degraded"),
+               "resources": pressure_state()}
         fold_health(self.slo_engine, doc)
         return doc
 
@@ -295,8 +297,20 @@ class ScoringServer:
                 from transmogrifai_tpu.utils.faults import FaultHarnessError
                 if isinstance(e, FaultHarnessError):
                     raise
-                self._enter_degraded(e)
-                results = self._row_dispatch(rows)
+                shed_results = self._shed_and_retry(rows, e)
+                if shed_results is not None:
+                    # the degradation ladder re-served the batch compiled
+                    # at a smaller shape: not row-path degradation — the
+                    # server stays on the (narrower) compiled path. If
+                    # this batch was a degraded-mode PROBE, the success
+                    # is a recovery: clear the mode now, not at the next
+                    # probe interval
+                    self._exit_degraded()
+                    degraded = False
+                    results = shed_results
+                else:
+                    self._enter_degraded(e)
+                    results = self._row_dispatch(rows)
         else:
             results = self._row_dispatch(rows)
         self.metrics.record_batch(len(rows), time.monotonic() - t0,
@@ -331,6 +345,13 @@ class ScoringServer:
         finally:
             if attempts["n"] > 1:
                 self.metrics.record_retry(attempts["n"] - 1)
+        self._exit_degraded()
+        return list(results)
+
+    def _exit_degraded(self) -> None:
+        """A compiled-path success while degraded IS the recovery —
+        shared by the probe path and the OOM-shed rung (whose success
+        proves the compiled path good at the smaller shape)."""
         if self._degraded_since is not None:
             down_s = time.monotonic() - self._degraded_since
             self._degraded_since = None
@@ -340,7 +361,54 @@ class ScoringServer:
             warnings.warn(
                 f"serving: compiled path recovered after {down_s:.1f}s "
                 "degraded", RuntimeWarning)
-        return list(results)
+
+    def _shed_and_retry(self, rows: Sequence[dict],
+                        err: BaseException) -> Optional[list]:
+        """The serving degradation ladder (utils/resources.py): when the
+        compiled dispatch died of a genuine allocation failure, shed HBM
+        — evict the coldest half of the shared compiled-program cache
+        (other models' idle buckets before anyone's live traffic), drop
+        this scorer's largest padding bucket — and retry the SAME batch
+        compiled at the smaller shape, rung by rung down to the smallest
+        bucket. Returns the batch's results, or None when the rungs are
+        exhausted (caller then row-serves; zero requests dropped either
+        way). Runs on the dispatcher thread; every rung is counted,
+        event-logged, and spanned."""
+        from transmogrifai_tpu.utils.resources import (
+            is_resource_exhausted, ladder_enabled, record_degradation,
+        )
+        from transmogrifai_tpu.utils.tracing import span
+        if not ladder_enabled() or not is_resource_exhausted(err):
+            return None
+        cache = self.scorer.program_cache
+        if cache is not None:
+            # fleet pressure rung: cold (fingerprint, layer, bucket)
+            # entries go first — an idle model's warm programs are
+            # cheaper to recompile later than any live request is to slow
+            # down now
+            cache.evict_cold(cache.current_bytes // 2)
+        last = err
+        while True:
+            shed = self.scorer.shed_largest_bucket()
+            if shed is None:
+                return None  # bucket floor reached: row path serves
+            record_degradation(
+                "serving.dispatch", f"shed_bucket_{shed}", error=last,
+                model=self.event_label, rows=len(rows),
+                bucketsLeft=len(self.scorer.buckets))
+            try:
+                with span("resource.degrade", site="serving.dispatch",
+                          rung=f"shed_bucket_{shed}", rows=len(rows)):
+                    return list(self.scorer.score_batch(rows))
+            except Exception as e:  # noqa: BLE001 — next rung or give up to the row path
+                from transmogrifai_tpu.utils.faults import (
+                    FaultHarnessError,
+                )
+                if isinstance(e, FaultHarnessError):
+                    raise
+                if not is_resource_exhausted(e):
+                    return None
+                last = e
 
     def _enter_degraded(self, err: BaseException) -> None:
         if self._degraded_since is None:

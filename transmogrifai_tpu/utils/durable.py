@@ -16,7 +16,14 @@ the same durability rules, and they must never drift apart:
   so the warn-and-continue path is exercisable in CI;
 - **preemptable**: an injected :class:`~transmogrifai_tpu.utils.faults.
   SimulatedPreemption` propagates — a crashed process does not warn, it
-  dies and resumes.
+  dies and resumes;
+- **pressure-aware**: an observed ``ENOSPC`` (real or injected via the
+  ``enospc`` fault kind) is counted in ``utils.resources.
+  resource_counters`` and arms a cooldown window during which further
+  best-effort writes short-circuit (counted in ``writesSkipped``)
+  instead of paying a failing syscall + warning per checkpoint against
+  a disk that cannot have recovered yet
+  (``TRANSMOGRIFAI_ENOSPC_COOLDOWN_S``, default 30s).
 """
 
 from __future__ import annotations
@@ -58,10 +65,20 @@ def best_effort_checkpoint_write(write: Callable[[], None],
                                  failure_msg: str) -> bool:
     """Run ``write()`` under the shared checkpoint durability contract.
     Returns True on success; on failure warns ``failure_msg`` (with the
-    cause appended) and returns False. Simulated preemption propagates."""
+    cause appended) and returns False. Simulated preemption propagates.
+    While the ENOSPC cooldown is armed (a recent write saw a full
+    disk), the write is skipped up front and counted — the run keeps
+    its at-least-once restart semantics, the full disk stops costing a
+    syscall + warning per checkpoint."""
     from transmogrifai_tpu.utils.faults import (
         FaultHarnessError, fault_point,
     )
+    from transmogrifai_tpu.utils.resources import (
+        is_disk_full, resource_counters,
+    )
+    if resource_counters.enospc_backoff_active():
+        resource_counters.note_write_skipped()
+        return False
     try:
         fault_point("checkpoint.write")
         write()
@@ -69,6 +86,8 @@ def best_effort_checkpoint_write(write: Callable[[], None],
     except FaultHarnessError:
         raise  # injected crash / misconfigured plan: surface, never swallow
     except Exception as e:  # noqa: BLE001 — warned: best-effort by contract
+        if is_disk_full(e):
+            resource_counters.note_enospc()  # arms the cooldown window
         warnings.warn(f"{failure_msg} ({type(e).__name__}: {e})",
                       RuntimeWarning)
         return False

@@ -282,6 +282,10 @@ class EventRing:
             if spill_path is None:
                 return
             try:
+                # chaos seam: the enospc kind exercises the counted
+                # best-effort loss path below without a real full disk
+                from transmogrifai_tpu.utils.faults import fault_point
+                fault_point("events.spill")
                 if self._spill_fh is None:
                     parent = os.path.dirname(spill_path)
                     if parent:
@@ -299,7 +303,7 @@ class EventRing:
                 self._spill_fh.flush()
                 with self._lock:
                     self.spilled += len(pending)
-            except OSError:
+            except OSError as e:
                 # failure-ok: the spill is redundancy over the in-memory
                 # ring; a full disk must not take the serving path down.
                 # But the loss is ACCOUNTED — the exported counters must
@@ -307,6 +311,16 @@ class EventRing:
                 self._spill_fh = None
                 with self._lock:
                     self.spill_lost += len(pending)
+                from transmogrifai_tpu.utils.resources import (
+                    is_disk_full, resource_counters,
+                )
+                if is_disk_full(e):
+                    # a full disk is host pressure, not a local IO blip:
+                    # count it on the resource surface too. Does NOT arm
+                    # the durable-write cooldown — the spill's volume may
+                    # not be the checkpoint volume, and checkpoint writes
+                    # re-detect their own ENOSPC on first failure
+                    resource_counters.note_enospc(arm_backoff=False)
 
     def flush(self) -> None:
         """Synchronously drain the pending spill (tests, incident dumps,

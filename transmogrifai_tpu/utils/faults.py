@@ -35,6 +35,9 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``continuous.promote``    before the retrained model's registration /
                           hot-swap — the abort path must leave the old
                           version serving with zero drops
+``events.spill``          one flight-recorder JSONL spill batch write (the
+                          ``enospc`` kind exercises the counted
+                          best-effort loss path)
 ========================  ====================================================
 
 Plan syntax (env ``TRANSMOGRIFAI_FAULT_PLAN`` or programmatic), entries
@@ -51,7 +54,12 @@ separated by ``;``::
                                  collective
     transient@serving.dispatch%0.5  seeded coin-flip per dispatch
 
-``kind``: ``transient`` | ``io`` | ``slow`` | ``preempt``. ``#at`` is the
+``kind``: ``transient`` | ``io`` | ``slow`` | ``preempt`` | ``oom`` |
+``enospc``. ``oom`` raises a realistic ``RESOURCE_EXHAUSTED:``-prefixed
+``XlaRuntimeError`` (classified by ``utils.resources.
+is_resource_exhausted``, NOT transient — it exercises the degradation
+ladder); ``enospc`` raises ``OSError(ENOSPC)`` (the full-disk path:
+counted best-effort writes, never a crashed run). ``#at`` is the
 0-based invocation index the entry starts firing at (default 0);
 ``xtimes`` the number of consecutive firings (default 1, ``x*`` forever);
 ``:delay_s`` the stall for ``slow``; ``%prob`` replaces the #at/xtimes
@@ -78,10 +86,10 @@ KNOWN_SITES = frozenset({
     "dag.apply_layer", "sweep.fit", "selector.refit", "train.layer",
     "ingest.read", "checkpoint.write", "collective", "serving.dispatch",
     "serving.swap", "continuous.ingest", "continuous.trigger",
-    "continuous.retrain", "continuous.promote",
+    "continuous.retrain", "continuous.promote", "events.spill",
 })
 
-KINDS = ("transient", "io", "slow", "preempt")
+KINDS = ("transient", "io", "slow", "preempt", "oom", "enospc")
 
 
 class FaultHarnessError(Exception):
@@ -225,6 +233,17 @@ def _inject(spec: FaultSpec, site: str, inv: int) -> None:
         raise XlaRuntimeError(f"UNAVAILABLE: {tag} (simulated flaky device)")
     if spec.kind == "io":
         raise OSError(f"{tag} (simulated host-IO failure)")
+    if spec.kind == "oom":
+        # the real allocator's phrasing: RESOURCE_EXHAUSTED status + an
+        # allocation message, so utils.resources.is_resource_exhausted
+        # classifies it exactly like a genuine HBM OOM (and utils.retry
+        # correctly refuses to retry it at the same shape)
+        raise XlaRuntimeError(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            f"1073741824 bytes ({tag})")
+    if spec.kind == "enospc":
+        import errno
+        raise OSError(errno.ENOSPC, f"No space left on device ({tag})")
     if spec.kind == "preempt":
         raise SimulatedPreemption(f"{tag} (simulated preemption)")
 

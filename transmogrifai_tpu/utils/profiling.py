@@ -186,6 +186,11 @@ class AppMetrics:
             # already collect (module global: one run's counters, reset
             # alongside the profiler)
             "runCounters": run_counters.to_json(),
+            # resource-pressure accounting (utils/resources.py): every
+            # degradation rung the run took, OOM/ENOSPC events, skipped
+            # best-effort writes — the ladder's ground truth in the same
+            # json
+            "resourceCounters": _resource_counters_json(),
         }
 
     def save(self, path: str) -> None:
@@ -251,6 +256,13 @@ class AppMetrics:
         return {"hostSpans": n_host,
                 "deviceSlices": len(self.device_events),
                 "phases": len(self.spans)}
+
+
+def _resource_counters_json() -> dict:
+    """Lazy import seam: profiling is imported by nearly everything, and
+    resources imports retry — keep the module graph acyclic."""
+    from transmogrifai_tpu.utils.resources import resource_counters
+    return resource_counters.to_json()
 
 
 class _CompileAttribution:
@@ -558,9 +570,11 @@ class _Profiler:
         trace spanning everything until ``finalize()``. Sweep and run
         counters reset alongside so a run's counters cover exactly that
         run."""
+        from transmogrifai_tpu.utils.resources import resource_counters
         from transmogrifai_tpu.utils.tracing import recorder
         sweep_counters.reset()
         run_counters.reset()
+        resource_counters.reset()
         recorder.reset()
         self.metrics = AppMetrics(app_name=app_name)
         self.trace_dir = trace_dir

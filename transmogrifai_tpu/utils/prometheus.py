@@ -193,6 +193,58 @@ def _event_collectors(reg: PromRegistry) -> None:
                  lambda: [({}, len(events))])
 
 
+def _resource_collectors(reg: PromRegistry) -> None:
+    """The ``transmogrifai_resource_*`` surface (``utils/resources.py``):
+    degradation-ladder rungs taken (labeled by site), OOM/ENOSPC event
+    counts, skipped best-effort writes, and live host-pressure gauges
+    (RSS, free disk, 0/1 pressure against the configured budgets).
+    Carried by EVERY registry, like the flight-recorder series — an
+    operator must see pressure on whatever endpoint they already
+    scrape."""
+    from transmogrifai_tpu.utils import resources
+    rc = resources.resource_counters
+
+    reg.register(
+        "transmogrifai_resource_degradations_total", "counter",
+        "degradation-ladder rungs taken, by failing site",
+        lambda: [({"site": s}, n)
+                 for s, n in sorted(rc.to_json()
+                                    ["degradationsBySite"].items())]
+                or [({"site": "none"}, 0)])
+    for attr, name, help_ in (
+            ("oom_events", "oom_events",
+             "RESOURCE_EXHAUSTED / allocator-OOM errors observed"),
+            ("enospc_events", "enospc_events",
+             "full-disk (ENOSPC) write failures observed"),
+            ("writes_skipped", "writes_skipped",
+             "best-effort durable writes skipped under the ENOSPC "
+             "cooldown")):
+        reg.register(f"transmogrifai_resource_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(rc, a))])
+    reg.register(
+        "transmogrifai_resource_rss_bytes", "gauge",
+        "resident set size of this process",
+        lambda: [({}, resources.rss_bytes())])
+    reg.register(
+        "transmogrifai_resource_disk_free_bytes", "gauge",
+        "free bytes on the working filesystem (-1 = probe failed)",
+        lambda: [({}, resources.disk_free_bytes())])
+    def _pressure_samples():
+        state = resources.pressure_state()
+        return [({"kind": "rss"}, 1 if state["rssPressure"] else 0),
+                ({"kind": "disk"}, 1 if state["diskPressure"] else 0)]
+
+    reg.register(
+        "transmogrifai_resource_pressure", "gauge",
+        "1 while the sampled value breaches its configured budget",
+        _pressure_samples)
+    reg.register(
+        "transmogrifai_resource_ladder_enabled", "gauge",
+        "1 while the adaptive degradation ladder is enabled "
+        "(TRANSMOGRIFAI_RESOURCE_LADDER)",
+        lambda: [({}, 1 if resources.ladder_enabled() else 0)])
+
+
 def _slo_collectors(reg: PromRegistry, engine) -> None:
     """The ``transmogrifai_slo_*`` surface over a ``utils.slo.SLOEngine``:
     targets, per-(alert, window) burn rates, and 0/1 alert states —
@@ -504,8 +556,10 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     ``transmogrifai_slo_*`` burn-rate surface. ``server`` (a
     ``ScoringServer``) is optional extra context reserved for future
     gauges. EVERY registry carries ``transmogrifai_build_info``, the
-    process-uptime gauge, and the flight recorder's
-    ``transmogrifai_events_*`` accounting, so any scrape is correlatable
+    process-uptime gauge, the flight recorder's
+    ``transmogrifai_events_*`` accounting, and the resource-pressure
+    ``transmogrifai_resource_*`` series (degradation-ladder rungs,
+    OOM/ENOSPC events, RSS/disk gauges), so any scrape is correlatable
     across restarts."""
     if serving is not None and fleet is not None:
         raise ValueError("pass serving= or fleet=, not both (the serving "
@@ -513,6 +567,7 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     reg = PromRegistry()
     _process_collectors(reg)
     _event_collectors(reg)
+    _resource_collectors(reg)
     if include_app:
         _app_collectors(reg)
     if serving is not None:

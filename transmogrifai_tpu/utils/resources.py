@@ -1,0 +1,413 @@
+"""Resource-exhaustion resilience: the adaptive degradation ladder.
+
+The stacked sweep, the serving fleet's compiled-program cache, and the
+continuous retrain loop all size their device programs against
+*estimates* (``TRANSMOGRIFAI_SWEEP_HBM_BUDGET``, ``tree_stack_bytes``,
+``layer_entry_bytes``). On hardware where an estimate is wrong, the
+allocator answers with a real ``RESOURCE_EXHAUSTED`` ``XlaRuntimeError``
+— which ``utils.retry`` correctly refuses to retry (the identical
+program would OOM identically). Before this layer existed, that one
+error killed a 4000-second run or poisoned a live scoring lane. The
+Spark reference survives the analogous executor memory pressure by
+spilling and retrying the stage; the TPU analog is to retry the failing
+unit **one rung down a degradation ladder**:
+
+==========================  ================================================
+subsystem                   rungs (largest shape first)
+==========================  ================================================
+sweep, stacked family       fold-stacked program -> per-fold loop
+sweep, tree depth-group     k x L lanes -> halved lane chunks -> ... ->
+                            per-fold loop
+winner refit                warm-started stacked refit -> cold refit
+serving dispatch            evict cold shared-cache entries + shed the
+                            largest padding bucket -> ... -> row path
+continuous retrain          full buffer window -> halved row window +
+                            backoff (the old model keeps serving)
+durable writes              normal -> counted best-effort skip window on
+                            ``ENOSPC`` (never raises mid-train)
+==========================  ================================================
+
+This module owns the pieces every subsystem shares:
+
+- **classification**: :func:`is_resource_exhausted` recognizes genuine
+  allocator OOMs (``RESOURCE_EXHAUSTED:``-status ``XlaRuntimeError``,
+  allocator messages, host ``MemoryError``) by walking the SAME
+  ``__cause__``/``__context__`` chain ``utils.retry`` walks
+  (:func:`~transmogrifai_tpu.utils.retry.iter_error_chain` — one walker,
+  two classifiers, they cannot drift). :func:`is_disk_full` does the
+  errno-based equivalent for ``ENOSPC``/``EDQUOT``. These are THE
+  classifiers: ad-hoc ``"RESOURCE_EXHAUSTED" in str(e)`` checks anywhere
+  else fail the ``scripts/check_failure_paths.py`` lint.
+- **accounting**: every rung taken counts in the process-global
+  :data:`resource_counters` (per-site), emits a ``resource.degrade``
+  flight-recorder event carrying the failing shape and the rung chosen,
+  and exports as ``transmogrifai_resource_*`` Prometheus series (every
+  registry carries them).
+- **host watchdogs**: :func:`rss_bytes` / :func:`disk_free_bytes`
+  samplers, budget envs (``TRANSMOGRIFAI_RSS_BUDGET``,
+  ``TRANSMOGRIFAI_DISK_MIN_FREE``), :func:`pressure_state` for
+  ``/healthz``, and the background :class:`ResourceWatchdog` the
+  continuous daemon runs.
+
+Gating: ``TRANSMOGRIFAI_RESOURCE_LADDER=0`` disables every rung — the
+same faults then fail exactly as they always did (family failure
+isolation, serving row-path degradation, retrain backoff), so the
+ladder is an additive behavior, never a silent change.
+
+Deterministic ``oom``/``enospc`` fault kinds (``utils/faults.py``) make
+every rung exercisable on CPU; see docs/ROBUSTNESS.md "Resource
+exhaustion".
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+import time
+import warnings
+from typing import Optional
+
+from transmogrifai_tpu.utils.retry import iter_error_chain
+
+__all__ = ["is_resource_exhausted", "is_disk_full", "ladder_enabled",
+           "ResourceCounters", "resource_counters", "record_degradation",
+           "rss_bytes", "disk_free_bytes", "rss_budget_bytes",
+           "disk_min_free_bytes", "pressure_state", "set_watch_path",
+           "watch_path", "ResourceWatchdog"]
+
+#: master switch for every degradation rung (default ON)
+LADDER_ENV = "TRANSMOGRIFAI_RESOURCE_LADDER"
+#: host-RSS budget in bytes (0/unset = no RSS pressure reporting)
+RSS_BUDGET_ENV = "TRANSMOGRIFAI_RSS_BUDGET"
+#: minimum free disk in bytes before writes report pressure (0/unset =
+#: no disk pressure reporting)
+DISK_MIN_FREE_ENV = "TRANSMOGRIFAI_DISK_MIN_FREE"
+#: after an observed ENOSPC, durable best-effort writes short-circuit
+#: (counted) for this long instead of hammering a full disk
+ENOSPC_COOLDOWN_ENV = "TRANSMOGRIFAI_ENOSPC_COOLDOWN_S"
+
+#: allocator-OOM message markers. "RESOURCE_EXHAUSTED" is the XLA status
+#: prefix observed on real TPU allocator failures; the rest cover the
+#: BFC-allocator and PJRT host phrasings that surface without the status
+#: prefix. Deliberately DISJOINT from utils.retry._TRANSIENT_MARKERS:
+#: an OOM retried at the same shape OOMs again.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
+                "out of memory", "Failed to allocate")
+
+#: exception type NAMES eligible for message-based OOM classification —
+#: same exact-name discipline as utils.retry (RuntimeError subclasses
+#: like NotImplementedError must never match)
+_OOM_TYPES = ("JaxRuntimeError", "XlaRuntimeError", "RuntimeError")
+
+
+def ladder_enabled() -> bool:
+    """True unless ``TRANSMOGRIFAI_RESOURCE_LADDER=0`` — the one gate
+    every degradation rung checks before acting."""
+    return os.environ.get(LADDER_ENV, "1") != "0"
+
+
+def _is_oom_one(err: BaseException) -> bool:
+    if isinstance(err, MemoryError):
+        return True  # host allocation failure: unambiguous
+    if type(err).__name__ not in _OOM_TYPES:
+        return False
+    msg = str(err)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def is_resource_exhausted(err: BaseException) -> bool:
+    """True when ``err`` — or any exception in its ``__cause__``/
+    ``__context__`` chain (``raise ... from None`` severs it, exactly as
+    the transient classifier honors) — is a genuine device/host
+    allocation failure: the error class worth retrying ONE RUNG DOWN the
+    degradation ladder, never at the same shape."""
+    return any(_is_oom_one(e) for e in iter_error_chain(err))
+
+
+def is_disk_full(err: BaseException) -> bool:
+    """True when the chain contains an ``OSError`` whose errno is
+    ``ENOSPC`` (or the quota twin ``EDQUOT``) — the write-side analog of
+    :func:`is_resource_exhausted`."""
+    return any(isinstance(e, OSError)
+               and getattr(e, "errno", None) in (errno.ENOSPC,
+                                                 getattr(errno, "EDQUOT",
+                                                         errno.ENOSPC))
+               for e in iter_error_chain(err))
+
+
+class ResourceCounters:
+    """Process-global resource-pressure accounting (the
+    ``transmogrifai_resource_*`` Prometheus feed and the
+    ``appMetrics.resourceCounters`` block). Thread-safe: serving lanes,
+    the sweep, and the spill writer all report concurrently.
+
+    ``enospc`` events additionally arm a cooldown window
+    (:meth:`enospc_backoff_active`): once a disk reports full, durable
+    best-effort writes short-circuit (counted in ``writes_skipped``)
+    until the window expires instead of paying a failing syscall +
+    warning per checkpoint on a disk that cannot have recovered."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.degradations = 0
+        self.oom_events = 0
+        self.enospc_events = 0
+        self.writes_skipped = 0
+        #: site -> rungs taken there (the labeled counter series)
+        self.degradations_by_site: dict[str, int] = {}
+        self._enospc_until = 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.degradations = 0
+            self.oom_events = 0
+            self.enospc_events = 0
+            self.writes_skipped = 0
+            self.degradations_by_site = {}
+            self._enospc_until = 0.0
+
+    def note_degradation(self, site: str) -> None:
+        with self._lock:
+            self.degradations += 1
+            self.degradations_by_site[site] = \
+                self.degradations_by_site.get(site, 0) + 1
+
+    def note_oom(self) -> None:
+        with self._lock:
+            self.oom_events += 1
+
+    def note_enospc(self, cooldown_s: Optional[float] = None,
+                    arm_backoff: bool = True) -> None:
+        """Count one full-disk event. ``arm_backoff`` additionally opens
+        the durable-write skip window — pass False from writers on a
+        DIFFERENT filesystem than the checkpoints (e.g. the event
+        spill): a full data volume must not silence checkpoint writes
+        on a healthy checkpoint disk (those re-detect their own ENOSPC
+        and arm from there)."""
+        if cooldown_s is None:
+            try:
+                cooldown_s = float(os.environ.get(ENOSPC_COOLDOWN_ENV,
+                                                  "30"))
+            except ValueError:
+                cooldown_s = 30.0
+        with self._lock:
+            self.enospc_events += 1
+            if arm_backoff:
+                self._enospc_until = max(self._enospc_until,
+                                         time.monotonic() + cooldown_s)
+
+    def note_write_skipped(self) -> None:
+        with self._lock:
+            self.writes_skipped += 1
+
+    def enospc_backoff_active(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._enospc_until
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"degradations": self.degradations,
+                    "oomEvents": self.oom_events,
+                    "enospcEvents": self.enospc_events,
+                    "writesSkipped": self.writes_skipped,
+                    "degradationsBySite": dict(self.degradations_by_site)}
+
+
+resource_counters = ResourceCounters()
+
+
+def record_degradation(site: str, rung: str, *,
+                       error: Optional[BaseException] = None,
+                       **shape) -> None:
+    """The ONE bookkeeping call every rung makes: count (per site), emit
+    the ``resource.degrade`` flight-recorder event carrying the failing
+    shape and the rung chosen, and warn — an operator watching either
+    surface sees every step the ladder took. ``shape`` attrs are
+    camelCase (they land verbatim in the event JSONL); ``kind``/
+    ``trace_id``/``t`` are reserved by ``emit`` itself."""
+    reserved = {"kind", "trace_id", "t", "site", "rung", "error"} \
+        & set(shape)
+    if reserved:
+        raise ValueError(
+            f"record_degradation: shape attrs {sorted(reserved)} "
+            "collide with reserved event fields")
+    from transmogrifai_tpu.utils.events import events
+    resource_counters.note_degradation(site)
+    if error is not None and is_disk_full(error):
+        resource_counters.note_enospc()
+    elif error is not None:
+        resource_counters.note_oom()
+    events.emit("resource.degrade", site=site, rung=rung,
+                error=(f"{type(error).__name__}: {str(error)[:200]}"
+                       if error is not None else None),
+                **shape)
+    warnings.warn(
+        f"resource pressure at {site}: degrading to rung {rung!r}"
+        + (f" after {type(error).__name__}: {str(error)[:140]}"
+           if error is not None else ""),
+        RuntimeWarning)
+
+
+# -- host watchdogs ----------------------------------------------------------
+
+def rss_bytes() -> int:
+    """Current resident set size of this process in bytes (0 when the
+    platform exposes neither ``/proc/self/statm`` nor ``getrusage``)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource as _res
+        ru = _res.getrusage(_res.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux (bytes on macOS); a peak, not the
+        # current RSS — the degraded-platform fallback, not the contract
+        return int(ru.ru_maxrss) * 1024
+    except Exception:  # failure-ok: platform without rusage — sampler reports 0
+        return 0
+
+
+def disk_free_bytes(path: Optional[str] = None) -> int:
+    """Free bytes on the filesystem holding ``path`` (default: the
+    process watch path); -1 when the probe itself fails —
+    distinguishable from a genuinely full disk."""
+    try:
+        import shutil
+        return int(shutil.disk_usage(path if path is not None
+                                     else watch_path()).free)
+    except OSError:
+        return -1
+
+
+#: the directory whose filesystem the default pressure probes watch —
+#: daemons point it at their WRITE root (state dir / spill dir): a
+#: /healthz or scrape reporting free space on the cwd's roomy rootfs
+#: while the data volume the daemon writes is full watches the wrong
+#: disk
+_watch_path = "."
+
+
+def set_watch_path(path: str) -> None:
+    """Point the default pressure probes (``pressure_state()``, the
+    ``transmogrifai_resource_disk_*`` gauges, ``/healthz``) at the
+    filesystem the process actually writes."""
+    global _watch_path
+    _watch_path = path
+
+
+def watch_path() -> str:
+    return _watch_path
+
+
+def _env_bytes(name: str) -> int:
+    v = os.environ.get(name)
+    if not v:
+        return 0
+    try:
+        return int(float(v))
+    except ValueError:
+        warnings.warn(f"{name}={v!r} is not a byte count; ignoring",
+                      RuntimeWarning)
+        return 0
+
+
+def rss_budget_bytes() -> int:
+    return _env_bytes(RSS_BUDGET_ENV)
+
+
+def disk_min_free_bytes() -> int:
+    return _env_bytes(DISK_MIN_FREE_ENV)
+
+
+def pressure_state(path: Optional[str] = None) -> dict:
+    """One JSON-able snapshot of host resource pressure — the block
+    ``/healthz`` folds in and the incident dumps freeze. ``path``
+    defaults to the process watch path (``set_watch_path``).
+    ``rssPressure`` / ``diskPressure`` are False when no budget is
+    configured (pressure is a judgment against a stated budget, not an
+    absolute)."""
+    rss = rss_bytes()
+    free = disk_free_bytes(path)
+    rss_budget = rss_budget_bytes()
+    min_free = disk_min_free_bytes()
+    return {
+        "ladderEnabled": ladder_enabled(),
+        "rssBytes": rss,
+        "rssBudgetBytes": rss_budget,
+        "rssPressure": bool(rss_budget and rss > rss_budget),
+        "diskFreeBytes": free,
+        "diskMinFreeBytes": min_free,
+        "diskPressure": bool(min_free and 0 <= free < min_free),
+        "enospcBackoffActive": resource_counters.enospc_backoff_active(),
+        "counters": resource_counters.to_json(),
+    }
+
+
+class ResourceWatchdog:
+    """Background host-pressure sampler for long-running daemons: every
+    ``interval_s`` it samples RSS and free disk under ``path`` and, on a
+    budget crossing, emits a rate-limited ``resource.pressure``
+    flight-recorder event + warning (once per crossing, not per tick).
+    Purely observational — the rungs react to real failures, the
+    watchdog gives operators the lead time."""
+
+    def __init__(self, path: Optional[str] = None,
+                 interval_s: float = 5.0):
+        self.path = path  # None = the process watch path
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._was_pressured = False
+        #: last sampled values (scrape gauges read these when the
+        #: watchdog runs; otherwise the collectors sample inline)
+        self.last_sample: Optional[dict] = None
+
+    def start(self) -> "ResourceWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="transmogrifai-resource-watchdog",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def tick(self) -> dict:
+        """One sample (also the test seam). Returns the pressure
+        state."""
+        from transmogrifai_tpu.utils.events import events
+        state = pressure_state(self.path)
+        self.last_sample = state
+        pressured = state["rssPressure"] or state["diskPressure"]
+        if pressured and not self._was_pressured:
+            events.emit("resource.pressure",
+                        rssBytes=state["rssBytes"],
+                        rssBudgetBytes=state["rssBudgetBytes"],
+                        diskFreeBytes=state["diskFreeBytes"],
+                        diskMinFreeBytes=state["diskMinFreeBytes"])
+            warnings.warn(
+                "host resource pressure: rss "
+                f"{state['rssBytes']}/{state['rssBudgetBytes'] or '-'}B, "
+                f"disk free {state['diskFreeBytes']}B (min "
+                f"{state['diskMinFreeBytes'] or '-'}B)", RuntimeWarning)
+        self._was_pressured = pressured
+        return state
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — a broken probe must not kill the daemon
+                warnings.warn(
+                    f"resource watchdog sample failed "
+                    f"({type(e).__name__}: {e})", RuntimeWarning)
+            self._stop.wait(self.interval_s)

@@ -28,8 +28,8 @@ import time
 import warnings
 from typing import Callable, Optional, TypeVar
 
-__all__ = ["is_transient_device_error", "with_device_retry",
-           "retry_backoff_s"]
+__all__ = ["is_transient_device_error", "iter_error_chain",
+           "with_device_retry", "retry_backoff_s"]
 
 T = TypeVar("T")
 
@@ -60,25 +60,37 @@ def _is_transient_one(err: BaseException) -> bool:
     return any(m in msg for m in _TRANSIENT_MARKERS)
 
 
-def is_transient_device_error(err: BaseException) -> bool:
-    """True when ``err`` — or any exception in its ``__cause__``/
-    ``__context__`` chain — is a runtime device error worth retrying
-    (flaky tunnel/device); False for deterministic program errors."""
+def iter_error_chain(err: BaseException):
+    """Yield ``err`` and every exception in its ``__cause__``/
+    ``__context__`` chain, honoring ``__suppress_context__`` (``raise X
+    from None`` severs the chain — the raiser judged the failure
+    self-contained) and guarding against cycles.
+
+    THE shared walker for every error classifier: the transient check
+    here and the OOM/ENOSPC checks in ``utils.resources`` must see the
+    same chain, or a wrapped root cause would be transient to one layer
+    and invisible to another."""
     seen: set[int] = set()
     e: Optional[BaseException] = err
     while e is not None and id(e) not in seen:
         seen.add(id(e))
-        if _is_transient_one(e):
-            return True
+        yield e
         if e.__cause__ is not None:
             e = e.__cause__
         elif not e.__suppress_context__:
             e = e.__context__
         else:
-            # ``raise X from None``: the raiser explicitly severed the
-            # chain — it judged the failure deterministic; honor that
             break
-    return False
+
+
+def is_transient_device_error(err: BaseException) -> bool:
+    """True when ``err`` — or any exception in its ``__cause__``/
+    ``__context__`` chain — is a runtime device error worth retrying
+    (flaky tunnel/device); False for deterministic program errors
+    (which includes allocator OOMs: see ``utils.resources.
+    is_resource_exhausted`` — those are handled by the degradation
+    ladder, one rung down, never retried at the same shape)."""
+    return any(_is_transient_one(e) for e in iter_error_chain(err))
 
 
 def _env_float(name: str, default: float) -> float:
