@@ -211,6 +211,15 @@ def _child_main():
         except RuntimeError:
             pass
     _enable_compile_cache()
+    # measurement children run under the dispatch watchdog: a stalled
+    # settle/collective/dispatch autopsies itself (thread stacks, pending
+    # dispatches, HBM census) into .bench_incidents/ before the parent's
+    # timeout fires — a hang produces a diagnosis, not a dead window
+    from transmogrifai_tpu.utils import devicewatch
+    devicewatch.configure(incident_dir=os.environ.get(
+        "_BENCH_INCIDENT_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_incidents")))
     rows = int(os.environ["_BENCH_CHILD_ROWS"])
     trace = os.environ.get("_BENCH_TRACE") == "1"
     result = run_pipeline(rows, trace=trace)
@@ -224,6 +233,7 @@ def _run_child(rows: int, extra_env: dict, label: str,
     env = dict(os.environ, _BENCH_CHILD="1", _BENCH_CHILD_ROWS=str(rows),
                **({"_BENCH_TRACE": "1"} if trace else {}), **extra_env)
     here = os.path.dirname(os.path.abspath(__file__))
+    child_t0 = time.time()
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
@@ -232,6 +242,23 @@ def _run_child(rows: int, extra_env: dict, label: str,
     except subprocess.TimeoutExpired:
         print(f"# [{label}] timed out after {timeout or CHILD_TIMEOUT}s",
               file=sys.stderr)
+        # only incidents written by THIS child (mtime >= its start):
+        # .bench_incidents persists across runs, and a stale dump
+        # misattributed to this hang would send the operator to the
+        # wrong stall site
+        inc_dir = os.path.join(
+            env.get("_BENCH_INCIDENT_DIR")
+            or os.path.join(here, ".bench_incidents"), "incidents")
+        try:
+            fresh = [f for f in sorted(os.listdir(inc_dir))
+                     if os.path.getmtime(os.path.join(inc_dir, f))
+                     >= child_t0]
+            if fresh:
+                print(f"# [{label}] devicewatch incident: "
+                      f"{os.path.join(inc_dir, fresh[-1])}",
+                      file=sys.stderr)
+        except OSError:
+            pass
         return None
     except Exception as e:
         print(f"# [{label}] failed to launch: {e}", file=sys.stderr)
@@ -251,39 +278,129 @@ def _run_child(rows: int, extra_env: dict, label: str,
 
 
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", 240))
+#: ESCALATING per-attempt probe timeouts (round 12): three identical 240s
+#: windows cannot distinguish a dead tunnel from a slow backend init —
+#: attempt N gets rung N of this ladder, and the per-attempt outcome
+#: ledger is persisted into benchmarks/ACCEL_AUTOPSY.json when every
+#: rung hangs, instead of dying as stderr lines (BENCH_r05 postmortem)
+PROBE_TIMEOUTS = [int(x) for x in
+                  (t.strip() for t in os.environ.get(
+                      "BENCH_PROBE_TIMEOUTS", "240,480,900").split(","))
+                  if x]
+
+#: the probe child arms its own DispatchWatchdog so a hang autopsies
+#: ITSELF (thread stacks inside the hung backend init, HBM census,
+#: pending dispatches) before the parent's SIGKILL; the stall deadline
+#: sits safely inside the parent's timeout so the incident file lands
+_PROBE_CODE = """\
+import os, sys
+from transmogrifai_tpu.utils import devicewatch
+devicewatch.configure(
+    incident_dir=os.environ['_PROBE_INCIDENT_DIR'],
+    stall_timeout_s=float(os.environ['_PROBE_STALL_S']),
+    poll_interval_s=1.0)
+with devicewatch.guard('bench.probe', site='bench.probe'):
+    import jax, jax.numpy as jnp
+    d = jax.devices()
+    x = jax.jit(lambda a: a * 2)(jnp.ones(8))
+    x.block_until_ready()
+print('PROBE_OK', d[0].platform)
+"""
+
+
+def _probe_incident_digest(inc_dir: str) -> dict:
+    """Summarize the probe child's self-autopsy (newest incident json in
+    ``inc_dir``) into the attempt-ledger entry: the stall site, how many
+    threads were frozen, what was pending, and the innermost frames of
+    the blocked wait — evidence, not a timeout line. ``stall_site``
+    always present ('unknown' when the child hung before arming)."""
+    digest: dict = {"stall_site": "unknown"}
+    try:
+        files = sorted(
+            f for f in os.listdir(os.path.join(inc_dir, "incidents"))
+            if f.endswith(".json"))
+        if not files:
+            return digest
+        with open(os.path.join(inc_dir, "incidents", files[-1])) as fh:
+            doc = json.load(fh)
+        autopsy = (doc.get("extra") or {}).get("autopsy") or {}
+        wait = autopsy.get("wait") or {}
+        stacks = autopsy.get("threadStacks") or []
+        census = autopsy.get("hbmCensus") or {}
+        blocked = next((s for s in stacks
+                        if s.get("threadName") == wait.get("thread")),
+                       stacks[0] if stacks else {})
+        digest = {
+            "stall_site": str(wait.get("site") or "unknown"),
+            "incident": {
+                "threads": len(stacks),
+                "pending_dispatches": autopsy.get("pendingDispatches")
+                or [],
+                "hbm_bytes_in_use": census.get("bytesInUse"),
+                "blocked_frames": (blocked.get("frames") or [])[-6:],
+                "elapsed_s": wait.get("elapsedSeconds"),
+            },
+        }
+    except Exception:  # noqa: BLE001 — a digest failure must not lose the probe result
+        pass
+    return digest
 
 
 def _probe_backend(extra_env: dict, label: str,
-                   timeout: int | None = None) -> str | None:
+                   timeout: int | None = None) -> tuple[str | None, dict]:
     """Cheap child that only initializes the jax backend and runs one tiny
     jit — catches hung/broken accelerator tunnels in minutes instead of
-    burning a full measurement timeout. Returns the platform name or None."""
+    burning a full measurement timeout. Returns ``(platform | None,
+    attempt_record)``; the record is the ledger entry the committed
+    autopsy artifact carries for this attempt."""
+    import shutil
+    import tempfile as _tempfile
     timeout = timeout or PROBE_TIMEOUT
-    env = dict(os.environ, _BENCH_PROBE="1", **extra_env)
-    code = ("import jax, jax.numpy as jnp;"
-            "d = jax.devices();"
-            "x = jax.jit(lambda a: a * 2)(jnp.ones(8));"
-            "x.block_until_ready();"
-            "print('PROBE_OK', d[0].platform)")
+    here = os.path.dirname(os.path.abspath(__file__))
+    inc_dir = _tempfile.mkdtemp(prefix="bench_probe_watch_")
+    env = dict(os.environ, _BENCH_PROBE="1",
+               _PROBE_INCIDENT_DIR=inc_dir,
+               _PROBE_STALL_S=str(max(min(timeout * 0.5, timeout - 20.0),
+                                      5.0)),
+               **extra_env)
+    rec: dict = {"label": label, "timeout_s": timeout}
+    t0 = time.time()
     try:
-        out = subprocess.run([sys.executable, "-c", code], env=env,
-                             capture_output=True, text=True,
-                             timeout=timeout)
-    except subprocess.TimeoutExpired:
-        print(f"# [probe {label}] hung > {timeout}s", file=sys.stderr)
-        return None
-    except Exception as e:
-        print(f"# [probe {label}] failed to launch: {e}", file=sys.stderr)
-        return None
-    for line in out.stdout.splitlines():
-        if line.startswith("PROBE_OK"):
-            platform = line.split()[-1]
-            print(f"# [probe {label}] platform={platform}", file=sys.stderr)
-            return platform
-    tail = (out.stderr or "").strip().splitlines()[-3:]
-    print(f"# [probe {label}] rc={out.returncode}; tail: "
-          + " | ".join(tail), file=sys.stderr)
-    return None
+        try:
+            out = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                                 env=env, capture_output=True, text=True,
+                                 timeout=timeout, cwd=here)
+        except subprocess.TimeoutExpired:
+            rec["wall_s"] = round(time.time() - t0, 1)
+            rec["outcome"] = "hung"
+            rec.update(_probe_incident_digest(inc_dir))
+            print(f"# [probe {label}] hung > {timeout}s (stall site: "
+                  f"{rec['stall_site']})", file=sys.stderr)
+            return None, rec
+        except Exception as e:
+            rec["wall_s"] = round(time.time() - t0, 1)
+            rec["outcome"] = "launch_error"
+            rec["error"] = str(e)[:200]
+            print(f"# [probe {label}] failed to launch: {e}",
+                  file=sys.stderr)
+            return None, rec
+        rec["wall_s"] = round(time.time() - t0, 1)
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE_OK"):
+                platform = line.split()[-1]
+                rec["outcome"] = "ok" if platform != "cpu" else "cpu"
+                rec["platform"] = platform
+                print(f"# [probe {label}] platform={platform}",
+                      file=sys.stderr)
+                return platform, rec
+        tail = (out.stderr or "").strip().splitlines()[-3:]
+        rec["outcome"] = "error"
+        rec["tail"] = " | ".join(tail)[:400]
+        print(f"# [probe {label}] rc={out.returncode}; tail: "
+              + " | ".join(tail), file=sys.stderr)
+        return None, rec
+    finally:
+        shutil.rmtree(inc_dir, ignore_errors=True)
 
 
 def _device_breakdown(accel: dict) -> dict:
@@ -333,6 +450,38 @@ _PROBE_MARKER_TTL_S = 900
 def _accel_artifact_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "ACCEL_4M_MEASURED.json")
+
+
+def _save_probe_autopsy(attempts: list, wall_s: float) -> None:
+    """A fully-hung probe ladder commits its evidence (round 12): the
+    escalating-timeout attempt ledger plus each hung child's
+    self-autopsy digest land in ``benchmarks/ACCEL_AUTOPSY.json``
+    (schema: ``accel_probe_autopsy`` in scripts/check_artifacts.py) —
+    the next accel session starts from a diagnosis, not a stderr line.
+    Atomic + best-effort, like every artifact write here."""
+    if not any(a.get("outcome") == "hung" for a in attempts):
+        return
+    doc = {
+        "metric": "accel_probe_autopsy",
+        "platform": "unknown",
+        "rows": N_ROWS,
+        "models": MODELS,
+        "probe_wall_s": round(max(wall_s, 0.001), 1),
+        "attempts": attempts,
+        "code_fingerprint": _code_fingerprint(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+    }
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "ACCEL_AUTOPSY.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=2)
+        os.replace(tmp, path)
+        print(f"# probe autopsy committed to {path}", file=sys.stderr)
+    except OSError:
+        pass
 
 
 def _code_fingerprint() -> str:
@@ -451,12 +600,20 @@ def main():
               file=sys.stderr)
         probe_attempts = [({}, 0)]
     accel_env = None
+    probe_ledger: list[dict] = []
+    probe_t0 = time.time()
     for i, (env, delay) in enumerate(probe_attempts):
         if delay:
             time.sleep(delay)
-        platform = _probe_backend(env, f"accel attempt {i + 1}",
-                                  timeout=min(60, PROBE_TIMEOUT)
-                                  if quick else None)
+        # escalating rungs (240s -> 480s -> 900s by default): a slow-but-
+        # alive backend init gets room to finish before the ladder gives
+        # up; the quick re-check after a recent full failure stays short
+        rung = PROBE_TIMEOUTS[min(i, len(PROBE_TIMEOUTS) - 1)] \
+            if PROBE_TIMEOUTS else PROBE_TIMEOUT
+        platform, rec = _probe_backend(env, f"accel attempt {i + 1}",
+                                       timeout=min(60, PROBE_TIMEOUT)
+                                       if quick else rung)
+        probe_ledger.append(rec)
         if platform is not None and platform != "cpu":
             accel_env = env
             try:
@@ -476,6 +633,10 @@ def main():
                 fh.write(str(time.time()))
         except OSError:
             pass
+    if accel_env is None:
+        # the per-attempt ledger becomes a committed partial artifact
+        # whenever a rung HUNG (a clean 'cpu' answer commits nothing)
+        _save_probe_autopsy(probe_ledger, time.time() - probe_t0)
 
     accel = None
     curve = []
@@ -488,7 +649,7 @@ def main():
             # cheap — it reprobes (the crash may have killed the backend)
             # and resumes from the checkpoint instead of restarting
             if _probe_backend(accel_env, "post-crash reprobe",
-                              timeout=120) is not None:
+                              timeout=120)[0] is not None:
                 accel = _run_child(N_ROWS, accel_env,
                                    "accel measurement (retry)", trace=True)
         if accel is not None and not accel.get("resumed") \

@@ -89,6 +89,131 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_continuous_loop(doc))
     if doc.get("metric") == "resource_resilience":
         errors.extend(_validate_resource_resilience(doc))
+    if doc.get("metric") == "accel_probe_autopsy":
+        errors.extend(_validate_accel_autopsy(doc))
+    if doc.get("metric") == "devicewatch_overhead":
+        errors.extend(_validate_devicewatch_overhead(doc))
+    return errors
+
+
+#: dispatch-watchdog + compile-telemetry cost on the serving hot path —
+#: the acceptance bound the committed DEVICEWATCH_OVERHEAD.json is held
+#: to (round 12): a guard is two dict ops per BATCH, so the measured
+#: overhead must be noise-level
+MAX_DEVICEWATCH_OVERHEAD_PCT = 2.0
+
+
+def _validate_devicewatch_overhead(doc: dict) -> list[str]:
+    """The ``benchmarks/DEVICEWATCH_OVERHEAD.json`` contract: the serving
+    throughput path driven interleaved with the watchdog + compile
+    telemetry disabled (base) and armed (watched), overhead within
+    ``MAX_DEVICEWATCH_OVERHEAD_PCT``; the watched leg must actually have
+    armed guards with ZERO false stall fires; and a one-sync sweep run
+    under the armed watchdog must still record exactly ONE blocking host
+    sync (the watchdog adds observation, never syncs)."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    for k in ("base_rps", "watched_rps"):
+        if not (num(doc.get(k)) and doc[k] > 0):
+            errors.append(f"devicewatch-overhead artifact: missing "
+                          f"positive {k!r}")
+    ov = doc.get("overhead_pct")
+    if not num(ov):
+        errors.append("devicewatch-overhead artifact: missing numeric "
+                      "'overhead_pct'")
+    elif ov > MAX_DEVICEWATCH_OVERHEAD_PCT:
+        errors.append(
+            f"devicewatch overhead {ov:.2f}% exceeds the "
+            f"{MAX_DEVICEWATCH_OVERHEAD_PCT:g}% acceptance bound — the "
+            "watchdog is not hot-path free")
+    if not pos_int(doc.get("guards_armed")):
+        errors.append("devicewatch-overhead artifact: missing positive "
+                      "int 'guards_armed' (the watched leg must actually "
+                      "arm deadlines)")
+    fs = doc.get("false_stalls")
+    if not (isinstance(fs, int) and not isinstance(fs, bool)):
+        errors.append("devicewatch-overhead artifact: missing int "
+                      "'false_stalls'")
+    elif fs != 0:
+        errors.append(
+            f"devicewatch-overhead artifact: {fs} false stall fire(s) — "
+            "healthy waits must never autopsy")
+    sweep = doc.get("sweep_one_sync")
+    if not isinstance(sweep, dict):
+        errors.append("devicewatch-overhead artifact: missing "
+                      "'sweep_one_sync' block")
+    else:
+        if sweep.get("watchdog_armed") is not True:
+            errors.append("devicewatch-overhead artifact: sweep_one_sync."
+                          "watchdog_armed must be true")
+        syncs = sweep.get("host_syncs")
+        if not (isinstance(syncs, int) and not isinstance(syncs, bool)):
+            errors.append("devicewatch-overhead artifact: sweep_one_sync."
+                          "host_syncs must be an int")
+        elif syncs != 1:
+            errors.append(
+                f"one-sync contract violated under the armed watchdog: "
+                f"{syncs} blocking host syncs (must be exactly 1 — the "
+                "watchdog may add zero syncs)")
+    return errors
+
+
+def _validate_accel_autopsy(doc: dict) -> list[str]:
+    """The ``benchmarks/ACCEL_AUTOPSY.json`` contract: a fully-hung accel
+    probe ladder commits its evidence — an escalating (non-decreasing)
+    per-attempt timeout ledger where every attempt records an outcome,
+    at least one attempt HUNG, and every hung attempt names its stall
+    site (from the probe child's self-autopsy; 'unknown' when the child
+    hung before arming is honest and allowed)."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    if not (num(doc.get("probe_wall_s")) and doc["probe_wall_s"] > 0):
+        errors.append("accel-autopsy artifact: missing positive "
+                      "'probe_wall_s'")
+    attempts = doc.get("attempts")
+    if not (isinstance(attempts, list) and attempts
+            and all(isinstance(a, dict) for a in attempts)):
+        errors.append("accel-autopsy artifact: 'attempts' must be a "
+                      "non-empty list of per-attempt records")
+        return errors
+    prev_timeout = None
+    any_hung = False
+    for i, a in enumerate(attempts):
+        if not (isinstance(a.get("label"), str) and a.get("label")):
+            errors.append(f"accel-autopsy attempt {i}: missing 'label'")
+        if not (num(a.get("timeout_s")) and a["timeout_s"] > 0):
+            errors.append(f"accel-autopsy attempt {i}: missing positive "
+                          "'timeout_s'")
+        else:
+            if prev_timeout is not None and a["timeout_s"] < prev_timeout:
+                errors.append(
+                    f"accel-autopsy attempt {i}: timeout {a['timeout_s']}"
+                    f"s < attempt {i - 1}'s {prev_timeout}s — the retry "
+                    "ladder must ESCALATE, not burn identical windows")
+            prev_timeout = a["timeout_s"]
+        outcome = a.get("outcome")
+        if not (isinstance(outcome, str) and outcome):
+            errors.append(f"accel-autopsy attempt {i}: missing 'outcome'")
+            continue
+        if outcome == "hung":
+            any_hung = True
+            if not isinstance(a.get("stall_site"), str):
+                errors.append(
+                    f"accel-autopsy attempt {i}: hung attempt lacks "
+                    "'stall_site' (the probe child's self-autopsy digest "
+                    "— 'unknown' is allowed, absence is not)")
+    if not any_hung:
+        errors.append("accel-autopsy artifact: no attempt hung — this "
+                      "artifact exists to commit hang evidence")
     return errors
 
 

@@ -43,10 +43,13 @@ _SNAKE_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
 #: "attrs" holds span attributes (python identifiers, snake_case)
 #: "degradationsBySite" is keyed by fault-site names (dotted identifiers
 #: like "sweep.tree_group") — measured things, not schema fields
+#: "bySite"/"stallsBySite"/"programCosts" are keyed by devicewatch site
+#: labels (dotted identifiers like "sweep.settle") — measured things
 DATA_KEYED = {"phases", "stages", "sizeHistogram", "buckets",
               "compileBuckets", "families", "sweep", "customParams",
               "stageOverrides", "readerOverrides", "objectives",
-              "alerts", "attrs", "degradationsBySite"}
+              "alerts", "attrs", "degradationsBySite", "bySite",
+              "stallsBySite", "programCosts"}
 
 
 def check_json_doc(doc, where: str, _parent_key: str = "") -> list[str]:
@@ -225,6 +228,49 @@ def collect_violations() -> list[str]:
         out.extend(check_registry(build_registry(include_app=False)))
     finally:
         resources.resource_counters = saved_counters
+
+    # the device-execution observatory (round 12): the compile-telemetry
+    # and watchdog JSON surfaces, the autopsy document an incident dump
+    # freezes, and the transmogrifai_device_*/transmogrifai_compile_*
+    # series rendered with NON-ZERO representative data (swapped-in
+    # instances, same pattern as the resource counters above)
+    from transmogrifai_tpu.utils import devicewatch as dw
+
+    tele = dw.CompileTelemetry()
+    # the stub feeds _on_event directly — mark the listener installed so
+    # building() can't register this throwaway instance with
+    # jax.monitoring (listeners never unregister; a leak would double-
+    # count every later compile in the calling process)
+    tele._listening = True
+    with tele.building("sweep.family"):
+        tele._on_event("/jax/core/compile/backend_compile_duration", 0.25)
+    tele.record_program_cost("serving.layer0.bucket8",
+                             {"flops": 128.0, "bytesAccessed": 192.0,
+                              "hloTextBytes": 476})
+    out.extend(check_json_doc(tele.to_json(), "CompileTelemetry.to_json"))
+    ledger = dw.DispatchLedger()
+    ledger.register("sweep.pending", family="OpGBTClassifier_1",
+                    unitKind="tree", units=2)
+    wd = dw.DispatchWatchdog()
+    wd.configure(enabled=True)
+    wd.guards = 3
+    wd.stalls = 1
+    wd.stalls_by_site = {"sweep.settle": 1}
+    wd.autopsies = 1
+    out.extend(check_json_doc(wd.to_json(), "DispatchWatchdog.to_json"))
+    saved_dw = (dw.compile_telemetry, dw.dispatch_ledger, dw.watchdog)
+    try:
+        dw.compile_telemetry = tele
+        dw.dispatch_ledger = ledger
+        dw.watchdog = wd
+        autopsy = dw.build_autopsy(
+            wait={"name": "sweep.settle", "site": "sweep.settle",
+                  "timeoutS": 120.0, "t0": 0.0, "thread": "MainThread",
+                  "attrs": {"families": 2}})
+        out.extend(check_json_doc(autopsy, "devicewatch.build_autopsy"))
+        out.extend(check_registry(build_registry(include_app=False)))
+    finally:
+        (dw.compile_telemetry, dw.dispatch_ledger, dw.watchdog) = saved_dw
 
     # the flight recorder's exported surfaces: event JSONL documents and
     # the dump-on-incident snapshot are JSON exports too — camelCase

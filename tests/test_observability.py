@@ -246,10 +246,11 @@ def test_runner_trace_out_emits_valid_chrome_trace(tmp_path):
     events = doc["traceEvents"]
     assert isinstance(events, list) and events
     # schema: every event names itself and carries a phase marker; every
-    # complete event has microsecond ts + dur
+    # complete event has microsecond ts + dur ("C" = the devicewatch HBM
+    # counter track, present when the run sampled the census)
     for e in events:
         assert isinstance(e.get("name"), str) and e["name"]
-        assert e.get("ph") in ("X", "M")
+        assert e.get("ph") in ("X", "M", "C")
         if e["ph"] == "X":
             assert isinstance(e["ts"], float) or isinstance(e["ts"], int)
             assert e["dur"] >= 0

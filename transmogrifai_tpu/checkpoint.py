@@ -204,11 +204,14 @@ class TrainCheckpoint:
         (the live transformer is already usable); unmatched or unrestorable
         records are skipped with a warning — they cost a refit, not a
         crash."""
+        from transmogrifai_tpu.utils.devicewatch import guard
         from transmogrifai_tpu.utils.profiling import run_counters
         from transmogrifai_tpu.utils.tracing import span
         if not self._layers:
             return {}
-        with span("checkpoint.restore", n_layers=len(self._layers)):
+        with span("checkpoint.restore", n_layers=len(self._layers)), \
+                guard("checkpoint.restore", site="checkpoint.restore",
+                      nLayers=len(self._layers)):
             return self._restore_overrides(dag, run_counters)
 
     def _restore_overrides(self, dag, run_counters

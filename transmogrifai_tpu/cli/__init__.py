@@ -51,6 +51,11 @@ def main(argv=None) -> int:
     add_slo_args(sub.add_parser(
         "slo", help="SLO burn-rate status of a running serve/continuous "
                     "daemon (scrapes its /healthz + /metrics)"))
+    from transmogrifai_tpu.cli.autopsy import add_autopsy_args, run_autopsy
+    add_autopsy_args(sub.add_parser(
+        "autopsy", help="pretty-print an incident dump / device-stall "
+                        "autopsy (stall site, thread stacks, HBM "
+                        "holders, pending dispatches, event tail)"))
     args = ap.parse_args(argv)
 
     if args.command == "shell":
@@ -64,6 +69,8 @@ def main(argv=None) -> int:
         return run_profile(args)
     if args.command == "slo":
         return run_slo(args)
+    if args.command == "autopsy":
+        return run_autopsy(args)
     if args.command == "gen":
         path = generate_project(
             name=args.name, input_path=args.input, id_col=args.id_col,

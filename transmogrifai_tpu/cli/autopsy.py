@@ -1,0 +1,222 @@
+"""``transmogrifai_tpu autopsy`` — pretty-print an incident dump.
+
+The flight recorder's ``dump_incident`` snapshots and the devicewatch
+stall autopsies are raw JSON/JSONL with no reader; this subcommand
+renders one as ``utils/table.py`` tables: the stall site and wait, a
+thread-stack digest, the top HBM holders, the pending-dispatch
+inventory, and the recent event tail::
+
+    python -m transmogrifai_tpu.cli autopsy incidents/incident_...json
+    python -m transmogrifai_tpu.cli autopsy state_dir            # newest
+    python -m transmogrifai_tpu.cli autopsy state_dir/events.jsonl
+
+Accepts an incident JSON file, a directory (the newest
+``incident_*.json`` under it or its ``incidents/`` subdir is picked),
+or a flight-recorder ``events.jsonl`` spill (the event tail plus any
+``device.stall`` records render). Exit status: 0 rendered, 2 nothing
+readable at the path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+__all__ = ["add_autopsy_args", "run_autopsy"]
+
+
+def add_autopsy_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("path",
+                    help="incident .json, a directory holding incidents, "
+                         "or a flight-recorder events.jsonl spill")
+    sp.add_argument("--events", type=int, default=20, metavar="N",
+                    help="event-tail rows to render (default 20)")
+    sp.add_argument("--frames", type=int, default=8, metavar="N",
+                    help="innermost stack frames per thread (default 8)")
+
+
+def _newest_incident(dir_path: str) -> Optional[str]:
+    """The newest ``incident_*.json`` under ``dir_path`` or its
+    ``incidents/`` subdir (dump_incident's layout)."""
+    for root in (os.path.join(dir_path, "incidents"), dir_path):
+        try:
+            files = sorted(f for f in os.listdir(root)
+                           if f.startswith("incident_")
+                           and f.endswith(".json"))
+        except OSError:
+            continue
+        if files:
+            return os.path.join(root, files[-1])
+    return None
+
+
+def _fmt_ts(ts) -> str:
+    import datetime
+    try:
+        return datetime.datetime.fromtimestamp(
+            float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError, OverflowError):
+        return str(ts)
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return "-"
+
+
+def _event_rows(events: list, n: int) -> list[tuple]:
+    rows = []
+    for ev in events[-n:]:
+        attrs = {k: v for k, v in ev.items()
+                 if k not in ("ts", "kind", "traceId")}
+        summary = ", ".join(f"{k}={v}" for k, v in list(attrs.items())[:4])
+        rows.append((_fmt_ts(ev.get("ts")), str(ev.get("kind", "?")),
+                     str(ev.get("traceId") or "-"), summary[:70]))
+    return rows
+
+
+def _render_events_tail(events: list, n: int) -> None:
+    from transmogrifai_tpu.utils.table import Table
+    rows = _event_rows(events, n)
+    if rows:
+        print(Table(["time", "kind", "trace", "attrs"], rows,
+                    title=f"event tail (newest {len(rows)})"))
+
+
+def _render_incident(doc: dict, args: argparse.Namespace) -> None:
+    from transmogrifai_tpu.utils.table import Table
+    autopsy = (doc.get("extra") or {}).get("autopsy") or {}
+    wait = autopsy.get("wait") or {}
+    head_rows = [("reason", str(doc.get("reason", "?"))),
+                 ("written at", _fmt_ts(doc.get("at")))]
+    if wait:
+        head_rows += [("stall site", str(wait.get("site", "?"))),
+                      ("blocked wait", str(wait.get("name", "?"))),
+                      ("blocked thread", str(wait.get("thread", "?"))),
+                      ("elapsed (s)", str(wait.get("elapsedSeconds",
+                                                   "?"))),
+                      ("deadline (s)", str(wait.get("timeoutSeconds",
+                                                    "?")))]
+    print(Table(["field", "value"], head_rows, title="incident"))
+
+    stacks = autopsy.get("threadStacks") or []
+    if stacks:
+        rows = []
+        blocked_name = wait.get("thread")
+        for s in stacks:
+            frames = (s.get("frames") or [])[-args.frames:]
+            mark = "*" if s.get("threadName") == blocked_name else ""
+            rows.append((f"{s.get('threadName', '?')}{mark}",
+                         "y" if s.get("daemon") else "n",
+                         " <- ".join(reversed(frames))[:120]))
+        print(Table(["thread (*=stalled)", "daemon",
+                     "stack (innermost first)"], rows,
+                    title=f"thread stacks ({len(stacks)})"))
+
+    buffers = autopsy.get("liveBuffers") or {}
+    buckets = buffers.get("buckets") or []
+    if buckets:
+        rows = [(b.get("shape", "?"), b.get("dtype", "?"),
+                 b.get("count", 0), _fmt_bytes(b.get("bytes")))
+                for b in buckets]
+        print(Table(["shape", "dtype", "count", "bytes"], rows,
+                    title=f"top {len(rows)} HBM holders "
+                          f"(of {buffers.get('arrays', '?')} live arrays, "
+                          f"{_fmt_bytes(buffers.get('totalBytes'))})"))
+    census = autopsy.get("hbmCensus") or {}
+    if census.get("devices"):
+        rows = [(d.get("device", "?"), _fmt_bytes(d.get("bytesInUse")),
+                 _fmt_bytes(d.get("peakBytesInUse")),
+                 _fmt_bytes(d.get("bytesLimit")))
+                for d in census["devices"]]
+        print(Table(["device", "in use", "peak", "limit"], rows,
+                    title="per-device HBM census"))
+
+    pend = autopsy.get("pendingDispatches") or []
+    if pend:
+        rows = []
+        for p in pend:
+            attrs = {k: v for k, v in p.items()
+                     if k not in ("site", "ageSeconds")}
+            rows.append((str(p.get("site", "?")),
+                         str(p.get("ageSeconds", "?")),
+                         ", ".join(f"{k}={v}"
+                                   for k, v in attrs.items())[:60]))
+        print(Table(["site", "age (s)", "labels"], rows,
+                    title=f"pending dispatches ({len(pend)})"))
+    else:
+        print("(no pending dispatches in the ledger)")
+
+    compile_state = autopsy.get("compile") or {}
+    if compile_state:
+        rows = [("programs compiled", compile_state.get("programs", 0)),
+                ("compile wall (s)", compile_state.get("wallSeconds", 0)),
+                ("slowest compile (s)",
+                 compile_state.get("maxWallSeconds", 0)),
+                ("builds in progress",
+                 compile_state.get("inProgress", 0)),
+                ("slow compiles", compile_state.get("slowCompiles", 0))]
+        print(Table(["compile state", "value"], rows))
+
+    _render_events_tail(doc.get("events") or [], args.events)
+
+
+def run_autopsy(args: argparse.Namespace) -> int:
+    path = args.path
+    if os.path.isdir(path):
+        found = _newest_incident(path)
+        if found is None:
+            print(f"autopsy: no incident_*.json under {path!r} (or its "
+                  "incidents/ subdir)", file=sys.stderr)
+            return 2
+        path = found
+    if not os.path.exists(path):
+        print(f"autopsy: {path!r} does not exist", file=sys.stderr)
+        return 2
+    if path.endswith(".jsonl"):
+        events = []
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError as e:
+            print(f"autopsy: cannot read {path!r}: {e}", file=sys.stderr)
+            return 2
+        stalls = [e for e in events if e.get("kind") == "device.stall"]
+        if stalls:
+            from transmogrifai_tpu.utils.table import Table
+            rows = [(_fmt_ts(e.get("ts")), str(e.get("site", "?")),
+                     str(e.get("elapsedSeconds", "?")),
+                     str(e.get("pendingDispatches", "?")),
+                     _fmt_bytes(e.get("hbmBytesInUse")))
+                    for e in stalls]
+            print(Table(["time", "site", "elapsed (s)", "pending",
+                         "HBM in use"], rows,
+                        title=f"device.stall events ({len(stalls)})"))
+        _render_events_tail(events, args.events)
+        return 0
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"autopsy: cannot read {path!r}: {e}", file=sys.stderr)
+        return 2
+    print(f"# {path}")
+    _render_incident(doc, args)
+    return 0

@@ -137,8 +137,14 @@ def _observability_setup(args, app_name: str):
         from transmogrifai_tpu.utils.resources import set_watch_path
         events.configure(spill_path=args.events_out)
         # the spill dir is this daemon's write root: point the default
-        # disk-pressure probes at its filesystem instead of the cwd's
-        set_watch_path(os.path.dirname(os.path.abspath(args.events_out)))
+        # disk-pressure probes at its filesystem instead of the cwd's —
+        # and land device-stall autopsy dumps beside the spill (an
+        # explicit TRANSMOGRIFAI_DEVICEWATCH_DIR wins)
+        write_root = os.path.dirname(os.path.abspath(args.events_out))
+        set_watch_path(write_root)
+        from transmogrifai_tpu.utils import devicewatch
+        if devicewatch.watchdog.incident_dir is None:
+            devicewatch.configure(incident_dir=write_root)
     slo = None
     if getattr(args, "slo_path", None):
         from transmogrifai_tpu.utils.slo import load_objectives

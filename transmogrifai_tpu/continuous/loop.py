@@ -318,6 +318,21 @@ class ContinuousLoop:
             events.configure(spill_path=os.path.join(
                 self.state_dir, "events.jsonl"))
             self._events_spill_configured = True
+        # device-stall autopsies freeze their full dumps under the same
+        # state_dir/incidents/ every other incident producer here uses
+        # (an explicit TRANSMOGRIFAI_DEVICEWATCH_DIR wins; without this
+        # a daemon stall would emit only the summary event and discard
+        # the thread stacks / ledger / HBM census)
+        from transmogrifai_tpu.utils import devicewatch
+        if devicewatch.watchdog.incident_dir is None \
+                and not self.state._disabled:
+            devicewatch.configure(
+                incident_dir=self.state_dir,
+                scrape_fn=lambda: self._registry().render())
+            # ownership marker: _shutdown releases the process-global
+            # config (and the closure pinning this loop) so a later
+            # loop in the same process can claim it for ITS state_dir
+            self._devicewatch_owner = True
         if self.state.drift_reference:
             self.monitor.restore_reference(self.state.drift_reference)
         if self.reference_frame is None and self.reference_path \
@@ -422,6 +437,16 @@ class ContinuousLoop:
             # must not keep appending into this one's history
             events.configure(spill_path=None)
             self._events_spill_configured = False
+        if getattr(self, "_devicewatch_owner", False):
+            # release the process-global autopsy config this loop
+            # claimed at startup: a later loop (supervisor restart into
+            # a NEW state dir) must claim its own incident dir, not dump
+            # into this one's — and the scrape closure must not pin the
+            # dead loop in memory for the process lifetime
+            from transmogrifai_tpu.utils import devicewatch
+            devicewatch.watchdog.incident_dir = None
+            devicewatch.watchdog.scrape_fn = None
+            self._devicewatch_owner = False
 
     def _has_active(self) -> bool:
         return self.fleet.registry.active_version(self.model_id) is not None

@@ -19,6 +19,8 @@ provide their own combine.
 from __future__ import annotations
 
 import threading
+import time
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -79,6 +81,7 @@ def run_with_deadline(fn: Callable[[], Any], *, name: str,
     timeout = collective_timeout_s(timeout_s)
     if timeout <= 0:
         return fn()
+    from transmogrifai_tpu.utils import devicewatch
     box: dict[str, Any] = {}
     done = threading.Event()
 
@@ -92,13 +95,37 @@ def run_with_deadline(fn: Callable[[], Any], *, name: str,
 
     t = threading.Thread(target=work, daemon=True,
                          name=f"collective[{name}]")
-    t.start()
-    if not done.wait(timeout):
-        raise CollectiveTimeoutError(
-            f"DEADLINE_EXCEEDED: collective {name!r} timed out after "
-            f"{timeout:g}s on {_host_diagnostics()} — a peer host is "
-            "likely dead or partitioned; restart the job and resume from "
-            "checkpoints (docs/ROBUSTNESS.md)")
+    t0 = time.time()
+    eid = devicewatch.dispatch_ledger.register("collective", name=name,
+                                               timeoutSeconds=timeout)
+    try:
+        t.start()
+        if not done.wait(timeout):
+            # freeze the device-execution autopsy BEFORE raising: the
+            # abandoned worker thread's stack (blocked inside the
+            # collective), the in-flight dispatch inventory, and the HBM
+            # census are exactly the evidence a pod-hang postmortem
+            # needs. Gated like every observatory seam — a disabled
+            # watchdog (TRANSMOGRIFAI_DEVICEWATCH=0) restores the
+            # pre-observatory timeout byte for byte
+            if devicewatch.watchdog.enabled:
+                try:
+                    devicewatch.stall_autopsy(
+                        f"collective.timeout:{name}", site="collective",
+                        wait={"name": name, "site": "collective",
+                              "timeoutS": timeout, "t0": t0,
+                              "thread": t.name})
+                except Exception as e:  # noqa: BLE001 — diagnostics must never mask the timeout
+                    warnings.warn(
+                        f"collective-timeout autopsy failed "
+                        f"({type(e).__name__}: {e})", RuntimeWarning)
+            raise CollectiveTimeoutError(
+                f"DEADLINE_EXCEEDED: collective {name!r} timed out after "
+                f"{timeout:g}s on {_host_diagnostics()} — a peer host is "
+                "likely dead or partitioned; restart the job and resume "
+                "from checkpoints (docs/ROBUSTNESS.md)")
+    finally:
+        devicewatch.dispatch_ledger.complete(eid)
     if "error" in box:
         raise box["error"]
     return box["value"]

@@ -34,6 +34,7 @@ and docs/SWEEP.md.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
@@ -440,16 +441,27 @@ class ModelSelector(Estimator):
     def _stacked_hbm_budget() -> float:
         """Byte budget for one family's stacked fold batch.
         ``TRANSMOGRIFAI_SWEEP_HBM_BUDGET`` overrides; otherwise half the
-        device's reported memory limit, or 4 GiB when the backend exposes
-        none (CPU)."""
+        reported memory limit from the shared ``utils/devicewatch.py``
+        census — summed across ALL local devices when a mesh is active
+        (the stacked batch shards over it), but device 0's alone without
+        one (un-meshed, the batch lands on a single device and an N-
+        device sum would admit N×-too-large programs) — or 4 GiB when
+        the backend exposes none (CPU)."""
         import os
         env = os.environ.get("TRANSMOGRIFAI_SWEEP_HBM_BUDGET")
         if env:
             return float(env)
         try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats() or {}
-            limit = float(stats.get("bytes_limit", 0))
+            from transmogrifai_tpu.parallel import mesh as pmesh
+            from transmogrifai_tpu.utils.devicewatch import (
+                device_memory_census,
+            )
+            census = device_memory_census()
+            if pmesh.current_mesh() is not None:
+                limit = float(census["bytesLimit"])
+            else:
+                devices = census["devices"]
+                limit = float(devices[0]["bytesLimit"]) if devices else 0.0
             if limit > 0:
                 return 0.5 * limit
         except Exception:  # failure-ok: memory-stats probe; conservative default
@@ -596,6 +608,7 @@ class ModelSelector(Estimator):
             supports_fold_stacking, supports_tree_stacking,
         )
         from transmogrifai_tpu.parallel import mesh as pmesh
+        from transmogrifai_tpu.utils.devicewatch import compile_telemetry
         from transmogrifai_tpu.utils.profiling import sweep_counters
         from transmogrifai_tpu.utils.retry import with_device_retry
         from transmogrifai_tpu.utils.tracing import span
@@ -676,6 +689,8 @@ class ModelSelector(Estimator):
                             int(np.asarray(jnp.max(ytr_s))) + 1, 2)
                     try:
                         with sweep_counters.tracking(fname), \
+                                compile_telemetry.building(
+                                    f"sweep.family:{fname}"), \
                                 span("sweep.family", family=fname,
                                      mode="fold_stacked", folds=k,
                                      grid=len(grid)):
@@ -798,16 +813,38 @@ class ModelSelector(Estimator):
         ran) collects its family into ``oom_retry`` instead — the caller
         re-dispatches those one rung down the degradation ladder."""
         import jax
+        from transmogrifai_tpu.utils import devicewatch
         from transmogrifai_tpu.utils.faults import FaultHarnessError
         from transmogrifai_tpu.utils.profiling import sweep_counters
         from transmogrifai_tpu.utils.tracing import span
         with span("sweep.settle",
                   families=len({e["ci"] for e in pending}),
-                  units=sum(len(e["chunks"]) for e in pending)):
+                  units=sum(len(e["chunks"]) for e in pending)), \
+                contextlib.ExitStack() as ledger_stack:
+            # the dispatch ledger the hang autopsy inventories: one
+            # labeled entry per pending family/depth-group, completed as
+            # that family settles (or unconditionally on exit — a
+            # poisoned program must not leak a phantom in-flight entry)
+            for e in pending:
+                e["_dw"] = devicewatch.dispatch_ledger.register(
+                    "sweep.pending", family=e["fname"],
+                    unitKind=e["kind"], units=len(e["chunks"]))
+                ledger_stack.callback(
+                    devicewatch.dispatch_ledger.complete, e["_dw"])
             barrier_ok = True
             try:
-                jax.block_until_ready(
-                    [a for e in pending for _c0, _ln, a in e["chunks"]])
+                # the watchdog arms a stall deadline around the ONE
+                # blocking sync; it adds no host syncs of its own (the
+                # sweepHostSyncs == 1 contract holds armed, counter-
+                # asserted in tests + DEVICEWATCH_OVERHEAD.json), and an
+                # exception here — e.g. an OOM retried down the ladder —
+                # disarms the deadline on block exit
+                with devicewatch.guard(
+                        "sweep.settle", site="sweep.settle",
+                        families=len({e["ci"] for e in pending}),
+                        units=sum(len(e["chunks"]) for e in pending)):
+                    jax.block_until_ready(
+                        [a for e in pending for _c0, _ln, a in e["chunks"]])
                 sweep_counters.count_run(host_syncs=1)
             except FaultHarnessError:
                 raise  # a preempted process dies; it does not isolate
@@ -820,8 +857,11 @@ class ModelSelector(Estimator):
                     continue
                 try:
                     if not barrier_ok:
-                        jax.block_until_ready(
-                            [a for _c0, _ln, a in e["chunks"]])
+                        with devicewatch.guard(
+                                "sweep.settle", site="sweep.settle",
+                                family=e["fname"]):
+                            jax.block_until_ready(
+                                [a for _c0, _ln, a in e["chunks"]])
                         sweep_counters.count_run(host_syncs=1)
                     if e["kind"] == "stacked":
                         vals = np.asarray(e["chunks"][0][2])
@@ -865,6 +905,8 @@ class ModelSelector(Estimator):
                                          host_syncs=len(e["chunks"]))
                 done[e["key"]] = flat
                 self._ckpt_save(done)
+                # settled: this family's futures are no longer in flight
+                devicewatch.dispatch_ledger.complete(e.get("_dw"))
 
     # -- fold x grid-stacked tree sweep (round 8) ----------------------------
     @staticmethod
@@ -1026,8 +1068,13 @@ class ModelSelector(Estimator):
             vals_kl = np.empty((k, L), np.float64)
             chunks: list[tuple[int, int, Any]] = []  # async device futures
             cs_cur = cs  # degradation ladder may narrow it mid-group
+            from transmogrifai_tpu.utils.devicewatch import (
+                compile_telemetry,
+            )
             try:
-                with sweep_counters.tracking(fname):
+                with sweep_counters.tracking(fname), \
+                        compile_telemetry.building(
+                            f"sweep.tree:{fname}"):
                     c0 = 0
                     while c0 < L:
                         chunk = g["params"][c0:c0 + cs_cur]
@@ -1435,8 +1482,11 @@ class ModelSelector(Estimator):
         cm = (span("selector.refit_stacked", family=fname, lane=best_gj,
                    warm=warm is not None)
               if stacked_refit else contextlib.nullcontext())
+        from transmogrifai_tpu.utils.devicewatch import compile_telemetry
         try:
-            with sweep_counters.tracking(fname), cm:
+            with sweep_counters.tracking(fname), \
+                    compile_telemetry.building(
+                        f"selector.refit:{fname}"), cm:
                 best_model, warm_used = with_device_retry(
                     best_est.refit_winner, Xs, ys, ws, best_params,
                     warm=warm, lane=best_gj, hints=hints or None,
@@ -1455,7 +1505,9 @@ class ModelSelector(Estimator):
                           rows=int(n), cols=int(d))
             warm = None
             refit_state.get("warm", {}).pop(best_ci, None)
-            with sweep_counters.tracking(fname):
+            with sweep_counters.tracking(fname), \
+                    compile_telemetry.building(
+                        f"selector.refit:{fname}"):
                 best_model, warm_used = with_device_retry(
                     best_est.refit_winner, Xs, ys, ws, best_params,
                     warm=None, lane=best_gj, hints=hints or None,

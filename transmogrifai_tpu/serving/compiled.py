@@ -155,6 +155,11 @@ class CompiledScorer:
                              and b.is_nullable)
             self._raw.append((f.name, ftype))
         self._programs: dict[int, Any] = {}
+        #: warmup-only program cost analysis (utils/devicewatch.py):
+        #: lowering re-traces on host, so it runs once per (layer,
+        #: bucket) during warmup and NEVER on the steady-state path
+        self._analyze_cold = False
+        self._analyzed: set = set()
         self._vocabs: dict[str, tuple[tuple[str, ...], dict]] = {}
         self._vocab_lock = threading.Lock()
         self._seed_vocabs()
@@ -262,11 +267,21 @@ class CompiledScorer:
                ) -> list[int]:
         """Dispatch one replicated batch per padding bucket so every fused
         layer program is compiled before traffic arrives. Returns the
-        buckets warmed."""
+        buckets warmed. Compiles triggered here attribute to the
+        ``serving.bucket_<n>`` site of the devicewatch compile telemetry,
+        and each (layer, bucket) program gets a one-time cost analysis
+        (FLOPs / bytes / HLO size) — warmup is the cold seam, so the
+        steady-state dispatch path pays nothing for either."""
+        from transmogrifai_tpu.utils.devicewatch import compile_telemetry
         warmed = []
-        for b in (buckets if buckets is not None else self.buckets):
-            self.score_batch([dict(row)] * int(b))
-            warmed.append(int(b))
+        self._analyze_cold = True
+        try:
+            for b in (buckets if buckets is not None else self.buckets):
+                with compile_telemetry.building(f"serving.bucket_{b}"):
+                    self.score_batch([dict(row)] * int(b))
+                warmed.append(int(b))
+        finally:
+            self._analyze_cold = False
         return warmed
 
     def score_batch(self, rows: Sequence[dict]) -> list[dict]:
@@ -379,6 +394,18 @@ class CompiledScorer:
             spent = set(self._free_plan[li]) if self.donate else set()
             donate_cols = {n: c for n, c in in_cols.items() if n in spent}
             keep_cols = {n: c for n, c in in_cols.items() if n not in spent}
+            if self._analyze_cold and (li, bucket) not in self._analyzed:
+                # warmup-only: lower (host retrace, no backend compile)
+                # and record FLOPs/bytes/HLO size BEFORE the dispatch —
+                # after it, donated buffers are dead
+                self._analyzed.add((li, bucket))
+                from transmogrifai_tpu.utils.devicewatch import (
+                    analyze_program, compile_telemetry,
+                )
+                compile_telemetry.record_program_cost(
+                    f"serving.layer{li}.bucket{bucket}",
+                    analyze_program(program, params, donate_cols,
+                                    keep_cols))
             outs = program(params, donate_cols, keep_cols)
             # donated buffers are dead: drop the references so nothing can
             # reread them (and the host copy frees with the batch)

@@ -327,6 +327,7 @@ class ScoringServer:
         return False
 
     def _compiled_dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        from transmogrifai_tpu.utils import devicewatch
         from transmogrifai_tpu.utils.faults import fault_point
         attempts = {"n": 0}
 
@@ -338,11 +339,21 @@ class ScoringServer:
             fault_point("serving.dispatch")
             return self.scorer.score_batch(rows)
 
+        # devicewatch: one ledger entry + one armed stall deadline per
+        # BATCH dispatch (never per request) — a wedged device turns into
+        # a device.stall autopsy naming this batch instead of a silent
+        # worker hang; cost is two dict ops at batch granularity
+        eid = devicewatch.dispatch_ledger.register(
+            "serving.dispatch", rows=len(rows), model=self.event_label)
         try:
-            results = with_device_retry(
-                attempt, retries=self.retries,
-                backoff_s=self.retry_backoff_s)
+            with devicewatch.guard("serving.dispatch",
+                                   site="serving.dispatch",
+                                   rows=len(rows)):
+                results = with_device_retry(
+                    attempt, retries=self.retries,
+                    backoff_s=self.retry_backoff_s)
         finally:
+            devicewatch.dispatch_ledger.complete(eid)
             if attempts["n"] > 1:
                 self.metrics.record_retry(attempts["n"] - 1)
         self._exit_degraded()

@@ -54,15 +54,12 @@ class PhaseMetrics:
 
 
 def _device_memory() -> tuple[int, int]:
-    """(bytes_in_use, peak_bytes_in_use) of device 0, or zeros when the
-    backend doesn't expose memory_stats (CPU, some plugins)."""
-    try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return (int(stats.get("bytes_in_use", 0)),
-                int(stats.get("peak_bytes_in_use", 0)))
-    except Exception:  # failure-ok: backend exposes no memory stats
-        return 0, 0
+    """(bytes_in_use, peak_bytes_in_use) summed across EVERY local
+    device (the shared ``utils/devicewatch.py`` census — a mesh-sharded
+    phase's memory lives on all devices, not device 0), or zeros when
+    the backend exposes no memory_stats (CPU, some plugins)."""
+    from transmogrifai_tpu.utils.devicewatch import device_memory
+    return device_memory()
 
 
 def trace_device_events(trace_dir: str) -> list[tuple[float, float, str]]:
@@ -247,6 +244,14 @@ class AppMetrics:
             events.append({"name": name or "device-op", "ph": "X",
                            "pid": 2, "tid": 0, "ts": start * 1e6,
                            "dur": dur * 1e6, "args": {"kind": "device"}})
+        # the HBM timeline (utils/devicewatch.py low-rate census) renders
+        # as a chrome-trace counter track on the device process
+        from transmogrifai_tpu.utils.devicewatch import hbm_timeline
+        hbm = hbm_timeline()
+        for ts, used in hbm:
+            events.append({"name": "hbm_bytes_in_use", "ph": "C",
+                           "pid": 2, "tid": 0, "ts": ts * 1e6,
+                           "args": {"bytesInUse": used}})
         doc = {"traceEvents": events, "displayTimeUnit": "ms",
                "otherData": {"appName": self.app_name,
                              "totalWallSeconds": self.total_wall_s}}
@@ -255,7 +260,8 @@ class AppMetrics:
         n_host = sum(1 for e in host_events if e["ph"] == "X")
         return {"hostSpans": n_host,
                 "deviceSlices": len(self.device_events),
-                "phases": len(self.spans)}
+                "phases": len(self.spans),
+                "hbmSamples": len(hbm)}
 
 
 def _resource_counters_json() -> dict:
@@ -570,12 +576,14 @@ class _Profiler:
         trace spanning everything until ``finalize()``. Sweep and run
         counters reset alongside so a run's counters cover exactly that
         run."""
+        from transmogrifai_tpu.utils.devicewatch import reset_run
         from transmogrifai_tpu.utils.resources import resource_counters
         from transmogrifai_tpu.utils.tracing import recorder
         sweep_counters.reset()
         run_counters.reset()
         resource_counters.reset()
         recorder.reset()
+        reset_run()  # the HBM timeline covers exactly this run's trace
         self.metrics = AppMetrics(app_name=app_name)
         self.trace_dir = trace_dir
         if self._tracing:  # a previous run never finalized: stop its trace

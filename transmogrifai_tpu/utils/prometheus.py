@@ -245,6 +245,94 @@ def _resource_collectors(reg: PromRegistry) -> None:
         lambda: [({}, 1 if resources.ladder_enabled() else 0)])
 
 
+def _devicewatch_collectors(reg: PromRegistry) -> None:
+    """The device-execution observatory (``utils/devicewatch.py``):
+    dispatch-watchdog stall accounting, the in-flight dispatch ledger,
+    the all-device HBM census gauges, and the ``transmogrifai_compile_*``
+    compile-telemetry series. Carried by EVERY registry, like the
+    flight-recorder and resource series — a wedged device must be
+    visible on whatever endpoint an operator already scrapes."""
+    from transmogrifai_tpu.utils import devicewatch as dw
+
+    # collectors go through the LOCKED to_json() copies, never the live
+    # dicts: a scrape iterating by_site while a compile lands would raise
+    # dictionary-changed-size (same discipline as the resource series)
+    reg.register(
+        "transmogrifai_device_stalls_total", "counter",
+        "blocking device waits that exceeded their stall deadline, by "
+        "guarded site",
+        lambda: [({"site": s}, n)
+                 for s, n in sorted(
+                     dw.watchdog.to_json()["stallsBySite"].items())]
+                or [({"site": "none"}, 0)])
+    reg.register(
+        "transmogrifai_device_guarded_waits_total", "counter",
+        "blocking device waits armed under the dispatch watchdog",
+        lambda: [({}, dw.watchdog.guards)])
+    reg.register(
+        "transmogrifai_device_autopsies_total", "counter",
+        "stall autopsies fired (device.stall events / incident dumps)",
+        lambda: [({}, dw.watchdog.autopsies)])
+    reg.register(
+        "transmogrifai_device_watch_enabled", "gauge",
+        "1 while the dispatch watchdog is enabled "
+        "(TRANSMOGRIFAI_DEVICEWATCH)",
+        lambda: [({}, 1 if dw.watchdog.enabled else 0)])
+    reg.register(
+        "transmogrifai_device_pending_dispatches", "gauge",
+        "device dispatches currently in flight (ledger entries)",
+        lambda: [({}, len(dw.dispatch_ledger))])
+    # bounded census: a scrape of a wedged backend serves the last good
+    # sample instead of hanging /metrics exactly when it matters most
+    reg.register(
+        "transmogrifai_device_hbm_bytes_in_use", "gauge",
+        "bytes in use summed across every local device (bounded census; "
+        "0 when the backend exposes no memory stats)",
+        lambda: [({}, dw.device_memory_bounded()[0])])
+    reg.register(
+        "transmogrifai_device_hbm_peak_bytes", "gauge",
+        "peak bytes in use summed across every local device",
+        lambda: [({}, dw.device_memory_bounded()[1])])
+    # one locked snapshot shared by both compile collectors per scrape
+    # (the same short-memo trick the SLO collectors use) — to_json()
+    # copies the whole telemetry map, and doing it twice per scrape
+    # doubles lock contention with the compile path's _on_event
+    memo = {"t": 0.0, "v": None}
+
+    def _by_site():
+        now = time.monotonic()
+        if memo["v"] is None or now - memo["t"] > 0.25:
+            memo["v"] = dw.compile_telemetry.to_json()["bySite"]
+            memo["t"] = now
+        return memo["v"]
+
+    reg.register(
+        "transmogrifai_compile_programs_total", "counter",
+        "XLA backend compiles observed, by attributed site",
+        lambda: [({"site": s}, v["programs"])
+                 for s, v in sorted(_by_site().items())]
+                or [({"site": "none"}, 0)])
+    reg.register(
+        "transmogrifai_compile_wall_seconds_total", "counter",
+        "XLA backend compile wall seconds, by attributed site",
+        lambda: [({"site": s}, v["wallSeconds"])
+                 for s, v in sorted(_by_site().items())]
+                or [({"site": "none"}, 0)])
+    reg.register(
+        "transmogrifai_compile_slow_total", "counter",
+        "backend compiles over the slow threshold "
+        "(TRANSMOGRIFAI_SLOW_COMPILE_S)",
+        lambda: [({}, dw.compile_telemetry.slow)])
+    reg.register(
+        "transmogrifai_compile_in_progress", "gauge",
+        "program builds currently in flight (building() blocks open)",
+        lambda: [({}, dw.compile_telemetry.in_progress)])
+    reg.register(
+        "transmogrifai_compile_max_wall_seconds", "gauge",
+        "slowest backend compile observed this process",
+        lambda: [({}, dw.compile_telemetry.max_wall_s)])
+
+
 def _slo_collectors(reg: PromRegistry, engine) -> None:
     """The ``transmogrifai_slo_*`` surface over a ``utils.slo.SLOEngine``:
     targets, per-(alert, window) burn rates, and 0/1 alert states —
@@ -557,10 +645,12 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     ``ScoringServer``) is optional extra context reserved for future
     gauges. EVERY registry carries ``transmogrifai_build_info``, the
     process-uptime gauge, the flight recorder's
-    ``transmogrifai_events_*`` accounting, and the resource-pressure
+    ``transmogrifai_events_*`` accounting, the resource-pressure
     ``transmogrifai_resource_*`` series (degradation-ladder rungs,
-    OOM/ENOSPC events, RSS/disk gauges), so any scrape is correlatable
-    across restarts."""
+    OOM/ENOSPC events, RSS/disk gauges), and the device-execution
+    observatory's ``transmogrifai_device_*`` / ``transmogrifai_compile_*``
+    series (watchdog stalls, in-flight dispatches, all-device HBM,
+    compile walls), so any scrape is correlatable across restarts."""
     if serving is not None and fleet is not None:
         raise ValueError("pass serving= or fleet=, not both (the serving "
                          "series would collide)")
@@ -568,6 +658,7 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     _process_collectors(reg)
     _event_collectors(reg)
     _resource_collectors(reg)
+    _devicewatch_collectors(reg)
     if include_app:
         _app_collectors(reg)
     if serving is not None:

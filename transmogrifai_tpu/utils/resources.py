@@ -386,6 +386,14 @@ class ResourceWatchdog:
         state."""
         from transmogrifai_tpu.utils.events import events
         state = pressure_state(self.path)
+        try:
+            # the watchdog's cadence doubles as the HBM-timeline sampler
+            # (utils/devicewatch.py): one all-device census per tick,
+            # merged into the chrome-trace export as a counter track
+            from transmogrifai_tpu.utils.devicewatch import sample_hbm
+            state["deviceHbmBytes"] = sample_hbm()
+        except Exception:  # failure-ok: the device census is optional telemetry
+            state["deviceHbmBytes"] = 0
         self.last_sample = state
         pressured = state["rssPressure"] or state["diskPressure"]
         if pressured and not self._was_pressured:

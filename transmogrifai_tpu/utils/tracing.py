@@ -192,8 +192,10 @@ class SpanRecorder:
 
     @staticmethod
     def _device_peak() -> int:
-        from transmogrifai_tpu.utils.profiling import _device_memory
-        return _device_memory()[1]
+        # the shared ALL-device census (utils/devicewatch.py): a sharded
+        # span's memory lives on every mesh device, not device 0
+        from transmogrifai_tpu.utils.devicewatch import device_memory
+        return device_memory()[1]
 
     def add(self, name: str, t0: float, t1: float, *,
             parent_id: Optional[int] = None, thread: Optional[str] = None,
