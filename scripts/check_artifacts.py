@@ -83,6 +83,8 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_tree_stacked(doc))
     if doc.get("metric") == "serving_fleet":
         errors.extend(_validate_serving_fleet(doc))
+    if doc.get("metric") == "serving_scaleout":
+        errors.extend(_validate_serving_scaleout(doc))
     if doc.get("metric") == "one_sync_sweep":
         errors.extend(_validate_one_sync(doc))
     if doc.get("metric") == "continuous_loop":
@@ -489,6 +491,152 @@ def _validate_serving_fleet(doc: dict) -> list[str]:
                     for k in ("insertions", "evictions"))):
         errors.append("serving-fleet artifact: 'cache' must record int "
                       "'insertions' and 'evictions'")
+    return errors
+
+
+#: scale-out aggregate throughput vs the MATCHED-LOAD single-fleet leg
+#: measured in the same run on the same host. The ratio's physical
+#: ceiling is the core count: a fleet process's XLA compute already
+#: releases the GIL, so on a host with fewer cores than the topology
+#: needs (replicas + router + clients) N processes can only REDIVIDE
+#: the same cores while paying a full extra HTTP hop per request. The
+#: gate therefore has two regimes, keyed on the recorded host_cpus:
+#: an unconstrained host (cores >= replicas + 2) must prove sharding
+#: PAYS; a core-constrained host must prove the stack still carries
+#: the majority of single-process throughput through the extra hop
+#: (the scaling claim needs hardware, the robustness claims don't).
+MIN_SCALEOUT_RATIO = 1.1
+MIN_SCALEOUT_RATIO_CONSTRAINED = 0.4
+#: scale-out p99 (router hop included, kill + roll in-window) may cost
+#: at most this factor over the matched-load single-fleet p99
+MAX_SCALEOUT_P99_FACTOR = 2.0
+
+
+def _validate_serving_scaleout(doc: dict) -> list[str]:
+    """The ``benchmarks/SERVING_SCALEOUT.json`` contract: >= 4 replica
+    workers behind the router; aggregate throughput vs the matched-load
+    single-fleet leg gated by the two-regime ratio floor (see
+    ``MIN_SCALEOUT_RATIO``/``MIN_SCALEOUT_RATIO_CONSTRAINED``) with
+    p99 within ``MAX_SCALEOUT_P99_FACTOR`` x; a mid-run ``kill -9`` of
+    one replica with zero client-visible drops (router retries
+    absorbed it) and the victim respawned; a rolling promotion across
+    every replica with zero global downtime and fleet convergence on
+    the new version; and 0 post-warmup compiles on replicas that
+    mapped the shared program artifacts."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    if not (pos_int(doc.get("replicas")) and doc["replicas"] >= 4):
+        errors.append("scaleout artifact: 'replicas' must be an int "
+                      ">= 4 (fewer is not a fleet-of-fleets)")
+    if not (num(doc.get("aggregate_rps"))
+            and doc["aggregate_rps"] > 0):
+        errors.append("scaleout artifact: missing positive "
+                      "'aggregate_rps'")
+    single = doc.get("single_fleet")
+    if not (isinstance(single, dict) and num(single.get("rps"))
+            and single["rps"] > 0 and num(single.get("p99_ms"))):
+        errors.append("scaleout artifact: 'single_fleet' must record "
+                      "the matched-load baseline leg's positive 'rps' "
+                      "and 'p99_ms'")
+        single = None
+    ratio = doc.get("scale_ratio")
+    cpus = doc.get("host_cpus")
+    reps = doc.get("replicas")
+    if not pos_int(cpus):
+        errors.append("scaleout artifact: missing positive int "
+                      "'host_cpus' (the scale_ratio gate is keyed on "
+                      "it — see MIN_SCALEOUT_RATIO)")
+    if not num(ratio):
+        errors.append("scaleout artifact: missing numeric "
+                      "'scale_ratio'")
+    elif pos_int(cpus) and pos_int(reps):
+        if cpus >= reps + 2 and ratio < MIN_SCALEOUT_RATIO:
+            errors.append(
+                f"scale-out ratio {ratio} below {MIN_SCALEOUT_RATIO} "
+                f"on an unconstrained host ({cpus} cpus, {reps} "
+                "replicas) — sharding did not pay for the router hop")
+        elif cpus < reps + 2 \
+                and ratio < MIN_SCALEOUT_RATIO_CONSTRAINED:
+            errors.append(
+                f"scale-out ratio {ratio} below "
+                f"{MIN_SCALEOUT_RATIO_CONSTRAINED} even for a core-"
+                f"constrained host ({cpus} cpus, {reps} replicas) — "
+                "the router hop is eating the fleet")
+    p99 = doc.get("p99_ms")
+    if not num(p99):
+        errors.append("scaleout artifact: missing numeric 'p99_ms'")
+    elif single is not None \
+            and p99 > MAX_SCALEOUT_P99_FACTOR * single["p99_ms"]:
+        errors.append(
+            f"scale-out p99 ({p99}ms) exceeds "
+            f"{MAX_SCALEOUT_P99_FACTOR:g}x the single-fleet p99 "
+            f"({single['p99_ms']}ms) — the hop is not latency-flat")
+    if doc.get("zero_dropped") is not True:
+        errors.append("scaleout artifact: 'zero_dropped' must be true "
+                      "— every client request settled 200 through the "
+                      "kill and the roll (503s retried, not dropped)")
+    kill = doc.get("kill")
+    if not isinstance(kill, dict):
+        errors.append("scaleout artifact: missing 'kill' block")
+    else:
+        if kill.get("zero_dropped") is not True:
+            errors.append("scaleout artifact: kill.zero_dropped must "
+                          "be true — the replica kill must cost "
+                          "retries, never drops")
+        if kill.get("respawned") is not True:
+            errors.append("scaleout artifact: kill.respawned must be "
+                          "true — the supervisor must bring the "
+                          "victim back")
+        if not isinstance(kill.get("replica"), str):
+            errors.append("scaleout artifact: kill.replica must name "
+                          "the victim")
+    roll = doc.get("roll")
+    if not isinstance(roll, dict):
+        errors.append("scaleout artifact: missing 'roll' block")
+    else:
+        if roll.get("promoted") is not True:
+            errors.append("scaleout artifact: roll.promoted must be "
+                          "true")
+        if roll.get("zero_downtime") is not True:
+            errors.append("scaleout artifact: roll.zero_downtime must "
+                          "be true — no bucket of the roll window may "
+                          "go successless")
+        if roll.get("converged") is not True:
+            errors.append("scaleout artifact: roll.converged must be "
+                          "true — every replica serves the promoted "
+                          "version after the roll")
+        if not num(roll.get("wall_s")):
+            errors.append("scaleout artifact: roll.wall_s must be "
+                          "numeric")
+    arts = doc.get("artifacts")
+    if not isinstance(arts, dict):
+        errors.append("scaleout artifact: missing 'artifacts' block")
+    else:
+        pw = arts.get("post_warmup_compiles_max")
+        if not (isinstance(pw, int) and not isinstance(pw, bool)):
+            errors.append("scaleout artifact: artifacts."
+                          "post_warmup_compiles_max must be an int")
+        elif pw > 0:
+            errors.append(
+                f"compile-storm bound violated: {pw} post-warmup "
+                "compile(s) on some replica — steady-state scale-out "
+                "traffic recompiled")
+        mr = arts.get("mapped_replicas")
+        reps = doc.get("replicas")
+        if not (isinstance(mr, int) and not isinstance(mr, bool)):
+            errors.append("scaleout artifact: artifacts."
+                          "mapped_replicas must be an int")
+        elif pos_int(reps) and mr < reps:
+            errors.append(
+                f"scaleout artifact: only {mr}/{reps} replicas mapped "
+                "the shared program artifacts — compile-once-map-"
+                "everywhere did not hold")
     return errors
 
 

@@ -182,6 +182,52 @@ def collect_violations() -> list[str]:
                                              continuous=cont,
                                              include_app=False)))
 
+    # the scale-out registries (round 13): the router's
+    # transmogrifai_router_* proxy surface and the supervisor's
+    # transmogrifai_scaleout_* lifecycle series, rendered from REAL
+    # metrics objects driven hot (requests recorded, spill/markdown
+    # counters bumped, a roll counted) so every collector closure runs
+    from transmogrifai_tpu.scaleout.router import (
+        ConsistentHashRing, RouterMetrics,
+    )
+    from transmogrifai_tpu.scaleout.supervisor import ScaleoutMetrics
+
+    rm = RouterMetrics()
+    rm.record("r0", 200, 0.004)
+    rm.record("r1", 503, 0.002)
+    rm.record(None, 500, 0.05)
+    rm.count("spillovers")
+    rm.count("retries")
+    rm.count("markdowns")
+    out.extend(check_json_doc(rm.to_json(), "RouterMetrics.to_json"))
+    router_stub = types.SimpleNamespace(
+        metrics=rm, ring=ConsistentHashRing(["r0", "r1"]),
+        replicas=lambda: {"r0": {"replicaId": "r0",
+                                 "host": "127.0.0.1", "port": 9001,
+                                 "state": "up", "changedAt": 0.0},
+                          "r1": {"replicaId": "r1",
+                                 "host": "127.0.0.1", "port": 9002,
+                                 "state": "down", "changedAt": 0.0}})
+    sm = ScaleoutMetrics()
+    sm.count("spawns", 4)
+    sm.count("respawns")
+    sm.count("scale_ups")
+    sm.count("rolls")
+    sm.count("rollbacks")
+    out.extend(check_json_doc(sm.to_json(), "ScaleoutMetrics.to_json"))
+    sup_stub = types.SimpleNamespace(
+        metrics=sm, desired_replicas=4,
+        queue_ratio=lambda: 0.25,
+        to_json=lambda: {"desiredReplicas": 4, "replicas": {
+            "r0": {"pid": 1, "alive": True, "respawns": 0,
+                   "spawnedAt": 0.0}},
+            "metrics": sm.to_json()})
+    out.extend(check_json_doc(sup_stub.to_json(),
+                              "ReplicaSupervisor.to_json"))
+    out.extend(check_registry(build_registry(router=router_stub,
+                                             scaleout=sup_stub,
+                                             include_app=False)))
+
     # the SLO registry (round 10): transmogrifai_slo_* burn-rate gauges
     # over a real engine fed a synthetic timeline (every collector
     # closure renders real samples), plus the camelCase contract on the

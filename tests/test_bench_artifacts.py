@@ -490,3 +490,87 @@ def test_resource_resilience_artifact_committed_and_healthy(checker):
     assert art["ladder_disabled_fails_fast"] is True
     assert art["counters"]["degradations"] >= 3
     assert art["counters"]["oomEvents"] >= 3
+
+
+def _scaleout_good():
+    return {
+        "metric": "serving_scaleout", "platform": "cpu",
+        "host_cpus": 2, "requests": 15000, "replicas": 4,
+        "models": 4, "aggregate_rps": 640.0,
+        "p50_ms": 10.0, "p99_ms": 60.0,
+        "single_fleet": {"rps": 1100.0, "p50_ms": 5.0,
+                         "p99_ms": 38.0, "clients": 8,
+                         "requests": 11000},
+        "scale_ratio": 0.58, "zero_dropped": True,
+        "kill": {"replica": "r2", "at_s": 8.0, "zero_dropped": True,
+                 "router_retries": 40, "router_markdowns": 5,
+                 "respawned": True},
+        "roll": {"model": "m1", "promoted": True,
+                 "zero_downtime": True, "converged": True,
+                 "wall_s": 0.9},
+        "artifacts": {"mapped_replicas": 4, "replicas_seen": 4,
+                      "post_warmup_compiles_max": 0},
+    }
+
+
+def test_serving_scaleout_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _scaleout_good()
+    assert v(good) == []
+    assert any("replicas" in e for e in v({**good, "replicas": 3}))
+    assert any("zero_dropped" in e for e in v(
+        {**good, "zero_dropped": False}))
+    assert any("single_fleet" in e for e in v(
+        {k: x for k, x in good.items() if k != "single_fleet"}))
+    # the two-regime scale_ratio gate: a core-constrained host (2 cpus,
+    # 4 replicas) holds the majority-throughput floor...
+    assert any("core-constrained" in e for e in v(
+        {**good, "scale_ratio": 0.2}))
+    # ...an unconstrained host must prove sharding PAYS
+    assert any("did not pay" in e for e in v(
+        {**good, "host_cpus": 16, "scale_ratio": 0.9}))
+    assert v({**good, "host_cpus": 16, "scale_ratio": 3.2}) == []
+    # p99 flatness vs the matched-load single-fleet leg
+    assert any("p99" in e for e in v({**good, "p99_ms": 100.0}))
+    # the kill block: retries-not-drops + respawn are the contract
+    assert any("respawned" in e for e in v(
+        {**good, "kill": {**good["kill"], "respawned": False}}))
+    # the roll block: zero global downtime + fleet convergence
+    assert any("zero_downtime" in e for e in v(
+        {**good, "roll": {**good["roll"], "zero_downtime": False}}))
+    assert any("converged" in e for e in v(
+        {**good, "roll": {**good["roll"], "converged": False}}))
+    # compile-once-map-everywhere: every replica mapped, 0 post-warmup
+    assert any("mapped" in e for e in v(
+        {**good, "artifacts": {**good["artifacts"],
+                               "mapped_replicas": 2}}))
+    assert any("compile-storm" in e for e in v(
+        {**good, "artifacts": {**good["artifacts"],
+                               "post_warmup_compiles_max": 1}}))
+
+
+def test_serving_scaleout_artifact_committed_and_healthy(checker):
+    """The scale-out load test's acceptance contract, pinned on the
+    COMMITTED artifact: >= 4 replica workers behind the router, a
+    mid-run replica kill -9 absorbed as router retries (zero
+    client-visible drops, victim respawned), a rolling promotion with
+    zero global downtime converging every replica, and the shared
+    program artifacts mapped by every replica with 0 post-warmup
+    compiles."""
+    path = os.path.join(REPO, "benchmarks", "SERVING_SCALEOUT.json")
+    assert os.path.exists(path), \
+        "benchmarks/SERVING_SCALEOUT.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "serving_scaleout"
+    assert art["replicas"] >= 4 and art["models"] >= 3
+    assert art["zero_dropped"] is True
+    assert art["kill"]["respawned"] is True
+    assert art["kill"]["router_retries"] >= 1
+    assert art["roll"]["promoted"] and art["roll"]["converged"]
+    assert art["roll"]["zero_downtime"] is True
+    assert all(n > 0 for n in art["roll"]["success_buckets"])
+    assert art["artifacts"]["mapped_replicas"] == art["replicas"]
+    assert art["artifacts"]["post_warmup_compiles_max"] == 0
+    assert art["single_fleet"]["rps"] > 0
+    assert art["scale_ratio"] > 0

@@ -644,13 +644,22 @@ def test_fleet_http_health_metrics_and_scoring(zoo):
             and lineage["version"] == "v1" and lineage["fingerprint"]
         row_a = zoo["alpha"].score_function()
         assert _diff(row_a(zoo["rows_a"][0]), doc) < 1e-4
+        # keep-alive (round 13): the connection persists across
+        # requests, so every reply body must be READ before the next
+        # request on this socket
         conn.request("POST", "/score",
                      json.dumps({**zoo["rows_b"][0], "model": "beta"}))
-        assert conn.getresponse().status == 200 or True
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200 or True
         conn.request("POST", "/score/ghost", json.dumps(zoo["rows_a"][0]))
-        assert conn.getresponse().status == 404
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 404
         conn.request("POST", "/score/alpha", json.dumps({"x1": 1.0}))
-        assert conn.getresponse().status == 400
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 400
         conn.request("GET", "/metrics")
         text = conn.getresponse().read().decode()
         assert 'transmogrifai_serving_requests_admitted_total' \

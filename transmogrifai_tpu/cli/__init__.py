@@ -35,11 +35,18 @@ def main(argv=None) -> int:
         add_continuous_args, run_continuous,
     )
     from transmogrifai_tpu.cli.profile import add_profile_args, run_profile
+    from transmogrifai_tpu.cli.scaleout import (
+        add_scaleout_args, run_scaleout,
+    )
     from transmogrifai_tpu.cli.serve import add_serve_args, run_serve
     from transmogrifai_tpu.cli.slo import add_slo_args, run_slo
     add_serve_args(sub.add_parser(
         "serve", help="online micro-batched scoring over a saved model "
                       "(jsonl/csv in, jsonl scores out)"))
+    add_scaleout_args(sub.add_parser(
+        "scaleout", help="multi-process serving scale-out: consistent-"
+                         "hash router + N replica fleet workers + "
+                         "heartbeat supervision + autoscaling"))
     add_continuous_args(sub.add_parser(
         "continuous", help="closed-loop daemon: stream ingest + drift "
                            "detection + checkpoint-resumed retrain + "
@@ -63,6 +70,8 @@ def main(argv=None) -> int:
         return run_shell()
     if args.command == "serve":
         return run_serve(args)
+    if args.command == "scaleout":
+        return run_scaleout(args)
     if args.command == "continuous":
         return run_continuous(args)
     if args.command == "profile":

@@ -135,7 +135,8 @@ def _load_workflow(spec: str):
 
 def run_continuous(args: argparse.Namespace) -> int:
     from transmogrifai_tpu.cli.serve import (
-        _observability_setup, _observability_teardown,
+        GracefulShutdown, _observability_setup, _observability_teardown,
+        install_sigterm_handler,
     )
     from transmogrifai_tpu.continuous import ContinuousLoop, DriftConfig
     from transmogrifai_tpu.workflow import load_model
@@ -176,8 +177,17 @@ def run_continuous(args: argparse.Namespace) -> int:
           f"(pattern {args.pattern!r}), serving model id "
           f"{args.model_id!r}, state under {args.state_dir!r}",
           file=sys.stderr)
+    install_sigterm_handler()
     try:
         report = loop.run()
+    except GracefulShutdown:
+        # SIGTERM: loop.run()'s finally already drained the fleet,
+        # snapshotted serving totals and released the endpoint —
+        # classified as a routine shutdown (no incident dump). Report
+        # and exit 0 like a stream-timeout stop.
+        print("# SIGTERM: continuous loop drained and stopped cleanly",
+              file=sys.stderr)
+        report = loop.report()
     finally:
         _observability_teardown(args)
     print(json.dumps(report, indent=2, default=str))

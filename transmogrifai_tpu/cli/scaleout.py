@@ -1,0 +1,152 @@
+"""``transmogrifai_tpu scaleout`` — multi-process serving scale-out.
+
+``serve`` (default) runs the whole stack in one control process — the
+consistent-hash router on ``--port``, ``--replicas`` worker
+subprocesses (each a full fleet server on an ephemeral port),
+heartbeat supervision with crash respawn, and optionally the
+SLO/pressure-driven autoscaler::
+
+    python -m transmogrifai_tpu.cli scaleout serve \
+        --model-dir models/ --replicas 4 --port 8300 \
+        --state-dir scale_state/ --autoscale --max-replicas 8
+
+``status --url http://127.0.0.1:8300`` prints the replica table and
+router counters from a running stack's ``/healthz``. Rolling
+promotions are an embedding API (``ScaleoutStack.rolling_swap`` /
+``ReplicaSupervisor.rolling_swap``) — see docs/SERVING.md
+("Scale-out").
+
+SIGTERM drains: replicas finish in-flight requests before the stack
+exits (the same contract ``cli serve``/``cli continuous`` honor).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+__all__ = ["add_scaleout_args", "run_scaleout"]
+
+
+def add_scaleout_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("mode", nargs="?", default="serve",
+                    choices=("serve", "status"),
+                    help="serve (default): run router + replicas; "
+                         "status: query a running stack's /healthz")
+    sp.add_argument("--model-dir", default=None,
+                    help="saved-model register root (<id>/ or "
+                         "<id>/<version>/ layouts; required for serve)")
+    sp.add_argument("--state-dir", default=None,
+                    help="heartbeats + replica logs (required for "
+                         "serve)")
+    sp.add_argument("--replicas", type=int, default=2)
+    sp.add_argument("--port", type=int, default=0,
+                    help="router port (0 = ephemeral, printed to "
+                         "stderr)")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--spill", type=int, default=2,
+                    help="backpressure spillover bound: how many ring "
+                         "successors a 503'd request may try "
+                         "(default 2)")
+    sp.add_argument("--max-batch", type=int, default=64)
+    sp.add_argument("--queue-capacity", type=int, default=256)
+    sp.add_argument("--no-artifacts", action="store_true",
+                    help="skip the shared compiled-program artifact "
+                         "layer")
+    sp.add_argument("--warmup", default=None,
+                    help="JSON file mapping model id -> one "
+                         "representative row; published as artifact "
+                         "manifests so every replica warms before "
+                         "traffic")
+    sp.add_argument("--autoscale", action="store_true",
+                    help="drive replica count from SLO burn + queue "
+                         "depth (up) and host pressure (down)")
+    sp.add_argument("--min-replicas", type=int, default=1)
+    sp.add_argument("--max-replicas", type=int, default=8)
+    sp.add_argument("--slo", default=None, dest="slo_path",
+                    help="SLO objectives JSON evaluated over ROUTER-"
+                         "observed traffic (also the autoscaler's "
+                         "scale-up signal)")
+    sp.add_argument("--duration-s", type=float, default=None,
+                    help="serve for this long then drain and exit "
+                         "(default: until SIGTERM/^C)")
+    sp.add_argument("--url", default=None,
+                    help="status mode: the running router's base URL")
+    sp.add_argument("--events-out", default=None,
+                    help="spill the control process's flight-recorder "
+                         "events to this JSONL")
+    sp.add_argument("--resource-ladder", choices=("on", "off"),
+                    default=None, help="override the degradation "
+                         "ladder for the control process")
+
+
+def _status(url: str) -> int:
+    import urllib.request
+    with urllib.request.urlopen(f"{url.rstrip('/')}/healthz",
+                                timeout=10) as resp:
+        doc = json.loads(resp.read())
+    reps = doc.get("replicas", {})
+    print(f"status: {doc.get('status')}  ready: {doc.get('ready')}  "
+          f"replicas: {len(reps)}")
+    for rid, rep in sorted(reps.items()):
+        print(f"  {rid:>6}  {rep.get('state', '?'):>9}  "
+              f"127.0.0.1:{rep.get('port')}")
+    router = doc.get("router", {})
+    print(f"router: completed={router.get('completed')} "
+          f"failed={router.get('failed')} "
+          f"spillovers={router.get('spillovers')} "
+          f"retries={router.get('retries')} "
+          f"markdowns={router.get('markdowns')}")
+    return 0 if doc.get("ready") else 1
+
+
+def run_scaleout(args: argparse.Namespace) -> int:
+    if args.mode == "status":
+        if not args.url:
+            print("scaleout status: pass --url http://host:port",
+                  file=sys.stderr)
+            return 2
+        return _status(args.url)
+    if not args.model_dir or not args.state_dir:
+        print("scaleout serve: --model-dir and --state-dir are "
+              "required", file=sys.stderr)
+        return 2
+    from transmogrifai_tpu.cli.serve import (
+        GracefulShutdown, _observability_setup, install_sigterm_handler,
+    )
+    from transmogrifai_tpu.scaleout.stack import ScaleoutStack
+    slo = _observability_setup(args, "transmogrifai_tpu.scaleout")
+    warm = None
+    if args.warmup:
+        with open(args.warmup) as fh:
+            warm = json.load(fh)
+    worker_args = ["--max-batch", str(args.max_batch),
+                   "--queue-capacity", str(args.queue_capacity)]
+    stack = ScaleoutStack(
+        args.model_dir, args.state_dir,
+        replicas=args.replicas, port=args.port, host=args.host,
+        spill=args.spill, slo=slo, autoscale=args.autoscale,
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        warm_rows=warm, worker_args=worker_args,
+        use_artifacts=not args.no_artifacts)
+    install_sigterm_handler()
+    t_end = (time.monotonic() + args.duration_s
+             if args.duration_s is not None else None)
+    try:
+        stack.start()
+        print(f"# scaleout: router on http://{args.host}:{stack.port} "
+              f"(POST /score/<model>, /healthz, /metrics), "
+              f"{stack.supervisor.replica_count()} replica(s)",
+              file=sys.stderr)
+        while t_end is None or time.monotonic() < t_end:
+            time.sleep(0.5)
+    except (KeyboardInterrupt, GracefulShutdown):
+        print("# scaleout: draining replicas and stopping cleanly",
+              file=sys.stderr)
+    finally:
+        status = stack.status()
+        stack.stop()
+    print(json.dumps(status, indent=2, default=str))
+    return 0

@@ -31,7 +31,33 @@ import sys
 import time
 from typing import Any, Iterable, Optional
 
-__all__ = ["add_serve_args", "run_serve"]
+__all__ = ["add_serve_args", "run_serve", "GracefulShutdown",
+           "install_sigterm_handler"]
+
+
+class GracefulShutdown(SystemExit):
+    """Raised in the main thread by the SIGTERM handler: drain what was
+    admitted, write outputs/snapshots, exit 0 — a supervised daemon
+    (systemd stop, the scale-out supervisor's SIGTERM, a k8s preStop)
+    must not die mid-batch with unwritten output. A ``SystemExit``
+    subclass so the continuous loop's graceful-vs-incident
+    classification treats it as a routine shutdown, never a
+    postmortem."""
+
+
+def install_sigterm_handler() -> bool:
+    """Install the drain-and-exit SIGTERM handler (main thread only;
+    returns False elsewhere — embedded callers drive stop themselves)."""
+    import signal
+    import threading
+
+    def _handler(signum, frame):
+        raise GracefulShutdown(0)
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    signal.signal(signal.SIGTERM, _handler)
+    return True
 
 
 def add_serve_args(sp: argparse.ArgumentParser) -> None:
@@ -213,6 +239,7 @@ def run_serve(args: argparse.Namespace) -> int:
             out.write(json.dumps(doc, default=str) + "\n")
         window.clear()
 
+    install_sigterm_handler()
     try:
         server.start()
         if server.metrics_http is not None:
@@ -230,6 +257,11 @@ def run_serve(args: argparse.Namespace) -> int:
             if len(window) >= args.queue_capacity:
                 drain()
         drain()
+    except GracefulShutdown:
+        # SIGTERM: stop ADMITTING, but every already-submitted request
+        # settles and lands in the output at its slot before exit
+        drain()
+        print("# SIGTERM: drained and stopped cleanly", file=sys.stderr)
     finally:
         server.stop()
         if out is not sys.stdout:
@@ -297,6 +329,7 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
             out.write(json.dumps(doc, default=str) + "\n")
         window.clear()
 
+    install_sigterm_handler()
     try:
         fleet.start()
         if fleet.metrics_http is not None:
@@ -324,6 +357,9 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
             if len(window) >= args.queue_capacity:
                 drain()
         drain()
+    except GracefulShutdown:
+        drain()
+        print("# SIGTERM: drained and stopped cleanly", file=sys.stderr)
     finally:
         # snapshot BEFORE stop: stop() drops the lanes (and their
         # per-model metrics) so a restarted fleet builds fresh ones

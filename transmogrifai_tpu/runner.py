@@ -28,11 +28,12 @@ class RunTypes:
     SCORE = "score"
     STREAMING_SCORE = "streaming-score"
     SERVE = "serve"
+    SCALEOUT = "scaleout"
     CONTINUOUS = "continuous"
     EVALUATE = "evaluate"
     FEATURES = "features"
-    ALL = (TRAIN, SCORE, STREAMING_SCORE, SERVE, CONTINUOUS, EVALUATE,
-           FEATURES)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, SERVE, SCALEOUT, CONTINUOUS,
+           EVALUATE, FEATURES)
 
 
 class WorkflowRunner:
@@ -148,6 +149,14 @@ class WorkflowRunner:
                 # watched directory and checkpoint_dir (or
                 # customParams.stateDir) the durable resume root.
                 self._run_continuous(params, result, checkpoint_dir)
+            elif run_type == RunTypes.SCALEOUT:
+                # multi-process serving scale-out replay: spin the
+                # router + N replica worker subprocesses and drive the
+                # reader's rows through the HTTP front (docs/SERVING.md
+                # "Scale-out"). customParams: modelDir (required),
+                # replicas, defaultModel (replay target), stateDir
+                # (default --checkpoint-dir)
+                self._run_scaleout(params, result, checkpoint_dir)
             elif run_type == RunTypes.SERVE and \
                     (params.custom_params or {}).get("modelDir"):
                 # fleet replay: customParams.modelDir registers every
@@ -377,6 +386,109 @@ class WorkflowRunner:
             events_spill=bool(cp.get("eventsSpill", True)))
         result["continuous"] = loop.run()
         result["stateDir"] = state_dir
+
+    def _run_scaleout(self, params: OpParams, result: dict,
+                      checkpoint_dir: Optional[str]) -> None:
+        """SCALEOUT: replay the reader's rows through a live
+        router + replica-worker stack over HTTP — every row takes the
+        full multi-process path (router hash/spill, replica admission,
+        micro-batched compiled scoring). The reader materializes ONE
+        model's predictor columns, so ``customParams.defaultModel``
+        names the replay target when more than one model is
+        registered (same contract as the SERVE fleet replay)."""
+        import http.client
+
+        from transmogrifai_tpu.scaleout.stack import ScaleoutStack
+        cp = dict(params.custom_params or {})
+        model_dir = cp.get("modelDir")
+        if not model_dir:
+            raise ValueError("scaleout requires customParams.modelDir")
+        state_dir = cp.get("stateDir") or checkpoint_dir
+        if not state_dir:
+            raise ValueError("scaleout requires a state root: pass "
+                             "--checkpoint-dir or customParams.stateDir")
+        stack = ScaleoutStack(
+            model_dir, state_dir,
+            replicas=int(cp.get("replicas", 2)),
+            spill=int(cp.get("spill", 2)),
+            worker_args=["--max-batch", str(cp.get("maxBatch", 64)),
+                         "--queue-capacity",
+                         str(cp.get("queueCapacity", 256))])
+        ids = sorted(
+            d for d in os.listdir(model_dir)
+            if os.path.isdir(os.path.join(model_dir, d))
+            and not d.startswith("_"))
+        target = cp.get("defaultModel") or \
+            (ids[0] if len(ids) == 1 else None)
+        if target is None:
+            raise ValueError(
+                f"modelDir holds {len(ids)} models ({', '.join(ids)}); "
+                "customParams.defaultModel must name the replay target")
+        from transmogrifai_tpu.workflow import load_model
+        from transmogrifai_tpu.serialization import MODEL_JSON
+        tdir = os.path.join(model_dir, target)
+        if not os.path.exists(os.path.join(tdir, MODEL_JSON)):
+            versions = sorted(v for v in os.listdir(tdir)
+                              if os.path.exists(os.path.join(
+                                  tdir, v, MODEL_JSON)))
+            if not versions:
+                raise ValueError(f"no saved model under {tdir!r}")
+            tdir = os.path.join(tdir, versions[0])
+        ref = load_model(tdir)
+        reader = (self.scoring_reader_factory(params)
+                  if self.scoring_reader_factory else self.workflow.reader)
+        predictors = [f for f in ref.raw_features if not f.is_response]
+        frame = reader.generate_frame(predictors)
+        n_rows = n_errors = 0
+        #: whole-replay wall bound: a fleet that never becomes routable
+        #: (every replica crash-looping) must fail the run loudly, not
+        #: retry one row forever
+        replay_deadline = time.monotonic() + float(
+            cp.get("replayTimeoutS", 600.0))
+        with profiler.phase(OpStep.SCORING):
+            stack.start()
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", stack.port, timeout=60)
+                for row in frame.iter_rows():
+                    body = json.dumps(row, default=str)
+                    while True:
+                        if time.monotonic() > replay_deadline:
+                            raise RuntimeError(
+                                "scaleout replay exceeded "
+                                f"{cp.get('replayTimeoutS', 600.0)}s "
+                                f"(replicas: {stack.router.replicas()})"
+                            )
+                        try:
+                            conn.request(
+                                "POST", f"/score/{target}", body,
+                                {"Content-Type": "application/json"})
+                            resp = conn.getresponse()
+                            resp.read()
+                        except OSError:
+                            conn.close()
+                            time.sleep(0.05)
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", stack.port, timeout=60)
+                            continue
+                        if resp.status == 503:
+                            # router-level shed: wait out the hint and
+                            # retry the SAME row — reporting load as an
+                            # error slot would misread shed as loss
+                            time.sleep(min(float(resp.headers.get(
+                                "Retry-After", 0.05)), 0.5))
+                            continue
+                        break
+                    n_rows += 1
+                    if resp.status != 200:
+                        n_errors += 1
+                conn.close()
+            finally:
+                result["scaleout"] = stack.status()
+                stack.stop()
+        result["nRows"] = n_rows
+        result["nErrors"] = n_errors
+        result["rowsByModel"] = {target: n_rows}
 
     def _serve_fleet(self, params: OpParams, result: dict) -> None:
         """SERVE with ``customParams.modelDir``: replay the reader's rows

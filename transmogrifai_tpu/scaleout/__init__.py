@@ -1,0 +1,51 @@
+"""Sharded fleet-of-fleets: multi-process serving scale-out.
+
+One host (or many) runs N **replica workers** — each a full
+``serving.FleetServer`` process with its own HTTP surface — behind a
+thin **router** that consistent-hashes on model id with bounded
+spillover, supervised for heartbeat liveness / crash respawn / rolling
+hot-swap, and autoscaled from the SLO burn-rate and host-pressure
+signals the platform already keeps. See ``docs/SERVING.md``
+("Scale-out") and the module docstrings:
+
+- :mod:`~transmogrifai_tpu.scaleout.wire` — heartbeat files + admin
+  HTTP control plane (stdlib-only; the protocol contract)
+- :mod:`~transmogrifai_tpu.scaleout.router` — consistent-hash front
+  with spillover, markdown, retry-not-drop semantics
+- :mod:`~transmogrifai_tpu.scaleout.worker` — one replica process
+  (``python -m transmogrifai_tpu.scaleout.worker``)
+- :mod:`~transmogrifai_tpu.scaleout.stub_worker` — jax-free protocol
+  conformance stub (fast multi-process tests, chaos drills)
+- :mod:`~transmogrifai_tpu.scaleout.supervisor` — spawn/respawn/drain/
+  scale/rolling-swap coordination
+- :mod:`~transmogrifai_tpu.scaleout.autoscaler` — SLO-burn scale-up,
+  pressure-guarded scale-down
+- :mod:`~transmogrifai_tpu.scaleout.artifacts` — fingerprint-keyed
+  shared compiled-program artifacts (compile once, map everywhere)
+- :mod:`~transmogrifai_tpu.scaleout.stack` — the assembled
+  router+supervisor+autoscaler stack (CLI / runner / bench surface)
+"""
+
+_LAZY = {
+    "ConsistentHashRing": ("transmogrifai_tpu.scaleout.router",
+                           "ConsistentHashRing"),
+    "Router": ("transmogrifai_tpu.scaleout.router", "Router"),
+    "ReplicaSupervisor": ("transmogrifai_tpu.scaleout.supervisor",
+                          "ReplicaSupervisor"),
+    "RollingSwapError": ("transmogrifai_tpu.scaleout.supervisor",
+                         "RollingSwapError"),
+    "Autoscaler": ("transmogrifai_tpu.scaleout.autoscaler", "Autoscaler"),
+    "ArtifactStore": ("transmogrifai_tpu.scaleout.artifacts",
+                      "ArtifactStore"),
+    "ScaleoutStack": ("transmogrifai_tpu.scaleout.stack", "ScaleoutStack"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(name)

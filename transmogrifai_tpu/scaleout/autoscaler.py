@@ -1,0 +1,199 @@
+"""SLO-driven autoscaling over the replica fleet.
+
+The scaling signals are the ones the platform already keeps — nothing
+new is measured:
+
+- **scale-up** fires when the router's SLO engine reports a fast-burn
+  alert (PR 9's multi-window burn rates over router-observed
+  availability/latency: the error budget is burning at page rate, add
+  capacity before it pages) OR the mean replica admission-queue fill
+  ratio crosses ``queue_high`` (PR 6's backpressure signal, read from
+  heartbeats: the fleet is absorbing load into queues).
+- **scale-down** fires after ``low_steps`` consecutive evaluations
+  under ``queue_low`` with no burn — sustained idleness, not one quiet
+  tick.
+- **host pressure guards the decisions** (PR 10's
+  ``resources.pressure_state()``): a pressured host never scales UP
+  (another jax process on an exhausted host makes the incident worse),
+  and RSS pressure forces a scale-down step toward ``min_replicas``
+  even under load — shedding a replica IS the host's degradation-
+  ladder rung at fleet scope (the remaining replicas shed load via
+  backpressure, which clients retry; memory exhaustion drops the whole
+  host).
+
+Bounded by ``min_replicas``/``max_replicas`` with a ``cooldown_s``
+between actions so one noisy window can't flap the fleet. Every signal
+is injectable (``burn_fn``/``queue_ratio_fn``/``pressure_fn``) and
+``evaluate()`` is a pure decision function — tests drive transitions
+deterministically; ``start()`` runs it on a timer against the real
+supervisor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Callable, Optional
+
+from transmogrifai_tpu.utils.events import events
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler:
+    def __init__(self, supervisor, *, min_replicas: int = 1,
+                 max_replicas: int = 8,
+                 queue_high: float = 0.5, queue_low: float = 0.05,
+                 low_steps: int = 3,
+                 cooldown_s: float = 30.0,
+                 interval_s: float = 5.0,
+                 burn_fn: Optional[Callable[[], bool]] = None,
+                 queue_ratio_fn: Optional[Callable[[], float]] = None,
+                 pressure_fn: Optional[Callable[[], dict]] = None):
+        self.supervisor = supervisor
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.low_steps = int(low_steps)
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self._burn_fn = burn_fn
+        self._queue_ratio_fn = queue_ratio_fn
+        self._pressure_fn = pressure_fn
+        self._low_streak = 0
+        self._last_action_at: Optional[float] = None
+        self.evaluations = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signals (each injectable) -------------------------------------------
+    def _burning(self) -> bool:
+        if self._burn_fn is not None:
+            return bool(self._burn_fn())
+        engine = getattr(getattr(self.supervisor, "router", None),
+                         "slo_engine", None)
+        if engine is None:
+            return False
+        try:
+            return engine.page_firing()
+        except Exception as e:  # noqa: BLE001 — a broken signal must not kill scaling
+            warnings.warn(f"autoscaler: burn signal failed "
+                          f"({type(e).__name__}: {e})", RuntimeWarning)
+            return False
+
+    def _queue_ratio(self) -> float:
+        if self._queue_ratio_fn is not None:
+            return float(self._queue_ratio_fn())
+        try:
+            return float(self.supervisor.queue_ratio())
+        except Exception as e:  # noqa: BLE001 — see _burning
+            warnings.warn(f"autoscaler: queue signal failed "
+                          f"({type(e).__name__}: {e})", RuntimeWarning)
+            return 0.0
+
+    def _pressure(self) -> dict:
+        if self._pressure_fn is not None:
+            return dict(self._pressure_fn())
+        from transmogrifai_tpu.utils.resources import pressure_state
+        return pressure_state()
+
+    # -- decision -------------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> Optional[dict]:
+        """One scaling decision (pure; ``apply`` acts on it). Returns
+        ``{"direction", "fromReplicas", "toReplicas", "reason"}`` or
+        None."""
+        now = time.monotonic() if now is None else now
+        self.evaluations += 1
+        current = self.supervisor.replica_count()
+        in_cooldown = (self._last_action_at is not None
+                       and now - self._last_action_at < self.cooldown_s)
+        burning = self._burning()
+        ratio = self._queue_ratio()
+        pressure = self._pressure()
+        pressured = bool(pressure.get("rssPressure"))
+        want_up = burning or ratio >= self.queue_high
+        if want_up:
+            self._low_streak = 0
+        elif ratio <= self.queue_low and not burning:
+            self._low_streak += 1
+        else:
+            self._low_streak = 0
+        if pressured and current > self.min_replicas \
+                and not in_cooldown:
+            # the fleet-scope degradation rung: shed a replica to
+            # relieve the host, even under load (see module docstring)
+            return {"direction": "down", "fromReplicas": current,
+                    "toReplicas": current - 1,
+                    "reason": "host_pressure"}
+        if in_cooldown:
+            return None
+        if want_up and not pressured and current < self.max_replicas:
+            return {"direction": "up", "fromReplicas": current,
+                    "toReplicas": current + 1,
+                    "reason": "slo_burn" if burning else "queue_depth"}
+        if self._low_streak >= self.low_steps \
+                and current > self.min_replicas:
+            return {"direction": "down", "fromReplicas": current,
+                    "toReplicas": current - 1, "reason": "idle"}
+        return None
+
+    def apply(self, decision: Optional[dict],
+              now: Optional[float] = None) -> bool:
+        if decision is None:
+            return False
+        now = time.monotonic() if now is None else now
+        self.supervisor.scale_to(decision["toReplicas"])
+        self._last_action_at = now
+        self._low_streak = 0
+        if decision["direction"] == "up":
+            self.scale_ups += 1
+        else:
+            self.scale_downs += 1
+        events.emit("scaleout.autoscale", **decision)
+        return True
+
+    def step(self, now: Optional[float] = None) -> Optional[dict]:
+        decision = self.evaluate(now)
+        self.apply(decision, now)
+        return decision
+
+    # -- timer ---------------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="transmogrifai-scaleout-autoscaler",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — scaling must not die of one bad tick
+                warnings.warn(
+                    f"autoscaler: step failed ({type(e).__name__}: "
+                    f"{e})", RuntimeWarning)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def to_json(self) -> dict:
+        return {"minReplicas": self.min_replicas,
+                "maxReplicas": self.max_replicas,
+                "queueHigh": self.queue_high,
+                "queueLow": self.queue_low,
+                "cooldownSeconds": self.cooldown_s,
+                "evaluations": self.evaluations,
+                "scaleUps": self.scale_ups,
+                "scaleDowns": self.scale_downs,
+                "lowStreak": self._low_streak}

@@ -1,0 +1,551 @@
+"""The scale-out front door: a consistent-hash router over replica
+workers with bounded spillover, heartbeat markdown, and retry-not-drop
+semantics.
+
+Routing policy:
+
+- **consistent hash on model id** (``ConsistentHashRing``, virtual
+  nodes): one model's traffic lands on one primary replica, so each
+  replica's compiled-program cache holds the programs of the models it
+  actually serves — fleet-wide HBM is sharded, not mirrored. Ring
+  membership changes move only the affected arc (the consistent-hash
+  property a modulo hash lacks), so a respawn doesn't reshuffle every
+  model's affinity.
+- **bounded spillover**: a primary answering 503 (its admission queue
+  is full — the replica's OWN backpressure) spills the request to the
+  next ``spill`` distinct replicas in ring order. Spillover is the
+  pressure valve that turns single-model hotspots into fleet-wide
+  utilization; the bound keeps a poisoned request from touring every
+  replica.
+- **markdown**: a replica that refuses connections (crashed, killed,
+  mid-respawn) is marked down immediately and skipped by routing until
+  the supervisor's heartbeat monitor marks it back up. The in-flight
+  request that DISCOVERED the death is retried on the next candidate —
+  scoring is idempotent, so a replica kill costs retries, never client
+  drops.
+- every proxied reply carries ``X-Served-By: <replica_id>`` so a load
+  harness can prove where traffic actually went.
+
+The router itself is model-free and jax-free: it proxies bytes. Its
+``/metrics`` renders ``transmogrifai_router_*`` plus the standard
+process series; ``/healthz`` reports the replica table and SLO state
+(the router's own availability/latency objectives can drive the
+autoscaler's scale-up signal). Chaos seam: ``fault_point
+("scaleout.route")`` fires per proxy attempt.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from transmogrifai_tpu.serving.metrics import LATENCY_BUCKETS_S
+from transmogrifai_tpu.utils.events import events
+
+__all__ = ["ConsistentHashRing", "Router", "RouterMetrics",
+           "ReplicaDown"]
+
+
+class ReplicaDown(RuntimeError):
+    """Transport-level failure talking to a replica (connect/read)."""
+
+
+class ConsistentHashRing:
+    """Consistent hashing with virtual nodes. ``order(key)`` walks the
+    ring from the key's position and returns every DISTINCT member once
+    — the primary first, then the spillover successors. Membership
+    changes move only the arcs adjacent to the changed member."""
+
+    def __init__(self, members=(), vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        #: membership changes swap in a freshly built (ring, hashes)
+        #: pair under the lock; order() snapshots the pair once, so a
+        #: handler thread mid-walk can never index a ring that a
+        #: concurrent rebuild just shrank
+        self._lock = threading.Lock()
+        self._ring: list[tuple[int, str]] = []
+        self._hashes: list[int] = []
+        self._members: set[str] = set()
+        for m in members:
+            self.add(m)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(key.encode()).digest()[:8], "big")
+
+    def _rebuild(self) -> None:
+        ring = sorted(
+            (self._hash(f"{m}#{i}"), m)
+            for m in self._members for i in range(self.vnodes))
+        self._ring = ring
+        self._hashes = [h for h, _ in ring]
+
+    def add(self, member: str) -> None:
+        with self._lock:
+            if member not in self._members:
+                self._members.add(member)
+                self._rebuild()
+
+    def remove(self, member: str) -> None:
+        with self._lock:
+            if member in self._members:
+                self._members.discard(member)
+                self._rebuild()
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def order(self, key: str) -> list[str]:
+        """Every member once, in ring order starting at ``key``'s
+        position (primary first)."""
+        with self._lock:
+            ring, hashes = self._ring, self._hashes
+            n_members = len(self._members)
+        if not ring:
+            return []
+        start = bisect.bisect_left(hashes, self._hash(key)) % len(ring)
+        seen: list[str] = []
+        seen_set: set[str] = set()
+        n = len(ring)
+        for i in range(n):
+            _, m = ring[(start + i) % n]
+            if m not in seen_set:
+                seen.append(m)
+                seen_set.add(m)
+                if len(seen_set) == n_members:
+                    break
+        return seen
+
+
+class RouterMetrics:
+    """Router-side request accounting. Deliberately shaped like the
+    slice of ``ServingMetrics`` the SLO engine reads (``completed`` /
+    ``failed`` counters + ``latency_histogram()``), so availability and
+    latency objectives bind to router-observed traffic unchanged —
+    which is what the autoscaler's burn-rate scale-up signal watches."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.completed = 0          # 2xx replies proxied back
+        self.failed = 0             # 5xx/transport after all candidates
+        self.client_errors = 0      # 4xx from the replica (caller bug)
+        self.spillovers = 0         # 503 -> next replica
+        self.retries = 0            # transport error -> next replica
+        self.markdowns = 0          # replicas marked down by the router
+        self.no_replica = 0         # no routable replica at all
+        self.by_replica: dict[str, int] = {}
+        self._lat_buckets = [0] * (len(LATENCY_BUCKETS_S) + 1)
+        self._lat_sum = 0.0
+
+    def record(self, replica_id: Optional[str], status: int,
+               latency_s: float) -> None:
+        with self._lock:
+            if replica_id is not None:
+                self.by_replica[replica_id] = \
+                    self.by_replica.get(replica_id, 0) + 1
+            if 200 <= status < 300:
+                self.completed += 1
+            elif 400 <= status < 500:
+                self.client_errors += 1
+            else:
+                self.failed += 1
+            self._lat_sum += latency_s
+            for i, bound in enumerate(LATENCY_BUCKETS_S):
+                if latency_s <= bound:
+                    self._lat_buckets[i] += 1
+                    break
+            else:
+                self._lat_buckets[-1] += 1
+
+    def count(self, attr: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + n)
+
+    def latency_histogram(self) -> dict:
+        with self._lock:
+            per_bin = list(self._lat_buckets)
+            total = self._lat_sum
+        buckets: dict = {}
+        running = 0
+        for bound, n in zip(LATENCY_BUCKETS_S, per_bin):
+            running += n
+            buckets[f"{bound:g}"] = running
+        running += per_bin[-1]
+        buckets["+Inf"] = running
+        return {"buckets": buckets, "sum": total, "count": running}
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"completed": self.completed, "failed": self.failed,
+                    "clientErrors": self.client_errors,
+                    "spillovers": self.spillovers,
+                    "retries": self.retries,
+                    "markdowns": self.markdowns,
+                    "noReplica": self.no_replica,
+                    "byReplica": dict(self.by_replica)}
+
+
+class _Replica:
+    __slots__ = ("replica_id", "host", "port", "state", "changed_at")
+
+    def __init__(self, replica_id, host, port):
+        self.replica_id = replica_id
+        self.host = host
+        self.port = int(port)
+        self.state = "up"            # up | down | draining
+        self.changed_at = time.time()
+
+    def to_json(self) -> dict:
+        return {"replicaId": self.replica_id, "host": self.host,
+                "port": self.port, "state": self.state,
+                "changedAt": self.changed_at}
+
+
+class Router:
+    """HTTP front proxying ``POST /score[/<model_id>]`` across replica
+    workers (see module docstring for the policy). Thread-per-connection
+    (``ThreadingHTTPServer``) with one upstream keep-alive connection
+    per (handler thread, replica) — the hop costs a request/response on
+    a warm socket, not a handshake."""
+
+    def __init__(self, *, port: int = 0, host: str = "127.0.0.1",
+                 spill: int = 2, vnodes: int = 64,
+                 route_field: str = "model",
+                 upstream_timeout_s: float = 30.0,
+                 slo=None):
+        self.ring = ConsistentHashRing(vnodes=vnodes)
+        self.metrics = RouterMetrics()
+        self.spill = int(spill)
+        self.route_field = route_field
+        self.upstream_timeout_s = float(upstream_timeout_s)
+        self._replicas: dict[str, _Replica] = {}
+        self._lock = threading.Lock()
+        self._host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._tls = threading.local()
+        #: SLO engine over ROUTER-observed traffic (availability /
+        #: latency objectives; the autoscaler's burn signal)
+        self.slo_engine = None
+        if slo is not None:
+            from transmogrifai_tpu.utils.slo import SLOEngine
+            self.slo_engine = SLOEngine.for_serving(
+                slo, lambda: [self.metrics])
+        self._registry_obj = None
+
+    # -- membership (supervisor-driven) --------------------------------------
+    def set_replica(self, replica_id: str, port: int,
+                    host: str = "127.0.0.1") -> None:
+        """Add or re-point a replica (respawns get a fresh port)."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.port != int(port) or rep.host != host:
+                self._replicas[replica_id] = _Replica(
+                    replica_id, host, port)
+            else:
+                rep.state = "up"
+                rep.changed_at = time.time()
+        self.ring.add(replica_id)
+
+    def remove_replica(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+        self.ring.remove(replica_id)
+
+    def _set_state(self, replica_id: str, state: str) -> bool:
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.state == state:
+                return False
+            rep.state = state
+            rep.changed_at = time.time()
+            return True
+
+    def mark_down(self, replica_id: str, reason: str = "") -> None:
+        """Take a replica out of routing (crash, stale heartbeat). The
+        requests it was serving are retried on its ring successors."""
+        if self._set_state(replica_id, "down"):
+            self.metrics.count("markdowns")
+            events.emit("scaleout.markdown", replica=replica_id,
+                        reason=reason or None)
+
+    def mark_up(self, replica_id: str) -> None:
+        if self._set_state(replica_id, "up"):
+            events.emit("scaleout.markup", replica=replica_id)
+
+    def set_draining(self, replica_id: str) -> None:
+        """Stop routing NEW requests to a replica (rolling swap / scale
+        down) without counting it as a failure."""
+        self._set_state(replica_id, "draining")
+
+    def replicas(self) -> dict:
+        with self._lock:
+            return {rid: rep.to_json()
+                    for rid, rep in self._replicas.items()}
+
+    # -- routing --------------------------------------------------------------
+    def candidates(self, model_id: str) -> list[_Replica]:
+        """The primary + up to ``spill`` routable successors for one
+        model id (ring order, down/draining filtered out)."""
+        order = self.ring.order(model_id)
+        out: list[_Replica] = []
+        with self._lock:
+            for rid in order:
+                rep = self._replicas.get(rid)
+                if rep is not None and rep.state == "up":
+                    out.append(rep)
+                    if len(out) > self.spill:
+                        break
+        return out
+
+    def route_order(self, model_id: str) -> list[str]:
+        return [r.replica_id for r in self.candidates(model_id)]
+
+    def _upstream(self, rep: _Replica) -> http.client.HTTPConnection:
+        """Per-(handler thread, replica) keep-alive connection."""
+        pool = getattr(self._tls, "pool", None)
+        if pool is None:
+            pool = self._tls.pool = {}
+        key = (rep.host, rep.port)
+        conn = pool.get(key)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.upstream_timeout_s)
+            pool[key] = conn
+        return conn
+
+    def _drop_upstream(self, rep: _Replica) -> None:
+        pool = getattr(self._tls, "pool", None)
+        if pool is not None:
+            conn = pool.pop((rep.host, rep.port), None)
+            if conn is not None:
+                conn.close()
+
+    def _proxy_once(self, rep: _Replica, path: str, body: bytes,
+                    headers: dict) -> tuple:
+        """One upstream attempt -> (status, reply_headers, payload).
+        Transport failures raise :class:`ReplicaDown`. One reconnect is
+        attempted first: an idle keep-alive socket the replica closed
+        (or a stale pool entry from before a respawn) is not a dead
+        replica."""
+        from transmogrifai_tpu.utils.faults import fault_point
+        fault_point("scaleout.route")
+        for attempt in (0, 1):
+            conn = self._upstream(rep)
+            try:
+                conn.request("POST", path, body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                return resp.status, dict(resp.getheaders()), payload
+            except Exception as e:  # noqa: BLE001 — classified below
+                self._drop_upstream(rep)
+                if attempt == 1:
+                    raise ReplicaDown(
+                        f"replica {rep.replica_id} at {rep.host}:"
+                        f"{rep.port}: {type(e).__name__}: {e}") from e
+
+    def dispatch(self, model_id: str, body: bytes,
+                 headers: Optional[dict] = None) -> tuple:
+        """Route one scoring request: primary, spill on 503, retry next
+        on transport death (marking the dead replica down). Returns
+        ``(status, headers, payload, replica_id)``; with no routable
+        replica or every candidate exhausted, a synthesized 503."""
+        headers = dict(headers or {})
+        headers.setdefault("Content-Type", "application/json")
+        path = f"/score/{model_id}"
+        candidates = self.candidates(model_id)
+        if not candidates:
+            self.metrics.count("no_replica")
+            return (503, {"Retry-After": "1.0"},
+                    json.dumps({"error": "no routable replica"}).encode(),
+                    None)
+        last: tuple = (503, {"Retry-After": "0.05"},
+                       json.dumps({"error": "all replicas "
+                                            "backpressured"}).encode(),
+                       None)
+        for i, rep in enumerate(candidates):
+            try:
+                status, rheaders, payload = self._proxy_once(
+                    rep, path, body, headers)
+            except ReplicaDown as e:
+                # the request DISCOVERED the death: mark down, retry on
+                # the next candidate — a kill costs retries, not drops
+                self.mark_down(rep.replica_id, reason=str(e)[:200])
+                self.metrics.count("retries")
+                continue
+            except Exception as e:  # noqa: BLE001 — injected route faults
+                # (chaos site scaleout.route): transient/io failures on
+                # the hop retry the next candidate, bounded by the
+                # candidate list; harness errors must surface
+                from transmogrifai_tpu.utils.faults import (
+                    FaultHarnessError,
+                )
+                if isinstance(e, FaultHarnessError):
+                    raise
+                self.metrics.count("retries")
+                continue
+            if status == 503:
+                # the replica's own admission backpressure: spill over
+                self.metrics.count("spillovers")
+                last = (status, rheaders, payload, rep.replica_id)
+                continue
+            return status, rheaders, payload, rep.replica_id
+        return last
+
+    # -- HTTP front -----------------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def _registry(self):
+        if self._registry_obj is None:
+            from transmogrifai_tpu.utils.prometheus import build_registry
+            self._registry_obj = build_registry(
+                router=self, slo=self.slo_engine, include_app=False)
+        return self._registry_obj
+
+    def health(self) -> dict:
+        from transmogrifai_tpu.utils.resources import pressure_state
+        from transmogrifai_tpu.utils.slo import fold_health
+        reps = self.replicas()
+        up = sum(1 for r in reps.values() if r["state"] == "up")
+        doc = {"status": "ok" if up else "no_replicas",
+               "ready": up > 0,
+               "replicas": reps,
+               "router": self.metrics.to_json(),
+               "resources": pressure_state()}
+        fold_health(self.slo_engine, doc)
+        return doc
+
+    def start(self) -> "Router":
+        if self._httpd is not None:
+            return self
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, code, body, ctype="application/json",
+                       extra=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    if k.lower() not in ("content-length", "connection",
+                                         "transfer-encoding", "server",
+                                         "date", "content-type"):
+                        self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?")[0]
+                try:
+                    if path == "/metrics":
+                        from transmogrifai_tpu.utils.prometheus import (
+                            CONTENT_TYPE,
+                        )
+                        self._reply(200,
+                                    outer._registry().render().encode(),
+                                    CONTENT_TYPE)
+                    elif path == "/healthz":
+                        self._reply(200, (json.dumps(outer.health())
+                                          + "\n").encode())
+                    elif path == "/replicas":
+                        self._reply(200, (json.dumps(outer.replicas())
+                                          + "\n").encode())
+                    else:
+                        self.send_error(404, "only /metrics, /healthz, "
+                                             "/replicas, POST /score")
+                except Exception as e:  # noqa: BLE001 — a probe must see the failure
+                    self.send_error(500, f"{type(e).__name__}: "
+                                         f"{str(e)[:200]}")
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                t0 = time.monotonic()
+                path = self.path.split("?")[0]
+                if not (path == "/score" or path.startswith("/score/")):
+                    self.send_error(404, "POST /score[/<model>]")
+                    return
+                from transmogrifai_tpu.serving.http import MAX_BODY_BYTES
+                if self.headers.get("Transfer-Encoding"):
+                    # an unread chunked body would desync keep-alive
+                    self.send_error(411, "chunked bodies unsupported; "
+                                         "send Content-Length")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self.send_error(400, "malformed Content-Length")
+                    return
+                if n < 0:
+                    self.send_error(400, "negative Content-Length")
+                    return
+                if n > MAX_BODY_BYTES:
+                    self.send_error(413, "request body too large")
+                    return
+                body = self.rfile.read(n) if n else b"{}"
+                model_id = path[len("/score/"):] \
+                    if path.startswith("/score/") else ""
+                if not model_id:
+                    # routing key from the body's route field (popped by
+                    # the replica fleet anyway)
+                    try:
+                        doc = json.loads(body or b"{}")
+                        model_id = str(doc.get(outer.route_field, ""))
+                    except ValueError:
+                        model_id = ""
+                    if not model_id:
+                        self._reply(400, json.dumps(
+                            {"error": "no model id (path or "
+                                      f"{outer.route_field!r} field)"}
+                        ).encode())
+                        return
+                fwd = {"Content-Type": "application/json"}
+                trace = self.headers.get("X-Trace-Id")
+                if trace:
+                    fwd["X-Trace-Id"] = trace
+                status, rheaders, payload, rid = outer.dispatch(
+                    model_id, body, fwd)
+                outer.metrics.record(rid, status,
+                                     time.monotonic() - t0)
+                extra = {k: v for k, v in rheaders.items()
+                         if k.lower() in ("x-trace-id", "retry-after")}
+                if rid is not None:
+                    extra["X-Served-By"] = rid
+                self._reply(status, payload, extra=extra)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="transmogrifai-scaleout-router", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

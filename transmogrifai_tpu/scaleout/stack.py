@@ -1,0 +1,153 @@
+"""The assembled scale-out stack: router + supervisor (+ autoscaler)
+as one object — the surface ``cli scaleout``, the runner's SCALEOUT
+mode, the bench and the tests all drive.
+
+Startup order matters and lives here so every caller gets it right:
+the router binds first (clients can connect and get honest 503s while
+replicas warm), replicas spawn and join as their heartbeats publish
+bound ports, artifact manifests publish BEFORE the spawn when warm
+rows are given (so even the first replica warms through the shared
+layer), and the autoscaler starts last.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from typing import Optional
+
+__all__ = ["ScaleoutStack"]
+
+
+class ScaleoutStack:
+    """One-call scale-out serving: ``ScaleoutStack(model_dir,
+    state_dir, replicas=4).start()``."""
+
+    def __init__(self, model_dir: str, state_dir: str, *,
+                 replicas: int = 2, port: int = 0,
+                 host: str = "127.0.0.1", spill: int = 2,
+                 slo=None, autoscale: bool = False,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 autoscale_interval_s: float = 5.0,
+                 cooldown_s: float = 30.0,
+                 warm_rows: Optional[dict] = None,
+                 worker_module: str =
+                 "transmogrifai_tpu.scaleout.worker",
+                 worker_args: Optional[list] = None,
+                 worker_env: Optional[dict] = None,
+                 heartbeat_ttl_s: float = 3.0,
+                 spawn_timeout_s: float = 180.0,
+                 use_artifacts: bool = True):
+        from transmogrifai_tpu.scaleout.autoscaler import Autoscaler
+        from transmogrifai_tpu.scaleout.router import Router
+        from transmogrifai_tpu.scaleout.supervisor import (
+            ReplicaSupervisor,
+        )
+        self.model_dir = model_dir
+        self.state_dir = state_dir
+        self.use_artifacts = bool(use_artifacts)
+        #: model id -> one representative request row; published as
+        #: artifact manifests before the first replica spawns
+        self.warm_rows = dict(warm_rows or {})
+        self.router = Router(port=port, host=host, spill=spill, slo=slo)
+        args = list(worker_args or [])
+        if not use_artifacts and "--no-artifacts" not in args:
+            args.append("--no-artifacts")
+        self.supervisor = ReplicaSupervisor(
+            model_dir, state_dir, self.router, replicas=replicas,
+            worker_module=worker_module, worker_args=args,
+            worker_env=worker_env, heartbeat_ttl_s=heartbeat_ttl_s,
+            spawn_timeout_s=spawn_timeout_s)
+        self.autoscaler = Autoscaler(
+            self.supervisor, min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            interval_s=autoscale_interval_s,
+            cooldown_s=cooldown_s) if autoscale else None
+        self.started_at: Optional[float] = None
+
+    # -- artifact publication -------------------------------------------------
+    def publish_artifacts(self) -> int:
+        """Publish warmup manifests for ``warm_rows`` WITHOUT loading
+        any model (fingerprints hash the saved bytes): the operator-prep
+        step that lets replica #1 already warm through the shared
+        layer. Returns the number of manifests published."""
+        if not self.warm_rows or not self.use_artifacts:
+            return 0
+        from transmogrifai_tpu.checkpoint import model_fingerprint
+        from transmogrifai_tpu.scaleout.artifacts import ArtifactStore
+        from transmogrifai_tpu.serialization import MODEL_JSON
+        from transmogrifai_tpu.serving.registry import read_active_alias
+        store = ArtifactStore(self.model_dir)
+        n = 0
+        for model_id, row in self.warm_rows.items():
+            id_dir = os.path.join(self.model_dir, model_id)
+            path = None
+            if os.path.exists(os.path.join(id_dir, MODEL_JSON)):
+                path = id_dir
+            elif os.path.isdir(id_dir):
+                alias = read_active_alias(id_dir)
+                versions = sorted(
+                    v for v in os.listdir(id_dir)
+                    if os.path.exists(os.path.join(id_dir, v,
+                                                   MODEL_JSON)))
+                if alias and alias in versions:
+                    path = os.path.join(id_dir, alias)
+                elif versions:
+                    path = os.path.join(id_dir, versions[0])
+            if path is None:
+                warnings.warn(
+                    f"scaleout: no saved model for warm row "
+                    f"{model_id!r} under {self.model_dir!r}",
+                    RuntimeWarning)
+                continue
+            fp = model_fingerprint(path=path)
+            if store.publish(fp, {"modelId": model_id,
+                                  "warmRow": dict(row),
+                                  "publishedBy": "stack"}):
+                n += 1
+        return n
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "ScaleoutStack":
+        self.publish_artifacts()
+        self.router.start()
+        self.supervisor.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        self.started_at = time.time()
+        return self
+
+    def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.supervisor.stop()
+        self.router.stop()
+
+    def __enter__(self) -> "ScaleoutStack":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- operations -----------------------------------------------------------
+    def rolling_swap(self, model_id: str, **kwargs) -> dict:
+        return self.supervisor.rolling_swap(model_id, **kwargs)
+
+    def scale_to(self, n: int) -> int:
+        return self.supervisor.scale_to(n)
+
+    @property
+    def port(self) -> Optional[int]:
+        return self.router.port
+
+    def status(self) -> dict:
+        doc = {"router": {"port": self.router.port,
+                          "replicas": self.router.replicas(),
+                          "metrics": self.router.metrics.to_json()},
+               "supervisor": self.supervisor.to_json(),
+               "heartbeats": self.supervisor.heartbeats(),
+               "startedAt": self.started_at}
+        if self.autoscaler is not None:
+            doc["autoscaler"] = self.autoscaler.to_json()
+        return doc

@@ -1,0 +1,195 @@
+"""Wire-protocol conformance stub: a replica that speaks the full
+scale-out contract with NO model and NO jax.
+
+``python -m transmogrifai_tpu.scaleout.stub_worker --state-dir S
+--replica-id r0`` starts in ~100ms and serves:
+
+- ``POST /score/<model>`` -> ``{"score": <deterministic value>,
+  "replica": <id>, "version": <active>}`` (optional ``--latency-ms``),
+- heartbeats + ``POST /admin/status|drain|swap|quit``,
+- scripted failure modes (``--reject-swap``: the admin swap answers
+  409 like a shadow-gate rejection — UNLESS the swap skips the gate
+  with ``shadowRows: 0``, exactly like the real worker's forced
+  rollback; ``--backpressure``: every score answers 503+Retry-After).
+
+Two jobs: (1) fast multi-process supervisor/router/rolling-swap tests
+— spawn/kill/respawn semantics are about processes and sockets, not
+about jax; (2) an operator chaos drill against a live router without
+burning accelerator time. The REAL replica (``scaleout/worker.py``)
+is covered by its own end-to-end test and the committed scale-out
+bench; this stub exists so everything around it is cheap to exercise.
+
+Imports only the stdlib + ``scaleout/wire.py`` — keep it that way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from transmogrifai_tpu.scaleout import wire
+from transmogrifai_tpu.scaleout.wire import ReplicaStates
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("scaleout stub worker")
+    ap.add_argument("--state-dir", required=True)
+    ap.add_argument("--replica-id", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--heartbeat-interval", type=float, default=0.2)
+    ap.add_argument("--version", default="v1",
+                    help="initial active version reported per model")
+    ap.add_argument("--latency-ms", type=float, default=0.0)
+    ap.add_argument("--reject-swap", action="store_true",
+                    help="answer gated admin swaps 409 (shadow-parity "
+                         "rejection analog); gate-skipped swaps "
+                         "(shadowRows=0) still succeed")
+    ap.add_argument("--backpressure", action="store_true",
+                    help="answer every score 503 + Retry-After")
+    # accepted-and-ignored real-worker flags so a supervisor configured
+    # for real workers can be pointed at the stub unchanged
+    ap.add_argument("--model-dir", default=None)
+    ap.add_argument("--max-batch", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    state = {"state": ReplicaStates.STARTING,
+             "version": args.version, "swaps": [], "served": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code, doc, extra=None):
+            body = (json.dumps(doc) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path.split("?")[0] == "/healthz":
+                with lock:
+                    self._reply(200, {"status": "ok",
+                                      "replicaId": args.replica_id,
+                                      "state": state["state"]})
+            else:
+                self.send_error(404)
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            path = self.path.split("?")[0]
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) if n else b"{}"
+            try:
+                payload = json.loads(raw or b"{}")
+            except ValueError:
+                payload = {}
+            if path.startswith("/score"):
+                if args.backpressure:
+                    self._reply(503, {"error": "stub backpressure"},
+                                {"Retry-After": "0.01"})
+                    return
+                if args.latency_ms:
+                    time.sleep(args.latency_ms / 1e3)
+                model = path[len("/score/"):] or "default"
+                with lock:
+                    state["served"] += 1
+                    doc = {"score": float(
+                               len(model) + len(payload)),
+                           "replica": args.replica_id,
+                           "version": state["version"]}
+                self._reply(200, doc)
+                return
+            if path.startswith("/admin/"):
+                self._admin(path[len("/admin/"):], payload)
+                return
+            self.send_error(404)
+
+        def _admin(self, action, payload):
+            if action == "status":
+                with lock:
+                    self._reply(200, {"ok": True,
+                                      "replicaId": args.replica_id,
+                                      "state": state["state"],
+                                      "version": state["version"],
+                                      "served": state["served"],
+                                      "swaps": list(state["swaps"])})
+            elif action == "drain":
+                # draining is a moment, not a destination (see the real
+                # worker's _drain): quiesce instantly, back to READY
+                with lock:
+                    state["state"] = ReplicaStates.READY
+                self._reply(200, {"ok": True, "drained": True})
+            elif action == "swap":
+                gated = int(payload.get("shadowRows", 1) or 0) > 0
+                if args.reject_swap and gated:
+                    self._reply(409, {
+                        "ok": False,
+                        "error": "ShadowParityError: stub gate "
+                                 "rejection (scripted)"})
+                    return
+                with lock:
+                    old = state["version"]
+                    new = payload.get("version") \
+                        or os.path.basename(
+                            str(payload.get("path", "v?")))
+                    state["version"] = new
+                    state["swaps"].append(
+                        {"from": old, "to": new, "gated": gated})
+                    state["state"] = ReplicaStates.READY
+                self._reply(200, {"ok": True, "fromVersion": old,
+                                  "toVersion": new, "fromPath": old,
+                                  "modelId": payload.get("modelId")})
+            elif action == "quit":
+                self._reply(200, {"ok": True, "stopping": True})
+                stop.set()
+            else:
+                self._reply(400, {"ok": False,
+                                  "error": f"unknown action {action}"})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    with lock:
+        state["state"] = ReplicaStates.READY
+
+    def hb():
+        with lock:
+            return wire.write_heartbeat(args.state_dir, {
+                "replicaId": args.replica_id, "pid": os.getpid(),
+                "port": port, "state": state["state"],
+                "models": ["stub"], "queueDepths": {},
+                "counters": {"admitted": state["served"],
+                             "completed": state["served"], "failed": 0},
+                "postWarmupCompilesMax": 0, "artifactMapped": [],
+                "startedAt": time.time()})
+
+    hb()
+    while not stop.wait(args.heartbeat_interval):
+        hb()
+    with lock:
+        state["state"] = ReplicaStates.STOPPED
+    hb()
+    httpd.shutdown()
+    httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""Scale-out wire protocol: heartbeat files + the admin control plane.
+
+The contract between the three scale-out processes — supervisor, router
+(both usually one control process) and N replica workers — kept
+deliberately **stdlib-only** so a conformance stub (or an operator's
+shell one-liner) can speak it without importing the framework:
+
+- **heartbeats**: each replica atomically rewrites
+  ``<state_dir>/replicas/<replica_id>.json`` every
+  ``heartbeat_interval_s`` with its pid, bound HTTP port, lifecycle
+  state, queue depths and serving counters. Writes go tmp-file +
+  ``os.replace`` so the supervisor's poll NEVER reads a torn document;
+  staleness (``ts`` older than the TTL) is the liveness signal that
+  marks a replica down in the router before respawn.
+- **admin control plane**: ``POST /admin/<action>`` on the replica's
+  own HTTP port (``serving/http.py`` ``control_fn``), JSON in/out.
+  Actions every worker implements: ``status`` (fleet snapshot +
+  post-warmup compile counts), ``drain`` (quiesce: finish in-flight,
+  report drained), ``swap`` (hot-swap one model behind the live
+  endpoint; ``{"modelId", "version"|"path", "tolerance"?,
+  "shadowRows"?}`` — ``shadowRows: 0`` skips the parity gate, the
+  forced-rollback path), ``quit`` (graceful exit).
+
+Replica lifecycle states (the ``state`` heartbeat field):
+``starting -> ready -> draining -> stopped`` (+ ``swapping`` while an
+admin swap is in flight). The router routes only to ``ready``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["HEARTBEAT_DIRNAME", "ReplicaStates", "heartbeat_path",
+           "write_heartbeat", "read_heartbeats", "is_fresh",
+           "admin_call", "AdminError", "atomic_write_json"]
+
+#: subdirectory of the scale-out state dir holding one heartbeat file
+#: per replica
+HEARTBEAT_DIRNAME = "replicas"
+
+
+class ReplicaStates:
+    STARTING = "starting"
+    READY = "ready"
+    SWAPPING = "swapping"
+    DRAINING = "draining"
+    STOPPED = "stopped"
+
+
+class AdminError(RuntimeError):
+    """An admin call failed. ``status`` carries the HTTP code (0 for
+    transport errors) and ``doc`` the decoded error body when one came
+    back — 409 means a shadow-gate rejection (see serving/http.py)."""
+
+    def __init__(self, msg: str, status: int = 0,
+                 doc: Optional[dict] = None):
+        super().__init__(msg)
+        self.status = int(status)
+        self.doc = doc or {}
+
+
+def atomic_write_json(doc: dict, path: str) -> None:
+    """tmp-file + rename: a concurrent reader sees old or new, never a
+    torn write. (Standalone twin of ``utils.durable.atomic_json_dump``
+    so the stdlib-only stub worker can heartbeat without importing the
+    framework.)"""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+
+
+def heartbeat_path(state_dir: str, replica_id: str) -> str:
+    return os.path.join(state_dir, HEARTBEAT_DIRNAME,
+                        f"{replica_id}.json")
+
+
+def write_heartbeat(state_dir: str, doc: dict) -> str:
+    """Atomically publish one replica's heartbeat. ``doc`` must carry
+    ``replicaId``; ``ts`` (epoch seconds) is stamped here so freshness
+    is measured against the WRITE, not whenever the caller built the
+    document."""
+    replica_id = doc["replicaId"]
+    path = heartbeat_path(state_dir, replica_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = dict(doc)
+    doc["ts"] = time.time()
+    atomic_write_json(doc, path)
+    return path
+
+
+def read_heartbeats(state_dir: str) -> dict:
+    """replica_id -> heartbeat doc for every readable heartbeat file.
+    Unreadable/corrupt files are skipped (atomic writes make that a
+    transient race at worst, e.g. a replica deleted its own file on
+    clean exit between listdir and open)."""
+    hb_dir = os.path.join(state_dir, HEARTBEAT_DIRNAME)
+    out: dict = {}
+    try:
+        names = os.listdir(hb_dir)
+    except FileNotFoundError:
+        return out
+    for name in sorted(names):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(hb_dir, name)) as fh:
+                doc = json.load(fh)
+            rid = doc.get("replicaId")
+            if rid:
+                out[str(rid)] = doc
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def clear_heartbeat(state_dir: str, replica_id: str) -> None:
+    """Remove a replica's heartbeat file (clean exit / forgotten
+    replica) — best-effort."""
+    try:
+        os.remove(heartbeat_path(state_dir, replica_id))
+    except OSError:
+        pass
+
+
+def is_fresh(doc: dict, ttl_s: float,
+             now: Optional[float] = None) -> bool:
+    """Liveness: the heartbeat's ``ts`` is within ``ttl_s`` of now."""
+    ts = doc.get("ts")
+    if not isinstance(ts, (int, float)):
+        return False
+    return (time.time() if now is None else now) - float(ts) <= ttl_s
+
+
+def admin_call(port: int, action: str, payload: Optional[dict] = None,
+               host: str = "127.0.0.1", timeout_s: float = 60.0) -> dict:
+    """One admin control-plane request; returns the decoded JSON reply
+    or raises :class:`AdminError` (status 409 = shadow-gate
+    rejection)."""
+    body = json.dumps(payload or {})
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("POST", f"/admin/{action}", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            doc = json.loads(raw) if raw else {}
+        except ValueError:
+            doc = {"raw": raw.decode(errors="replace")[:300]}
+        if resp.status != 200:
+            raise AdminError(
+                f"admin {action!r} on {host}:{port} -> {resp.status}: "
+                f"{doc.get('error', doc)}", status=resp.status, doc=doc)
+        return doc
+    except AdminError:
+        raise
+    except Exception as e:  # noqa: BLE001 — transport failure, status 0
+        raise AdminError(
+            f"admin {action!r} on {host}:{port} failed: "
+            f"{type(e).__name__}: {e}") from e
+    finally:
+        conn.close()

@@ -1,0 +1,366 @@
+"""One scale-out replica: a full ``serving.FleetServer`` process behind
+the wire protocol.
+
+``python -m transmogrifai_tpu.scaleout.worker --model-dir models/
+--state-dir scale_state/ --replica-id r0`` runs the EXISTING fleet
+server unmodified — per-model lanes, shared in-process program cache,
+shadow-gated hot swap — and adds the scale-out contract around it:
+
+- binds its HTTP surface on an **ephemeral port** (``--port 0``) and
+  publishes the bound port through its heartbeat file, so N replicas on
+  one host never race on a fixed port;
+- **heartbeats** every ``--heartbeat-interval`` seconds (atomic
+  rewrite; see ``scaleout/wire.py``) with lifecycle state, queue
+  depths, serving counters and the post-warmup compile bound;
+- serves the **admin control plane** (``POST /admin/status|drain|swap|
+  quit``) the supervisor drives drains and rolling promotions through;
+- maps the **shared compiled-program artifact layer**: the register
+  root's ``_artifacts/`` XLA cache is enabled before the first compile
+  and published warmup manifests decide which padding buckets warm
+  before traffic — a program any replica compiled before is loaded,
+  not recompiled (per-replica cache/counter attribution unchanged);
+- honors the durable ``ACTIVE.json`` alias (``serving/registry.py``):
+  a replica respawned after a fleet-wide rolling promotion comes back
+  serving the promoted version, not v1;
+- drains gracefully on **SIGTERM** (finish in-flight requests, final
+  ``stopped`` heartbeat) — the supervisor's scale-down and the
+  operator's ^C both exit without dropping an admitted request.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import warnings
+from typing import Optional
+
+from transmogrifai_tpu.scaleout import wire
+from transmogrifai_tpu.scaleout.wire import ReplicaStates
+from transmogrifai_tpu.utils.events import events
+
+__all__ = ["ReplicaWorker", "main"]
+
+
+class ReplicaWorker:
+    """The in-process body of one replica (the subprocess entry point,
+    but embeddable in tests)."""
+
+    def __init__(self, model_dir: str, state_dir: str, replica_id: str,
+                 *, port: int = 0, host: str = "127.0.0.1",
+                 heartbeat_interval_s: float = 1.0,
+                 use_artifacts: bool = True,
+                 warmup_rows: Optional[dict] = None,
+                 **fleet_kwargs):
+        from transmogrifai_tpu.scaleout.artifacts import ArtifactStore
+        from transmogrifai_tpu.serving.fleet import FleetServer
+        from transmogrifai_tpu.serving.registry import ModelRegistry
+        self.model_dir = model_dir
+        self.state_dir = state_dir
+        self.replica_id = replica_id
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._host = host
+        self._port = int(port)
+        self.state = ReplicaStates.STARTING
+        self._stop = threading.Event()
+        self._state_lock = threading.Lock()
+        self.artifacts = ArtifactStore(model_dir) if use_artifacts \
+            else None
+        registry = ModelRegistry()
+        if self.artifacts is not None:
+            registry.attach_artifacts(self.artifacts)
+        self.fleet = FleetServer(registry=registry, **fleet_kwargs)
+        self.http = None
+        #: explicit warm rows (e.g. --warmup file) — merged over the
+        #: artifact manifests' rows
+        self._warmup_rows = dict(warmup_rows or {})
+        self._artifact_mapped: list = []
+        self.started_at = time.time()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "ReplicaWorker":
+        from transmogrifai_tpu.serving.http import MetricsServer
+        from transmogrifai_tpu.utils.prometheus import build_registry
+        if self.artifacts is not None:
+            # BEFORE the first compile: later is silently ineffective
+            self.artifacts.enable_shared_compilation_cache()
+        entries = self.fleet.register_dir(self.model_dir)
+        if not entries:
+            raise ValueError(
+                f"replica {self.replica_id}: no saved models under "
+                f"{self.model_dir!r}")
+        warm = self._collect_warmup_rows()
+        self.fleet.start(warmup_rows=warm)
+        self._publish_artifacts(warm)
+        registry = build_registry(fleet=self.fleet)
+        self.http = MetricsServer(
+            render_fn=registry.render, health_fn=self.health,
+            score_fn=self.fleet._http_score, control_fn=self.control,
+            port=self._port, host=self._host).start()
+        self._set_state(ReplicaStates.READY)
+        self.heartbeat()
+        events.emit("scaleout.replica_ready", replica=self.replica_id,
+                    port=self.http.port,
+                    models=self.fleet.registry.model_ids())
+        return self
+
+    def _collect_warmup_rows(self) -> dict:
+        """model id -> representative row: explicit rows first, then the
+        shared artifact manifests (the 'map everywhere' half: warm the
+        published buckets before traffic, hitting the shared XLA
+        cache)."""
+        warm = dict(self._warmup_rows)
+        for model_id in self.fleet.registry.model_ids():
+            if model_id in warm:
+                continue
+            version = self.fleet.registry.active_version(model_id)
+            if version is None:
+                continue
+            entry = self.fleet.registry.get(model_id, version)
+            manifest = self.fleet.registry.program_artifact(
+                entry.fingerprint)
+            if manifest and isinstance(manifest.get("warmRow"), dict):
+                warm[model_id] = dict(manifest["warmRow"])
+                self._artifact_mapped.append(model_id)
+        return warm
+
+    def _publish_artifacts(self, warm: dict) -> None:
+        """Publish manifests for models this replica warmed from an
+        explicit row (first replica up publishes; later replicas map)."""
+        for model_id, row in warm.items():
+            version = self.fleet.registry.active_version(model_id)
+            if version is None:
+                continue
+            entry = self.fleet.registry.get(model_id, version)
+            self.fleet.registry.publish_program_artifact(
+                entry.fingerprint,
+                {"modelId": model_id, "version": version,
+                 "warmRow": row, "publishedBy": self.replica_id})
+
+    def run(self) -> int:
+        """Start, then heartbeat until stopped (SIGTERM / admin quit)."""
+        signal.signal(signal.SIGTERM, lambda *_: self.request_stop())
+        try:
+            self.start()
+        except Exception as e:  # noqa: BLE001 — a failed start must report, not hang the supervisor
+            print(f"# replica {self.replica_id}: start failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            self._set_state(ReplicaStates.STOPPED)
+            self.heartbeat(best_effort=True)
+            return 1
+        print(f"# replica {self.replica_id}: serving "
+              f"{self.fleet.registry.model_ids()} on "
+              f"{self._host}:{self.http.port}", file=sys.stderr)
+        while not self._stop.wait(self.heartbeat_interval_s):
+            self.heartbeat(best_effort=True)
+        self._shutdown()
+        return 0
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def _shutdown(self) -> None:
+        """Graceful SIGTERM/quit path: drain in-flight, final
+        heartbeat."""
+        self._set_state(ReplicaStates.DRAINING)
+        self.heartbeat(best_effort=True)
+        try:
+            self.fleet.stop(drain=True)
+        finally:
+            if self.http is not None:
+                self.http.stop()
+                self.http = None
+            self._set_state(ReplicaStates.STOPPED)
+            self.heartbeat(best_effort=True)
+
+    def _set_state(self, state: str) -> None:
+        with self._state_lock:
+            self.state = state
+
+    # -- wire surface ---------------------------------------------------------
+    def heartbeat(self, best_effort: bool = False) -> Optional[str]:
+        try:
+            totals = {"admitted": 0, "completed": 0, "failed": 0}
+            post_warmup_max = 0
+            lanes = self.fleet.active_lanes() \
+                if self.state != ReplicaStates.STOPPED else {}
+            for lane in lanes.values():
+                m = lane.metrics
+                totals["admitted"] += m.admitted
+                totals["completed"] += m.completed
+                totals["failed"] += m.failed
+                per = lane.post_warmup_compiles()
+                if per:
+                    post_warmup_max = max(post_warmup_max,
+                                          max(per.values()))
+            doc = {
+                "replicaId": self.replica_id,
+                "pid": os.getpid(),
+                "port": self.http.port if self.http else None,
+                "state": self.state,
+                "models": self.fleet.registry.model_ids(),
+                "queueDepths": (self.fleet.queue_depths()
+                                if lanes else {}),
+                "queueCapacity": next(
+                    (lane.batcher.queue_capacity
+                     for lane in lanes.values()), None),
+                "counters": totals,
+                "postWarmupCompilesMax": post_warmup_max,
+                "artifactMapped": sorted(self._artifact_mapped),
+                "startedAt": self.started_at,
+            }
+            return wire.write_heartbeat(self.state_dir, doc)
+        except Exception as e:  # noqa: BLE001 — a heartbeat must not kill the replica
+            if not best_effort:
+                raise
+            warnings.warn(
+                f"replica {self.replica_id}: heartbeat write failed "
+                f"({type(e).__name__}: {e})", RuntimeWarning)
+            return None
+
+    def health(self) -> dict:
+        doc = self.fleet.health()
+        doc["replicaId"] = self.replica_id
+        doc["replicaState"] = self.state
+        return doc
+
+    def control(self, action: str, payload: dict) -> dict:
+        """The admin control plane (behind ``POST /admin/<action>``)."""
+        if action == "status":
+            return self._status()
+        if action == "drain":
+            return self._drain(timeout_s=float(
+                payload.get("timeoutS", 30.0)))
+        if action == "swap":
+            return self._swap(payload)
+        if action == "quit":
+            self.request_stop()
+            return {"ok": True, "stopping": True}
+        raise ValueError(f"unknown admin action {action!r} (one of "
+                         "status, drain, swap, quit)")
+
+    def _status(self) -> dict:
+        post_warmup = {
+            mid: {str(b): n
+                  for b, n in lane.post_warmup_compiles().items()}
+            for mid, lane in self.fleet.active_lanes().items()}
+        return {"ok": True, "replicaId": self.replica_id,
+                "state": self.state, "pid": os.getpid(),
+                "models": self.fleet.registry.list(),
+                "queueDepths": self.fleet.queue_depths(),
+                "postWarmupCompiles": post_warmup,
+                "artifactMapped": sorted(self._artifact_mapped),
+                "cache": self.fleet.program_cache.to_json()}
+
+    def _drain(self, timeout_s: float = 30.0) -> dict:
+        """Quiesce: wait (bounded) for every lane's admission queue to
+        empty. The caller (supervisor) has already stopped routing new
+        traffic here; this settles the stragglers. The replica returns
+        to READY when the wait ends — draining is a moment, not a
+        destination: the router-side flag owns keep-away during a
+        swap, and a roll that dies between drain and swap must not
+        leave a healthy replica heartbeating DRAINING (unroutable)
+        forever. A SIGTERM/scale-down drain is followed by process
+        exit, where the brief READY re-report is moot."""
+        self._set_state(ReplicaStates.DRAINING)
+        self.heartbeat(best_effort=True)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while time.monotonic() < deadline:
+                depths = self.fleet.queue_depths()
+                if not any(depths.values()):
+                    return {"ok": True, "drained": True,
+                            "queueDepths": depths}
+                time.sleep(0.05)
+            return {"ok": True, "drained": False,
+                    "queueDepths": self.fleet.queue_depths()}
+        finally:
+            if not self._stop.is_set():
+                self._set_state(ReplicaStates.READY)
+                self.heartbeat(best_effort=True)
+
+    def _swap(self, payload: dict) -> dict:
+        """Hot-swap one model behind the live endpoint. ``shadowRows:
+        0`` skips the parity gate — the supervisor's forced-rollback
+        path (the version being restored was the known-good one)."""
+        model_id = payload.get("modelId")
+        if not model_id:
+            raise ValueError("swap needs modelId")
+        old_version = self.fleet.registry.active_version(model_id)
+        old_path = None
+        if old_version is not None:
+            old_path = self.fleet.registry.get(
+                model_id, old_version).path
+        kwargs: dict = {}
+        if payload.get("tolerance") is not None:
+            kwargs["tolerance"] = float(payload["tolerance"])
+        if payload.get("shadowRows") is not None:
+            kwargs["shadow_rows"] = int(payload["shadowRows"])
+        self._set_state(ReplicaStates.SWAPPING)
+        self.heartbeat(best_effort=True)
+        try:
+            report = self.fleet.hot_swap(
+                model_id, payload.get("path"),
+                version=payload.get("version"), **kwargs)
+        finally:
+            self._set_state(ReplicaStates.READY)
+            self.heartbeat(best_effort=True)
+        report = dict(report)
+        report["ok"] = True
+        report["fromPath"] = old_path
+        return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("transmogrifai_tpu scaleout worker")
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--state-dir", required=True)
+    ap.add_argument("--replica-id", required=True)
+    ap.add_argument("--port", type=int, default=0,
+                    help="HTTP port (0 = ephemeral, reported via the "
+                         "heartbeat; default)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--heartbeat-interval", type=float, default=1.0)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--min-bucket", type=int, default=None,
+                    help="smallest padding bucket (default max-batch: "
+                         "ONE bucket per model keeps replica warmup to "
+                         "one compile per fused layer)")
+    ap.add_argument("--shadow-tolerance", type=float, default=None)
+    ap.add_argument("--no-artifacts", action="store_true",
+                    help="skip the shared compiled-program artifact "
+                         "layer (every replica compiles for itself)")
+    ap.add_argument("--warmup", default=None,
+                    help="JSON file mapping model id -> one "
+                         "representative request row (pre-compiles "
+                         "padding buckets and publishes the artifact "
+                         "manifest)")
+    args = ap.parse_args(argv)
+    warm = None
+    if args.warmup:
+        with open(args.warmup) as fh:
+            warm = json.load(fh)
+    fleet_kwargs: dict = {
+        "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+        "queue_capacity": args.queue_capacity,
+        "min_bucket": (args.min_bucket if args.min_bucket is not None
+                       else args.max_batch)}
+    if args.shadow_tolerance is not None:
+        fleet_kwargs["shadow_tolerance"] = args.shadow_tolerance
+    worker = ReplicaWorker(
+        args.model_dir, args.state_dir, args.replica_id,
+        port=args.port, host=args.host,
+        heartbeat_interval_s=args.heartbeat_interval,
+        use_artifacts=not args.no_artifacts,
+        warmup_rows=warm, **fleet_kwargs)
+    return worker.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -839,6 +839,20 @@ class FleetServer:
                 "old version still serving", max_abs_diff=max_diff)
         return max_diff
 
+    @property
+    def bound_metrics_port(self) -> Optional[int]:
+        """The ACTUAL port the scrape/score endpoint bound (ephemeral
+        with ``metrics_port=0``); None while no endpoint runs."""
+        return self.metrics_http.port if self.metrics_http else None
+
+    def queue_depths(self) -> dict:
+        """model id -> requests waiting in its active lane's admission
+        queue — the scale-out drain/quiesce probe (a replica reports
+        drained when every lane reads 0) and the autoscaler's
+        queue-pressure signal."""
+        return {mid: lane.batcher.queue_depth
+                for mid, lane in self.active_lanes().items()}
+
     # -- observability -------------------------------------------------------
     def active_lanes(self) -> dict:
         """model id -> its active version's running lane."""

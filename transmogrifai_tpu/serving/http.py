@@ -15,12 +15,26 @@ score document alongside the serving model's lineage.
 Deliberately dependency-free and tiny: one daemon thread, a
 ``ThreadingHTTPServer`` so a slow scraper or a blocking score can't
 stall a liveness probe, and no other routes — everything else is a 404.
-Port 0 binds an ephemeral port (tests); the bound port is
-``MetricsServer.port``. Scoring status mapping: strict-admission /
-malformed-request errors are 400, an unknown model id 404, a queue-full
-``BackpressureError`` 503 with a ``Retry-After`` hint, an expired
-request deadline 504 — load shed and routing mistakes are the CLIENT's
-signal, never a server crash.
+Port 0 binds an ephemeral port (tests, multi-process fleets racing on
+fixed ports); the bound port is ``MetricsServer.port``. Scoring status
+mapping: strict-admission / malformed-request errors are 400, an
+unknown model id 404, a queue-full ``BackpressureError`` 503 with a
+``Retry-After`` hint, an expired request deadline 504 — load shed and
+routing mistakes are the CLIENT's signal, never a server crash.
+
+Wire behavior: the handler speaks **HTTP/1.1 with keep-alive** — a
+router or load harness reuses one connection per replica instead of
+paying a TCP handshake per request (the scale-out hop's hot path).
+Request bodies are bounded (``max_body_bytes``, default 1 MiB): an
+oversized or length-less body is rejected 413/411 with the connection
+closed, never buffered — one request row has no business being
+megabytes, and an unbounded read is a trivial DoS surface.
+
+With ``control_fn`` the endpoint also serves ``POST /admin/<action>``
+(JSON body in, JSON reply out) — the scale-out control plane a replica
+worker exposes to its supervisor (drain, hot-swap, status, quit). A
+shadow-gate rejection maps to 409 so a rolling swap can distinguish
+"the candidate failed parity" from infrastructure errors.
 
 Access logging: ``BaseHTTPRequestHandler``'s per-request stderr line is
 suppressed (a daemon's stderr is not a log pipeline); instead, with
@@ -43,7 +57,7 @@ from transmogrifai_tpu.utils.events import events
 from transmogrifai_tpu.utils.prometheus import CONTENT_TYPE
 from transmogrifai_tpu.utils.tracing import new_trace_id, sanitize_trace_id
 
-__all__ = ["MetricsServer", "TRACE_HEADER"]
+__all__ = ["MetricsServer", "TRACE_HEADER", "MAX_BODY_BYTES"]
 
 #: the request/response trace-context header (Dapper/B3-style: honor an
 #: inbound id so a caller's trace continues through this hop)
@@ -51,6 +65,9 @@ TRACE_HEADER = "X-Trace-Id"
 
 #: hard ceiling on sampled access-log events per second
 ACCESS_LOG_MAX_PER_S = 100
+
+#: default request-body bound (bytes): one JSON request row, with slack
+MAX_BODY_BYTES = 1 << 20
 
 
 class MetricsServer:
@@ -61,12 +78,20 @@ class MetricsServer:
                  port: int = 0, host: str = "127.0.0.1",
                  score_fn: Optional[Callable[
                      [Optional[str], dict, Optional[str]], dict]] = None,
-                 access_log_sample: float = 0.0):
+                 control_fn: Optional[Callable[[str, dict], dict]] = None,
+                 access_log_sample: float = 0.0,
+                 max_body_bytes: int = MAX_BODY_BYTES):
         self.render_fn = render_fn
         self.health_fn = health_fn
         #: ``score_fn(model_id_or_None, row, trace_id) -> score doc``;
         #: None disables the POST /score routes (scrape-only endpoint)
         self.score_fn = score_fn
+        #: ``control_fn(action, payload) -> reply doc`` behind
+        #: ``POST /admin/<action>`` — the replica-worker control plane
+        #: (None disables the admin routes). The endpoint binds loopback
+        #: by default; expose it beyond localhost deliberately.
+        self.control_fn = control_fn
+        self.max_body_bytes = int(max_body_bytes)
         #: sampled structured access log: 0 (default) = off, else the
         #: fraction of requests evented (1.0 = every request, 0.01 =
         #: every 100th — deterministic stride, not a coin flip)
@@ -116,6 +141,39 @@ class MetricsServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: persistent connections by default — the router->
+            # replica hop must not pay a TCP handshake per request. Every
+            # reply carries Content-Length (send_error closes on its own)
+            protocol_version = "HTTP/1.1"
+
+            def _read_body(self) -> Optional[bytes]:
+                """Bounded request-body read, or None after an error
+                reply. Oversized (413) and length-less-chunked (411)
+                bodies are refused WITHOUT reading — send_error marks
+                the connection close, so an unread body can't desync
+                keep-alive."""
+                if self.headers.get("Transfer-Encoding"):
+                    self.send_error(
+                        411, "chunked bodies unsupported; send "
+                             "Content-Length")
+                    return None
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self.send_error(400, "malformed Content-Length")
+                    return None
+                if n < 0:
+                    # read(-1) would buffer until EOF — the exact
+                    # unbounded read the bound exists to prevent
+                    self.send_error(400, "negative Content-Length")
+                    return None
+                if n > outer.max_body_bytes:
+                    self.send_error(
+                        413, f"request body {n} bytes exceeds the "
+                             f"{outer.max_body_bytes}-byte bound")
+                    return None
+                return self.rfile.read(n) if n else b""
+
             def _reply(self, code: int, body: bytes, ctype: str,
                        extra: Optional[dict] = None) -> None:
                 self.send_response(code)
@@ -153,6 +211,10 @@ class MetricsServer:
             def do_POST(self):  # noqa: N802 — http.server API
                 t0 = time.monotonic()
                 path = self.path.split("?")[0]
+                if outer.control_fn is not None \
+                        and path.startswith("/admin/"):
+                    self._admin(path, t0)
+                    return
                 if outer.score_fn is None or not (
                         path == "/score" or path.startswith("/score/")):
                     self.send_error(
@@ -175,8 +237,11 @@ class MetricsServer:
                         "application/json", {**traced, **(extra or {})})
                     outer._access("POST", path, c, t0, trace_id)
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    row = json.loads(self.rfile.read(n) or b"{}")
+                    raw = self._read_body()
+                    if raw is None:
+                        outer._access("POST", path, 413, t0, trace_id)
+                        return
+                    row = json.loads(raw or b"{}")
                     if not isinstance(row, dict):
                         raise ValueError("request body must be one JSON "
                                          "object (a request row)")
@@ -214,6 +279,43 @@ class MetricsServer:
                                   + "\n").encode(), "application/json",
                             traced)
                 outer._access("POST", path, 200, t0, trace_id)
+
+            def _admin(self, path: str, t0: float) -> None:
+                """``POST /admin/<action>``: the replica-worker control
+                plane. JSON payload -> ``control_fn(action, payload)``
+                -> JSON reply. Status mapping mirrors /score, plus 409
+                for a shadow-gate rejection (a rolling swap must tell
+                "candidate failed parity" from infrastructure faults)."""
+                action = path[len("/admin/"):]
+                try:
+                    raw = self._read_body()
+                    if raw is None:
+                        outer._access("POST", path, 413, t0)
+                        return
+                    payload = json.loads(raw or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("admin payload must be a JSON "
+                                         "object")
+                    doc = outer.control_fn(action, payload)
+                    code = 200
+                except Exception as e:  # noqa: BLE001 — mapped to an HTTP status
+                    from transmogrifai_tpu.serving.registry import (
+                        UnknownModelError,
+                    )
+                    if type(e).__name__ == "ShadowParityError":
+                        code = 409
+                    elif isinstance(e, UnknownModelError):
+                        code = 404
+                    elif isinstance(e, (KeyError, ValueError,
+                                        json.JSONDecodeError)):
+                        code = 400
+                    else:
+                        code = 500
+                    doc = {"ok": False, "error":
+                           f"{type(e).__name__}: {str(e)[:300]}"}
+                self._reply(code, (json.dumps(doc, default=str)
+                                   + "\n").encode(), "application/json")
+                outer._access("POST", path, code, t0)
 
             def log_message(self, *args):
                 # stderr access lines are suppressed; the structured,

@@ -24,6 +24,22 @@ Directory layouts ``register_dir`` understands::
       ctr/              # <id>/<version>/model.json  -> (ctr, v1), (ctr, v2)
         v1/model.json
         v2/model.json
+        ACTIVE.json     # durable alias: {"version": "v2"} (optional)
+
+**Durable alias** (multi-process serving): an in-memory ``promote`` is
+invisible to every OTHER process serving the same directory — a replica
+respawned after a fleet-wide rolling promotion would regress to ``v1``.
+``write_active_alias``/``read_active_alias`` persist the per-id alias as
+``<id>/ACTIVE.json``, written via tmp-file + ``os.replace`` so a
+concurrent reader observes either the old or the new alias, NEVER a
+torn or truncated one; ``register_dir`` activates the alias's version
+when present (falling back to the lowest version with a warning when it
+names a version that doesn't exist).
+
+**Program artifacts**: ``attach_artifacts`` binds a fingerprint-keyed
+artifact store (``scaleout/artifacts.py``) so compiled-program warmup
+recipes publish THROUGH the registry — the cross-process analog of the
+in-process ``ProgramCache``: one replica compiles, every replica maps.
 """
 
 from __future__ import annotations
@@ -32,11 +48,52 @@ import os
 import re
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Optional
 
 __all__ = ["ModelEntry", "ModelRegistry", "ModelState",
-           "UnknownModelError"]
+           "UnknownModelError", "ACTIVE_JSON", "write_active_alias",
+           "read_active_alias"]
+
+#: durable per-model-id active-version alias file (versioned layout)
+ACTIVE_JSON = "ACTIVE.json"
+
+
+def write_active_alias(root: str, model_id: str, version: str) -> str:
+    """Persist ``<root>/<model_id>/ACTIVE.json`` atomically (tmp-file +
+    rename — concurrent readers can never observe a torn alias).
+    Returns the path. The single-writer here is the promotion
+    coordinator (a fleet hot-swap, the scale-out rolling roll); replicas
+    only read."""
+    from transmogrifai_tpu.utils.durable import atomic_json_dump
+    id_dir = os.path.join(root, model_id)
+    os.makedirs(id_dir, exist_ok=True)
+    path = os.path.join(id_dir, ACTIVE_JSON)
+    atomic_json_dump({"modelId": model_id, "version": version,
+                      "promotedAt": time.time()}, path)
+    return path
+
+
+def read_active_alias(id_dir: str) -> Optional[str]:
+    """The durably promoted version of ``<id_dir>/ACTIVE.json``, or None
+    (missing file, or corrupt — warn-and-None: a broken alias must not
+    keep a replica from serving SOMETHING)."""
+    path = os.path.join(id_dir, ACTIVE_JSON)
+    try:
+        import json
+        with open(path) as fh:
+            doc = json.load(fh)
+        version = doc.get("version")
+        return str(version) if version else None
+    except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — corrupt alias: warn and fall back
+        warnings.warn(
+            f"registry: unreadable active alias {path!r} "
+            f"({type(e).__name__}: {e}); falling back to the lowest "
+            "version", RuntimeWarning)
+        return None
 
 
 class ModelState:
@@ -81,6 +138,30 @@ class ModelRegistry:
         self._entries: dict[str, dict[str, ModelEntry]] = {}
         #: model_id -> active version (the alias live traffic follows)
         self._active: dict[str, str] = {}
+        #: fingerprint-keyed program-artifact store (scaleout/artifacts.
+        #: ArtifactStore-shaped: publish/get); None = not attached
+        self.artifacts = None
+
+    # -- program artifacts ---------------------------------------------------
+    def attach_artifacts(self, store) -> "ModelRegistry":
+        """Bind a program-artifact store so compiled-program warmup
+        recipes publish through the registry (compile-once,
+        map-everywhere across replica processes)."""
+        self.artifacts = store
+        return self
+
+    def publish_program_artifact(self, fingerprint: str,
+                                 doc: dict) -> Optional[str]:
+        """Publish one model's compiled-program artifact manifest
+        (no-op returning None without an attached store)."""
+        if self.artifacts is None:
+            return None
+        return self.artifacts.publish(fingerprint, doc)
+
+    def program_artifact(self, fingerprint: str) -> Optional[dict]:
+        if self.artifacts is None:
+            return None
+        return self.artifacts.get(fingerprint)
 
     # -- registration --------------------------------------------------------
     def register(self, path: Optional[str] = None, *,
@@ -141,7 +222,10 @@ class ModelRegistry:
         ``<id>/model.json`` or versioned ``<id>/<version>/model.json``
         layouts; see module docstring). Version subdirs register in
         sorted order, so ``v1`` activates and later versions await
-        promotion. Returns the new entries."""
+        promotion — unless a durable ``ACTIVE.json`` alias names the
+        promoted version, in which case THAT version activates (the
+        respawned-replica path: a fleet-wide rolling promotion must
+        survive any one process's restart). Returns the new entries."""
         from transmogrifai_tpu.serialization import MODEL_JSON
         if os.path.exists(os.path.join(root, MODEL_JSON)):
             return [self.register(root)]
@@ -162,11 +246,23 @@ class ModelRegistry:
             if os.path.exists(os.path.join(subdir, MODEL_JSON)):
                 entries.append(self.register(subdir, model_id=sub))
                 continue
+            registered: list[str] = []
             for ver in sorted(os.listdir(subdir), key=version_key):
                 vdir = os.path.join(subdir, ver)
                 if os.path.exists(os.path.join(vdir, MODEL_JSON)):
                     entries.append(self.register(
                         vdir, model_id=sub, version=ver))
+                    registered.append(ver)
+            alias = read_active_alias(subdir) if registered else None
+            if alias is not None:
+                if alias in registered:
+                    self.promote(sub, alias)
+                else:
+                    warnings.warn(
+                        f"registry: ACTIVE.json of {sub!r} names "
+                        f"unregistered version {alias!r} (have "
+                        f"{registered}); keeping the lowest version "
+                        "active", RuntimeWarning)
         return entries
 
     # -- lookup --------------------------------------------------------------

@@ -176,6 +176,14 @@ class ScoringServer:
         self.stop()
 
     @property
+    def bound_metrics_port(self) -> Optional[int]:
+        """The ACTUAL port the scrape endpoint bound — with
+        ``metrics_port=0`` (ephemeral: multi-process tests and benches
+        must not race on fixed ports) this is the kernel-assigned one;
+        None while no endpoint is running."""
+        return self.metrics_http.port if self.metrics_http else None
+
+    @property
     def degraded(self) -> bool:
         return self._degraded_since is not None
 

@@ -30,6 +30,18 @@ collects wide events from every subsystem:
                                 drift window + retrain that produced it
 ``fault.injected``              a chaos-plan fault fired at a site
 ``http.access``                 sampled structured access log
+``scaleout.replica_spawned`` / ``scaleout.replica_ready`` /
+``scaleout.replica_down`` / ``scaleout.replica_stopped``
+                                replica-process lifecycle (supervisor)
+``scaleout.markdown`` / ``scaleout.markup``
+                                router routing-table transitions
+``scaleout.scale`` / ``scaleout.autoscale``
+                                fleet resize (manual / signal-driven)
+``scaleout.roll_started`` / ``scaleout.roll_step`` /
+``scaleout.roll`` / ``scaleout.roll_failed``
+                                rolling hot-swap lifecycle (a failed
+                                roll's event names the halting replica,
+                                the gate verdict and the rollback set)
 ==============================  =============================================
 
 Design constraints (the serving hot path pays for this):

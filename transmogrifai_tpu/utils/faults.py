@@ -38,6 +38,13 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``events.spill``          one flight-recorder JSONL spill batch write (the
                           ``enospc`` kind exercises the counted
                           best-effort loss path)
+``scaleout.route``        one router proxy attempt (transient/io faults
+                          retry the next replica candidate, bounded)
+``scaleout.heartbeat``    one supervisor liveness-monitor tick (faults
+                          must be survived — warn and keep monitoring)
+``scaleout.roll``         one replica step of a rolling hot-swap (a fault
+                          here halts the roll and rolls already-swapped
+                          replicas back to the old version)
 ========================  ====================================================
 
 Plan syntax (env ``TRANSMOGRIFAI_FAULT_PLAN`` or programmatic), entries
@@ -87,6 +94,7 @@ KNOWN_SITES = frozenset({
     "ingest.read", "checkpoint.write", "collective", "serving.dispatch",
     "serving.swap", "continuous.ingest", "continuous.trigger",
     "continuous.retrain", "continuous.promote", "events.spill",
+    "scaleout.route", "scaleout.heartbeat", "scaleout.roll",
 })
 
 KINDS = ("transient", "io", "slow", "preempt", "oom", "enospc")

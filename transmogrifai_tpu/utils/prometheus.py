@@ -587,6 +587,89 @@ def _fleet_collectors(reg: PromRegistry, fleet) -> None:
                  for mid, lane in sorted(fleet.active_lanes().items())])
 
 
+def _router_collectors(reg: PromRegistry, router) -> None:
+    """The scale-out router's series (``scaleout/router.py``): request
+    outcomes, per-replica proxy counts, spillover/markdown/retry
+    accounting, router-observed latency, and the routing table as a
+    per-replica state gauge."""
+    rm = router.metrics
+    for attr, name, help_ in (
+            ("completed", "requests_completed",
+             "requests proxied to a 2xx reply"),
+            ("failed", "requests_failed",
+             "requests answered 5xx after every candidate"),
+            ("client_errors", "requests_client_error",
+             "4xx replies proxied back (caller errors)"),
+            ("spillovers", "spillovers",
+             "503-backpressured requests spilled to a ring successor"),
+            ("retries", "retries",
+             "requests retried on the next replica after a transport "
+             "failure (replica kill = retries, not drops)"),
+            ("markdowns", "markdowns",
+             "replicas marked down by the router"),
+            ("no_replica", "no_replica",
+             "requests with no routable replica at all")):
+        reg.register(f"transmogrifai_router_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(rm, a))])
+    reg.register(
+        "transmogrifai_router_proxied_total", "counter",
+        "requests proxied, by serving replica",
+        lambda: [({"replica": rid}, n)
+                 for rid, n in sorted(rm.to_json()["byReplica"]
+                                      .items())]
+                or [({"replica": "none"}, 0)])
+    reg.register(
+        "transmogrifai_router_latency_seconds", "histogram",
+        "request latency through the router (proxy hop included)",
+        lambda: [({}, rm.latency_histogram())])
+    reg.register(
+        "transmogrifai_router_replica_state", "gauge",
+        "1 per replica in its current routing state (up/down/draining)",
+        lambda: [({"replica": rid, "state": doc["state"]}, 1)
+                 for rid, doc in sorted(router.replicas().items())])
+    reg.register(
+        "transmogrifai_router_replicas", "gauge",
+        "replicas currently routable (state up)",
+        lambda: [({}, sum(1 for d in router.replicas().values()
+                          if d["state"] == "up"))])
+
+
+def _scaleout_collectors(reg: PromRegistry, supervisor) -> None:
+    """Supervisor lifecycle series (``scaleout/supervisor.py``):
+    spawn/respawn/scale/roll counters plus desired-vs-live replica
+    gauges."""
+    sm = supervisor.metrics
+    for attr, name, help_ in (
+            ("spawns", "spawns", "replica processes spawned"),
+            ("respawns", "respawns", "replica processes respawned "
+                                     "after a crash"),
+            ("scale_ups", "scale_ups", "fleet scale-up actions"),
+            ("scale_downs", "scale_downs", "fleet scale-down actions"),
+            ("rolls", "rolls", "completed rolling hot-swaps"),
+            ("roll_failures", "roll_failures",
+             "rolling hot-swaps halted (fleet converged on the old "
+             "version)"),
+            ("rollbacks", "rollbacks",
+             "already-swapped replicas forced back to the old version "
+             "by a halted roll")):
+        reg.register(f"transmogrifai_scaleout_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(sm, a))])
+    reg.register(
+        "transmogrifai_scaleout_desired_replicas", "gauge",
+        "replica count the supervisor converges on",
+        lambda: [({}, supervisor.desired_replicas)])
+    reg.register(
+        "transmogrifai_scaleout_live_replicas", "gauge",
+        "replica processes currently alive",
+        lambda: [({}, sum(1 for d in supervisor.to_json()["replicas"]
+                          .values() if d["alive"]))])
+    reg.register(
+        "transmogrifai_scaleout_queue_ratio", "gauge",
+        "mean replica admission-queue fill ratio (heartbeat-reported; "
+        "the autoscaler's load signal)",
+        lambda: [({}, supervisor.queue_ratio())])
+
+
 def _continuous_collectors(reg: PromRegistry, cont) -> None:
     """The continuous-loop series over a ``ContinuousLoop``-shaped
     object: lifecycle counters from its ``metrics``
@@ -632,6 +715,7 @@ def _continuous_collectors(reg: PromRegistry, cont) -> None:
 
 
 def build_registry(serving=None, server=None, fleet=None, continuous=None,
+                   router=None, scaleout=None,
                    slo=None, include_app: bool = True) -> PromRegistry:
     """The standard registry: process-wide training/run/sweep series
     (``include_app``) plus the full serving surface — unlabeled for one
@@ -640,7 +724,11 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     exclusive with ``serving``). ``continuous`` (a ``ContinuousLoop``)
     adds the ``transmogrifai_continuous_*`` drift/retrain/promotion
     series and composes with ``fleet`` — the loop's scrape endpoint
-    exposes both. ``slo`` (a ``utils.slo.SLOEngine``) adds the
+    exposes both. ``router`` (a ``scaleout.Router``) adds the
+    ``transmogrifai_router_*`` proxy surface and ``scaleout`` (a
+    ``scaleout.ReplicaSupervisor``) the ``transmogrifai_scaleout_*``
+    lifecycle series — the scale-out control process scrapes both on
+    one endpoint. ``slo`` (a ``utils.slo.SLOEngine``) adds the
     ``transmogrifai_slo_*`` burn-rate surface. ``server`` (a
     ``ScoringServer``) is optional extra context reserved for future
     gauges. EVERY registry carries ``transmogrifai_build_info``, the
@@ -667,6 +755,14 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
         _fleet_collectors(reg, fleet)
     if continuous is not None:
         _continuous_collectors(reg, continuous)
+    if router is not None:
+        # the scale-out front door (scaleout/router.py): the
+        # transmogrifai_router_* proxy/markdown/latency surface
+        _router_collectors(reg, router)
+    if scaleout is not None:
+        # the replica supervisor (scaleout/supervisor.py):
+        # spawn/respawn/scale/roll lifecycle + replica gauges
+        _scaleout_collectors(reg, scaleout)
     if slo is not None:
         _slo_collectors(reg, slo)
     return reg

@@ -459,6 +459,13 @@ class SLOEngine:
                 "fastBurnFiring": s["fastBurnFiring"],
                 "ok": not s["firing"]}
 
+    def page_firing(self, t: Optional[float] = None) -> bool:
+        """True while any objective burns at page severity — the ONE
+        boolean consumers act on without reading the whole status doc:
+        ``/healthz`` readiness flips on it and the scale-out autoscaler
+        reads it as the scale-up trigger."""
+        return bool(self.status(t)["fastBurnFiring"])
+
     # -- export --------------------------------------------------------------
     def gauge_samples(self) -> dict:
         """Label/value sample lists for the ``transmogrifai_slo_*``
