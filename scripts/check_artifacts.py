@@ -95,6 +95,88 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_accel_autopsy(doc))
     if doc.get("metric") == "devicewatch_overhead":
         errors.extend(_validate_devicewatch_overhead(doc))
+    if doc.get("metric") == "ingest_fe_fusion":
+        errors.extend(_validate_ingest_fe_fusion(doc))
+    return errors
+
+
+#: round-14 acceptance bounds for the fused ingest/FE path: host-side FE
+#: wall share must drop by at least this factor on the Criteo e2e bench,
+#: with fused-vs-unfused predictions within MAX_FE_FUSION_PARITY
+MIN_HOST_FE_CUT = 3.0
+MAX_FE_FUSION_PARITY = 1e-5
+
+
+def _validate_ingest_fe_fusion(doc: dict) -> list[str]:
+    """The ``benchmarks/INGEST_FE_FUSION.json`` contract (round 14): the
+    Criteo-shaped FE pipeline measured with host-side FE vs the fused
+    device program. Gates: host-FE wall share cut >= MIN_HOST_FE_CUT,
+    fused-vs-unfused prediction parity <= MAX_FE_FUSION_PARITY, a
+    measured ingest/compute overlap ratio in [0, 1] over >= 2 chunks, a
+    per-phase wall breakdown, and proof that TRANSMOGRIFAI_FE_FUSED=0
+    restores the pre-fusion path byte-for-byte with ZERO fused programs
+    (counter-asserted)."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    share = doc.get("host_fe_wall_share")
+    if not isinstance(share, dict):
+        errors.append("missing 'host_fe_wall_share' block")
+    else:
+        for k in ("unfused_share", "fused_share", "cut_ratio"):
+            if not num(share.get(k)):
+                errors.append(f"host_fe_wall_share.{k} missing/not numeric")
+        if num(share.get("unfused_share")) and not (
+                0 < share["unfused_share"] <= 1):
+            errors.append("host_fe_wall_share.unfused_share must be in "
+                          "(0, 1] — a baseline with no host FE cannot "
+                          "demonstrate a cut")
+        if num(share.get("cut_ratio")) and share["cut_ratio"] < MIN_HOST_FE_CUT:
+            errors.append(
+                f"host_fe_wall_share.cut_ratio {share['cut_ratio']} < "
+                f"{MIN_HOST_FE_CUT} (the fused path must cut host-side FE "
+                "wall share at least that much)")
+    parity = doc.get("parity")
+    if not isinstance(parity, dict) or not num(
+            parity.get("prediction_max_abs")):
+        errors.append("missing numeric parity.prediction_max_abs")
+    elif not (0 <= parity["prediction_max_abs"] <= MAX_FE_FUSION_PARITY):
+        errors.append(
+            f"parity.prediction_max_abs {parity['prediction_max_abs']} "
+            f"exceeds {MAX_FE_FUSION_PARITY}")
+    ov = doc.get("overlap")
+    if not isinstance(ov, dict):
+        errors.append("missing 'overlap' block")
+    else:
+        if not num(ov.get("ratio")) or not (0 <= ov["ratio"] <= 1):
+            errors.append("overlap.ratio missing or outside [0, 1]")
+        chunks = ov.get("chunks")
+        if not (isinstance(chunks, int) and chunks >= 2):
+            errors.append("overlap.chunks must be an int >= 2 (a single "
+                          "chunk cannot overlap with anything)")
+        for k in ("decode_s", "wall_s"):
+            if not num(ov.get(k)):
+                errors.append(f"overlap.{k} missing/not numeric")
+    disabled = doc.get("fused_disabled")
+    if not isinstance(disabled, dict):
+        errors.append("missing 'fused_disabled' block")
+    else:
+        if disabled.get("fused_programs") != 0:
+            errors.append(
+                "fused_disabled.fused_programs must be exactly 0 "
+                "(TRANSMOGRIFAI_FE_FUSED=0 must not dispatch fused "
+                "programs)")
+        if disabled.get("bitwise_equal") is not True:
+            errors.append("fused_disabled.bitwise_equal must be true "
+                          "(gate off = the pre-fusion path byte-for-byte)")
+    phases = doc.get("phases")
+    if not isinstance(phases, dict) or sum(
+            1 for k, v in phases.items()
+            if k.endswith("_s") and num(v)) < 3:
+        errors.append("missing 'phases' per-phase wall breakdown "
+                      "(>= 3 *_s entries)")
     return errors
 
 

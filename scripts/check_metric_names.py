@@ -275,6 +275,34 @@ def collect_violations() -> list[str]:
     finally:
         resources.resource_counters = saved_counters
 
+    # the fused-ingest/FE surface (round 14): the ingestCounters block
+    # every run json carries and the transmogrifai_ingest_* series,
+    # rendered with NON-ZERO representative data so every collector
+    # closure (incl. the derived overlap ratio) runs hot
+    from transmogrifai_tpu.utils import profiling as prof
+
+    icounters = prof.IngestCounters()
+    icounters.fe_fused_programs = 2
+    icounters.fe_fused_stages = 9
+    icounters.fe_fused_rows = 9000
+    icounters.fe_host_rows = 1000
+    icounters.fe_host_fallbacks = 1
+    icounters.chunks_prefetched = 4
+    icounters.prefetch_wait_s = 0.25
+    icounters.decode_s = 1.5
+    icounters.frame_cache_reuses = 1
+    icounters.frame_cache_stores = 2
+    icounters.frame_cache_drops = 1
+    icounters.presharded_skips = 3
+    out.extend(check_json_doc(icounters.to_json(),
+                              "IngestCounters.to_json"))
+    saved_ic = prof.ingest_counters
+    try:
+        prof.ingest_counters = icounters
+        out.extend(check_registry(build_registry(include_app=False)))
+    finally:
+        prof.ingest_counters = saved_ic
+
     # the device-execution observatory (round 12): the compile-telemetry
     # and watchdog JSON surfaces, the autopsy document an incident dump
     # freezes, and the transmogrifai_device_*/transmogrifai_compile_*

@@ -574,3 +574,66 @@ def test_serving_scaleout_artifact_committed_and_healthy(checker):
     assert art["artifacts"]["post_warmup_compiles_max"] == 0
     assert art["single_fleet"]["rps"] > 0
     assert art["scale_ratio"] > 0
+
+
+def _fe_fusion_good():
+    return {
+        "metric": "ingest_fe_fusion", "platform": "cpu", "rows": 200000,
+        "value": 2.5, "unit": "s",
+        "phases": {"build_s": 1.0, "fe_host_leg_s": 5.0,
+                   "fe_fused_leg_s": 2.5, "overlap_wall_s": 3.0},
+        "host_fe_wall_share": {"unfused_share": 0.55, "fused_share": 0.01,
+                               "cut_ratio": 55.0},
+        "parity": {"prediction_max_abs": 3e-7, "rows": 50000},
+        "overlap": {"ratio": 0.4, "chunks": 8, "decode_s": 2.0,
+                    "consumer_wait_s": 1.2, "wall_s": 3.0},
+        "fused_disabled": {"fused_programs": 0, "bitwise_equal": True},
+    }
+
+
+def test_ingest_fe_fusion_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _fe_fusion_good()
+    assert v(good) == []
+    share = good["host_fe_wall_share"]
+    assert any("cut_ratio" in e for e in v(
+        {**good, "host_fe_wall_share": {**share, "cut_ratio": 2.0}}))
+    assert any("unfused_share" in e for e in v(
+        {**good, "host_fe_wall_share": {**share, "unfused_share": 0.0}}))
+    assert any("prediction_max_abs" in e for e in v(
+        {**good, "parity": {"prediction_max_abs": 1e-3}}))
+    assert any("ratio" in e for e in v(
+        {**good, "overlap": {**good["overlap"], "ratio": 1.5}}))
+    assert any("chunks" in e for e in v(
+        {**good, "overlap": {**good["overlap"], "chunks": 1}}))
+    assert any("fused_programs" in e for e in v(
+        {**good, "fused_disabled": {"fused_programs": 2,
+                                    "bitwise_equal": True}}))
+    assert any("bitwise" in e for e in v(
+        {**good, "fused_disabled": {"fused_programs": 0,
+                                    "bitwise_equal": False}}))
+    assert any("phases" in e for e in v(
+        {**good, "phases": {"build_s": 1.0}}))
+    assert any("overlap" in e for e in v(
+        {k: x for k, x in good.items() if k != "overlap"}))
+
+
+def test_ingest_fe_fusion_artifact_committed_and_healthy(checker):
+    """The round-14 acceptance contract on the COMMITTED artifact:
+    host-side FE wall share cut >= 3x with fused-vs-unfused prediction
+    parity <= 1e-5, a measured ingest/compute overlap ratio, and the
+    TRANSMOGRIFAI_FE_FUSED=0 leg restoring the pre-fusion path
+    byte-for-byte with zero fused programs (counter-asserted)."""
+    path = os.path.join(REPO, "benchmarks", "INGEST_FE_FUSION.json")
+    assert os.path.exists(path), \
+        "benchmarks/INGEST_FE_FUSION.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "ingest_fe_fusion"
+    assert art["host_fe_wall_share"]["cut_ratio"] >= checker.MIN_HOST_FE_CUT
+    assert art["parity"]["prediction_max_abs"] <= checker.MAX_FE_FUSION_PARITY
+    assert 0 <= art["overlap"]["ratio"] <= 1
+    assert art["overlap"]["chunks"] >= 2
+    assert art["fused_disabled"]["fused_programs"] == 0
+    assert art["fused_disabled"]["bitwise_equal"] is True
+    assert art["counters"]["fused_leg"]["feFusedPrograms"] >= 1

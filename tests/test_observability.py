@@ -684,7 +684,10 @@ def test_cli_profile_emits_trace_and_table(served_with_metrics, tmp_path,
     doc = json.load(open(trace))
     names = {e["name"] for e in doc["traceEvents"]}
     assert "reader.generate_frame" in names
-    assert "layer.apply_device" in names
+    # scoring dispatches through the fused FE segment program when
+    # TRANSMOGRIFAI_FE_FUSED=1 (the default, round 14) and through the
+    # per-layer program otherwise — either span proves the device leg
+    assert {"layer.apply_device", "fe.fused"} & names
     mdoc = json.load(open(metrics))
     assert "Scoring" in mdoc["phases"]
     err = capsys.readouterr().err

@@ -10,6 +10,19 @@ TPU-first: the per-layer fusion target is a single jitted XLA program over
 device columns (params passed as a pytree so recompilation is shape-keyed
 only); host transformers run eagerly before it. Compiled programs are cached
 per (layer stage uids) on the executor, so repeated scoring reuses them.
+
+Round 14 extends fusion past the single layer: a maximal run of consecutive
+ALL-device DAG levels compiles as ONE jitted program
+(``fuse_dag_program``) — intermediate columns live only inside the program
+(XLA register/VMEM residency, no HBM round-trip between levels), and when
+every level is fusable the whole ingest->features pipeline feeding the
+ModelSelector is a single device dispatch. Gated by
+``TRANSMOGRIFAI_FE_FUSED=1|0`` (default on); with the gate off the
+pre-fusion per-layer path runs byte-for-byte (counter-asserted in tests and
+the committed ``INGEST_FE_FUSION.json``). An OOM inside a fused segment
+takes the resource ladder's ``ingest.fuse`` rung: the segment re-applies
+stage-by-stage (peak memory ~ one stage's block, not the whole segment's
+intermediates) and the run completes.
 """
 
 from __future__ import annotations
@@ -36,9 +49,42 @@ from transmogrifai_tpu.stages.base import (
 from transmogrifai_tpu.utils.tracing import device_scope, span
 
 __all__ = ["compute_dag", "cut_dag", "CutDag", "DagExecutor", "Dag",
-           "fuse_layer_program"]
+           "fuse_layer_program", "fuse_dag_program", "fe_fused_enabled",
+           "fusable_segments"]
 
 Dag = list  # list[list[PipelineStage]], execution order
+
+
+def fe_fused_enabled() -> bool:
+    """Master gate for multi-layer FE fusion (``TRANSMOGRIFAI_FE_FUSED``,
+    default on). Off = the pre-round-14 per-layer execution path,
+    byte-for-byte."""
+    return os.environ.get("TRANSMOGRIFAI_FE_FUSED", "1") != "0"
+
+
+def _layer_fusable(layer) -> bool:
+    """A DAG level joins a fused segment when every stage is a device
+    transformer (host/string stages force eager materialization)."""
+    return bool(layer) and all(
+        isinstance(s, Transformer) and s.is_device for s in layer)
+
+
+def fusable_segments(dag: Dag):
+    """Partition a fitted DAG into execution segments: ``("fused",
+    [layer, ...])`` for each maximal run of consecutive all-device levels,
+    ``("layer", layer)`` for everything else. Segment order preserves DAG
+    order, so replaying segments is exactly replaying the DAG."""
+    run: list = []
+    for layer in dag:
+        if _layer_fusable(layer):
+            run.append(layer)
+            continue
+        if run:
+            yield ("fused", run)
+            run = []
+        yield ("layer", layer)
+    if run:
+        yield ("fused", run)
 
 
 def compute_dag(result_features: Sequence[FeatureLike]) -> Dag:
@@ -152,19 +198,44 @@ def _check_distinct_uids(dist) -> None:
 
 
 class DagExecutor:
-    """Fits/applies a leveled DAG over PipelineData with per-layer fusion."""
+    """Fits/applies a leveled DAG over PipelineData with per-layer fusion
+    (and, round 14, cross-layer fusion of all-device level runs)."""
 
     def __init__(self):
         self._fused_cache: dict[tuple[str, ...], Any] = {}
+        #: cross-layer fused programs, keyed by the segment's stage uids
+        self._fused_dag_cache: dict[tuple[str, ...], Any] = {}
 
     # -- fit -----------------------------------------------------------------
     def fit_transform(self, data: PipelineData, dag: Dag
                       ) -> tuple[PipelineData, Dag]:
         """Fold over layers: fit estimators, then apply the whole layer.
         Returns transformed data + the fitted DAG (estimators replaced by
-        their models)."""
+        their models). With FE fusion on, consecutive estimator-free
+        all-device layers DEFER application and flush as one fused device
+        program at the next materialization point (an estimator fit, a
+        host layer, or the end of the DAG) — the whole-pipeline fusion the
+        fitted-DAG replay path gets unconditionally."""
+        fuse = fe_fused_enabled()
         fitted_dag: Dag = []
+        pending: list = []  # deferred all-device fitted layers
+
+        def flush(d: PipelineData) -> PipelineData:
+            if not pending:
+                return d
+            t0 = time.time()
+            d = self.apply_fused(d, list(pending))
+            _plog(f"apply fused segment ({len(pending)} layers)", t0)
+            pending.clear()
+            return d
+
         for layer in dag:
+            has_estimator = any(isinstance(s, Estimator) for s in layer)
+            if fuse and not has_estimator and _layer_fusable(layer):
+                pending.append(layer)
+                fitted_dag.append(list(layer))
+                continue
+            data = flush(data)
             fitted_layer: list[Transformer] = []
             for stage in layer:
                 if isinstance(stage, Estimator):
@@ -179,16 +250,29 @@ class DagExecutor:
                 else:
                     raise TypeError(f"Cannot execute stage {stage!r}")
             t0 = time.time()
-            data = self.apply_layer(data, fitted_layer)
+            if fuse and _layer_fusable(fitted_layer):
+                data = self.apply_fused(data, [fitted_layer])
+            else:
+                data = self.apply_layer(data, fitted_layer)
             _plog(f"apply layer [{', '.join(t.operation_name for t in fitted_layer)}]",
                   t0)
             fitted_dag.append(fitted_layer)
+        data = flush(data)
         return data, fitted_dag
 
     # -- transform -----------------------------------------------------------
     def transform(self, data: PipelineData, dag: Dag) -> PipelineData:
-        for layer in dag:
-            data = self.apply_layer(data, layer)
+        if not fe_fused_enabled():
+            # the pre-fusion path, byte-for-byte (counter-asserted: no
+            # fused segment programs run with the gate off)
+            for layer in dag:
+                data = self.apply_layer(data, layer)
+            return data
+        for kind, seg in fusable_segments(dag):
+            if kind == "fused":
+                data = self.apply_fused(data, seg)
+            else:
+                data = self.apply_layer(data, seg)
         return data
 
     def apply_layer(self, data: PipelineData,
@@ -240,6 +324,80 @@ class DagExecutor:
         self._fused_cache[key] = compiled
         return compiled
 
+    # -- cross-layer fusion (round 14) ---------------------------------------
+    def apply_fused(self, data: PipelineData,
+                    layers: Sequence[Sequence[Transformer]]) -> PipelineData:
+        """Apply a run of consecutive all-device layers as ONE jitted
+        program. Intermediate level outputs never materialize in HBM
+        between levels; every stage output still lands in the returned
+        PipelineData (downstream layers, host pulls and keep-intermediate
+        scoring read them exactly as before).
+
+        Failure ladder: an OOM inside the fused program (the whole
+        segment's intermediates are live at once) takes the
+        ``ingest.fuse`` rung — re-apply the segment stage by stage, the
+        smallest-peak execution order — instead of killing a run the
+        per-layer path would have completed."""
+        from transmogrifai_tpu.utils.faults import fault_point
+        from transmogrifai_tpu.utils.profiling import ingest_counters
+        from transmogrifai_tpu.utils.retry import with_device_retry
+        stages = [t for layer in layers for t in layer]
+        key = tuple(t.uid for t in stages)
+        prog = self._fused_dag_cache.get(key)
+        if prog is None:
+            base = fuse_dag_program(layers)
+            prog = lambda params, in_cols: base(params, {}, in_cols)  # noqa: E731
+            self._fused_dag_cache[key] = prog
+        params = {t.uid: t.device_params() for t in stages}
+        produced = {t.get_output().name for t in stages}
+        in_names = [n for t in stages for n in t.runtime_input_names()
+                    if n not in produced]
+        try:
+            fault_point("ingest.fuse")
+            in_cols = {n: data.device_col(n) for n in dict.fromkeys(in_names)}
+            with span("fe.fused", n_stages=len(stages), n_layers=len(layers),
+                      stages=",".join(t.operation_name for t in stages)):
+                outs = with_device_retry(prog, params, in_cols,
+                                         site="dag.apply_layer")
+        except Exception as err:
+            from transmogrifai_tpu.utils import resources
+            from transmogrifai_tpu.utils.faults import FaultHarnessError
+            if isinstance(err, FaultHarnessError):
+                raise
+            if not (resources.ladder_enabled()
+                    and resources.is_resource_exhausted(err)):
+                raise
+            resources.record_degradation(
+                "ingest.fuse", "stagewise", error=err,
+                nStages=len(stages), nLayers=len(layers),
+                nRows=data.n_rows)
+            ingest_counters.fe_host_fallbacks += 1
+            ingest_counters.fe_host_rows += data.n_rows * len(stages)
+            return self._apply_stagewise(data, layers)
+        ingest_counters.fe_fused_programs += 1
+        ingest_counters.fe_fused_stages += len(stages)
+        ingest_counters.fe_fused_rows += data.n_rows * len(stages)
+        data = data.with_device_cols(outs)
+        for t in stages:
+            m = getattr(outs.get(t.get_output().name), "metadata", None)
+            if m is not None:
+                t.out_meta = m
+        return data
+
+    def _apply_stagewise(self, data: PipelineData,
+                         layers: Sequence[Sequence[Transformer]]
+                         ) -> PipelineData:
+        """The ``ingest.fuse`` OOM rung: one stage = one small jitted
+        program (``apply_layer`` over single-stage layers), intermediates
+        materialized (and droppable) between stages — peak memory is a
+        single stage's blocks. Staying on the jitted path keeps the rung
+        bitwise-identical to the fused program (eager per-primitive
+        execution codegens trig differently at the ULP level)."""
+        for layer in layers:
+            for t in layer:
+                data = self.apply_layer(data, [t])
+        return data
+
 
 def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False):
     """One jitted XLA program applying every device transformer of a layer.
@@ -252,19 +410,42 @@ def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False):
     not touch a donated column afterwards. Batch scoring passes everything
     in ``keep_cols`` — columns live in the executor's PipelineData and are
     reread by later layers and host pulls."""
-    ts = list(dev_ts)
+    return fuse_dag_program([list(dev_ts)], donate=donate)
+
+
+def fuse_dag_program(layers: Sequence[Sequence[Transformer]],
+                     donate: bool = False):
+    """One jitted XLA program applying a run of consecutive ALL-device DAG
+    levels — the round-14 generalization of :func:`fuse_layer_program`
+    (which is the single-level special case and shares this builder, so
+    serving's per-layer programs and the executor's segment programs are
+    one code path).
+
+    Signature and donation semantics match ``fuse_layer_program``; the
+    returned dict holds EVERY stage output across the fused levels.
+    Level-to-level intermediates flow through the traced program directly:
+    a later level's stage reads an earlier level's output column from the
+    in-program environment, never from HBM."""
+    layer_list = [list(layer) for layer in layers]
 
     def fused(params, donate_cols, keep_cols):
-        in_cols = {**donate_cols, **keep_cols}
+        env = {**donate_cols, **keep_cols}
         out = {}
-        for t in ts:
-            cols = [in_cols[n] for n in t.runtime_input_names()]
-            # per-stage named scope: ops staged out here carry the stage's
-            # operation name + uid in their XLA metadata, so profiler-trace
-            # device slices attribute to stages, not just layers
-            with device_scope(f"{t.operation_name}[{t.uid}]"):
-                out[t.get_output().name] = t.device_apply(
-                    params[t.uid], *cols)
+        for ts in layer_list:
+            produced = {}
+            for t in ts:
+                cols = [env[n] for n in t.runtime_input_names()]
+                # per-stage named scope: ops staged out here carry the
+                # stage's operation name + uid in their XLA metadata, so
+                # profiler-trace device slices attribute to stages, not
+                # just layers/segments
+                with device_scope(f"{t.operation_name}[{t.uid}]"):
+                    produced[t.get_output().name] = t.device_apply(
+                        params[t.uid], *cols)
+            # a level's outputs become visible to LATER levels only
+            # (within a level, stages are independent by construction)
+            env.update(produced)
+            out.update(produced)
         return out
 
     return jax.jit(fused, donate_argnums=(1,) if donate else ())

@@ -31,6 +31,7 @@ from transmogrifai_tpu.types import feature_types as ft
 __all__ = [
     "HostColumn", "HostFrame", "NumericColumn", "CodesColumn", "VectorColumn",
     "DeviceFrame", "NUMERIC_KINDS", "TEXT_KINDS", "MAP_KINDS", "LIST_KINDS",
+    "frame_fingerprint", "device_col_nbytes",
 ]
 
 # device_kind families
@@ -83,50 +84,82 @@ class HostColumn:
 
     # -- construction -------------------------------------------------------
     @staticmethod
+    def builder(ftype: type[ft.FeatureType]):
+        """Resolve the kind dispatch ONCE and return a chunk builder
+        ``(raw values) -> HostColumn``. Chunked/streaming ingest calls
+        this per reader, not per micro-batch: the per-column schema
+        resolution (kind family, representation choice) used to re-run
+        on every chunk concat (``readers/base.generate_frame``), which a
+        high-frequency micro-batch stream paid per batch."""
+        kind = _kind_of(ftype)
+        if kind in NUMERIC_KINDS:
+            return lambda raw: HostColumn._build_numeric(ftype, raw)
+        if kind in TEXT_KINDS:
+            return lambda raw: HostColumn._build_text(ftype, raw)
+        if kind == "geolocation":
+            return lambda raw: HostColumn._build_geolocation(ftype, raw)
+        if kind == "vector":
+            return lambda raw: HostColumn._build_vector(ftype, raw)
+        return lambda raw: HostColumn._build_object(ftype, raw)
+
+    @staticmethod
     def from_values(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
         """Build a column from python values (None = missing), validating via
         the feature type (the columnar analog of wrapping each value)."""
-        kind = _kind_of(ftype)
+        return HostColumn.builder(ftype)(raw)
+
+    @staticmethod
+    def _build_numeric(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
         n = len(raw)
-        if kind in NUMERIC_KINDS:
-            vals = np.zeros(n, dtype=np.float64)
-            mask = np.zeros(n, dtype=bool)
-            for i, v in enumerate(raw):
-                pv = ftype._validate(v)
-                if pv is not None:
-                    vals[i] = float(pv)
-                    mask[i] = True
-            if not ftype.is_nullable and not mask.all():
+        vals = np.zeros(n, dtype=np.float64)
+        mask = np.zeros(n, dtype=bool)
+        for i, v in enumerate(raw):
+            pv = ftype._validate(v)
+            if pv is not None:
+                vals[i] = float(pv)
+                mask[i] = True
+        if not ftype.is_nullable and not mask.all():
+            raise ft.FeatureTypeValueError(
+                f"{ftype.__name__} column contains empty values")
+        return HostColumn(ftype, vals, mask)
+
+    @staticmethod
+    def _build_text(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
+        vals = np.empty(len(raw), dtype=object)
+        for i, v in enumerate(raw):
+            vals[i] = ftype._validate(v)
+        return HostColumn(ftype, vals, None)
+
+    @staticmethod
+    def _build_geolocation(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
+        n = len(raw)
+        vals = np.zeros((n, 3), dtype=np.float64)
+        mask = np.zeros(n, dtype=bool)
+        for i, v in enumerate(raw):
+            pv = ftype._validate(v)
+            if pv:
+                vals[i] = pv
+                mask[i] = True
+        return HostColumn(ftype, vals, mask)
+
+    @staticmethod
+    def _build_vector(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
+        n = len(raw)
+        arrs = [np.asarray(ftype._validate(v), dtype=np.float32) for v in raw]
+        d = max((a.shape[0] for a in arrs), default=0)
+        vals = np.zeros((n, d), dtype=np.float32)
+        for i, a in enumerate(arrs):
+            if a.shape[0] not in (0, d):
                 raise ft.FeatureTypeValueError(
-                    f"{ftype.__name__} column contains empty values")
-            return HostColumn(ftype, vals, mask)
-        if kind in TEXT_KINDS:
-            vals = np.empty(n, dtype=object)
-            for i, v in enumerate(raw):
-                vals[i] = ftype._validate(v)
-            return HostColumn(ftype, vals, None)
-        if kind == "geolocation":
-            vals = np.zeros((n, 3), dtype=np.float64)
-            mask = np.zeros(n, dtype=bool)
-            for i, v in enumerate(raw):
-                pv = ftype._validate(v)
-                if pv:
-                    vals[i] = pv
-                    mask[i] = True
-            return HostColumn(ftype, vals, mask)
-        if kind == "vector":
-            arrs = [np.asarray(ftype._validate(v), dtype=np.float32) for v in raw]
-            d = max((a.shape[0] for a in arrs), default=0)
-            vals = np.zeros((n, d), dtype=np.float32)
-            for i, a in enumerate(arrs):
-                if a.shape[0] not in (0, d):
-                    raise ft.FeatureTypeValueError(
-                        f"ragged vector column: {a.shape[0]} vs {d}")
-                if a.shape[0] == d:
-                    vals[i] = a
-            return HostColumn(ftype, vals, None)
+                    f"ragged vector column: {a.shape[0]} vs {d}")
+            if a.shape[0] == d:
+                vals[i] = a
+        return HostColumn(ftype, vals, None)
+
+    @staticmethod
+    def _build_object(ftype: type[ft.FeatureType], raw: Sequence[Any]) -> "HostColumn":
         # lists, sets, maps, prediction -> object array of validated values
-        vals = np.empty(n, dtype=object)
+        vals = np.empty(len(raw), dtype=object)
         for i, v in enumerate(raw):
             vals[i] = ftype._validate(v)
         return HostColumn(ftype, vals, None)
@@ -371,3 +404,50 @@ class HostFrame:
     def __repr__(self) -> str:
         cols = ", ".join(f"{n}: {c.ftype.__name__}" for n, c in self._cols.items())
         return f"HostFrame(n={self._n}, [{cols}])"
+
+
+# ---------------------------------------------------------------------------
+# Identity + accounting helpers (round 14: device-frame cache)
+# ---------------------------------------------------------------------------
+
+def frame_fingerprint(frame: "HostFrame") -> str:
+    """Content fingerprint of a host frame: column names, feature types,
+    and the FULL value/mask bytes (blake2b). This keys the device-frame
+    cache, so it must be collision-safe in practice — numeric columns hash
+    at memory bandwidth; object columns (strings/maps) hash per-row reprs,
+    the same order of work dict-encoding them costs. Two frames with equal
+    fingerprints produce identical device columns."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    for name in sorted(frame.names()):
+        col = frame[name]
+        h.update(name.encode())
+        h.update(col.ftype.__name__.encode())
+        v = col.values
+        h.update(str(v.shape).encode())
+        if v.dtype == object:
+            for x in v:
+                h.update(repr(x).encode())
+                h.update(b"\x1f")
+        else:
+            h.update(np.ascontiguousarray(v).tobytes())
+        if col.mask is not None:
+            h.update(np.ascontiguousarray(col.mask).tobytes())
+        if col.meta is not None:
+            # vector provenance metadata distinguishes otherwise
+            # value-equal frames (it rides the cached device column)
+            h.update(repr(col.meta).encode())
+    if frame.key is not None:
+        for k in frame.key:
+            h.update(str(k).encode())
+            h.update(b"\x1f")
+    return h.hexdigest()
+
+
+def device_col_nbytes(col: Any) -> int:
+    """Approximate HBM bytes a device column holds (leaf array nbytes);
+    the device-frame cache's budget accounting."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(col):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total

@@ -1,0 +1,356 @@
+"""Device-resident ingest/FE fusion: double-buffered streaming ingest and
+the fingerprint-keyed device-frame cache.
+
+Round 14 (ROADMAP item 4): ingest + transmogrify were the last big
+host-side phase on the training wall. Three pieces close it:
+
+- ``dag.fuse_dag_program`` (see ``dag.py``) compiles every all-device run
+  of fitted DAG levels into ONE jitted program over the HBM-resident
+  columnar frame.
+- :class:`ChunkPrefetcher` (here) overlaps host IO + decode for chunk N+1
+  with chunk N's device FE program: a bounded background thread runs the
+  decode function ahead of the consumer, waits are watchdog-armed
+  (``utils/devicewatch.py`` — a hung decode autopsies like a hung device
+  dispatch), and the consumer's blocked time is metered so the committed
+  overlap ratio is measured, not asserted.
+- :class:`DeviceFrameCache` (here) keys the uploaded device columns by the
+  host frame's content fingerprint: a train-then-score or repeated
+  ``train()`` session over identical host columns reuses the resident
+  device frame instead of re-transferring (and re-dict-encoding) it.
+  Entries drop under HBM pressure (``utils/resources.hbm_pressure_state``)
+  or RSS pressure on stat-less backends.
+
+Knobs: ``TRANSMOGRIFAI_FE_FUSED=1|0`` (fusion master gate, ``dag.py``),
+``TRANSMOGRIFAI_PREFETCH_DEPTH`` (chunks decoded ahead; 0 disables the
+background thread), ``TRANSMOGRIFAI_FRAME_CACHE=1|0`` and
+``TRANSMOGRIFAI_FRAME_CACHE_ENTRIES`` (device-frame cache). See
+docs/PIPELINE.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+import warnings
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from transmogrifai_tpu import frame as fr
+
+__all__ = ["ChunkPrefetcher", "DeviceFrameCache", "prefetch_depth",
+           "frame_cache_enabled"]
+
+_SENTINEL = object()
+
+
+def prefetch_depth() -> int:
+    """Chunks the background decoder may run ahead of the consumer
+    (``TRANSMOGRIFAI_PREFETCH_DEPTH``, default 2; 0 disables prefetch)."""
+    try:
+        return max(int(os.environ.get("TRANSMOGRIFAI_PREFETCH_DEPTH", "2")), 0)
+    except ValueError:
+        warnings.warn("TRANSMOGRIFAI_PREFETCH_DEPTH is not an int; using 2",
+                      RuntimeWarning)
+        return 2
+
+
+def frame_cache_enabled() -> bool:
+    return os.environ.get("TRANSMOGRIFAI_FRAME_CACHE", "1") != "0"
+
+
+class ChunkPrefetcher:
+    """Bounded background decode-ahead over an iterable of work items.
+
+    ``fn(item)`` runs on ONE background thread (host-only work by
+    contract: record decode, numpy column building — jax dispatch stays
+    on the consumer thread so device program order is unchanged), at most
+    ``depth`` results ahead of the consumer. Iterating the prefetcher
+    yields results in input order; a decode error re-raises at the
+    consumer's position, so failure semantics match the serial loop.
+
+    Every consumer wait is armed under the dispatch watchdog (site
+    ``ingest.prefetch``) and registered in the ``DispatchLedger`` — a
+    wedged producer (NFS hang, poisoned decode) autopsies exactly like a
+    wedged device dispatch instead of silently stalling the train loop.
+    Metering: ``utils.profiling.ingest_counters`` gets one
+    ``chunks_prefetched`` per decoded chunk, the background thread's busy
+    seconds in ``decode_s``, and the consumer's blocked seconds in
+    ``prefetch_wait_s`` (the overlap ratio's raw ingredients).
+    """
+
+    def __init__(self, items: Iterable[Any], fn: Callable[[Any], Any],
+                 depth: Optional[int] = None, name: str = "ingest-prefetch"):
+        self.depth = prefetch_depth() if depth is None else max(int(depth), 0)
+        self._fn = fn
+        self._items = iter(items)
+        self._name = name
+        self._queue: queue.Queue = queue.Queue(maxsize=max(self.depth, 1))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: True while the producer is INSIDE fn (decoding a known item).
+        #: The consumer arms the stall watchdog only then: a wait on an
+        #: idle upstream (a long-running file stream between arrivals) is
+        #: healthy and must not fire hang autopsies.
+        self._decoding = False
+        #: consumer-side accounting (read by the bench's overlap ratio)
+        self.decode_s = 0.0
+        self.wait_s = 0.0
+        self.chunks = 0
+
+    # -- producer ------------------------------------------------------------
+    def _produce(self) -> None:
+        from transmogrifai_tpu.utils.faults import fault_point
+        from transmogrifai_tpu.utils.profiling import ingest_counters
+        from transmogrifai_tpu.utils.tracing import span
+        try:
+            for item in self._items:
+                if self._stop.is_set():
+                    return
+                t0 = time.monotonic()
+                self._decoding = True
+                try:
+                    fault_point("ingest.prefetch")
+                    with span("ingest.prefetch", chunk=self.chunks):
+                        result = self._fn(item)
+                except BaseException as err:  # noqa: BLE001 — re-raised at the consumer
+                    self._queue.put(("error", err))
+                    return
+                finally:
+                    self._decoding = False
+                dt = time.monotonic() - t0
+                self.decode_s += dt
+                self.chunks += 1
+                ingest_counters.chunks_prefetched += 1
+                ingest_counters.decode_s += dt
+                self._queue.put(("ok", result))
+            self._queue.put(("done", _SENTINEL))
+        except BaseException as err:  # noqa: BLE001 — re-raised at the consumer
+            try:
+                self._queue.put(("error", err))
+            except Exception:  # failure-ok: consumer gone; nothing to notify
+                pass
+
+    # -- consumer ------------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        if self.depth <= 0:
+            # prefetch disabled: serial decode on the consumer thread,
+            # same metering surface (decode_s ticks, overlap is 0)
+            from transmogrifai_tpu.utils.faults import fault_point
+            from transmogrifai_tpu.utils.tracing import span
+            for item in self._items:
+                t0 = time.monotonic()
+                fault_point("ingest.prefetch")
+                with span("ingest.prefetch", chunk=self.chunks):
+                    result = self._fn(item)
+                self.decode_s += time.monotonic() - t0
+                self.chunks += 1
+                yield result
+            return
+        from transmogrifai_tpu.utils import devicewatch as dw
+        from transmogrifai_tpu.utils.profiling import ingest_counters
+        self._thread = threading.Thread(
+            target=self._produce, name=self._name, daemon=True)
+        self._thread.start()
+        try:
+            while True:
+                t0 = time.monotonic()
+                got = None
+                while got is None:
+                    if self._decoding:
+                        # the producer is mid-decode: a wedged fn is the
+                        # hang this wait can actually suffer — arm the
+                        # watchdog + ledger for the remainder of the wait
+                        eid = dw.dispatch_ledger.register(
+                            "ingest.prefetch", chunk=self.chunks)
+                        try:
+                            with dw.watchdog.guard("ingest.prefetch",
+                                                   site="ingest.prefetch"):
+                                got = self._queue.get()
+                        finally:
+                            dw.dispatch_ledger.complete(eid)
+                    else:
+                        # upstream idle (e.g. a long-running file stream
+                        # between arrivals): waiting is healthy — poll
+                        # UNGUARDED so no false stall autopsies fire
+                        try:
+                            got = self._queue.get(timeout=0.5)
+                        except queue.Empty:
+                            continue
+                kind, payload = got
+                waited = time.monotonic() - t0
+                self.wait_s += waited
+                ingest_counters.prefetch_wait_s += waited
+                if kind == "done":
+                    return
+                if kind == "error":
+                    raise payload
+                yield payload
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the producer (idempotent). Drains the queue so a blocked
+        ``put`` can observe the stop flag and exit."""
+        self._stop.set()
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+
+class DeviceFrameCache:
+    """Fingerprint-keyed cache of uploaded device frames.
+
+    One entry = the DEVICE state a ``PipelineData`` accumulated for a host
+    frame: the raw device column dict (numeric/vector uploads), the text
+    codes cache (dict-encode results), and the row mask. The entry holds a
+    reference to the LIVE dicts of the PipelineData registered at ingest —
+    columns uploaded lazily after registration (the bulk numeric path, the
+    first text encode) land in the cached entry automatically, so the
+    second train()/score() over the same bytes starts fully resident.
+
+    Keys combine the host frame's content fingerprint
+    (``frame.frame_fingerprint``) with the placement context (backend +
+    mesh shape/devices): a cache built under one mesh never serves a
+    differently-sharded session. Entries are LRU-bounded
+    (``TRANSMOGRIFAI_FRAME_CACHE_ENTRIES``, default 2) and ALL drop when
+    the device reports HBM pressure (``resources.hbm_pressure_state``) or,
+    on stat-less backends, host RSS pressure — the cache is a freshness
+    optimization, never a residency obligation.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(
+                    "TRANSMOGRIFAI_FRAME_CACHE_ENTRIES", "2"))
+            except ValueError:
+                capacity = 2
+        self.capacity = max(int(capacity), 1)
+        self._entries: "collections.OrderedDict[tuple, dict]" = \
+            collections.OrderedDict()
+        #: column-identity memo: tuple((name, id(values), id(mask))) ->
+        #: content fingerprint. Scoring consults ONLY this (O(columns));
+        #: the O(rows) content hash is paid when a frame is REGISTERED
+        #: (train()) — never per scored micro-batch, where a stream of
+        #: distinct batches could otherwise pay a guaranteed-miss full
+        #: hash (plus per-row reprs on text columns) per batch. Sound
+        #: because HostColumn/HostFrame are immutable by contract: the
+        #: same value-array objects imply the same bytes.
+        self._ident_fp: "collections.OrderedDict[tuple, str]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _ctx_key() -> tuple:
+        import jax
+
+        from transmogrifai_tpu.parallel import mesh as pmesh
+        try:
+            backend = jax.default_backend()
+        except Exception:  # failure-ok: no backend -> host-only context
+            backend = "none"
+        ctx = pmesh.current_mesh()
+        if ctx is None:
+            return (backend, None)
+        return (backend, (ctx.n_data, ctx.n_model,
+                          tuple(d.id for d in ctx.mesh.devices.flat)))
+
+    def _under_pressure(self) -> bool:
+        from transmogrifai_tpu.utils import resources
+        hbm = resources.hbm_pressure_state()
+        if hbm["pressured"]:
+            return True
+        if hbm["hbmBytesLimit"] > 0:
+            return False
+        # stat-less backends (CPU) only: the host RSS budget stands in —
+        # the "device" arrays live in host memory there (the statvfs +
+        # /proc probe is skipped entirely when real HBM stats exist)
+        return bool(resources.pressure_state()["rssPressure"])
+
+    def _drop_all(self, reason: str) -> None:
+        from transmogrifai_tpu.utils.events import events
+        from transmogrifai_tpu.utils.profiling import ingest_counters
+        if not self._entries:
+            return
+        n = len(self._entries)
+        self._entries.clear()
+        ingest_counters.frame_cache_drops += n
+        events.emit("ingest.frame_cache_drop", entries=n, reason=reason)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(e["nbytes"] for e in self._entries.values())
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    @staticmethod
+    def _ident(frame: fr.HostFrame) -> tuple:
+        return tuple(sorted(
+            (n, id(frame[n].values),
+             id(frame[n].mask) if frame[n].mask is not None else 0)
+            for n in frame.names()))
+
+    # -- the adopt seam ------------------------------------------------------
+    def adopt(self, frame: fr.HostFrame, data, register: bool = True) -> Any:
+        """Called at ingest with the fresh ``PipelineData``: on a hit,
+        returns a NEW PipelineData over ``frame`` sharing the cached
+        device state (no re-transfer); on a miss with ``register``,
+        fingerprints and registers the fresh instance's live device dicts
+        (the train seam). ``register=False`` is the SCORING seam: only
+        the O(columns) identity memo is consulted — an unknown frame
+        (every distinct streaming micro-batch) returns untouched without
+        paying the O(rows) content hash."""
+        from transmogrifai_tpu.pipeline_data import PipelineData
+        from transmogrifai_tpu.utils.profiling import ingest_counters
+        ident = self._ident(frame)
+        with self._lock:
+            content_fp = self._ident_fp.get(ident)
+        if content_fp is None:
+            if not register:
+                return data
+            content_fp = fr.frame_fingerprint(frame)
+        fp = (content_fp, self._ctx_key())
+        with self._lock:
+            if self._under_pressure():
+                self._drop_all("pressure")
+                return data
+            self._ident_fp[ident] = content_fp
+            while len(self._ident_fp) > 4 * self.capacity:
+                self._ident_fp.popitem(last=False)
+            entry = self._entries.get(fp)
+            if entry is not None:
+                self._entries.move_to_end(fp)
+                ingest_counters.frame_cache_reuses += 1
+                out = PipelineData(frame, entry["device"],
+                                   n_rows_logical=entry["n_logical"])
+                # share the LIVE dicts: later lazy uploads keep warming
+                # the cached entry for the next session
+                out.device = entry["device"]
+                out._codes_cache = entry["codes"]
+                out._row_mask = entry["row_mask"]
+                return out
+            if not register:
+                return data
+            self._entries[fp] = {
+                "device": data.device, "codes": data._codes_cache,
+                "row_mask": data._row_mask,
+                "n_logical": data._n_logical,
+                "nbytes": sum(fr.device_col_nbytes(c)
+                              for c in data.device.values()),
+            }
+            ingest_counters.frame_cache_stores += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        return data

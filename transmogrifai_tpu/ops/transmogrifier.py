@@ -126,8 +126,17 @@ def transmogrify(features: Sequence[FeatureLike],
                  label: Optional[FeatureLike] = None,
                  track_invalid: bool = TransmogrifierDefaults.TRACK_INVALID,
                  min_info_gain: float = TransmogrifierDefaults.MIN_INFO_GAIN,
+                 text_vectorizer: str = "smart",
                  ) -> FeatureLike:
     """Vectorize a heterogeneous feature set into one combined OPVector.
+
+    ``text_vectorizer`` routes the free-text group: ``"smart"`` (default,
+    the cardinality-adaptive SmartTextVectorizer), ``"hash"`` (host
+    token-bag :class:`TextHashingVectorizer`), or ``"hash_device"``
+    (round 14: :class:`DeviceTextHashingVectorizer` — categorical
+    whole-value murmur hashing computed inside the fused device FE
+    program; the right choice for Criteo-style high-cardinality id
+    columns, where it removes the per-row host hashing loop entirely).
 
     ``label``: optional response feature enabling the reference's
     label-aware smart defaults (Transmogrifier.scala:99-104 passes the
@@ -226,9 +235,24 @@ def transmogrify(features: Sequence[FeatureLike],
             stage = OneHotVectorizer(top_k=top_k, min_support=min_support,
                                      track_nulls=track_nulls)
         elif kind == "smart_text":
-            stage = SmartTextVectorizer(
-                top_k=top_k, min_support=min_support,
-                num_hash_features=num_hash_features, track_nulls=track_nulls)
+            if text_vectorizer == "hash_device":
+                from transmogrifai_tpu.ops.vectorizers.hashing import (
+                    DeviceTextHashingVectorizer,
+                )
+                stage = DeviceTextHashingVectorizer(
+                    num_features=num_hash_features, track_nulls=track_nulls)
+            elif text_vectorizer == "hash":
+                stage = TextHashingVectorizer(
+                    num_features=num_hash_features, track_nulls=track_nulls)
+            elif text_vectorizer == "smart":
+                stage = SmartTextVectorizer(
+                    top_k=top_k, min_support=min_support,
+                    num_hash_features=num_hash_features,
+                    track_nulls=track_nulls)
+            else:
+                raise ValueError(
+                    f"text_vectorizer={text_vectorizer!r}; one of "
+                    "smart|hash|hash_device")
         elif kind == "multipicklist":
             stage = SetVectorizer(top_k=top_k, min_support=min_support,
                                   track_nulls=track_nulls)

@@ -73,20 +73,15 @@ def _bucketize_block(values, mask, splits: np.ndarray, track_invalid: bool,
     Layout: [bucket_0..bucket_{k-1}, invalid?, null?] — a present value in
     [splits[i], splits[i+1]) lights bucket i; out-of-range lights the invalid
     column (or nothing); missing lights the null column (or nothing).
+
+    Engine-dispatched (round 14): on TPU backends the bin-edge search +
+    one-hot expand run as a Pallas kernel fully in VMEM
+    (``ops/quantile_bin_pallas.py``); everywhere else (and under
+    ``TRANSMOGRIFAI_BUCKET_ENGINE=xla``) the original XLA path runs —
+    CPU CI asserts bitwise parity between the two.
     """
-    k = len(splits) - 1
-    inner = jnp.asarray(splits[1:-1], jnp.float32)
-    idx = jnp.searchsorted(inner, values, side="right") if k > 1 else (
-        jnp.zeros(values.shape, jnp.int32))
-    in_range = (values >= splits[0]) & (values <= splits[-1])
-    width = k + int(track_invalid) + int(track_nulls)
-    # slot: bucket for valid, k for invalid, k+trackInvalid for null,
-    # `width` (one-hot of width drops it) for untracked cases
-    invalid_slot = k if track_invalid else width
-    null_slot = k + int(track_invalid) if track_nulls else width
-    slot = jnp.where(in_range, idx, invalid_slot)
-    slot = jnp.where(mask > 0, slot, null_slot)
-    return jax.nn.one_hot(slot, width, dtype=jnp.float32)
+    from transmogrifai_tpu.ops.quantile_bin_pallas import bucketize_block
+    return bucketize_block(values, mask, splits, track_invalid, track_nulls)
 
 
 class NumericBucketizer(DeviceTransformer):

@@ -22,14 +22,15 @@ from typing import Optional, Sequence
 import numpy as np
 
 from transmogrifai_tpu import frame as fr
-from transmogrifai_tpu.stages.base import HostTransformer
+from transmogrifai_tpu.stages.base import DeviceTransformer, HostTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import (
     parent_of,
     NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
 )
 
-__all__ = ["TextHashingVectorizer", "hash_token", "encode_ascii_rows"]
+__all__ = ["TextHashingVectorizer", "DeviceTextHashingVectorizer",
+           "hash_token", "encode_ascii_rows"]
 
 _TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
 
@@ -212,6 +213,93 @@ class TextHashingVectorizer(HostTransformer):
                     cols.append(VectorColumnMetadata(
                         *parent_of(f), grouping=f.name,
                         descriptor_value=f"hash_{j}"))
+        if self.track_nulls:
+            for f in feats:
+                cols.append(VectorColumnMetadata(
+                    *parent_of(f), grouping=f.name,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.get_output().name, tuple(cols)).reindexed(0)
+
+
+class DeviceTextHashingVectorizer(DeviceTransformer):
+    """Device-resident categorical feature hashing: N text inputs ->
+    [n, N*bins (+N)] hashed one-hot counts, computed INSIDE the fused FE
+    program (round 14).
+
+    Semantics: each value hashes as ONE token (murmur3 x86_32 of its
+    UTF-8 bytes — ``ops/hashing_pallas.murmur3_str``) — the categorical
+    hashing-trick (Criteo-style high-cardinality id columns), not the
+    token-bag hashing of :class:`TextHashingVectorizer` (which stays the
+    right choice for free text). Layout matches the host vectorizer:
+    per-input hash blocks first, then one null-indicator column per input.
+
+    Execution split: hashing is per-UNIQUE — a trace-time murmur3 table
+    over the column's dictionary vocab (aux data, exactly
+    ``OneHotModel``'s category-table idiom, so the jit key moves only
+    when the vocab does) — while the per-ROW work (the O(n x bins)
+    one-hot accumulate the host vectorizer paid in Python) runs on
+    device through ``ops/hashing_pallas.segment_onehot`` (Pallas kernel
+    on TPU, XLA fallback elsewhere; bitwise-identical)."""
+
+    variadic = True
+    in_types = (ft.Text,)
+    out_type = ft.OPVector
+
+    def __init__(self, num_features: int = 512, track_nulls: bool = True,
+                 seed: int = 0, uid: Optional[str] = None):
+        self.num_features = num_features
+        self.track_nulls = track_nulls
+        self.seed = seed
+        super().__init__(uid=uid)
+
+    def _vocab_bins(self, vocab: Sequence[str]) -> np.ndarray:
+        from transmogrifai_tpu.ops.hashing_pallas import murmur3_str
+        if not vocab:
+            return np.zeros(1, np.int32)
+        return np.fromiter(
+            (murmur3_str(v, self.seed) % self.num_features for v in vocab),
+            np.int32, count=len(vocab))
+
+    def device_apply(self, params, *cols: fr.CodesColumn) -> fr.VectorColumn:
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.ops.hashing_pallas import segment_onehot
+        B = self.num_features
+        blocks = []
+        nulls = []
+        for c in cols:
+            table = jnp.asarray(self._vocab_bins(c.vocab))
+            bins = jnp.where(c.codes >= 0, table[jnp.clip(c.codes, 0)],
+                             jnp.int32(-1))
+            blocks.append(segment_onehot(bins[:, None], B))
+            if self.track_nulls:
+                nulls.append((c.codes < 0).astype(jnp.float32)[:, None])
+        parts = blocks + nulls
+        out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        return fr.VectorColumn(out, self._meta(len(cols)))
+
+    def transform_row(self, *values):
+        from transmogrifai_tpu.ops.hashing_pallas import murmur3_str
+        B = self.num_features
+        n = len(values)
+        width = n * B + (n if self.track_nulls else 0)
+        row = np.zeros(width, np.float32)
+        for i, v in enumerate(values):
+            if v is None:
+                if self.track_nulls:
+                    row[n * B + i] = 1.0
+            else:
+                row[i * B + murmur3_str(v, self.seed) % B] += 1.0
+        return row
+
+    def _meta(self, n_inputs: int) -> VectorMetadata:
+        feats = self.input_features
+        cols = []
+        for f in feats:
+            for j in range(self.num_features):
+                cols.append(VectorColumnMetadata(
+                    *parent_of(f), grouping=f.name,
+                    descriptor_value=f"hash_{j}"))
         if self.track_nulls:
             for f in feats:
                 cols.append(VectorColumnMetadata(

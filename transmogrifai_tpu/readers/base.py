@@ -106,16 +106,19 @@ class DataReader:
     def generate_frame(self, raw_features: Sequence[FeatureLike]) -> HostFrame:
         from transmogrifai_tpu.utils.tracing import span
         stages = [_origin(f) for f in raw_features]
+        # schema resolution hoisted ONCE per reader (HostColumn.builder):
+        # the per-column kind dispatch used to re-run on every chunk, which
+        # streaming micro-batch ingest paid per batch
+        builders = [HostColumn.builder(f.ftype) for f in raw_features]
         chunk_cols: dict[str, list[HostColumn]] = {f.name: []
                                                    for f in raw_features}
         key_chunks: Optional[list] = [] if self.key_fn is not None else None
         with span("reader.generate_frame", reader=type(self).__name__,
                   n_features=len(raw_features)):
             for chunk in self._iter_chunks():
-                for f, stage in zip(raw_features, stages):
+                for f, stage, build in zip(raw_features, stages, builders):
                     vals = [stage.extract(r) for r in chunk]
-                    chunk_cols[f.name].append(
-                        HostColumn.from_values(f.ftype, vals))
+                    chunk_cols[f.name].append(build(vals))
                 if key_chunks is not None:
                     key_chunks.append(np.asarray(
                         [str(self.key_fn(r)) for r in chunk], dtype=object))

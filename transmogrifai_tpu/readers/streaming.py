@@ -309,11 +309,21 @@ def reader_for_file(path: str, schema: Optional[dict] = None) -> DataReader:
 
 
 def stream_score(model, reader: StreamingReader,
-                 write_batch: Optional[Callable[[Any, int], None]] = None
-                 ) -> Iterator[Any]:
+                 write_batch: Optional[Callable[[Any, int], None]] = None,
+                 prefetch: Optional[int] = None) -> Iterator[Any]:
     """Continuous scoring loop (reference OpWorkflowRunner StreamingScore):
     for each micro-batch, run the fitted DAG and yield the scored frame
-    (and/or hand it to ``write_batch(frame, batch_index)``)."""
+    (and/or hand it to ``write_batch(frame, batch_index)``).
+
+    Round 14 double buffer: the HOST half of ingest (record decode ->
+    typed raw columns, ``WorkflowModel._ingest_frame``) for batch N+1 runs
+    on a background prefetch thread while batch N's fused FE program
+    executes on device, so host IO overlaps device compute instead of
+    serializing with it. ``prefetch`` overrides
+    ``TRANSMOGRIFAI_PREFETCH_DEPTH`` (0 = the serial pre-round-14 loop,
+    byte-for-byte). Device dispatch stays on the consumer thread; waits
+    are dispatch-watchdog-armed (site ``ingest.prefetch``)."""
+    from transmogrifai_tpu.ingest_fusion import ChunkPrefetcher
     pinned = getattr(reader, "schema", ...) is None
     if pinned:
         # pin batch-file parsing to the model's raw predictor types so
@@ -321,12 +331,23 @@ def stream_score(model, reader: StreamingReader,
         # (responses stay inferred: score streams usually lack them)
         reader.schema = {f.name: f.ftype for f in model.raw_features
                          if not f.is_response}
+    if getattr(reader, "checkpoint", None) is not None:
+        # a durable stream commits a file as done when the NEXT batch is
+        # pulled — prefetching would advance the source generator (and the
+        # commit) ahead of actual consumption, breaking the at-least-once
+        # crash-replay contract. Durability outranks overlap: run serial.
+        prefetch = 0
+    prefetcher = ChunkPrefetcher(
+        reader.stream(),
+        lambda records: model._ingest_frame(CustomReader(records=records)),
+        depth=prefetch)
     try:
-        for i, records in enumerate(reader.stream()):
-            scored = model.score(CustomReader(records=records))
+        for i, frame in enumerate(prefetcher):
+            scored = model.score(frame)
             if write_batch is not None:
                 write_batch(scored, i)
             yield scored
     finally:
+        prefetcher.close()
         if pinned:
             reader.schema = None  # don't leak this model's types

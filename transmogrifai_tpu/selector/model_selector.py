@@ -1571,10 +1571,18 @@ class ModelSelector(Estimator):
     def fit_model(self, data) -> SelectedModel:
         from transmogrifai_tpu.dag import _plog
         from transmogrifai_tpu.utils.profiling import OpStep, profiler
+        from transmogrifai_tpu.utils.tracing import span as _span
         t0 = time.time()
         label_name, feat_name = self.input_names
-        X = data.device_col(feat_name).values
-        y = data.device_col(label_name).values
+        # the ingest->sweep handoff (round 14): with fused FE the feature
+        # matrix is already an HBM-resident, rows-on-"data"-sharded device
+        # column — the sweep consumes it pre-partitioned, no host pull and
+        # no resharding device_put. `presharded` makes that assertable.
+        presharded = feat_name in data.device
+        with _span("sweep.operands", presharded=presharded,
+                   feature=feat_name):
+            X = data.device_col(feat_name).values
+            y = data.device_col(label_name).values
         n = data.n_rows  # logical rows: device arrays may carry mesh padding
 
         train_idx, holdout_idx, w_train, prep_results = \

@@ -21,6 +21,10 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
                           retraining the winner
 ``train.layer``           start of each Workflow.train layer (preemption)
 ``ingest.read``           one streaming micro-batch file read
+``ingest.fuse``           one fused FE segment dispatch (an injected OOM
+                          takes the stagewise degradation rung)
+``ingest.prefetch``       one double-buffered ingest chunk decode (the
+                          background prefetch thread's work unit)
 ``checkpoint.write``      any durable checkpoint write (train/sweep/stream)
 ``collective``            multihost barrier / global-array assembly
 ``serving.dispatch``      one compiled serving batch dispatch
@@ -91,7 +95,8 @@ __all__ = ["FaultPlan", "FaultSpec", "FaultHarnessError",
 #: the instrumented site names (documentation + parse-time validation)
 KNOWN_SITES = frozenset({
     "dag.apply_layer", "sweep.fit", "selector.refit", "train.layer",
-    "ingest.read", "checkpoint.write", "collective", "serving.dispatch",
+    "ingest.read", "ingest.fuse", "ingest.prefetch",
+    "checkpoint.write", "collective", "serving.dispatch",
     "serving.swap", "continuous.ingest", "continuous.trigger",
     "continuous.retrain", "continuous.promote", "events.spill",
     "scaleout.route", "scaleout.heartbeat", "scaleout.roll",

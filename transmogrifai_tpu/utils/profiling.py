@@ -30,7 +30,8 @@ from typing import Optional
 __all__ = ["OpStep", "AppMetrics", "profiler", "phase",
            "trace_device_intervals", "trace_device_events",
            "aggregate_across_hosts", "SweepCounters", "sweep_counters",
-           "ServingCounters", "RunCounters", "run_counters"]
+           "ServingCounters", "RunCounters", "run_counters",
+           "IngestCounters", "ingest_counters"]
 
 
 class OpStep(Enum):
@@ -188,6 +189,9 @@ class AppMetrics:
             # best-effort writes — the ladder's ground truth in the same
             # json
             "resourceCounters": _resource_counters_json(),
+            # fused-ingest/FE accounting (round 14): fused vs host-side
+            # FE stage-rows, prefetch overlap, frame-cache hits
+            "ingestCounters": ingest_counters.to_json(),
         }
 
     def save(self, path: str) -> None:
@@ -466,6 +470,74 @@ run_counters = RunCounters()
 
 
 @dataclass
+class IngestCounters:
+    """Fused-ingest/FE observability for one run (round 14; reset with the
+    profiler, process-global like ``run_counters``).
+
+    The device-resident FE contract is asserted through these: with
+    ``TRANSMOGRIFAI_FE_FUSED=1`` every all-device DAG segment runs as one
+    fused program (``fe_fused_programs``/``fe_fused_stages``; OFF must
+    leave both at exactly 0 — the byte-for-byte pre-fusion path), an OOM
+    inside a segment takes the stagewise rung (``fe_host_fallbacks``, rows
+    re-applied stage-by-stage land in ``fe_host_rows``), the streaming
+    double buffer prefetches chunk N+1 while chunk N computes
+    (``chunks_prefetched``, blocked-consumer seconds in
+    ``prefetch_wait_s``, background decode seconds in ``decode_s``), the
+    fingerprint-keyed device-frame cache skips identical host->device
+    re-transfers (``frame_cache_reuses``/``stores``; pressure drops in
+    ``frame_cache_drops``), and mesh placement skips device_puts whose
+    operand already carries the target sharding (``presharded_skips`` —
+    the "sweep consumes pre-partitioned operands" handoff).
+
+    Row counts are stage-rows (rows x stages applied), so fused vs
+    host-side FE shares compare directly however segments split."""
+
+    fe_fused_programs: int = 0
+    fe_fused_stages: int = 0
+    fe_fused_rows: int = 0
+    fe_host_rows: int = 0
+    fe_host_fallbacks: int = 0
+    chunks_prefetched: int = 0
+    prefetch_wait_s: float = 0.0
+    decode_s: float = 0.0
+    frame_cache_reuses: int = 0
+    frame_cache_stores: int = 0
+    frame_cache_drops: int = 0
+    presharded_skips: int = 0
+
+    def reset(self) -> None:
+        self.fe_fused_programs = 0
+        self.fe_fused_stages = 0
+        self.fe_fused_rows = 0
+        self.fe_host_rows = 0
+        self.fe_host_fallbacks = 0
+        self.chunks_prefetched = 0
+        self.prefetch_wait_s = 0.0
+        self.decode_s = 0.0
+        self.frame_cache_reuses = 0
+        self.frame_cache_stores = 0
+        self.frame_cache_drops = 0
+        self.presharded_skips = 0
+
+    def to_json(self) -> dict:
+        return {"feFusedPrograms": self.fe_fused_programs,
+                "feFusedStages": self.fe_fused_stages,
+                "feFusedRows": self.fe_fused_rows,
+                "feHostRows": self.fe_host_rows,
+                "feHostFallbacks": self.fe_host_fallbacks,
+                "chunksPrefetched": self.chunks_prefetched,
+                "prefetchWaitSeconds": self.prefetch_wait_s,
+                "decodeSeconds": self.decode_s,
+                "frameCacheReuses": self.frame_cache_reuses,
+                "frameCacheStores": self.frame_cache_stores,
+                "frameCacheDrops": self.frame_cache_drops,
+                "preshardedSkips": self.presharded_skips}
+
+
+ingest_counters = IngestCounters()
+
+
+@dataclass
 class ServingBucketCounters:
     """Per-padding-bucket online-serving observability (``ServingCounters``)."""
     compiles: int = 0    # XLA backend compiles while this bucket dispatched
@@ -581,6 +653,7 @@ class _Profiler:
         from transmogrifai_tpu.utils.tracing import recorder
         sweep_counters.reset()
         run_counters.reset()
+        ingest_counters.reset()
         resource_counters.reset()
         recorder.reset()
         reset_run()  # the HBM timeline covers exactly this run's trace

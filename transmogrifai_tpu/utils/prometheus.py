@@ -245,6 +245,68 @@ def _resource_collectors(reg: PromRegistry) -> None:
         lambda: [({}, 1 if resources.ladder_enabled() else 0)])
 
 
+def _ingest_collectors(reg: PromRegistry) -> None:
+    """The fused-ingest/FE surface (round 14, ``utils/profiling.
+    IngestCounters``): fused vs host-side FE stage-rows, fused program
+    dispatches + OOM fallbacks, streaming prefetch accounting (chunks,
+    decode seconds, consumer blocked seconds, live overlap ratio), the
+    device-frame cache's reuse/store/pressure-drop counters, and the
+    already-sharded device_put skips the pre-partitioned sweep handoff
+    counts. Carried by EVERY registry, like the resource series."""
+    from transmogrifai_tpu.dag import fe_fused_enabled
+    from transmogrifai_tpu.utils.profiling import ingest_counters as ic
+
+    for attr, name, help_ in (
+            ("fe_fused_programs", "fe_fused_programs",
+             "fused FE segment programs dispatched"),
+            ("fe_fused_stages", "fe_fused_stages",
+             "device transformer stages executed inside fused programs"),
+            ("fe_fused_rows", "fe_fused_rows",
+             "stage-rows (rows x stages) transformed by fused programs"),
+            ("fe_host_rows", "fe_host_rows",
+             "stage-rows transformed by the stagewise/host FE path"),
+            ("fe_host_fallbacks", "fe_host_fallbacks",
+             "fused segments degraded to the stagewise rung (OOM)"),
+            ("chunks_prefetched", "chunks_prefetched",
+             "ingest chunks decoded ahead by the prefetch thread"),
+            ("frame_cache_reuses", "frame_cache_reuses",
+             "device-frame cache hits (host->device transfer skipped)"),
+            ("frame_cache_stores", "frame_cache_stores",
+             "device frames registered in the cache"),
+            ("frame_cache_drops", "frame_cache_drops",
+             "cached device frames released under memory pressure"),
+            ("presharded_skips", "presharded_skips",
+             "device_puts skipped because the operand already carried "
+             "the target sharding")):
+        reg.register(f"transmogrifai_ingest_{name}_total", "counter",
+                     help_, lambda a=attr: [({}, getattr(ic, a))])
+    reg.register(
+        "transmogrifai_ingest_prefetch_wait_seconds", "gauge",
+        "cumulative consumer seconds blocked waiting on the prefetch "
+        "queue", lambda: [({}, ic.prefetch_wait_s)])
+    reg.register(
+        "transmogrifai_ingest_decode_seconds", "gauge",
+        "cumulative background decode seconds spent by the prefetcher",
+        lambda: [({}, ic.decode_s)])
+
+    def _overlap():
+        # decode seconds the consumer did NOT wait for = overlapped work;
+        # 1.0 = decode fully hidden behind device compute
+        d = ic.decode_s
+        if d <= 0:
+            return [({}, 0.0)]
+        return [({}, max(0.0, min(1.0, (d - ic.prefetch_wait_s) / d)))]
+
+    reg.register(
+        "transmogrifai_ingest_overlap_ratio", "gauge",
+        "fraction of prefetch decode seconds hidden behind consumer "
+        "compute (1 = fully overlapped)", _overlap)
+    reg.register(
+        "transmogrifai_ingest_fe_fused_enabled", "gauge",
+        "1 while fused FE is enabled (TRANSMOGRIFAI_FE_FUSED)",
+        lambda: [({}, 1 if fe_fused_enabled() else 0)])
+
+
 def _devicewatch_collectors(reg: PromRegistry) -> None:
     """The device-execution observatory (``utils/devicewatch.py``):
     dispatch-watchdog stall accounting, the in-flight dispatch ledger,
@@ -747,6 +809,7 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     _event_collectors(reg)
     _resource_collectors(reg)
     _devicewatch_collectors(reg)
+    _ingest_collectors(reg)
     if include_app:
         _app_collectors(reg)
     if serving is not None:

@@ -322,6 +322,35 @@ def disk_min_free_bytes() -> int:
     return _env_bytes(DISK_MIN_FREE_ENV)
 
 
+#: HBM fill fraction beyond which device-side caches (the round-14
+#: device-frame cache) release their entries
+HBM_PRESSURE_FRAC_ENV = "TRANSMOGRIFAI_HBM_PRESSURE_FRAC"
+
+
+def hbm_pressure_state() -> dict:
+    """Device-memory pressure snapshot for HBM-resident caches: bytes in
+    use vs the backend's per-device limit (``utils/devicewatch.py``
+    census). ``pressured`` is True when usage exceeds the configured
+    fraction (``TRANSMOGRIFAI_HBM_PRESSURE_FRAC``, default 0.85) of a
+    KNOWN limit — backends that expose no memory stats (CPU) report no
+    pressure, and the RSS budget (``pressure_state``) stands in for them."""
+    from transmogrifai_tpu.utils.devicewatch import device_memory_census
+    census = device_memory_census()  # ONE all-device walk per call
+    in_use, limit = census["bytesInUse"], census["bytesLimit"]
+    try:
+        frac = float(os.environ.get(HBM_PRESSURE_FRAC_ENV, "") or 0.85)
+    except ValueError:
+        warnings.warn(f"{HBM_PRESSURE_FRAC_ENV} is not a float; using 0.85",
+                      RuntimeWarning)
+        frac = 0.85
+    return {
+        "hbmBytesInUse": int(in_use),
+        "hbmBytesLimit": int(limit),
+        "hbmPressureFrac": frac,
+        "pressured": bool(limit > 0 and in_use > frac * limit),
+    }
+
+
 def pressure_state(path: Optional[str] = None) -> dict:
     """One JSON-able snapshot of host resource pressure — the block
     ``/healthz`` folds in and the incident dumps freeze. ``path``

@@ -36,6 +36,12 @@ class Workflow:
         self._raw_feature_filter = None
         self._workflow_cv = False
         self._model_stage_overrides: dict[str, Any] = {}
+        #: fingerprint-keyed device-frame cache (round 14): repeated
+        #: train() over identical host columns — and the trained model's
+        #: first score over the training frame — reuse the HBM-resident
+        #: columns instead of re-transferring. Shared into WorkflowModel.
+        from transmogrifai_tpu.ingest_fusion import DeviceFrameCache
+        self._frame_cache = DeviceFrameCache()
 
     def with_workflow_cv(self, enabled: bool = True) -> "Workflow":
         """Leakage-free workflow-level CV (reference ``withWorkflowCV``):
@@ -235,6 +241,9 @@ class Workflow:
                 result, filter_results.map_key_blocklist
                 if filter_results is not None else {})
         data = PipelineData.from_host(frame)
+        from transmogrifai_tpu.ingest_fusion import frame_cache_enabled
+        if frame_cache_enabled():
+            data = self._frame_cache.adopt(frame, data)
         executor = DagExecutor()
         ckpt = None
         ckpt_overrides: dict[str, Any] = {}
@@ -304,12 +313,17 @@ class Workflow:
         finally:
             for s in patched_selectors:
                 s.checkpoint_dir = None
-        return WorkflowModel(
+        model = WorkflowModel(
             result_features=result,
             raw_features=raw, dag=fitted, executor=executor,
             blocklisted=blocklist,
             label_distribution=_label_distribution(frame, raw),
             raw_filter_results=filter_results)
+        # the model scores through the same device-frame cache: a
+        # train-then-score session over the training frame (holdout
+        # evaluation, insights) never re-uploads identical host columns
+        model._frame_cache = self._frame_cache
+        return model
 
     @staticmethod
     def _apply_map_key_blocklist(result, map_key_blocklist: dict) -> None:
@@ -344,7 +358,16 @@ class Workflow:
         checkpoint is active — persisted before the next layer starts, so
         a crash loses at most the in-flight layer. ``fault_point
         ("train.layer")`` fires at each layer start: the deterministic
-        preemption site the chaos suite kills training at."""
+        preemption site the chaos suite kills training at.
+
+        Note on FE fusion (round 14): this loop deliberately feeds
+        ``fit_transform`` ONE layer at a time — the per-layer fault-point
+        and checkpoint granularity is the chaos/resume contract — so
+        cross-layer fusion here is bounded to within a layer. The
+        multi-layer fused programs fire where whole fitted DAGs replay:
+        ``executor.transform`` (scoring, CV validation transforms) and
+        the selector's per-fold during-DAG ``fit_transform`` over the
+        full multi-layer cut (``fit_with_dag``)."""
         from transmogrifai_tpu.stages.base import Estimator
         from transmogrifai_tpu.utils.faults import fault_point
         from transmogrifai_tpu.utils.profiling import run_counters
@@ -407,9 +430,17 @@ class WorkflowModel:
         #: RawFeatureFilterResults (or None) — exclusion reasons incl.
         #: per-key map blocklists, surfaced in summary/ModelInsights
         self.raw_filter_results = raw_filter_results
+        #: device-frame cache shared from the training Workflow (or a
+        #: fresh one for loaded models): identical host frames skip the
+        #: host->device re-transfer at scoring time
+        from transmogrifai_tpu.ingest_fusion import DeviceFrameCache
+        self._frame_cache = DeviceFrameCache()
 
     # -- scoring -------------------------------------------------------------
-    def _ingest(self, reader_or_frame) -> PipelineData:
+    def _ingest_frame(self, reader_or_frame) -> fr.HostFrame:
+        """HOST half of ingest: raw-feature frame generation only (no jax
+        work) — safe to run on the streaming prefetch thread while the
+        device executes the previous batch's FE program."""
         if isinstance(reader_or_frame, fr.HostFrame):
             reader: DataReader = CustomReader(frame=reader_or_frame)
         else:
@@ -449,8 +480,24 @@ class WorkflowModel:
                     f"Scoring data lacks predictor columns {missing_required}")
             raw = [f for f in raw
                    if not column_read(f) or f.name in available]
-        frame = reader.generate_frame(raw)
-        return PipelineData.from_host(frame)
+        return reader.generate_frame(raw)
+
+    def _ingest(self, reader_or_frame) -> PipelineData:
+        return self._wrap_frame(self._ingest_frame(reader_or_frame))
+
+    def _wrap_frame(self, frame: fr.HostFrame) -> PipelineData:
+        """DEVICE half of ingest: wrap a generated host frame, consulting
+        the device-frame cache so identical host columns reuse their
+        resident device arrays. Scoring consults via the O(columns)
+        identity memo only (``register=False``): the train-then-score
+        flow hits (the training frame's column objects are registered at
+        ``train()``), while a stream of distinct micro-batches never pays
+        the O(rows) content hash."""
+        from transmogrifai_tpu.ingest_fusion import frame_cache_enabled
+        data = PipelineData.from_host(frame)
+        if frame_cache_enabled():
+            data = self._frame_cache.adopt(frame, data, register=False)
+        return data
 
     def transform(self, reader_or_frame) -> PipelineData:
         from transmogrifai_tpu.utils.tracing import span
