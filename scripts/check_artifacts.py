@@ -97,6 +97,98 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_devicewatch_overhead(doc))
     if doc.get("metric") == "ingest_fe_fusion":
         errors.extend(_validate_ingest_fe_fusion(doc))
+    if doc.get("metric") == "explain_overhead":
+        errors.extend(_validate_explain_overhead(doc))
+    return errors
+
+
+#: round-15 acceptance bounds for line-rate explainability: served
+#: attributions must match the offline RecordInsightsLOCO path within
+#: MAX_EXPLAIN_PARITY, and explained traffic may cost at most
+#: MAX_EXPLAIN_OVERHEAD_X the plain-scoring latency (G masked forward
+#: passes amortized into one compiled program — the whole point of the
+#: compiled path is that this factor stays modest)
+MAX_EXPLAIN_PARITY = 1e-5
+MAX_EXPLAIN_OVERHEAD_X = 25.0
+
+
+def _validate_explain_overhead(doc: dict) -> list[str]:
+    """The ``benchmarks/EXPLAIN_OVERHEAD.json`` contract: explained
+    traffic served through the live fleet with a measured plain-vs-
+    explained cost, exact-ish (<= MAX_EXPLAIN_PARITY) parity vs the
+    offline LOCO stage, ZERO post-warmup compiles per (lane, bucket),
+    and explanations surviving a mid-run hot-swap with the promoted
+    version's lineage stamped."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    for leg in ("plain", "explained"):
+        block = doc.get(leg)
+        if not (isinstance(block, dict) and num(block.get("rps"))
+                and block.get("rps", 0) > 0
+                and num(block.get("p50_ms")) and num(block.get("p99_ms"))):
+            errors.append(f"explain-overhead artifact: '{leg}' must "
+                          "record positive 'rps' + 'p50_ms'/'p99_ms'")
+    overhead = doc.get("overhead_x")
+    if not (num(overhead) and overhead > 0):
+        errors.append("explain-overhead artifact: missing positive "
+                      "'overhead_x' (plain rps / explained rps)")
+    elif overhead > MAX_EXPLAIN_OVERHEAD_X:
+        errors.append(
+            f"explain overhead bound violated: explained traffic costs "
+            f"{overhead}x plain scoring, over the "
+            f"{MAX_EXPLAIN_OVERHEAD_X:g}x bound — the compiled LOCO "
+            "path is not earning its keep")
+    parity = doc.get("parity_vs_offline_loco")
+    if not num(parity):
+        errors.append("explain-overhead artifact: missing "
+                      "'parity_vs_offline_loco' (max |served - offline| "
+                      "attribution delta)")
+    elif parity > MAX_EXPLAIN_PARITY:
+        errors.append(
+            f"explain parity violated: served attributions diverge "
+            f"from the offline RecordInsightsLOCO path by {parity} > "
+            f"{MAX_EXPLAIN_PARITY:g}")
+    if not (isinstance(doc.get("parity_rows"), int)
+            and not isinstance(doc.get("parity_rows"), bool)
+            and doc["parity_rows"] > 0):
+        errors.append("explain-overhead artifact: missing positive int "
+                      "'parity_rows'")
+    if not (isinstance(doc.get("groups"), int)
+            and not isinstance(doc.get("groups"), bool)
+            and doc.get("groups", 0) >= 2):
+        errors.append("explain-overhead artifact: 'groups' must be an "
+                      "int >= 2 (a one-group LOCO explains nothing)")
+    storm = doc.get("compile_storm")
+    if not isinstance(storm, dict) \
+            or not isinstance(storm.get("max_post_warmup_per_bucket"), int) \
+            or isinstance(storm.get("max_post_warmup_per_bucket"), bool):
+        errors.append("explain-overhead artifact: 'compile_storm."
+                      "max_post_warmup_per_bucket' must be an int")
+    elif storm["max_post_warmup_per_bucket"] > 0:
+        errors.append(
+            "compile-storm bound violated: "
+            f"{storm['max_post_warmup_per_bucket']} post-warmup "
+            "compile(s) in some (lane, bucket) — steady-state explained "
+            "traffic recompiled")
+    swap = doc.get("swap")
+    if not (isinstance(swap, dict) and isinstance(swap.get("promoted"),
+                                                  str)
+            and swap.get("promoted")
+            and swap.get("zero_dropped") is True
+            and isinstance(swap.get("post_swap_lineage"), str)):
+        errors.append("explain-overhead artifact: 'swap' must record the "
+                      "'promoted' version, 'zero_dropped': true, and the "
+                      "'post_swap_lineage' version explained replies "
+                      "carried afterwards")
+    elif swap["post_swap_lineage"] != swap["promoted"]:
+        errors.append(
+            f"post-swap explained replies carried lineage "
+            f"{swap['post_swap_lineage']!r}, not the promoted "
+            f"{swap['promoted']!r} — explanations did not survive the "
+            "hot-swap on the new version")
     return errors
 
 

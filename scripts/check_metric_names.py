@@ -135,12 +135,33 @@ def collect_violations() -> list[str]:
 
     out.extend(check_registry(build_registry(serving=serving)))
 
+    # the explain-lane registry (round 15): the transmogrifai_explain_*
+    # series over a structural server stand-in whose explain lane is
+    # hot (admissions, a dispatched batch, per-bucket compiles) so every
+    # collector closure renders real samples — standalone (unlabeled)
+    # AND fleet (model-labeled) variants both lint.
+    import types
+
+    explain_metrics = ServingMetrics(max_samples=16)
+    explain_metrics.record_admitted(2)
+    explain_metrics.record_requests_done([(0.01, True), (0.4, True)])
+    explain_metrics.record_batch(2, 0.02)
+    xc = ServingCounters()
+    xc.count(8, dispatches=1, compiles=1)
+    explain_metrics.compile_counters = xc
+    out.extend(check_json_doc(
+        explain_metrics.snapshot(mirror_to_profiler=False),
+        "ServingMetrics.snapshot[explain]"))
+    explainer_stub = types.SimpleNamespace(mask_chunk=32, n_groups=9)
+    server_stub = types.SimpleNamespace(explain_metrics=explain_metrics,
+                                        explainer=explainer_stub)
+    out.extend(check_registry(build_registry(serving=serving,
+                                             server=server_stub)))
+
     # the fleet registry: the same serving series model-labeled per lane
     # plus the transmogrifai_fleet_* swap/cache surface. A structural
     # stand-in (real metrics objects, no trained models) keeps the lint
     # fast while every collector closure still renders real samples.
-    import types
-
     from transmogrifai_tpu.serving.fleet import FleetMetrics, ProgramCache
 
     fleet_metrics = FleetMetrics()
@@ -154,7 +175,9 @@ def collect_violations() -> list[str]:
                               "FleetMetrics.to_json"))
     out.extend(check_json_doc({"cache": cache.to_json()},
                               "ProgramCache.to_json"))
-    lane = types.SimpleNamespace(metrics=serving, state="ready")
+    lane = types.SimpleNamespace(metrics=serving, state="ready",
+                                 explain_metrics=explain_metrics,
+                                 explainer=explainer_stub)
     fleet = types.SimpleNamespace(
         metrics=fleet_metrics, program_cache=cache,
         active_lanes=lambda: {"churn": lane})

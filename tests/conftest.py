@@ -63,3 +63,29 @@ def mesh4x2():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Slowest-MODULE report (tier-1 wall guard): pytest's --durations
+    lists individual tests, but the budget that matters is per module —
+    the suite runs ~30s under the tier-1 timeout, so a module-level wall
+    regression must be visible in every run's tail, not discovered when
+    the timeout bites. Aggregates setup+call+teardown per test FILE."""
+    per_module: dict = {}
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            dur = getattr(rep, "duration", None)
+            path = getattr(rep, "fspath", None) or getattr(
+                rep, "location", (None,))[0]
+            if dur is None or not path:
+                continue
+            per_module[path] = per_module.get(path, 0.0) + dur
+    if not per_module:
+        return
+    top = sorted(per_module.items(), key=lambda kv: -kv[1])[:15]
+    total = sum(per_module.values())
+    terminalreporter.write_sep(
+        "=", f"slowest modules (sum {total:.0f}s across "
+             f"{len(per_module)} files)")
+    for path, dur in top:
+        terminalreporter.write_line(f"{dur:8.1f}s  {path}")

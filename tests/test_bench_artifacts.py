@@ -637,3 +637,59 @@ def test_ingest_fe_fusion_artifact_committed_and_healthy(checker):
     assert art["fused_disabled"]["fused_programs"] == 0
     assert art["fused_disabled"]["bitwise_equal"] is True
     assert art["counters"]["fused_leg"]["feFusedPrograms"] >= 1
+
+
+def _explain_overhead_good():
+    return {
+        "metric": "explain_overhead", "platform": "cpu", "requests": 2000,
+        "plain_rps": 230.0, "explained_rps": 210.0,
+        "plain": {"rps": 230.0, "p50_ms": 4.2, "p99_ms": 6.4},
+        "explained": {"rps": 210.0, "p50_ms": 4.5, "p99_ms": 7.2},
+        "overhead_x": 1.1, "parity_vs_offline_loco": 5e-7,
+        "parity_rows": 24, "groups": 7,
+        "compile_storm": {"max_post_warmup_per_bucket": 0},
+        "swap": {"promoted": "v2", "zero_dropped": True,
+                 "post_swap_lineage": "v2", "wall_s": 0.1},
+    }
+
+
+def test_explain_overhead_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _explain_overhead_good()
+    assert v(good) == []
+    assert any("parity" in e for e in v(
+        {**good, "parity_vs_offline_loco": 1e-3}))
+    assert any("overhead" in e for e in v({**good, "overhead_x": 100.0}))
+    assert any("compile-storm" in e for e in v(
+        {**good, "compile_storm": {"max_post_warmup_per_bucket": 2}}))
+    assert any("groups" in e for e in v({**good, "groups": 1}))
+    assert any("rps" in e for e in v(
+        {**good, "explained": {"rps": 0, "p50_ms": 1, "p99_ms": 2}}))
+    swap = good["swap"]
+    assert any("lineage" in e for e in v(
+        {**good, "swap": {**swap, "post_swap_lineage": "v1"}}))
+    assert any("swap" in e for e in v(
+        {**good, "swap": {**swap, "zero_dropped": False}}))
+    assert any("swap" in e for e in v(
+        {**good, "swap": {**swap, "promoted": ""}}))
+
+
+def test_explain_overhead_artifact_committed_and_healthy(checker):
+    """The round-15 acceptance contract on the COMMITTED artifact:
+    explained traffic through the live fleet with parity <= 1e-5 vs the
+    offline LOCO path, a bounded measured overhead, ZERO post-warmup
+    compiles per (lane, bucket), and explanations surviving the mid-run
+    hot-swap with the promoted version's lineage."""
+    path = os.path.join(REPO, "benchmarks", "EXPLAIN_OVERHEAD.json")
+    assert os.path.exists(path), \
+        "benchmarks/EXPLAIN_OVERHEAD.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "explain_overhead"
+    assert art["parity_vs_offline_loco"] <= checker.MAX_EXPLAIN_PARITY
+    assert art["overhead_x"] <= checker.MAX_EXPLAIN_OVERHEAD_X
+    assert art["compile_storm"]["max_post_warmup_per_bucket"] == 0
+    assert art["swap"]["zero_dropped"] is True
+    assert art["swap"]["post_swap_lineage"] == art["swap"]["promoted"]
+    assert art["groups"] >= 2 and art["parity_rows"] > 0
+    assert art["ok"] is True

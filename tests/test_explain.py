@@ -1,0 +1,289 @@
+"""Line-rate explainability (round 15): compiled LOCO through the
+serving stack — parity vs the offline ``RecordInsightsLOCO`` path,
+program-cache bounds (both the serving explain programs and the offline
+LOCO program cache), OOM mask-chunk rungs, the HTTP ``explain`` field
+with lineage, hot-swap survival, and router passthrough.
+
+ONE module-scoped trained model backs every case (tier-1 wall budget:
+this file must stay lean)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+N = 160
+
+
+def _train(max_iter: int = 25):
+    from transmogrifai_tpu.uid import UID
+    UID.reset()  # versions of one endpoint share feature names
+    rng = np.random.default_rng(5)
+    x1 = rng.normal(size=N)
+    x2 = rng.normal(size=N)
+    color = rng.choice(["red", "green", "blue"], size=N)
+    logit = 1.6 * x1 - x2 + (color == "red") * 1.3
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-logit))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "color": (ft.PickList, color.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"], feats["color"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=max_iter), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "color": str(color[i])} for i in range(N)]
+    return model, rows, frame
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _train()
+
+
+def _pred_stage(model):
+    pred_f = model._prediction_feature()
+    for t in model.stages():
+        if t.get_output() == pred_f:
+            return t, t.runtime_input_names()[-1]
+    raise AssertionError("no prediction stage")
+
+
+def _offline_deltas(model, frame, rows_idx, top_k=500):
+    from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
+    pstage, vec_name = _pred_stage(model)
+    col = model.transform(frame).host_col(vec_name)
+    vals = RecordInsightsLOCO(model=pstage,
+                              top_k=top_k).host_apply(col).values
+    return [{k: float(v) for k, v in vals[i].items()} for i in rows_idx]
+
+
+def test_compiled_explainer_parity_vs_offline_loco(fitted):
+    """Served attributions == offline RecordInsightsLOCO deltas (the
+    acceptance bound the committed artifact also gates)."""
+    from transmogrifai_tpu.serving.explain import CompiledExplainer
+    model, rows, frame = fitted
+    ex = CompiledExplainer(model, top_k=500, max_batch=16, min_bucket=8)
+    docs, exps = ex.explain_batch(rows[:6])
+    offline = _offline_deltas(model, frame, range(6))
+    assert len(docs) == 6 and len(exps) == 6
+    for served, ref in zip(exps, offline):
+        assert served, "no attributions served"
+        for e in served:
+            assert e["name"] in ref
+            assert abs(e["delta"] - ref[e["name"]]) <= 1e-5
+        # ordering: |delta| non-increasing (offline Abs strategy)
+        mags = [abs(e["delta"]) for e in served]
+        assert mags == sorted(mags, reverse=True)
+
+
+def test_explain_program_cache_bounds_and_reuse(fitted):
+    """Explain programs are padded-bucket bounded: repeat traffic at any
+    admitted size compiles nothing new, and the private program dict
+    holds one explain entry per (layer-run, chunk) plus the plain
+    layers."""
+    from transmogrifai_tpu.serving.explain import CompiledExplainer
+    model, rows, _ = fitted
+    ex = CompiledExplainer(model, top_k=3, max_batch=16, min_bucket=8)
+    ex.warmup(rows[0])
+    warm = dict(ex.counters.compiles_by_bucket())
+    for n in (1, 3, 8, 11, 16, 2, 16):
+        docs, exps = ex.explain_batch(rows[:n])
+        assert len(docs) == n and len(exps) == n
+    assert dict(ex.counters.compiles_by_bucket()) == warm, \
+        "steady-state explained traffic recompiled"
+
+
+def test_offline_loco_program_cache_reuse(fitted):
+    """Satellite regression: repeated ``host_apply`` batches and
+    ``transform_row`` calls reuse ONE compiled program per shape instead
+    of re-tracing the masked-score closure every invocation."""
+    from transmogrifai_tpu.insights.loco import (
+        RecordInsightsLOCO, loco_programs,
+    )
+    model, rows, frame = fitted
+    pstage, vec_name = _pred_stage(model)
+    col = model.transform(frame).host_col(vec_name)
+    X = np.asarray(col.values, np.float32)
+    sub = fr.HostColumn(ft.OPVector, X[:32], meta=col.meta)
+    loco = RecordInsightsLOCO(model=pstage, top_k=4)
+    loco_programs.clear()
+    a = loco.host_apply(sub).values
+    s1 = loco_programs.stats()
+    assert s1["insertions"] == 1
+    # same shape again — a pure hit, even from a NEW stage instance
+    b = RecordInsightsLOCO(model=pstage, top_k=4).host_apply(sub).values
+    s2 = loco_programs.stats()
+    assert s2["insertions"] == 1 and s2["hits"] >= 1
+    assert list(a[0].items()) == list(b[0].items())
+    # transform_row: one [1, d] program shared across rows
+    r1 = loco.transform_row(X[0])
+    loco.transform_row(X[1])
+    loco.transform_row(X[2])
+    s3 = loco_programs.stats()
+    assert s3["insertions"] == 2  # the single [1, d] entry
+    assert s3["hits"] >= s2["hits"] + 2
+    assert r1  # non-empty insight map
+    # Avg strategy caches separately, keyed on its chunking
+    RecordInsightsLOCO(model=pstage, top_k=4,
+                       aggregation_strategy="Avg").host_apply(sub)
+    assert loco_programs.stats()["insertions"] == 3
+
+
+def test_explain_oom_rung_halves_mask_chunk(fitted):
+    """Resource ladder at site serving.explain: an OOM explain dispatch
+    halves the mask-chunk width and re-serves the SAME batch — same
+    attributions, request settles, degradation counted."""
+    from transmogrifai_tpu.serving.server import ScoringServer
+    from transmogrifai_tpu.utils.faults import fault_plan
+    from transmogrifai_tpu.utils.resources import resource_counters
+    model, rows, _ = fitted
+    # default mask_chunk (64) >> group count: the rung must halve the
+    # EFFECTIVE chunk (the width programs were traced at), not the raw
+    # knob — regression for the no-op-rung keying mismatch
+    with ScoringServer(model, max_batch=16, min_bucket=16, explain=True,
+                       explain_top_k=4, retries=1) as srv:
+        srv.start(warmup_row=rows[0])
+        clean = srv.explain(rows[3], timeout_s=60)
+        before = resource_counters.degradations_by_site.get(
+            "serving.explain", 0)
+        n_groups = srv.explainer.n_groups
+        assert srv.explainer.effective_mask_chunk() == n_groups
+        with fault_plan("oom@serving.explain#0"):
+            doc = srv.explain(rows[3], timeout_s=60)
+        assert srv.explainer.mask_chunk == n_groups // 2
+        assert srv.explainer.effective_mask_chunk() == n_groups // 2
+        assert resource_counters.degradations_by_site.get(
+            "serving.explain", 0) == before + 1
+        assert doc["explanations"], "rung retry lost the attributions"
+        got = {e["name"]: e["delta"] for e in doc["explanations"]}
+        ref = {e["name"]: e["delta"] for e in clean["explanations"]}
+        assert set(got) == set(ref)
+        for k, v in got.items():
+            assert abs(v - ref[k]) <= 1e-6
+        # post-rung traffic keeps serving compiled at the smaller chunk
+        assert srv.explain(rows[4], timeout_s=60)["explanations"]
+        assert srv.explain_metrics.degraded_batches == 0
+
+
+def test_fleet_http_explain_field_lineage_and_hot_swap(fitted):
+    """The end-to-end surface: POST /score with {"explain": K} returns
+    top-K attributions + trace id + lineage; plain requests carry no
+    explanations; a mid-run hot-swap keeps explaining with the PROMOTED
+    version's lineage; the scrape exposes transmogrifai_explain_*."""
+    import http.client
+
+    from transmogrifai_tpu.serving import FleetServer
+    model, rows, _ = fitted
+    v2_model, _, _ = _train(max_iter=26)
+    fleet = FleetServer(max_batch=16, min_bucket=16, shadow_rows=4,
+                        metrics_port=0, explain=True, explain_top_k=3)
+    fleet.register(model=model, model_id="m")
+    fleet.start(warmup_rows={"m": rows[0]})
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          fleet.metrics_http.port,
+                                          timeout=30)
+
+        def post(row):
+            conn.request("POST", "/score/m", json.dumps(row).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp, json.loads(resp.read())
+
+        resp, plain = post(dict(rows[1]))
+        assert resp.status == 200 and "explanations" not in plain
+        resp, doc = post({**rows[1], "explain": 2})
+        assert resp.status == 200
+        assert len(doc["explanations"]) <= 2 and doc["explanations"]
+        assert doc["traceId"] and doc["lineage"]["version"] == "v1"
+        # keep some live rows flowing so the swap's shadow gate has feed
+        for r in rows[2:6]:
+            post({**r, "explain": True})
+        fleet.hot_swap("m", model=v2_model, tolerance=1.0)
+        resp, doc2 = post({**rows[1], "explain": True})
+        assert resp.status == 200 and doc2["explanations"]
+        assert doc2["lineage"]["version"] == "v2"
+        lane = fleet.active_lanes()["m"]
+        assert lane.post_warmup_explain_compiles() == {}
+        # scrape: the explain series render model-labeled
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode()
+        assert "transmogrifai_explain_requests_admitted_total" in body
+        assert 'model="m"' in body
+        assert "transmogrifai_explain_latency_seconds_bucket" in body
+        conn.close()
+    finally:
+        fleet.stop()
+
+
+def test_router_passes_explain_field_through(fitted):
+    """Scale-out passthrough: the router proxies request bodies
+    verbatim, so the explain directive reaches the replica unchanged."""
+    import http.client
+
+    from transmogrifai_tpu.scaleout.router import Router
+    from transmogrifai_tpu.serving.http import MetricsServer
+    seen = {}
+
+    def score(mid, row, tid):
+        seen.update(row)
+        return {"ok": True, "explain_seen": row.get("explain")}
+
+    srv = MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                        score_fn=score, port=0).start()
+    router = Router(port=0).start()
+    try:
+        router.set_replica("r0", srv.port)
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("POST", "/score/m1",
+                     json.dumps({"x": 1.0, "explain": 5}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200 and body["explain_seen"] == 5
+        assert seen.get("explain") == 5
+        conn.close()
+    finally:
+        router.stop()
+        srv.stop()
+
+
+def test_explain_snapshot_and_disabled_lane(fitted):
+    """Snapshot carries the explain block; submit_explain without the
+    lane is a loud ValueError; per-request top-K overrides the lane
+    default."""
+    from transmogrifai_tpu.serving.server import ScoringServer
+    model, rows, _ = fitted
+    with ScoringServer(model, max_batch=16, min_bucket=16,
+                       explain=True, explain_top_k=2) as srv:
+        srv.start(warmup_row=rows[0])
+        d_default = srv.explain(rows[2], timeout_s=60)
+        d_wide = srv.explain(rows[2], top_k=500, timeout_s=60)
+        assert len(d_default["explanations"]) <= 2
+        assert len(d_wide["explanations"]) > len(d_default["explanations"])
+        snap = srv.snapshot()
+        assert snap["explain"]["config"]["topK"] == 2
+        assert snap["explain"]["requests"]["completed"] >= 2
+        assert snap["explain"]["postWarmupCompiles"] == {}
+    with ScoringServer(model, max_batch=16) as srv2:
+        with pytest.raises(ValueError):
+            srv2.submit_explain(rows[0])

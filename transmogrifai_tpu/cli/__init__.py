@@ -38,11 +38,19 @@ def main(argv=None) -> int:
     from transmogrifai_tpu.cli.scaleout import (
         add_scaleout_args, run_scaleout,
     )
+    from transmogrifai_tpu.cli.explain import (
+        add_explain_args, run_explain,
+    )
     from transmogrifai_tpu.cli.serve import add_serve_args, run_serve
     from transmogrifai_tpu.cli.slo import add_slo_args, run_slo
     add_serve_args(sub.add_parser(
         "serve", help="online micro-batched scoring over a saved model "
-                      "(jsonl/csv in, jsonl scores out)"))
+                      "(jsonl/csv in, jsonl scores out); "
+                      "--explain-top-k adds per-request LOCO "
+                      "attributions"))
+    add_explain_args(sub.add_parser(
+        "explain", help="batch explainability: ModelInsights report + "
+                        "per-row LOCO insight maps over a saved model"))
     add_scaleout_args(sub.add_parser(
         "scaleout", help="multi-process serving scale-out: consistent-"
                          "hash router + N replica fleet workers + "
@@ -70,6 +78,8 @@ def main(argv=None) -> int:
         return run_shell()
     if args.command == "serve":
         return run_serve(args)
+    if args.command == "explain":
+        return run_explain(args)
     if args.command == "scaleout":
         return run_scaleout(args)
     if args.command == "continuous":

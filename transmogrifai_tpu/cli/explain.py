@@ -1,0 +1,118 @@
+"""``transmogrifai_tpu explain`` — batch explainability over a saved model.
+
+Two outputs from one fitted checkpoint:
+
+- the merged **ModelInsights report** (``insights/model_insights.py``:
+  selected model + validation table, top contributions, label
+  correlations, SanityChecker drops, sensitive features) — printed as a
+  pretty table by default, ``--json`` for the full document;
+- with ``--input``, per-row **LOCO record insights**
+  (``insights/loco.py``) over a jsonl/csv request file: one JSON line of
+  ``{group name: delta}`` per input row, through the cached compiled
+  LOCO programs (repeat batches are pure program-cache hits).
+
+    python -m transmogrifai_tpu.cli explain --model model_dir \
+        --input requests.jsonl --output insights.jsonl --top-k 10
+
+The line-rate twin of this offline surface is ``serve --explain-top-k``
+(and the HTTP ``{"explain": true}`` field) — see docs/INSIGHTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["add_explain_args", "run_explain"]
+
+
+def add_explain_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("--model", required=True,
+                    help="saved model directory (serialization.save_model)")
+    sp.add_argument("--input", default=None,
+                    help="request rows (.jsonl / .csv, or '-' for stdin): "
+                         "emit per-row LOCO insight maps")
+    sp.add_argument("--output", default="-",
+                    help="insights jsonl path, or '-' for stdout")
+    sp.add_argument("--top-k", type=int, default=20,
+                    help="attributions kept per row (default 20)")
+    sp.add_argument("--aggregation", default="LeaveOutVector",
+                    choices=("LeaveOutVector", "Avg"),
+                    help="LOCO group aggregation strategy (reference "
+                         "VectorAggregationStrategy; default "
+                         "LeaveOutVector)")
+    sp.add_argument("--json", action="store_true",
+                    help="print the ModelInsights report as full JSON "
+                         "instead of the pretty tables")
+    sp.add_argument("--no-report", action="store_true",
+                    help="skip the ModelInsights report (LOCO only)")
+
+
+def _read_rows(path: str):
+    from transmogrifai_tpu.cli.serve import _read_rows
+    return _read_rows(path)
+
+
+def run_explain(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.workflow import load_model
+
+    model = load_model(args.model)
+    if not args.no_report:
+        insights = model.model_insights()
+        if args.json:
+            print(insights.json())
+        else:
+            print(insights.pretty())
+    if args.input is None:
+        return 0
+
+    from transmogrifai_tpu.insights.loco import (
+        RecordInsightsLOCO, loco_programs,
+    )
+    from transmogrifai_tpu.serving.explain import resolve_prediction_stage
+    try:
+        pstage, vec_name, _, _ = resolve_prediction_stage(model)
+    except ValueError as e:
+        print(f"explain: {e}", file=sys.stderr)
+        return 2
+    loco = RecordInsightsLOCO(model=pstage, top_k=args.top_k,
+                              aggregation_strategy=args.aggregation)
+
+    rows = list(_read_rows(args.input))
+    if not rows:
+        print("explain: --input holds no rows", file=sys.stderr)
+        return 2
+    from transmogrifai_tpu.types.feature_types import nullable_base
+    raw_names = {f.name for f in model.raw_features}
+    cols: dict = {}
+    for f in model.raw_features:
+        vals = [r.get(f.name) for r in rows]
+        # requests legitimately omit the label (cf. CompiledScorer)
+        ftype = nullable_base(f.ftype) if f.is_response else f.ftype
+        cols[f.name] = fr.HostColumn.from_values(ftype, vals)
+    unknown = set(rows[0]) - raw_names
+    if unknown:
+        print(f"# ignoring non-raw request keys: {sorted(unknown)}",
+              file=sys.stderr)
+    data = model.transform(fr.HostFrame(cols))
+    insight_col = loco.host_apply(data.host_col(vec_name))
+
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        for m in insight_col.values:
+            out.write(json.dumps(
+                {k: float(v) for k, v in sorted(
+                    m.items(), key=lambda kv: -abs(float(kv[1])))},
+                default=str) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    stats = loco_programs.stats()
+    print(f"# explained {len(rows)} rows through {stats['insertions']} "
+          f"compiled LOCO program(s) ({stats['hits']} cache hits)",
+          file=sys.stderr)
+    return 0

@@ -90,6 +90,13 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                     help="skip admission-time raw-key validation")
     sp.add_argument("--no-warmup", action="store_true",
                     help="skip padding-bucket warmup before traffic")
+    sp.add_argument("--explain-top-k", type=int, default=None,
+                    help="serve every request through the EXPLAIN lane: "
+                         "each output line gains an ordered "
+                         "'explanations' list of the top-K LOCO "
+                         "attributions (docs/INSIGHTS.md). HTTP scoring "
+                         "(--metrics-port, fleet mode) also accepts an "
+                         "opt-in per-request {\"explain\": true|K} field")
     sp.add_argument("--metrics-port", type=int, default=None,
                     help="serve GET /metrics (Prometheus exposition) and "
                          "/healthz on this port while scoring (0 = "
@@ -208,12 +215,15 @@ def run_serve(args: argparse.Namespace) -> int:
     if args.model_dir is not None:
         return _run_serve_fleet(args, slo)
     model = load_model(args.model)
+    explaining = args.explain_top_k is not None
     server = ScoringServer(
         model, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_capacity=args.queue_capacity,
         default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
         metrics_port=args.metrics_port, metrics_host=args.metrics_host,
-        access_log_sample=args.access_log_sample, slo=slo)
+        access_log_sample=args.access_log_sample, slo=slo,
+        explain=explaining,
+        explain_top_k=args.explain_top_k if explaining else 5)
 
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     t0 = time.monotonic()
@@ -250,7 +260,10 @@ def run_serve(args: argparse.Namespace) -> int:
                 server.start(warmup_row=row)  # non-fatal on a bad row
                 warmed = True
             try:
-                window.append((i, server.submit_blocking(row)))
+                if explaining:
+                    window.append((i, server.submit_explain_blocking(row)))
+                else:
+                    window.append((i, server.submit_blocking(row)))
             except KeyError as e:  # strict admission reject
                 window.append((i, e))
             n += 1
@@ -272,7 +285,9 @@ def run_serve(args: argparse.Namespace) -> int:
     if args.metrics:
         with open(args.metrics, "w") as fh:
             json.dump(snap, fh, indent=2)
-    lat = snap["latencyMs"]
+    # explained replays flow through the explain lane: its latencies are
+    # the ones the operator asked to see
+    lat = snap["explain"]["latencyMs"] if explaining else snap["latencyMs"]
     print(f"# served {n} requests ({n_err} errored) in {wall:.2f}s "
           f"({n / max(wall, 1e-9):.0f} rps), p50={lat['p50']}ms "
           f"p95={lat['p95']}ms p99={lat['p99']}ms "
@@ -284,13 +299,16 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
     """``--model-dir`` mode: many registered models, per-row routing."""
     from transmogrifai_tpu.serving import FleetServer, UnknownModelError
 
+    explaining = args.explain_top_k is not None
+    explain_kw = {"explain": True, "explain_top_k": args.explain_top_k} \
+        if explaining else {}
     fleet = FleetServer(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_capacity=args.queue_capacity,
         default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
         route_field=args.model_field,
         metrics_port=args.metrics_port, metrics_host=args.metrics_host,
-        access_log_sample=args.access_log_sample, slo=slo)
+        access_log_sample=args.access_log_sample, slo=slo, **explain_kw)
     entries = fleet.register_dir(args.model_dir)
     if not entries:
         print(f"serve: no saved models (model.json) under "
@@ -350,7 +368,11 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
                     if lane is not None:
                         lane.start(warmup_row=dict(row))
                     warmed.add(mid)
-                window.append((i, fleet.submit_blocking(mid, row)))
+                if explaining:
+                    window.append(
+                        (i, fleet.submit_explain_blocking(mid, row)))
+                else:
+                    window.append((i, fleet.submit_blocking(mid, row)))
             except (KeyError, UnknownModelError) as e:
                 window.append((i, e))
             n += 1

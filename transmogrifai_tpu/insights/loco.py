@@ -9,10 +9,27 @@ TPU-first: the reference loops per row per column; here the whole batch
 evaluates all G group-masks in ONE vmapped program — ``[G]`` masked forward
 passes over the full ``[n, d]`` matrix, all on device (SURVEY: "TPUs make
 LOCO cheaper than the reference").
+
+Compiled-program reuse (round 15): ``host_apply`` used to rebuild the
+masked-score closure on EVERY call, so each invocation re-traced and
+re-compiled the whole masked sweep — fatal for streaming scoring and the
+line-rate serving path, which call it per batch. Programs now live in a
+process-wide :data:`loco_programs` cache keyed on ``(model fingerprint,
+padded batch rows, d, strategy, group layout)``; batches pad (replicating
+the last row — scoring transforms are row-local) to the next power of two
+so a stream of varying batch sizes touches a LOG-bounded set of shapes,
+and ``transform_row``'s ``[1, d]`` program is one cached entry instead of
+a fresh trace per row. The serving half (``serving/explain.py``) shares
+the grouping/mask helpers here and compiles LOCO *into* the serving DAG's
+padded-bucket programs.
 """
 
 from __future__ import annotations
 
+import collections
+import hashlib
+import json
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -25,12 +42,135 @@ from transmogrifai_tpu.stages.base import HostTransformer
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.vector_metadata import VectorMetadata
 
-__all__ = ["RecordInsightsLOCO"]
+__all__ = ["RecordInsightsLOCO", "loco_groups", "group_masks",
+           "stage_fingerprint", "loco_programs", "LocoProgramCache"]
 
 #: Avg-strategy column-sweep block size: peak memory is
 #: [_AVG_CHUNK_COLS, n, d] masked inputs when XLA can't fuse the mask
 #: into the score fn (gather-based tree predicts at hashed widths)
 _AVG_CHUNK_COLS = 256
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def loco_groups(meta: Optional[VectorMetadata], d: int,
+                aggregate_groups: bool = True
+                ) -> list[tuple[str, list[int]]]:
+    """The LOCO feature-group layout of a ``d``-wide vector: hash/date
+    descriptor columns aggregate per (parent feature, grouping); pivot
+    indicator columns stay individual (like the reference). Without
+    usable metadata every column is its own ``col_<j>`` group. Shared by
+    the offline stage and the serving ``CompiledExplainer``."""
+    if meta is None or meta.size != d:
+        return [(f"col_{j}", [j]) for j in range(d)]
+    if not aggregate_groups:
+        return [(c.make_col_name(), [c.index]) for c in meta.columns]
+    groups: dict[str, list[int]] = {}
+    order: list[str] = []
+    for c in meta.columns:
+        if c.descriptor_value is not None and c.grouping is not None:
+            key = f"{'_'.join(c.parent_feature)}::{c.grouping}"
+        else:
+            key = c.make_col_name()
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(c.index)
+    return [(k, groups[k]) for k in order]
+
+
+def group_masks(groups: Sequence[tuple[str, list[int]]],
+                d: int) -> np.ndarray:
+    """``[G, d]`` float32 leave-one-group-out masks (1 keeps, 0 drops)."""
+    masks = np.ones((len(groups), d), dtype=np.float32)
+    for gi, (_, idxs) in enumerate(groups):
+        masks[gi, idxs] = 0.0
+    return masks
+
+
+def stage_fingerprint(model) -> str:
+    """Content fingerprint of one fitted prediction stage (class + config
+    + parameter bytes) — the LOCO program-cache key component that lets
+    two stage instances over byte-identical fitted models share compiled
+    programs while differently-fitted ones can never collide. Cached on
+    the instance: the param pull + hash runs once per model."""
+    cached = getattr(model, "_loco_fingerprint", None)
+    if cached is not None:
+        return cached
+    h = hashlib.blake2b(digest_size=16)
+    h.update(type(model).__name__.encode())
+    try:
+        h.update(json.dumps(model.config(), sort_keys=True,
+                            default=str).encode())
+    except Exception:  # config is id context only; params still hash (failure-ok)
+        pass
+    for leaf in jax.tree_util.tree_leaves(model.device_params()):
+        h.update(np.asarray(leaf).tobytes())
+    fp = h.hexdigest()
+    try:
+        model._loco_fingerprint = fp
+    except Exception:  # unwritable stage: recompute next call (failure-ok)
+        pass
+    return fp
+
+
+class LocoProgramCache:
+    """Process-wide LRU of compiled LOCO programs.
+
+    Keyed ``(model fingerprint, n_pad, d, strategy, G[, chunk])`` — the
+    full jit-shape identity of one masked-sweep program. ``hits`` /
+    ``insertions`` make program reuse counter-assertable (tests and the
+    serving bench require repeat batches to be pure hits)."""
+
+    def __init__(self, max_entries: int = 64):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._programs: "collections.OrderedDict" = collections.OrderedDict()
+        self.hits = 0
+        self.insertions = 0
+
+    def get(self, key, factory):
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                self.hits += 1
+                return prog
+        prog = factory()
+        with self._lock:
+            if key not in self._programs:
+                self._programs[key] = prog
+                self.insertions += 1
+                while len(self._programs) > self.max_entries:
+                    self._programs.popitem(last=False)
+            else:  # racing factory: keep the first inserted program
+                prog = self._programs[key]
+                self.hits += 1
+        return prog
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self.hits = 0
+            self.insertions = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._programs), "hits": self.hits,
+                    "insertions": self.insertions}
+
+
+#: the process-wide compiled-LOCO-program cache
+loco_programs = LocoProgramCache()
 
 
 class RecordInsightsLOCO(HostTransformer):
@@ -59,36 +199,25 @@ class RecordInsightsLOCO(HostTransformer):
         #: reference TopKStrategy: Abs = top-k by |delta|;
         #: PositiveNegative = top k/2 positive + top k/2 negative
         self.top_k_strategy = top_k_strategy
+        #: static device operands (group masks / segment maps) keyed by
+        #: the group layout — a stream of same-schema batches re-uploads
+        #: nothing (the [G, d] mask matrix is the expensive part)
+        self._op_cache: dict = {}
         super().__init__(uid=uid)
 
     # -- grouping ------------------------------------------------------------
     def _groups(self, meta: Optional[VectorMetadata], d: int
                 ) -> list[tuple[str, list[int]]]:
-        if meta is None or meta.size != d:
-            return [(f"col_{j}", [j]) for j in range(d)]
-        if not self.aggregate_groups:
-            return [(c.make_col_name(), [c.index]) for c in meta.columns]
-        groups: dict[str, list[int]] = {}
-        order: list[str] = []
-        for c in meta.columns:
-            # hash/date descriptor columns aggregate per parent feature;
-            # pivot indicator columns stay individual (like the reference)
-            if c.descriptor_value is not None and c.grouping is not None:
-                key = f"{'_'.join(c.parent_feature)}::{c.grouping}"
-            else:
-                key = c.make_col_name()
-            if key not in groups:
-                groups[key] = []
-                order.append(key)
-            groups[key].append(c.index)
-        return [(k, groups[k]) for k in order]
+        return loco_groups(meta, d, self.aggregate_groups)
 
-    # -- scoring -------------------------------------------------------------
-    def _score_fn(self):
+    # -- compiled programs ---------------------------------------------------
+    def _score_expr(self):
+        """The traced positive-class score of one masked input — shared
+        by both strategies' programs. ``params`` ride as operands so the
+        cached program serves any same-fingerprint stage instance."""
         model = self.model
-        params = model.device_params()
 
-        def score(X):
+        def score(params, X):
             out = model.device_apply(params, fr.VectorColumn(X))
             prob = out.probability
             if prob is not None and prob.ndim == 2 and prob.shape[1] >= 2:
@@ -97,59 +226,114 @@ class RecordInsightsLOCO(HostTransformer):
 
         return score
 
+    def _leave_out_program(self):
+        score = self._score_expr()
+
+        def program(params, X, masks):
+            base = score(params, X)                          # [n]
+            return jax.vmap(lambda m: base - score(params, X * m))(
+                masks)                                       # [G, n]
+
+        return jax.jit(program)
+
+    def _avg_program(self, d: int, n_groups: int):
+        score = self._score_expr()
+
+        def program(params, X, col_ids, seg):
+            base = score(params, X)
+
+            def chunk_deltas(js):                            # [chunk] ids
+                cd = jax.vmap(
+                    lambda j: base - score(params, X * (
+                        1.0 - jax.nn.one_hot(j, d, dtype=X.dtype))))(
+                    jnp.minimum(js, d - 1))                  # [chunk, n]
+                return jax.ops.segment_sum(
+                    cd * (js < d)[:, None].astype(X.dtype), seg[js],
+                    num_segments=n_groups + 1)               # [G+1, n]
+
+            return jax.lax.map(chunk_deltas, col_ids).sum(0)[:-1]
+
+        return jax.jit(program)
+
+    # -- scoring -------------------------------------------------------------
+    def _deltas(self, X_host: np.ndarray,
+                groups: Sequence[tuple[str, list[int]]]) -> np.ndarray:
+        """``[n, G]`` LOCO deltas through the cached padded-bucket
+        programs: rows pad (replicating the last row — scoring transforms
+        are row-local, padded slots compute real discarded values) to the
+        next power of two, so streaming batches of every size share a
+        log-bounded program set and ``transform_row`` reuses ONE ``[1,
+        d]`` program across rows."""
+        n, d = X_host.shape
+        n_pad = _next_pow2(n)
+        if n_pad > n:
+            X_host = np.concatenate(
+                [X_host, np.repeat(X_host[-1:], n_pad - n, axis=0)])
+        X = jnp.asarray(X_host)
+        params = self.model.device_params()
+        fp = stage_fingerprint(self.model)
+        if self.aggregation_strategy == "Avg":
+            # per-COLUMN deltas, averaged within each group (reference
+            # Avg strategy). The column sweep is CHUNKED (lax.map over
+            # blocks of an inner vmap): a flat vmap over all d columns
+            # batches the masked input to [d, n, d], which only stays
+            # un-materialized if XLA fuses the mask into the score fn —
+            # for gather-based tree predicts at hashed widths (d ~10k+)
+            # it may not, and the program OOMs. Chunking caps the peak at
+            # [chunk, n, d] while the per-chunk segment-sum keeps the
+            # running result at [G, n].
+            chunk = min(d, _AVG_CHUNK_COLS)  # d >= 1 (zero-width returns
+            layout = (d, chunk,              # before _deltas is called)
+                      tuple((g, tuple(idxs)) for g, idxs in groups))
+            ops = self._op_cache.get(("Avg", layout))
+            if ops is None:
+                group_of = np.zeros(d, np.int32)
+                sizes = np.zeros(len(groups), np.float32)
+                for gi, (_, idxs) in enumerate(groups):
+                    group_of[idxs] = gi
+                    sizes[gi] = len(idxs)
+                n_chunks = -(-d // chunk)
+                pad = n_chunks * chunk - d
+                # padded tail columns map to a scratch segment (dropped)
+                col_ids = jnp.asarray(np.arange(
+                    n_chunks * chunk,
+                    dtype=np.int32).reshape(n_chunks, chunk))
+                seg = jnp.asarray(np.concatenate(
+                    [group_of, np.full((pad,), len(groups), np.int32)]))
+                ops = (col_ids, seg, sizes)
+                self._op_cache = {("Avg", layout): ops}
+            col_ids, seg, sizes = ops
+            prog = loco_programs.get(
+                (fp, n_pad, d, "Avg", len(groups), chunk),
+                lambda: self._avg_program(d, len(groups)))
+            summed = np.asarray(prog(params, X, col_ids,
+                                     seg))                  # [G, n_pad]
+            deltas = summed / sizes[:, None]
+        else:
+            layout = (d, tuple((g, tuple(idxs)) for g, idxs in groups))
+            masks = self._op_cache.get(("LeaveOutVector", layout))
+            if masks is None:
+                masks = jnp.asarray(group_masks(groups, d))
+                # one layout at a time: a schema change replaces the
+                # cache instead of growing it unboundedly
+                self._op_cache = {("LeaveOutVector", layout): masks}
+            prog = loco_programs.get(
+                (fp, n_pad, d, "LeaveOutVector", len(groups)),
+                lambda: self._leave_out_program())
+            deltas = np.asarray(prog(params, X, masks))
+        return deltas[:, :n].T                               # [n, G]
+
     def host_apply(self, *cols: fr.HostColumn) -> fr.HostColumn:
         col = cols[0]
-        X = jnp.asarray(col.values, jnp.float32)
-        n, d = X.shape
+        X_host = np.asarray(col.values, np.float32)
+        n, d = X_host.shape
         meta = col.meta
         groups = self._groups(meta, d)
         if d == 0:  # zero-width vector (e.g. every key blocklisted):
             # nothing to leave out, every row's insight map is empty
             return fr.HostColumn(
                 ft.TextMap, np.array([{} for _ in range(n)], dtype=object))
-        score = self._score_fn()
-        base = score(X)                                     # [n]
-        if self.aggregation_strategy == "Avg":
-            # per-COLUMN deltas, averaged within each group (reference Avg
-            # strategy). The column sweep is CHUNKED (lax.map over blocks
-            # of an inner vmap): a flat vmap over all d columns batches the
-            # masked input to [d, n, d], which only stays un-materialized
-            # if XLA fuses the mask into the score fn — for gather-based
-            # tree predicts at hashed widths (d ~10k+) it may not, and the
-            # program OOMs. Chunking caps the peak at [chunk, n, d] while
-            # the per-chunk segment-sum keeps the running result at [G, n].
-            group_of = np.zeros(d, np.int32)
-            sizes = np.zeros(len(groups), np.float32)
-            for gi, (_, idxs) in enumerate(groups):
-                group_of[idxs] = gi
-                sizes[gi] = len(idxs)
-            chunk = min(d, _AVG_CHUNK_COLS)  # d >= 1 past the early return
-            n_chunks = -(-d // chunk)
-            pad = n_chunks * chunk - d
-            # padded tail columns map to a scratch segment dropped below
-            col_ids = jnp.arange(n_chunks * chunk).reshape(n_chunks, chunk)
-            seg = jnp.concatenate(
-                [jnp.asarray(group_of),
-                 jnp.full((pad,), len(groups), jnp.int32)])
-
-            def chunk_deltas(js):                            # [chunk] ids
-                cd = jax.vmap(
-                    lambda j: base - score(
-                        X * (1.0 - jax.nn.one_hot(j, d, dtype=X.dtype))))(
-                    jnp.minimum(js, d - 1))                  # [chunk, n]
-                return jax.ops.segment_sum(
-                    cd * (js < d)[:, None].astype(X.dtype), seg[js],
-                    num_segments=len(groups) + 1)            # [G+1, n]
-
-            summed = jax.lax.map(chunk_deltas, col_ids).sum(0)[:-1]
-            deltas = np.asarray(summed / jnp.asarray(sizes)[:, None]).T
-        else:
-            masks = np.ones((len(groups), d), dtype=np.float32)
-            for gi, (_, idxs) in enumerate(groups):
-                masks[gi, idxs] = 0.0
-            deltas = jax.vmap(lambda m: base - score(X * m))(
-                jnp.asarray(masks))                          # [G, n]
-            deltas = np.asarray(deltas).T                    # [n, G]
+        deltas = self._deltas(X_host, groups)
         names = [g for g, _ in groups]
         out = np.empty(n, dtype=object)
         for i in range(n):

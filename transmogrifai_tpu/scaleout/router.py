@@ -437,6 +437,10 @@ class Router:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: headers and body flush as separate
+            # writes; Nagle would hold the body for a delayed
+            # ACK (~40ms/request on ACK-delaying kernels)
+            disable_nagle_algorithm = True
 
             def _reply(self, code, body, ctype="application/json",
                        extra=None):

@@ -67,6 +67,9 @@ def main(argv=None) -> int:
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # TCP_NODELAY: the reply body must not wait out a
+        # delayed ACK behind Nagle (~40ms/request)
+        disable_nagle_algorithm = True
 
         def _reply(self, code, doc, extra=None):
             body = (json.dumps(doc) + "\n").encode()

@@ -7,8 +7,12 @@ reproduces the reference's engine-free row closure
 compiled batch program at production request rates. See ``docs/SERVING.md``.
 
 - ``CompiledScorer`` — padding-bucket jit cache over the fused device DAG
+- ``CompiledExplainer`` — the scorer plus per-request LOCO attributions
+  compiled into the same padded-bucket programs (line-rate "why this
+  score"; see ``docs/INSIGHTS.md``)
 - ``MicroBatcher`` — dynamic request coalescing, bounded queue, deadlines
 - ``ScoringServer`` — the service: admission, retry, row-path degradation
+  (+ an opt-in explain lane with its own batcher and metrics)
 - ``ServingMetrics`` — p50/p95/p99 latency, throughput, degradation counters
 - ``ModelRegistry``/``FleetServer``/``ProgramCache`` — the multi-model
   fleet: fingerprint-keyed registry, per-model routed lanes over one
@@ -19,6 +23,7 @@ from transmogrifai_tpu.serving.batcher import (
     BackpressureError, MicroBatcher, RequestTimeout,
 )
 from transmogrifai_tpu.serving.compiled import UNKNOWN_TOKEN, CompiledScorer
+from transmogrifai_tpu.serving.explain import CompiledExplainer
 from transmogrifai_tpu.serving.fleet import (
     FleetServer, ProgramCache, ShadowParityError,
 )
@@ -29,7 +34,8 @@ from transmogrifai_tpu.serving.registry import (
 from transmogrifai_tpu.serving.server import ScoringServer
 
 __all__ = [
-    "BackpressureError", "CompiledScorer", "FleetServer", "MicroBatcher",
+    "BackpressureError", "CompiledExplainer", "CompiledScorer",
+    "FleetServer", "MicroBatcher",
     "ModelRegistry", "ModelState", "ProgramCache", "RequestTimeout",
     "ScoringServer", "ServingMetrics", "ShadowParityError",
     "UNKNOWN_TOKEN", "UnknownModelError",

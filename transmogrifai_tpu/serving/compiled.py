@@ -148,11 +148,8 @@ class CompiledScorer:
             if f.name not in runtime_needed:
                 continue
             ftype = f.ftype
-            if f.is_response and not ftype.is_nullable:
-                ftype = next(b for b in ftype.__mro__
-                             if isinstance(b, type)
-                             and issubclass(b, ft.FeatureType)
-                             and b.is_nullable)
+            if f.is_response:
+                ftype = ft.nullable_base(ftype)
             self._raw.append((f.name, ftype))
         self._programs: dict[int, Any] = {}
         #: warmup-only program cost analysis (utils/devicewatch.py):

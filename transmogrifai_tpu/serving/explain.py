@@ -1,0 +1,359 @@
+"""Compiled per-request explainability: LOCO attributions on the serving
+hot path.
+
+``insights/loco.py`` answers "why this score" offline — a host transformer
+over an already-materialized feature vector. Production serving (ROADMAP
+item 6) needs the same answer at line rate: explanations must ride the
+SAME compiled, padding-bucketed, cache-accounted path as scores, not a
+host-side afterthought that re-traces per batch.
+
+:class:`CompiledExplainer` extends :class:`~transmogrifai_tpu.serving.
+compiled.CompiledScorer` with one extra compiled program per padding
+bucket: the fused program of the PREDICTION layer runs the forward pass
+ONCE (producing the same score outputs the plain path extracts) and, in
+the same jitted program, batches the G leave-one-group-out masked passes
+over the prediction model (``lax.map`` over mask chunks of an inner
+``vmap`` — the chunk width caps peak memory at ``[chunk, n, d]`` masked
+inputs, and is the resource ladder's rung at fault site
+``serving.explain``: OOM halves it and re-serves the same batch).
+
+Cache/fleet semantics carry over unchanged: explain programs live in the
+shared :class:`~transmogrifai_tpu.serving.fleet.ProgramCache` keyed
+``(model fingerprint, ("explain", layer, chunk), padding bucket)`` with
+HBM accounting, so hot-swap eviction, prewarm, and budget pressure treat
+them exactly like scoring programs — and the explainer's NON-prediction
+layers use the same ``(fingerprint, layer, bucket)`` keys as the scoring
+lane, sharing those compiled entries outright.
+
+Feature groups come from the fitted vector's ``VectorMetadata`` through
+the SAME ``loco_groups`` the offline stage uses, so served attributions
+are parity-checkable (<= 1e-5, asserted by ``benchmarks/
+bench_explain_overhead.py``) against ``RecordInsightsLOCO``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.insights.loco import group_masks, loco_groups
+from transmogrifai_tpu.serving.compiled import CompiledScorer
+
+__all__ = ["CompiledExplainer", "resolve_prediction_stage",
+           "DEFAULT_MASK_CHUNK", "MASK_CHUNK_ENV"]
+
+#: default leave-one-group-out mask-chunk width (masks per inner vmap):
+#: peak explain memory is ~[chunk, bucket, d] masked inputs when XLA
+#: can't fuse the mask into the score fn
+DEFAULT_MASK_CHUNK = 64
+
+#: env override for the initial mask-chunk width
+MASK_CHUNK_ENV = "TRANSMOGRIFAI_EXPLAIN_MASK_CHUNK"
+
+
+def resolve_prediction_stage(model) -> tuple:
+    """``(stage, vector input name, prediction output name, layer index)``
+    of the fitted prediction stage — the model whose masked re-scores ARE
+    the LOCO deltas. Raises ``ValueError`` when the workflow has no
+    device prediction stage to explain."""
+    pred_f = model._prediction_feature()
+    for li, layer in enumerate(model.dag):
+        for t in layer:
+            if t.get_output() == pred_f:
+                if not t.is_device:
+                    raise ValueError(
+                        f"prediction stage {type(t).__name__} is not a "
+                        "device stage; compiled explain needs a device "
+                        "prediction model")
+                return t, t.runtime_input_names()[-1], pred_f.name, li
+    raise ValueError("fitted model has no prediction stage to explain")
+
+
+class CompiledExplainer(CompiledScorer):
+    """Jitted columnar batch scorer that ALSO returns top-K LOCO
+    attributions per row.
+
+    ``explain_batch(rows) -> (score_docs, explanations)`` where
+    ``score_docs`` matches ``score_batch``'s contract exactly and
+    ``explanations[i]`` is an ordered ``[{"name", "delta"}, ...]`` top-K
+    list for row i. One instance backs one explain lane (single
+    dispatcher thread), typically sharing its ``program_cache`` and
+    ``fingerprint`` with the scoring lane's ``CompiledScorer``.
+    """
+
+    def __init__(self, model, *, top_k: int = 5,
+                 mask_chunk: Optional[int] = None, **kwargs):
+        super().__init__(model, **kwargs)
+        self.top_k = int(top_k)
+        if mask_chunk is None:
+            env = os.environ.get(MASK_CHUNK_ENV)
+            mask_chunk = int(env) if env else DEFAULT_MASK_CHUNK
+        #: masks per inner vmap — the serving.explain ladder rung halves
+        #: this on OOM (``shrink_mask_chunk``); floor 1
+        self.mask_chunk = max(1, int(mask_chunk))
+        (self._pstage, self._vec_name, self._pred_name,
+         self._pred_li) = resolve_prediction_stage(model)
+        #: resolved on the first explain dispatch from the fitted
+        #: vector's metadata (static per fingerprint): [(name, idxs)]
+        self._groups: Optional[list] = None
+        self._group_names: list[str] = []
+        self._masks_np: Optional[np.ndarray] = None     # [G, d]
+        #: chunk -> device-resident [n_chunks, chunk, d] masks — static
+        #: per chunk width, so steady-state dispatches re-upload nothing
+        self._masks_dev: dict = {}
+        self._vec_d: Optional[int] = None
+
+    # -- group/mask resolution ----------------------------------------------
+    def _resolve_groups(self, vec_col) -> None:
+        d = int(vec_col.values.shape[-1])
+        self._groups = loco_groups(getattr(vec_col, "metadata", None), d)
+        self._group_names = [g for g, _ in self._groups]
+        self._masks_np = group_masks(self._groups, d)
+        self._masks_dev.clear()
+        self._vec_d = d
+
+    @property
+    def n_groups(self) -> Optional[int]:
+        return len(self._groups) if self._groups is not None else None
+
+    def _chunked_masks(self, chunk: int):
+        """``[n_chunks, chunk, d]`` device masks, padded with all-ones
+        rows (delta exactly 0: ``x * 1.0`` is bitwise ``x``) dropped
+        after the program. Static per chunk width: built and uploaded
+        once, reused by every steady-state dispatch."""
+        cached = self._masks_dev.get(chunk)
+        if cached is not None:
+            return cached
+        import jax.numpy as jnp
+        G, d = self._masks_np.shape
+        n_chunks = -(-G // chunk)
+        pad = n_chunks * chunk - G
+        masks = self._masks_np
+        if pad:
+            masks = np.concatenate(
+                [masks, np.ones((pad, d), np.float32)])
+        dev = jnp.asarray(masks.reshape(n_chunks, chunk, d))
+        self._masks_dev[chunk] = dev
+        return dev
+
+    def effective_mask_chunk(self) -> int:
+        """The chunk width programs are actually keyed/traced at:
+        ``mask_chunk`` clamped to the group count (a chunk wider than G
+        would only pad)."""
+        if self._groups is not None:
+            return max(1, min(self.mask_chunk, len(self._groups)))
+        return max(1, self.mask_chunk)
+
+    def shrink_mask_chunk(self) -> Optional[int]:
+        """Resource-ladder rung (site ``serving.explain``): halve the
+        mask-chunk width so the next attempt's masked-input peak halves
+        too, evicting the old chunk's compiled entries (and cached
+        device masks) so their accounted HBM actually releases. Halving
+        operates on the EFFECTIVE chunk — the width programs were
+        actually traced at — so a ``mask_chunk`` wider than the group
+        count still steps down instead of burning no-op rungs. Returns
+        the new chunk, or None at the floor (chunk 1 — below it there
+        is nothing to shed but the padding buckets, which the serving
+        ladder already owns)."""
+        old = self.effective_mask_chunk()
+        if old <= 1:
+            return None
+        self.mask_chunk = max(1, old // 2)
+        self._masks_dev.pop(old, None)
+        if self.program_cache is not None:
+            self.program_cache.evict_matching(
+                lambda k: isinstance(k, tuple) and len(k) == 3
+                and k[0] == self.fingerprint
+                and isinstance(k[1], tuple) and k[1][:1] == ("explain",)
+                and k[1][2] == old)
+        else:
+            for key in [k for k in self._programs
+                        if isinstance(k, tuple) and k[:1] == ("explain",)
+                        and k[2] == old]:
+                self._programs.pop(key, None)
+        return self.mask_chunk
+
+    # -- compiled explain program -------------------------------------------
+    def _explain_program_for(self, dev_ts, bucket: int, chunk: int):
+        factory = lambda: self._build_explain_program(dev_ts)  # noqa: E731
+        if self.program_cache is None:
+            key = ("explain", self._pred_li, chunk)
+            program = self._programs.get(key)
+            if program is None:
+                program = factory()
+                self._programs[key] = program
+            return program
+        return self.program_cache.get(
+            (self.fingerprint, ("explain", self._pred_li, chunk), bucket),
+            factory,
+            bytes_est=lambda: self.explain_entry_bytes(bucket, chunk),
+            counters=self.counters, bucket=bucket)
+
+    def explain_entry_bytes(self, bucket: int, chunk: int) -> int:
+        """Coarse HBM estimate for one compiled explain entry: the
+        scoring layer's estimate plus the masked-input working set
+        (``chunk`` masked ``[bucket, d]`` copies when XLA materializes
+        them) — an estimate by design, like every HBM guard here."""
+        d = self._vec_d if self._vec_d is not None else 0
+        return self.layer_entry_bytes(self._pred_li, bucket) \
+            + int(chunk) * int(bucket) * int(d) * 4
+
+    def _build_explain_program(self, dev_ts):
+        """ONE jitted program: the prediction layer's forward pass (same
+        outputs the plain path extracts) + the G masked re-scores of the
+        prediction model, chunked ``lax.map`` over an inner ``vmap``."""
+        import jax
+
+        dev_ts = list(dev_ts)
+        pstage, vec_name = self._pstage, self._vec_name
+        from transmogrifai_tpu.utils.tracing import device_scope
+
+        def score_of(out):
+            prob = out.probability
+            if prob is not None and prob.ndim == 2 and prob.shape[1] >= 2:
+                return prob[:, 1]
+            return out.prediction
+
+        def fused(params, donate_cols, keep_cols, masks):
+            env = {**donate_cols, **keep_cols}
+            produced = {}
+            for t in dev_ts:
+                cols = [env[n] for n in t.runtime_input_names()]
+                with device_scope(f"{t.operation_name}[{t.uid}]"):
+                    produced[t.get_output().name] = t.device_apply(
+                        params[t.uid], *cols)
+            base = score_of(produced[self._pred_name])       # [n]
+            X = env[vec_name].values                         # [n, d]
+            pp = params[pstage.uid]
+
+            def one(m):
+                return base - score_of(
+                    pstage.device_apply(pp, fr.VectorColumn(X * m)))
+
+            with device_scope(f"loco[{pstage.uid}]"):
+                deltas = jax.lax.map(jax.vmap(one), masks)
+            # [n_chunks, chunk, n] -> [G_pad, n]
+            return produced, deltas.reshape(-1, X.shape[0])
+
+        return jax.jit(fused, donate_argnums=(1,) if self.donate else ())
+
+    # -- explain dispatch ----------------------------------------------------
+    def warmup(self, row: dict, buckets: Optional[Sequence[int]] = None
+               ) -> list[int]:
+        """Pre-compile every padding bucket's EXPLAIN path (which also
+        warms/shares the plain layers' programs) before traffic."""
+        from transmogrifai_tpu.utils.devicewatch import compile_telemetry
+        warmed = []
+        for b in (buckets if buckets is not None else self.buckets):
+            with compile_telemetry.building(f"serving.explain_bucket_{b}"):
+                self.explain_batch([dict(row)] * int(b))
+            warmed.append(int(b))
+        return warmed
+
+    def explain_batch(self, rows: Sequence[dict],
+                      top_k=None) -> tuple[list[dict], list[list]]:
+        """Score + explain one batch. ``top_k``: None (the explainer's
+        default), an int for the whole batch, or a per-row list."""
+        rows = list(rows)
+        if not rows:
+            return [], []
+        ks = self._per_row_ks(rows, top_k)
+        if len(rows) > self.max_batch:
+            docs: list[dict] = []
+            exps: list[list] = []
+            for i in range(0, len(rows), self.max_batch):
+                d_, e_ = self.explain_batch(
+                    rows[i:i + self.max_batch],
+                    ks[i:i + self.max_batch])
+                docs.extend(d_)
+                exps.extend(e_)
+            return docs, exps
+        n = len(rows)
+        bucket = self.bucket_for(n)
+        from transmogrifai_tpu.pipeline_data import PipelineData
+        padded = rows + [rows[-1]] * (bucket - n)
+        cols = {name: fr.HostColumn.from_values(
+                    ftype, [r.get(name) for r in padded])
+                for name, ftype in self._raw}
+        data = PipelineData(fr.HostFrame(cols))
+        if self.program_cache is not None:
+            data, deltas = self._transform_explain(data, bucket)
+            self.counters.count(bucket, dispatches=1)
+        else:
+            before = self._program_cache_entries()
+            data, deltas = self._transform_explain(data, bucket)
+            grew = self._program_cache_entries() - before
+            self.counters.count(bucket, dispatches=1, compiles=grew)
+            if grew:
+                from transmogrifai_tpu.utils.events import events
+                events.emit("serving.compile", bucket=bucket,
+                            programs=grew, lane="explain",
+                            fingerprint=self.fingerprint)
+        docs = self._extract_rows(data, n)
+        exps = self._extract_explanations(deltas, n, ks)
+        return docs, exps
+
+    def _per_row_ks(self, rows: Sequence[dict], top_k) -> list[int]:
+        if top_k is None:
+            return [self.top_k] * len(rows)
+        if isinstance(top_k, int):
+            return [top_k] * len(rows)
+        return [self.top_k if k is None else int(k) for k in top_k]
+
+    def _transform_explain(self, data, bucket: int):
+        """The scorer's ``_transform`` with the prediction layer's
+        program swapped for the fused forward+LOCO one. Returns
+        ``(data, deltas[G, bucket] np.ndarray)``."""
+        deltas = None
+        for li, (host_ts, dev_ts) in enumerate(self._layers):
+            if host_ts:
+                data = data.with_host_cols(
+                    {t.get_output().name: t.output_column(data)
+                     for t in host_ts})
+            if not dev_ts:
+                continue
+            in_cols = {n: self._device_input(data, n)
+                       for t in dev_ts for n in t.runtime_input_names()}
+            spent = set(self._free_plan[li]) if self.donate else set()
+            donate_cols = {n: c for n, c in in_cols.items() if n in spent}
+            keep_cols = {n: c for n, c in in_cols.items() if n not in spent}
+            params = {t.uid: t.device_params() for t in dev_ts}
+            if li == self._pred_li:
+                if self._groups is None:
+                    self._resolve_groups(in_cols[self._vec_name])
+                chunk = self.effective_mask_chunk()
+                program = self._explain_program_for(dev_ts, bucket, chunk)
+                outs, dd = program(params, donate_cols, keep_cols,
+                                   self._chunked_masks(chunk))
+                deltas = np.asarray(dd)[:len(self._groups)]
+            else:
+                program = self._program_for(li, dev_ts, bucket)
+                outs = program(params, donate_cols, keep_cols)
+            for name in self._free_plan[li]:
+                data.device.pop(name, None)
+            data = data.with_device_cols(outs)
+            for t in dev_ts:
+                m = getattr(outs.get(t.get_output().name), "metadata", None)
+                if m is not None:
+                    t.out_meta = m
+        if deltas is None:  # unreachable by construction: _pred_li indexes
+            raise RuntimeError("prediction layer never dispatched")
+        return data, deltas
+
+    def _extract_explanations(self, deltas: np.ndarray, n: int,
+                              ks: Sequence[int]) -> list[list]:
+        """``[G, bucket]`` deltas -> per-row ordered top-K attribution
+        lists, matching the offline Abs strategy (sort by |delta|, drop
+        exact zeros)."""
+        names = self._group_names
+        per_row = deltas[:, :n].T                             # [n, G]
+        out: list[list] = []
+        for i in range(n):
+            row = per_row[i]
+            top = np.argsort(-np.abs(row))[:max(int(ks[i]), 0)]
+            out.append([{"name": names[j], "delta": float(row[j])}
+                        for j in top if row[j] != 0.0])
+        return out

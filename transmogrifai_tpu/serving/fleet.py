@@ -181,6 +181,24 @@ class ProgramCache:
                 old.counters.count(old.bucket, evictions=1)
         return len(evicted)
 
+    def evict_matching(self, pred: Callable[[Any], bool]) -> int:
+        """Drop every entry whose key satisfies ``pred`` — the explain
+        lane's mask-chunk rung uses this to release the superseded
+        chunk's programs (their accounted HBM must free NOW, that is the
+        rung's whole point). Evictions attribute to each entry's owner
+        like every other eviction path."""
+        evicted: list[_CacheEntry] = []
+        with self._lock:
+            for key in [k for k in self._entries if pred(k)]:
+                old = self._entries.pop(key)
+                self.current_bytes -= old.bytes
+                self.evictions += 1
+                evicted.append(old)
+        for old in evicted:
+            if old.counters is not None and old.bucket is not None:
+                old.counters.count(old.bucket, evictions=1)
+        return len(evicted)
+
     def evict_cold(self, bytes_to_free: int) -> int:
         """Evict least-recently-dispatched entries until at least
         ``bytes_to_free`` accounted bytes are released (or one entry
@@ -448,7 +466,20 @@ class FleetServer:
         scorer = CompiledScorer(entry.model,
                                 program_cache=self.program_cache,
                                 fingerprint=entry.fingerprint, **kw)
-        return scorer.warmup(row)
+        warmed = scorer.warmup(row)
+        if self._lane_kwargs.get("explain"):
+            # explain-enabled fleets prewarm the candidate's explain
+            # programs too — a post-swap explain request must be a pure
+            # cache hit, exactly like a post-swap score
+            from transmogrifai_tpu.serving.explain import CompiledExplainer
+            explainer = CompiledExplainer(
+                entry.model, program_cache=self.program_cache,
+                fingerprint=entry.fingerprint,
+                top_k=int(self._lane_kwargs.get("explain_top_k", 5)),
+                mask_chunk=self._lane_kwargs.get("explain_mask_chunk"),
+                **kw)
+            explainer.warmup(row)
+        return warmed
 
     def _start_lane(self, entry: ModelEntry,
                     warmup_row: Optional[dict] = None) -> ScoringServer:
@@ -550,9 +581,21 @@ class FleetServer:
         return self._submit_routed(model_id, row, timeout_ms,
                                    trace_id)[0]
 
+    def submit_explain(self, model_id: str, row: dict,
+                       top_k: Optional[int] = None,
+                       timeout_ms: Optional[float] = None,
+                       trace_id: Optional[str] = None):
+        """Route one EXPLAIN request (score + top-K LOCO attributions) to
+        ``model_id``'s active version's explain lane. Requires the fleet
+        to be built with ``explain=True`` in the lane kwargs."""
+        return self._submit_routed(model_id, row, timeout_ms, trace_id,
+                                   explain=True, top_k=top_k)[0]
+
     def _submit_routed(self, model_id: str, row: dict,
                        timeout_ms: Optional[float] = None,
-                       trace_id: Optional[str] = None) -> tuple:
+                       trace_id: Optional[str] = None,
+                       explain: bool = False,
+                       top_k: Optional[int] = None) -> tuple:
         """``submit`` that also returns which version admitted the
         request — the lineage a reply must carry is the version that
         SCORED it, which during a hot swap is not necessarily the
@@ -560,8 +603,13 @@ class FleetServer:
         for _ in range(8):
             lane, version = self._resolve(model_id)
             try:
-                fut = lane.submit(row, timeout_ms=timeout_ms,
-                                  trace_id=trace_id)
+                if explain:
+                    fut = lane.submit_explain(row, top_k=top_k,
+                                              timeout_ms=timeout_ms,
+                                              trace_id=trace_id)
+                else:
+                    fut = lane.submit(row, timeout_ms=timeout_ms,
+                                      trace_id=trace_id)
             except RuntimeError:
                 # the lane stopped between resolve and submit — a swap
                 # demoted it (the alias flips BEFORE the old lane drains,
@@ -585,6 +633,19 @@ class FleetServer:
         return absorb_backpressure(
             lambda: self.submit(model_id, row, timeout_ms=timeout_ms,
                                 trace_id=trace_id),
+            max_wait_s=max_wait_s)
+
+    def submit_explain_blocking(self, model_id: str, row: dict,
+                                top_k: Optional[int] = None,
+                                timeout_ms: Optional[float] = None,
+                                max_wait_s: Optional[float] = None,
+                                trace_id: Optional[str] = None):
+        """``submit_explain`` that absorbs backpressure."""
+        from transmogrifai_tpu.serving.batcher import absorb_backpressure
+        return absorb_backpressure(
+            lambda: self.submit_explain(model_id, row, top_k=top_k,
+                                        timeout_ms=timeout_ms,
+                                        trace_id=trace_id),
             max_wait_s=max_wait_s)
 
     def score(self, model_id: str, row: dict,
@@ -614,7 +675,15 @@ class FleetServer:
         """POST /score[/model_id] adapter: path id wins, else the row's
         ``route_field``, else the sole registered model. The returned
         document is stamped with the trace id and the scoring model's
-        lineage (the response-side half of request-scoped tracing)."""
+        lineage (the response-side half of request-scoped tracing).
+
+        Opt-in explainability: an ``"explain"`` field on the request row
+        (popped before admission — it is a directive, not a raw feature)
+        routes through the model's explain lane; ``true`` uses the lane's
+        default top-K, an integer asks for that many attributions. The
+        reply gains an ordered ``"explanations"`` list alongside the
+        score, under the same trace id + lineage stamp."""
+        explain = row.pop("explain", False)
         if model_id is None:
             model_id = row.pop(self.route_field, None)
         if model_id is None:
@@ -625,8 +694,12 @@ class FleetServer:
                     f"or /score/<id> path) and the fleet serves "
                     f"{len(ids)} models")
             model_id = ids[0]
+        top_k = explain if isinstance(explain, int) \
+            and not isinstance(explain, bool) and explain > 0 else None
         fut, version = self._submit_routed(model_id, row,
-                                           trace_id=trace_id)
+                                           trace_id=trace_id,
+                                           explain=bool(explain),
+                                           top_k=top_k)
         doc = dict(fut.result(timeout=self.http_timeout_s))
         if trace_id is not None:
             doc["traceId"] = trace_id

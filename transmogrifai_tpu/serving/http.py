@@ -4,6 +4,13 @@ live counters), ``GET /healthz`` (liveness + per-model readiness as
 JSON), and — when the owner provides a ``score_fn`` (the fleet does) —
 ``POST /score`` / ``POST /score/<model_id>`` (one JSON request row in,
 one JSON score document out; the multi-process load harness's wire).
+An ``"explain": true`` (or ``"explain": K``) field on the request row
+opts into the fleet's explain lane — the reply gains an ordered
+``"explanations"`` top-K LOCO attribution list alongside the score,
+under the same trace id + lineage stamp (docs/INSIGHTS.md). The field
+is a directive, popped before admission, so strict validation never
+sees it; the scale-out router proxies bodies verbatim, so explained
+requests ride through unchanged.
 
 Request-scoped tracing starts HERE: every scoring request gets a trace
 id — the inbound ``X-Trace-Id`` header when present (sanitized), else a
@@ -145,6 +152,13 @@ class MetricsServer:
             # replica hop must not pay a TCP handshake per request. Every
             # reply carries Content-Length (send_error closes on its own)
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: the reply's status+headers and body flush as
+            # separate writes; with Nagle on, the body segment waits for
+            # the ACK of the first — a ~40ms delayed-ACK stall PER
+            # REQUEST on kernels that delay loopback ACKs. A scoring
+            # endpoint's replies are single small documents: latency
+            # wins, coalescing buys nothing.
+            disable_nagle_algorithm = True
 
             def _read_body(self) -> Optional[bytes]:
                 """Bounded request-body read, or None after an error

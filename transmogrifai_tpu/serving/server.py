@@ -43,6 +43,10 @@ from transmogrifai_tpu.utils.retry import with_device_retry
 
 __all__ = ["ScoringServer"]
 
+#: reserved request-row key carrying a per-request explain top-K through
+#: the batcher (popped before scoring; never a raw feature)
+_EXPLAIN_K = "__explain_top_k__"
+
 
 class ScoringServer:
     """Thread-based online scorer for a fitted ``WorkflowModel``.
@@ -66,7 +70,9 @@ class ScoringServer:
                  metrics_host: str = "127.0.0.1",
                  access_log_sample: float = 0.0,
                  slo=None, event_label: Optional[str] = None,
-                 program_cache=None, fingerprint: Optional[str] = None):
+                 program_cache=None, fingerprint: Optional[str] = None,
+                 explain: bool = False, explain_top_k: int = 5,
+                 explain_mask_chunk: Optional[int] = None):
         self.model = model
         #: label stamped on this server's flight-recorder events (the
         #: fleet sets the model id; a standalone server has none)
@@ -105,6 +111,37 @@ class ScoringServer:
             queue_depth_fn=lambda: self.batcher.queue_depth,
             queue_capacity=queue_capacity,
             compile_counters=self.scorer.counters)
+        #: the EXPLAIN lane (opt-in): its own compiled explainer (sharing
+        #: the scoring lane's program cache + fingerprint, so the plain
+        #: layers' compiled entries are literally shared), its own
+        #: micro-batcher (an expensive explain batch must never add
+        #: latency to plain scoring traffic), and its own ServingMetrics
+        #: (the transmogrifai_explain_* series)
+        self.explainer = None
+        self.explain_batcher = None
+        self.explain_metrics = None
+        if explain:
+            from transmogrifai_tpu.serving.explain import CompiledExplainer
+            self.explainer = CompiledExplainer(
+                model, top_k=explain_top_k,
+                mask_chunk=explain_mask_chunk, max_batch=max_batch,
+                min_bucket=min_bucket, donate=donate,
+                program_cache=program_cache,
+                fingerprint=self.scorer.fingerprint)
+            self.explain_batcher = MicroBatcher(
+                self._explain_dispatch, max_batch=max_batch,
+                max_wait_ms=max_wait_ms, queue_capacity=queue_capacity,
+                default_timeout_ms=default_timeout_ms,
+                on_complete=lambda settled:
+                    self.explain_metrics.record_requests_done(settled),
+                on_expired=lambda n:
+                    self.explain_metrics.record_expired(n))
+            self.explain_metrics = ServingMetrics(
+                max_samples=metrics_max_samples,
+                queue_depth_fn=lambda: self.explain_batcher.queue_depth,
+                queue_capacity=queue_capacity,
+                compile_counters=self.explainer.counters)
+        self._warmup_explain_compiles: dict = {}
         self._degraded_since: Optional[float] = None
         self._last_probe = 0.0
         #: scrape endpoint (/metrics + /healthz), started with the server
@@ -141,6 +178,15 @@ class ScoringServer:
                     f"serving: warmup failed ({type(e).__name__}: "
                     f"{str(e)[:140]}); padding buckets will compile lazily",
                     RuntimeWarning)
+            if self.explainer is not None:
+                try:
+                    self.explainer.warmup(warmup_row,
+                                          buckets=warmup_buckets)
+                except Exception as e:  # noqa: BLE001 — degrade to lazy compile
+                    warnings.warn(
+                        f"serving: explain warmup failed "
+                        f"({type(e).__name__}: {str(e)[:140]}); explain "
+                        "buckets will compile lazily", RuntimeWarning)
         # bind the scrape endpoint BEFORE the worker starts: a port-bind
         # failure (EADDRINUSE) must fail start() cleanly, not leave a
         # half-started server with a running batcher thread behind it
@@ -156,6 +202,10 @@ class ScoringServer:
                 host=self._metrics_host,
                 access_log_sample=self._access_log_sample).start()
         self.batcher.start()
+        if self.explain_batcher is not None:
+            self.explain_batcher.start()
+            self._warmup_explain_compiles = dict(
+                self.explainer.counters.compiles_by_bucket())
         self._warmup_compiles = dict(self.scorer.counters
                                      .compiles_by_bucket())
         self._lifecycle = "ready"
@@ -163,6 +213,8 @@ class ScoringServer:
 
     def stop(self, drain: bool = True) -> None:
         self._lifecycle = "draining"
+        if self.explain_batcher is not None:
+            self.explain_batcher.stop(drain=drain)
         self.batcher.stop(drain=drain)
         self._lifecycle = "stopped"
         if self.metrics_http is not None:
@@ -220,6 +272,17 @@ class ScoringServer:
                 for b, n in now.items()
                 if n - self._warmup_compiles.get(b, 0)}
 
+    def post_warmup_explain_compiles(self) -> dict:
+        """The explain lane's compile-storm bound: per-bucket explain
+        compiles since warmup (0 everywhere = steady-state explained
+        traffic never recompiled)."""
+        if self.explainer is None:
+            return {}
+        now = self.explainer.counters.compiles_by_bucket()
+        return {b: n - self._warmup_explain_compiles.get(b, 0)
+                for b, n in now.items()
+                if n - self._warmup_explain_compiles.get(b, 0)}
+
     # -- request API ---------------------------------------------------------
     def submit(self, row: dict,
                timeout_ms: Optional[float] = None,
@@ -264,6 +327,62 @@ class ScoringServer:
             lambda: self.submit(row, timeout_ms=timeout_ms,
                                 trace_id=trace_id),
             max_wait_s=max_wait_s)
+
+    def submit_explain(self, row: dict, top_k: Optional[int] = None,
+                       timeout_ms: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> Future:
+        """Admit one EXPLAIN request: the future resolves to the score
+        document PLUS an ordered ``"explanations"`` top-K LOCO
+        attribution list. Its own lane (queue, batcher, metrics): an
+        expensive explain batch never blocks plain scoring traffic.
+        ``top_k`` overrides the lane's default for this request."""
+        if self.explain_batcher is None:
+            raise ValueError(
+                "explain lane is disabled; construct the server with "
+                "explain=True")
+        if self.strict:
+            try:
+                check_row(row, self.required_keys)
+            except KeyError:
+                self.explain_metrics.record_rejected(invalid=True)
+                raise
+        row = dict(row)
+        if top_k is not None:
+            row[_EXPLAIN_K] = int(top_k)
+        try:
+            fut = self.explain_batcher.submit(row, timeout_ms=timeout_ms,
+                                              trace_id=trace_id)
+        except BackpressureError as e:
+            self.explain_metrics.record_rejected(invalid=False)
+            events.emit_limited(
+                f"bpx:{id(self)}", 1.0, "serving.backpressure_reject",
+                trace_id=trace_id, model=self.event_label, lane="explain",
+                queueDepth=self.explain_batcher.queue_depth,
+                retryAfterS=round(e.retry_after_s, 4))
+            raise
+        self.explain_metrics.record_admitted()
+        return fut
+
+    def submit_explain_blocking(self, row: dict,
+                                top_k: Optional[int] = None,
+                                timeout_ms: Optional[float] = None,
+                                max_wait_s: Optional[float] = None,
+                                trace_id: Optional[str] = None) -> Future:
+        """``submit_explain`` that absorbs backpressure (the shared
+        ``batcher.absorb_backpressure`` client loop)."""
+        from transmogrifai_tpu.serving.batcher import absorb_backpressure
+        return absorb_backpressure(
+            lambda: self.submit_explain(row, top_k=top_k,
+                                        timeout_ms=timeout_ms,
+                                        trace_id=trace_id),
+            max_wait_s=max_wait_s)
+
+    def explain(self, row: dict, top_k: Optional[int] = None,
+                timeout_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> dict:
+        return self.submit_explain(row, top_k=top_k,
+                                   trace_id=trace_id).result(
+                                       timeout=timeout_s)
 
     def score(self, row: dict, timeout_s: Optional[float] = None,
               trace_id: Optional[str] = None) -> dict:
@@ -441,6 +560,114 @@ class ScoringServer:
                 f"({type(err).__name__}: {str(err)[:140]}); degrading to "
                 "the local row path until a probe succeeds", RuntimeWarning)
 
+    # -- explain dispatch (explain batcher worker thread) --------------------
+    def _explain_dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        """The explain lane's batch dispatch: compiled forward + LOCO
+        program, transient retry, then the ``serving.explain`` resource
+        ladder (mask-chunk halving, re-serving the SAME batch at the
+        smaller chunk). When every rung is exhausted — or the failure is
+        not an allocation — the batch degrades to plain ROW-PATH scores
+        with a per-row ``explanationsError`` note: an admitted explain
+        request always settles with its score, never drops."""
+        from transmogrifai_tpu.utils import devicewatch
+        from transmogrifai_tpu.utils.faults import (
+            FaultHarnessError, fault_point,
+        )
+        from transmogrifai_tpu.utils.tracing import span
+        t0 = time.monotonic()
+        rows = [dict(r) for r in rows]
+        ks = [r.pop(_EXPLAIN_K, None) for r in rows]
+        attempts = {"n": 0}
+
+        def attempt():
+            attempts["n"] += 1
+            fault_point("serving.explain")
+            docs, exps = self.explainer.explain_batch(rows, top_k=ks)
+            for doc, exp in zip(docs, exps):
+                doc["explanations"] = exp
+            return docs
+
+        degraded = True
+        try:
+            eid = devicewatch.dispatch_ledger.register(
+                "serving.explain", rows=len(rows),
+                model=self.event_label)
+            try:
+                with span("serving.explain_dispatch", rows=len(rows)), \
+                        devicewatch.guard("serving.explain",
+                                          site="serving.explain",
+                                          rows=len(rows)):
+                    results = with_device_retry(
+                        attempt, retries=self.retries,
+                        backoff_s=self.retry_backoff_s)
+                degraded = False
+            finally:
+                devicewatch.dispatch_ledger.complete(eid)
+                if attempts["n"] > 1:
+                    self.explain_metrics.record_retry(attempts["n"] - 1)
+        except FaultHarnessError:
+            raise
+        except Exception as e:  # noqa: BLE001 — ladder rungs, then row-path floor
+            results = self._explain_shed_and_retry(rows, ks, e)
+            if results is not None:
+                degraded = False
+            else:
+                self.explain_metrics.record_degraded_entry()
+                events.emit("serving.explain_degraded",
+                            model=self.event_label,
+                            error=f"{type(e).__name__}: {str(e)[:200]}")
+                note = f"{type(e).__name__}: {str(e)[:200]}"
+                results = []
+                for r in self._row_dispatch(rows):
+                    if isinstance(r, BaseException):
+                        results.append(r)
+                    else:
+                        doc = dict(r)
+                        doc["explanations"] = None
+                        doc["explanationsError"] = note
+                        results.append(doc)
+        self.explain_metrics.record_batch(
+            len(rows), time.monotonic() - t0, degraded=degraded)
+        return results
+
+    def _explain_shed_and_retry(self, rows: Sequence[dict], ks,
+                                err: BaseException) -> Optional[list]:
+        """The explain degradation ladder: on a genuine allocation
+        failure, halve the LOCO mask-chunk width (the masked-input peak
+        halves with it) and re-serve the SAME batch, rung by rung down
+        to chunk 1. Returns results or None when exhausted."""
+        from transmogrifai_tpu.utils.resources import (
+            is_resource_exhausted, ladder_enabled, record_degradation,
+        )
+        from transmogrifai_tpu.utils.tracing import span
+        if not ladder_enabled() or not is_resource_exhausted(err):
+            return None
+        last = err
+        while True:
+            chunk = self.explainer.shrink_mask_chunk()
+            if chunk is None:
+                return None  # chunk floor: the row-path score serves
+            record_degradation(
+                "serving.explain", f"mask_chunk_{chunk}", error=last,
+                model=self.event_label, rows=len(rows))
+            try:
+                with span("resource.degrade", site="serving.explain",
+                          rung=f"mask_chunk_{chunk}", rows=len(rows)):
+                    docs, exps = self.explainer.explain_batch(
+                        rows, top_k=ks)
+                for doc, exp in zip(docs, exps):
+                    doc["explanations"] = exp
+                return docs
+            except Exception as e:  # noqa: BLE001 — next rung or give up to the row path
+                from transmogrifai_tpu.utils.faults import (
+                    FaultHarnessError,
+                )
+                if isinstance(e, FaultHarnessError):
+                    raise
+                if not is_resource_exhausted(e):
+                    return None
+                last = e
+
     def _row_dispatch(self, rows: Sequence[dict]) -> list[Any]:
         from transmogrifai_tpu.utils.tracing import span
         out: list[Any] = []
@@ -469,4 +696,15 @@ class ScoringServer:
         doc["state"] = self.state
         doc["postWarmupCompiles"] = {
             str(b): n for b, n in self.post_warmup_compiles().items()}
+        if self.explain_metrics is not None:
+            xdoc = self.explain_metrics.snapshot(mirror_to_profiler=False)
+            xdoc["config"] = {
+                "topK": self.explainer.top_k,
+                "maskChunk": self.explainer.mask_chunk,
+                "groups": self.explainer.n_groups,
+            }
+            xdoc["postWarmupCompiles"] = {
+                str(b): n
+                for b, n in self.post_warmup_explain_compiles().items()}
+            doc["explain"] = xdoc
         return doc

@@ -738,3 +738,15 @@ def feature_type_of(name: str) -> type[FeatureType]:
 def is_subtype(a: type, b: type) -> bool:
     """``a`` conforms to ``b`` in the feature type lattice."""
     return issubclass(a, b)
+
+
+def nullable_base(ftype: type) -> type:
+    """The nearest nullable ancestor of a feature type (``ftype`` itself
+    when already nullable). The serving/explain surfaces build RESPONSE
+    raw columns with this: requests legitimately omit the label, and a
+    non-nullable type (RealNN) would reject the resulting Nones."""
+    if ftype.is_nullable:
+        return ftype
+    return next(b for b in ftype.__mro__
+                if isinstance(b, type) and issubclass(b, FeatureType)
+                and b.is_nullable)

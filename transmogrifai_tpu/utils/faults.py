@@ -28,6 +28,8 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``checkpoint.write``      any durable checkpoint write (train/sweep/stream)
 ``collective``            multihost barrier / global-array assembly
 ``serving.dispatch``      one compiled serving batch dispatch
+``serving.explain``       one compiled explain-lane batch dispatch (OOM
+                          here takes the mask-chunk-halving ladder rung)
 ``serving.swap``          mid-fleet-hot-swap (candidate warm, alias not
                           yet flipped — the abort path must leave the old
                           version serving with zero drops)
@@ -97,7 +99,8 @@ KNOWN_SITES = frozenset({
     "dag.apply_layer", "sweep.fit", "selector.refit", "train.layer",
     "ingest.read", "ingest.fuse", "ingest.prefetch",
     "checkpoint.write", "collective", "serving.dispatch",
-    "serving.swap", "continuous.ingest", "continuous.trigger",
+    "serving.explain", "serving.swap", "continuous.ingest",
+    "continuous.trigger",
     "continuous.retrain", "continuous.promote", "events.spill",
     "scaleout.route", "scaleout.heartbeat", "scaleout.roll",
 })

@@ -601,6 +601,102 @@ def _serving_collectors(reg: PromRegistry, lanes_fn) -> None:
                  per_bucket("evictions"))
 
 
+def _explain_collectors(reg: PromRegistry, servers_fn) -> None:
+    """The explain-lane series over ``servers_fn() -> [(labels,
+    ScoringServer), ...]`` (only servers whose explain lane is enabled).
+    Same shape discipline as the serving series: one sample set per
+    lane, ``model``-labeled under a fleet, unlabeled standalone — the
+    ``transmogrifai_explain_*`` namespace is the explained-traffic half
+    of every dashboard."""
+    def lanes():
+        return [(labels, srv.explain_metrics)
+                for labels, srv in servers_fn()
+                if srv.explain_metrics is not None]
+
+    def per_lane(attr: str):
+        def collect():
+            return [(labels, getattr(m, attr)) for labels, m in lanes()]
+        return collect
+
+    for attr, name, help_ in (
+            ("admitted", "requests_admitted", "explain requests accepted "
+                                              "at the door"),
+            ("completed", "requests_completed", "explain requests settled "
+                                                "ok"),
+            ("failed", "requests_failed", "explain requests settled with "
+                                          "an error"),
+            ("expired", "requests_expired", "explain requests whose queue "
+                                            "deadline expired"),
+            ("batches", "batches", "dispatched explain micro-batches"),
+            ("degraded_batches", "degraded_batches",
+             "explain batches served as row-path scores without "
+             "attributions (ladder exhausted)"),
+            ("batch_rows", "batch_rows", "rows dispatched in explain "
+                                         "batches"),
+            ("dispatch_retries", "dispatch_retries", "transient explain "
+                                                     "dispatch retries"),
+            ("batch_wall_s", "batch_wall_seconds", "cumulative explain "
+                                                   "batch dispatch wall")):
+        reg.register(f"transmogrifai_explain_{name}_total", "counter",
+                     help_, per_lane(attr))
+    reg.register(
+        "transmogrifai_explain_rejected_total", "counter",
+        "explain requests rejected at admission, by reason",
+        lambda: [({**labels, "reason": "backpressure"},
+                  m.rejected_backpressure)
+                 for labels, m in lanes()]
+               + [({**labels, "reason": "invalid"}, m.rejected_invalid)
+                  for labels, m in lanes()])
+    reg.register(
+        "transmogrifai_explain_latency_seconds", "histogram",
+        "explain request latency, admission to settlement",
+        lambda: [(labels, m.latency_histogram())
+                 for labels, m in lanes()])
+    reg.register(
+        "transmogrifai_explain_queue_depth", "gauge",
+        "requests waiting in the explain admission queue",
+        lambda: [(labels, (m.queue_depth_fn or (lambda: 0))())
+                 for labels, m in lanes()])
+    reg.register(
+        "transmogrifai_explain_throughput_rolling_rps", "gauge",
+        "explained completions/s over the rolling window",
+        lambda: [(labels, m.rolling_rps()) for labels, m in lanes()])
+    reg.register(
+        "transmogrifai_explain_mask_chunk", "gauge",
+        "current LOCO mask-chunk width (the serving.explain ladder rung "
+        "halves it under memory pressure)",
+        lambda: [(labels, srv.explainer.mask_chunk)
+                 for labels, srv in servers_fn()
+                 if srv.explainer is not None])
+    reg.register(
+        "transmogrifai_explain_groups", "gauge",
+        "LOCO feature groups of the served vector (0 until the first "
+        "explain dispatch resolves them)",
+        lambda: [(labels, srv.explainer.n_groups or 0)
+                 for labels, srv in servers_fn()
+                 if srv.explainer is not None])
+
+    def per_bucket(attr: str):
+        def collect():
+            out = []
+            for labels, m in lanes():
+                cc = m.compile_counters
+                if cc is None:
+                    continue
+                out.extend(({**labels, "bucket": str(b)},
+                            getattr(c, attr))
+                           for b, c in sorted(cc.buckets.items()))
+            return out
+        return collect
+
+    reg.register("transmogrifai_explain_compiles_total", "counter",
+                 "explain-program compiles per padding bucket",
+                 per_bucket("compiles"))
+    reg.register("transmogrifai_explain_dispatches_total", "counter",
+                 "explain batch dispatches per padding bucket",
+                 per_bucket("dispatches"))
+
+
 def _fleet_collectors(reg: PromRegistry, fleet) -> None:
     """Fleet-level series: swap lifecycle, shared compiled-program cache
     accounting, per-model state — plus every serving series labeled
@@ -609,6 +705,12 @@ def _fleet_collectors(reg: PromRegistry, fleet) -> None:
         reg, lambda: [({"model": mid}, lane.metrics)
                       for mid, lane in sorted(
                           fleet.active_lanes().items())])
+    _explain_collectors(
+        reg, lambda: [({"model": mid}, lane)
+                      for mid, lane in sorted(
+                          fleet.active_lanes().items())
+                      if getattr(lane, "explain_metrics", None)
+                      is not None])
     fm = fleet.metrics
     for attr, name, help_ in (
             ("swaps", "swaps", "completed zero-downtime hot-swaps"),
@@ -792,8 +894,10 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     lifecycle series — the scale-out control process scrapes both on
     one endpoint. ``slo`` (a ``utils.slo.SLOEngine``) adds the
     ``transmogrifai_slo_*`` burn-rate surface. ``server`` (a
-    ``ScoringServer``) is optional extra context reserved for future
-    gauges. EVERY registry carries ``transmogrifai_build_info``, the
+    ``ScoringServer``) adds the ``transmogrifai_explain_*`` lane series
+    when its explain lane is enabled (fleets get the model-labeled
+    variant automatically). EVERY registry carries
+    ``transmogrifai_build_info``, the
     process-uptime gauge, the flight recorder's
     ``transmogrifai_events_*`` accounting, the resource-pressure
     ``transmogrifai_resource_*`` series (degradation-ladder rungs,
@@ -814,6 +918,11 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
         _app_collectors(reg)
     if serving is not None:
         _serving_collectors(reg, lambda: [({}, serving)])
+        if server is not None and \
+                getattr(server, "explain_metrics", None) is not None:
+            # the standalone server's explain lane (fleets wire their
+            # model-labeled explain series via _fleet_collectors)
+            _explain_collectors(reg, lambda: [({}, server)])
     if fleet is not None:
         _fleet_collectors(reg, fleet)
     if continuous is not None:
