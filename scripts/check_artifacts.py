@@ -99,6 +99,120 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_ingest_fe_fusion(doc))
     if doc.get("metric") == "explain_overhead":
         errors.extend(_validate_explain_overhead(doc))
+    if doc.get("metric") == "wire_speed":
+        errors.extend(_validate_wire_speed(doc))
+    return errors
+
+
+#: round-16 acceptance bounds for the binary columnar wire: the
+#: single-replica binary-wire HTTP leg must carry at least
+#: MIN_WIRE_BINARY_SPEEDUP x the committed pre-wire fleet HTTP rate
+#: (the 436 rps the ThreadingHTTPServer + per-row JSON seam managed)
+#: with request p99 under MAX_WIRE_P99_MS, and binary-vs-JSON replies
+#: must agree within MAX_WIRE_PARITY — a faster wire that changes
+#: scores is a different server, not a faster one
+MIN_WIRE_BINARY_SPEEDUP = 10.0
+MAX_WIRE_P99_MS = 5.0
+MAX_WIRE_PARITY = 1e-5
+
+
+def _validate_wire_speed(doc: dict) -> list[str]:
+    """The ``benchmarks/WIRE_SPEED.json`` contract: JSON and binary
+    legs measured against the SAME live replica (rps = rows/s through
+    HTTP), the binary leg >= MIN_WIRE_BINARY_SPEEDUP x the committed
+    pre-wire baseline AND faster than the same-run JSON leg, p99 within
+    MAX_WIRE_P99_MS, parity within MAX_WIRE_PARITY, an encode/decode
+    wall split per frame, a through-router leg, ZERO post-warmup
+    compiles, and zero drops through a mid-run hot-swap."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    base = doc.get("baseline_fleet_http_rps")
+    if not (num(base) and base > 0):
+        errors.append("wire-speed artifact: missing positive "
+                      "'baseline_fleet_http_rps' (the committed "
+                      "pre-wire number being beaten)")
+    for leg in ("json", "binary"):
+        block = doc.get(leg)
+        if not (isinstance(block, dict) and num(block.get("rps"))
+                and block.get("rps", 0) > 0
+                and num(block.get("p50_ms"))
+                and num(block.get("p99_ms"))):
+            errors.append(f"wire-speed artifact: '{leg}' must record "
+                          "positive 'rps' + 'p50_ms'/'p99_ms'")
+    binary, json_leg = doc.get("binary"), doc.get("json")
+    if isinstance(binary, dict):
+        if not pos_int(binary.get("rows_per_frame")):
+            errors.append("wire-speed artifact: binary.rows_per_frame "
+                          "must be a positive int")
+        for k in ("encode_ms_per_frame", "decode_ms_per_frame"):
+            if not (num(binary.get(k)) and binary[k] >= 0):
+                errors.append(f"wire-speed artifact: binary.{k} "
+                              "missing (the codec wall split is the "
+                              "evidence the frame path is cheap)")
+        rps, p99 = binary.get("rps"), binary.get("p99_ms")
+        if num(rps) and num(base) and base > 0 \
+                and rps < MIN_WIRE_BINARY_SPEEDUP * base:
+            errors.append(
+                f"wire-speed bound violated: binary leg carries "
+                f"{rps:.0f} rows/s < {MIN_WIRE_BINARY_SPEEDUP:g}x the "
+                f"committed {base:g} rps baseline")
+        if num(p99) and p99 > MAX_WIRE_P99_MS:
+            errors.append(
+                f"wire-speed p99 bound violated: {p99}ms > "
+                f"{MAX_WIRE_P99_MS:g}ms")
+        if isinstance(json_leg, dict) and num(json_leg.get("rps")) \
+                and num(rps) and rps <= json_leg["rps"]:
+            errors.append(
+                "wire-speed artifact: the binary leg must beat the "
+                "same-run JSON leg — otherwise the wire is overhead")
+    router = doc.get("router")
+    if not (isinstance(router, dict) and num(router.get("json_rps"))
+            and router["json_rps"] > 0
+            and num(router.get("binary_rps"))
+            and router["binary_rps"] > 0):
+        errors.append("wire-speed artifact: 'router' must record "
+                      "positive 'json_rps' and 'binary_rps' (the "
+                      "passthrough leg)")
+    parity = doc.get("parity_vs_json")
+    if not num(parity):
+        errors.append("wire-speed artifact: missing numeric "
+                      "'parity_vs_json' (max |binary - json| score "
+                      "delta through the live server)")
+    elif parity > MAX_WIRE_PARITY:
+        errors.append(
+            f"wire parity violated: binary replies diverge from JSON "
+            f"replies by {parity} > {MAX_WIRE_PARITY:g}")
+    if not pos_int(doc.get("parity_rows")):
+        errors.append("wire-speed artifact: missing positive int "
+                      "'parity_rows'")
+    storm = doc.get("compile_storm")
+    if not isinstance(storm, dict) \
+            or not isinstance(storm.get("max_post_warmup_per_bucket"),
+                              int) \
+            or isinstance(storm.get("max_post_warmup_per_bucket"), bool):
+        errors.append("wire-speed artifact: 'compile_storm."
+                      "max_post_warmup_per_bucket' must be an int")
+    elif storm["max_post_warmup_per_bucket"] > 0:
+        errors.append(
+            "compile-storm bound violated: "
+            f"{storm['max_post_warmup_per_bucket']} post-warmup "
+            "compile(s) in some (lane, bucket) — framed traffic "
+            "recompiled")
+    swap = doc.get("swap")
+    if not (isinstance(swap, dict) and isinstance(swap.get("promoted"),
+                                                  str)
+            and swap.get("promoted")
+            and swap.get("zero_dropped") is True):
+        errors.append("wire-speed artifact: 'swap' must record the "
+                      "'promoted' version and 'zero_dropped': true — "
+                      "framed traffic must survive a mid-run hot-swap "
+                      "with every frame settled")
     return errors
 
 

@@ -693,3 +693,79 @@ def test_explain_overhead_artifact_committed_and_healthy(checker):
     assert art["swap"]["post_swap_lineage"] == art["swap"]["promoted"]
     assert art["groups"] >= 2 and art["parity_rows"] > 0
     assert art["ok"] is True
+
+
+def _wire_speed_good():
+    return {
+        "metric": "wire_speed", "platform": "cpu",
+        "requests": 400, "rows": 51200, "wall_s": 8.0,
+        "baseline_fleet_http_rps": 436.2,
+        "json": {"rps": 600.0, "p50_ms": 1.4, "p99_ms": 2.9},
+        "binary": {"rps": 52000.0, "p50_ms": 1.8, "p99_ms": 3.6,
+                   "rows_per_frame": 128,
+                   "encode_ms_per_frame": 0.21,
+                   "decode_ms_per_frame": 0.34},
+        "router": {"json_rps": 520.0, "binary_rps": 41000.0},
+        "speedup_vs_json": 86.7, "speedup_vs_baseline": 119.2,
+        "parity_vs_json": 3e-8, "parity_rows": 64,
+        "compile_storm": {"max_post_warmup_per_bucket": 0},
+        "swap": {"promoted": "v2", "zero_dropped": True},
+    }
+
+
+def test_wire_speed_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _wire_speed_good()
+    assert v(good) == []
+    binary = good["binary"]
+    assert any("baseline" in e for e in v(
+        {k: x for k, x in good.items()
+         if k != "baseline_fleet_http_rps"}))
+    assert any("binary leg carries" in e for e in v(
+        {**good, "binary": {**binary, "rps": 4000.0}}))
+    assert any("p99" in e for e in v(
+        {**good, "binary": {**binary, "p99_ms": 9.0}}))
+    assert any("rows_per_frame" in e for e in v(
+        {**good, "binary": {**binary, "rows_per_frame": 0}}))
+    assert any("decode_ms_per_frame" in e for e in v(
+        {**good, "binary": {k: x for k, x in binary.items()
+                            if k != "decode_ms_per_frame"}}))
+    assert any("beat the same-run JSON" in e for e in v(
+        {**good, "json": {"rps": 60000.0, "p50_ms": 1.0,
+                          "p99_ms": 2.0}}))
+    assert any("parity" in e for e in v(
+        {**good, "parity_vs_json": 1e-3}))
+    assert any("parity_rows" in e for e in v(
+        {**good, "parity_rows": 0}))
+    assert any("router" in e for e in v(
+        {**good, "router": {"json_rps": 520.0, "binary_rps": 0}}))
+    assert any("compile-storm" in e for e in v(
+        {**good, "compile_storm": {"max_post_warmup_per_bucket": 3}}))
+    assert any("swap" in e for e in v(
+        {**good, "swap": {"promoted": "v2", "zero_dropped": False}}))
+    assert any("swap" in e for e in v(
+        {**good, "swap": {"promoted": "", "zero_dropped": True}}))
+
+
+def test_wire_speed_artifact_committed_and_healthy(checker):
+    """The round-16 acceptance contract on the COMMITTED artifact:
+    single-replica binary-wire HTTP >= 10x the committed 436 rps
+    pre-wire fleet rate with p99 < 5ms, binary-vs-JSON parity <= 1e-5
+    through the live server, an encode/decode wall split per frame, a
+    through-router passthrough leg, ZERO post-warmup compiles, and zero
+    drops through a mid-run hot-swap."""
+    path = os.path.join(REPO, "benchmarks", "WIRE_SPEED.json")
+    assert os.path.exists(path), \
+        "benchmarks/WIRE_SPEED.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "wire_speed"
+    assert art["binary"]["rps"] >= (checker.MIN_WIRE_BINARY_SPEEDUP
+                                    * art["baseline_fleet_http_rps"])
+    assert art["binary"]["rps"] > art["json"]["rps"]
+    assert art["binary"]["p99_ms"] <= checker.MAX_WIRE_P99_MS
+    assert art["parity_vs_json"] <= checker.MAX_WIRE_PARITY
+    assert art["parity_rows"] > 0
+    assert art["router"]["binary_rps"] > 0
+    assert art["compile_storm"]["max_post_warmup_per_bucket"] == 0
+    assert art["swap"]["zero_dropped"] is True

@@ -1,0 +1,357 @@
+"""Binary columnar scoring wire (``serving/wireformat.py`` +
+the live-server frame lane): property-style codec round trips across
+every dtype (nulls, unicode, empty batches), corrupt/truncated-frame
+rejection with clean 400s, JSON-vs-binary parity through a LIVE HTTP
+server, and the NDJSON compat lane — ONE shared module-scoped model."""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.serving import wireformat as wf
+
+# -- pure codec (no model, no jax) -------------------------------------------
+
+_ALL_DTYPES = (wf.F64, wf.F32, wf.I64, wf.I32, wf.BOOL, wf.TEXT,
+               wf.JSONCOL)
+
+#: unicode corpus: multibyte, astral-plane, RTL, combining, empty
+_TEXTS = ["", "plain", "héllo wörld", "日本語のテキスト", "🚀🧪💡",
+          "مرحبا بالعالم", "éclair", "tab\tand\nnewline",
+          "null\x00byte"]
+
+
+def _random_column(rng, name, n):
+    """One random WireColumn of a random dtype (possibly masked)."""
+    dtype = _ALL_DTYPES[int(rng.integers(len(_ALL_DTYPES)))]
+    masked = bool(rng.integers(2)) and n > 0
+    mask = None
+    if masked:
+        mask = rng.integers(0, 2, size=n).astype(bool)
+        if n:
+            mask[int(rng.integers(n))] = True  # keep >= 1 present
+    if dtype == wf.F64:
+        vals = rng.normal(size=n).astype(np.float64)
+    elif dtype == wf.F32:
+        vals = rng.normal(size=n).astype(np.float32)
+    elif dtype == wf.I64:
+        vals = rng.integers(-2**40, 2**40, size=n).astype(np.int64)
+    elif dtype == wf.I32:
+        vals = rng.integers(-2**20, 2**20, size=n).astype(np.int32)
+    elif dtype == wf.BOOL:
+        vals = rng.integers(0, 2, size=n).astype(np.uint8)
+    elif dtype == wf.TEXT:
+        vals = [None if (mask is not None and not mask[i])
+                else _TEXTS[int(rng.integers(len(_TEXTS)))]
+                for i in range(n)]
+        mask = None  # text nulls ride the values, not the bitmap
+    else:  # JSONCOL: arbitrary nested python values
+        pool = [None, 1, 2.5, True, "s", {"a": [1, 2]}, ["x", {"y": 3}],
+                {"uni": "héllo"}]
+        vals = [pool[int(rng.integers(len(pool)))] for _ in range(n)]
+        mask = None
+    return wf.WireColumn(name, dtype, vals, mask)
+
+
+def _assert_column_equal(sent: wf.WireColumn, got: wf.WireColumn, n):
+    assert got.dtype == sent.dtype
+    if sent.dtype in (wf.TEXT, wf.JSONCOL):
+        assert list(got.values) == list(sent.values)
+        return
+    sent_mask = sent.mask if sent.mask is not None \
+        else np.ones(n, bool)
+    got_mask = got.mask if got.mask is not None else np.ones(n, bool)
+    assert np.array_equal(sent_mask, got_mask)
+    sv = np.asarray(sent.values)[sent_mask]
+    gv = np.asarray(got.values)[got_mask]
+    assert gv.dtype == sv.dtype
+    assert np.array_equal(sv, gv)
+
+
+def test_roundtrip_random_schemas():
+    """Property-style: 30 random (schema, batch) pairs — every dtype,
+    random null bitmaps, unicode text, zero-row and zero-column frames
+    — survive encode -> decode exactly."""
+    rng = np.random.default_rng(11)
+    for trial in range(30):
+        n = int(rng.integers(0, 41))
+        n_cols = int(rng.integers(0, 7))
+        cols = [_random_column(rng, f"c{j}_é", n)
+                for j in range(n_cols)]
+        meta = {"trial": trial, "uni": "méta"} \
+            if rng.integers(2) else None
+        buf = wf.encode_frame(f"model-{trial}-ü", cols, n,
+                              meta=meta)
+        assert wf.peek_model_id(buf) == f"model-{trial}-ü"
+        frame = wf.decode_frame(buf)
+        assert frame.kind == wf.KIND_REQUEST
+        assert frame.n_rows == n
+        assert frame.meta == (meta or {})
+        assert list(frame.columns) == [c.name for c in cols]
+        for c in cols:
+            _assert_column_equal(c, frame.columns[c.name], n)
+
+
+def test_roundtrip_empty_batch_and_empty_frame():
+    buf = wf.encode_frame("m", [], 0)
+    frame = wf.decode_frame(buf)
+    assert frame.n_rows == 0 and frame.columns == {}
+    # zero rows but a declared schema
+    cols = [wf.WireColumn("x", wf.F64, np.zeros(0)),
+            wf.WireColumn("t", wf.TEXT, [])]
+    frame = wf.decode_frame(wf.encode_frame("m", cols, 0))
+    assert frame.n_rows == 0
+    assert list(frame.columns) == ["x", "t"]
+
+
+def test_rows_to_columns_roundtrip_rows():
+    rows = [{"x": 1.5, "b": True, "s": "héllo", "j": {"k": [1]}},
+            {"x": None, "b": False, "s": None, "j": None},
+            {"x": -2.0, "b": None, "s": "🚀", "j": [3, 4]}]
+    frame = wf.decode_frame(wf.encode_rows("m", rows))
+    assert wf.frame_to_rows(frame) == rows
+
+
+def test_reply_roundtrip_dotted_names():
+    cols = wf.reply_columns(
+        {"pred.prediction": np.array([1.0, 0.0]),
+         "pred.probability_0": np.array([0.25, 0.75], np.float64),
+         "plain": [{"a": 1}, None]}, 2)
+    frame = wf.decode_frame(
+        wf.encode_frame("m", cols, 2, kind=wf.KIND_REPLY))
+    rows = wf.reply_to_rows(frame)
+    assert rows[0]["pred"] == {"prediction": 1.0, "probability_0": 0.25}
+    assert rows[1]["plain"] is None
+
+
+def test_truncated_frames_rejected():
+    """Every proper prefix of a valid frame is a clean
+    ``WireFormatError`` — never an IndexError/struct.error crash."""
+    cols = [wf.WireColumn("x", wf.F64, np.arange(5.0)),
+            wf.WireColumn("t", wf.TEXT, list("abcde"))]
+    buf = wf.encode_frame("model-1", cols, 5)
+    step = max(len(buf) // 64, 1)  # sample prefixes, always incl. 0
+    for cut in list(range(0, len(buf), step)) + [len(buf) - 1]:
+        with pytest.raises(wf.WireFormatError):
+            wf.decode_frame(buf[:cut])
+    with pytest.raises(wf.WireFormatError):
+        wf.peek_model_id(buf[:wf.MODEL_ID_OFFSET + 2])
+
+
+def test_corrupt_frames_rejected():
+    cols = [wf.WireColumn("x", wf.F64, np.arange(4.0))]
+    good = bytearray(wf.encode_frame("m", cols, 4))
+    bad_magic = bytearray(good)
+    bad_magic[4:8] = b"NOPE"
+    with pytest.raises(wf.WireFormatError, match="magic"):
+        wf.decode_frame(bytes(bad_magic))
+    bad_version = bytearray(good)
+    bad_version[8] = 99
+    with pytest.raises(wf.WireFormatError, match="version"):
+        wf.decode_frame(bytes(bad_version))
+    bad_kind = bytearray(good)
+    bad_kind[9] = 77
+    with pytest.raises(wf.WireFormatError):
+        wf.decode_frame(bytes(bad_kind))
+    # frame_len lying about the payload size
+    lies = bytearray(good)
+    lies[0:4] = (len(good) * 3).to_bytes(4, "little")
+    with pytest.raises(wf.WireFormatError):
+        wf.decode_frame(bytes(lies))
+    # oversize declaration: refused before any allocation
+    huge = bytearray(good)
+    huge[0:4] = (wf.MAX_FRAME_BYTES + 1).to_bytes(4, "little")
+    with pytest.raises(wf.WireFormatError):
+        wf.decode_frame(bytes(huge))
+
+
+def test_random_garbage_rejected():
+    rng = np.random.default_rng(5)
+    for _ in range(50):
+        blob = rng.integers(0, 256,
+                            size=int(rng.integers(0, 200))).astype(
+                                np.uint8).tobytes()
+        with pytest.raises(wf.WireFormatError):
+            wf.decode_frame(blob)
+
+
+def test_text_offsets_must_be_monotonic():
+    cols = [wf.WireColumn("t", wf.TEXT, ["aa", "bb", "cc"])]
+    buf = bytearray(wf.encode_frame("m", cols, 3))
+    # the offsets vector is the first 8-byte-aligned buffer after the
+    # column table; flip one offset pair to be decreasing
+    base = buf.rfind(b"aabbcc") - 4 * 4
+    buf[base + 4:base + 8] = (6).to_bytes(4, "little")
+    with pytest.raises(wf.WireFormatError):
+        wf.decode_frame(bytes(buf))
+
+
+# -- live server (ONE shared module-scoped model) ----------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    """One model, one running fleet HTTP endpoint for every live-wire
+    test in this module."""
+    from test_serving import _make_model
+    from transmogrifai_tpu.serving import FleetServer
+    model, rows = _make_model()
+    fleet = FleetServer(max_batch=16, max_wait_ms=1.0, metrics_port=0)
+    fleet.register(model=model, model_id="m1")
+    fleet.start()
+    try:
+        yield {"fleet": fleet, "model": model, "rows": rows,
+               "port": fleet.metrics_http.port}
+    finally:
+        fleet.stop()
+
+
+def _conn(served):
+    return http.client.HTTPConnection("127.0.0.1", served["port"],
+                                      timeout=30)
+
+
+def _post(conn, path, body, ctype="application/json"):
+    conn.request("POST", path, body, {"Content-Type": ctype})
+    resp = conn.getresponse()
+    return resp.status, resp.getheader("Content-Type"), resp.read()
+
+
+def test_live_json_vs_binary_parity(served):
+    """The same 24 rows through the JSON wire (one POST per row) and
+    the binary frame wire (one POST total) agree to 1e-9 on every
+    score field, and the framed reply carries trace + lineage meta."""
+    rows = served["rows"][:24]
+    conn = _conn(served)
+    json_docs = []
+    for r in rows:
+        status, ctype, body = _post(conn, "/score/m1", json.dumps(r))
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        doc.pop("traceId"), doc.pop("lineage")
+        json_docs.append(doc)
+    status, ctype, body = _post(conn, "/score/m1",
+                                wf.encode_rows("m1", rows),
+                                ctype=wf.CONTENT_TYPE_FRAME)
+    assert status == 200
+    assert ctype == wf.CONTENT_TYPE_FRAME
+    reply = wf.decode_frame(body)
+    assert reply.kind == wf.KIND_REPLY
+    assert reply.meta["lineage"]["modelId"] == "m1"
+    frame_docs = wf.reply_to_rows(reply)
+    assert len(frame_docs) == len(json_docs)
+    from test_serving import _diff
+    worst = max(_diff(a, b) for a, b in zip(json_docs, frame_docs))
+    assert worst <= 1e-9, worst
+    conn.close()
+
+
+def test_live_model_id_from_frame_header(served):
+    """POST /score with no path id: the frame header's model id
+    routes."""
+    conn = _conn(served)
+    status, ctype, body = _post(conn, "/score",
+                                wf.encode_rows("m1", served["rows"][:3]),
+                                ctype=wf.CONTENT_TYPE_FRAME)
+    assert status == 200 and ctype == wf.CONTENT_TYPE_FRAME
+    assert wf.decode_frame(body).n_rows == 3
+    conn.close()
+
+
+def test_live_corrupt_frame_400_connection_survives(served):
+    """Truncated and garbage frames answer 400 with a JSON error body —
+    and the keep-alive connection keeps serving afterwards."""
+    good = wf.encode_rows("m1", served["rows"][:4])
+    conn = _conn(served)
+    for bad in (good[: len(good) // 2], b"\x00" * 40, b""):
+        status, ctype, body = _post(conn, "/score/m1", bad,
+                                    ctype=wf.CONTENT_TYPE_FRAME)
+        assert status == 400, (bad[:16], status, body)
+        assert ctype == "application/json"
+        doc = json.loads(body)
+        assert "error" in doc and doc["traceId"]
+    # same socket still scores
+    status, ctype, body = _post(conn, "/score/m1", good,
+                                ctype=wf.CONTENT_TYPE_FRAME)
+    assert status == 200
+    conn.close()
+
+
+def test_live_unknown_model_frame_404(served):
+    conn = _conn(served)
+    status, _, body = _post(conn, "/score/ghost",
+                            wf.encode_rows("ghost", served["rows"][:2]),
+                            ctype=wf.CONTENT_TYPE_FRAME)
+    assert status == 404
+    assert "error" in json.loads(body)
+    conn.close()
+
+
+def test_live_empty_frame(served):
+    conn = _conn(served)
+    status, ctype, body = _post(conn, "/score/m1",
+                                wf.encode_frame("m1", [], 0),
+                                ctype=wf.CONTENT_TYPE_FRAME)
+    assert status == 200 and ctype == wf.CONTENT_TYPE_FRAME
+    assert wf.decode_frame(body).n_rows == 0
+    conn.close()
+
+
+def test_live_ndjson_compat(served):
+    """NDJSON stays served on the same port: one doc per line, same
+    order, a poison middle line answers INLINE without voiding the
+    batch."""
+    rows = served["rows"][:5]
+    lines = [json.dumps(r) for r in rows]
+    lines[2] = "{not json"
+    conn = _conn(served)
+    status, ctype, body = _post(conn, "/score/m1",
+                                "\n".join(lines) + "\n",
+                                ctype="application/x-ndjson")
+    assert status == 200
+    assert ctype == "application/x-ndjson"
+    docs = [json.loads(ln) for ln in body.splitlines() if ln.strip()]
+    assert len(docs) == 5
+    for i, d in enumerate(docs):
+        if i == 2:
+            assert "error" in d
+        else:
+            assert "error" not in d and "prediction" in str(d)
+    conn.close()
+
+
+def test_live_json_lane_unchanged(served):
+    """Plain JSON clients are untouched by the wire work: default
+    content type still scores one row -> one document."""
+    conn = _conn(served)
+    status, ctype, body = _post(conn, "/score/m1",
+                                json.dumps(served["rows"][0]))
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["lineage"]["modelId"] == "m1" and doc["traceId"]
+    conn.close()
+
+
+def test_wire_json_pins_endpoint_json_only(served):
+    """``wire="json"`` (the CLI's --wire json) disables frame
+    negotiation: frame POSTs answer 400, JSON keeps working."""
+    from transmogrifai_tpu.serving import FleetServer
+    fleet = FleetServer(max_batch=16, max_wait_ms=1.0, metrics_port=0,
+                        wire="json")
+    fleet.register(model=served["model"], model_id="m1")
+    fleet.start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", fleet.metrics_http.port, timeout=30)
+        status, _, body = _post(conn, "/score/m1",
+                                wf.encode_rows("m1", served["rows"][:2]),
+                                ctype=wf.CONTENT_TYPE_FRAME)
+        assert status == 400
+        assert "unsupported" in json.loads(body)["error"]
+        status, _, body = _post(conn, "/score/m1",
+                                json.dumps(served["rows"][0]))
+        assert status == 200
+        conn.close()
+    finally:
+        fleet.stop()

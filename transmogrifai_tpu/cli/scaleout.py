@@ -52,6 +52,13 @@ def add_scaleout_args(sp: argparse.ArgumentParser) -> None:
                          "(default 2)")
     sp.add_argument("--max-batch", type=int, default=64)
     sp.add_argument("--queue-capacity", type=int, default=256)
+    sp.add_argument("--wire", choices=("binary", "json"),
+                    default="binary",
+                    help="binary (default): replicas negotiate the "
+                         "columnar frame wire alongside JSON/NDJSON "
+                         "(the router forwards frames opaquely either "
+                         "way); json: pin replicas JSON-only — frame "
+                         "POSTs answer 400 (docs/WIRE.md)")
     sp.add_argument("--no-artifacts", action="store_true",
                     help="skip the shared compiled-program artifact "
                          "layer")
@@ -123,7 +130,8 @@ def run_scaleout(args: argparse.Namespace) -> int:
         with open(args.warmup) as fh:
             warm = json.load(fh)
     worker_args = ["--max-batch", str(args.max_batch),
-                   "--queue-capacity", str(args.queue_capacity)]
+                   "--queue-capacity", str(args.queue_capacity),
+                   "--wire", args.wire]
     stack = ScaleoutStack(
         args.model_dir, args.state_dir,
         replicas=args.replicas, port=args.port, host=args.host,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Iterable, Optional
@@ -97,6 +98,16 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                          "attributions (docs/INSIGHTS.md). HTTP scoring "
                          "(--metrics-port, fleet mode) also accepts an "
                          "opt-in per-request {\"explain\": true|K} field")
+    sp.add_argument("--wire", choices=("json", "binary"),
+                    default="json",
+                    help="replay encoding: json (default) submits each "
+                         "row as-is; binary packs contiguous rows into "
+                         "length-prefixed columnar frames (up to "
+                         "--max-batch rows each) and drives the full "
+                         "encode -> column-path score -> decode wire "
+                         "round trip (docs/WIRE.md). Output is "
+                         "identical either way: one JSON score line "
+                         "per input line")
     sp.add_argument("--metrics-port", type=int, default=None,
                     help="serve GET /metrics (Prometheus exposition) and "
                          "/healthz on this port while scoring (0 = "
@@ -149,6 +160,58 @@ def _read_rows(path: str) -> Iterable[dict]:
             line = line.strip()
             if line:
                 yield json.loads(line)
+
+
+class _FrameChunk:
+    """One ``--wire binary`` window item: a frame of contiguous input
+    rows for one model. ``item`` is the frame future, or the
+    encode/admission exception; drain fans the framed reply back out to
+    one score document per row, at each row's input slot."""
+
+    __slots__ = ("model_id", "rows", "item")
+
+    def __init__(self, model_id: str, rows: list, item: Any):
+        self.model_id = model_id
+        self.rows = rows
+        self.item = item
+
+
+def _submit_frame_chunk(submit_fn, model_id: str,
+                        rows: list) -> _FrameChunk:
+    """rows -> request frame BYTES -> decode -> submit. The replay
+    deliberately runs the client codec in both directions so ``--wire
+    binary`` proves the wire end to end, not just the column scorer."""
+    from transmogrifai_tpu.serving import wireformat as wf
+    from transmogrifai_tpu.serving.batcher import absorb_backpressure
+    try:
+        frame = wf.decode_frame(wf.encode_rows(model_id, rows))
+        fut = absorb_backpressure(lambda: submit_fn(frame))
+        return _FrameChunk(model_id, rows, fut)
+    except Exception as e:  # noqa: BLE001 — chunk-level admission error
+        return _FrameChunk(model_id, rows, e)
+
+
+def _frame_chunk_docs(chunk: _FrameChunk) -> list:
+    """Settle one frame chunk into per-row score documents (reply
+    columns -> reply frame bytes -> decode -> rows). A chunk-level
+    failure errors every row of the chunk — the frame is the admission
+    unit; per-row failures inside a scored frame ride the reply's
+    ``error`` column instead."""
+    from transmogrifai_tpu.serving import wireformat as wf
+    n = len(chunk.rows)
+    item = chunk.item
+    if not isinstance(item, Exception):
+        try:
+            kind, result = item.result()
+            cols = wf.reply_columns(result, n) if kind == "columns" \
+                else wf.rows_to_reply_columns(result)
+            reply = wf.decode_frame(wf.encode_frame(
+                chunk.model_id, cols, n, kind=wf.KIND_REPLY))
+            return wf.reply_to_rows(reply)
+        except Exception as e:  # noqa: BLE001 — per-chunk report
+            item = e
+    return [{"error": f"{type(item).__name__}: {item}"}
+            for _ in range(n)]
 
 
 def _observability_setup(args, app_name: str):
@@ -211,6 +274,12 @@ def run_serve(args: argparse.Namespace) -> int:
         print("serve: pass exactly one of --model (single model) or "
               "--model-dir (fleet)", file=sys.stderr)
         return 2
+    if args.wire == "binary" and args.explain_top_k is not None:
+        print("serve: --wire binary and --explain-top-k are exclusive "
+              "in replay — explained replays ride the row lane (HTTP "
+              "frame clients opt in per request via frame meta "
+              "{\"explain\": K})", file=sys.stderr)
+        return 2
     slo = _observability_setup(args, "transmogrifai_tpu.serve")
     if args.model_dir is not None:
         return _run_serve_fleet(args, slo)
@@ -228,15 +297,32 @@ def run_serve(args: argparse.Namespace) -> int:
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     t0 = time.monotonic()
     n = n_err = 0
-    #: (index, future | error) in submit order; drained whenever the
-    #: window exceeds the queue so output order == input order without
-    #: materializing every request first
+    binary = args.wire == "binary"
+    #: (index, future | error | _FrameChunk) in submit order; drained
+    #: whenever the window exceeds the queue so output order == input
+    #: order without materializing every request first
     window: list[tuple[int, Any]] = []
     warmed = args.no_warmup
+    #: --wire binary: rows awaiting their frame (flushed at --max-batch)
+    chunk: list = []
+    frame_mid = os.path.basename(
+        os.path.normpath(args.model)) or "model"
+
+    def flush_chunk() -> None:
+        if chunk:
+            window.append((-1, _submit_frame_chunk(
+                server.submit_frame, frame_mid, chunk[:])))
+            chunk.clear()
 
     def drain() -> None:
         nonlocal n_err
         for _, item in window:
+            if isinstance(item, _FrameChunk):
+                for doc in _frame_chunk_docs(item):
+                    if doc.get("error") is not None:
+                        n_err += 1
+                    out.write(json.dumps(doc, default=str) + "\n")
+                continue
             if isinstance(item, Exception):
                 doc = {"error": f"{type(item).__name__}: {item}"}
                 n_err += 1
@@ -260,7 +346,11 @@ def run_serve(args: argparse.Namespace) -> int:
                 server.start(warmup_row=row)  # non-fatal on a bad row
                 warmed = True
             try:
-                if explaining:
+                if binary:
+                    chunk.append(row)
+                    if len(chunk) >= max(args.max_batch, 1):
+                        flush_chunk()
+                elif explaining:
                     window.append((i, server.submit_explain_blocking(row)))
                 else:
                     window.append((i, server.submit_blocking(row)))
@@ -269,10 +359,14 @@ def run_serve(args: argparse.Namespace) -> int:
             n += 1
             if len(window) >= args.queue_capacity:
                 drain()
+        flush_chunk()
         drain()
     except GracefulShutdown:
         # SIGTERM: stop ADMITTING, but every already-submitted request
         # settles and lands in the output at its slot before exit
+        # (rows already read into a pending frame chunk count as
+        # submitted — their output lines were promised)
+        flush_chunk()
         drain()
         print("# SIGTERM: drained and stopped cleanly", file=sys.stderr)
     finally:
@@ -325,16 +419,37 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     t0 = time.monotonic()
     n = n_err = 0
+    binary = args.wire == "binary"
     window: list[tuple[int, Any]] = []
     #: per-model lanes warm on their first routed row (cf. the
     #: single-model path's first-row warmup; a bad first row only costs
     #: that model lazy compiles). --no-warmup pre-marks every model so
     #: buckets compile lazily, same as the single-model flag
     warmed: set = set(model_ids) if args.no_warmup else set()
+    #: --wire binary: contiguous same-model rows awaiting their frame
+    #: (flushed at --max-batch or when the routed model id changes —
+    #: frames are per-model, output order stays per-line)
+    chunk: list = []
+    chunk_mid: Optional[str] = None
+
+    def flush_chunk() -> None:
+        nonlocal chunk_mid
+        if chunk:
+            mid = chunk_mid
+            window.append((-1, _submit_frame_chunk(
+                lambda fr: fleet.submit_frame(mid, fr), mid, chunk[:])))
+            chunk.clear()
+        chunk_mid = None
 
     def drain() -> None:
         nonlocal n_err
         for _, item in window:
+            if isinstance(item, _FrameChunk):
+                for doc in _frame_chunk_docs(item):
+                    if doc.get("error") is not None:
+                        n_err += 1
+                    out.write(json.dumps(doc, default=str) + "\n")
+                continue
             if isinstance(item, Exception):
                 doc = {"error": f"{type(item).__name__}: {item}"}
                 n_err += 1
@@ -368,18 +483,30 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
                     if lane is not None:
                         lane.start(warmup_row=dict(row))
                     warmed.add(mid)
-                if explaining:
+                if binary:
+                    if chunk and mid != chunk_mid:
+                        flush_chunk()
+                    chunk_mid = mid
+                    chunk.append(row)
+                    if len(chunk) >= max(args.max_batch, 1):
+                        flush_chunk()
+                elif explaining:
                     window.append(
                         (i, fleet.submit_explain_blocking(mid, row)))
                 else:
                     window.append((i, fleet.submit_blocking(mid, row)))
             except (KeyError, UnknownModelError) as e:
+                # pending frame rows precede this row: flush first so
+                # the error line lands at its input slot
+                flush_chunk()
                 window.append((i, e))
             n += 1
             if len(window) >= args.queue_capacity:
                 drain()
+        flush_chunk()
         drain()
     except GracefulShutdown:
+        flush_chunk()
         drain()
         print("# SIGTERM: drained and stopped cleanly", file=sys.stderr)
     finally:

@@ -26,11 +26,14 @@ Routing policy:
 - every proxied reply carries ``X-Served-By: <replica_id>`` so a load
   harness can prove where traffic actually went.
 
-The router itself is model-free and jax-free: it proxies bytes. Its
-``/metrics`` renders ``transmogrifai_router_*`` plus the standard
-process series; ``/healthz`` reports the replica table and SLO state
-(the router's own availability/latency objectives can drive the
-autoscaler's scale-up signal). Chaos seam: ``fault_point
+The router itself is model-free and jax-free: it proxies bytes. A
+binary columnar frame (``application/x-tmog-frame``) is routed by
+PEEKING the fixed-offset model id in its header (``wireformat.
+peek_model_id``) and forwarded as opaque bytes — the router never
+decodes a column. Its ``/metrics`` renders ``transmogrifai_router_*``
+plus the standard process series; ``/healthz`` reports the replica
+table and SLO state (the router's own availability/latency objectives
+can drive the autoscaler's scale-up signal). Chaos seam: ``fault_point
 ("scaleout.route")`` fires per proxy attempt.
 """
 
@@ -42,10 +45,15 @@ import http.client
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from transmogrifai_tpu.serving.aiohttp_core import (
+    AsyncHTTPServer, Request, Response,
+)
 from transmogrifai_tpu.serving.metrics import LATENCY_BUCKETS_S
+from transmogrifai_tpu.serving.wireformat import (
+    CONTENT_TYPE_FRAME, WireFormatError, peek_model_id,
+)
 from transmogrifai_tpu.utils.events import events
 
 __all__ = ["ConsistentHashRing", "Router", "RouterMetrics",
@@ -215,10 +223,11 @@ class _Replica:
 
 class Router:
     """HTTP front proxying ``POST /score[/<model_id>]`` across replica
-    workers (see module docstring for the policy). Thread-per-connection
-    (``ThreadingHTTPServer``) with one upstream keep-alive connection
-    per (handler thread, replica) — the hop costs a request/response on
-    a warm socket, not a handshake."""
+    workers (see module docstring for the policy). The front is the
+    shared event-loop core (``serving/aiohttp_core.py``); the sync
+    ``dispatch`` runs on its bounded thread pool with one upstream
+    keep-alive connection per (pool thread, replica) — the hop costs a
+    request/response on a warm socket, not a handshake."""
 
     def __init__(self, *, port: int = 0, host: str = "127.0.0.1",
                  spill: int = 2, vnodes: int = 64,
@@ -234,8 +243,7 @@ class Router:
         self._lock = threading.Lock()
         self._host = host
         self._requested_port = int(port)
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._http: Optional[AsyncHTTPServer] = None
         self._tls = threading.local()
         #: SLO engine over ROUTER-observed traffic (availability /
         #: latency objectives; the autoscaler's burn signal)
@@ -408,7 +416,7 @@ class Router:
     # -- HTTP front -----------------------------------------------------------
     @property
     def port(self) -> Optional[int]:
-        return self._httpd.server_address[1] if self._httpd else None
+        return self._http.port if self._http else None
 
     def _registry(self):
         if self._registry_obj is None:
@@ -430,126 +438,97 @@ class Router:
         fold_health(self.slo_engine, doc)
         return doc
 
-    def start(self) -> "Router":
-        if self._httpd is not None:
-            return self
-        outer = self
+    async def _do_get(self, req: Request) -> Response:
+        path = req.path
+        try:
+            if path == "/metrics":
+                from transmogrifai_tpu.utils.prometheus import (
+                    CONTENT_TYPE,
+                )
+                body = (await self._http.run_blocking(
+                    lambda: self._registry().render())).encode()
+                return Response(200, body, CONTENT_TYPE)
+            if path == "/healthz":
+                doc = await self._http.run_blocking(self.health)
+                return Response(200, (json.dumps(doc) + "\n").encode())
+            if path == "/replicas":
+                return Response(200, (json.dumps(self.replicas())
+                                      + "\n").encode())
+            return Response.error(404, "only /metrics, /healthz, "
+                                       "/replicas, POST /score")
+        except Exception as e:  # noqa: BLE001 — a probe must see the failure
+            return Response.error(500, f"{type(e).__name__}: "
+                                       f"{str(e)[:200]}")
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # TCP_NODELAY: headers and body flush as separate
-            # writes; Nagle would hold the body for a delayed
-            # ACK (~40ms/request on ACK-delaying kernels)
-            disable_nagle_algorithm = True
-
-            def _reply(self, code, body, ctype="application/json",
-                       extra=None):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in (extra or {}).items():
-                    if k.lower() not in ("content-length", "connection",
-                                         "transfer-encoding", "server",
-                                         "date", "content-type"):
-                        self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?")[0]
+    async def _do_post(self, req: Request) -> Response:
+        t0 = time.monotonic()
+        path = req.path
+        if not (path == "/score" or path.startswith("/score/")):
+            return Response.error(404, "POST /score[/<model>]")
+        body = req.body or b"{}"
+        ctype = (req.header("content-type") or "").split(";")[0].strip()
+        is_frame = ctype == CONTENT_TYPE_FRAME
+        model_id = path[len("/score/"):] \
+            if path.startswith("/score/") else ""
+        if not model_id:
+            if is_frame:
+                # routing key from the frame's FIXED-OFFSET header — the
+                # columns stay opaque bytes all the way to the replica
                 try:
-                    if path == "/metrics":
-                        from transmogrifai_tpu.utils.prometheus import (
-                            CONTENT_TYPE,
-                        )
-                        self._reply(200,
-                                    outer._registry().render().encode(),
-                                    CONTENT_TYPE)
-                    elif path == "/healthz":
-                        self._reply(200, (json.dumps(outer.health())
-                                          + "\n").encode())
-                    elif path == "/replicas":
-                        self._reply(200, (json.dumps(outer.replicas())
-                                          + "\n").encode())
-                    else:
-                        self.send_error(404, "only /metrics, /healthz, "
-                                             "/replicas, POST /score")
-                except Exception as e:  # noqa: BLE001 — a probe must see the failure
-                    self.send_error(500, f"{type(e).__name__}: "
-                                         f"{str(e)[:200]}")
-
-            def do_POST(self):  # noqa: N802 — http.server API
-                t0 = time.monotonic()
-                path = self.path.split("?")[0]
-                if not (path == "/score" or path.startswith("/score/")):
-                    self.send_error(404, "POST /score[/<model>]")
-                    return
-                from transmogrifai_tpu.serving.http import MAX_BODY_BYTES
-                if self.headers.get("Transfer-Encoding"):
-                    # an unread chunked body would desync keep-alive
-                    self.send_error(411, "chunked bodies unsupported; "
-                                         "send Content-Length")
-                    return
+                    model_id = peek_model_id(body)
+                except WireFormatError as e:
+                    return Response(400, (json.dumps(
+                        {"error": str(e)[:300]}) + "\n").encode())
+            else:
+                # routing key from the body's route field (popped by
+                # the replica fleet anyway)
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(body or b"{}")
+                    model_id = str(doc.get(self.route_field, ""))
                 except ValueError:
-                    self.send_error(400, "malformed Content-Length")
-                    return
-                if n < 0:
-                    self.send_error(400, "negative Content-Length")
-                    return
-                if n > MAX_BODY_BYTES:
-                    self.send_error(413, "request body too large")
-                    return
-                body = self.rfile.read(n) if n else b"{}"
-                model_id = path[len("/score/"):] \
-                    if path.startswith("/score/") else ""
-                if not model_id:
-                    # routing key from the body's route field (popped by
-                    # the replica fleet anyway)
-                    try:
-                        doc = json.loads(body or b"{}")
-                        model_id = str(doc.get(outer.route_field, ""))
-                    except ValueError:
-                        model_id = ""
-                    if not model_id:
-                        self._reply(400, json.dumps(
-                            {"error": "no model id (path or "
-                                      f"{outer.route_field!r} field)"}
-                        ).encode())
-                        return
-                fwd = {"Content-Type": "application/json"}
-                trace = self.headers.get("X-Trace-Id")
-                if trace:
-                    fwd["X-Trace-Id"] = trace
-                status, rheaders, payload, rid = outer.dispatch(
-                    model_id, body, fwd)
-                outer.metrics.record(rid, status,
-                                     time.monotonic() - t0)
-                extra = {k: v for k, v in rheaders.items()
-                         if k.lower() in ("x-trace-id", "retry-after")}
-                if rid is not None:
-                    extra["X-Served-By"] = rid
-                self._reply(status, payload, extra=extra)
+                    model_id = ""
+            if not model_id:
+                return Response(400, (json.dumps(
+                    {"error": "no model id (path or "
+                              f"{self.route_field!r} field)"}
+                ).encode()))
+        fwd = {"Content-Type":
+               CONTENT_TYPE_FRAME if is_frame else "application/json"}
+        trace = req.header("x-trace-id")
+        if trace:
+            fwd["X-Trace-Id"] = trace
+        status, rheaders, payload, rid = \
+            await self._http.run_blocking(
+                self.dispatch, model_id, body, fwd)
+        self.metrics.record(rid, status, time.monotonic() - t0)
+        extra = {k: v for k, v in rheaders.items()
+                 if k.lower() in ("x-trace-id", "retry-after")}
+        if rid is not None:
+            extra["X-Served-By"] = rid
+        rtype = next((v for k, v in rheaders.items()
+                      if k.lower() == "content-type"),
+                     "application/json")
+        return Response(status, payload, rtype, extra)
 
-            def log_message(self, *args):
-                pass
+    async def _handle(self, req: Request) -> Response:
+        if req.method == "GET":
+            return await self._do_get(req)
+        if req.method == "POST":
+            return await self._do_post(req)
+        return Response.error(404, f"method {req.method} unsupported")
 
-        self._httpd = ThreadingHTTPServer(
-            (self._host, self._requested_port), Handler)
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="transmogrifai-scaleout-router", daemon=True)
-        self._thread.start()
+    def start(self) -> "Router":
+        if self._http is not None:
+            return self
+        from transmogrifai_tpu.serving.http import MAX_BODY_BYTES
+        self._http = AsyncHTTPServer(
+            self._handle, port=self._requested_port, host=self._host,
+            max_body_bytes=MAX_BODY_BYTES,
+            name="transmogrifai-scaleout-router").start()
         return self
 
     def stop(self) -> None:
-        if self._httpd is None:
+        if self._http is None:
             return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        self._http.stop()
+        self._http = None

@@ -19,22 +19,26 @@ burning accelerator time. The REAL replica (``scaleout/worker.py``)
 is covered by its own end-to-end test and the committed scale-out
 bench; this stub exists so everything around it is cheap to exercise.
 
-Imports only the stdlib + ``scaleout/wire.py`` — keep it that way.
+Imports only the stdlib + ``scaleout/wire.py`` + the stdlib-only
+``serving/aiohttp_core.py`` event-loop HTTP core — keep it that way.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
 import signal
 import sys
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from transmogrifai_tpu.scaleout import wire
 from transmogrifai_tpu.scaleout.wire import ReplicaStates
+from transmogrifai_tpu.serving.aiohttp_core import (
+    AsyncHTTPServer, Request, Response,
+)
 
 __all__ = ["main"]
 
@@ -65,109 +69,86 @@ def main(argv=None) -> int:
     lock = threading.Lock()
     stop = threading.Event()
 
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-        # TCP_NODELAY: the reply body must not wait out a
-        # delayed ACK behind Nagle (~40ms/request)
-        disable_nagle_algorithm = True
+    def reply(code, doc, extra=None) -> Response:
+        return Response(code, (json.dumps(doc) + "\n").encode(),
+                        "application/json", extra or {})
 
-        def _reply(self, code, doc, extra=None):
-            body = (json.dumps(doc) + "\n").encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            for k, v in (extra or {}).items():
-                self.send_header(k, v)
-            self.end_headers()
-            self.wfile.write(body)
+    def admin(action, payload) -> Response:
+        if action == "status":
+            with lock:
+                return reply(200, {"ok": True,
+                                   "replicaId": args.replica_id,
+                                   "state": state["state"],
+                                   "version": state["version"],
+                                   "served": state["served"],
+                                   "swaps": list(state["swaps"])})
+        if action == "drain":
+            # draining is a moment, not a destination (see the real
+            # worker's _drain): quiesce instantly, back to READY
+            with lock:
+                state["state"] = ReplicaStates.READY
+            return reply(200, {"ok": True, "drained": True})
+        if action == "swap":
+            gated = int(payload.get("shadowRows", 1) or 0) > 0
+            if args.reject_swap and gated:
+                return reply(409, {
+                    "ok": False,
+                    "error": "ShadowParityError: stub gate "
+                             "rejection (scripted)"})
+            with lock:
+                old = state["version"]
+                new = payload.get("version") \
+                    or os.path.basename(
+                        str(payload.get("path", "v?")))
+                state["version"] = new
+                state["swaps"].append(
+                    {"from": old, "to": new, "gated": gated})
+                state["state"] = ReplicaStates.READY
+            return reply(200, {"ok": True, "fromVersion": old,
+                               "toVersion": new, "fromPath": old,
+                               "modelId": payload.get("modelId")})
+        if action == "quit":
+            stop.set()
+            return reply(200, {"ok": True, "stopping": True})
+        return reply(400, {"ok": False,
+                           "error": f"unknown action {action}"})
 
-        def do_GET(self):  # noqa: N802 — http.server API
-            if self.path.split("?")[0] == "/healthz":
+    async def handle(req: Request) -> Response:
+        path = req.path
+        if req.method == "GET":
+            if path == "/healthz":
                 with lock:
-                    self._reply(200, {"status": "ok",
-                                      "replicaId": args.replica_id,
-                                      "state": state["state"]})
-            else:
-                self.send_error(404)
+                    return reply(200, {"status": "ok",
+                                       "replicaId": args.replica_id,
+                                       "state": state["state"]})
+            return Response.error(404, "only /healthz, POST /score")
+        if req.method != "POST":
+            return Response.error(404,
+                                  f"method {req.method} unsupported")
+        try:
+            payload = json.loads(req.body or b"{}")
+        except ValueError:
+            payload = {}
+        if path.startswith("/score"):
+            if args.backpressure:
+                return reply(503, {"error": "stub backpressure"},
+                             {"Retry-After": "0.01"})
+            if args.latency_ms:
+                await asyncio.sleep(args.latency_ms / 1e3)
+            model = path[len("/score/"):] or "default"
+            with lock:
+                state["served"] += 1
+                doc = {"score": float(len(model) + len(payload)),
+                       "replica": args.replica_id,
+                       "version": state["version"]}
+            return reply(200, doc)
+        if path.startswith("/admin/"):
+            return admin(path[len("/admin/"):], payload)
+        return Response.error(404, "only /healthz, POST /score")
 
-        def do_POST(self):  # noqa: N802 — http.server API
-            path = self.path.split("?")[0]
-            n = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(n) if n else b"{}"
-            try:
-                payload = json.loads(raw or b"{}")
-            except ValueError:
-                payload = {}
-            if path.startswith("/score"):
-                if args.backpressure:
-                    self._reply(503, {"error": "stub backpressure"},
-                                {"Retry-After": "0.01"})
-                    return
-                if args.latency_ms:
-                    time.sleep(args.latency_ms / 1e3)
-                model = path[len("/score/"):] or "default"
-                with lock:
-                    state["served"] += 1
-                    doc = {"score": float(
-                               len(model) + len(payload)),
-                           "replica": args.replica_id,
-                           "version": state["version"]}
-                self._reply(200, doc)
-                return
-            if path.startswith("/admin/"):
-                self._admin(path[len("/admin/"):], payload)
-                return
-            self.send_error(404)
-
-        def _admin(self, action, payload):
-            if action == "status":
-                with lock:
-                    self._reply(200, {"ok": True,
-                                      "replicaId": args.replica_id,
-                                      "state": state["state"],
-                                      "version": state["version"],
-                                      "served": state["served"],
-                                      "swaps": list(state["swaps"])})
-            elif action == "drain":
-                # draining is a moment, not a destination (see the real
-                # worker's _drain): quiesce instantly, back to READY
-                with lock:
-                    state["state"] = ReplicaStates.READY
-                self._reply(200, {"ok": True, "drained": True})
-            elif action == "swap":
-                gated = int(payload.get("shadowRows", 1) or 0) > 0
-                if args.reject_swap and gated:
-                    self._reply(409, {
-                        "ok": False,
-                        "error": "ShadowParityError: stub gate "
-                                 "rejection (scripted)"})
-                    return
-                with lock:
-                    old = state["version"]
-                    new = payload.get("version") \
-                        or os.path.basename(
-                            str(payload.get("path", "v?")))
-                    state["version"] = new
-                    state["swaps"].append(
-                        {"from": old, "to": new, "gated": gated})
-                    state["state"] = ReplicaStates.READY
-                self._reply(200, {"ok": True, "fromVersion": old,
-                                  "toVersion": new, "fromPath": old,
-                                  "modelId": payload.get("modelId")})
-            elif action == "quit":
-                self._reply(200, {"ok": True, "stopping": True})
-                stop.set()
-            else:
-                self._reply(400, {"ok": False,
-                                  "error": f"unknown action {action}"})
-
-        def log_message(self, *a):
-            pass
-
-    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
-    httpd.daemon_threads = True
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    port = httpd.server_address[1]
+    server = AsyncHTTPServer(handle, port=args.port,
+                             name="transmogrifai-stub-worker").start()
+    port = server.port
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     with lock:
         state["state"] = ReplicaStates.READY
@@ -189,8 +170,7 @@ def main(argv=None) -> int:
     with lock:
         state["state"] = ReplicaStates.STOPPED
     hb()
-    httpd.shutdown()
-    httpd.server_close()
+    server.stop()
     return 0
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -136,32 +137,93 @@ def is_fresh(doc: dict, ttl_s: float,
     return (time.time() if now is None else now) - float(ts) <= ttl_s
 
 
+# Per-thread keep-alive pool for the admin control plane. Supervisors
+# poll ``status`` on every replica every tick — a fresh TCP connect per
+# poll was the dominant control-plane cost (and, under SYN-flood-y
+# chaos drills, a ladder of TIME_WAIT sockets). Thread-local because
+# http.client connections are not thread-safe and the supervisor,
+# router and tests all call in from their own threads.
+_LOCAL = threading.local()
+
+
+def _pooled_conn(host: str, port: int,
+                 timeout_s: float) -> http.client.HTTPConnection:
+    pool = getattr(_LOCAL, "admin_pool", None)
+    if pool is None:
+        pool = _LOCAL.admin_pool = {}
+    conn = pool.get((host, port))
+    if conn is None:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        pool[(host, port)] = conn
+    else:
+        conn.timeout = timeout_s
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout_s)
+    return conn
+
+
+def _drop_conn(host: str, port: int) -> None:
+    pool = getattr(_LOCAL, "admin_pool", None)
+    conn = pool.pop((host, port), None) if pool else None
+    if conn is not None:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+
+
+def _admin_once(conn: http.client.HTTPConnection, host: str, port: int,
+                action: str, body: str) -> dict:
+    conn.request("POST", f"/admin/{action}", body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()  # drain fully so the connection stays reusable
+    try:
+        doc = json.loads(raw) if raw else {}
+    except ValueError:
+        doc = {"raw": raw.decode(errors="replace")[:300]}
+    if resp.status != 200:
+        # an HTTP-level error is a *complete* exchange — the keep-alive
+        # connection is still good, do NOT rebuild it
+        raise AdminError(
+            f"admin {action!r} on {host}:{port} -> {resp.status}: "
+            f"{doc.get('error', doc)}", status=resp.status, doc=doc)
+    return doc
+
+
 def admin_call(port: int, action: str, payload: Optional[dict] = None,
                host: str = "127.0.0.1", timeout_s: float = 60.0) -> dict:
     """One admin control-plane request; returns the decoded JSON reply
     or raises :class:`AdminError` (status 409 = shadow-gate
-    rejection)."""
+    rejection).
+
+    Connections are kept alive in a per-thread pool and reused across
+    calls; only socket-level failures tear one down (with ONE silent
+    retry on a fresh connection, since an idle keep-alive socket may
+    have been closed server-side between calls). Error *statuses* ride
+    the same connection — they don't cost a reconnect."""
     body = json.dumps(payload or {})
-    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    conn = _pooled_conn(host, port, timeout_s)
+    fresh = conn.sock is None
     try:
-        conn.request("POST", f"/admin/{action}", body,
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        raw = resp.read()
-        try:
-            doc = json.loads(raw) if raw else {}
-        except ValueError:
-            doc = {"raw": raw.decode(errors="replace")[:300]}
-        if resp.status != 200:
+        return _admin_once(conn, host, port, action, body)
+    except AdminError:
+        raise
+    except Exception as e:  # noqa: BLE001 — socket-level failure
+        _drop_conn(host, port)
+        if fresh:
+            # connect itself failed — retrying immediately won't help
             raise AdminError(
-                f"admin {action!r} on {host}:{port} -> {resp.status}: "
-                f"{doc.get('error', doc)}", status=resp.status, doc=doc)
-        return doc
+                f"admin {action!r} on {host}:{port} failed: "
+                f"{type(e).__name__}: {e}") from e
+    # stale keep-alive socket: one retry on a brand-new connection
+    conn = _pooled_conn(host, port, timeout_s)
+    try:
+        return _admin_once(conn, host, port, action, body)
     except AdminError:
         raise
     except Exception as e:  # noqa: BLE001 — transport failure, status 0
+        _drop_conn(host, port)
         raise AdminError(
             f"admin {action!r} on {host}:{port} failed: "
             f"{type(e).__name__}: {e}") from e
-    finally:
-        conn.close()

@@ -333,6 +333,12 @@ def main(argv=None) -> int:
                          "ONE bucket per model keeps replica warmup to "
                          "one compile per fused layer)")
     ap.add_argument("--shadow-tolerance", type=float, default=None)
+    ap.add_argument("--wire", choices=("binary", "json"),
+                    default="binary",
+                    help="binary (default): negotiate the columnar "
+                         "frame wire alongside JSON/NDJSON on /score; "
+                         "json: pin the endpoint JSON-only (frame "
+                         "POSTs answer 400)")
     ap.add_argument("--no-artifacts", action="store_true",
                     help="skip the shared compiled-program artifact "
                          "layer (every replica compiles for itself)")
@@ -350,7 +356,8 @@ def main(argv=None) -> int:
         "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
         "queue_capacity": args.queue_capacity,
         "min_bucket": (args.min_bucket if args.min_bucket is not None
-                       else args.max_batch)}
+                       else args.max_batch),
+        "wire": args.wire}
     if args.shadow_tolerance is not None:
         fleet_kwargs["shadow_tolerance"] = args.shadow_tolerance
     worker = ReplicaWorker(

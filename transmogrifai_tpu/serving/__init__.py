@@ -17,26 +17,47 @@ compiled batch program at production request rates. See ``docs/SERVING.md``.
 - ``ModelRegistry``/``FleetServer``/``ProgramCache`` — the multi-model
   fleet: fingerprint-keyed registry, per-model routed lanes over one
   HBM-budgeted shared compiled-program cache, zero-downtime hot-swap
+
+Attribute access is LAZY (like the top-level package): the jax-free
+members of this package — ``serving.wireformat`` (the binary columnar
+wire codec) and ``serving.aiohttp_core`` (the shared event-loop HTTP
+front) — must stay importable without dragging jax in, because the
+scale-out router and the stdlib-only stub worker import them.
 """
 
-from transmogrifai_tpu.serving.batcher import (
-    BackpressureError, MicroBatcher, RequestTimeout,
-)
-from transmogrifai_tpu.serving.compiled import UNKNOWN_TOKEN, CompiledScorer
-from transmogrifai_tpu.serving.explain import CompiledExplainer
-from transmogrifai_tpu.serving.fleet import (
-    FleetServer, ProgramCache, ShadowParityError,
-)
-from transmogrifai_tpu.serving.metrics import ServingMetrics
-from transmogrifai_tpu.serving.registry import (
-    ModelRegistry, ModelState, UnknownModelError,
-)
-from transmogrifai_tpu.serving.server import ScoringServer
+_LAZY = {
+    "BackpressureError": ("transmogrifai_tpu.serving.batcher",
+                          "BackpressureError"),
+    "MicroBatcher": ("transmogrifai_tpu.serving.batcher", "MicroBatcher"),
+    "RequestTimeout": ("transmogrifai_tpu.serving.batcher",
+                       "RequestTimeout"),
+    "UNKNOWN_TOKEN": ("transmogrifai_tpu.serving.compiled",
+                      "UNKNOWN_TOKEN"),
+    "CompiledScorer": ("transmogrifai_tpu.serving.compiled",
+                       "CompiledScorer"),
+    "CompiledExplainer": ("transmogrifai_tpu.serving.explain",
+                          "CompiledExplainer"),
+    "FleetServer": ("transmogrifai_tpu.serving.fleet", "FleetServer"),
+    "ProgramCache": ("transmogrifai_tpu.serving.fleet", "ProgramCache"),
+    "ShadowParityError": ("transmogrifai_tpu.serving.fleet",
+                          "ShadowParityError"),
+    "ServingMetrics": ("transmogrifai_tpu.serving.metrics",
+                       "ServingMetrics"),
+    "ModelRegistry": ("transmogrifai_tpu.serving.registry",
+                      "ModelRegistry"),
+    "ModelState": ("transmogrifai_tpu.serving.registry", "ModelState"),
+    "UnknownModelError": ("transmogrifai_tpu.serving.registry",
+                          "UnknownModelError"),
+    "ScoringServer": ("transmogrifai_tpu.serving.server",
+                      "ScoringServer"),
+}
 
-__all__ = [
-    "BackpressureError", "CompiledExplainer", "CompiledScorer",
-    "FleetServer", "MicroBatcher",
-    "ModelRegistry", "ModelState", "ProgramCache", "RequestTimeout",
-    "ScoringServer", "ServingMetrics", "ShadowParityError",
-    "UNKNOWN_TOKEN", "UnknownModelError",
-]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,334 @@
+"""Shared event-loop HTTP/1.1 core (stdlib asyncio, no dependencies).
+
+One implementation of the wire behavior that serving/http.py,
+scaleout/router.py and scaleout/stub_worker.py used to copy-paste
+around ``BaseHTTPRequestHandler`` — header parsing, bounded bodies,
+keep-alive, and the error statuses that keep a persistent connection
+from desyncing:
+
+- **HTTP/1.1 keep-alive** by default: a router or load harness reuses
+  one connection per replica instead of paying a TCP handshake per
+  request. Every reply carries ``Content-Length``; replies that could
+  leave an unread body on the socket (413 and friends) close the
+  connection instead of desyncing it.
+- **bounded buffering**: request bodies are refused 413 above
+  ``max_body_bytes`` WITHOUT reading, chunked bodies 411 (no
+  ``Content-Length`` means no bound), malformed/negative lengths 400.
+- **event loop, not thread-per-connection**: a single daemon thread
+  runs an asyncio loop; N idle keep-alive connections cost N parked
+  coroutines, not N parked OS threads. Handlers are async; legacy
+  blocking callbacks (a fleet's ``score_fn`` blocking on a batcher
+  future) run on the server's bounded thread pool via
+  :meth:`AsyncHTTPServer.run_blocking`.
+- ``TCP_NODELAY`` on every connection: replies are single small
+  documents; a delayed-ACK stall per request is pure loss.
+
+The public surface mirrors the old servers': synchronous ``start()`` /
+``stop()`` and a ``port`` property, so owners (MetricsServer, Router,
+the stub worker) keep their APIs unchanged.
+
+Deliberately jax-free and framework-free: the stub worker imports this
+plus ``scaleout/wire.py`` and nothing else.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+__all__ = ["AsyncHTTPServer", "Request", "Response",
+           "DEFAULT_MAX_BODY_BYTES"]
+
+#: default request-body bound (bytes) — one JSON request row or one
+#: columnar frame, with slack
+DEFAULT_MAX_BODY_BYTES = 1 << 20
+
+#: request line + headers may not exceed this many bytes total
+MAX_HEADER_BYTES = 32 << 10
+
+_REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
+           409: "Conflict", 411: "Length Required",
+           413: "Request Entity Too Large", 500: "Internal Server Error",
+           503: "Service Unavailable", 504: "Gateway Timeout"}
+
+
+@dataclass
+class Request:
+    method: str
+    target: str                       # raw request target (may carry ?query)
+    headers: dict                     # lower-cased header name -> value
+    body: bytes = b""
+
+    @property
+    def path(self) -> str:
+        return self.target.split("?")[0]
+
+    def header(self, name: str, default=None):
+        return self.headers.get(name.lower(), default)
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    ctype: str = "application/json"
+    headers: dict = field(default_factory=dict)
+    #: close the connection after this reply (error replies that may
+    #: leave an unread request body MUST set this)
+    close: bool = False
+
+    @staticmethod
+    def error(status: int, message: str,
+              close: bool = True) -> "Response":
+        import json
+        body = (json.dumps({"error": message}) + "\n").encode()
+        return Response(status, body, "application/json", close=close)
+
+
+class _BadRequest(Exception):
+    """Protocol-level refusal decided before the handler runs."""
+
+    def __init__(self, response: Response):
+        self.response = response
+
+
+class AsyncHTTPServer:
+    """One asyncio HTTP/1.1 server on a daemon thread.
+
+    ``handler`` is ``async (Request) -> Response``; it runs on the
+    event loop, so anything blocking inside it must go through
+    :meth:`run_blocking`. Construction does not bind; ``start()``
+    binds (port 0 = ephemeral) and returns once ``port`` is live.
+    """
+
+    def __init__(self, handler: Callable[[Request],
+                                         Awaitable[Response]],
+                 port: int = 0, host: str = "127.0.0.1",
+                 max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+                 name: str = "transmogrifai-http",
+                 executor_workers: int = 32):
+        self.handler = handler
+        self.max_body_bytes = int(max_body_bytes)
+        self._host = host
+        self._requested_port = int(port)
+        self._name = name
+        self._executor_workers = int(executor_workers)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._port: Optional[int] = None
+        self._writers: set = set()
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    def start(self) -> "AsyncHTTPServer":
+        if self._thread is not None:
+            return self
+        ready = threading.Event()
+        boot_err: list = []
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            self._executor = ThreadPoolExecutor(
+                max_workers=self._executor_workers,
+                thread_name_prefix=f"{self._name}-blk")
+
+            async def boot():
+                try:
+                    self._server = await asyncio.start_server(
+                        self._serve_connection, self._host,
+                        self._requested_port, limit=MAX_HEADER_BYTES)
+                    self._port = \
+                        self._server.sockets[0].getsockname()[1]
+                except Exception as e:  # noqa: BLE001 — surfaced to start()
+                    boot_err.append(e)
+                finally:
+                    ready.set()
+
+            loop.run_until_complete(boot())
+            if not boot_err:
+                try:
+                    loop.run_forever()
+                finally:
+                    # drain cancelled tasks so their closers run
+                    pending = asyncio.all_tasks(loop)
+                    for t in pending:
+                        t.cancel()
+                    if pending:
+                        loop.run_until_complete(asyncio.gather(
+                            *pending, return_exceptions=True))
+            loop.close()
+
+        self._thread = threading.Thread(target=run, name=self._name,
+                                        daemon=True)
+        self._thread.start()
+        ready.wait(timeout=10.0)
+        if boot_err:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+            raise boot_err[0]
+        if self._port is None:
+            raise RuntimeError(f"{self._name}: server failed to bind")
+        return self
+
+    def stop(self) -> None:
+        loop, thread = self._loop, self._thread
+        if loop is None or thread is None:
+            return
+
+        def shutdown():
+            if self._server is not None:
+                self._server.close()
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+            loop.stop()
+
+        loop.call_soon_threadsafe(shutdown)
+        thread.join(timeout=5.0)
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+        self._loop = None
+        self._server = None
+        self._thread = None
+        self._executor = None
+        self._port = None
+
+    def run_blocking(self, fn, *args):
+        """Awaitable running ``fn(*args)`` on the server's thread pool —
+        the seam for legacy blocking callbacks (render/score/control
+        functions that block on locks or batcher futures)."""
+        return asyncio.get_running_loop().run_in_executor(
+            self._executor, fn, *args)
+
+    # -- protocol ------------------------------------------------------------
+    async def _read_request(self, reader) -> Optional[Request]:
+        """One request off the stream, or None at clean EOF. Raises
+        ``_BadRequest`` carrying the refusal reply for protocol-level
+        errors (bad Content-Length, chunked, oversized)."""
+        try:
+            line = await reader.readline()
+        except (asyncio.LimitOverrunError, ValueError):
+            raise _BadRequest(Response.error(
+                400, "request line too long")) from None
+        if not line:
+            return None
+        try:
+            parts = line.decode("latin-1").rstrip("\r\n").split()
+            method, target = parts[0], parts[1]
+        except (IndexError, UnicodeDecodeError):
+            raise _BadRequest(Response.error(
+                400, "malformed request line")) from None
+        headers: dict = {}
+        total = len(line)
+        while True:
+            try:
+                hline = await reader.readline()
+            except (asyncio.LimitOverrunError, ValueError):
+                raise _BadRequest(Response.error(
+                    400, "header line too long")) from None
+            total += len(hline)
+            if total > MAX_HEADER_BYTES:
+                raise _BadRequest(Response.error(
+                    400, "request headers too large"))
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            try:
+                k, _, v = hline.decode("latin-1").partition(":")
+            except UnicodeDecodeError:
+                raise _BadRequest(Response.error(
+                    400, "malformed header")) from None
+            headers[k.strip().lower()] = v.strip()
+        if headers.get("transfer-encoding"):
+            # an unread chunked body would desync keep-alive; close
+            raise _BadRequest(Response.error(
+                411, "chunked bodies unsupported; send Content-Length"))
+        try:
+            n = int(headers.get("content-length", 0))
+        except ValueError:
+            raise _BadRequest(Response.error(
+                400, "malformed Content-Length")) from None
+        if n < 0:
+            raise _BadRequest(Response.error(
+                400, "negative Content-Length"))
+        if n > self.max_body_bytes:
+            # refused WITHOUT reading: the reply closes the connection,
+            # so the unread body can't desync keep-alive
+            raise _BadRequest(Response.error(
+                413, f"request body {n} bytes exceeds the "
+                     f"{self.max_body_bytes}-byte bound"))
+        body = b""
+        if n:
+            try:
+                body = await reader.readexactly(n)
+            except asyncio.IncompleteReadError:
+                return None  # client died mid-body: nothing to answer
+        return Request(method, target, headers, body)
+
+    @staticmethod
+    def _render(resp: Response) -> bytes:
+        reason = _REASON.get(resp.status, "Unknown")
+        head = [f"HTTP/1.1 {resp.status} {reason}",
+                f"Content-Type: {resp.ctype}",
+                f"Content-Length: {len(resp.body)}"]
+        for k, v in resp.headers.items():
+            if k.lower() in ("content-length", "content-type",
+                             "connection", "transfer-encoding"):
+                continue
+            head.append(f"{k}: {v}")
+        if resp.close:
+            head.append("Connection: close")
+        return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") \
+            + resp.body
+
+    async def _serve_connection(self, reader, writer) -> None:
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                1)
+            except OSError:
+                pass
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except _BadRequest as e:
+                    writer.write(self._render(e.response))
+                    await writer.drain()
+                    break
+                if req is None:
+                    break
+                try:
+                    resp = await self.handler(req)
+                except Exception as e:  # noqa: BLE001 — a handler crash must not kill the loop
+                    resp = Response.error(
+                        500, f"{type(e).__name__}: {str(e)[:200]}")
+                want_close = resp.close or \
+                    req.header("connection", "").lower() == "close"
+                resp.close = want_close
+                writer.write(self._render(resp))
+                await writer.drain()
+                if want_close:
+                    break
+        except (ConnectionError, asyncio.CancelledError, OSError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — socket already dead
+                pass

@@ -77,6 +77,10 @@ class _Pending:
     t_submit: float
     deadline: Optional[float]  # monotonic seconds, None = no deadline
     trace_id: Optional[str] = None  # request-scoped trace context
+    #: how many device rows this item contributes to a batch — 1 for a
+    #: plain row, n for a columnar wire frame. A full frame must not
+    #: sit out max_wait waiting for companions it cannot admit anyway.
+    weight: int = 1
 
 
 @dataclass
@@ -181,11 +185,16 @@ class MicroBatcher:
 
     def submit(self, row: dict,
                timeout_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               weight: int = 1) -> Future:
         """``trace_id`` (optional) rides the request through the queue:
         the worker stamps it into the batch's flight-recorder events and
         the dispatch span's member list, so one id greps the request's
-        whole path (admission -> batch -> dispatch -> reply)."""
+        whole path (admission -> batch -> dispatch -> reply).
+        ``weight`` is the item's device-row count (1 for a plain row,
+        n for a columnar frame) — it feeds the coalescing bound, so an
+        already-full frame dispatches immediately instead of burning
+        ``max_wait_ms`` waiting for companions."""
         if self._stop.is_set() or self._thread is None:
             raise RuntimeError("batcher is not running")
         t = time.monotonic()
@@ -193,7 +202,8 @@ class MicroBatcher:
             else self.default_timeout_ms
         deadline = None if timeout_ms is None else t + timeout_ms / 1e3
         pending = _Pending(row=row, future=Future(), t_submit=t,
-                           deadline=deadline, trace_id=trace_id)
+                           deadline=deadline, trace_id=trace_id,
+                           weight=max(int(weight), 1))
         try:
             self._q.put_nowait(pending)
         except queue.Full:
@@ -212,29 +222,36 @@ class MicroBatcher:
 
     # -- worker --------------------------------------------------------------
     def _collect(self) -> list[_Pending]:
-        """Block for the first request, then coalesce companions for up to
-        ``max_wait_s`` (or until the batch is full)."""
+        """Block for the first request, then coalesce companions for up
+        to ``max_wait_s`` — or until the batch's WEIGHT (device rows,
+        not queue items) reaches ``max_batch``. A frame arriving full
+        therefore dispatches with zero coalescing wait."""
         try:
             first = self._q.get(timeout=0.05)
         except queue.Empty:
             return []
         batch = [first]
+        rows = first.weight
         t_end = time.monotonic() + self.max_wait_s
-        while len(batch) < self.max_batch:
+        while rows < self.max_batch:
             # burst-drain whatever is already queued (no condition-variable
             # wait per item — at saturation this is the whole batch)
             try:
-                while len(batch) < self.max_batch:
-                    batch.append(self._q.get_nowait())
+                while rows < self.max_batch:
+                    p = self._q.get_nowait()
+                    batch.append(p)
+                    rows += p.weight
             except queue.Empty:
                 pass
-            if len(batch) >= self.max_batch:
+            if rows >= self.max_batch:
                 break
             remaining = t_end - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                batch.append(self._q.get(timeout=remaining))
+                p = self._q.get(timeout=remaining)
+                batch.append(p)
+                rows += p.weight
             except queue.Empty:
                 break
         return batch

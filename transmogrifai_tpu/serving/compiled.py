@@ -39,6 +39,7 @@ import numpy as np
 from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.dag import fuse_layer_program
 from transmogrifai_tpu.pipeline_data import PipelineData
+from transmogrifai_tpu.serving import wireformat as wf
 from transmogrifai_tpu.types import feature_types as ft
 from transmogrifai_tpu.utils.profiling import ServingCounters
 
@@ -299,7 +300,15 @@ class CompiledScorer:
         cols = {name: fr.HostColumn.from_values(
                     ftype, [r.get(name) for r in padded])
                 for name, ftype in self._raw}
-        data = PipelineData(fr.HostFrame(cols))
+        data = self._transform_counted(
+            PipelineData(fr.HostFrame(cols)), bucket)
+        return self._extract_rows(data, n)
+
+    def _transform_counted(self, data: PipelineData,
+                           bucket: int) -> PipelineData:
+        """``_transform`` plus per-dispatch compile accounting — shared
+        by the row entry (``score_batch``) and the columnar entry
+        (``score_columns``)."""
         if self.program_cache is not None:
             # shared-cache mode: one program per (fingerprint, layer,
             # bucket) key, so an insertion IS a compile (the entry's one
@@ -307,23 +316,195 @@ class CompiledScorer:
             # insertions/evictions to this scorer's counters directly
             data = self._transform(data, bucket)
             self.counters.count(bucket, dispatches=1)
-        else:
-            # compile accounting via this scorer's OWN fused-program
-            # jit-cache growth: exact and per-scorer (a process-global
-            # compile listener would cross-attribute concurrent servers)
-            before = self._program_cache_entries()
-            data = self._transform(data, bucket)
-            grew = self._program_cache_entries() - before
-            self.counters.count(bucket, dispatches=1, compiles=grew)
-            if grew:
-                # cold path only: steady-state traffic never gets here —
-                # a compile event under load is the flight-recorder
-                # symptom of a bucket/cache misconfiguration
-                from transmogrifai_tpu.utils.events import events
-                events.emit("serving.compile", bucket=bucket,
-                            programs=grew,
-                            fingerprint=self.fingerprint)
-        return self._extract_rows(data, n)
+            return data
+        # compile accounting via this scorer's OWN fused-program
+        # jit-cache growth: exact and per-scorer (a process-global
+        # compile listener would cross-attribute concurrent servers)
+        before = self._program_cache_entries()
+        data = self._transform(data, bucket)
+        grew = self._program_cache_entries() - before
+        self.counters.count(bucket, dispatches=1, compiles=grew)
+        if grew:
+            # cold path only: steady-state traffic never gets here —
+            # a compile event under load is the flight-recorder
+            # symptom of a bucket/cache misconfiguration
+            from transmogrifai_tpu.utils.events import events
+            events.emit("serving.compile", bucket=bucket,
+                        programs=grew,
+                        fingerprint=self.fingerprint)
+        return data
+
+    # -- columnar (wire-frame) scoring ---------------------------------------
+    def host_columns_from_wire(self, frame: "wf.WireFrame"
+                               ) -> tuple[dict, int]:
+        """Decoded request frame -> ``{name: HostColumn}`` for every raw
+        feature the DAG reads, bypassing the per-row dict walk AND the
+        per-cell ``ftype._validate`` calls — typed wire buffers land in
+        the column representations ``HostColumn.from_values`` would have
+        built (SNIPPETS[3]'s pre-partitioned-operand rule at the
+        socket). Fixed-width columns stay zero-copy views over the frame
+        buffer until padding. Returns ``(cols, n_rows)``.
+
+        A missing required column raises ``KeyError`` (HTTP 400, like a
+        strict-admission miss on the row path); a wire dtype the feature
+        kind can't accept raises ``WireFormatError``; an empty value in
+        a non-nullable column raises ``FeatureTypeValueError`` exactly
+        like the row path."""
+        n = frame.n_rows
+        cols: dict[str, fr.HostColumn] = {}
+        for name, ftype in self._raw:
+            col = frame.columns.get(name)
+            if col is None:
+                raise KeyError(
+                    f"request frame missing raw feature {name!r}")
+            cols[name] = self._host_col_from_wire(name, ftype, col, n)
+        return cols, n
+
+    @staticmethod
+    def _host_col_from_wire(name: str, ftype, col: "wf.WireColumn",
+                            n: int) -> fr.HostColumn:
+        kind = ftype.device_kind
+        if col.dtype == wf.JSONCOL:
+            # escape hatch for any kind: python values through the
+            # validating builder (maps, lists, prediction, ...)
+            return fr.HostColumn.from_values(ftype, col.values)
+        if kind in fr.NUMERIC_KINDS:
+            if col.dtype not in (wf.F64, wf.F32, wf.I64, wf.I32,
+                                 wf.BOOL):
+                raise wf.WireFormatError(
+                    f"column {name!r}: dtype {col.dtype} is not "
+                    f"numeric (feature kind {kind!r})")
+            vals = np.asarray(col.values)
+            if vals.ndim != 1:
+                raise wf.WireFormatError(
+                    f"column {name!r}: width {vals.shape[1]} invalid "
+                    f"for a scalar {kind!r} feature")
+            mask = np.ones(n, dtype=bool) if col.mask is None \
+                else np.asarray(col.mask, dtype=bool)
+            if not ftype.is_nullable and not mask.all():
+                raise ft.FeatureTypeValueError(
+                    f"{ftype.__name__} column contains empty values")
+            if vals.dtype != np.float64:
+                vals = vals.astype(np.float64)
+            if not mask.all():
+                # missing slots hold 0.0, matching _build_numeric
+                vals = np.where(mask, vals, 0.0)
+            return fr.HostColumn(ftype, vals, mask)
+        if kind in fr.TEXT_KINDS:
+            if col.dtype != wf.TEXT:
+                raise wf.WireFormatError(
+                    f"column {name!r}: dtype {col.dtype} is not TEXT "
+                    f"(feature kind {kind!r})")
+            vals = np.empty(n, dtype=object)
+            for i, v in enumerate(col.values):
+                vals[i] = v
+            return fr.HostColumn(ftype, vals, None)
+        if kind == "geolocation":
+            if col.dtype not in (wf.F64, wf.F32) \
+                    or np.ndim(col.values) != 2 \
+                    or col.values.shape[1] != 3:
+                raise wf.WireFormatError(
+                    f"column {name!r}: geolocation rides as F64 "
+                    "width=3 (lat, lon, accuracy)")
+            vals = np.asarray(col.values, dtype=np.float64)
+            mask = np.ones(n, dtype=bool) if col.mask is None \
+                else np.asarray(col.mask, dtype=bool)
+            if not mask.all():
+                vals = np.where(mask[:, None], vals, 0.0)
+            return fr.HostColumn(ftype, vals, mask)
+        if kind == "vector":
+            if col.dtype not in (wf.F32, wf.F64) \
+                    or np.ndim(col.values) != 2:
+                raise wf.WireFormatError(
+                    f"column {name!r}: feature vectors ride as F32 "
+                    "width=d")
+            return fr.HostColumn(
+                ftype, np.asarray(col.values, dtype=np.float32), None)
+        raise wf.WireFormatError(
+            f"column {name!r}: feature kind {kind!r} requires a JSON "
+            "wire column")
+
+    @staticmethod
+    def _pad_cols(cols: dict, n: int, bucket: int) -> dict:
+        """Pad every column to ``bucket`` rows by replicating the last
+        row — the array-level analog of ``score_batch``'s row padding
+        (transforms are row-local at scoring time, so padded slots
+        compute real, discarded values)."""
+        if bucket == n:
+            return cols
+        pad = bucket - n
+        out = {}
+        for name, col in cols.items():
+            vals = np.concatenate(
+                [col.values, np.repeat(col.values[-1:], pad, axis=0)])
+            mask = None if col.mask is None else np.concatenate(
+                [col.mask, np.repeat(col.mask[-1:], pad)])
+            out[name] = fr.HostColumn(col.ftype, vals, mask, col.meta)
+        return out
+
+    def score_columns(self, cols: dict, n: int) -> dict:
+        """Columnar scoring entry: ``{name: HostColumn}`` (every raw
+        feature the DAG reads, ``n`` rows each) -> ``{result name:
+        ndarray | list}`` with prediction results flattened to dotted
+        f64 columns (``{name}.prediction``, ``{name}.rawPrediction_i``,
+        ``{name}.probability_i``) — the shape ``wireformat.
+        reply_columns`` ships. No row dicts are built in either
+        direction; parity with ``score_batch`` is exact (same programs,
+        same padding)."""
+        if n == 0:
+            return {}
+        if n > self.max_batch:
+            merged: dict = {}
+            for i in range(0, n, self.max_batch):
+                j = min(i + self.max_batch, n)
+                part = self.score_columns(
+                    {name: c.take(np.arange(i, j))
+                     for name, c in cols.items()}, j - i)
+                for k, v in part.items():
+                    if k in merged:
+                        merged[k] = np.concatenate([merged[k], v]) \
+                            if isinstance(v, np.ndarray) \
+                            else merged[k] + v
+                    else:
+                        merged[k] = v
+            return merged
+        bucket = self.bucket_for(n)
+        data = self._transform_counted(
+            PipelineData(fr.HostFrame(self._pad_cols(cols, n, bucket))),
+            bucket)
+        return self._extract_columns(data, n)
+
+    def _extract_columns(self, data: PipelineData, n: int) -> dict:
+        """Result columns in columnar form — the framed-reply analog of
+        ``_extract_rows`` (one array per column, zero per-cell boxing
+        for device results)."""
+        out: dict = {}
+        for name, ftype in self._result:
+            dev = data.device.get(name)
+            if isinstance(dev, fr.PredictionColumn):
+                out[f"{name}.{ft.Prediction.PredictionName}"] = \
+                    np.asarray(dev.prediction, np.float64)[:n]
+                for label, block in (
+                        (ft.Prediction.RawPredictionName,
+                         dev.raw_prediction),
+                        (ft.Prediction.ProbabilityName,
+                         dev.probability)):
+                    arr = np.asarray(block, np.float64)
+                    arr = arr.reshape(arr.shape[0], -1)[:n]
+                    for i in range(arr.shape[1]):
+                        out[f"{name}.{label}_{i}"] = \
+                            np.ascontiguousarray(arr[:, i])
+            elif isinstance(dev, fr.VectorColumn):
+                out[name] = np.asarray(dev.values, np.float64)[:n]
+            else:
+                col = data.host_col(name)
+                vectorish = issubclass(ftype, ft.OPVector)
+                vals = [col.python_value(i) for i in range(n)]
+                if vectorish:
+                    vals = [None if v is None else list(map(float, v))
+                            for v in vals]
+                out[name] = vals
+        return out
 
     def _program_cache_entries(self) -> int:
         total = 0

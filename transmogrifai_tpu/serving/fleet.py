@@ -354,6 +354,7 @@ class FleetServer:
                  metrics_host: str = "127.0.0.1",
                  access_log_sample: float = 0.0,
                  slo=None,
+                 wire: str = "binary",
                  **lane_kwargs):
         """``lane_kwargs`` (``max_batch``, ``max_wait_ms``,
         ``queue_capacity``, ``default_timeout_ms``, ``strict``,
@@ -362,7 +363,11 @@ class FleetServer:
         ``utils.slo.SLObjective``/dicts, a config path, or a prebuilt
         ``SLOEngine``) evaluates burn-rate objectives over the whole
         fleet's lanes; firing fast-burn alerts flip ``/healthz``
-        readiness."""
+        readiness. ``wire`` (default ``"binary"``) keeps the HTTP
+        endpoint negotiating the binary columnar frame wire alongside
+        JSON/NDJSON; ``wire="json"`` pins the endpoint JSON-only
+        (``application/x-tmog-frame`` POSTs answer 400) for operators
+        who must guarantee no binary clients."""
         bad = {"metrics_port", "metrics_host", "program_cache",
                "fingerprint", "event_label", "slo"} & set(lane_kwargs)
         if bad:
@@ -378,6 +383,10 @@ class FleetServer:
         #: one must not silently widen the other
         self.http_timeout_s = float(http_timeout_s)
         self.route_field = route_field
+        if wire not in ("binary", "json"):
+            raise ValueError(f"wire must be 'binary' or 'json', "
+                             f"got {wire!r}")
+        self.wire = wire
         self._lane_kwargs = dict(lane_kwargs)
         self._lock = threading.RLock()
         #: (model_id, version) -> ScoringServer lane
@@ -513,6 +522,8 @@ class FleetServer:
             self.metrics_http = MetricsServer(
                 render_fn=registry.render, health_fn=self.health,
                 score_fn=self._http_score,
+                frame_fn=self._http_frame
+                if self.wire == "binary" else None,
                 port=self._metrics_port, host=self._metrics_host,
                 access_log_sample=self._access_log_sample).start()
         return self
@@ -590,6 +601,18 @@ class FleetServer:
         to be built with ``explain=True`` in the lane kwargs."""
         return self._submit_routed(model_id, row, timeout_ms, trace_id,
                                    explain=True, top_k=top_k)[0]
+
+    def submit_frame(self, model_id: str, frame,
+                     timeout_ms: Optional[float] = None,
+                     trace_id: Optional[str] = None):
+        """Route one decoded binary wire frame
+        (``wireformat.WireFrame`` of batched columns) to ``model_id``'s
+        active version — the columnar analog of :meth:`submit`. The
+        future resolves to ``("columns", {name: values})`` on the
+        column fast path, or ``("rows", [doc | exception, ...])`` when
+        the batch fell back to the row lane."""
+        return self._submit_frame_routed(model_id, frame, timeout_ms,
+                                         trace_id)[0]
 
     def _submit_routed(self, model_id: str, row: dict,
                        timeout_ms: Optional[float] = None,
@@ -719,6 +742,105 @@ class FleetServer:
                                   "version": version,
                                   "fingerprint": None}
         return doc
+
+    def _submit_frame_routed(self, model_id: str, frame,
+                             timeout_ms: Optional[float] = None,
+                             trace_id: Optional[str] = None) -> tuple:
+        """``_submit_routed`` for a decoded wire frame: same
+        lane-stopped retry loop (a hot swap mid-flight re-resolves onto
+        the promoted version), same lineage contract."""
+        for _ in range(8):
+            lane, version = self._resolve(model_id)
+            try:
+                fut = lane.submit_frame(frame, timeout_ms=timeout_ms,
+                                        trace_id=trace_id)
+            except RuntimeError:
+                if self.registry.active_version(model_id) == version:
+                    raise
+                continue
+            return fut, version
+        raise RuntimeError(
+            f"model {model_id!r}: could not route (lanes kept stopping)")
+
+    def _frame_lineage_meta(self, model_id: str, version,
+                            trace_id: Optional[str]) -> dict:
+        meta: dict = {}
+        if trace_id is not None:
+            meta["traceId"] = trace_id
+        # lineage of the version that ADMITTED the frame, with the same
+        # swap-race fallbacks as the JSON reply path
+        try:
+            meta["lineage"] = self.lineage(model_id, version)
+        except UnknownModelError:
+            try:
+                meta["lineage"] = self.lineage(model_id)
+            except UnknownModelError:
+                meta["lineage"] = {"modelId": model_id,
+                                   "version": version,
+                                   "fingerprint": None}
+        return meta
+
+    def _http_frame(self, model_id: Optional[str], frame_bytes: bytes,
+                    trace_id: Optional[str] = None) -> bytes:
+        """``application/x-tmog-frame`` adapter: one binary columnar
+        request frame in, one framed columnar reply out. Model
+        resolution: path id wins, else the frame header's model id,
+        else the sole registered model. The reply's meta carries the
+        trace id + lineage stamp (the framed analog of the JSON reply's
+        ``traceId``/``lineage`` fields); a request-level failure raises
+        and maps to an HTTP status exactly like the JSON path
+        (``WireFormatError`` is a ``ValueError`` -> 400).
+
+        ``{"explain": true | K}`` in the request meta routes the batch
+        through the explain lane — attributions ride the same framed
+        reply as an ``explanations`` JSON column."""
+        from transmogrifai_tpu.serving import wireformat as wf
+        frame = wf.decode_frame(frame_bytes)
+        if model_id is None:
+            model_id = frame.model_id or None
+        if model_id is None:
+            ids = self.registry.model_ids()
+            if len(ids) != 1:
+                raise ValueError(
+                    "request frame names no model (header model id or "
+                    f"/score/<id> path) and the fleet serves "
+                    f"{len(ids)} models")
+            model_id = ids[0]
+        explain = frame.meta.get("explain", False)
+        if explain:
+            # the explain lane batches rows, not columns: convert once
+            # (LOCO dwarfs the conversion) and fan through the lane so
+            # attributions ride the framed reply
+            top_k = explain if isinstance(explain, int) \
+                and not isinstance(explain, bool) and explain > 0 \
+                else None
+            rows = wf.frame_to_rows(frame)
+            futs = []
+            version = None
+            for r in rows:
+                fut, version = self._submit_routed(
+                    model_id, r, trace_id=trace_id, explain=True,
+                    top_k=top_k)
+                futs.append(fut)
+            docs = [f.result(timeout=self.http_timeout_s)
+                    for f in futs]
+            return wf.encode_frame(
+                model_id, wf.rows_to_reply_columns(docs), len(docs),
+                kind=wf.KIND_REPLY,
+                meta=self._frame_lineage_meta(model_id, version,
+                                              trace_id))
+        fut, version = self._submit_frame_routed(model_id, frame,
+                                                 trace_id=trace_id)
+        kind, result = fut.result(timeout=self.http_timeout_s)
+        if kind == "columns":
+            cols = wf.reply_columns(result, frame.n_rows)
+        else:
+            # degraded/row-fallback batch: per-row docs (or isolated
+            # per-row exceptions, carried as an ``error`` column)
+            cols = wf.rows_to_reply_columns(result)
+        return wf.encode_frame(
+            model_id, cols, frame.n_rows, kind=wf.KIND_REPLY,
+            meta=self._frame_lineage_meta(model_id, version, trace_id))
 
     # -- hot swap ------------------------------------------------------------
     def hot_swap(self, model_id: str, path: Optional[str] = None, *,

@@ -1,16 +1,29 @@
-"""Scrape + scoring endpoint: a stdlib ``http.server`` background thread
-serving ``GET /metrics`` (Prometheus text exposition over the server's
-live counters), ``GET /healthz`` (liveness + per-model readiness as
-JSON), and — when the owner provides a ``score_fn`` (the fleet does) —
-``POST /score`` / ``POST /score/<model_id>`` (one JSON request row in,
-one JSON score document out; the multi-process load harness's wire).
-An ``"explain": true`` (or ``"explain": K``) field on the request row
-opts into the fleet's explain lane — the reply gains an ordered
-``"explanations"`` top-K LOCO attribution list alongside the score,
-under the same trace id + lineage stamp (docs/INSIGHTS.md). The field
-is a directive, popped before admission, so strict validation never
-sees it; the scale-out router proxies bodies verbatim, so explained
-requests ride through unchanged.
+"""Scrape + scoring endpoint on the shared event-loop HTTP core
+(``serving/aiohttp_core.py``): ``GET /metrics`` (Prometheus text
+exposition over the server's live counters), ``GET /healthz`` (liveness
++ per-model readiness as JSON), and — when the owner provides a
+``score_fn`` (the fleet does) — ``POST /score`` / ``POST
+/score/<model_id>``.
+
+The scoring route negotiates on ``Content-Type``:
+
+- ``application/json`` (default): one JSON request row in, one JSON
+  score document out — the original wire, unchanged.
+- ``application/x-ndjson``: one JSON row per line in, one score
+  document per line out (same order). Per-line failures come back as
+  inline ``{"error": ..., "traceId": ...}`` documents, so a batch with
+  one poison row still scores the rest.
+- ``application/x-tmog-frame``: one binary columnar frame in
+  (``serving/wireformat.py``), one framed columnar reply out — the
+  wire-speed path, served through ``frame_fn`` when the owner provides
+  one. Malformed frames are 400s; error replies stay JSON (status
+  codes + a readable body beat a binary error frame).
+
+An ``"explain": true`` (or ``"explain": K``) field on a JSON request
+row — or ``{"explain": K}`` in a frame's meta — opts into the fleet's
+explain lane: the reply gains an ordered ``"explanations"`` top-K LOCO
+attribution list alongside the score, under the same trace id +
+lineage stamp (docs/INSIGHTS.md).
 
 Request-scoped tracing starts HERE: every scoring request gets a trace
 id — the inbound ``X-Trace-Id`` header when present (sanitized), else a
@@ -19,23 +32,14 @@ batcher into the flight recorder, echoed back as the response's
 ``X-Trace-Id`` header (success AND error replies), and stamped into the
 score document alongside the serving model's lineage.
 
-Deliberately dependency-free and tiny: one daemon thread, a
-``ThreadingHTTPServer`` so a slow scraper or a blocking score can't
-stall a liveness probe, and no other routes — everything else is a 404.
-Port 0 binds an ephemeral port (tests, multi-process fleets racing on
-fixed ports); the bound port is ``MetricsServer.port``. Scoring status
-mapping: strict-admission / malformed-request errors are 400, an
-unknown model id 404, a queue-full ``BackpressureError`` 503 with a
-``Retry-After`` hint, an expired request deadline 504 — load shed and
-routing mistakes are the CLIENT's signal, never a server crash.
-
-Wire behavior: the handler speaks **HTTP/1.1 with keep-alive** — a
-router or load harness reuses one connection per replica instead of
-paying a TCP handshake per request (the scale-out hop's hot path).
-Request bodies are bounded (``max_body_bytes``, default 1 MiB): an
-oversized or length-less body is rejected 413/411 with the connection
-closed, never buffered — one request row has no business being
-megabytes, and an unbounded read is a trivial DoS surface.
+The transport (keep-alive, TCP_NODELAY, bounded bodies: 413 oversize,
+411 chunked, 400 malformed lengths — all with the connection closed so
+an unread body can't desync a persistent connection) lives in the
+shared core; this module only maps applications errors to statuses:
+strict-admission / malformed-request errors are 400, an unknown model
+id 404, a queue-full ``BackpressureError`` 503 with a ``Retry-After``
+hint, an expired request deadline 504 — load shed and routing mistakes
+are the CLIENT's signal, never a server crash.
 
 With ``control_fn`` the endpoint also serves ``POST /admin/<action>``
 (JSON body in, JSON reply out) — the scale-out control plane a replica
@@ -43,28 +47,30 @@ worker exposes to its supervisor (drain, hot-swap, status, quit). A
 shadow-gate rejection maps to 409 so a rolling swap can distinguish
 "the candidate failed parity" from infrastructure errors.
 
-Access logging: ``BaseHTTPRequestHandler``'s per-request stderr line is
-suppressed (a daemon's stderr is not a log pipeline); instead, with
-``access_log_sample > 0``, every Nth completed request emits a
-structured ``http.access`` event into the flight recorder (method, path,
-status, duration, trace id), additionally capped at
-``ACCESS_LOG_MAX_PER_S`` events/second so a scrape storm cannot evict
-the incident history the ring exists to keep.
+Access logging: with ``access_log_sample > 0``, every Nth completed
+request emits a structured ``http.access`` event into the flight
+recorder (method, path, status, duration, trace id), additionally
+capped at ``ACCESS_LOG_MAX_PER_S`` events/second so a scrape storm
+cannot evict the incident history the ring exists to keep.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from transmogrifai_tpu.serving.aiohttp_core import (
+    AsyncHTTPServer, Request, Response,
+)
 from transmogrifai_tpu.utils.events import events
 from transmogrifai_tpu.utils.prometheus import CONTENT_TYPE
 from transmogrifai_tpu.utils.tracing import new_trace_id, sanitize_trace_id
 
-__all__ = ["MetricsServer", "TRACE_HEADER", "MAX_BODY_BYTES"]
+__all__ = ["MetricsServer", "TRACE_HEADER", "MAX_BODY_BYTES",
+           "CONTENT_TYPE_FRAME", "CONTENT_TYPE_NDJSON"]
 
 #: the request/response trace-context header (Dapper/B3-style: honor an
 #: inbound id so a caller's trace continues through this hop)
@@ -73,8 +79,13 @@ TRACE_HEADER = "X-Trace-Id"
 #: hard ceiling on sampled access-log events per second
 ACCESS_LOG_MAX_PER_S = 100
 
-#: default request-body bound (bytes): one JSON request row, with slack
+#: default request-body bound (bytes): one JSON request row or one
+#: columnar frame, with slack
 MAX_BODY_BYTES = 1 << 20
+
+#: negotiated content types on POST /score (see module docstring)
+CONTENT_TYPE_FRAME = "application/x-tmog-frame"
+CONTENT_TYPE_NDJSON = "application/x-ndjson"
 
 
 class MetricsServer:
@@ -87,12 +98,19 @@ class MetricsServer:
                      [Optional[str], dict, Optional[str]], dict]] = None,
                  control_fn: Optional[Callable[[str, dict], dict]] = None,
                  access_log_sample: float = 0.0,
-                 max_body_bytes: int = MAX_BODY_BYTES):
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 frame_fn: Optional[Callable[
+                     [Optional[str], bytes, Optional[str]],
+                     bytes]] = None):
         self.render_fn = render_fn
         self.health_fn = health_fn
         #: ``score_fn(model_id_or_None, row, trace_id) -> score doc``;
         #: None disables the POST /score routes (scrape-only endpoint)
         self.score_fn = score_fn
+        #: ``frame_fn(model_id_or_None, frame_bytes, trace_id) ->
+        #: reply frame bytes`` — the binary columnar scoring wire
+        #: (``application/x-tmog-frame``); None disables it
+        self.frame_fn = frame_fn
         #: ``control_fn(action, payload) -> reply doc`` behind
         #: ``POST /admin/<action>`` — the replica-worker control plane
         #: (None disables the admin routes). The endpoint binds loopback
@@ -106,14 +124,13 @@ class MetricsServer:
         self._access_n = 0
         self._access_window = [0.0, 0]   # [window second, emits in it]
         self._access_lock = threading.Lock()
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._http: Optional[AsyncHTTPServer] = None
         self._host = host
         self._requested_port = int(port)
 
     @property
     def port(self) -> Optional[int]:
-        return self._httpd.server_address[1] if self._httpd else None
+        return self._http.port if self._http else None
 
     # -- access log ----------------------------------------------------------
     def _access(self, method: str, path: str, status: int, t0: float,
@@ -142,215 +159,229 @@ class MetricsServer:
                     path=path, status=int(status),
                     durationMs=round((time.monotonic() - t0) * 1e3, 3))
 
+    # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MetricsServer":
-        if self._httpd is not None:
+        if self._http is not None:
             return self
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            # HTTP/1.1: persistent connections by default — the router->
-            # replica hop must not pay a TCP handshake per request. Every
-            # reply carries Content-Length (send_error closes on its own)
-            protocol_version = "HTTP/1.1"
-            # TCP_NODELAY: the reply's status+headers and body flush as
-            # separate writes; with Nagle on, the body segment waits for
-            # the ACK of the first — a ~40ms delayed-ACK stall PER
-            # REQUEST on kernels that delay loopback ACKs. A scoring
-            # endpoint's replies are single small documents: latency
-            # wins, coalescing buys nothing.
-            disable_nagle_algorithm = True
-
-            def _read_body(self) -> Optional[bytes]:
-                """Bounded request-body read, or None after an error
-                reply. Oversized (413) and length-less-chunked (411)
-                bodies are refused WITHOUT reading — send_error marks
-                the connection close, so an unread body can't desync
-                keep-alive."""
-                if self.headers.get("Transfer-Encoding"):
-                    self.send_error(
-                        411, "chunked bodies unsupported; send "
-                             "Content-Length")
-                    return None
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                except ValueError:
-                    self.send_error(400, "malformed Content-Length")
-                    return None
-                if n < 0:
-                    # read(-1) would buffer until EOF — the exact
-                    # unbounded read the bound exists to prevent
-                    self.send_error(400, "negative Content-Length")
-                    return None
-                if n > outer.max_body_bytes:
-                    self.send_error(
-                        413, f"request body {n} bytes exceeds the "
-                             f"{outer.max_body_bytes}-byte bound")
-                    return None
-                return self.rfile.read(n) if n else b""
-
-            def _reply(self, code: int, body: bytes, ctype: str,
-                       extra: Optional[dict] = None) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in (extra or {}).items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):  # noqa: N802 — http.server API
-                t0 = time.monotonic()
-                path = self.path.split("?")[0]
-                try:
-                    if path == "/metrics":
-                        body = outer.render_fn().encode()
-                        ctype = CONTENT_TYPE
-                    elif path == "/healthz":
-                        body = (json.dumps(outer.health_fn())
-                                + "\n").encode()
-                        ctype = "application/json"
-                    else:
-                        self.send_error(404, "only /metrics, /healthz "
-                                             "and POST /score")
-                        outer._access("GET", path, 404, t0)
-                        return
-                except Exception as e:  # noqa: BLE001 — a scrape must see the failure, not a hang
-                    self.send_error(
-                        500, f"{type(e).__name__}: {str(e)[:200]}")
-                    outer._access("GET", path, 500, t0)
-                    return
-                self._reply(200, body, ctype)
-                outer._access("GET", path, 200, t0)
-
-            def do_POST(self):  # noqa: N802 — http.server API
-                t0 = time.monotonic()
-                path = self.path.split("?")[0]
-                if outer.control_fn is not None \
-                        and path.startswith("/admin/"):
-                    self._admin(path, t0)
-                    return
-                if outer.score_fn is None or not (
-                        path == "/score" or path.startswith("/score/")):
-                    self.send_error(
-                        404, "POST /score requires a scoring server")
-                    outer._access("POST", path, 404, t0)
-                    return
-                model_id = path[len("/score/"):] or None \
-                    if path.startswith("/score/") else None
-                # trace context: continue the caller's trace or start one
-                trace_id = sanitize_trace_id(
-                    self.headers.get(TRACE_HEADER)) or new_trace_id()
-                traced = {TRACE_HEADER: trace_id}
-
-                def err_json(c, e, extra=None):
-                    self._reply(
-                        c, (json.dumps(
-                            {"error": f"{type(e).__name__}: "
-                                      f"{str(e)[:300]}",
-                             "traceId": trace_id}) + "\n").encode(),
-                        "application/json", {**traced, **(extra or {})})
-                    outer._access("POST", path, c, t0, trace_id)
-                try:
-                    raw = self._read_body()
-                    if raw is None:
-                        outer._access("POST", path, 413, t0, trace_id)
-                        return
-                    row = json.loads(raw or b"{}")
-                    if not isinstance(row, dict):
-                        raise ValueError("request body must be one JSON "
-                                         "object (a request row)")
-                    doc = outer.score_fn(model_id, row, trace_id)
-                except Exception as e:  # noqa: BLE001 — mapped to an HTTP status below
-                    from concurrent.futures import (
-                        TimeoutError as FutureTimeout,
-                    )
-
-                    from transmogrifai_tpu.serving.batcher import (
-                        BackpressureError, RequestTimeout,
-                    )
-                    from transmogrifai_tpu.serving.registry import (
-                        UnknownModelError,
-                    )
-                    if isinstance(e, BackpressureError):
-                        err_json(503, e, {"Retry-After":
-                                          f"{e.retry_after_s:.3f}"})
-                    elif isinstance(e, UnknownModelError):
-                        err_json(404, e)
-                    elif isinstance(e, (RequestTimeout, TimeoutError,
-                                        FutureTimeout)):
-                        # RequestTimeout = queue deadline; Future/builtin
-                        # TimeoutError = the result-wait bound (NOT the
-                        # same class pre-3.11) — all 504, never a 5xx
-                        # "server fault"
-                        err_json(504, e)
-                    elif isinstance(e, (KeyError, ValueError,
-                                        json.JSONDecodeError)):
-                        err_json(400, e)  # strict admission / bad body
-                    else:
-                        err_json(500, e)
-                    return
-                self._reply(200, (json.dumps(doc, default=str)
-                                  + "\n").encode(), "application/json",
-                            traced)
-                outer._access("POST", path, 200, t0, trace_id)
-
-            def _admin(self, path: str, t0: float) -> None:
-                """``POST /admin/<action>``: the replica-worker control
-                plane. JSON payload -> ``control_fn(action, payload)``
-                -> JSON reply. Status mapping mirrors /score, plus 409
-                for a shadow-gate rejection (a rolling swap must tell
-                "candidate failed parity" from infrastructure faults)."""
-                action = path[len("/admin/"):]
-                try:
-                    raw = self._read_body()
-                    if raw is None:
-                        outer._access("POST", path, 413, t0)
-                        return
-                    payload = json.loads(raw or b"{}")
-                    if not isinstance(payload, dict):
-                        raise ValueError("admin payload must be a JSON "
-                                         "object")
-                    doc = outer.control_fn(action, payload)
-                    code = 200
-                except Exception as e:  # noqa: BLE001 — mapped to an HTTP status
-                    from transmogrifai_tpu.serving.registry import (
-                        UnknownModelError,
-                    )
-                    if type(e).__name__ == "ShadowParityError":
-                        code = 409
-                    elif isinstance(e, UnknownModelError):
-                        code = 404
-                    elif isinstance(e, (KeyError, ValueError,
-                                        json.JSONDecodeError)):
-                        code = 400
-                    else:
-                        code = 500
-                    doc = {"ok": False, "error":
-                           f"{type(e).__name__}: {str(e)[:300]}"}
-                self._reply(code, (json.dumps(doc, default=str)
-                                   + "\n").encode(), "application/json")
-                outer._access("POST", path, code, t0)
-
-            def log_message(self, *args):
-                # stderr access lines are suppressed; the structured,
-                # sampled http.access event stream replaces them
-                pass
-
-        self._httpd = ThreadingHTTPServer(
-            (self._host, self._requested_port), Handler)
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="transmogrifai-metrics-http", daemon=True)
-        self._thread.start()
+        self._http = AsyncHTTPServer(
+            self._handle, port=self._requested_port, host=self._host,
+            max_body_bytes=self.max_body_bytes,
+            name="transmogrifai-metrics-http").start()
         return self
 
     def stop(self) -> None:
-        if self._httpd is None:
+        if self._http is None:
             return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        self._http.stop()
+        self._http = None
+
+    # -- request handling (event loop) ---------------------------------------
+    async def _handle(self, req: Request) -> Response:
+        if req.method == "GET":
+            return await self._do_get(req)
+        if req.method == "POST":
+            return await self._do_post(req)
+        return Response.error(404, f"method {req.method} unsupported")
+
+    async def _do_get(self, req: Request) -> Response:
+        t0 = time.monotonic()
+        path = req.path
+        try:
+            if path == "/metrics":
+                body = (await self._http.run_blocking(
+                    self.render_fn)).encode()
+                ctype = CONTENT_TYPE
+            elif path == "/healthz":
+                doc = await self._http.run_blocking(self.health_fn)
+                body = (json.dumps(doc) + "\n").encode()
+                ctype = "application/json"
+            else:
+                self._access("GET", path, 404, t0)
+                return Response.error(
+                    404, "only /metrics, /healthz and POST /score")
+        except Exception as e:  # noqa: BLE001 — a scrape must see the failure, not a hang
+            self._access("GET", path, 500, t0)
+            return Response.error(
+                500, f"{type(e).__name__}: {str(e)[:200]}")
+        self._access("GET", path, 200, t0)
+        return Response(200, body, ctype)
+
+    async def _do_post(self, req: Request) -> Response:
+        t0 = time.monotonic()
+        path = req.path
+        if self.control_fn is not None and path.startswith("/admin/"):
+            return await self._admin(req, path, t0)
+        servable = self.score_fn is not None \
+            or self.frame_fn is not None
+        if not servable or not (path == "/score"
+                                or path.startswith("/score/")):
+            self._access("POST", path, 404, t0)
+            return Response.error(
+                404, "POST /score requires a scoring server")
+        model_id = path[len("/score/"):] or None \
+            if path.startswith("/score/") else None
+        # trace context: continue the caller's trace or start one
+        trace_id = sanitize_trace_id(
+            req.header(TRACE_HEADER)) or new_trace_id()
+        ctype = (req.header("content-type") or "").split(";")[0].strip()
+        if ctype == CONTENT_TYPE_FRAME:
+            return await self._score_frame(req, path, model_id,
+                                           trace_id, t0)
+        if ctype == CONTENT_TYPE_NDJSON:
+            return await self._score_ndjson(req, path, model_id,
+                                            trace_id, t0)
+        return await self._score_json(req, path, model_id, trace_id, t0)
+
+    def _err_json(self, code: int, e: BaseException, trace_id: str,
+                  extra: Optional[dict] = None) -> Response:
+        body = (json.dumps(
+            {"error": f"{type(e).__name__}: {str(e)[:300]}",
+             "traceId": trace_id}) + "\n").encode()
+        headers = {TRACE_HEADER: trace_id, **(extra or {})}
+        return Response(code, body, "application/json", headers)
+
+    def _map_score_error(self, e: BaseException, path: str,
+                         trace_id: str, t0: float) -> Response:
+        from concurrent.futures import TimeoutError as FutureTimeout
+
+        from transmogrifai_tpu.serving.batcher import (
+            BackpressureError, RequestTimeout,
+        )
+        from transmogrifai_tpu.serving.registry import UnknownModelError
+        if isinstance(e, BackpressureError):
+            resp = self._err_json(503, e, trace_id,
+                                  {"Retry-After":
+                                   f"{e.retry_after_s:.3f}"})
+        elif isinstance(e, UnknownModelError):
+            resp = self._err_json(404, e, trace_id)
+        elif isinstance(e, (RequestTimeout, TimeoutError,
+                            FutureTimeout, asyncio.TimeoutError)):
+            # RequestTimeout = queue deadline; Future/builtin
+            # TimeoutError = the result-wait bound (distinct classes
+            # pre-3.11, and run_in_executor re-raises a FutureTimeout
+            # as asyncio.TimeoutError) — all 504, never a 5xx
+            # "server fault"
+            resp = self._err_json(504, e, trace_id)
+        elif isinstance(e, (KeyError, ValueError,
+                            json.JSONDecodeError)):
+            resp = self._err_json(400, e, trace_id)  # strict admission / bad body
+        else:
+            resp = self._err_json(500, e, trace_id)
+        self._access("POST", path, resp.status, t0, trace_id)
+        return resp
+
+    async def _score_json(self, req: Request, path: str,
+                          model_id: Optional[str], trace_id: str,
+                          t0: float) -> Response:
+        if self.score_fn is None:
+            self._access("POST", path, 404, t0, trace_id)
+            return Response.error(
+                404, "POST /score requires a scoring server")
+        try:
+            row = json.loads(req.body or b"{}")
+            if not isinstance(row, dict):
+                raise ValueError("request body must be one JSON "
+                                 "object (a request row)")
+            doc = await self._http.run_blocking(
+                self.score_fn, model_id, row, trace_id)
+        except Exception as e:  # noqa: BLE001 — mapped to an HTTP status
+            return self._map_score_error(e, path, trace_id, t0)
+        self._access("POST", path, 200, t0, trace_id)
+        return Response(200, (json.dumps(doc, default=str)
+                              + "\n").encode(), "application/json",
+                        {TRACE_HEADER: trace_id})
+
+    async def _score_ndjson(self, req: Request, path: str,
+                            model_id: Optional[str], trace_id: str,
+                            t0: float) -> Response:
+        """One JSON row per line in, one score document per line out.
+        Per-line failures reply INLINE (an ``{"error": ...}`` document
+        in that line's slot) so one poison row doesn't void the batch;
+        a request-level failure on the FIRST line (backpressure, an
+        unknown model) maps to its HTTP status like the JSON path, so
+        clients keep their retry semantics."""
+        if self.score_fn is None:
+            self._access("POST", path, 404, t0, trace_id)
+            return Response.error(
+                404, "POST /score requires a scoring server")
+        lines = [ln for ln in req.body.splitlines() if ln.strip()]
+
+        def run():
+            docs = []
+            for i, ln in enumerate(lines):
+                try:
+                    row = json.loads(ln)
+                    if not isinstance(row, dict):
+                        raise ValueError(
+                            "each NDJSON line must be one JSON object")
+                    docs.append(self.score_fn(model_id, row, trace_id))
+                except Exception as e:  # noqa: BLE001 — isolated per line (or mapped whole)
+                    if i == 0 and not docs:
+                        raise
+                    docs.append(
+                        {"error": f"{type(e).__name__}: "
+                                  f"{str(e)[:300]}",
+                         "traceId": trace_id})
+            return docs
+
+        try:
+            docs = await self._http.run_blocking(run)
+        except Exception as e:  # noqa: BLE001 — mapped to an HTTP status
+            return self._map_score_error(e, path, trace_id, t0)
+        body = "".join(json.dumps(d, default=str) + "\n"
+                       for d in docs).encode()
+        self._access("POST", path, 200, t0, trace_id)
+        return Response(200, body, CONTENT_TYPE_NDJSON,
+                        {TRACE_HEADER: trace_id})
+
+    async def _score_frame(self, req: Request, path: str,
+                           model_id: Optional[str], trace_id: str,
+                           t0: float) -> Response:
+        if self.frame_fn is None:
+            self._access("POST", path, 400, t0, trace_id)
+            return self._err_json(
+                400, ValueError(
+                    f"{CONTENT_TYPE_FRAME} unsupported on this "
+                    "endpoint"), trace_id)
+        try:
+            reply = await self._http.run_blocking(
+                self.frame_fn, model_id, req.body, trace_id)
+        except Exception as e:  # noqa: BLE001 — mapped to an HTTP status
+            return self._map_score_error(e, path, trace_id, t0)
+        self._access("POST", path, 200, t0, trace_id)
+        return Response(200, reply, CONTENT_TYPE_FRAME,
+                        {TRACE_HEADER: trace_id})
+
+    async def _admin(self, req: Request, path: str,
+                     t0: float) -> Response:
+        """``POST /admin/<action>``: the replica-worker control plane.
+        JSON payload -> ``control_fn(action, payload)`` -> JSON reply.
+        Status mapping mirrors /score, plus 409 for a shadow-gate
+        rejection (a rolling swap must tell "candidate failed parity"
+        from infrastructure faults)."""
+        action = path[len("/admin/"):]
+        try:
+            payload = json.loads(req.body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("admin payload must be a JSON object")
+            doc = await self._http.run_blocking(
+                self.control_fn, action, payload)
+            code = 200
+        except Exception as e:  # noqa: BLE001 — mapped to an HTTP status
+            from transmogrifai_tpu.serving.registry import (
+                UnknownModelError,
+            )
+            if type(e).__name__ == "ShadowParityError":
+                code = 409
+            elif isinstance(e, UnknownModelError):
+                code = 404
+            elif isinstance(e, (KeyError, ValueError,
+                                json.JSONDecodeError)):
+                code = 400
+            else:
+                code = 500
+            doc = {"ok": False, "error":
+                   f"{type(e).__name__}: {str(e)[:300]}"}
+        self._access("POST", path, code, t0)
+        return Response(code, (json.dumps(doc, default=str)
+                               + "\n").encode(), "application/json")

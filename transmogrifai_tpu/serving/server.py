@@ -47,6 +47,12 @@ __all__ = ["ScoringServer"]
 #: the batcher (popped before scoring; never a raw feature)
 _EXPLAIN_K = "__explain_top_k__"
 
+#: reserved batcher-item key carrying one decoded wire frame's host
+#: columns through the SAME admission queue as row requests (one queue
+#: slot per frame): backpressure, deadlines, and zero-drop semantics
+#: apply to framed batches unchanged
+_FRAME_K = "__wire_frame__"
+
 
 class ScoringServer:
     """Thread-based online scorer for a fitted ``WorkflowModel``.
@@ -328,6 +334,42 @@ class ScoringServer:
                                 trace_id=trace_id),
             max_wait_s=max_wait_s)
 
+    def submit_frame(self, frame,
+                     timeout_ms: Optional[float] = None,
+                     trace_id: Optional[str] = None) -> Future:
+        """Admit one decoded wire frame (``wireformat.WireFrame``): the
+        columnar analog of ``submit``. The column build happens HERE on
+        the caller's thread (zero-copy from the wire buffers), so a
+        malformed frame fails fast — ``KeyError`` for a missing raw
+        feature, ``WireFormatError``/``FeatureTypeValueError`` for a
+        type mismatch — without ever queueing. The future resolves to
+        ``("columns", {name: ndarray|list})`` on the compiled path or
+        ``("rows", [doc | Exception])`` when the batch row-served
+        (degraded mode / data-error isolation) — either way every row
+        settles (``wireformat.reply_columns`` / ``rows_to_reply_
+        columns`` encode both shapes)."""
+        if frame.n_rows == 0:
+            fut: Future = Future()
+            fut.set_result(("columns", {}))
+            return fut
+        cols, n = self.scorer.host_columns_from_wire(frame)
+        try:
+            # weight=n: a frame that already fills max_batch dispatches
+            # immediately instead of sitting out the coalescing wait
+            fut = self.batcher.submit({_FRAME_K: (cols, n)},
+                                      timeout_ms=timeout_ms,
+                                      trace_id=trace_id, weight=n)
+        except BackpressureError as e:
+            self.metrics.record_rejected(invalid=False)
+            events.emit_limited(
+                f"bp:{id(self)}", 1.0, "serving.backpressure_reject",
+                trace_id=trace_id, model=self.event_label,
+                queueDepth=self.batcher.queue_depth,
+                retryAfterS=round(e.retry_after_s, 4))
+            raise
+        self.metrics.record_admitted()
+        return fut
+
     def submit_explain(self, row: dict, top_k: Optional[int] = None,
                        timeout_ms: Optional[float] = None,
                        trace_id: Optional[str] = None) -> Future:
@@ -395,11 +437,49 @@ class ScoringServer:
 
     # -- dispatch (batcher worker thread) ------------------------------------
     def _dispatch(self, rows: Sequence[dict]) -> list[Any]:
+        """Batcher worker entry: partition the coalesced batch into
+        plain rows and framed-columnar items (``_FRAME_K`` sentinels,
+        one per wire frame), serve each through the same compiled /
+        degrade / row-fallback ladder, and settle every future."""
+        t0 = time.monotonic()
+        frame_ix = [i for i, r in enumerate(rows) if _FRAME_K in r]
+        if not frame_ix:
+            results, degraded = self._dispatch_rows(rows)
+            self.metrics.record_batch(len(rows),
+                                      time.monotonic() - t0,
+                                      degraded=degraded)
+            return results
+        out: list[Any] = [None] * len(rows)
+        degraded = False
+        plain_ix = [i for i in range(len(rows)) if i not in
+                    set(frame_ix)]
+        if plain_ix:
+            res, deg = self._dispatch_rows([rows[i] for i in plain_ix])
+            degraded |= deg
+            for i, r in zip(plain_ix, res):
+                out[i] = r
+        for i in frame_ix:
+            cols, n = rows[i][_FRAME_K]
+            try:
+                out[i], deg = self._dispatch_frame(cols, n)
+                degraded |= deg
+            except Exception as e:  # noqa: BLE001 — harness errors re-raised inside
+                from transmogrifai_tpu.utils.faults import (
+                    FaultHarnessError,
+                )
+                if isinstance(e, FaultHarnessError):
+                    raise
+                out[i] = e
+        self.metrics.record_batch(len(rows), time.monotonic() - t0,
+                                  degraded=degraded)
+        return out
+
+    def _dispatch_rows(self, rows: Sequence[dict]
+                       ) -> tuple[list[Any], bool]:
         from transmogrifai_tpu.types.feature_types import (
             FeatureTypeValueError,
         )
         from transmogrifai_tpu.utils.tracing import span
-        t0 = time.monotonic()
         degraded = True
         if self._compiled_eligible():
             try:
@@ -440,9 +520,83 @@ class ScoringServer:
                     results = self._row_dispatch(rows)
         else:
             results = self._row_dispatch(rows)
-        self.metrics.record_batch(len(rows), time.monotonic() - t0,
-                                  degraded=degraded)
-        return results
+        return results, degraded
+
+    # -- framed-columnar dispatch (batcher worker thread) --------------------
+    def _dispatch_frame(self, cols: dict, n: int) -> tuple[Any, bool]:
+        """One wire frame through the serving ladder. Compiled success
+        returns ``("columns", result_cols)`` — no row dicts anywhere.
+        Every fallback (data error, degraded mode, shed-ladder
+        exhaustion) converts the columns to rows ONCE and re-serves
+        through the existing row machinery, returning ``("rows",
+        [doc | Exception])`` — per-row faults isolate, zero drops."""
+        from transmogrifai_tpu.types.feature_types import (
+            FeatureTypeValueError,
+        )
+        from transmogrifai_tpu.utils.tracing import span
+        if self._compiled_eligible():
+            try:
+                with span("serving.compiled_dispatch", rows=n,
+                          wire="frame"):
+                    return (("columns",
+                             self._compiled_frame_dispatch(cols, n)),
+                            False)
+            except FeatureTypeValueError:
+                self.metrics.record_data_error_batch()
+                return ("rows", self._row_dispatch(
+                    self._cols_to_rows(cols, n))), False
+            except Exception as e:  # noqa: BLE001 — same ladder as _dispatch_rows
+                from transmogrifai_tpu.utils.faults import (
+                    FaultHarnessError,
+                )
+                if isinstance(e, FaultHarnessError):
+                    raise
+                rows = self._cols_to_rows(cols, n)
+                shed_results = self._shed_and_retry(rows, e)
+                if shed_results is not None:
+                    self._exit_degraded()
+                    return ("rows", shed_results), False
+                self._enter_degraded(e)
+                return ("rows", self._row_dispatch(rows)), True
+        return ("rows", self._row_dispatch(
+            self._cols_to_rows(cols, n))), True
+
+    def _compiled_frame_dispatch(self, cols: dict, n: int) -> dict:
+        """``_compiled_dispatch`` for a columnar batch: same devicewatch
+        ledger/guard, chaos seam, and transient retry around
+        ``scorer.score_columns``."""
+        from transmogrifai_tpu.utils import devicewatch
+        from transmogrifai_tpu.utils.faults import fault_point
+        attempts = {"n": 0}
+
+        def attempt():
+            attempts["n"] += 1
+            fault_point("serving.dispatch")
+            return self.scorer.score_columns(cols, n)
+
+        eid = devicewatch.dispatch_ledger.register(
+            "serving.dispatch", rows=n, model=self.event_label)
+        try:
+            with devicewatch.guard("serving.dispatch",
+                                   site="serving.dispatch", rows=n):
+                result = with_device_retry(
+                    attempt, retries=self.retries,
+                    backoff_s=self.retry_backoff_s)
+        finally:
+            devicewatch.dispatch_ledger.complete(eid)
+            if attempts["n"] > 1:
+                self.metrics.record_retry(attempts["n"] - 1)
+        self._exit_degraded()
+        return result
+
+    @staticmethod
+    def _cols_to_rows(cols: dict, n: int) -> list[dict]:
+        """Host columns back to request rows — the fallback seam: the
+        row path's closure wants python values, and a frame that hit a
+        degraded/poisoned batch pays the conversion exactly once."""
+        names = list(cols)
+        return [{name: cols[name].python_value(i) for name in names}
+                for i in range(n)]
 
     def _compiled_eligible(self) -> bool:
         if self._degraded_since is None:
