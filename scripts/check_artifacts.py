@@ -101,6 +101,141 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_explain_overhead(doc))
     if doc.get("metric") == "wire_speed":
         errors.extend(_validate_wire_speed(doc))
+    if doc.get("metric") == "multitenant_fleet":
+        errors.extend(_validate_multitenant_fleet(doc))
+    return errors
+
+
+#: round-17 acceptance bounds for the multi-tenant 1000-model fleet:
+#: registration must be lazy (ZERO checkpoint loads while registering),
+#: hot-tenant p99 must stay interactive while cold tenants page in
+#: around it, a first-score cold start (disk -> RAM -> compiled lane)
+#: must complete within the SLA, and a hot-tenant flood may cost the
+#: cold tenants at most MAX_MT_FAIRNESS_RATIO x their unloaded p99 —
+#: otherwise admission is not isolating tenants
+MIN_MT_MODELS = 1000
+MAX_MT_HOT_P99_MS = 250.0
+MAX_MT_COLD_START_P99_MS = 5000.0
+MAX_MT_FAIRNESS_RATIO = 4.0
+
+
+def _validate_multitenant_fleet(doc: dict) -> list[str]:
+    """The ``benchmarks/MULTITENANT_FLEET.json`` contract: >=
+    MIN_MT_MODELS versioned model dirs lazily registered (counter-
+    asserted zero ``np.load`` at registration), Zipf-skewed traffic
+    through the live fleet with zero drops, demand paging actually
+    cycling (promotions AND budget demotions both > 0), hot-tenant p99
+    under MAX_MT_HOT_P99_MS, measured cold-start p99 under
+    MAX_MT_COLD_START_P99_MS, and the fairness experiment: a hot-tenant
+    flood leaves cold-tenant p99 within MAX_MT_FAIRNESS_RATIO x the
+    flood-free baseline, with the flood actually throttled and no cold
+    request dropped."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    def nonneg_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+    models = doc.get("models")
+    if not (pos_int(models) and models >= MIN_MT_MODELS):
+        errors.append(f"multitenant artifact: 'models' must be an int "
+                      f">= {MIN_MT_MODELS} — the fleet claim is about "
+                      "model counts no eager registry could hold")
+    if doc.get("zero_dropped") is not True:
+        errors.append("multitenant artifact: 'zero_dropped' must be "
+                      "true — throttled is retried, never dropped")
+    regn = doc.get("registration")
+    if not isinstance(regn, dict):
+        errors.append("multitenant artifact: missing 'registration' "
+                      "block")
+    else:
+        if not (pos_int(regn.get("models"))
+                and regn["models"] >= MIN_MT_MODELS):
+            errors.append(f"multitenant artifact: registration.models "
+                          f"must be >= {MIN_MT_MODELS}")
+        if not (num(regn.get("wall_s")) and regn["wall_s"] > 0):
+            errors.append("multitenant artifact: registration.wall_s "
+                          "must be positive")
+        loads = regn.get("loads_at_register")
+        if not nonneg_int(loads):
+            errors.append("multitenant artifact: registration."
+                          "loads_at_register must be an int (spy-"
+                          "counted np.load calls during register_dir)")
+        elif loads != 0:
+            errors.append(
+                f"lazy-registration contract violated: {loads} "
+                "checkpoint load(s) during registration — registering "
+                "a model must only stat its manifest")
+    hot = doc.get("hot")
+    if not (isinstance(hot, dict) and num(hot.get("rps"))
+            and hot.get("rps", 0) > 0 and num(hot.get("p50_ms"))
+            and num(hot.get("p99_ms"))):
+        errors.append("multitenant artifact: 'hot' must record the "
+                      "hot-tenant leg's positive 'rps' + "
+                      "'p50_ms'/'p99_ms'")
+    elif hot["p99_ms"] > MAX_MT_HOT_P99_MS:
+        errors.append(
+            f"hot-tenant p99 bound violated: {hot['p99_ms']}ms > "
+            f"{MAX_MT_HOT_P99_MS:g}ms while cold tenants paged in")
+    cold = doc.get("cold_start_ms")
+    if not (isinstance(cold, dict) and pos_int(cold.get("count"))
+            and num(cold.get("p50")) and num(cold.get("p99"))):
+        errors.append("multitenant artifact: 'cold_start_ms' must "
+                      "record positive 'count' + numeric 'p50'/'p99' "
+                      "(the measured first-score page-in SLA)")
+    elif cold["p99"] > MAX_MT_COLD_START_P99_MS:
+        errors.append(
+            f"cold-start SLA violated: p99 {cold['p99']}ms > "
+            f"{MAX_MT_COLD_START_P99_MS:g}ms disk -> RAM -> lane")
+    fair = doc.get("fairness")
+    if not isinstance(fair, dict):
+        errors.append("multitenant artifact: missing 'fairness' block")
+    else:
+        for k in ("baseline_p99_ms", "flood_p99_ms"):
+            if not (num(fair.get(k)) and fair[k] > 0):
+                errors.append(f"multitenant artifact: fairness.{k} "
+                              "must be positive")
+        ratio = fair.get("ratio")
+        if not num(ratio):
+            errors.append("multitenant artifact: fairness.ratio must "
+                          "be numeric (flood p99 / baseline p99 for "
+                          "the cold tenants)")
+        elif ratio > MAX_MT_FAIRNESS_RATIO:
+            errors.append(
+                f"fairness bound violated: a hot-tenant flood pushed "
+                f"cold-tenant p99 to {ratio}x the flood-free baseline "
+                f"(> {MAX_MT_FAIRNESS_RATIO:g}x) — admission is not "
+                "isolating tenants")
+        if not pos_int(fair.get("hot_throttled")):
+            errors.append("multitenant artifact: fairness."
+                          "hot_throttled must be >= 1 — a flood the "
+                          "bucket never throttled proves nothing")
+        if fair.get("cold_dropped") != 0:
+            errors.append("multitenant artifact: fairness.cold_dropped "
+                          "must be exactly 0")
+    tiers = doc.get("tiers")
+    if not isinstance(tiers, dict):
+        errors.append("multitenant artifact: missing 'tiers' block")
+    else:
+        for k in ("promotions_disk_ram", "promotions_ram_hbm",
+                  "demotions_ram"):
+            if not pos_int(tiers.get(k)):
+                errors.append(
+                    f"multitenant artifact: tiers.{k} must be >= 1 — "
+                    "the residency ladder must actually cycle (page "
+                    "in AND evict under the RAM budget)")
+        if not pos_int(tiers.get("ram_budget_bytes")):
+            errors.append("multitenant artifact: tiers."
+                          "ram_budget_bytes must be a positive int "
+                          "(an unbounded RAM tier never demotes)")
+    if not pos_int(doc.get("distinct_models_scored")):
+        errors.append("multitenant artifact: missing positive int "
+                      "'distinct_models_scored'")
     return errors
 
 

@@ -45,11 +45,14 @@ _SNAKE_RE = re.compile(r"^transmogrifai_[a-z0-9]+(_[a-z0-9]+)*$")
 #: like "sweep.tree_group") — measured things, not schema fields
 #: "bySite"/"stallsBySite"/"programCosts" are keyed by devicewatch site
 #: labels (dotted identifiers like "sweep.settle") — measured things
+#: "tenants"/"weights" are keyed by tenant (model) ids, "ringWeights"
+#: by replica ids — routing/admission data, not schema fields
 DATA_KEYED = {"phases", "stages", "sizeHistogram", "buckets",
               "compileBuckets", "families", "sweep", "customParams",
               "stageOverrides", "readerOverrides", "objectives",
               "alerts", "attrs", "degradationsBySite", "bySite",
-              "stallsBySite", "programCosts"}
+              "stallsBySite", "programCosts", "tenants", "weights",
+              "ringWeights"}
 
 
 def check_json_doc(doc, where: str, _parent_key: str = "") -> list[str]:
@@ -183,6 +186,80 @@ def collect_violations() -> list[str]:
         active_lanes=lambda: {"churn": lane})
     out.extend(check_registry(build_registry(fleet=fleet)))
 
+    # the multi-tenant tiering surface (round 17): a tenancy-enabled
+    # fleet stub with MORE lanes than the top-K cap
+    # (TRANSMOGRIFAI_METRICS_TENANT_TOPK=3 here) so the model="_other"
+    # rollup series actually render and lint, plus the
+    # transmogrifai_tenancy_* residency ladder and the
+    # transmogrifai_fairness_* per-tenant series (tenant="_other"
+    # rollup included) over real metrics objects driven hot
+    from transmogrifai_tpu.serving.batcher import BackpressureError
+    from transmogrifai_tpu.tenancy import TenantAdmission, TierMetrics
+    from transmogrifai_tpu.utils.prometheus import TENANT_TOPK_ENV
+
+    def hot_lane(admits: int):
+        m = ServingMetrics(max_samples=16)
+        m.record_admitted(admits)
+        m.record_requests_done([(0.01, True)] * 2)
+        m.record_batch(2, 0.01)
+        cc2 = ServingCounters()
+        cc2.count(8, dispatches=1, compiles=1)
+        m.compile_counters = cc2
+        return types.SimpleNamespace(metrics=m, state="ready",
+                                     explain_metrics=None,
+                                     explainer=None)
+
+    lanes = {f"tenant{i}": hot_lane(10 * (i + 1)) for i in range(5)}
+    # the two COLDEST lanes roll up — give them explain metrics so the
+    # explain _other rollup renders too
+    lanes["tenant0"].explain_metrics = explain_metrics
+    lanes["tenant1"].explain_metrics = explain_metrics
+    tiers = TierMetrics()
+    tiers.note_promotion_ram()
+    tiers.note_promotion_hbm()
+    tiers.note_demotion(hbm_entries=2)
+    tiers.note_shed()
+    tiers.note_prewarm()
+    tiers.note_cold_start(0.125)
+    out.extend(check_json_doc(tiers.to_json(), "TierMetrics.to_json"))
+    store_stub = types.SimpleNamespace(
+        metrics=tiers, ram_bytes=1 << 20, ram_budget_bytes=4 << 20,
+        resident_count=2,
+        to_json=lambda: {"residentModels": 2, "ramBytes": 1 << 20,
+                         "ramBudgetBytes": 4 << 20,
+                         "metrics": tiers.to_json()})
+    fake_now = [1000.0]
+    admission = TenantAdmission(rate_per_s=2.0, burst=2.0,
+                                weights={"tenant4": 0.5},
+                                clock=lambda: fake_now[0])
+    for i in range(5):
+        for _ in range(3):  # burst 2 -> the 3rd request throttles
+            try:
+                admission.admit(f"tenant{i}")
+            except BackpressureError:
+                pass
+    admission.metrics.note_cold_start_wait(0.125)
+    out.extend(check_json_doc(admission.to_json(top_k=3),
+                              "TenantAdmission.to_json"))
+    registry_stub = types.SimpleNamespace(
+        list=lambda: [{"model": f"cold{i}", "state": "cold"}
+                      for i in range(3)])
+    tfleet = types.SimpleNamespace(
+        metrics=fleet_metrics, program_cache=cache,
+        active_lanes=lambda: dict(lanes),
+        tenancy_store=store_stub, admission=admission,
+        registry=registry_stub)
+    saved_topk = os.environ.get(TENANT_TOPK_ENV)
+    os.environ[TENANT_TOPK_ENV] = "3"
+    try:
+        out.extend(check_registry(build_registry(fleet=tfleet,
+                                                 include_app=False)))
+    finally:
+        if saved_topk is None:
+            os.environ.pop(TENANT_TOPK_ENV, None)
+        else:
+            os.environ[TENANT_TOPK_ENV] = saved_topk
+
     # the continuous-loop registry: lifecycle counters + per-feature
     # drift-score gauges. Same structural-stub approach — real metrics
     # objects, no live loop — so every collector closure renders.
@@ -222,9 +299,19 @@ def collect_violations() -> list[str]:
     rm.count("spillovers")
     rm.count("retries")
     rm.count("markdowns")
+    rm.count("rebalances")
     out.extend(check_json_doc(rm.to_json(), "RouterMetrics.to_json"))
+    from transmogrifai_tpu.tenancy import PopularityTracker
+
+    tracker = PopularityTracker(half_life_s=30.0, clock=lambda: 100.0)
+    tracker.record("live", 5.0)
+    out.extend(check_json_doc(tracker.to_json(),
+                              "PopularityTracker.to_json"))
+    skew_ring = ConsistentHashRing(["r0", "r1"])
+    skew_ring.set_weights({"r0": 1.5, "r1": 0.75})
     router_stub = types.SimpleNamespace(
-        metrics=rm, ring=ConsistentHashRing(["r0", "r1"]),
+        metrics=rm, ring=skew_ring,
+        load_skew=lambda: 1.5,
         replicas=lambda: {"r0": {"replicaId": "r0",
                                  "host": "127.0.0.1", "port": 9001,
                                  "state": "up", "changedAt": 0.0},

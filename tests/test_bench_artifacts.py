@@ -769,3 +769,94 @@ def test_wire_speed_artifact_committed_and_healthy(checker):
     assert art["router"]["binary_rps"] > 0
     assert art["compile_storm"]["max_post_warmup_per_bucket"] == 0
     assert art["swap"]["zero_dropped"] is True
+
+
+def _multitenant_good():
+    return {
+        "metric": "multitenant_fleet", "platform": "cpu",
+        "requests": 12000, "wall_s": 40.0, "models": 1000,
+        "zero_dropped": True, "distinct_models_scored": 180,
+        "registration": {"models": 1000, "wall_s": 1.8,
+                         "loads_at_register": 0},
+        "hot": {"rps": 800.0, "p50_ms": 6.0, "p99_ms": 40.0},
+        "cold_start_ms": {"count": 150, "p50": 300.0, "p99": 900.0,
+                          "max": 1500.0},
+        "fairness": {"baseline_p99_ms": 30.0, "flood_p99_ms": 45.0,
+                     "ratio": 1.5, "hot_throttled": 200,
+                     "cold_dropped": 0},
+        "tiers": {"promotions_disk_ram": 170, "promotions_ram_hbm": 170,
+                  "demotions_ram": 110, "demotions_hbm": 80,
+                  "ram_budget_bytes": 1 << 26},
+    }
+
+
+def test_multitenant_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _multitenant_good()
+    assert v(good) == []
+    # the fleet-size floor: the whole claim is "no eager registry
+    # could hold this many"
+    assert any("models" in e for e in v({**good, "models": 999}))
+    assert any("zero_dropped" in e for e in v(
+        {**good, "zero_dropped": False}))
+    # lazy registration is counter-asserted: ONE np.load at register
+    # time breaks the contract
+    regn = good["registration"]
+    assert any("lazy-registration" in e for e in v(
+        {**good, "registration": {**regn, "loads_at_register": 1}}))
+    assert any("registration" in e for e in v(
+        {k: x for k, x in good.items() if k != "registration"}))
+    # the hot-tenant p99 bound while cold tenants page in around it
+    assert any("hot-tenant p99" in e for e in v(
+        {**good, "hot": {**good["hot"], "p99_ms": 400.0}}))
+    # the first-score cold-start SLA
+    assert any("cold-start SLA" in e for e in v(
+        {**good, "cold_start_ms": {**good["cold_start_ms"],
+                                   "p99": 9000.0}}))
+    # the fairness experiment: bounded flood damage, flood actually
+    # throttled, no cold request dropped
+    fair = good["fairness"]
+    assert any("fairness bound" in e for e in v(
+        {**good, "fairness": {**fair, "ratio": 8.0}}))
+    assert any("hot_throttled" in e for e in v(
+        {**good, "fairness": {**fair, "hot_throttled": 0}}))
+    assert any("cold_dropped" in e for e in v(
+        {**good, "fairness": {**fair, "cold_dropped": 3}}))
+    # the residency ladder must actually cycle: page-ins AND budget
+    # demotions both counted
+    tiers = good["tiers"]
+    assert any("demotions_ram" in e for e in v(
+        {**good, "tiers": {**tiers, "demotions_ram": 0}}))
+    assert any("promotions_disk_ram" in e for e in v(
+        {**good, "tiers": {**tiers, "promotions_disk_ram": 0}}))
+    assert any("ram_budget_bytes" in e for e in v(
+        {**good, "tiers": {**tiers, "ram_budget_bytes": 0}}))
+    assert any("distinct_models_scored" in e for e in v(
+        {k: x for k, x in good.items()
+         if k != "distinct_models_scored"}))
+
+
+def test_multitenant_artifact_committed_and_healthy(checker):
+    """The round-17 acceptance contract on the COMMITTED artifact:
+    >= 1000 model dirs registered lazily (zero checkpoint loads),
+    Zipf-skewed traffic with zero drops, the residency ladder cycling
+    under a RAM budget, hot-tenant p99 and cold-start p99 within
+    bounds, and a hot-tenant flood leaving cold-tenant p99 within the
+    fairness ratio."""
+    path = os.path.join(REPO, "benchmarks", "MULTITENANT_FLEET.json")
+    assert os.path.exists(path), \
+        "benchmarks/MULTITENANT_FLEET.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "multitenant_fleet"
+    assert art["models"] >= checker.MIN_MT_MODELS
+    assert art["zero_dropped"] is True
+    assert art["registration"]["loads_at_register"] == 0
+    assert art["hot"]["p99_ms"] <= checker.MAX_MT_HOT_P99_MS
+    assert art["cold_start_ms"]["p99"] <= checker.MAX_MT_COLD_START_P99_MS
+    assert art["fairness"]["ratio"] <= checker.MAX_MT_FAIRNESS_RATIO
+    assert art["fairness"]["hot_throttled"] >= 1
+    assert art["fairness"]["cold_dropped"] == 0
+    assert art["tiers"]["promotions_disk_ram"] >= 1
+    assert art["tiers"]["demotions_ram"] >= 1
+    assert art["distinct_models_scored"] > 0

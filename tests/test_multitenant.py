@@ -1,0 +1,521 @@
+"""Multi-tenant model tiering: lazy (stat-only) registration, the
+HBM -> RAM -> disk demand-paging ladder, RAM-budget demotion and
+transparent re-page-in, per-tenant weighted-fair admission, the
+popularity-driven prewarm daemon, skew-aware ring re-weighting, and
+the O(1)-between-mutations ``list()``/``/healthz`` render caches.
+
+ONE tiny fitted workflow is trained for the whole module; tenant
+fleets are built by symlinking its checkpoint into versioned dirs —
+every tenant shares the same content fingerprint (so compiled programs
+are shared), while each dir gets a DISTINCT lazy stat fingerprint.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.serving.batcher import BackpressureError
+from transmogrifai_tpu.serving.fleet import FleetServer, score_diff
+from transmogrifai_tpu.serving.registry import (
+    ModelRegistry,
+    ModelState,
+    stat_fingerprint,
+)
+from transmogrifai_tpu.tenancy import (
+    PopularityTracker,
+    PrewarmDaemon,
+    TenancyConfig,
+    TenantAdmission,
+    TokenBucket,
+    model_file_bytes,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.uid import UID
+from transmogrifai_tpu.workflow import Workflow
+
+N = 160
+
+
+def _train(seed):
+    """One tiny fitted binary workflow (the shared tenant checkpoint)."""
+    UID.reset()
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=N)
+    x2 = rng.normal(size=N)
+    color = rng.choice(["red", "green", "blue"], size=N)
+    logit = 1.5 * x1 - x2 + (color == "red") * 1.2
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-logit))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "color": (ft.PickList, color.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"], feats["color"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=20), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "color": str(color[i])} for i in range(N)]
+    return model, rows
+
+
+@pytest.fixture(scope="module")
+def zoo(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tenant_zoo")
+    model, rows = _train(seed=3)
+    canonical = root / "canonical"
+    model.save(str(canonical))
+    return {"canonical": str(canonical), "rows": rows}
+
+
+def _fan_out(root: str, canonical: str, n: int) -> list:
+    """Symlink the canonical checkpoint into ``n`` versioned tenant
+    dirs (``root/m0007/v1/``). Same bytes -> shared TRUE fingerprint;
+    distinct paths -> distinct LAZY fingerprints."""
+    ids = []
+    for i in range(n):
+        model_id = f"m{i:04d}"
+        d = os.path.join(root, model_id, "v1")
+        os.makedirs(d)
+        for name in os.listdir(canonical):
+            os.symlink(os.path.join(canonical, name),
+                       os.path.join(d, name))
+        ids.append(model_id)
+    return ids
+
+
+def _fake_checkpoints(root: str, n: int) -> None:
+    """``n`` stat-able but never-loadable checkpoint dirs — lazy
+    registration only ever stats them, so content is irrelevant."""
+    for i in range(n):
+        d = os.path.join(root, f"fake{i:04d}", "v1")
+        os.makedirs(d)
+        with open(os.path.join(d, "model.json"), "w") as fh:
+            fh.write("{}")
+        with open(os.path.join(d, "arrays.npz"), "wb") as fh:
+            fh.write(b"\0" * 64)
+
+
+# -- fairness / popularity units (injected clocks, no jax) ----------------
+
+
+def test_token_bucket_refill_arithmetic():
+    now = [0.0]
+    bucket = TokenBucket(rate_per_s=10.0, burst=5.0,
+                         clock=lambda: now[0])
+    for _ in range(5):
+        assert bucket.try_take(1.0) == 0.0
+    wait = bucket.try_take(1.0)
+    assert wait == pytest.approx(0.1)
+    # a failed take leaves the bucket untouched; waiting exactly the
+    # suggested Retry-After makes the next take succeed
+    now[0] += wait
+    assert bucket.try_take(1.0) == 0.0
+    # refill caps at burst
+    now[0] += 100.0
+    assert bucket.tokens == pytest.approx(5.0)
+
+
+def test_tenant_admission_weights_and_retry_after():
+    now = [0.0]
+    adm = TenantAdmission(rate_per_s=2.0, burst=2.0,
+                          weights={"vip": 2.0}, clock=lambda: now[0])
+    # default-weight tenant: 2 tokens of burst, then throttled
+    adm.admit("org")
+    adm.admit("org")
+    with pytest.raises(BackpressureError) as ei:
+        adm.admit("org")
+    assert ei.value.retry_after_s == pytest.approx(0.5)
+    # weighted tenant gets rate x2 AND burst x2
+    for _ in range(4):
+        adm.admit("vip")
+    with pytest.raises(BackpressureError) as ei:
+        adm.admit("vip")
+    assert ei.value.retry_after_s == pytest.approx(0.25)
+    rows = adm.metrics.tenant_rows()
+    assert rows["org"]["admitted"] == 2 and rows["org"]["throttled"] == 1
+    assert rows["org"]["debtSeconds"] == pytest.approx(0.5)
+    assert rows["vip"]["admitted"] == 4
+    # the suggested wait is exact: after it elapses the take admits
+    now[0] += 0.5
+    adm.admit("org")
+
+
+def test_fairness_topk_rolls_tail_into_other():
+    adm = TenantAdmission(rate_per_s=1.0, burst=1.0,
+                          clock=lambda: 0.0)
+    for i in range(5):
+        tenant = f"t{i}"
+        adm.admit(tenant)
+        for _ in range(i):     # t4 is throttled hardest
+            with pytest.raises(BackpressureError):
+                adm.admit(tenant)
+    top, other = adm.metrics.topk(2)
+    assert set(top) == {"t4", "t3"}
+    assert other["tenants"] == 3
+    assert other["admitted"] == 3 and other["throttled"] == 1 + 2
+    # unlimited k: no rollup
+    top_all, none = adm.metrics.topk(0)
+    assert len(top_all) == 5 and none is None
+    doc = adm.to_json(top_k=2)
+    assert doc["other"]["tenants"] == 3 and doc["ratePerS"] == 1.0
+
+
+def test_popularity_tracker_decays_to_now():
+    now = [0.0]
+    tracker = PopularityTracker(half_life_s=10.0, clock=lambda: now[0])
+    tracker.record("hot", 10.0)
+    tracker.record("warm", 2.0)
+    rate_at_zero = tracker.rate("hot")
+    assert rate_at_zero > tracker.rate("warm") > 0.0
+    # one half-life later the rate has halved — WITHOUT a new event
+    now[0] = 10.0
+    assert tracker.rate("hot") == pytest.approx(rate_at_zero / 2.0)
+    # rank decays idle models down: keep touching "warm" until it wins
+    for _ in range(20):
+        tracker.record("warm", 2.0)
+    assert tracker.rank()[0][0] == "warm"
+    doc = tracker.to_json(top_k=1)
+    assert doc["tracked"] == 2 and doc["top"][0]["model"] == "warm"
+
+
+# -- lazy registration / fingerprints -------------------------------------
+
+
+def test_stat_fingerprint_contract(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        stat_fingerprint(str(tmp_path))
+    (tmp_path / "model.json").write_text("{}")
+    (tmp_path / "arrays.npz").write_bytes(b"\0" * 32)
+    fp = stat_fingerprint(str(tmp_path))
+    assert fp.startswith("lazy:")
+    assert stat_fingerprint(str(tmp_path)) == fp
+    # a changed checkpoint (size) changes the placeholder
+    (tmp_path / "arrays.npz").write_bytes(b"\0" * 64)
+    assert stat_fingerprint(str(tmp_path)) != fp
+
+
+def test_registry_list_cache_at_1000_entries(tmp_path):
+    reg = ModelRegistry()
+    _fake_checkpoints(str(tmp_path), 1000)
+    entries = reg.register_dir(str(tmp_path), lazy=True)
+    assert len(entries) == 1000
+    assert all(e.state == ModelState.COLD for e in entries)
+    assert len(reg.list()) == 1000
+    # an unchanged registry serves the rendered cache — prove it by
+    # planting a sentinel where the cache lives
+    reg._list_cache = (reg.mutation_seq, [{"sentinel": 1}])
+    assert reg.list() == [{"sentinel": 1}]
+    # callers get copies: mutating a returned doc can't poison the cache
+    reg.list()[0]["sentinel"] = 999
+    assert reg.list() == [{"sentinel": 1}]
+    # any mutation invalidates
+    reg.touch()
+    docs = reg.list()
+    assert len(docs) == 1000 and "sentinel" not in docs[0]
+
+
+def test_healthz_static_fragment_cached_between_mutations(tmp_path):
+    fleet = FleetServer(tenancy=True, max_batch=4, max_wait_ms=1.0)
+    _fake_checkpoints(str(tmp_path), 1000)
+    assert len(fleet.register_dir(str(tmp_path))) == 1000
+    calls = []
+    orig = fleet._health_static_fragment
+    fleet._health_static_fragment = \
+        lambda lanes: (calls.append(1), orig(lanes))[1]
+    for _ in range(5):
+        doc = fleet.health()
+    assert len(calls) == 1          # 4 probes served from the cache
+    assert len(doc["models"]) == 1000
+    assert all(m["state"] == "cold" for m in doc["models"].values())
+    fleet.registry.touch()
+    fleet.health()
+    assert len(calls) == 2          # mutation invalidated the fragment
+
+
+def test_lazy_register_requires_tenancy():
+    fleet = FleetServer(max_batch=4, max_wait_ms=1.0)
+    with pytest.raises(ValueError, match="tenancy"):
+        fleet.register("/nonexistent", model_id="x", lazy=True)
+    assert fleet.ensure_hot("x") is False
+
+
+# -- demand paging through a live fleet -----------------------------------
+
+
+def test_lazy_fleet_zero_loads_until_first_score(zoo, tmp_path,
+                                                 monkeypatch):
+    loads = [0]
+    orig_load = np.load
+
+    def spy(*args, **kwargs):
+        loads[0] += 1
+        return orig_load(*args, **kwargs)
+
+    monkeypatch.setattr(np, "load", spy)
+    ids = _fan_out(str(tmp_path), zoo["canonical"], 12)
+    fleet = FleetServer(tenancy=TenancyConfig(rate_per_s=None),
+                        max_batch=8, max_wait_ms=1.0)
+    try:
+        entries = fleet.register_dir(str(tmp_path))
+        assert len(entries) == 12
+        assert loads[0] == 0, "registration must not open checkpoints"
+        assert all(e.state == ModelState.COLD for e in entries)
+        lazy_fps = {e.fingerprint for e in entries}
+        assert len(lazy_fps) == 12  # distinct dirs -> distinct placeholders
+        assert all(fp.startswith("lazy:") for fp in lazy_fps)
+        fleet.start()
+        assert loads[0] == 0, "start() must leave COLD entries on disk"
+        assert fleet.health()["ready"], \
+            "a started all-cold tiered fleet pages in on demand"
+
+        row = zoo["rows"][0]
+        doc = fleet.submit_blocking(ids[0], row).result(timeout=60)
+        assert loads[0] >= 1
+        entry = fleet.registry.get(ids[0], "v1")
+        assert not entry.fingerprint.startswith("lazy:")
+        assert entry.state == ModelState.READY
+        store = fleet.tenancy_store
+        assert store.resident_count == 1 and store.ram_bytes > 0
+        assert store.metrics.promotions_disk_ram == 1
+        assert store.metrics.promotions_ram_hbm == 1
+        cold = store.metrics.cold_start_percentiles_ms()
+        assert cold["count"] == 1 and cold["p99"] > 0
+
+        # a second tenant of the SAME checkpoint: distinct lazy
+        # placeholder, but page-in resolves to the SHARED true
+        # fingerprint — and the same score
+        doc2 = fleet.submit_blocking(ids[1], row).result(timeout=60)
+        entry2 = fleet.registry.get(ids[1], "v1")
+        assert entry2.fingerprint == entry.fingerprint
+        assert score_diff(doc, doc2) == 0.0
+    finally:
+        fleet.stop()
+
+
+def test_ram_budget_demotes_lru_and_repages(zoo, tmp_path):
+    per_model = model_file_bytes(zoo["canonical"])
+    assert per_model > 0
+    budget = int(per_model * 2.5)   # room for ~2 resident records
+    ids = _fan_out(str(tmp_path), zoo["canonical"], 6)
+    fleet = FleetServer(
+        tenancy=TenancyConfig(ram_budget_bytes=budget, rate_per_s=None),
+        max_batch=8, max_wait_ms=1.0)
+    try:
+        fleet.register_dir(str(tmp_path))
+        fleet.start()
+        row = zoo["rows"][1]
+        base = fleet.submit_blocking(ids[0], row).result(timeout=60)
+        for model_id in ids[1:]:
+            fleet.submit_blocking(model_id, row).result(timeout=60)
+        store = fleet.tenancy_store
+        assert store.metrics.promotions_disk_ram == 6
+        assert store.metrics.demotions_ram >= 1, \
+            "6 models through a ~2-model budget must demote"
+        assert store.resident_count < 6
+        assert store.ram_bytes <= budget
+        # the demoted tenant's entry went back to COLD, model dropped
+        cold_ids = [m for m in ids
+                    if fleet.registry.get(m, "v1").state
+                    == ModelState.COLD]
+        assert cold_ids and all(
+            fleet.registry.get(m, "v1").model is None
+            for m in cold_ids)
+        # ...and re-pages transparently, scoring identically
+        again = fleet.submit_blocking(cold_ids[0], row).result(timeout=60)
+        assert score_diff(base, again) == 0.0
+        assert store.metrics.promotions_disk_ram == 7
+        health = fleet.health()
+        assert health["tenancy"]["metrics"]["demotionsRam"] >= 1
+    finally:
+        fleet.stop()
+
+
+def test_unload_releases_ram_tier_and_programs(zoo, tmp_path):
+    ids = _fan_out(str(tmp_path), zoo["canonical"], 2)
+    fleet = FleetServer(tenancy=TenancyConfig(rate_per_s=None),
+                        max_batch=8, max_wait_ms=1.0)
+    try:
+        fleet.register_dir(str(tmp_path))
+        fleet.start()
+        fleet.submit_blocking(ids[0], zoo["rows"][2]).result(timeout=60)
+        store = fleet.tenancy_store
+        assert store.resident_count == 1 and store.ram_bytes > 0
+        assert len(fleet.program_cache) >= 1
+        demotions = store.metrics.demotions_ram
+
+        fleet.registry.unload(ids[0])
+        assert store.resident_count == 0 and store.ram_bytes == 0
+        assert store.metrics.demotions_ram == demotions + 1
+        # ids[1] was never loaded, so NO loaded entry shares the
+        # fingerprint: the compiled programs go too
+        assert len(fleet.program_cache) == 0
+    finally:
+        fleet.stop()
+
+
+def test_admission_throttles_flood_and_health_reports(zoo, tmp_path):
+    ids = _fan_out(str(tmp_path), zoo["canonical"], 2)
+    fleet = FleetServer(
+        tenancy=TenancyConfig(rate_per_s=5.0, burst=5.0),
+        max_batch=8, max_wait_ms=1.0)
+    try:
+        fleet.register_dir(str(tmp_path))
+        fleet.start()
+        row = zoo["rows"][3]
+        # absorb_backpressure waits out the gate: throttled, not dropped
+        fleet.submit_blocking(ids[0], row).result(timeout=60)
+        futures, throttled = [], None
+        for _ in range(50):
+            try:
+                futures.append(fleet.submit(ids[0], row))
+            except BackpressureError as e:
+                throttled = e
+                break
+        assert throttled is not None, \
+            "a 50-deep burst against burst=5 must throttle"
+        assert throttled.retry_after_s > 0.0
+        for fut in futures:
+            fut.result(timeout=60)
+
+        fair = fleet.admission.metrics.tenant_rows()
+        assert fair[ids[0]]["throttled"] >= 1
+        assert fair[ids[0]]["admitted"] >= 1
+        # popularity saw the flood (recorded BEFORE the gate)
+        assert fleet.popularity.rate(ids[0]) > 0.0
+        health = fleet.health()
+        assert health["tenancy"]["fairness"]["tenants"][ids[0]][
+            "throttled"] >= 1
+        snap = fleet.snapshot()
+        assert snap["tenancy"]["popularity"]["tracked"] >= 1
+
+        from transmogrifai_tpu.utils.prometheus import build_registry
+        text = build_registry(fleet=fleet, include_app=False).render()
+        assert "transmogrifai_tenancy_ram_bytes" in text
+        assert "transmogrifai_fairness_throttled_total" in text
+    finally:
+        fleet.stop()
+
+
+def test_prewarm_tick_pages_hot_and_sheds_under_pressure(
+        zoo, tmp_path, monkeypatch):
+    ids = _fan_out(str(tmp_path), zoo["canonical"], 3)
+    fleet = FleetServer(tenancy=TenancyConfig(rate_per_s=None),
+                        max_batch=8, max_wait_ms=1.0)
+    try:
+        fleet.register_dir(str(tmp_path))
+        fleet.start()
+        daemon = PrewarmDaemon(fleet, fleet.popularity, top_k=2)
+        fleet.popularity.record(ids[0], 3.0)
+        fleet.popularity.record(ids[1], 5.0)
+        assert daemon.tick() == 2
+        assert ids[0] in fleet.active_lanes()
+        assert ids[1] in fleet.active_lanes()
+        store = fleet.tenancy_store
+        assert store.metrics.prewarms == 2
+        assert store.is_resident(ids[0], "v1")
+        assert store.is_resident(ids[1], "v1")
+        # already hot: nothing to do
+        assert daemon.tick() == 0
+        assert store.metrics.prewarms == 2
+
+        # under pressure the daemon SHEDS instead of paging more in
+        import transmogrifai_tpu.utils.resources as res
+        degradations = []
+        monkeypatch.setattr(res, "ladder_enabled", lambda: True)
+        monkeypatch.setattr(
+            res, "pressure_state", lambda: {"rssPressure": True})
+        monkeypatch.setattr(
+            res, "record_degradation",
+            lambda site, action, **kw: degradations.append(
+                (site, action, kw)))
+        fleet.popularity.record(ids[2], 5.0)
+        assert daemon.tick() == 0
+        assert ids[2] not in fleet.active_lanes()
+        assert any(site == "tenancy.prewarm" and action == "prewarm_skip"
+                   for site, action, _ in degradations)
+        assert store.metrics.sheds >= 1
+        # the LRU prewarmed record shed; the newest always survives
+        assert store.resident_count == 1
+    finally:
+        fleet.stop()
+
+
+def test_cli_serve_fleet_tenancy_flags(zoo, tmp_path):
+    import json
+
+    from transmogrifai_tpu.cli import main as cli_main
+    root = tmp_path / "tenants"
+    os.makedirs(root)
+    ids = _fan_out(str(root), zoo["canonical"], 3)
+    req = tmp_path / "req.jsonl"
+    with open(req, "w") as fh:
+        for i in range(6):
+            fh.write(json.dumps(
+                {**zoo["rows"][i], "model": ids[i % 2]}) + "\n")
+    out = tmp_path / "scores.jsonl"
+    metrics = tmp_path / "metrics.json"
+    rc = cli_main(["serve", "--model-dir", str(root),
+                   "--input", str(req), "--output", str(out),
+                   "--metrics", str(metrics), "--max-batch", "8",
+                   "--tenancy", "on", "--tenant-rate", "500"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in open(out)]
+    assert len(lines) == 6
+    assert all("error" not in ln for ln in lines)
+    snap = json.load(open(metrics))
+    # only the two routed tenants paged in; the third stayed COLD
+    assert snap["tenancy"]["metrics"]["promotionsDiskRam"] == 2
+    assert snap["tenancy"]["fairness"]["tenants"][ids[0]][
+        "admitted"] == 3
+
+
+# -- skew-aware placement --------------------------------------------------
+
+
+def test_weighted_ring_shifts_arc_share():
+    from transmogrifai_tpu.scaleout.router import ConsistentHashRing
+    ring = ConsistentHashRing(["a", "b"], vnodes=64)
+    assert ring.weights() == {"a": 1.0, "b": 1.0}
+    keys = [f"m{i:03d}" for i in range(300)]
+    before = sum(1 for k in keys if ring.order(k)[0] == "a")
+    assert ring.set_weights({"a": 3.0, "b": 0.5}) is True
+    after = sum(1 for k in keys if ring.order(k)[0] == "a")
+    assert after > before, "a 6:1 weight ratio must grow a's arc share"
+    # unknown members are ignored; a no-op map reports no change
+    assert ring.set_weights({"zzz": 2.0}) is False
+
+
+def test_router_load_skew_and_damped_rebalance():
+    from transmogrifai_tpu.scaleout.router import Router
+    router = Router(port=0)
+    router.set_replica("r0", 10001)
+    router.set_replica("r1", 10002)
+    assert router.load_skew() == 1.0    # no signal yet
+    assert router.rebalance() == {}
+    # drive EWMA load ONLY at models whose primary arc is r0
+    hot = [m for m in (f"m{i:03d}" for i in range(400))
+           if router.ring.order(m)[0] == "r0"][:20]
+    assert hot
+    for model_id in hot:
+        router.load.record(model_id, 50.0)
+    skew_before = router.load_skew()
+    assert skew_before > 1.5
+    applied = router.rebalance()
+    assert applied["r0"] < 1.0 < applied["r1"], \
+        "the overloaded replica sheds arc weight, the idle one gains"
+    assert router.metrics.rebalances == 1
+    assert router.ring.weights()["r0"] == pytest.approx(applied["r0"])
+    assert router.load_skew() <= skew_before

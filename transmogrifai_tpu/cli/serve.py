@@ -134,6 +134,30 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                     help="spill flight-recorder events to this JSONL "
                          "file (grep a trace id to reconstruct a "
                          "request's path)")
+    sp.add_argument("--tenancy", choices=("on", "off"), default=None,
+                    help="fleet mode: multi-tenant model tiering "
+                         "(docs/SERVING.md 'Multi-tenant fleet') — "
+                         "checkpoints register COLD (stat-only) and "
+                         "demand-page on first score, with per-tenant "
+                         "admission in front of the lanes. Implied by "
+                         "any other --tenant*/--model-ram-budget/"
+                         "--prewarm-top-k flag")
+    sp.add_argument("--model-ram-budget", type=int, default=None,
+                    help="host-RAM byte budget for decoded model "
+                         "records (the RAM tier): LRU tenants demote "
+                         "back to COLD beyond it. Default: "
+                         "TRANSMOGRIFAI_MODEL_RAM_BUDGET, unset = "
+                         "unbounded")
+    sp.add_argument("--tenant-rate", type=float, default=None,
+                    help="per-tenant admission rate in requests/s "
+                         "before weighting (default 200; 0 disables "
+                         "admission). Throttled requests get 503 + "
+                         "Retry-After, never a drop")
+    sp.add_argument("--prewarm-top-k", type=int, default=None,
+                    help="page this many of the hottest tenants in "
+                         "ahead of traffic each prewarm tick "
+                         "(popularity EWMA ranking; 0 = no daemon, "
+                         "the default)")
     sp.add_argument("--resource-ladder", choices=("on", "off"),
                     default=None,
                     help="override the adaptive degradation ladder "
@@ -396,13 +420,30 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
     explaining = args.explain_top_k is not None
     explain_kw = {"explain": True, "explain_top_k": args.explain_top_k} \
         if explaining else {}
+    tenancy = None
+    if args.tenancy != "off" and (
+            args.tenancy == "on"
+            or args.model_ram_budget is not None
+            or args.tenant_rate is not None
+            or args.prewarm_top_k is not None):
+        from transmogrifai_tpu.tenancy import TenancyConfig
+        tenancy_kw: dict = {}
+        if args.model_ram_budget is not None:
+            tenancy_kw["ram_budget_bytes"] = args.model_ram_budget
+        if args.tenant_rate is not None:
+            # 0 disables admission (TenancyConfig treats None/0 alike)
+            tenancy_kw["rate_per_s"] = args.tenant_rate or None
+        if args.prewarm_top_k is not None:
+            tenancy_kw["prewarm_top_k"] = args.prewarm_top_k
+        tenancy = TenancyConfig(**tenancy_kw)
     fleet = FleetServer(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_capacity=args.queue_capacity,
         default_timeout_ms=args.timeout_ms, strict=not args.no_strict,
         route_field=args.model_field,
         metrics_port=args.metrics_port, metrics_host=args.metrics_host,
-        access_log_sample=args.access_log_sample, slo=slo, **explain_kw)
+        access_log_sample=args.access_log_sample, slo=slo,
+        tenancy=tenancy, **explain_kw)
     entries = fleet.register_dir(args.model_dir)
     if not entries:
         print(f"serve: no saved models (model.json) under "

@@ -68,7 +68,14 @@ class ConsistentHashRing:
     """Consistent hashing with virtual nodes. ``order(key)`` walks the
     ring from the key's position and returns every DISTINCT member once
     — the primary first, then the spillover successors. Membership
-    changes move only the arcs adjacent to the changed member."""
+    changes move only the arcs adjacent to the changed member.
+
+    Members carry a **placement weight** (default 1.0): a member gets
+    ``round(vnodes x weight)`` virtual nodes, so its expected share of
+    the keyspace scales with the weight. This is the skew-rebalancing
+    lever — an overloaded replica's weight drops, it sheds arcs (and
+    only arcs: keys whose primary didn't change keep their affinity,
+    the property a full reshuffle lacks)."""
 
     def __init__(self, members=(), vnodes: int = 64):
         self.vnodes = int(vnodes)
@@ -80,6 +87,8 @@ class ConsistentHashRing:
         self._ring: list[tuple[int, str]] = []
         self._hashes: list[int] = []
         self._members: set[str] = set()
+        #: member -> placement weight (only non-default entries kept)
+        self._weights: dict[str, float] = {}
         for m in members:
             self.add(m)
 
@@ -88,24 +97,62 @@ class ConsistentHashRing:
         return int.from_bytes(
             hashlib.sha1(key.encode()).digest()[:8], "big")
 
+    def _member_vnodes(self, member: str) -> int:
+        # at least one vnode: a weighted-down member stays routable
+        # (markdown, not weighting, is how a member leaves routing)
+        return max(1, round(self.vnodes * self._weights.get(member, 1.0)))
+
     def _rebuild(self) -> None:
         ring = sorted(
             (self._hash(f"{m}#{i}"), m)
-            for m in self._members for i in range(self.vnodes))
+            for m in self._members
+            for i in range(self._member_vnodes(m)))
         self._ring = ring
         self._hashes = [h for h, _ in ring]
 
-    def add(self, member: str) -> None:
+    def add(self, member: str, weight: Optional[float] = None) -> None:
         with self._lock:
+            changed = False
             if member not in self._members:
                 self._members.add(member)
+                changed = True
+            if weight is not None \
+                    and self._weights.get(member, 1.0) != float(weight):
+                self._weights[member] = float(weight)
+                changed = True
+            if changed:
                 self._rebuild()
 
     def remove(self, member: str) -> None:
         with self._lock:
             if member in self._members:
                 self._members.discard(member)
+                self._weights.pop(member, None)
                 self._rebuild()
+
+    def set_weights(self, weights: dict) -> bool:
+        """Apply a full member -> weight map in ONE rebuild (the
+        rebalancer's bulk path; per-member ``add`` would rebuild the
+        ring N times). Unknown members are ignored. True if the ring
+        changed."""
+        with self._lock:
+            new = {m: float(w) for m, w in weights.items()
+                   if m in self._members and float(w) != 1.0}
+            for m in self._members:
+                if m in weights:
+                    continue
+                if m in self._weights:
+                    new[m] = self._weights[m]
+            if new == self._weights:
+                return False
+            self._weights = new
+            self._rebuild()
+            return True
+
+    def weights(self) -> dict:
+        with self._lock:
+            return {m: self._weights.get(m, 1.0)
+                    for m in sorted(self._members)}
 
     def members(self) -> list[str]:
         with self._lock:
@@ -153,6 +200,7 @@ class RouterMetrics:
         self.retries = 0            # transport error -> next replica
         self.markdowns = 0          # replicas marked down by the router
         self.no_replica = 0         # no routable replica at all
+        self.rebalances = 0         # skew-triggered ring re-weightings
         self.by_replica: dict[str, int] = {}
         self._lat_buckets = [0] * (len(LATENCY_BUCKETS_S) + 1)
         self._lat_sum = 0.0
@@ -202,6 +250,7 @@ class RouterMetrics:
                     "retries": self.retries,
                     "markdowns": self.markdowns,
                     "noReplica": self.no_replica,
+                    "rebalances": self.rebalances,
                     "byReplica": dict(self.by_replica)}
 
 
@@ -233,9 +282,16 @@ class Router:
                  spill: int = 2, vnodes: int = 64,
                  route_field: str = "model",
                  upstream_timeout_s: float = 30.0,
-                 slo=None):
+                 slo=None, load_half_life_s: float = 30.0):
         self.ring = ConsistentHashRing(vnodes=vnodes)
         self.metrics = RouterMetrics()
+        #: per-model EWMA request rate observed AT THE ROUTER — the
+        #: skew-rebalancing signal (the same decayed-rate estimator the
+        #: tenancy prewarm ranking uses)
+        from transmogrifai_tpu.tenancy.popularity import (
+            PopularityTracker,
+        )
+        self.load = PopularityTracker(load_half_life_s)
         self.spill = int(spill)
         self.route_field = route_field
         self.upstream_timeout_s = float(upstream_timeout_s)
@@ -322,6 +378,61 @@ class Router:
     def route_order(self, model_id: str) -> list[str]:
         return [r.replica_id for r in self.candidates(model_id)]
 
+    # -- load skew / rebalancing ---------------------------------------------
+    def replica_loads(self) -> dict:
+        """replica id -> summed EWMA request rate of the models whose
+        PRIMARY arc it owns (spillover traffic intentionally excluded:
+        placement decides primaries, so primaries are what placement
+        must balance)."""
+        loads = {rid: 0.0 for rid in self.ring.members()}
+        for model_id, rate in self.load.rank():
+            order = self.ring.order(model_id)
+            if order:
+                loads[order[0]] = loads.get(order[0], 0.0) + rate
+        return loads
+
+    def load_skew(self) -> float:
+        """max/mean primary load over ring members — 1.0 is perfectly
+        balanced; Zipf traffic through an unweighted ring typically
+        reads 2-4. The supervisor's rebalance trigger."""
+        loads = self.replica_loads()
+        if not loads:
+            return 1.0
+        mean = sum(loads.values()) / len(loads)
+        if mean <= 0.0:
+            return 1.0
+        return max(loads.values()) / mean
+
+    def rebalance(self, min_weight: float = 0.25,
+                  max_weight: float = 4.0) -> dict:
+        """One damped re-weighting step toward balanced primary load:
+        each member's weight moves by ``sqrt(mean/load)`` (square-root
+        damping keeps successive rebalances from oscillating around
+        the target), clamped to ``[min_weight, max_weight]`` so no
+        replica ever sheds ALL its arcs or absorbs the whole keyspace.
+        Returns the applied weight map (empty when there's no load
+        signal yet)."""
+        loads = self.replica_loads()
+        total = sum(loads.values())
+        if not loads or total <= 0.0:
+            return {}
+        mean = total / len(loads)
+        current = self.ring.weights()
+        eps = mean * 1e-3
+        weights = {}
+        for rid, load in loads.items():
+            step = (mean / max(load, eps)) ** 0.5
+            weights[rid] = min(max(current.get(rid, 1.0) * step,
+                                   min_weight), max_weight)
+        skew_before = max(loads.values()) / mean
+        if self.ring.set_weights(weights):
+            self.metrics.count("rebalances")
+            events.emit("scaleout.rebalance",
+                        skewBefore=round(skew_before, 3),
+                        weights={r: round(w, 3)
+                                 for r, w in sorted(weights.items())})
+        return weights
+
     def _upstream(self, rep: _Replica) -> http.client.HTTPConnection:
         """Per-(handler thread, replica) keep-alive connection."""
         pool = getattr(self._tls, "pool", None)
@@ -374,6 +485,7 @@ class Router:
         headers = dict(headers or {})
         headers.setdefault("Content-Type", "application/json")
         path = f"/score/{model_id}"
+        self.load.record(model_id)
         candidates = self.candidates(model_id)
         if not candidates:
             self.metrics.count("no_replica")
@@ -434,6 +546,9 @@ class Router:
                "ready": up > 0,
                "replicas": reps,
                "router": self.metrics.to_json(),
+               "loadSkew": round(self.load_skew(), 3),
+               "ringWeights": {r: round(w, 3)
+                               for r, w in self.ring.weights().items()},
                "resources": pressure_state()}
         fold_health(self.slo_engine, doc)
         return doc

@@ -81,6 +81,7 @@ class ScaleoutMetrics:
         self.rolls = 0
         self.roll_failures = 0
         self.rollbacks = 0
+        self.rebalances = 0
 
     def count(self, attr: str, n: int = 1) -> None:
         with self._lock:
@@ -93,7 +94,8 @@ class ScaleoutMetrics:
                     "scaleDowns": self.scale_downs,
                     "rolls": self.rolls,
                     "rollFailures": self.roll_failures,
-                    "rollbacks": self.rollbacks}
+                    "rollbacks": self.rollbacks,
+                    "rebalances": self.rebalances}
 
 
 class _Proc:
@@ -124,7 +126,9 @@ class ReplicaSupervisor:
                  spawn_timeout_s: float = 120.0,
                  respawn: bool = True,
                  max_respawns_per_replica: int = 5,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 rebalance_skew: float = 2.0,
+                 rebalance_cooldown_s: float = 10.0):
         self.model_dir = model_dir
         self.state_dir = state_dir
         self.router = router
@@ -138,6 +142,13 @@ class ReplicaSupervisor:
         self.respawn = bool(respawn)
         self.max_respawns_per_replica = int(max_respawns_per_replica)
         self.drain_timeout_s = float(drain_timeout_s)
+        #: trigger a load-weighted ring rebalance when the router's
+        #: primary-load skew (max/mean) exceeds this; <= 1.0 disables.
+        #: Cooldown keeps successive ticks from thrashing the ring
+        #: while the damped re-weighting converges
+        self.rebalance_skew = float(rebalance_skew)
+        self.rebalance_cooldown_s = float(rebalance_cooldown_s)
+        self._last_rebalance = 0.0
         self.metrics = ScaleoutMetrics()
         self._procs: dict[str, _Proc] = {}
         self._lock = threading.RLock()
@@ -370,6 +381,32 @@ class ReplicaSupervisor:
             elif state in (ReplicaStates.DRAINING,
                            ReplicaStates.STOPPED):
                 self.router.set_draining(rid)
+        self._maybe_rebalance()
+
+    def _maybe_rebalance(self) -> None:
+        """Skew-aware placement: when the router's per-model EWMA loads
+        pile onto one primary past ``rebalance_skew`` (max/mean), take
+        one damped re-weighting step — the ring rebalances on LOAD
+        skew, not just membership change. Cooldown-limited so the EWMA
+        can reflect the new placement before the next step."""
+        if self.rebalance_skew <= 1.0:
+            return
+        load_skew = getattr(self.router, "load_skew", None)
+        rebalance = getattr(self.router, "rebalance", None)
+        if load_skew is None or rebalance is None:
+            return
+        if len(getattr(self.router, "ring", ())) < 2:
+            return      # one primary owns everything by construction
+        now = time.time()
+        if now - self._last_rebalance < self.rebalance_cooldown_s:
+            return
+        skew = load_skew()
+        if skew <= self.rebalance_skew:
+            return
+        self._last_rebalance = now
+        if rebalance():
+            self.metrics.count("rebalances")
+            events.emit("scaleout.rebalanced", skew=round(skew, 3))
 
     # -- scaling --------------------------------------------------------------
     def scale_to(self, n: int, wait_ready: bool = True) -> int:

@@ -355,6 +355,7 @@ class FleetServer:
                  access_log_sample: float = 0.0,
                  slo=None,
                  wire: str = "binary",
+                 tenancy=None,
                  **lane_kwargs):
         """``lane_kwargs`` (``max_batch``, ``max_wait_ms``,
         ``queue_capacity``, ``default_timeout_ms``, ``strict``,
@@ -367,7 +368,15 @@ class FleetServer:
         endpoint negotiating the binary columnar frame wire alongside
         JSON/NDJSON; ``wire="json"`` pins the endpoint JSON-only
         (``application/x-tmog-frame`` POSTs answer 400) for operators
-        who must guarantee no binary clients."""
+        who must guarantee no binary clients.
+
+        ``tenancy`` (a ``tenancy.TenancyConfig``, or ``True`` for the
+        defaults) turns on multi-tenant tiering: lazy COLD
+        registration, demand paging (disk -> RAM -> HBM) on first
+        score, a byte-budgeted host-RAM tier that demotes
+        least-recently-scored models, per-tenant token-bucket
+        admission in front of lane backpressure, and popularity-driven
+        prewarm."""
         bad = {"metrics_port", "metrics_host", "program_cache",
                "fingerprint", "event_label", "slo"} & set(lane_kwargs)
         if bad:
@@ -412,32 +421,80 @@ class FleetServer:
             self.slo_engine = SLOEngine.for_serving(
                 slo, lambda: [lane.metrics
                               for lane in self.active_lanes().values()])
+        #: /healthz static fragment (models without a running lane),
+        #: cached against the registry mutation sequence — at 1000+
+        #: registered models re-rendering every COLD entry per probe is
+        #: the O(n) the scraper notices
+        self._health_static: Optional[tuple] = None
+        #: multi-tenant tiering (None = classic eager fleet)
+        self.tenancy = None
+        self.tenancy_store = None
+        self.admission = None
+        self.popularity = None
+        self._prewarm_daemon = None
+        if tenancy:
+            from transmogrifai_tpu.tenancy import (
+                PopularityTracker,
+                TenancyConfig,
+                TenantAdmission,
+                TieredModelStore,
+            )
+            cfg = TenancyConfig() if tenancy is True else tenancy
+            self.tenancy = cfg
+            self.tenancy_store = TieredModelStore(
+                self.registry, self.program_cache,
+                ram_budget_bytes=cfg.ram_budget_bytes,
+                on_demote=self._demote_lane)
+            if cfg.rate_per_s:
+                self.admission = TenantAdmission(
+                    cfg.rate_per_s, cfg.burst, weights=cfg.weights)
+            self.popularity = PopularityTracker(cfg.half_life_s)
 
     # -- registration --------------------------------------------------------
+    def _lazy_default(self, lazy: Optional[bool]) -> bool:
+        if lazy is None:
+            return bool(self.tenancy is not None and self.tenancy.lazy
+                        and self.tenancy_store is not None)
+        if lazy and self.tenancy_store is None:
+            raise ValueError(
+                "lazy registration needs tenancy enabled (a COLD entry "
+                "only becomes servable through demand paging)")
+        return lazy
+
     def register(self, path: Optional[str] = None, *, model=None,
                  model_id: Optional[str] = None,
                  version: Optional[str] = None,
-                 warmup_row: Optional[dict] = None) -> ModelEntry:
+                 warmup_row: Optional[dict] = None,
+                 lazy: Optional[bool] = None) -> ModelEntry:
         """Register one model (see ``ModelRegistry.register``). If the
         fleet is already serving and the new version becomes the active
         one (first version of its id), its lane starts — warmed with
-        ``warmup_row`` when given — before this returns."""
+        ``warmup_row`` when given — before this returns. ``lazy``
+        defaults to the tenancy config's policy (False without
+        tenancy): a lazily registered model is COLD — stat-validated
+        only, no lane — and pages in on first score."""
         entry = self.registry.register(path, model=model,
-                                       model_id=model_id, version=version)
+                                       model_id=model_id, version=version,
+                                       lazy=self._lazy_default(lazy))
         self.metrics.record_registered()
-        if self._started and \
+        if self._started and entry.model is not None and \
                 self.registry.active_version(entry.model_id) == entry.version:
             self._start_lane(entry, warmup_row=warmup_row)
         return entry
 
-    def register_dir(self, root: str) -> list[ModelEntry]:
+    def register_dir(self, root: str, *,
+                     lazy: Optional[bool] = None) -> list[ModelEntry]:
         """Register every fingerprinted checkpoint under ``root``
-        (``ModelRegistry.register_dir`` layouts)."""
-        entries = self.registry.register_dir(root)
+        (``ModelRegistry.register_dir`` layouts). ``lazy`` as in
+        :meth:`register` — the thousand-tenant startup registers COLD
+        in milliseconds and pages in on demand."""
+        entries = self.registry.register_dir(
+            root, lazy=self._lazy_default(lazy))
         for entry in entries:
             self.metrics.record_registered()
-            if self._started and self.registry.active_version(
-                    entry.model_id) == entry.version:
+            if self._started and entry.model is not None \
+                    and self.registry.active_version(
+                        entry.model_id) == entry.version:
                 self._start_lane(entry)
         return entries
 
@@ -498,7 +555,92 @@ class FleetServer:
         entry.state = ModelState.READY
         with self._lock:
             self._lanes[(entry.model_id, entry.version)] = lane
+        self.registry.touch()
         return lane
+
+    # -- demand paging (tenancy) ---------------------------------------------
+    def _page_in(self, entry: ModelEntry) -> ScoringServer:
+        """Walk a COLD entry up the residency ladder — disk -> RAM
+        (``tenancy_store.touch``: checkpoint load + true-fingerprint
+        resolution) -> HBM (lane start; programs compile lazily on
+        first dispatch) — and return the running lane. Single-flighted
+        per ``(model_id, version)`` on the store's page lock; the
+        measured wall is the model's COLD-START latency (the
+        first-score SLA). A resource-exhausted lane start sheds the RAM
+        tier once and retries — tier demotion is the pressure rung that
+        runs BEFORE giving up on a tenant."""
+        from transmogrifai_tpu.utils.resources import (
+            is_resource_exhausted, record_degradation,
+        )
+        from transmogrifai_tpu.utils.tracing import span
+        store = self.tenancy_store
+        key = (entry.model_id, entry.version)
+        with store.page_lock(key):
+            with self._lock:
+                lane = self._lanes.get(key)
+                if lane is not None:
+                    return lane
+            t0 = time.monotonic()
+            with span("tenancy.cold_start", model=entry.model_id,
+                      version=entry.version):
+                store.touch(entry)
+                try:
+                    lane = self._start_lane(entry)
+                except Exception as e:
+                    if not is_resource_exhausted(e):
+                        raise
+                    budget = store.ram_budget_bytes or store.ram_bytes
+                    record_degradation(
+                        "tenancy.page_in", "shed_retry", error=e,
+                        model=entry.model_id)
+                    store.shed(max(budget // 4, 1))
+                    lane = self._start_lane(entry)
+            wall = time.monotonic() - t0
+            store.metrics.note_promotion_hbm()
+            store.metrics.note_cold_start(wall)
+            if self.admission is not None:
+                self.admission.metrics.note_cold_start_wait(wall)
+            events.emit("tenancy.cold_start", model=entry.model_id,
+                        version=entry.version,
+                        wallMs=round(wall * 1e3, 3))
+            return lane
+
+    def _demote_lane(self, entry: ModelEntry) -> None:
+        """Tier-store demotion hook (called under the victim's page
+        lock): drop the victim's lane from routing first, then drain it
+        — every admitted request settles before the model object goes
+        away. Demotion is load shedding, not an outage."""
+        with self._lock:
+            lane = self._lanes.pop((entry.model_id, entry.version), None)
+        if lane is None:
+            return
+        entry.state = ModelState.DRAINING
+        lane.stop(drain=True)
+        self.registry.touch()
+
+    def ensure_hot(self, model_id: str,
+                   version: Optional[str] = None) -> bool:
+        """Page ``model_id``'s active (or named) version in NOW if it
+        is COLD — the prewarm daemon's entry point, also useful ahead
+        of a known traffic shift. True when a page-in happened."""
+        if self.tenancy_store is None or not self._started:
+            return False
+        if version is None:
+            version = self.registry.active_version(model_id)
+            if version is None:
+                return False
+        with self._lock:
+            if (model_id, version) in self._lanes:
+                return False
+        try:
+            entry = self.registry.get(model_id, version)
+        except UnknownModelError:
+            return False
+        if entry.state == ModelState.UNLOADED or (
+                entry.model is None and entry.path is None):
+            return False
+        self._page_in(entry)
+        return True
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_rows: Optional[dict] = None) -> "FleetServer":
@@ -513,8 +655,20 @@ class FleetServer:
             if version is None:
                 continue
             entry = self.registry.get(model_id, version)
+            if entry.model is None:
+                # COLD (lazy/demoted) entries start no lane here: a
+                # 1000-model fleet starting in bounded time is the
+                # point — first score (or prewarm) pages them in
+                continue
             if (model_id, version) not in self._lanes:
                 self._start_lane(entry, warmup_row=warmup_rows.get(model_id))
+        if self.tenancy is not None and self.tenancy.prewarm_top_k > 0 \
+                and self._prewarm_daemon is None:
+            from transmogrifai_tpu.tenancy import PrewarmDaemon
+            self._prewarm_daemon = PrewarmDaemon(
+                self, self.popularity,
+                top_k=self.tenancy.prewarm_top_k,
+                interval_s=self.tenancy.prewarm_interval_s).start()
         if self._metrics_port is not None and self.metrics_http is None:
             from transmogrifai_tpu.serving.http import MetricsServer
             from transmogrifai_tpu.utils.prometheus import build_registry
@@ -529,6 +683,9 @@ class FleetServer:
         return self
 
     def stop(self, drain: bool = True) -> None:
+        if self._prewarm_daemon is not None:
+            self._prewarm_daemon.stop()
+            self._prewarm_daemon = None
         with self._lock:
             lanes = dict(self._lanes)
             # drop the lane objects: their worker threads are about to
@@ -550,6 +707,7 @@ class FleetServer:
                 # drain forever: the model stays loaded, just unserved
                 entry.state = ModelState.STOPPED
         self._started = False
+        self.registry.touch()
         if self.metrics_http is not None:
             self.metrics_http.stop()
             self.metrics_http = None
@@ -568,11 +726,20 @@ class FleetServer:
                 # raises UnknownModelError with the precise reason
                 self.registry.get(model_id)
             lane = self._lanes.get((model_id, version))
-            if lane is None:
-                raise UnknownModelError(
-                    f"model {model_id!r} version {version!r} has no "
-                    "running lane (fleet not started?)")
-            return lane, version
+            if lane is not None:
+                return lane, version
+        # no running lane: with tenancy, a registered-but-COLD model is
+        # a PAGE-IN, not an error — the miss walks disk -> RAM -> HBM
+        # (outside the fleet lock: a cold start must not stall routing
+        # of every hot model)
+        if self.tenancy_store is not None and self._started:
+            entry = self.registry.get(model_id, version)
+            if entry.state != ModelState.UNLOADED and (
+                    entry.model is not None or entry.path is not None):
+                return self._page_in(entry), version
+        raise UnknownModelError(
+            f"model {model_id!r} version {version!r} has no "
+            "running lane (fleet not started?)")
 
     def _remember(self, model_id: str, row: dict) -> None:
         ring = self._recent.get(model_id)
@@ -623,6 +790,12 @@ class FleetServer:
         request — the lineage a reply must carry is the version that
         SCORED it, which during a hot swap is not necessarily the
         version that is active when the reply is assembled."""
+        # popularity BEFORE admission: a throttled tenant is still
+        # demand, and the prewarm ranking must see it
+        if self.popularity is not None:
+            self.popularity.record(model_id)
+        if self.admission is not None:
+            self.admission.admit(model_id)
         for _ in range(8):
             lane, version = self._resolve(model_id)
             try:
@@ -748,7 +921,14 @@ class FleetServer:
                              trace_id: Optional[str] = None) -> tuple:
         """``_submit_routed`` for a decoded wire frame: same
         lane-stopped retry loop (a hot swap mid-flight re-resolves onto
-        the promoted version), same lineage contract."""
+        the promoted version), same lineage contract. Admission meters
+        a frame at its ROW count — a tenant must not dodge its rate by
+        batching."""
+        n_rows = max(int(getattr(frame, "n_rows", 1) or 1), 1)
+        if self.popularity is not None:
+            self.popularity.record(model_id, n_rows)
+        if self.admission is not None:
+            self.admission.admit(model_id, n_rows)
         for _ in range(8):
             lane, version = self._resolve(model_id)
             try:
@@ -1060,18 +1240,24 @@ class FleetServer:
                     out[model_id] = lane
             return out
 
-    def health(self) -> dict:
-        """Per-model readiness + overall fleet status (the ``/healthz``
-        body): ``ok`` only when every active lane is on the compiled
-        path; ``warming``/``degraded`` name the worst offender state."""
+    # fleet status = the worst lane's OWN state word (not a coarse
+    # bucket): "warming" and "draining" point operators at opposite
+    # ends of a model's lifecycle and must never alias. COLD sits just
+    # above ok — an unpaged tenant is a tiered fleet's NORMAL state
+    _SEVERITY = {"ok": 0, "cold": 1, "warming": 2, "draining": 3,
+                 "stopped": 4, "degraded": 5, "unloaded": 6}
+
+    def _health_static_fragment(self, lanes: dict) -> tuple:
+        """The ``/healthz`` contribution of every model WITHOUT a
+        running lane (retired, COLD, stopped): pure registry state, so
+        it cannot change between registry mutations — cached against
+        ``registry.mutation_seq`` (lane starts/stops touch the
+        registry). Returns ``(models, worst, serving_worst,
+        pageable)``."""
+        severity = self._SEVERITY
         models: dict = {}
-        # fleet status = the worst lane's OWN state word (not a coarse
-        # bucket): "warming" and "draining" point operators at opposite
-        # ends of a model's lifecycle and must never alias
-        severity = {"ok": 0, "warming": 1, "draining": 2, "stopped": 3,
-                    "degraded": 4, "unloaded": 5}
         worst = serving_worst = "ok"
-        any_active = False
+        pageable = 0
         for model_id in self.registry.model_ids():
             version = self.registry.active_version(model_id)
             if version is None:
@@ -1084,16 +1270,56 @@ class FleetServer:
                 worst = max(worst, ModelState.UNLOADED,
                             key=lambda s: severity.get(s, 4))
                 continue
-            any_active = True
+            if (model_id, version) in lanes:
+                continue    # live: rendered fresh per probe
             entry = self.registry.get(model_id, version)
-            with self._lock:
-                lane = self._lanes.get((model_id, version))
-            state = lane.state if lane is not None else entry.state
-            doc = {"state": state, "version": version,
-                   "fingerprint": entry.fingerprint}
-            if lane is not None:
-                doc["queueDepth"] = lane.batcher.queue_depth
-            models[model_id] = doc
+            state = entry.state
+            models[model_id] = {"state": state, "version": version,
+                                "fingerprint": entry.fingerprint}
+            word = "ok" if state == "ready" else state
+            worst = max(worst, word, key=lambda s: severity.get(s, 4))
+            if state == ModelState.COLD and self.tenancy_store \
+                    is not None and (entry.model is not None
+                                     or entry.path is not None):
+                # COLD is one demand-paged score away from serving: it
+                # counts toward "the fleet can serve" and must not drag
+                # the readiness bit (unlike stopped/warming)
+                pageable += 1
+            else:
+                serving_worst = max(serving_worst, word,
+                                    key=lambda s: severity.get(s, 4))
+        return models, worst, serving_worst, pageable
+
+    def health(self) -> dict:
+        """Per-model readiness + overall fleet status (the ``/healthz``
+        body): ``ok`` only when every active lane is on the compiled
+        path; ``warming``/``degraded`` name the worst offender state.
+        Laneless models render from a mutation-seq-keyed cache — at
+        1000+ registered tenants the O(n) JSON per probe is what a
+        scraper notices; live lanes stay fresh every call."""
+        severity = self._SEVERITY
+        with self._lock:
+            lanes = dict(self._lanes)
+        seq = self.registry.mutation_seq
+        cached = self._health_static
+        if cached is None or cached[0] != seq:
+            cached = (seq, self._health_static_fragment(lanes))
+            self._health_static = cached
+        static_models, worst, serving_worst, pageable = cached[1]
+        models = dict(static_models)
+        any_active = False
+        for (model_id, version), lane in lanes.items():
+            if self.registry.active_version(model_id) != version:
+                continue    # a swap's draining loser: not the alias
+            any_active = True
+            try:
+                entry = self.registry.get(model_id, version)
+            except UnknownModelError:
+                continue
+            state = lane.state
+            models[model_id] = {"state": state, "version": version,
+                                "fingerprint": entry.fingerprint,
+                                "queueDepth": lane.batcher.queue_depth}
             word = "ok" if state == "ready" else state
             worst = max(worst, word, key=lambda s: severity.get(s, 4))
             serving_worst = max(serving_worst, word,
@@ -1102,7 +1328,11 @@ class FleetServer:
 
         # readiness: the load-balancer bit, over ACTIVE lanes only.
         # Degraded still serves (slowly); a firing fast-burn SLO alert
-        # flips it (fold_health); a fleet with nothing active isn't ready
+        # flips it (fold_health); a fleet with nothing active isn't
+        # ready — but a started tiered fleet whose models are all COLD
+        # is (they page in on first score)
+        if pageable and self._started:
+            any_active = True
         from transmogrifai_tpu.utils.resources import pressure_state
         doc = {"status": worst, "models": models,
                "fleet": self.metrics.to_json(),
@@ -1110,6 +1340,11 @@ class FleetServer:
                "resources": pressure_state(),
                "ready": any_active
                and serving_worst in ("ok", "degraded")}
+        if self.tenancy_store is not None:
+            tdoc = self.tenancy_store.to_json()
+            if self.admission is not None:
+                tdoc["fairness"] = self.admission.to_json()
+            doc["tenancy"] = tdoc
         fold_health(self.slo_engine, doc)
         return doc
 
@@ -1120,6 +1355,12 @@ class FleetServer:
                "cache": self.program_cache.to_json(),
                "registry": self.registry.list(),
                "models": {}}
+        if self.tenancy_store is not None:
+            doc["tenancy"] = self.tenancy_store.to_json()
+            if self.admission is not None:
+                doc["tenancy"]["fairness"] = self.admission.to_json()
+            if self.popularity is not None:
+                doc["tenancy"]["popularity"] = self.popularity.to_json()
         for model_id, lane in self.active_lanes().items():
             lane_doc = lane.snapshot(mirror_to_profiler=False)
             lane_doc["state"] = lane.state

@@ -54,7 +54,7 @@ from typing import Optional
 
 __all__ = ["ModelEntry", "ModelRegistry", "ModelState",
            "UnknownModelError", "ACTIVE_JSON", "write_active_alias",
-           "read_active_alias"]
+           "read_active_alias", "stat_fingerprint"]
 
 #: durable per-model-id active-version alias file (versioned layout)
 ACTIVE_JSON = "ACTIVE.json"
@@ -105,10 +105,41 @@ class ModelState:
     DRAINING = "draining"   # demoted; finishing in-flight requests
     STOPPED = "stopped"     # fleet stopped; model still loaded
     UNLOADED = "unloaded"   # drained and dropped; kept for audit
+    COLD = "cold"           # registered lazily or tier-demoted; pages
+    #                       # in (disk -> RAM -> HBM) on first score
 
 
 class UnknownModelError(KeyError):
     """Routing key names no registered model (or no active version)."""
+
+
+def stat_fingerprint(path: str) -> str:
+    """A ``"lazy:"``-prefixed placeholder fingerprint from the stat
+    signature (abspath + size + mtime_ns) of a checkpoint's
+    ``model.json`` and ``arrays.npz`` — registering 1000 models must
+    not read 1000 array files. The prefix keeps a placeholder from
+    EVER colliding with a content fingerprint in the shared
+    compiled-program cache; the true ``model_fingerprint`` replaces it
+    at first page-in, before anything compiles. Raises
+    ``FileNotFoundError`` when the manifest is missing (a lazy register
+    still validates the checkpoint EXISTS)."""
+    import hashlib
+
+    from transmogrifai_tpu.serialization import ARRAYS_NPZ, MODEL_JSON
+    h = hashlib.sha256()
+    manifest = os.path.join(path, MODEL_JSON)
+    if not os.path.exists(manifest):
+        raise FileNotFoundError(
+            f"no {MODEL_JSON} under {path!r}: not a saved model dir")
+    for name in (MODEL_JSON, ARRAYS_NPZ):
+        fpath = os.path.join(path, name)
+        try:
+            st = os.stat(fpath)
+        except OSError:
+            continue
+        h.update(f"{os.path.abspath(fpath)}|{st.st_size}|"
+                 f"{st.st_mtime_ns}\n".encode())
+    return "lazy:" + h.hexdigest()[:16]
 
 
 @dataclass
@@ -141,6 +172,15 @@ class ModelRegistry:
         #: fingerprint-keyed program-artifact store (scaleout/artifacts.
         #: ArtifactStore-shaped: publish/get); None = not attached
         self.artifacts = None
+        #: RAM-tier store (tenancy.TieredModelStore-shaped:
+        #: note_unloaded); None = no tiering
+        self.tier_store = None
+        #: bumps on every mutation (register/promote/unload/state
+        #: change via touch) — the invalidation key for rendered-list
+        #: and /healthz caches, so a 1000-model fleet is not O(n) JSON
+        #: per probe
+        self._seq = 0
+        self._list_cache: Optional[tuple[int, list[dict]]] = None
 
     # -- program artifacts ---------------------------------------------------
     def attach_artifacts(self, store) -> "ModelRegistry":
@@ -163,26 +203,61 @@ class ModelRegistry:
             return None
         return self.artifacts.get(fingerprint)
 
+    def attach_tier_store(self, store) -> "ModelRegistry":
+        """Bind the RAM-tier store so explicit ``unload`` releases the
+        tier's accounted bytes (and the model's compiled programs) —
+        not just the entry's model reference."""
+        self.tier_store = store
+        return self
+
+    # -- mutation sequence ---------------------------------------------------
+    @property
+    def mutation_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def touch(self) -> int:
+        """Bump the mutation sequence (and drop the rendered-list
+        cache). Callers that mutate entry state OUTSIDE registry
+        methods — the fleet flipping ``entry.state``, a tier demotion
+        dropping ``entry.model`` — must touch so cached ``/healthz``
+        blocks invalidate."""
+        with self._lock:
+            self._seq += 1
+            self._list_cache = None
+            return self._seq
+
     # -- registration --------------------------------------------------------
     def register(self, path: Optional[str] = None, *,
                  model=None, model_id: Optional[str] = None,
                  version: Optional[str] = None,
-                 activate: Optional[bool] = None) -> ModelEntry:
+                 activate: Optional[bool] = None,
+                 lazy: bool = False) -> ModelEntry:
         """Load (``path``: a ``serialization.save_model`` dir) or adopt
         (``model``: an in-memory fitted workflow) one model. ``model_id``
         defaults to the dir basename; ``version`` to the next ``v<n>``
         for that id. The FIRST version of an id activates automatically;
         later versions stay inactive until :meth:`promote` (or
         ``activate=True``) — registering a candidate never moves live
-        traffic by itself."""
+        traffic by itself.
+
+        ``lazy=True`` (path registrations only) records the entry COLD:
+        the checkpoint is stat-validated but NOTHING is read — no
+        ``np.load``, no manifest parse — and the fingerprint is a
+        stat-derived placeholder until first page-in resolves the true
+        content fingerprint. This is what lets ``register_dir`` admit
+        thousands of tenant dirs in milliseconds."""
         from transmogrifai_tpu.checkpoint import model_fingerprint
         if path is None and model is None:
             raise ValueError("register() needs a path or a model")
         if path is not None:
-            from transmogrifai_tpu.workflow import load_model
-            fingerprint = model_fingerprint(path=path)
-            if model is None:
-                model = load_model(path)
+            if lazy and model is None:
+                fingerprint = stat_fingerprint(path)
+            else:
+                from transmogrifai_tpu.workflow import load_model
+                fingerprint = model_fingerprint(path=path)
+                if model is None:
+                    model = load_model(path)
             if model_id is None:
                 base = os.path.basename(os.path.normpath(path))
                 # <id>/<version>/ layout: the version dir is not the id
@@ -211,13 +286,18 @@ class ModelRegistry:
             entry = ModelEntry(model_id=model_id, version=version,
                                path=path, fingerprint=fingerprint,
                                model=model)
+            if entry.model is None:
+                entry.state = ModelState.COLD
             versions[version] = entry
             if activate or (activate is None
                             and model_id not in self._active):
                 self._active[model_id] = version
+            self._seq += 1
+            self._list_cache = None
             return entry
 
-    def register_dir(self, root: str) -> list[ModelEntry]:
+    def register_dir(self, root: str, *,
+                     lazy: bool = False) -> list[ModelEntry]:
         """Register every fingerprinted checkpoint under ``root`` (flat
         ``<id>/model.json`` or versioned ``<id>/<version>/model.json``
         layouts; see module docstring). Version subdirs register in
@@ -225,10 +305,14 @@ class ModelRegistry:
         promotion — unless a durable ``ACTIVE.json`` alias names the
         promoted version, in which case THAT version activates (the
         respawned-replica path: a fleet-wide rolling promotion must
-        survive any one process's restart). Returns the new entries."""
+        survive any one process's restart). Returns the new entries.
+
+        ``lazy=True`` registers every checkpoint COLD (stat only, zero
+        array reads — see :meth:`register`): the thousand-tenant
+        startup path."""
         from transmogrifai_tpu.serialization import MODEL_JSON
         if os.path.exists(os.path.join(root, MODEL_JSON)):
-            return [self.register(root)]
+            return [self.register(root, lazy=lazy)]
 
         def version_key(name: str):
             # NATURAL order: lexical sort puts v10 before v2, and the
@@ -244,14 +328,15 @@ class ModelRegistry:
             if not os.path.isdir(subdir):
                 continue
             if os.path.exists(os.path.join(subdir, MODEL_JSON)):
-                entries.append(self.register(subdir, model_id=sub))
+                entries.append(self.register(
+                    subdir, model_id=sub, lazy=lazy))
                 continue
             registered: list[str] = []
             for ver in sorted(os.listdir(subdir), key=version_key):
                 vdir = os.path.join(subdir, ver)
                 if os.path.exists(os.path.join(vdir, MODEL_JSON)):
                     entries.append(self.register(
-                        vdir, model_id=sub, version=ver))
+                        vdir, model_id=sub, version=ver, lazy=lazy))
                     registered.append(ver)
             alias = read_active_alias(subdir) if registered else None
             if alias is not None:
@@ -306,8 +391,16 @@ class ModelRegistry:
 
     def list(self) -> list[dict]:
         """Every registered version, active-flagged — the inventory the
-        CLI and ``/healthz`` report."""
+        CLI and ``/healthz`` report. The rendered block is CACHED
+        against the mutation sequence: at 1000+ models a fresh O(n)
+        JSON render per health probe is what a scraper notices, and
+        between mutations the answer cannot change. Callers get a
+        shallow per-doc copy (mutating a returned doc must not poison
+        the cache)."""
         with self._lock:
+            cached = self._list_cache
+            if cached is not None and cached[0] == self._seq:
+                return [dict(doc) for doc in cached[1]]
             out = []
             for model_id in sorted(self._entries):
                 active = self._active.get(model_id)
@@ -315,7 +408,8 @@ class ModelRegistry:
                     doc = self._entries[model_id][version].to_json()
                     doc["active"] = version == active
                     out.append(doc)
-            return out
+            self._list_cache = (self._seq, out)
+            return [dict(doc) for doc in out]
 
     # -- lifecycle -----------------------------------------------------------
     def promote(self, model_id: str, version: str) -> tuple:
@@ -330,6 +424,8 @@ class ModelRegistry:
                     f"version {version!r}")
             old = self._active.get(model_id)
             self._active[model_id] = version
+            self._seq += 1
+            self._list_cache = None
             return old, version
 
     def unload(self, model_id: str, version: Optional[str] = None,
@@ -349,4 +445,12 @@ class ModelRegistry:
                 self._entries[model_id].pop(entry.version, None)
                 if not self._entries[model_id]:
                     del self._entries[model_id]
+            self._seq += 1
+            self._list_cache = None
+        if self.tier_store is not None:
+            # AFTER entry.model dropped: the tier must release its
+            # accounted bytes and the fingerprint's compiled programs
+            # (when no other loaded entry shares it) — an unload that
+            # only clears the reference leaks the RAM-tier budget
+            self.tier_store.note_unloaded(entry)
         return entry

@@ -1,0 +1,69 @@
+"""Multi-tenant model tiering: thousands of registered models, a
+working set far bigger than HBM.
+
+The subsystem has three legs (one module each):
+
+- :mod:`~transmogrifai_tpu.tenancy.store` — the HBM -> host-RAM ->
+  disk residency ladder with demand paging and pressure-rung demotion;
+- :mod:`~transmogrifai_tpu.tenancy.fairness` — weighted-fair
+  per-tenant token buckets in front of lane backpressure;
+- :mod:`~transmogrifai_tpu.tenancy.popularity` — EWMA request-rate
+  ranking driving the background prewarm daemon.
+
+:class:`TenancyConfig` is the one knob surface ``FleetServer`` (and
+the ``serve-fleet`` CLI) take: construct it, pass ``tenancy=cfg``, and
+the fleet wires store + admission + prewarm around its existing
+registry, program cache, and lanes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from transmogrifai_tpu.tenancy.fairness import (
+    FairnessMetrics,
+    TenantAdmission,
+    TokenBucket,
+)
+from transmogrifai_tpu.tenancy.popularity import (
+    PopularityTracker,
+    PrewarmDaemon,
+)
+from transmogrifai_tpu.tenancy.store import (
+    RAM_BUDGET_ENV,
+    TieredModelStore,
+    TierMetrics,
+    model_file_bytes,
+)
+
+__all__ = ["TenancyConfig", "TieredModelStore", "TierMetrics",
+           "TokenBucket", "TenantAdmission", "FairnessMetrics",
+           "PopularityTracker", "PrewarmDaemon", "RAM_BUDGET_ENV",
+           "model_file_bytes"]
+
+
+@dataclass
+class TenancyConfig:
+    """Everything the fleet needs to run multi-tenant.
+
+    Defaults are deliberately permissive — no RAM budget means the RAM
+    tier only accounts (nothing demotes), and admission at 200 req/s
+    per tenant only bites genuine floods."""
+    #: host-RAM budget for decoded model records; None = env
+    #: TRANSMOGRIFAI_MODEL_RAM_BUDGET, 0/unset = unbounded
+    ram_budget_bytes: Optional[int] = None
+    #: register checkpoints COLD (stat-only) and page in on first score
+    lazy: bool = True
+    #: per-tenant admission rate (tokens/s before weighting);
+    #: None/0 disables admission entirely
+    rate_per_s: Optional[float] = 200.0
+    #: bucket depth; None = one second of refill
+    burst: Optional[float] = None
+    #: tenant -> weight multiplier for the fair refill
+    weights: Dict[str, float] = field(default_factory=dict)
+    #: popularity EWMA half-life
+    half_life_s: float = 30.0
+    #: prewarm this many hottest models per tick; 0 disables the daemon
+    prewarm_top_k: int = 0
+    prewarm_interval_s: float = 2.0

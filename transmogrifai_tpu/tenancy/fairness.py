@@ -1,0 +1,211 @@
+"""Per-tenant admission: weighted-fair token buckets in FRONT of the
+lanes' queue backpressure.
+
+The lane queues already bound memory, but they are per-model FIFO with
+a shared device behind them: one hot tenant saturating its lane also
+saturates the compile/dispatch thread pool and the device itself, so a
+cold tenant's first request queues behind a flood it had no part in.
+The admission layer meters each tenant at the door instead — a token
+bucket per ``model_id``, refilled at ``rate_per_s x weight`` with a
+``burst``-sized reservoir — and answers an empty bucket with the SAME
+``BackpressureError`` (HTTP 503 + Retry-After) the queues use, so
+every existing client retry loop (``absorb_backpressure``, the bench
+clients, the router's spill path) already speaks the protocol and
+"throttled" never becomes "dropped".
+
+Retry-After is the bucket's own refill arithmetic (time until the
+needed tokens exist), so a throttled tenant backs off exactly as long
+as fairness requires, not a guessed constant.
+
+``FairnessMetrics`` keeps the per-tenant evidence: admits, throttles,
+**debt** (cumulative seconds of suggested wait — the integral of how
+hard a tenant pushed past its share) and cold-start waits. Export is
+cardinality-bounded: ``topk()`` ranks tenants by throttle pressure and
+rolls the tail into one ``_other`` aggregate, mirroring the
+Prometheus top-K policy.
+
+Clocks are injectable everywhere (``clock=time.monotonic``) so tests
+drive refill deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from transmogrifai_tpu.serving.batcher import BackpressureError
+
+__all__ = ["TokenBucket", "TenantAdmission", "FairnessMetrics"]
+
+
+class TokenBucket:
+    """A standard token bucket: ``rate_per_s`` tokens/s refill into a
+    ``burst``-sized reservoir; ``try_take`` returns 0.0 on admit or
+    the seconds until the requested tokens will exist."""
+
+    def __init__(self, rate_per_s: float, burst: float, *,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate_per_s <= 0:
+            raise ValueError(f"rate_per_s must be > 0, got {rate_per_s}")
+        self.rate_per_s = float(rate_per_s)
+        self.burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._at = clock()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        elapsed = max(now - self._at, 0.0)
+        self._tokens = min(self.burst,
+                           self._tokens + elapsed * self.rate_per_s)
+        self._at = now
+
+    def try_take(self, n: float = 1.0) -> float:
+        """Take ``n`` tokens if available (returns 0.0), else leave the
+        bucket untouched and return the wait in seconds until they
+        would be."""
+        with self._lock:
+            now = self._clock()
+            self._refill(now)
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            return (n - self._tokens) / self.rate_per_s
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
+
+
+class FairnessMetrics:
+    """Per-tenant admission evidence with bounded-cardinality export."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: tenant -> [admitted, throttled, debt_seconds]
+        self._tenants: Dict[str, list] = {}
+        self.cold_start_waits = 0
+        self.cold_start_wait_s = 0.0
+
+    def note_admitted(self, tenant: str) -> None:
+        with self._lock:
+            self._tenants.setdefault(tenant, [0, 0, 0.0])[0] += 1
+
+    def note_throttled(self, tenant: str, wait_s: float) -> None:
+        with self._lock:
+            row = self._tenants.setdefault(tenant, [0, 0, 0.0])
+            row[1] += 1
+            row[2] += wait_s
+
+    def note_cold_start_wait(self, wait_s: float) -> None:
+        with self._lock:
+            self.cold_start_waits += 1
+            self.cold_start_wait_s += wait_s
+
+    def tenant_rows(self) -> Dict[str, dict]:
+        with self._lock:
+            return {t: {"admitted": row[0], "throttled": row[1],
+                        "debtSeconds": round(row[2], 6)}
+                    for t, row in self._tenants.items()}
+
+    def topk(self, k: int) -> tuple:
+        """``(top, other)``: the ``k`` tenants under the most admission
+        pressure (throttles, then admits — the busy ones are the ones
+        worth a label) plus ONE aggregate of everyone else. ``k <= 0``
+        means unlimited (other is None when nothing rolled up)."""
+        rows = self.tenant_rows()
+        ranked = sorted(
+            rows.items(),
+            key=lambda kv: (-kv[1]["throttled"], -kv[1]["admitted"],
+                            kv[0]))
+        if k <= 0 or len(ranked) <= k:
+            return dict(ranked), None
+        top = dict(ranked[:k])
+        other = {"admitted": 0, "throttled": 0, "debtSeconds": 0.0,
+                 "tenants": len(ranked) - k}
+        for _, row in ranked[k:]:
+            other["admitted"] += row["admitted"]
+            other["throttled"] += row["throttled"]
+            other["debtSeconds"] += row["debtSeconds"]
+        other["debtSeconds"] = round(other["debtSeconds"], 6)
+        return top, other
+
+    def to_json(self, top_k: int = 20) -> dict:
+        top, other = self.topk(top_k)
+        with self._lock:
+            doc = {"coldStartWaits": self.cold_start_waits,
+                   "coldStartWaitSeconds":
+                       round(self.cold_start_wait_s, 6)}
+        doc["tenants"] = top
+        if other is not None:
+            doc["other"] = other
+        return doc
+
+
+class TenantAdmission:
+    """Weighted-fair per-tenant gate: one :class:`TokenBucket` per
+    ``model_id``, created on first request and refilled at
+    ``rate_per_s x weight(tenant)``. ``admit`` raises
+    :class:`BackpressureError` carrying the bucket's own refill time as
+    Retry-After — the shared 503 protocol the whole stack retries."""
+
+    def __init__(self, rate_per_s: float = 200.0,
+                 burst: Optional[float] = None, *,
+                 weights: Optional[Dict[str, float]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate_per_s = float(rate_per_s)
+        #: one second of refill by default — enough burst to never
+        #: throttle a tenant inside its steady-state share
+        self.burst = float(burst) if burst is not None \
+            else max(self.rate_per_s, 1.0)
+        self.weights = dict(weights or {})
+        self._clock = clock
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self.metrics = FairnessMetrics()
+
+    def weight(self, tenant: str) -> float:
+        return max(float(self.weights.get(tenant, 1.0)), 1e-6)
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        """Re-weight one tenant. Takes effect on its NEXT bucket refill
+        (the bucket is rebuilt; accumulated tokens are forfeit — a
+        deliberate penalty-free simplification: re-weighting is a rare
+        operator action)."""
+        with self._lock:
+            self.weights[tenant] = float(weight)
+            self._buckets.pop(tenant, None)
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                w = self.weight(tenant)
+                bucket = self._buckets[tenant] = TokenBucket(
+                    self.rate_per_s * w, self.burst * w,
+                    clock=self._clock)
+            return bucket
+
+    def admit(self, tenant: str, n: float = 1.0) -> None:
+        """Admit ``n`` requests for ``tenant`` or raise
+        ``BackpressureError`` with the precise Retry-After."""
+        wait = self._bucket(tenant).try_take(n)
+        if wait > 0.0:
+            self.metrics.note_throttled(tenant, wait)
+            raise BackpressureError(
+                f"tenant {tenant!r} over its admission rate "
+                f"({self.rate_per_s:g}/s x weight "
+                f"{self.weight(tenant):g}); retry in {wait:.3f}s",
+                retry_after_s=wait)
+        self.metrics.note_admitted(tenant)
+
+    def to_json(self, top_k: int = 20) -> dict:
+        doc = self.metrics.to_json(top_k)
+        doc["ratePerS"] = self.rate_per_s
+        doc["burst"] = self.burst
+        if self.weights:
+            doc["weights"] = dict(self.weights)
+        return doc

@@ -1,0 +1,168 @@
+"""Popularity-driven prewarm: EWMA request rates rank the fleet, a
+background daemon pages the top of the ranking in BEFORE traffic
+does.
+
+Demand paging alone makes every popularity shift a cold-start storm —
+the tenant that just went hot eats a page-in + compile on the request
+that made it hot. The tracker keeps a per-model exponentially-decayed
+request rate (event-driven, O(1) per request, no sample buffers): on
+each request batch ``rate = rate * exp(-dt/tau) + n/tau`` with ``tau =
+half_life / ln 2``, which is the standard irregular-interval EWMA —
+``rank()`` decays every rate to "now" so an idle model's score falls
+toward zero even with no events arriving.
+
+:class:`PrewarmDaemon` periodically takes the top-K ranking and pages
+non-resident entries in through the fleet's ``ensure_hot``. It
+composes with the PR 10 resource ladder rather than fighting it: under
+host-RSS or disk pressure the daemon SHEDS cold residency (the
+``tenancy.prewarm`` / ``prewarm_skip`` rung) instead of paging more
+models in — prewarm is a luxury, pressure relief is not.
+
+Clock injectable; daemon thread is named and daemonized like the other
+background loops (supervisor, continuous trainer).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Tuple
+
+__all__ = ["PopularityTracker", "PrewarmDaemon"]
+
+
+class PopularityTracker:
+    """Per-model exponentially-decayed request rate (requests/s)."""
+
+    def __init__(self, half_life_s: float = 30.0, *,
+                 clock: Callable[[], float] = time.monotonic):
+        if half_life_s <= 0:
+            raise ValueError(
+                f"half_life_s must be > 0, got {half_life_s}")
+        self.half_life_s = float(half_life_s)
+        self._tau = self.half_life_s / math.log(2.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: model_id -> [rate, last_update]
+        self._rates: Dict[str, list] = {}
+
+    def record(self, model_id: str, n: float = 1.0) -> None:
+        now = self._clock()
+        with self._lock:
+            row = self._rates.get(model_id)
+            if row is None:
+                self._rates[model_id] = [n / self._tau, now]
+                return
+            rate, at = row
+            row[0] = rate * math.exp(-(now - at) / self._tau) \
+                + n / self._tau
+            row[1] = now
+
+    def rate(self, model_id: str) -> float:
+        """The decayed-to-now request rate (requests/s estimate)."""
+        now = self._clock()
+        with self._lock:
+            row = self._rates.get(model_id)
+            if row is None:
+                return 0.0
+            return row[0] * math.exp(-(now - row[1]) / self._tau)
+
+    def rank(self) -> List[Tuple[str, float]]:
+        """All tracked models, hottest first, rates decayed to now —
+        an idle model sinks even though no event touched it."""
+        now = self._clock()
+        with self._lock:
+            decayed = [
+                (mid, row[0] * math.exp(-(now - row[1]) / self._tau))
+                for mid, row in self._rates.items()]
+        return sorted(decayed, key=lambda kv: (-kv[1], kv[0]))
+
+    def to_json(self, top_k: int = 20) -> dict:
+        ranked = self.rank()
+        shown = ranked if top_k <= 0 else ranked[:top_k]
+        return {"tracked": len(ranked),
+                "halfLifeSeconds": self.half_life_s,
+                "top": [{"model": m, "rps": round(r, 4)}
+                        for m, r in shown]}
+
+
+class PrewarmDaemon:
+    """Background loop: every ``interval_s``, page the ``top_k``
+    hottest non-resident models in via ``fleet.ensure_hot`` — unless
+    the resource ladder reports pressure, in which case shed instead
+    (tier demotion and prewarm share ONE pressure policy; see module
+    docstring)."""
+
+    def __init__(self, fleet, tracker: PopularityTracker, *,
+                 top_k: int = 8, interval_s: float = 2.0,
+                 shed_fraction: float = 0.25):
+        self.fleet = fleet
+        self.tracker = tracker
+        self.top_k = int(top_k)
+        self.interval_s = float(interval_s)
+        #: fraction of the RAM-tier budget to shed per pressured tick
+        self.shed_fraction = float(shed_fraction)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> "PrewarmDaemon":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tenancy-prewarm", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+                from transmogrifai_tpu.utils.events import events
+                events.emit_limited(
+                    "tenancy.prewarm.error", 30.0,
+                    "tenancy.prewarm_error",
+                    error=f"{type(e).__name__}: {e}")
+
+    def tick(self) -> int:
+        """One prewarm pass; returns models paged in (0 under
+        pressure). Split from ``_run`` so tests drive it inline."""
+        from transmogrifai_tpu.utils.resources import (
+            ladder_enabled,
+            pressure_state,
+            record_degradation,
+        )
+        store = getattr(self.fleet, "tenancy_store", None)
+        if store is None:
+            return 0
+        if ladder_enabled():
+            pressure = pressure_state()
+            if pressure.get("rssPressure") \
+                    or pressure.get("diskPressure") \
+                    or pressure.get("enospcBackoffActive"):
+                budget = store.ram_budget_bytes or store.ram_bytes
+                shed = store.shed(
+                    max(int(budget * self.shed_fraction), 1))
+                record_degradation(
+                    "tenancy.prewarm", "prewarm_skip",
+                    bytesShed=shed)
+                return 0
+        warmed = 0
+        for model_id, rate in self.tracker.rank()[:self.top_k]:
+            if self._stop.is_set() or rate <= 0.0:
+                break
+            try:
+                if self.fleet.ensure_hot(model_id):
+                    store.metrics.note_prewarm()
+                    warmed += 1
+            except Exception:  # noqa: BLE001 — one cold model must not
+                continue       # keep the rest of the ranking cold
+        return warmed

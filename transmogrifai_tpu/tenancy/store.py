@@ -1,0 +1,397 @@
+"""The tiered model store: HBM -> host RAM -> disk residency for a
+fleet whose working set is much bigger than device memory.
+
+Production multi-tenant AutoML is one model per org, thousands
+registered at once, with brutal popularity skew: a handful of models
+take most of the traffic while the long tail is touched hourly.
+Keeping every fitted model's decoded arrays in host RAM (let alone its
+compiled programs in HBM) does not survive that regime, so residency
+becomes a three-tier ladder:
+
+- **HBM tier** — compiled programs + deviced parameters in the fleet's
+  shared ``ProgramCache`` (its own byte-budget LRU, unchanged).
+- **RAM tier** — decoded-but-undeviced weight records: the loaded
+  ``WorkflowModel`` (numpy arrays straight out of ``arrays.npz``).
+  THIS module's budget: ``ram_budget_bytes`` bounds the accounted
+  bytes of resident models, LRU beyond it.
+- **cold tier** — a path and a stat-derived fingerprint, nothing else.
+  A lazily registered model costs two ``os.stat`` calls until its
+  first request.
+
+**Demand paging**: the first score against a cold model walks the
+ladder upward — ``touch`` loads the checkpoint (disk -> RAM, counted
+and span-traced as ``tenancy.page_in``), and the lane's first dispatch
+compiles into the shared cache (RAM -> HBM, counted by the existing
+compile counters). Budget pressure walks it downward: the LRU victim's
+lane is stopped (the fleet's ``on_demote`` hook), its model object
+dropped, and its compiled programs evicted unless another resident
+entry shares the fingerprint.
+
+**Pressure-ladder composition** (PR 10): ``shed`` is the tier-demotion
+rung — host RSS pressure demotes cold-tenant RAM residency FIRST,
+before the serving ladder starts degrading hot tenants' quality
+(precision/bucket shedding). Every shed records through
+``resources.record_degradation`` under site ``tenancy.store`` so the
+one degradation surface shows tier demotions next to bucket sheds.
+
+Concurrency: page-ins single-flight per ``(model_id, version)`` via a
+per-key reentrant lock (``page_lock``) that the fleet shares for lane
+startup; victims selected under the store lock are *unpinned* entries
+only (an in-flight page-in can never be chosen), and the demotion
+itself re-checks residency under the victim's page lock, so a racing
+re-page-in simply wins and the demotion becomes a no-op.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from transmogrifai_tpu.serving.registry import ModelState
+
+__all__ = ["TieredModelStore", "TierMetrics", "RAM_BUDGET_ENV",
+           "model_file_bytes"]
+
+#: host-RAM byte budget for decoded model records (the RAM tier);
+#: unset/0 = unbounded
+RAM_BUDGET_ENV = "TRANSMOGRIFAI_MODEL_RAM_BUDGET"
+
+#: newest cold-start walls kept for the percentile distribution the
+#: bench commits (bounded: the counter is lifetime, the reservoir is not)
+_COLD_START_SAMPLES = 4096
+
+
+def model_file_bytes(path: str) -> int:
+    """Stat-only RAM-footprint estimate of one saved model: the byte
+    sizes of ``model.json`` + ``arrays.npz``. The decoded arrays
+    dominate and land at roughly npz size (the format is uncompressed
+    by default); the manifest's reconstructed stage graph rides along.
+    Never opens either file."""
+    from transmogrifai_tpu.serialization import ARRAYS_NPZ, MODEL_JSON
+    total = 0
+    for name in (MODEL_JSON, ARRAYS_NPZ):
+        try:
+            total += os.stat(os.path.join(path, name)).st_size
+        except OSError:
+            pass
+    return total
+
+
+class TierMetrics:
+    """Thread-safe residency-ladder counters + the cold-start latency
+    reservoir (the fleet's first-score SLA evidence)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.promotions_disk_ram = 0   # checkpoint loads (page-ins)
+        self.promotions_ram_hbm = 0    # lane starts over a RAM record
+        self.demotions_ram = 0         # RAM records dropped (budget/shed)
+        self.demotions_hbm = 0         # program evictions forced by a
+        #                              # RAM demotion (not LRU aging)
+        self.sheds = 0                 # pressure-rung shed() calls
+        self.prewarms = 0              # popularity-driven page-ins
+        self.cold_starts = 0
+        self.cold_start_wall_s = 0.0
+        self._cold_walls: collections.deque = collections.deque(
+            maxlen=_COLD_START_SAMPLES)
+
+    def note_promotion_ram(self) -> None:
+        with self._lock:
+            self.promotions_disk_ram += 1
+
+    def note_promotion_hbm(self) -> None:
+        with self._lock:
+            self.promotions_ram_hbm += 1
+
+    def note_demotion(self, hbm_entries: int = 0) -> None:
+        with self._lock:
+            self.demotions_ram += 1
+            self.demotions_hbm += int(hbm_entries)
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def note_prewarm(self) -> None:
+        with self._lock:
+            self.prewarms += 1
+
+    def note_cold_start(self, wall_s: float) -> None:
+        with self._lock:
+            self.cold_starts += 1
+            self.cold_start_wall_s += wall_s
+            self._cold_walls.append(wall_s)
+
+    def cold_start_percentiles_ms(self) -> dict:
+        with self._lock:
+            walls = sorted(self._cold_walls)
+        if not walls:
+            return {"count": 0, "p50": None, "p99": None, "max": None}
+
+        def pct(p: float) -> float:
+            i = min(int(p * (len(walls) - 1) + 0.5), len(walls) - 1)
+            return round(walls[i] * 1e3, 3)
+
+        return {"count": len(walls), "p50": pct(0.50), "p99": pct(0.99),
+                "max": round(walls[-1] * 1e3, 3)}
+
+    def to_json(self) -> dict:
+        with self._lock:
+            doc = {"promotionsDiskRam": self.promotions_disk_ram,
+                   "promotionsRamHbm": self.promotions_ram_hbm,
+                   "demotionsRam": self.demotions_ram,
+                   "demotionsHbm": self.demotions_hbm,
+                   "sheds": self.sheds,
+                   "prewarms": self.prewarms,
+                   "coldStarts": self.cold_starts,
+                   "coldStartWallSeconds":
+                       round(self.cold_start_wall_s, 6)}
+        doc["coldStartMs"] = self.cold_start_percentiles_ms()
+        return doc
+
+
+class _Residency:
+    __slots__ = ("nbytes", "pinned")
+
+    def __init__(self, nbytes: int, pinned: bool):
+        self.nbytes = int(nbytes)
+        self.pinned = pinned
+
+
+class TieredModelStore:
+    """Byte-budgeted RAM tier over a ``ModelRegistry``'s entries, with
+    demand paging up and LRU/pressure demotion down (module docstring
+    for the full ladder)."""
+
+    def __init__(self, registry, program_cache=None, *,
+                 ram_budget_bytes: Optional[int] = None,
+                 on_demote: Optional[Callable] = None):
+        if ram_budget_bytes is None:
+            env = os.environ.get(RAM_BUDGET_ENV)
+            ram_budget_bytes = int(float(env)) if env else None
+        self.registry = registry
+        self.program_cache = program_cache
+        self.ram_budget_bytes = ram_budget_bytes
+        #: fleet hook, called (entry) under the victim's page lock
+        #: BEFORE the model object drops — the lane stop + drain
+        self.on_demote = on_demote
+        self.metrics = TierMetrics()
+        self._lock = threading.Lock()
+        #: (model_id, version) -> _Residency, LRU order (oldest first)
+        self._resident: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        #: per-key page-in/demotion serialization (reentrant: the fleet
+        #: wraps lane startup in the same lock)
+        self._page_locks: dict = {}
+        registry.attach_tier_store(self)
+
+    # -- locks ---------------------------------------------------------------
+    def page_lock(self, key: tuple) -> threading.RLock:
+        with self._lock:
+            lock = self._page_locks.get(key)
+            if lock is None:
+                lock = self._page_locks[key] = threading.RLock()
+            return lock
+
+    # -- accounting ----------------------------------------------------------
+    @property
+    def ram_bytes(self) -> int:
+        with self._lock:
+            return sum(r.nbytes for r in self._resident.values())
+
+    @property
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._resident)
+
+    def is_resident(self, model_id: str, version: str) -> bool:
+        with self._lock:
+            return (model_id, version) in self._resident
+
+    # -- paging up -----------------------------------------------------------
+    def touch(self, entry):
+        """Ensure ``entry`` is RAM-resident and return its model object
+        (the demand-paging entry point). A hit is one lock + an LRU
+        move; a miss loads the checkpoint (span ``tenancy.page_in``),
+        resolves the true content fingerprint of a lazily registered
+        entry, charges the stat-estimated bytes against the budget, and
+        demotes unpinned LRU victims beyond it."""
+        key = (entry.model_id, entry.version)
+        with self._lock:
+            res = self._resident.get(key)
+            if res is not None and entry.model is not None:
+                self._resident.move_to_end(key)
+                return entry.model
+        with self.page_lock(key):
+            # single-flight: a concurrent pager already finished
+            with self._lock:
+                res = self._resident.get(key)
+                if res is not None and entry.model is not None:
+                    self._resident.move_to_end(key)
+                    return entry.model
+            if entry.model is not None:
+                # loaded but unaccounted (an eagerly registered entry
+                # adopted into the tier): admit without re-loading
+                nbytes = model_file_bytes(entry.path) if entry.path \
+                    else 0
+                victims = self._admit(key, nbytes, pinned=False)
+                self._finish_demotions(victims)
+                return entry.model
+            if entry.path is None:
+                raise ValueError(
+                    f"model {entry.model_id!r} version "
+                    f"{entry.version!r} has no path to page in from")
+            nbytes = model_file_bytes(entry.path)
+            # reserve pinned BEFORE the load: a concurrent pager's
+            # victim scan must never pick an entry whose bytes are
+            # about to land (the pin is what breaks the demote/page-in
+            # lock cycle)
+            victims = self._admit(key, nbytes, pinned=True)
+            self._finish_demotions(victims)
+            from transmogrifai_tpu.utils.events import events
+            from transmogrifai_tpu.utils.tracing import span
+            t0 = time.monotonic()
+            try:
+                with span("tenancy.page_in", model=entry.model_id,
+                          version=entry.version, bytesEst=nbytes):
+                    from transmogrifai_tpu.workflow import load_model
+                    model = load_model(entry.path)
+                    if entry.fingerprint.startswith("lazy:"):
+                        from transmogrifai_tpu.checkpoint import (
+                            model_fingerprint,
+                        )
+                        entry.fingerprint = model_fingerprint(
+                            path=entry.path)
+                    entry.model = model
+            except BaseException:
+                with self._lock:
+                    self._resident.pop(key, None)
+                raise
+            with self._lock:
+                res = self._resident.get(key)
+                if res is not None:
+                    res.pinned = False
+            wall = time.monotonic() - t0
+            self.metrics.note_promotion_ram()
+            events.emit("tenancy.page_in", model=entry.model_id,
+                        version=entry.version, bytes=nbytes,
+                        wallMs=round(wall * 1e3, 3))
+            return entry.model
+
+    def _admit(self, key: tuple, nbytes: int,
+               pinned: bool) -> list:
+        """Insert/refresh one residency record and return the LRU
+        victims (key, nbytes) the budget demands — selected here under
+        the store lock, demoted by the caller outside it."""
+        victims: list = []
+        with self._lock:
+            self._resident[key] = _Residency(nbytes, pinned)
+            self._resident.move_to_end(key)
+            if self.ram_budget_bytes:
+                total = sum(r.nbytes for r in self._resident.values())
+                for vkey in list(self._resident):
+                    if total <= self.ram_budget_bytes \
+                            or len(self._resident) <= 1:
+                        break
+                    res = self._resident[vkey]
+                    if vkey == key or res.pinned:
+                        continue
+                    del self._resident[vkey]
+                    total -= res.nbytes
+                    victims.append((vkey, res.nbytes))
+        return victims
+
+    # -- paging down ---------------------------------------------------------
+    def _finish_demotions(self, victims: list,
+                          rung: Optional[str] = None) -> None:
+        """Demote each selected victim: under ITS page lock, re-check
+        it was not re-paged meanwhile, stop its lane (``on_demote``),
+        drop the model object, and evict its compiled programs unless a
+        still-resident entry shares the fingerprint."""
+        from transmogrifai_tpu.serving.registry import UnknownModelError
+        from transmogrifai_tpu.utils.events import events
+        for vkey, nbytes in victims:
+            with self.page_lock(vkey):
+                with self._lock:
+                    if vkey in self._resident:
+                        continue    # re-paged while pending: it wins
+                try:
+                    entry = self.registry.get(*vkey)
+                except UnknownModelError:
+                    continue        # forgotten while pending
+                if self.on_demote is not None:
+                    self.on_demote(entry)
+                entry.model = None
+                if entry.state != ModelState.UNLOADED:
+                    entry.state = ModelState.COLD
+                hbm = 0
+                if self.program_cache is not None \
+                        and not entry.fingerprint.startswith("lazy:") \
+                        and not self.registry.fingerprint_in_use(
+                            entry.fingerprint):
+                    hbm = self.program_cache.evict_model(
+                        entry.fingerprint)
+                self.metrics.note_demotion(hbm)
+                self.registry.touch()
+                events.emit("tenancy.demote", model=entry.model_id,
+                            version=entry.version, bytes=nbytes,
+                            hbmEntries=hbm, rung=rung)
+
+    def shed(self, bytes_to_free: int) -> int:
+        """The tier-demotion PRESSURE rung: demote least-recently-used
+        unpinned residents until ``bytes_to_free`` accounted bytes are
+        released (never the newest — the model serving the request that
+        tripped the pressure must survive). Records through the
+        resource ladder under site ``tenancy.store``. Returns the bytes
+        freed."""
+        victims: list = []
+        freed = 0
+        with self._lock:
+            for vkey in list(self._resident):
+                if freed >= bytes_to_free or len(self._resident) <= 1:
+                    break
+                res = self._resident[vkey]
+                if res.pinned:
+                    continue
+                del self._resident[vkey]
+                freed += res.nbytes
+                victims.append((vkey, res.nbytes))
+        if victims:
+            from transmogrifai_tpu.utils.resources import (
+                record_degradation,
+            )
+            self.metrics.note_shed()
+            record_degradation(
+                "tenancy.store", "demote_ram",
+                modelsDemoted=len(victims), bytesFreed=freed)
+            self._finish_demotions(victims, rung="demote_ram")
+        return freed
+
+    def note_unloaded(self, entry) -> None:
+        """Registry hook: an explicit ``unload`` must release the RAM
+        tier's accounted bytes (not just the device arrays) and the
+        model's compiled programs when no other loaded entry shares the
+        fingerprint. Called AFTER the registry dropped the model
+        object."""
+        key = (entry.model_id, entry.version)
+        with self._lock:
+            self._resident.pop(key, None)
+        hbm = 0
+        if self.program_cache is not None \
+                and not entry.fingerprint.startswith("lazy:") \
+                and not self.registry.fingerprint_in_use(
+                    entry.fingerprint):
+            hbm = self.program_cache.evict_model(entry.fingerprint)
+        self.metrics.note_demotion(hbm)
+
+    def to_json(self) -> dict:
+        with self._lock:
+            resident = len(self._resident)
+            nbytes = sum(r.nbytes for r in self._resident.values())
+        return {"residentModels": resident,
+                "ramBytes": nbytes,
+                "ramBudgetBytes": self.ram_budget_bytes,
+                "metrics": self.metrics.to_json()}

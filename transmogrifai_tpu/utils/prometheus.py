@@ -22,6 +22,7 @@ costs nothing on the serving hot path.
 from __future__ import annotations
 
 import functools
+import os
 import re
 import time
 from typing import Callable, Optional
@@ -697,20 +698,142 @@ def _explain_collectors(reg: PromRegistry, servers_fn) -> None:
                  per_bucket("dispatches"))
 
 
+#: cap on `model`-labeled tenant series per scrape: the K busiest lanes
+#: keep their own label, the tail aggregates into ONE `_other` sample
+#: set. <= 0 = unlimited (the pre-tiering behavior)
+TENANT_TOPK_ENV = "TRANSMOGRIFAI_METRICS_TENANT_TOPK"
+TENANT_TOPK_DEFAULT = 20
+
+#: the model=_other rollup series — per-tenant label cardinality is
+#: bounded; everything still SUMS correctly across the label
+TENANT_OTHER_LABEL = "_other"
+
+_ROLLUP_SUM_ATTRS = frozenset({
+    "admitted", "completed", "failed", "expired", "batches",
+    "degraded_batches", "data_error_batches", "batch_rows",
+    "degraded_entries", "recoveries", "dispatch_retries",
+    "batch_wall_s", "rejected_backpressure", "rejected_invalid"})
+
+
+class _ServingRollup:
+    """The ``model="_other"`` aggregate over the tail lanes' metrics:
+    counters sum, the latency histogram merges bucket-wise, gauges take
+    the honest aggregate (sum for depth/capacity/rps, any() for the
+    degraded flag). ``compile_counters`` is None — per-bucket compile
+    series stay per-model-only: a bucket histogram summed across
+    heterogeneous tail models would chart nothing anyone can act on."""
+
+    compile_counters = None
+
+    def __init__(self, members):
+        self._members = list(members)
+
+    def __getattr__(self, attr):
+        if attr in _ROLLUP_SUM_ATTRS:
+            return sum(getattr(m, attr) for m in self._members)
+        raise AttributeError(attr)
+
+    @property
+    def degraded_active(self):
+        return int(any(m.degraded_active for m in self._members))
+
+    @property
+    def queue_capacity(self):
+        return sum(m.queue_capacity or 0 for m in self._members)
+
+    @property
+    def queue_depth_fn(self):
+        members = self._members
+        return lambda: sum((m.queue_depth_fn or (lambda: 0))()
+                           for m in members)
+
+    def latency_histogram(self) -> dict:
+        buckets: dict = {}
+        total_sum = 0.0
+        total_count = 0
+        for m in self._members:
+            h = m.latency_histogram()
+            for le, cum in h["buckets"].items():
+                buckets[le] = buckets.get(le, 0) + cum
+            total_sum += h["sum"]
+            total_count += h["count"]
+        return {"buckets": buckets, "sum": total_sum,
+                "count": total_count}
+
+    def rolling_rps(self) -> float:
+        return sum(m.rolling_rps() for m in self._members)
+
+    def throughput_rps(self) -> float:
+        return sum(m.throughput_rps() for m in self._members)
+
+
+class _ExplainRollupLane:
+    """Server-shaped wrapper carrying the tail lanes' explain rollup
+    (``explainer`` stays None: mask-chunk/group gauges are
+    per-model-only, like the compile buckets)."""
+
+    explainer = None
+
+    def __init__(self, members):
+        self.explain_metrics = _ServingRollup(members)
+
+
+def tenant_topk() -> int:
+    env = os.environ.get(TENANT_TOPK_ENV)
+    if env is None or not env.strip():
+        return TENANT_TOPK_DEFAULT
+    try:
+        return int(float(env))
+    except ValueError:
+        return TENANT_TOPK_DEFAULT
+
+
+def _split_topk_lanes(fleet, k: int) -> tuple:
+    """``(top, tail)`` over the fleet's active lanes: the ``k`` busiest
+    (lifetime admitted — stable under scrape-to-scrape load wiggle,
+    unlike a rolling rate) keep their own ``model`` label; the rest
+    roll up. Top is re-sorted by id so scrape output stays diff-able."""
+    lanes = sorted(fleet.active_lanes().items())
+    if k <= 0 or len(lanes) <= k:
+        return lanes, []
+    ranked = sorted(lanes,
+                    key=lambda kv: (-kv[1].metrics.admitted, kv[0]))
+    return sorted(ranked[:k]), ranked[k:]
+
+
 def _fleet_collectors(reg: PromRegistry, fleet) -> None:
     """Fleet-level series: swap lifecycle, shared compiled-program cache
     accounting, per-model state — plus every serving series labeled
-    ``model=<id>`` via ``_serving_collectors`` over the active lanes."""
-    _serving_collectors(
-        reg, lambda: [({"model": mid}, lane.metrics)
-                      for mid, lane in sorted(
-                          fleet.active_lanes().items())])
-    _explain_collectors(
-        reg, lambda: [({"model": mid}, lane)
-                      for mid, lane in sorted(
-                          fleet.active_lanes().items())
-                      if getattr(lane, "explain_metrics", None)
-                      is not None])
+    ``model=<id>`` via ``_serving_collectors`` over the active lanes.
+
+    Label cardinality is BOUNDED: at 1000 tenants, per-model series
+    make every scrape megabytes, so only the top-K busiest lanes
+    (``TRANSMOGRIFAI_METRICS_TENANT_TOPK``, default 20) keep their own
+    ``model`` label and the tail aggregates into ``model="_other"``
+    (fleet-wide sums over the label stay exact)."""
+    topk = tenant_topk()
+
+    def serving_lanes():
+        top, tail = _split_topk_lanes(fleet, topk)
+        out = [({"model": mid}, lane.metrics) for mid, lane in top]
+        if tail:
+            out.append(({"model": TENANT_OTHER_LABEL},
+                        _ServingRollup([ln.metrics for _, ln in tail])))
+        return out
+
+    def explain_lanes():
+        top, tail = _split_topk_lanes(fleet, topk)
+        out = [({"model": mid}, lane) for mid, lane in top
+               if getattr(lane, "explain_metrics", None) is not None]
+        tail_m = [ln.explain_metrics for _, ln in tail
+                  if getattr(ln, "explain_metrics", None) is not None]
+        if tail_m:
+            out.append(({"model": TENANT_OTHER_LABEL},
+                        _ExplainRollupLane(tail_m)))
+        return out
+
+    _serving_collectors(reg, serving_lanes)
+    _explain_collectors(reg, explain_lanes)
     fm = fleet.metrics
     for attr, name, help_ in (
             ("swaps", "swaps", "completed zero-downtime hot-swaps"),
@@ -744,11 +867,110 @@ def _fleet_collectors(reg: PromRegistry, fleet) -> None:
     reg.register("transmogrifai_fleet_models", "gauge",
                  "models with a running active lane",
                  lambda: [({}, len(fleet.active_lanes()))])
+    def model_state():
+        top, tail = _split_topk_lanes(fleet, topk)
+        out = [({"model": mid, "state": lane.state}, 1)
+               for mid, lane in top]
+        if tail:
+            counts: dict = {}
+            for _, lane in tail:
+                counts[lane.state] = counts.get(lane.state, 0) + 1
+            out.extend(({"model": TENANT_OTHER_LABEL, "state": s}, n)
+                       for s, n in sorted(counts.items()))
+        return out
+
     reg.register(
         "transmogrifai_fleet_model_state", "gauge",
-        "1 for each model's current readiness state",
-        lambda: [({"model": mid, "state": lane.state}, 1)
-                 for mid, lane in sorted(fleet.active_lanes().items())])
+        "1 for each model's current readiness state (top-K lanes by "
+        "traffic; the tail aggregates per state under model=\"_other\")",
+        model_state)
+
+
+def _tenancy_collectors(reg: PromRegistry, fleet) -> None:
+    """Multi-tenant tiering series over a tenancy-enabled fleet: the
+    residency ladder (RAM-tier bytes/budget, promotion and demotion
+    counters per tier edge, cold starts) plus — when admission is on —
+    the per-tenant fairness surface, top-K-capped with a
+    ``tenant="_other"`` rollup exactly like the serving series."""
+    store = fleet.tenancy_store
+    tm = store.metrics
+    reg.register("transmogrifai_tenancy_ram_bytes", "gauge",
+                 "accounted host-RAM bytes of resident decoded models",
+                 lambda: [({}, store.ram_bytes)])
+    reg.register("transmogrifai_tenancy_ram_budget_bytes", "gauge",
+                 "configured RAM-tier byte budget (0 = unbounded)",
+                 lambda: [({}, store.ram_budget_bytes or 0)])
+    reg.register("transmogrifai_tenancy_models_resident", "gauge",
+                 "models resident in the host-RAM tier",
+                 lambda: [({}, store.resident_count)])
+    reg.register(
+        "transmogrifai_tenancy_models_cold", "gauge",
+        "registered models currently COLD (path-only; page in on "
+        "first score)",
+        lambda: [({}, sum(1 for d in fleet.registry.list()
+                          if d.get("state") == "cold"))])
+    reg.register(
+        "transmogrifai_tenancy_promotions_total", "counter",
+        "residency promotions, by tier edge (disk->RAM page-ins, "
+        "RAM->HBM lane starts)",
+        lambda: [({"tier": "ram"}, tm.promotions_disk_ram),
+                 ({"tier": "hbm"}, tm.promotions_ram_hbm)])
+    reg.register(
+        "transmogrifai_tenancy_demotions_total", "counter",
+        "residency demotions, by tier (RAM records dropped; HBM "
+        "program entries evicted by a RAM demotion)",
+        lambda: [({"tier": "ram"}, tm.demotions_ram),
+                 ({"tier": "hbm"}, tm.demotions_hbm)])
+    reg.register(
+        "transmogrifai_tenancy_sheds_total", "counter",
+        "pressure-rung shed passes (tier demotion under host "
+        "RSS/disk pressure)",
+        lambda: [({}, tm.sheds)])
+    reg.register(
+        "transmogrifai_tenancy_prewarms_total", "counter",
+        "popularity-driven background page-ins",
+        lambda: [({}, tm.prewarms)])
+    reg.register(
+        "transmogrifai_tenancy_cold_starts_total", "counter",
+        "demand page-ins on first score (disk -> RAM -> lane)",
+        lambda: [({}, tm.cold_starts)])
+    reg.register(
+        "transmogrifai_tenancy_cold_start_wall_seconds_total",
+        "counter",
+        "cumulative cold-start wall (first-score page-in latency)",
+        lambda: [({}, tm.cold_start_wall_s)])
+    admission = getattr(fleet, "admission", None)
+    if admission is None:
+        return
+    topk = tenant_topk()
+
+    def fairness(field: str):
+        def collect():
+            top, other = admission.metrics.topk(topk)
+            out = [({"tenant": t}, row[field])
+                   for t, row in sorted(top.items())]
+            if other is not None:
+                out.append(({"tenant": TENANT_OTHER_LABEL},
+                            other[field]))
+            return out
+        return collect
+
+    reg.register("transmogrifai_fairness_admitted_total", "counter",
+                 "requests admitted through the tenant token bucket "
+                 "(top-K tenants; tail under tenant=\"_other\")",
+                 fairness("admitted"))
+    reg.register("transmogrifai_fairness_throttled_total", "counter",
+                 "requests throttled by the tenant token bucket "
+                 "(answered 503 + Retry-After)",
+                 fairness("throttled"))
+    reg.register("transmogrifai_fairness_debt_seconds_total", "counter",
+                 "cumulative suggested-wait seconds per tenant (how "
+                 "hard each pushed past its fair share)",
+                 fairness("debtSeconds"))
+    reg.register(
+        "transmogrifai_fairness_cold_start_waits_total", "counter",
+        "requests that waited on a cold-start page-in",
+        lambda: [({}, admission.metrics.cold_start_waits)])
 
 
 def _router_collectors(reg: PromRegistry, router) -> None:
@@ -772,9 +994,25 @@ def _router_collectors(reg: PromRegistry, router) -> None:
             ("markdowns", "markdowns",
              "replicas marked down by the router"),
             ("no_replica", "no_replica",
-             "requests with no routable replica at all")):
+             "requests with no routable replica at all"),
+            ("rebalances", "rebalances",
+             "skew-triggered ring re-weightings applied")):
         reg.register(f"transmogrifai_router_{name}_total", "counter",
                      help_, lambda a=attr: [({}, getattr(rm, a))])
+    if getattr(router, "load_skew", None) is not None:
+        reg.register(
+            "transmogrifai_router_load_skew", "gauge",
+            "max/mean primary EWMA load over ring members (1.0 = "
+            "balanced; the supervisor's rebalance trigger)",
+            lambda: [({}, router.load_skew())])
+        reg.register(
+            "transmogrifai_router_ring_weight", "gauge",
+            "per-replica consistent-hash placement weight (vnode "
+            "multiplier; rebalancing moves these)",
+            lambda: [({"replica": rid}, w)
+                     for rid, w in sorted(
+                         router.ring.weights().items())]
+                    or [({"replica": "none"}, 0)])
     reg.register(
         "transmogrifai_router_proxied_total", "counter",
         "requests proxied, by serving replica",
@@ -815,7 +1053,9 @@ def _scaleout_collectors(reg: PromRegistry, supervisor) -> None:
              "version)"),
             ("rollbacks", "rollbacks",
              "already-swapped replicas forced back to the old version "
-             "by a halted roll")):
+             "by a halted roll"),
+            ("rebalances", "rebalances",
+             "skew-triggered ring rebalances the supervisor applied")):
         reg.register(f"transmogrifai_scaleout_{name}_total", "counter",
                      help_, lambda a=attr: [({}, getattr(sm, a))])
     reg.register(
@@ -925,6 +1165,9 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
             _explain_collectors(reg, lambda: [({}, server)])
     if fleet is not None:
         _fleet_collectors(reg, fleet)
+        if getattr(fleet, "tenancy_store", None) is not None:
+            # multi-tenant tiering: residency-ladder + fairness series
+            _tenancy_collectors(reg, fleet)
     if continuous is not None:
         _continuous_collectors(reg, continuous)
     if router is not None:
