@@ -103,6 +103,134 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_wire_speed(doc))
     if doc.get("metric") == "multitenant_fleet":
         errors.extend(_validate_multitenant_fleet(doc))
+    if doc.get("metric") == "network_chaos":
+        errors.extend(_validate_network_chaos(doc))
+    return errors
+
+
+#: round-18 acceptance bounds for the chaos-proven network data plane:
+#: the full socket-fault matrix (every NET_KINDS member fired at least
+#: once) driven through the REAL multi-process router + tenancy fleet
+#: must cost zero client-visible drops and zero double-scores (the
+#: dedupe-counter equality sum(scored) == distinct requests), with
+#: chaos-leg p99 inflated at most MAX_CHAOS_P99_INFLATION x the
+#: same-run steady leg
+MAX_CHAOS_P99_INFLATION = 3.0
+REQUIRED_FAULT_KINDS = ("delay", "reset", "refuse", "split",
+                        "truncate", "corrupt", "blackhole")
+MIN_CHAOS_MODELS = 1000
+
+
+def _validate_network_chaos(doc: dict) -> list[str]:
+    """The ``benchmarks/NETWORK_CHAOS.json`` contract: the PR-17
+    tenancy fleet (>= MIN_CHAOS_MODELS lazily registered models,
+    Zipf traffic) behind the real multi-process router with a
+    :class:`ChaosProxy` on every router -> replica hop. Gates:
+    'zero_dropped' true (every client request settled 2xx through the
+    fault matrix), 'double_scores' exactly 0 backed by the dedupe
+    equality (fleet-wide sum(scored) == 'distinct_requests'), every
+    fault kind in REQUIRED_FAULT_KINDS delivered >= 1 time, dedupe
+    hits >= 1 (a retry actually coalesced), and the chaos leg's p99
+    within MAX_CHAOS_P99_INFLATION x the same-run steady p99."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def pos_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+    def nonneg_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+    models = doc.get("models")
+    if not (pos_int(models) and models >= MIN_CHAOS_MODELS):
+        errors.append(f"network-chaos artifact: 'models' must be an "
+                      f"int >= {MIN_CHAOS_MODELS} — the chaos claim is "
+                      "about the tenancy fleet, not a toy replica")
+    if doc.get("zero_dropped") is not True:
+        errors.append("network-chaos artifact: 'zero_dropped' must be "
+                      "true — every client request settled through the "
+                      "fault matrix (retried, hedged, or spilled; "
+                      "never dropped)")
+    ds = doc.get("double_scores")
+    if not nonneg_int(ds):
+        errors.append("network-chaos artifact: 'double_scores' must be "
+                      "an int (fleet-wide sum(scored) - distinct "
+                      "requests)")
+    elif ds != 0:
+        errors.append(
+            f"idempotency violated: {ds} double-score(s) — a retried "
+            "or hedged frame was executed twice despite the dedupe "
+            "ring")
+    distinct = doc.get("distinct_requests")
+    scored = doc.get("scored_total")
+    if not pos_int(distinct):
+        errors.append("network-chaos artifact: missing positive int "
+                      "'distinct_requests'")
+    if not pos_int(scored):
+        errors.append("network-chaos artifact: missing positive int "
+                      "'scored_total' (fleet-wide sum of the replicas' "
+                      "dedupe-ring scored counters)")
+    if pos_int(distinct) and pos_int(scored) and nonneg_int(ds) \
+            and scored - distinct != ds:
+        errors.append(
+            f"network-chaos artifact: double_scores ({ds}) does not "
+            f"equal scored_total - distinct_requests ({scored} - "
+            f"{distinct}) — the equality IS the proof, recompute it")
+    for leg in ("steady", "chaos"):
+        block = doc.get(leg)
+        if not (isinstance(block, dict) and num(block.get("rps"))
+                and block.get("rps", 0) > 0
+                and num(block.get("p50_ms"))
+                and num(block.get("p99_ms"))
+                and block.get("p99_ms", 0) > 0):
+            errors.append(f"network-chaos artifact: '{leg}' must "
+                          "record positive 'rps' + 'p50_ms'/'p99_ms'")
+    steady, chaos = doc.get("steady"), doc.get("chaos")
+    infl = doc.get("p99_inflation_x")
+    if not num(infl):
+        errors.append("network-chaos artifact: missing numeric "
+                      "'p99_inflation_x' (chaos p99 / steady p99, "
+                      "same run)")
+    elif infl > MAX_CHAOS_P99_INFLATION:
+        errors.append(
+            f"chaos p99 bound violated: the fault matrix inflated p99 "
+            f"{infl}x over the same-run steady leg (> "
+            f"{MAX_CHAOS_P99_INFLATION:g}x) — the defenses shed too "
+            "slowly")
+    if isinstance(steady, dict) and isinstance(chaos, dict) \
+            and num(infl) and num(steady.get("p99_ms")) \
+            and steady.get("p99_ms", 0) > 0 \
+            and num(chaos.get("p99_ms")):
+        recomputed = chaos["p99_ms"] / steady["p99_ms"]
+        if abs(recomputed - infl) > 0.05 * max(1.0, abs(infl)):
+            errors.append(
+                f"network-chaos artifact: p99_inflation_x ({infl}) "
+                f"does not match chaos.p99_ms / steady.p99_ms "
+                f"({recomputed:.3f})")
+    faults = doc.get("faults")
+    if not isinstance(faults, dict):
+        errors.append("network-chaos artifact: missing 'faults' block "
+                      "(delivered-fault counts by kind)")
+    else:
+        for kind in REQUIRED_FAULT_KINDS:
+            if not pos_int(faults.get(kind)):
+                errors.append(
+                    f"network-chaos artifact: faults.{kind} must be "
+                    ">= 1 — a fault kind that never fired was never "
+                    "survived")
+    dd = doc.get("dedupe")
+    if not isinstance(dd, dict):
+        errors.append("network-chaos artifact: missing 'dedupe' block")
+    else:
+        if not pos_int(dd.get("hits")):
+            errors.append("network-chaos artifact: dedupe.hits must "
+                          "be >= 1 — at least one retry must actually "
+                          "have been answered from the ring")
+        if not nonneg_int(dd.get("waits")):
+            errors.append("network-chaos artifact: dedupe.waits must "
+                          "be a non-negative int")
     return errors
 
 

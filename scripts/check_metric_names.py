@@ -300,7 +300,28 @@ def collect_violations() -> list[str]:
     rm.count("retries")
     rm.count("markdowns")
     rm.count("rebalances")
+    rm.count("refusals")
+    rm.count("resets")
+    rm.count("hedges")
     out.extend(check_json_doc(rm.to_json(), "RouterMetrics.to_json"))
+
+    # the network data plane (round 18): the process-global
+    # transmogrifai_net_* counters every registry carries, driven hot
+    # so each collector closure renders non-zero, plus the camelCase
+    # contract on the counters' and the dedupe ring's JSON snapshots
+    from transmogrifai_tpu.serving.aiohttp_core import (
+        DedupeRing, Response, net_counters,
+    )
+
+    for f in net_counters.FIELDS:
+        setattr(net_counters, f, getattr(net_counters, f) + 1)
+    out.extend(check_json_doc(net_counters.to_json(),
+                              "NetCounters.to_json"))
+    ring = DedupeRing(capacity=4)
+    verdict, entry = ring.begin("req-1")
+    ring.complete("req-1", entry, Response(200, b"{}"))
+    ring.begin("req-1")
+    out.extend(check_json_doc(ring.to_json(), "DedupeRing.to_json"))
     from transmogrifai_tpu.tenancy import PopularityTracker
 
     tracker = PopularityTracker(half_life_s=30.0, clock=lambda: 100.0)

@@ -1,0 +1,157 @@
+"""Lint the network data plane for unbounded socket waits, checked in CI.
+
+A socket read/write with no armed deadline is how one slow or dead peer
+pins a thread (or the whole event loop) forever — the exact failure
+class the slowloris / black-hole chaos drills exist to catch. This lint
+makes "every wait is bounded" a STRUCTURAL property of
+``transmogrifai_tpu/serving`` + ``transmogrifai_tpu/scaleout`` (+ the
+netchaos proxy) instead of a review-time hope:
+
+- **async stream ops**: a bare ``await reader.read()/readline()/
+  readexactly()/readuntil()`` or ``await writer.drain()`` is a
+  violation — those must go through ``asyncio.wait_for`` or one of the
+  server's bounded helpers (``_bounded``/``_drain``), which arm a
+  deadline and shed the peer on expiry.
+- **sync recv-family ops**: ``sock.recv()/recv_into()/accept()`` (and
+  ``sendall`` on raw sockets) inside a function with no
+  ``settimeout(...)``/``create_connection(..., timeout=...)`` evidence
+  in the same function or enclosing class is a violation — a blocking
+  socket with no timeout waits forever.
+
+Escape hatch: a ``# deadline-ok: <reason>`` comment on the call's line
+acknowledges a deliberately unbounded (or otherwise-bounded) wait —
+e.g. an accept loop polling a stop flag through a short
+``settimeout``, or a proxy pump whose PEERS own the deadline.
+
+Library use: ``check_file(path)`` / ``check_tree(paths)`` return
+violation lists; ``main()`` lints the serving + scaleout trees and the
+netchaos module, printing every violation and exiting 1. Wired into
+tier-1 via ``tests/test_netchaos.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+
+__all__ = ["check_file", "check_tree"]
+
+#: awaited stream methods that block until the peer sends/accepts bytes
+ASYNC_WAITS = {"read", "readline", "readexactly", "readuntil", "drain"}
+
+#: async wrappers that arm a deadline around an awaited stream op
+ASYNC_BOUNDERS = {"wait_for", "_bounded", "_drain", "timeout",
+                  "timeout_at"}
+
+#: blocking socket methods that wait on the peer
+SYNC_WAITS = {"recv", "recv_into", "accept"}
+
+#: call names that prove a timeout is armed somewhere in the scope
+SYNC_EVIDENCE = {"settimeout", "create_connection", "wait_for"}
+
+
+def _call_attr(node: ast.AST) -> str:
+    """The attribute name of a direct method call, else ''."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _line_ok(source_lines: list[str], lineno: int) -> bool:
+    line = source_lines[lineno - 1] if 0 < lineno <= len(source_lines) \
+        else ""
+    return "# deadline-ok" in line
+
+
+def _has_sync_evidence(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        attr = _call_attr(node)
+        if attr in SYNC_EVIDENCE:
+            return True
+        if isinstance(node, ast.Call):
+            # create_connection(..., timeout=...) / socket(..., timeout=)
+            for kw in node.keywords:
+                if kw.arg == "timeout":
+                    return True
+    return False
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = source.splitlines()
+    out: list[str] = []
+    rel = os.path.relpath(path)
+
+    # pass 1: bare awaits of unbounded stream ops. A wrapped wait —
+    # wait_for(reader.read(n), t) — has the WRAPPER as the awaited
+    # call, so matching only the Await's direct value is exact.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Await):
+            continue
+        attr = _call_attr(node.value)
+        if attr in ASYNC_WAITS and not _line_ok(lines, node.lineno):
+            out.append(
+                f"{rel}:{node.lineno}: bare `await .{attr}(...)` has no "
+                "armed deadline — wrap in asyncio.wait_for / the "
+                "server's _bounded/_drain helpers, or annotate the line "
+                "with `# deadline-ok: <reason>`")
+
+    # pass 2: blocking recv-family calls in scopes with no timeout
+    # evidence. Scope = the enclosing function; a class-level helper
+    # that arms timeouts elsewhere annotates its recv lines instead.
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        risky = [n for n in ast.walk(fn)
+                 if _call_attr(n) in SYNC_WAITS
+                 and not _line_ok(lines, n.lineno)]
+        if risky and not _has_sync_evidence(fn):
+            for n in risky:
+                out.append(
+                    f"{rel}:{n.lineno}: blocking `.{_call_attr(n)}(...)`"
+                    f" in {fn.name}() with no settimeout/timeout= "
+                    "evidence in scope — arm a socket timeout or "
+                    "annotate with `# deadline-ok: <reason>`")
+    return out
+
+
+def check_tree(roots) -> list[str]:
+    out: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.extend(check_file(root))
+            continue
+        for path in sorted(glob.glob(os.path.join(root, "**", "*.py"),
+                                     recursive=True)):
+            out.extend(check_file(path))
+    return out
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "transmogrifai_tpu")
+    roots = args or [os.path.join(pkg, "serving"),
+                     os.path.join(pkg, "scaleout"),
+                     os.path.join(pkg, "utils", "netchaos.py")]
+    violations = check_tree(roots)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} unbounded socket wait(s) found")
+        return 1
+    print("socket-deadline lint clean: " + ", ".join(
+        os.path.relpath(r) for r in roots))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -836,6 +836,92 @@ def test_multitenant_artifact_schema_rejections(checker):
          if k != "distinct_models_scored"}))
 
 
+def _network_chaos_good():
+    return {
+        "metric": "network_chaos", "platform": "cpu",
+        "requests": 4400, "models": 1000, "wall_s": 30.0,
+        "zero_dropped": True, "distinct_requests": 4400,
+        "scored_total": 4400, "double_scores": 0,
+        "steady": {"rps": 210.0, "p50_ms": 35.0, "p99_ms": 90.0},
+        "chaos": {"rps": 205.0, "p50_ms": 36.0, "p99_ms": 110.0},
+        "p99_inflation_x": 1.222,
+        "faults": {"delay": 10, "reset": 3, "refuse": 2, "split": 12,
+                   "truncate": 2, "corrupt": 3, "blackhole": 1},
+        "dedupe": {"hits": 5, "waits": 0},
+    }
+
+
+def test_network_chaos_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _network_chaos_good()
+    assert v(good) == []
+    # the fleet-size floor: chaos against a toy replica proves nothing
+    assert any("models" in e for e in v({**good, "models": 999}))
+    assert any("zero_dropped" in e for e in v(
+        {**good, "zero_dropped": False}))
+    # the exactly-once ledger: any double-score is an idempotency hole
+    assert any("idempotency" in e for e in v(
+        {**good, "double_scores": 1, "scored_total": 4401}))
+    # ... and the committed equality must actually add up
+    assert any("the equality IS the proof" in e for e in v(
+        {**good, "scored_total": 4401}))
+    assert any("distinct_requests" in e for e in v(
+        {k: x for k, x in good.items() if k != "distinct_requests"}))
+    # both legs must carry real latency blocks
+    assert any("'steady'" in e for e in v(
+        {**good, "steady": {"rps": 0, "p50_ms": 1.0, "p99_ms": 2.0}}))
+    assert any("'chaos'" in e for e in v(
+        {k: x for k, x in good.items() if k != "chaos"}))
+    # the chaos p99 bound, and the inflation must match the legs
+    assert any("chaos p99 bound" in e for e in v(
+        {**good, "p99_inflation_x": 3.5,
+         "chaos": {"rps": 205.0, "p50_ms": 36.0, "p99_ms": 315.0}}))
+    assert any("does not match" in e for e in v(
+        {**good, "p99_inflation_x": 2.0}))
+    # every fault kind must have fired: unfired faults were not survived
+    faults = good["faults"]
+    assert any("blackhole" in e for e in v(
+        {**good, "faults": {k: x for k, x in faults.items()
+                            if k != "blackhole"}}))
+    assert any("reset" in e for e in v(
+        {**good, "faults": {**faults, "reset": 0}}))
+    # a retry must actually have been answered from the dedupe ring
+    assert any("dedupe.hits" in e for e in v(
+        {**good, "dedupe": {"hits": 0, "waits": 0}}))
+    assert any("dedupe" in e for e in v(
+        {k: x for k, x in good.items() if k != "dedupe"}))
+
+
+def test_network_chaos_artifact_committed_and_healthy(checker):
+    """The round-18 acceptance contract on the COMMITTED artifact: the
+    1000-model tenancy fleet scored over the binary wire through a
+    deterministic fault proxy on every router -> replica hop, with all
+    seven NET fault kinds delivered, zero client-visible drops, the
+    exactly-once dedupe equality (sum(scored) == distinct requests,
+    double_scores == 0), at least one retry answered from the ring,
+    and chaos-leg p99 within the inflation bound of the same-run
+    steady leg."""
+    path = os.path.join(REPO, "benchmarks", "NETWORK_CHAOS.json")
+    assert os.path.exists(path), \
+        "benchmarks/NETWORK_CHAOS.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "network_chaos"
+    assert art["ok"] is True and art["notes"] == []
+    assert art["models"] >= checker.MIN_CHAOS_MODELS
+    assert art["zero_dropped"] is True
+    assert art["double_scores"] == 0
+    assert art["scored_total"] == art["distinct_requests"] > 0
+    for kind in checker.REQUIRED_FAULT_KINDS:
+        assert art["faults"][kind] >= 1, kind
+    assert art["dedupe"]["hits"] >= 1
+    assert art["p99_inflation_x"] <= checker.MAX_CHAOS_P99_INFLATION
+    assert art["steady"]["rps"] > 0 and art["chaos"]["rps"] > 0
+    # provenance: the plan itself is committed so the run is replayable
+    assert art["plan"] and isinstance(art["plan_seed"], int)
+    assert art["replicas"] >= 2
+
+
 def test_multitenant_artifact_committed_and_healthy(checker):
     """The round-17 acceptance contract on the COMMITTED artifact:
     >= 1000 model dirs registered lazily (zero checkpoint loads),

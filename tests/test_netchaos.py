@@ -1,0 +1,654 @@
+"""Chaos-proven network data plane: the deterministic socket fault
+proxy (``utils/netchaos.py``) and its shared ``FaultPlan`` grammar, the
+event-loop front's slow-client defenses (slowloris read deadlines, idle
+keep-alive reaping, the bounded connection gate, write deadlines), the
+``X-Request-Id`` idempotency ring, the router's classified safe retries
+(refusal vs mid-request reset, Retry-After deferral, p99-gated
+hedging), the admin control plane's triple deadline, and the
+socket-deadline lint (``scripts/check_socket_deadlines.py``)."""
+
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.scaleout.router import Router
+from transmogrifai_tpu.scaleout.wire import AdminError, admin_call
+from transmogrifai_tpu.serving.aiohttp_core import (
+    DedupeRing, Response, net_counters,
+)
+from transmogrifai_tpu.serving.http import MetricsServer
+from transmogrifai_tpu.utils.faults import (
+    NET_KINDS, NET_SITES, FaultPlan,
+)
+from transmogrifai_tpu.utils.netchaos import ChaosProxy
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+# -- plan grammar: net sites/kinds --------------------------------------------
+
+def test_net_kinds_require_net_sites_and_vice_versa():
+    FaultPlan.parse("reset@net.write#2")          # valid pairing
+    FaultPlan.parse("transient@scaleout.route")   # valid pairing
+    with pytest.raises(ValueError):
+        FaultPlan.parse("reset@scaleout.route")   # net kind, frame site
+    with pytest.raises(ValueError):
+        FaultPlan.parse("transient@net.write")    # frame kind, net site
+    assert NET_SITES == frozenset(
+        {"net.accept", "net.connect", "net.read", "net.write"})
+    assert set(NET_KINDS) == {"delay", "reset", "refuse", "split",
+                              "truncate", "corrupt", "blackhole"}
+
+
+def test_net_check_fires_at_invocation_and_records():
+    plan = FaultPlan.parse("reset@net.write#2", seed=1)
+    assert plan.net_check("net.write") == []
+    assert plan.net_check("net.write") == []
+    fired = plan.net_check("net.write")
+    assert len(fired) == 1 and fired[0].kind == "reset"
+    assert ("net.write", 2, "reset") in plan.fired
+    assert plan.net_check("net.write") == []
+
+
+def test_one_plan_drives_both_layers():
+    """The point of sharing the grammar: ONE plan string schedules an
+    in-frame fault AND a socket fault, and the frame-layer ``check``
+    never raises for net entries (they are delivered, not raised)."""
+    from transmogrifai_tpu.utils.faults import XlaRuntimeError
+    plan = FaultPlan.parse("transient@scaleout.route#0;reset@net.write#0",
+                           seed=3)
+    with pytest.raises(XlaRuntimeError):
+        plan.check("scaleout.route")
+    assert plan.net_check("net.write")[0].kind == "reset"
+    kinds = {k for (_s, _i, k) in plan.fired}
+    assert kinds == {"transient", "reset"}
+
+
+# -- proxy: determinism + delivery --------------------------------------------
+
+def _echo_upstream():
+    """A tiny line-echo TCP server; returns (port, stop)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(0.2)
+    stopping = threading.Event()
+
+    def serve_one(conn):
+        conn.settimeout(5.0)
+        try:
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(1024)
+                if not chunk:
+                    return
+                buf += chunk
+            conn.sendall(buf)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def loop():
+        while not stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=serve_one, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=loop, daemon=True).start()
+
+    def stop():
+        stopping.set()
+        srv.close()
+
+    return srv.getsockname()[1], stop
+
+
+def _drive(proxy_port: int, n: int) -> list:
+    """n sequential echo round-trips; returns per-request outcomes."""
+    out = []
+    for i in range(n):
+        try:
+            with socket.create_connection(("127.0.0.1", proxy_port),
+                                          timeout=5.0) as c:
+                c.settimeout(2.0)
+                c.sendall(f"ping {i}\n".encode())
+                got = b""
+                while not got.endswith(b"\n"):
+                    chunk = c.recv(1024)
+                    if not chunk:
+                        break
+                    got += chunk
+                out.append(got.decode(errors="replace"))
+        except OSError:
+            # the error TYPE races (RST propagation vs client timeout);
+            # only the success/failure shape is deterministic here — the
+            # byte-exact contract is the plan's fired log
+            out.append("ERR")
+    return out
+
+
+def test_chaosproxy_deterministic_fired_log():
+    """Same plan text + same seed + same sequential traffic => the SAME
+    fired log and the same per-request outcomes, both runs."""
+    port, stop = _echo_upstream()
+    text = ("corrupt@net.read%0.5;delay@net.write:0.001%0.5;"
+            "reset@net.write#4")
+    try:
+        logs, outcomes = [], []
+        for _ in range(2):
+            plan = FaultPlan.parse(text, seed=42)
+            with ChaosProxy(port, plan=plan) as proxy:
+                outcomes.append(_drive(proxy.port, 8))
+            logs.append(list(plan.fired))
+        assert logs[0] == logs[1]
+        assert outcomes[0] == outcomes[1]
+        assert any(k == "reset" for (_s, _i, k) in logs[0])
+    finally:
+        stop()
+
+
+def test_chaosproxy_transparent_without_plan():
+    port, stop = _echo_upstream()
+    try:
+        with ChaosProxy(port, plan=FaultPlan.parse("", seed=0)) as proxy:
+            assert _drive(proxy.port, 3) == [
+                "ping 0\n", "ping 1\n", "ping 2\n"]
+            assert proxy.stats.faults_delivered == 0
+            assert proxy.stats.connections == 3
+    finally:
+        stop()
+
+
+def test_chaosproxy_corrupt_flips_bytes():
+    port, stop = _echo_upstream()
+    try:
+        plan = FaultPlan.parse("corrupt@net.read#0", seed=9)
+        with ChaosProxy(port, plan=plan) as proxy:
+            got = _drive(proxy.port, 1)[0]
+        assert got != "ping 0\n"           # one byte flipped upstream
+        assert ("net.read", 0, "corrupt") in plan.fired
+        assert proxy.stats.by_kind.get("corrupt") == 1
+    finally:
+        stop()
+
+
+def test_chaosproxy_refuse_on_connect():
+    port, stop = _echo_upstream()
+    try:
+        plan = FaultPlan.parse("refuse@net.connect#0", seed=1)
+        with ChaosProxy(port, plan=plan) as proxy:
+            outcomes = _drive(proxy.port, 2)
+        assert outcomes[0] in ("ERR", "")       # closed before the dial
+        assert outcomes[1] == "ping 1\n"        # one-shot spec
+        assert proxy.stats.upstream_dials == 1
+    finally:
+        stop()
+
+
+# -- slow-client defenses -----------------------------------------------------
+
+def _score_server(**kwargs) -> MetricsServer:
+    return MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                         score_fn=lambda mid, row, tid: {
+                             "model": mid, "ok": True},
+                         **kwargs).start()
+
+
+def test_slowloris_shed_while_real_traffic_flows():
+    """The regression the read deadline exists for: a 1-byte-per-second
+    client is shed 408 by the header deadline while concurrent JSON
+    traffic keeps completing."""
+    srv = _score_server(read_timeout_s=0.5, idle_timeout_s=5.0)
+    shed_before = net_counters.slow_clients_shed
+    try:
+        slow = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10.0)
+        slow.sendall(b"POST /score/m HTTP/1.1\r\n")
+        results = []
+
+        def trickle():
+            # one header byte at a time: never finishes inside 0.5s
+            try:
+                for b in b"Content-Length: 10\r\n":
+                    slow.sendall(bytes([b]))
+                    time.sleep(0.15)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=trickle, daemon=True)
+        t.start()
+        for i in range(5):   # framed traffic flows during the trickle
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request("POST", "/score/m", json.dumps({"x": i}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            results.append(resp.status)
+            conn.close()
+        slow.settimeout(10.0)
+        raw = b""
+        try:
+            while True:
+                chunk = slow.recv(1024)
+                if not chunk:
+                    break
+                raw += chunk
+        except OSError:
+            pass
+        t.join(timeout=10)
+        slow.close()
+        assert results == [200] * 5
+        assert b"408" in raw.split(b"\r\n", 1)[0]
+        assert net_counters.slow_clients_shed > shed_before
+    finally:
+        srv.stop()
+
+
+def test_idle_keepalive_reaped_silently():
+    srv = _score_server(idle_timeout_s=0.3)
+    idle_before = net_counters.idle_closed
+    try:
+        c = socket.create_connection(("127.0.0.1", srv.port),
+                                     timeout=5.0)
+        c.settimeout(5.0)
+        # never send a request line: the idle timeout reaps us silently
+        assert c.recv(1024) == b""
+        c.close()
+        assert net_counters.idle_closed > idle_before
+    finally:
+        srv.stop()
+
+
+def test_connection_gate_sheds_503_with_retry_after():
+    srv = _score_server(max_connections=1, idle_timeout_s=30.0)
+    shed_before = net_counters.shed_connections
+    try:
+        # first connection completes a request and stays keep-alive,
+        # holding the single bounded slot
+        first = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=10)
+        first.request("POST", "/score/m", b"{}",
+                      {"Content-Type": "application/json"})
+        assert first.getresponse().read() and True
+        # the gate sheds at accept: the 503 banner arrives unprompted,
+        # so read it raw (an http.client would race its request write
+        # against the teardown)
+        second = socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10.0)
+        second.settimeout(10.0)
+        raw = b""
+        try:
+            while b"\r\n\r\n" not in raw:
+                chunk = second.recv(4096)
+                if not chunk:
+                    break
+                raw += chunk
+        except OSError:
+            pass
+        second.close()
+        first.close()
+        assert b" 503 " in raw.split(b"\r\n", 1)[0]
+        assert b"Retry-After:" in raw
+        assert net_counters.shed_connections > shed_before
+    finally:
+        srv.stop()
+
+
+# -- idempotency: the dedupe ring ---------------------------------------------
+
+def test_dedupe_ring_mine_hit_wait_and_eviction():
+    ring = DedupeRing(capacity=2)
+    tag, entry = ring.begin("a")
+    assert tag == "mine"
+    tag2, waiter = ring.begin("a")
+    assert tag2 == "wait" and waiter is entry
+    ring.complete("a", entry, Response(200, b"one"))
+    tag3, resp = ring.begin("a")
+    assert tag3 == "hit" and resp.body == b"one"
+    # eviction: capacity 2, completed entries evict oldest-first
+    for key in ("b", "c"):
+        _, e = ring.begin(key)
+        ring.complete(key, e, Response(200, key.encode()))
+    assert ring.evicted >= 1
+    tag4, _ = ring.begin("a")     # evicted: re-claimed as mine
+    assert tag4 == "mine"
+
+
+def test_dedupe_abandon_releases_waiters_for_legit_retry():
+    ring = DedupeRing()
+    _, entry = ring.begin("k")
+    verdicts = []
+
+    def waiter():
+        tag, obj = ring.begin("k")
+        if tag == "wait":
+            obj.event.wait(5.0)
+            tag, obj = ring.begin("k")
+        verdicts.append(tag)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    ring.abandon("k", entry)   # failed execution: key forgotten
+    t.join(timeout=5)
+    assert verdicts == ["mine"]   # the retry re-executes legitimately
+    assert ring.scored == 0
+
+
+def test_metrics_server_dedupes_by_request_id():
+    calls = []
+
+    def score(mid, row, tid):
+        calls.append(mid)
+        return {"n": len(calls)}
+
+    srv = MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                        score_fn=score).start()
+    try:
+        def post(rid):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request("POST", "/score/m", b"{}",
+                         {"Content-Type": "application/json",
+                          "X-Request-Id": rid})
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+            dedupe = resp.getheader("X-Dedupe")
+            conn.close()
+            return resp.status, doc, dedupe
+
+        s1, d1, t1 = post("req-1")
+        s2, d2, t2 = post("req-1")     # retried: answered from ring
+        s3, d3, t3 = post("req-2")     # distinct key: scored fresh
+        assert (s1, s2, s3) == (200, 200, 200)
+        assert t1 == "original" and t2 == "hit" and t3 == "original"
+        assert d1 == d2                 # byte-identical cached reply
+        assert len(calls) == 2          # req-1 scored exactly once
+        assert srv.dedupe.to_json()["hits"] == 1
+    finally:
+        srv.stop()
+
+
+def test_frame_meta_request_id_peek():
+    from transmogrifai_tpu.serving.wireformat import (
+        encode_rows, peek_meta, peek_request_id,
+    )
+    frame = encode_rows("m1", [{"x": 1.0}],
+                        meta={"request_id": "abc-123", "other": 1})
+    assert peek_request_id(frame) == "abc-123"
+    assert peek_meta(frame)["other"] == 1
+    assert peek_request_id(encode_rows("m1", [{"x": 1.0}])) is None
+    assert peek_request_id(b"garbage") is None
+
+
+# -- router: classified retries ----------------------------------------------
+
+def _stub_replica(score_fn):
+    return MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                         score_fn=score_fn).start()
+
+
+def test_router_refusal_spills_immediately_and_marks_down():
+    """connect-refused = provably undelivered: next candidate at once,
+    refuser marked down, no retry budget spent."""
+    live = _stub_replica(lambda mid, row, tid: {"ok": True})
+    dead_port = socket.create_server(("127.0.0.1", 0))
+    port = dead_port.getsockname()[1]
+    dead_port.close()                 # nothing listens here now
+    router = Router(port=0)
+    try:
+        router.set_replica("rdead", port)
+        router.set_replica("rlive", live.port)
+        for i in range(4):            # hit both ring orders
+            status, _h, _p, served = router.dispatch(
+                f"model_{i}", b"{}")
+            assert status == 200 and served == "rlive"
+        assert router.metrics.refusals >= 1
+        assert router.replicas()["rdead"]["state"] == "down"
+        # the budget was NOT charged for refusals: resets untouched
+        assert router.metrics.resets == 0
+    finally:
+        router.stop()
+        live.stop()
+
+
+def test_router_reset_retry_same_replica_deduped():
+    """A mid-request reset (reply killed AFTER scoring) retries the
+    SAME replica under the minted X-Request-Id; the replica's dedupe
+    ring answers from cache — scored exactly once, client sees 200."""
+    calls = []
+
+    def score(mid, row, tid):
+        calls.append(mid)
+        return {"n": len(calls)}
+
+    replica = _stub_replica(score)
+    plan = FaultPlan.parse("reset@net.write#0", seed=5)
+    proxy = ChaosProxy(replica.port, plan=plan).start()
+    router = Router(port=0, retry_backoff_s=0.001)
+    try:
+        router.set_replica("r0", proxy.port)
+        status, rheaders, payload, served = router.dispatch(
+            "m1", b"{}")
+        assert status == 200 and served == "r0"
+        assert len(calls) == 1                  # never double-scored
+        assert router.metrics.resets >= 1
+        assert ("net.write", 0, "reset") in plan.fired
+        dedupe = {k.lower(): v for k, v in rheaders.items()}.get(
+            "x-dedupe")
+        assert dedupe == "hit"                  # the retry hit the ring
+        assert replica.dedupe.to_json()["scored"] == 1
+    finally:
+        router.stop()
+        proxy.stop()
+        replica.stop()
+
+
+def test_router_honors_retry_after_deferral():
+    """A replica's 503 Retry-After puts it at the END of the candidate
+    list (never dropped) until the window passes; mark_up clears it."""
+    def throttled(mid, row, tid):
+        from transmogrifai_tpu.serving.batcher import BackpressureError
+        raise BackpressureError("full", retry_after_s=30.0)
+
+    busy = _stub_replica(throttled)
+    free = _stub_replica(lambda mid, row, tid: {"ok": True})
+    router = Router(port=0)
+    try:
+        router.set_replica("rbusy", busy.port)
+        router.set_replica("rfree", free.port)
+        # find a model whose primary is the throttled replica
+        model = next(f"model_{i}" for i in range(64)
+                     if router.route_order(f"model_{i}")[0] == "rbusy")
+        status, _h, _p, served = router.dispatch(model, b"{}")
+        assert status == 200 and served == "rfree"
+        assert router.metrics.spillovers >= 1
+        # inside the (capped) Retry-After window the replica is
+        # deferred to the end of the order, not dropped
+        assert router.route_order(model) == ["rfree", "rbusy"]
+        assert router.replicas()["rbusy"].get("deferredS", 0) > 0
+        router.mark_up("rbusy")
+        assert router.route_order(model)[0] == "rbusy"
+    finally:
+        router.stop()
+        busy.stop()
+        free.stop()
+
+
+def test_router_hedges_slow_primary_to_successor():
+    """With hedging on and the primary overshooting its own observed
+    p99, the request duplicates to the ring successor (same request
+    id) and the first success wins."""
+    def slow(mid, row, tid):
+        time.sleep(0.6)
+        return {"who": "slow"}
+
+    slow_srv = _stub_replica(slow)
+    fast_srv = _stub_replica(lambda mid, row, tid: {"who": "fast"})
+    router = Router(port=0, hedge=True, hedge_min_samples=5,
+                    hedge_min_s=0.02, hedge_max_s=0.1)
+    try:
+        router.set_replica("rslow", slow_srv.port)
+        router.set_replica("rfast", fast_srv.port)
+        model = next(f"model_{i}" for i in range(64)
+                     if router.route_order(f"model_{i}")[0] == "rslow")
+        # prime the primary's latency window below the hedge delay
+        for _ in range(8):
+            router._note_latency("rslow", 0.01)
+        status, _h, payload, served = router.dispatch(model, b"{}")
+        assert status == 200
+        assert served == "rfast"                 # the hedge won
+        assert json.loads(payload)["who"] == "fast"
+        assert router.metrics.hedges >= 1
+    finally:
+        router.stop()
+        slow_srv.stop()
+        fast_srv.stop()
+
+
+# -- admin control-plane deadlines --------------------------------------------
+
+def _silent_listener(mode: str):
+    """A listener that accepts and never answers (``mode='mute'``) or
+    trickles one byte per 0.2s forever (``mode='trickle'``)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(0.2)
+    stopping = threading.Event()
+
+    def loop():
+        conns = []
+        while not stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                if mode == "trickle":
+                    for c in list(conns):
+                        try:
+                            c.sendall(b"H")
+                        except OSError:
+                            conns.remove(c)
+                continue
+            except OSError:
+                return
+            conn.settimeout(1.0)
+            try:
+                conn.recv(4096)       # swallow the request
+            except OSError:
+                pass
+            conns.append(conn)
+
+    threading.Thread(target=loop, daemon=True).start()
+
+    def stop():
+        stopping.set()
+        srv.close()
+
+    return srv.getsockname()[1], stop
+
+
+def test_admin_call_per_recv_timeout_flag():
+    port, stop = _silent_listener("mute")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(AdminError) as ei:
+            admin_call(port, "status", timeout_s=0.4, deadline_s=5.0)
+        assert ei.value.timeout is True
+        assert time.monotonic() - t0 < 4.0
+    finally:
+        stop()
+
+
+def test_admin_call_overall_deadline_beats_trickler():
+    """A peer trickling a byte per per-recv window defeats socket
+    timeouts; the watchdog's overall deadline still ends the call."""
+    port, stop = _silent_listener("trickle")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(AdminError) as ei:
+            admin_call(port, "status", timeout_s=0.5, deadline_s=0.8)
+        wall = time.monotonic() - t0
+        assert ei.value.timeout is True
+        assert wall < 3.0
+    finally:
+        stop()
+
+
+def test_admin_call_error_status_keeps_connection():
+    """Regression: an HTTP-level error is a complete exchange — it must
+    NOT tear down the keep-alive connection (only deadlines do)."""
+    def control(action, payload):
+        raise ValueError(f"unknown action {action!r}")
+
+    srv = MetricsServer(render_fn=lambda: "", health_fn=lambda: {},
+                        control_fn=control).start()
+    try:
+        with pytest.raises(AdminError) as ei:
+            admin_call(srv.port, "nope", timeout_s=5.0)
+        assert ei.value.status == 400 and ei.value.timeout is False
+        # second call rides the same pooled connection and still works
+        with pytest.raises(AdminError) as ei2:
+            admin_call(srv.port, "nope", timeout_s=5.0)
+        assert ei2.value.status == 400
+    finally:
+        srv.stop()
+
+
+# -- the socket-deadline lint -------------------------------------------------
+
+def _lint():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import check_socket_deadlines
+        return check_socket_deadlines
+    finally:
+        sys.path.remove(SCRIPTS)
+
+
+def test_socket_deadline_lint_is_clean():
+    lint = _lint()
+    assert lint.main([]) == 0
+
+
+def test_socket_deadline_lint_catches_violations(tmp_path):
+    lint = _lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "async def h(reader, writer):\n"
+        "    data = await reader.readline()\n"
+        "    await writer.drain()\n"
+        "def g(sock):\n"
+        "    return sock.recv(1024)\n")
+    out = lint.check_file(str(bad))
+    assert len(out) == 3
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import asyncio\n"
+        "async def h(reader, writer):\n"
+        "    data = await asyncio.wait_for(reader.readline(), 5.0)\n"
+        "    await writer.drain()  # deadline-ok: test fixture\n"
+        "def g(sock):\n"
+        "    sock.settimeout(1.0)\n"
+        "    return sock.recv(1024)\n")
+    assert lint.check_file(str(ok)) == []
+
+
+def test_net_counters_exported_on_every_registry():
+    from transmogrifai_tpu.utils.prometheus import build_registry
+    rendered = build_registry(include_app=False).render()
+    for name in ("transmogrifai_net_accepted_total",
+                 "transmogrifai_net_slow_clients_shed_total",
+                 "transmogrifai_net_dedupe_hits_total",
+                 "transmogrifai_net_hedges_total"):
+        assert name in rendered

@@ -23,6 +23,26 @@ Routing policy:
   request that DISCOVERED the death is retried on the next candidate —
   scoring is idempotent, so a replica kill costs retries, never client
   drops.
+- **safe retries** (the network failure domain): transport failures
+  are CLASSIFIED, not lumped. Connect-refused (:class:`ReplicaRefused`)
+  means no byte reached the replica — spill to the next candidate
+  immediately and mark the refuser down. A mid-request reset
+  (:class:`ReplicaDown`) means the request MAY already have been
+  scored with the reply lost on the wire — it is retried (same replica
+  first, then successors) only because every proxied request carries an
+  ``X-Request-Id`` idempotency key minted at the front door (or taken
+  from the client's header / frame-meta ``request_id``): replicas keep
+  a dedupe ring (``aiohttp_core.DedupeRing``) so the retry is answered
+  from cache instead of scored twice. Retries draw from a per-request
+  budget (``retry_budget``) with jittered exponential backoff.
+- **Retry-After honored**: a 503-answering replica that names its own
+  backoff (``Retry-After``) is not re-offered traffic until that many
+  seconds pass — the replica's admission controller, not a fixed
+  markdown TTL, decides when it wants traffic back.
+- **optional hedging**: with ``hedge=True`` a request still unanswered
+  at the primary's observed p99 is duplicated to the ring successor
+  (same idempotency key); first reply wins. Tail latency is traded for
+  bounded duplicate work — never duplicate SCORES, the key dedupes.
 - every proxied reply carries ``X-Served-By: <replica_id>`` so a load
   harness can prove where traffic actually went.
 
@@ -40,28 +60,58 @@ can drive the autoscaler's scale-up signal). Chaos seam: ``fault_point
 from __future__ import annotations
 
 import bisect
+import concurrent.futures
 import hashlib
 import http.client
 import json
+import random
 import threading
 import time
+import uuid
+from collections import deque
 from typing import Optional
 
 from transmogrifai_tpu.serving.aiohttp_core import (
-    AsyncHTTPServer, Request, Response,
+    AsyncHTTPServer, Request, Response, net_counters,
 )
 from transmogrifai_tpu.serving.metrics import LATENCY_BUCKETS_S
 from transmogrifai_tpu.serving.wireformat import (
     CONTENT_TYPE_FRAME, WireFormatError, peek_model_id,
+    peek_request_id,
 )
 from transmogrifai_tpu.utils.events import events
 
 __all__ = ["ConsistentHashRing", "Router", "RouterMetrics",
-           "ReplicaDown"]
+           "ReplicaDown", "ReplicaRefused"]
+
+#: an upstream's Retry-After is honored up to this long — a replica
+#: asking for more is treated as asking for this much (a typo'd header
+#: must not silently park a replica for an hour)
+RETRY_AFTER_CAP_S = 5.0
 
 
 class ReplicaDown(RuntimeError):
-    """Transport-level failure talking to a replica (connect/read)."""
+    """Mid-request transport failure talking to a replica (reset,
+    timeout, truncated reply): the request MAY have been delivered —
+    and scored — with the reply lost. Retrying is only safe under an
+    idempotency key."""
+
+
+class ReplicaRefused(ReplicaDown):
+    """Connect refused: no byte reached the replica, so the request was
+    provably NOT scored there. Always safe to retry on the next
+    candidate, and grounds for immediate markdown."""
+
+
+def _is_refused(e: BaseException) -> bool:
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        if isinstance(cur, ConnectionRefusedError):
+            return True
+        seen.add(id(cur))
+        cur = cur.__cause__ or cur.__context__
+    return False
 
 
 class ConsistentHashRing:
@@ -197,7 +247,10 @@ class RouterMetrics:
         self.failed = 0             # 5xx/transport after all candidates
         self.client_errors = 0      # 4xx from the replica (caller bug)
         self.spillovers = 0         # 503 -> next replica
-        self.retries = 0            # transport error -> next replica
+        self.retries = 0            # transport error -> retry
+        self.refusals = 0           # connect-refused -> immediate spill
+        self.resets = 0             # mid-request reset -> keyed retry
+        self.hedges = 0             # p99-gated duplicate to successor
         self.markdowns = 0          # replicas marked down by the router
         self.no_replica = 0         # no routable replica at all
         self.rebalances = 0         # skew-triggered ring re-weightings
@@ -248,6 +301,9 @@ class RouterMetrics:
                     "clientErrors": self.client_errors,
                     "spillovers": self.spillovers,
                     "retries": self.retries,
+                    "refusals": self.refusals,
+                    "resets": self.resets,
+                    "hedges": self.hedges,
                     "markdowns": self.markdowns,
                     "noReplica": self.no_replica,
                     "rebalances": self.rebalances,
@@ -255,7 +311,8 @@ class RouterMetrics:
 
 
 class _Replica:
-    __slots__ = ("replica_id", "host", "port", "state", "changed_at")
+    __slots__ = ("replica_id", "host", "port", "state", "changed_at",
+                 "not_before")
 
     def __init__(self, replica_id, host, port):
         self.replica_id = replica_id
@@ -263,11 +320,18 @@ class _Replica:
         self.port = int(port)
         self.state = "up"            # up | down | draining
         self.changed_at = time.time()
+        #: monotonic instant before which this replica is not offered
+        #: traffic (its own 503 Retry-After ask — see module docstring)
+        self.not_before = 0.0
 
     def to_json(self) -> dict:
-        return {"replicaId": self.replica_id, "host": self.host,
-                "port": self.port, "state": self.state,
-                "changedAt": self.changed_at}
+        doc = {"replicaId": self.replica_id, "host": self.host,
+               "port": self.port, "state": self.state,
+               "changedAt": self.changed_at}
+        defer = self.not_before - time.monotonic()
+        if defer > 0:
+            doc["deferredS"] = round(defer, 3)
+        return doc
 
 
 class Router:
@@ -282,9 +346,34 @@ class Router:
                  spill: int = 2, vnodes: int = 64,
                  route_field: str = "model",
                  upstream_timeout_s: float = 30.0,
-                 slo=None, load_half_life_s: float = 30.0):
+                 slo=None, load_half_life_s: float = 30.0,
+                 retry_budget: int = 3,
+                 retry_backoff_s: float = 0.01,
+                 hedge: bool = False,
+                 hedge_min_s: float = 0.02,
+                 hedge_max_s: float = 1.0,
+                 hedge_min_samples: int = 20):
         self.ring = ConsistentHashRing(vnodes=vnodes)
         self.metrics = RouterMetrics()
+        #: transport-failure retries one request may spend, total,
+        #: across all candidates (the poisoned-path tour bound)
+        self.retry_budget = int(retry_budget)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.hedge = bool(hedge)
+        self.hedge_min_s = float(hedge_min_s)
+        self.hedge_max_s = float(hedge_max_s)
+        self.hedge_min_samples = int(hedge_min_samples)
+        #: per-replica recent proxy latencies (the hedge gate's p99)
+        self._lat_lock = threading.Lock()
+        self._lat: dict[str, deque] = {}
+        self._hedge_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        if self.hedge:
+            self._hedge_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=16,
+                thread_name_prefix="transmogrifai-hedge")
+        #: jittered-backoff RNG (timing only — never correctness)
+        self._backoff_rng = random.Random()
         #: per-model EWMA request rate observed AT THE ROUTER — the
         #: skew-rebalancing signal (the same decayed-rate estimator the
         #: tenancy prewarm ranking uses)
@@ -347,6 +436,10 @@ class Router:
                         reason=reason or None)
 
     def mark_up(self, replica_id: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.not_before = 0.0
         if self._set_state(replica_id, "up"):
             events.emit("scaleout.markup", replica=replica_id)
 
@@ -363,16 +456,29 @@ class Router:
     # -- routing --------------------------------------------------------------
     def candidates(self, model_id: str) -> list[_Replica]:
         """The primary + up to ``spill`` routable successors for one
-        model id (ring order, down/draining filtered out)."""
+        model id (ring order, down/draining filtered out). Replicas
+        inside their self-declared ``Retry-After`` window are deferred
+        to the END of the list rather than dropped: honoring the ask
+        must never manufacture a no-replica 503."""
         order = self.ring.order(model_id)
         out: list[_Replica] = []
+        deferred: list[_Replica] = []
+        now = time.monotonic()
         with self._lock:
             for rid in order:
                 rep = self._replicas.get(rid)
-                if rep is not None and rep.state == "up":
+                if rep is None or rep.state != "up":
+                    continue
+                if rep.not_before > now:
+                    deferred.append(rep)
+                else:
                     out.append(rep)
-                    if len(out) > self.spill:
-                        break
+                if len(out) > self.spill:
+                    break
+        for rep in deferred:
+            if len(out) > self.spill:
+                break
+            out.append(rep)
         return out
 
     def route_order(self, model_id: str) -> list[str]:
@@ -456,34 +562,132 @@ class Router:
     def _proxy_once(self, rep: _Replica, path: str, body: bytes,
                     headers: dict) -> tuple:
         """One upstream attempt -> (status, reply_headers, payload).
-        Transport failures raise :class:`ReplicaDown`. One reconnect is
-        attempted first: an idle keep-alive socket the replica closed
-        (or a stale pool entry from before a respawn) is not a dead
-        replica."""
+        Transport failures are CLASSIFIED: :class:`ReplicaRefused` when
+        the connect itself was refused (no byte delivered — always safe
+        to retry elsewhere), :class:`ReplicaDown` for every mid-request
+        failure (the request may have been scored). One silent
+        reconnect is attempted only when the failing socket was a
+        previously-connected pool entry: an idle keep-alive socket the
+        replica closed (or a stale entry from before a respawn) is not
+        a dead replica — and nothing was delivered on it, so the
+        reconnect can't double-deliver."""
         from transmogrifai_tpu.utils.faults import fault_point
         fault_point("scaleout.route")
         for attempt in (0, 1):
             conn = self._upstream(rep)
+            fresh = conn.sock is None
+            t0 = time.monotonic()
             try:
                 conn.request("POST", path, body, headers)
                 resp = conn.getresponse()
                 payload = resp.read()
+                self._note_latency(rep.replica_id,
+                                   time.monotonic() - t0)
                 return resp.status, dict(resp.getheaders()), payload
             except Exception as e:  # noqa: BLE001 — classified below
                 self._drop_upstream(rep)
-                if attempt == 1:
-                    raise ReplicaDown(
-                        f"replica {rep.replica_id} at {rep.host}:"
-                        f"{rep.port}: {type(e).__name__}: {e}") from e
+                where = (f"replica {rep.replica_id} at {rep.host}:"
+                         f"{rep.port}: {type(e).__name__}: {e}")
+                if _is_refused(e):
+                    raise ReplicaRefused(where) from e
+                if fresh or attempt == 1:
+                    raise ReplicaDown(where) from e
+
+    # -- hedge gate -----------------------------------------------------------
+    def _note_latency(self, replica_id: str, latency_s: float) -> None:
+        with self._lat_lock:
+            dq = self._lat.get(replica_id)
+            if dq is None:
+                dq = self._lat[replica_id] = deque(maxlen=512)
+            dq.append(latency_s)
+
+    def replica_p99(self, replica_id: str) -> Optional[float]:
+        """The replica's observed p99 proxy latency, or None until
+        ``hedge_min_samples`` observations exist (hedging on a cold
+        estimate would hedge every request)."""
+        with self._lat_lock:
+            dq = self._lat.get(replica_id)
+            if dq is None or len(dq) < self.hedge_min_samples:
+                return None
+            lat = sorted(dq)
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    def _attempt(self, rep: _Replica, successor: Optional[_Replica],
+                 path: str, body: bytes, headers: dict) -> tuple:
+        """One routed attempt, hedged to ``successor`` when enabled and
+        the primary overshoots its own observed p99. Both legs carry
+        the same ``X-Request-Id``, so the duplicate is deduped at the
+        replica — a hedge can duplicate WORK (bounded, side-effect
+        free) but never a client-visible score. Returns ``(status,
+        reply_headers, payload, serving_replica)``."""
+        if self._hedge_pool is None or successor is None:
+            return (*self._proxy_once(rep, path, body, headers), rep)
+        p99 = self.replica_p99(rep.replica_id)
+        if p99 is None:
+            return (*self._proxy_once(rep, path, body, headers), rep)
+        delay = min(max(p99, self.hedge_min_s), self.hedge_max_s)
+        primary = self._hedge_pool.submit(
+            self._proxy_once, rep, path, body, headers)
+        try:
+            return (*primary.result(timeout=delay), rep)
+        except concurrent.futures.TimeoutError:
+            pass  # still in flight: hedge fires below
+        except ReplicaDown:
+            raise  # fast primary failure: no hedge, dispatch classifies
+        self.metrics.count("hedges")
+        net_counters.hedges += 1
+        events.emit("router.hedge", replica=rep.replica_id,
+                    successor=successor.replica_id,
+                    p99Ms=round(p99 * 1e3, 3))
+        hedge = self._hedge_pool.submit(
+            self._proxy_once, successor, path, body, headers)
+        owner = {primary: rep, hedge: successor}
+        pending = set(owner)
+        first_error: Optional[BaseException] = None
+        while pending:
+            done, _ = concurrent.futures.wait(
+                pending, timeout=self.upstream_timeout_s,
+                return_when=concurrent.futures.FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                pending.discard(fut)
+                err = fut.exception()
+                if err is None:
+                    return (*fut.result(), owner[fut])
+                if first_error is None or fut is primary:
+                    first_error = err
+        raise first_error if first_error is not None else ReplicaDown(
+            f"replica {rep.replica_id}: hedged attempts timed out")
+
+    def _note_retry_after(self, rep: _Replica, rheaders: dict) -> None:
+        """Honor the replica's own 503 Retry-After ask (bounded) before
+        re-offering it traffic."""
+        ra = next((v for k, v in rheaders.items()
+                   if k.lower() == "retry-after"), None)
+        if not ra:
+            return
+        try:
+            defer = min(float(ra), RETRY_AFTER_CAP_S)
+        except ValueError:
+            return
+        if defer > 0:
+            rep.not_before = max(rep.not_before,
+                                 time.monotonic() + defer)
 
     def dispatch(self, model_id: str, body: bytes,
                  headers: Optional[dict] = None) -> tuple:
-        """Route one scoring request: primary, spill on 503, retry next
-        on transport death (marking the dead replica down). Returns
+        """Route one scoring request: primary, spill on 503 (honoring
+        Retry-After), classified transport retries under a per-request
+        budget with jittered backoff (see module docstring). Returns
         ``(status, headers, payload, replica_id)``; with no routable
         replica or every candidate exhausted, a synthesized 503."""
         headers = dict(headers or {})
         headers.setdefault("Content-Type", "application/json")
+        if not headers.get("X-Request-Id"):
+            # the idempotency key that makes mid-request retries safe;
+            # minted here so every upstream hop carries one
+            headers["X-Request-Id"] = uuid.uuid4().hex[:16]
         path = f"/score/{model_id}"
         self.load.record(model_id)
         candidates = self.candidates(model_id)
@@ -496,33 +700,73 @@ class Router:
                        json.dumps({"error": "all replicas "
                                             "backpressured"}).encode(),
                        None)
+        budget = self.retry_budget
+
+        def backoff() -> None:
+            spent = self.retry_budget - budget
+            base = self.retry_backoff_s * (2 ** max(0, spent - 1))
+            time.sleep(base * self._backoff_rng.uniform(0.5, 1.5))
+
         for i, rep in enumerate(candidates):
-            try:
-                status, rheaders, payload = self._proxy_once(
-                    rep, path, body, headers)
-            except ReplicaDown as e:
-                # the request DISCOVERED the death: mark down, retry on
-                # the next candidate — a kill costs retries, not drops
-                self.mark_down(rep.replica_id, reason=str(e)[:200])
-                self.metrics.count("retries")
-                continue
-            except Exception as e:  # noqa: BLE001 — injected route faults
-                # (chaos site scaleout.route): transient/io failures on
-                # the hop retry the next candidate, bounded by the
-                # candidate list; harness errors must surface
-                from transmogrifai_tpu.utils.faults import (
-                    FaultHarnessError,
-                )
-                if isinstance(e, FaultHarnessError):
-                    raise
-                self.metrics.count("retries")
-                continue
-            if status == 503:
-                # the replica's own admission backpressure: spill over
-                self.metrics.count("spillovers")
-                last = (status, rheaders, payload, rep.replica_id)
-                continue
-            return status, rheaders, payload, rep.replica_id
+            successor = candidates[i + 1] if i + 1 < len(candidates) \
+                else None
+            same_replica_retries = 1
+            while True:
+                try:
+                    status, rheaders, payload, served = self._attempt(
+                        rep, successor, path, body, headers)
+                except ReplicaRefused as e:
+                    # no byte was delivered: safe immediate spillover,
+                    # and the refuser leaves routing until marked up
+                    self.mark_down(rep.replica_id, reason=str(e)[:200])
+                    self.metrics.count("retries")
+                    self.metrics.count("refusals")
+                    net_counters.refusals_spilled += 1
+                    break  # next candidate
+                except ReplicaDown as e:
+                    # mid-request failure: the request may have been
+                    # scored. The X-Request-Id key makes the retry safe
+                    # (replica dedupe ring); try the SAME replica once
+                    # first — a connection-level fault is not a dead
+                    # replica — then mark down and move on.
+                    self.metrics.count("retries")
+                    self.metrics.count("resets")
+                    net_counters.resets_retried += 1
+                    if budget <= 0:
+                        self.mark_down(rep.replica_id,
+                                       reason=str(e)[:200])
+                        return last
+                    budget -= 1
+                    backoff()
+                    if same_replica_retries > 0:
+                        same_replica_retries -= 1
+                        continue
+                    self.mark_down(rep.replica_id, reason=str(e)[:200])
+                    break  # next candidate
+                except Exception as e:  # noqa: BLE001 — injected route faults
+                    # (chaos site scaleout.route): transient/io failures
+                    # on the hop retry the next candidate, bounded by
+                    # the candidate list; harness errors must surface
+                    from transmogrifai_tpu.utils.faults import (
+                        FaultHarnessError,
+                    )
+                    if isinstance(e, FaultHarnessError):
+                        raise
+                    self.metrics.count("retries")
+                    if budget <= 0:
+                        return last
+                    budget -= 1
+                    break  # next candidate
+                if status == 503:
+                    # the replica's own admission backpressure: spill
+                    # over, and honor its Retry-After before offering
+                    # it traffic again
+                    self.metrics.count("spillovers")
+                    self._note_retry_after(served, rheaders)
+                    last = (status, rheaders, payload,
+                            served.replica_id)
+                    break  # next candidate
+                return status, rheaders, payload, served.replica_id
         return last
 
     # -- HTTP front -----------------------------------------------------------
@@ -612,12 +856,19 @@ class Router:
         trace = req.header("x-trace-id")
         if trace:
             fwd["X-Trace-Id"] = trace
+        # idempotency key: client header first, then in-band frame meta;
+        # dispatch mints one when neither is present
+        request_id = req.header("x-request-id") \
+            or (peek_request_id(body) if is_frame else None)
+        if request_id:
+            fwd["X-Request-Id"] = str(request_id)[:128]
         status, rheaders, payload, rid = \
             await self._http.run_blocking(
                 self.dispatch, model_id, body, fwd)
         self.metrics.record(rid, status, time.monotonic() - t0)
         extra = {k: v for k, v in rheaders.items()
-                 if k.lower() in ("x-trace-id", "retry-after")}
+                 if k.lower() in ("x-trace-id", "retry-after",
+                                  "x-request-id", "x-dedupe")}
         if rid is not None:
             extra["X-Served-By"] = rid
         rtype = next((v for k, v in rheaders.items()
@@ -635,6 +886,10 @@ class Router:
     def start(self) -> "Router":
         if self._http is not None:
             return self
+        if self.hedge and self._hedge_pool is None:
+            self._hedge_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=16,
+                thread_name_prefix="transmogrifai-hedge")
         from transmogrifai_tpu.serving.http import MAX_BODY_BYTES
         self._http = AsyncHTTPServer(
             self._handle, port=self._requested_port, host=self._host,
@@ -643,6 +898,9 @@ class Router:
         return self
 
     def stop(self) -> None:
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
+            self._hedge_pool = None
         if self._http is None:
             return
         self._http.stop()

@@ -10,7 +10,11 @@ scale-out contract with NO model and NO jax.
 - scripted failure modes (``--reject-swap``: the admin swap answers
   409 like a shadow-gate rejection — UNLESS the swap skips the gate
   with ``shadowRows: 0``, exactly like the real worker's forced
-  rollback; ``--backpressure``: every score answers 503+Retry-After).
+  rollback; ``--backpressure``: every score answers 503+Retry-After),
+- ``X-Request-Id`` idempotency: scores carrying a request id are
+  deduped through the same :class:`DedupeRing` the real serving stack
+  uses, so router retry/hedge semantics can be chaos-tested without
+  jax (``/admin/status`` reports the ring's counters as ``dedupe``).
 
 Two jobs: (1) fast multi-process supervisor/router/rolling-swap tests
 — spawn/kill/respawn semantics are about processes and sockets, not
@@ -37,7 +41,7 @@ import time
 from transmogrifai_tpu.scaleout import wire
 from transmogrifai_tpu.scaleout.wire import ReplicaStates
 from transmogrifai_tpu.serving.aiohttp_core import (
-    AsyncHTTPServer, Request, Response,
+    AsyncHTTPServer, DedupeRing, Request, Response,
 )
 
 __all__ = ["main"]
@@ -68,6 +72,7 @@ def main(argv=None) -> int:
              "version": args.version, "swaps": [], "served": 0}
     lock = threading.Lock()
     stop = threading.Event()
+    dedupe = DedupeRing()
 
     def reply(code, doc, extra=None) -> Response:
         return Response(code, (json.dumps(doc) + "\n").encode(),
@@ -81,7 +86,8 @@ def main(argv=None) -> int:
                                    "state": state["state"],
                                    "version": state["version"],
                                    "served": state["served"],
-                                   "swaps": list(state["swaps"])})
+                                   "swaps": list(state["swaps"]),
+                                   "dedupe": dedupe.to_json()})
         if action == "drain":
             # draining is a moment, not a destination (see the real
             # worker's _drain): quiesce instantly, back to READY
@@ -133,15 +139,52 @@ def main(argv=None) -> int:
             if args.backpressure:
                 return reply(503, {"error": "stub backpressure"},
                              {"Retry-After": "0.01"})
-            if args.latency_ms:
-                await asyncio.sleep(args.latency_ms / 1e3)
-            model = path[len("/score/"):] or "default"
-            with lock:
-                state["served"] += 1
-                doc = {"score": float(len(model) + len(payload)),
-                       "replica": args.replica_id,
-                       "version": state["version"]}
-            return reply(200, doc)
+
+            async def run_score() -> Response:
+                if args.latency_ms:
+                    await asyncio.sleep(args.latency_ms / 1e3)
+                model = path[len("/score/"):] or "default"
+                with lock:
+                    state["served"] += 1
+                    doc = {"score": float(len(model) + len(payload)),
+                           "replica": args.replica_id,
+                           "version": state["version"]}
+                return reply(200, doc)
+
+            rid = req.header("x-request-id")
+            if not rid:
+                return await run_score()
+            # idempotent path: same ring contract as the real stack —
+            # cached replies are re-issued as COPIES (the connection
+            # loop mutates Response.close on whatever it serves)
+            loop = asyncio.get_running_loop()
+            for _ in range(2):
+                verdict, obj = dedupe.begin(rid)
+                if verdict == "hit":
+                    return Response(obj.status, obj.body, obj.ctype,
+                                    {**obj.headers, "X-Dedupe": "hit"})
+                if verdict == "wait":
+                    done = await loop.run_in_executor(
+                        None, obj.event.wait, 30.0)
+                    if done:
+                        continue
+                    return reply(504, {"error": "duplicate of "
+                                       "in-flight request timed out"})
+                entry = obj
+                try:
+                    resp = await run_score()
+                except Exception:
+                    dedupe.abandon(rid, entry)
+                    raise
+                if 200 <= resp.status < 300:
+                    dedupe.complete(rid, entry, Response(
+                        resp.status, resp.body, resp.ctype,
+                        dict(resp.headers)))
+                else:
+                    dedupe.abandon(rid, entry)
+                resp.headers = {**resp.headers, "X-Dedupe": "original"}
+                return resp
+            return reply(504, {"error": "dedupe wait loop exhausted"})
         if path.startswith("/admin/"):
             return admin(path[len("/admin/"):], payload)
         return Response.error(404, "only /healthz, POST /score")

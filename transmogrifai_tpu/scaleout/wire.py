@@ -31,6 +31,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import socket
 import threading
 import time
 from typing import Optional
@@ -55,13 +56,18 @@ class ReplicaStates:
 class AdminError(RuntimeError):
     """An admin call failed. ``status`` carries the HTTP code (0 for
     transport errors) and ``doc`` the decoded error body when one came
-    back — 409 means a shadow-gate rejection (see serving/http.py)."""
+    back — 409 means a shadow-gate rejection (see serving/http.py).
+    ``timeout`` is True when the failure was a DEADLINE — connect
+    timeout, per-read socket timeout, or the call's overall deadline
+    (a black-holed replica trickling bytes forever): the supervisor
+    treats a timed-out replica as unhealthy, not the call as flaky."""
 
     def __init__(self, msg: str, status: int = 0,
-                 doc: Optional[dict] = None):
+                 doc: Optional[dict] = None, timeout: bool = False):
         super().__init__(msg)
         self.status = int(status)
         self.doc = doc or {}
+        self.timeout = bool(timeout)
 
 
 def atomic_write_json(doc: dict, path: str) -> None:
@@ -191,11 +197,67 @@ def _admin_once(conn: http.client.HTTPConnection, host: str, port: int,
     return doc
 
 
+def _is_timeout(e: BaseException) -> bool:
+    return isinstance(e, (socket.timeout, TimeoutError))
+
+
+class _Watchdog:
+    """Overall-deadline enforcement for one admin exchange: a timer
+    that hard-closes the connection's socket at the deadline, so a
+    black-holed replica trickling one byte per socket-timeout window
+    cannot hold the control plane past ``deadline_s``. ``fired`` tells
+    the caller the resulting socket error was OUR deadline, not the
+    network's."""
+
+    def __init__(self, conn: http.client.HTTPConnection,
+                 deadline_s: float):
+        self.fired = False
+        self._conn = conn
+        self._timer = threading.Timer(deadline_s, self._expire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _expire(self) -> None:
+        self.fired = True
+        sock_ = self._conn.sock
+        if sock_ is not None:
+            # shutdown() BEFORE close(): closing an fd from another
+            # thread does not wake a reader blocked in recv() — a
+            # half-open peer trickling bytes would keep the exchange
+            # alive past the deadline. shutdown delivers EOF to the
+            # blocked reader immediately.
+            try:
+                sock_.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock_.close()
+            except OSError:
+                pass
+
+    def cancel(self) -> None:
+        self._timer.cancel()
+
+
 def admin_call(port: int, action: str, payload: Optional[dict] = None,
-               host: str = "127.0.0.1", timeout_s: float = 60.0) -> dict:
+               host: str = "127.0.0.1", timeout_s: float = 60.0,
+               connect_timeout_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> dict:
     """One admin control-plane request; returns the decoded JSON reply
-    or raises :class:`AdminError` (status 409 = shadow-gate
-    rejection).
+    or raises :class:`AdminError` (status 409 = shadow-gate rejection;
+    ``timeout=True`` = a deadline fired, see below).
+
+    Three independent bounds keep a misbehaving replica from hanging
+    the supervisor's control plane:
+
+    - ``connect_timeout_s`` (default ``min(timeout_s, 5)``): how long
+      the TCP connect may take — a black-holed SYN fails fast instead
+      of inheriting the full I/O timeout;
+    - ``timeout_s``: the per-socket-operation bound (each recv);
+    - ``deadline_s`` (default ``2 x timeout_s``): the OVERALL wall
+      bound for the exchange — a replica trickling one byte per
+      ``timeout_s`` window defeats per-recv timeouts, so a watchdog
+      hard-closes the socket at the deadline.
 
     Connections are kept alive in a per-thread pool and reused across
     calls; only socket-level failures tear one down (with ONE silent
@@ -203,11 +265,38 @@ def admin_call(port: int, action: str, payload: Optional[dict] = None,
     have been closed server-side between calls). Error *statuses* ride
     the same connection — they don't cost a reconnect."""
     body = json.dumps(payload or {})
+    if connect_timeout_s is None:
+        connect_timeout_s = min(timeout_s, 5.0)
+    if deadline_s is None:
+        deadline_s = 2.0 * timeout_s
+
+    def once(conn: http.client.HTTPConnection) -> dict:
+        if conn.sock is None:
+            # distinct (shorter) connect bound, then the I/O timeout
+            conn.timeout = connect_timeout_s
+            conn.connect()
+            conn.sock.settimeout(timeout_s)
+            conn.timeout = timeout_s
+        dog = _Watchdog(conn, deadline_s)
+        try:
+            return _admin_once(conn, host, port, action, body)
+        except Exception as e:
+            if dog.fired:
+                raise AdminError(
+                    f"admin {action!r} on {host}:{port} exceeded the "
+                    f"{deadline_s:g}s overall deadline",
+                    timeout=True) from e
+            raise
+        finally:
+            dog.cancel()
+
     conn = _pooled_conn(host, port, timeout_s)
     fresh = conn.sock is None
     try:
-        return _admin_once(conn, host, port, action, body)
-    except AdminError:
+        return once(conn)
+    except AdminError as ae:
+        if ae.timeout:  # the watchdog half-closed the socket
+            _drop_conn(host, port)
         raise
     except Exception as e:  # noqa: BLE001 — socket-level failure
         _drop_conn(host, port)
@@ -215,15 +304,19 @@ def admin_call(port: int, action: str, payload: Optional[dict] = None,
             # connect itself failed — retrying immediately won't help
             raise AdminError(
                 f"admin {action!r} on {host}:{port} failed: "
-                f"{type(e).__name__}: {e}") from e
+                f"{type(e).__name__}: {e}",
+                timeout=_is_timeout(e)) from e
     # stale keep-alive socket: one retry on a brand-new connection
     conn = _pooled_conn(host, port, timeout_s)
     try:
-        return _admin_once(conn, host, port, action, body)
-    except AdminError:
+        return once(conn)
+    except AdminError as ae:
+        if ae.timeout:
+            _drop_conn(host, port)
         raise
     except Exception as e:  # noqa: BLE001 — transport failure, status 0
         _drop_conn(host, port)
         raise AdminError(
             f"admin {action!r} on {host}:{port} failed: "
-            f"{type(e).__name__}: {e}") from e
+            f"{type(e).__name__}: {e}",
+            timeout=_is_timeout(e)) from e

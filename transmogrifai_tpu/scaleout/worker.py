@@ -24,7 +24,15 @@ shadow-gated hot swap — and adds the scale-out contract around it:
   serving the promoted version, not v1;
 - drains gracefully on **SIGTERM** (finish in-flight requests, final
   ``stopped`` heartbeat) — the supervisor's scale-down and the
-  operator's ^C both exit without dropping an admitted request.
+  operator's ^C both exit without dropping an admitted request;
+- optionally runs **multi-tenant** (``--tenancy`` +
+  ``--tenancy-ram-budget-mb`` / ``--tenant-rate`` /
+  ``--tenancy-prewarm-top-k``): thousands of checkpoints register
+  COLD and demand-page through the router hop, with the per-tenant
+  fairness gate answering floods 503 + Retry-After at the front door;
+- deduplicates retried requests by ``X-Request-Id`` (the serving
+  stack's :class:`DedupeRing`); ``/admin/status`` reports the ring's
+  counters so a chaos drill can prove zero double-scores fleet-wide.
 """
 
 from __future__ import annotations
@@ -99,7 +107,13 @@ class ReplicaWorker:
         registry = build_registry(fleet=self.fleet)
         self.http = MetricsServer(
             render_fn=registry.render, health_fn=self.health,
-            score_fn=self.fleet._http_score, control_fn=self.control,
+            score_fn=self.fleet._http_score,
+            # --wire binary (the default) publishes the columnar frame
+            # wire on this replica's own port — the router's data plane;
+            # without it every frame request bounces 400 at the replica
+            frame_fn=self.fleet._http_frame
+            if self.fleet.wire == "binary" else None,
+            control_fn=self.control,
             port=self._port, host=self._host).start()
         self._set_state(ReplicaStates.READY)
         self.heartbeat()
@@ -248,13 +262,20 @@ class ReplicaWorker:
             mid: {str(b): n
                   for b, n in lane.post_warmup_compiles().items()}
             for mid, lane in self.fleet.active_lanes().items()}
-        return {"ok": True, "replicaId": self.replica_id,
-                "state": self.state, "pid": os.getpid(),
-                "models": self.fleet.registry.list(),
-                "queueDepths": self.fleet.queue_depths(),
-                "postWarmupCompiles": post_warmup,
-                "artifactMapped": sorted(self._artifact_mapped),
-                "cache": self.fleet.program_cache.to_json()}
+        doc = {"ok": True, "replicaId": self.replica_id,
+               "state": self.state, "pid": os.getpid(),
+               "models": self.fleet.registry.list(),
+               "queueDepths": self.fleet.queue_depths(),
+               "postWarmupCompiles": post_warmup,
+               "artifactMapped": sorted(self._artifact_mapped),
+               "cache": self.fleet.program_cache.to_json()}
+        if self.http is not None and self.http.dedupe is not None:
+            # idempotency proof surface: the chaos bench checks
+            # fleet-wide sum(dedupe.scored) == distinct requests
+            doc["dedupe"] = self.http.dedupe.to_json()
+        if self.fleet.tenancy_store is not None:
+            doc["tenancy"] = self.fleet.tenancy_store.to_json()
+        return doc
 
     def _drain(self, timeout_s: float = 30.0) -> dict:
         """Quiesce: wait (bounded) for every lane's admission queue to
@@ -347,6 +368,20 @@ def main(argv=None) -> int:
                          "representative request row (pre-compiles "
                          "padding buckets and publishes the artifact "
                          "manifest)")
+    ap.add_argument("--tenancy", action="store_true",
+                    help="multi-tenant tiering: register checkpoints "
+                         "COLD (stat-only), demand-page on first "
+                         "score, demote under the RAM budget")
+    ap.add_argument("--tenancy-ram-budget-mb", type=float, default=None,
+                    help="host-RAM budget for decoded model records "
+                         "(default: TRANSMOGRIFAI_MODEL_RAM_BUDGET "
+                         "env / unbounded)")
+    ap.add_argument("--tenant-rate", type=float, default=None,
+                    help="per-tenant admission tokens/s (0 disables "
+                         "the fairness gate; default 200)")
+    ap.add_argument("--tenancy-prewarm-top-k", type=int, default=0,
+                    help="prewarm this many hottest models per daemon "
+                         "tick (0 = no prewarm daemon)")
     args = ap.parse_args(argv)
     warm = None
     if args.warmup:
@@ -360,6 +395,17 @@ def main(argv=None) -> int:
         "wire": args.wire}
     if args.shadow_tolerance is not None:
         fleet_kwargs["shadow_tolerance"] = args.shadow_tolerance
+    if args.tenancy:
+        from transmogrifai_tpu.tenancy import TenancyConfig
+        budget = None
+        if args.tenancy_ram_budget_mb is not None:
+            budget = int(args.tenancy_ram_budget_mb * (1 << 20))
+        rate = args.tenant_rate
+        fleet_kwargs["tenancy"] = TenancyConfig(
+            ram_budget_bytes=budget,
+            rate_per_s=(None if rate == 0 else rate) if rate is not None
+            else 200.0,
+            prewarm_top_k=args.tenancy_prewarm_top_k)
     worker = ReplicaWorker(
         args.model_dir, args.state_dir, args.replica_id,
         port=args.port, host=args.host,

@@ -22,10 +22,30 @@ from desyncing:
   :meth:`AsyncHTTPServer.run_blocking`.
 - ``TCP_NODELAY`` on every connection: replies are single small
   documents; a delayed-ACK stall per request is pure loss.
+- **slow-client defenses** (the netchaos failure domain): the first
+  request line must arrive within ``idle_timeout_s`` (idle keep-alive
+  reaping), and once it does the REST of the request — headers and
+  body — must complete within ``read_timeout_s`` or the client is shed
+  with 408 and a hard teardown. A slowloris trickling one byte per
+  second therefore holds exactly one connection slot for one deadline,
+  never pins the event loop, and never starves framed traffic.
+- **write deadlines**: every reply ``drain()`` is bounded by
+  ``write_timeout_s``; a dead or black-holed peer gets its transport
+  aborted instead of parking a coroutine (and its buffer) forever.
+- **bounded accept**: at most ``max_connections`` concurrent
+  connections; excess connects are shed with ``503 + Retry-After``
+  instead of queueing unboundedly behind a flood.
 
 The public surface mirrors the old servers': synchronous ``start()`` /
 ``stop()`` and a ``port`` property, so owners (MetricsServer, Router,
 the stub worker) keep their APIs unchanged.
+
+This module also hosts the two tiny network-robustness primitives the
+rest of the data plane shares (they must stay importable from the
+jax-free stub worker): :data:`net_counters`, the process-global
+``transmogrifai_net_*`` accounting every Prometheus registry exports,
+and :class:`DedupeRing`, the idempotency-key ring replicas use so a
+router's retried frame is never double-scored (see docs/WIRE.md).
 
 Deliberately jax-free and framework-free: the stub worker imports this
 plus ``scaleout/wire.py`` and nothing else.
@@ -36,12 +56,13 @@ from __future__ import annotations
 import asyncio
 import socket
 import threading
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
-__all__ = ["AsyncHTTPServer", "Request", "Response",
-           "DEFAULT_MAX_BODY_BYTES"]
+__all__ = ["AsyncHTTPServer", "Request", "Response", "DedupeRing",
+           "NetCounters", "net_counters", "DEFAULT_MAX_BODY_BYTES"]
 
 #: default request-body bound (bytes) — one JSON request row or one
 #: columnar frame, with slack
@@ -51,9 +72,143 @@ DEFAULT_MAX_BODY_BYTES = 1 << 20
 MAX_HEADER_BYTES = 32 << 10
 
 _REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
-           409: "Conflict", 411: "Length Required",
-           413: "Request Entity Too Large", 500: "Internal Server Error",
-           503: "Service Unavailable", 504: "Gateway Timeout"}
+           408: "Request Timeout", 409: "Conflict",
+           411: "Length Required", 413: "Request Entity Too Large",
+           500: "Internal Server Error", 503: "Service Unavailable",
+           504: "Gateway Timeout"}
+
+#: Retry-After advertised by the connection gate's 503 shed
+SHED_RETRY_AFTER_S = 1
+
+
+class NetCounters:
+    """Process-global network-robustness accounting, exported as
+    ``transmogrifai_net_*`` on EVERY Prometheus registry (the network
+    failure domain is process-wide, like the flight recorder's own
+    counters). Plain attribute increments — GIL-atomic, same idiom as
+    the serving metrics objects."""
+
+    FIELDS = ("accepted", "shed_connections", "slow_clients_shed",
+              "idle_closed", "write_timeouts", "faults_injected",
+              "dedupe_hits", "dedupe_waits", "hedges", "resets_retried",
+              "refusals_spilled")
+
+    def __init__(self) -> None:
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def to_json(self) -> dict:
+        out = {}
+        for f in self.FIELDS:
+            head, *rest = f.split("_")
+            out[head + "".join(p.title() for p in rest)] = \
+                getattr(self, f)
+        return out
+
+
+#: the process-global instance (import and increment; never re-bind)
+net_counters = NetCounters()
+
+
+def _emit_net(kind: str, **attrs) -> None:
+    """Flight-recorder emission, imported lazily so the stub worker's
+    import set stays tiny and a broken recorder can't break the wire."""
+    try:
+        from transmogrifai_tpu.utils.events import events
+        events.emit(kind, **attrs)
+    except Exception:  # noqa: BLE001 — observability must not break serving
+        pass
+
+
+class _DedupeEntry:
+    __slots__ = ("event", "response")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.response: Optional[Response] = None
+
+
+class DedupeRing:
+    """Bounded idempotency-key ring: ``request_id -> cached 2xx reply``.
+
+    A router that retries a mid-request reset cannot know whether the
+    upstream already scored the frame — the reply may have died on the
+    wire AFTER the work was done. Replicas therefore keep this small
+    ring keyed by the request's idempotency key (``X-Request-Id``
+    header / frame-meta ``request_id``): a retried frame is answered
+    from the ring instead of being scored twice, and a retry racing the
+    original waits for the in-flight result instead of double-running
+    it.
+
+    Only SUCCESSFUL (cached) executions count toward ``scored`` — so a
+    fleet-wide ``sum(scored) == distinct requests`` equality is the
+    bench's proof of zero double-scores AND zero drops. Failed attempts
+    are abandoned (entry removed, waiters released) so the client's
+    retry can re-execute legitimately.
+    """
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _DedupeEntry]" = OrderedDict()
+        self.hits = 0        # answered from cache
+        self.waits = 0       # coalesced onto an in-flight execution
+        self.scored = 0      # actual completed executions
+        self.evicted = 0
+
+    def begin(self, request_id: str):
+        """Claim ``request_id``. Returns one of:
+
+        - ``("mine", entry)`` — caller executes, then MUST call
+          :meth:`complete` or :meth:`abandon` with the entry;
+        - ``("hit", response)`` — a finished duplicate: reply directly;
+        - ``("wait", entry)`` — an in-flight duplicate: wait on
+          ``entry.event`` (off-loop!), then re-check ``entry.response``.
+        """
+        with self._lock:
+            e = self._entries.get(request_id)
+            if e is None:
+                e = _DedupeEntry()
+                self._entries[request_id] = e
+                while len(self._entries) > self.capacity:
+                    # evict oldest COMPLETED entry; skip in-flight ones
+                    for k, old in self._entries.items():
+                        if old.response is not None or old is e:
+                            break
+                    if old is e:  # ring full of in-flight work: give up
+                        break
+                    del self._entries[k]
+                    self.evicted += 1
+                return ("mine", e)
+            if e.response is not None:
+                self.hits += 1
+                net_counters.dedupe_hits += 1
+                return ("hit", e.response)
+            self.waits += 1
+            net_counters.dedupe_waits += 1
+            return ("wait", e)
+
+    def complete(self, request_id: str, entry: _DedupeEntry,
+                 response: "Response") -> None:
+        with self._lock:
+            entry.response = response
+            self.scored += 1
+        entry.event.set()
+
+    def abandon(self, request_id: str, entry: _DedupeEntry) -> None:
+        """The execution failed before producing a cacheable reply:
+        forget the key so a client retry can legitimately re-run."""
+        with self._lock:
+            if self._entries.get(request_id) is entry:
+                del self._entries[request_id]
+        entry.event.set()
+
+    def to_json(self) -> dict:
+        with self._lock:
+            size = len(self._entries)
+        return {"hits": self.hits, "waits": self.waits,
+                "scored": self.scored, "evicted": self.evicted,
+                "size": size, "capacity": self.capacity}
 
 
 @dataclass
@@ -110,9 +265,21 @@ class AsyncHTTPServer:
                  port: int = 0, host: str = "127.0.0.1",
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  name: str = "transmogrifai-http",
-                 executor_workers: int = 32):
+                 executor_workers: int = 32,
+                 idle_timeout_s: float = 75.0,
+                 read_timeout_s: float = 30.0,
+                 write_timeout_s: float = 30.0,
+                 max_connections: int = 1024):
         self.handler = handler
         self.max_body_bytes = int(max_body_bytes)
+        #: keep-alive idle bound: how long a connection may sit between
+        #: requests (and how long the FIRST request line may take)
+        self.idle_timeout_s = float(idle_timeout_s)
+        #: slow-client bound: once the request line lands, the rest of
+        #: the request (headers + body) must complete within this
+        self.read_timeout_s = float(read_timeout_s)
+        self.write_timeout_s = float(write_timeout_s)
+        self.max_connections = int(max_connections)
         self._host = host
         self._requested_port = int(port)
         self._name = name
@@ -214,17 +381,45 @@ class AsyncHTTPServer:
             self._executor, fn, *args)
 
     # -- protocol ------------------------------------------------------------
+    async def _bounded(self, aw, deadline: float):
+        """Await ``aw`` under the request's read deadline; a client that
+        trickles past it is shed with 408 (counted + flight-recorded)."""
+        remaining = deadline - asyncio.get_running_loop().time()
+        if remaining > 0:
+            try:
+                return await asyncio.wait_for(aw, remaining)
+            except asyncio.TimeoutError:
+                pass
+        else:
+            # consume the coroutine so asyncio doesn't warn
+            asyncio.ensure_future(aw).cancel()
+        net_counters.slow_clients_shed += 1
+        _emit_net("net.slow_client_shed", reason="read_deadline",
+                  server=self._name, timeoutS=self.read_timeout_s)
+        raise _BadRequest(Response.error(
+            408, f"request not completed within "
+                 f"{self.read_timeout_s:g}s"))
+
     async def _read_request(self, reader) -> Optional[Request]:
         """One request off the stream, or None at clean EOF. Raises
         ``_BadRequest`` carrying the refusal reply for protocol-level
-        errors (bad Content-Length, chunked, oversized)."""
+        errors (bad Content-Length, chunked, oversized, slow-client
+        deadline). The FIRST line is bounded by the keep-alive idle
+        timeout; everything after it by ``read_timeout_s``."""
         try:
-            line = await reader.readline()
+            line = await asyncio.wait_for(reader.readline(),
+                                          self.idle_timeout_s)
+        except asyncio.TimeoutError:
+            # nothing (or a partial line) arrived within the idle bound:
+            # reap the parked connection silently
+            net_counters.idle_closed += 1
+            return None
         except (asyncio.LimitOverrunError, ValueError):
             raise _BadRequest(Response.error(
                 400, "request line too long")) from None
         if not line:
             return None
+        deadline = asyncio.get_running_loop().time() + self.read_timeout_s
         try:
             parts = line.decode("latin-1").rstrip("\r\n").split()
             method, target = parts[0], parts[1]
@@ -235,7 +430,7 @@ class AsyncHTTPServer:
         total = len(line)
         while True:
             try:
-                hline = await reader.readline()
+                hline = await self._bounded(reader.readline(), deadline)
             except (asyncio.LimitOverrunError, ValueError):
                 raise _BadRequest(Response.error(
                     400, "header line too long")) from None
@@ -272,7 +467,8 @@ class AsyncHTTPServer:
         body = b""
         if n:
             try:
-                body = await reader.readexactly(n)
+                body = await self._bounded(reader.readexactly(n),
+                                           deadline)
             except asyncio.IncompleteReadError:
                 return None  # client died mid-body: nothing to answer
         return Request(method, target, headers, body)
@@ -293,6 +489,29 @@ class AsyncHTTPServer:
         return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") \
             + resp.body
 
+    async def _drain(self, writer) -> bool:
+        """Bounded reply flush. Returns False (after aborting the
+        transport) when the peer would not take our bytes within
+        ``write_timeout_s`` — the dead-peer / black-holed-client case."""
+        try:
+            await asyncio.wait_for(writer.drain(), self.write_timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            net_counters.write_timeouts += 1
+            _emit_net("net.slow_client_shed", reason="write_deadline",
+                      server=self._name, timeoutS=self.write_timeout_s)
+            self._abort(writer)
+            return False
+
+    @staticmethod
+    def _abort(writer) -> None:
+        """Hard transport teardown: no lingering buffers for a peer that
+        already proved it will not cooperate."""
+        try:
+            writer.transport.abort()
+        except Exception:  # noqa: BLE001 — transport already gone
+            pass
+
     async def _serve_connection(self, reader, writer) -> None:
         sock = writer.get_extra_info("socket")
         if sock is not None:
@@ -301,14 +520,34 @@ class AsyncHTTPServer:
                                 1)
             except OSError:
                 pass
+        if len(self._writers) >= self.max_connections:
+            # bounded accept: shed instead of queueing unboundedly. The
+            # 503 carries Retry-After so well-behaved clients back off.
+            net_counters.shed_connections += 1
+            _emit_net("net.slow_client_shed", reason="connection_gate",
+                      server=self._name, limit=self.max_connections)
+            resp = Response.error(
+                503, f"connection limit {self.max_connections} reached")
+            resp.headers["Retry-After"] = str(SHED_RETRY_AFTER_S)
+            try:
+                writer.write(self._render(resp))
+                await self._drain(writer)
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                self._abort(writer)
+            return
+        net_counters.accepted += 1
         self._writers.add(writer)
+        shed = False
         try:
             while True:
                 try:
                     req = await self._read_request(reader)
                 except _BadRequest as e:
+                    shed = e.response.status == 408
                     writer.write(self._render(e.response))
-                    await writer.drain()
+                    await self._drain(writer)
                     break
                 if req is None:
                     break
@@ -321,13 +560,18 @@ class AsyncHTTPServer:
                     req.header("connection", "").lower() == "close"
                 resp.close = want_close
                 writer.write(self._render(resp))
-                await writer.drain()
+                if not await self._drain(writer):
+                    break
                 if want_close:
                     break
         except (ConnectionError, asyncio.CancelledError, OSError):
             pass
         finally:
             self._writers.discard(writer)
+            if shed:
+                # a shed slow client gets a hard abort so its window of
+                # unread bytes can't keep the socket half-alive
+                self._abort(writer)
             try:
                 writer.close()
             except Exception:  # noqa: BLE001 — socket already dead

@@ -63,18 +63,41 @@ import time
 from typing import Callable, Optional
 
 from transmogrifai_tpu.serving.aiohttp_core import (
-    AsyncHTTPServer, Request, Response,
+    AsyncHTTPServer, DedupeRing, Request, Response,
 )
 from transmogrifai_tpu.utils.events import events
 from transmogrifai_tpu.utils.prometheus import CONTENT_TYPE
 from transmogrifai_tpu.utils.tracing import new_trace_id, sanitize_trace_id
 
-__all__ = ["MetricsServer", "TRACE_HEADER", "MAX_BODY_BYTES",
-           "CONTENT_TYPE_FRAME", "CONTENT_TYPE_NDJSON"]
+__all__ = ["MetricsServer", "TRACE_HEADER", "REQUEST_ID_HEADER",
+           "MAX_BODY_BYTES", "CONTENT_TYPE_FRAME", "CONTENT_TYPE_NDJSON"]
 
 #: the request/response trace-context header (Dapper/B3-style: honor an
 #: inbound id so a caller's trace continues through this hop)
 TRACE_HEADER = "X-Trace-Id"
+
+#: the idempotency-key header (docs/WIRE.md): requests carrying one are
+#: deduped by the replica's ring, so a router's mid-request-reset retry
+#: is answered from cache instead of scored twice
+REQUEST_ID_HEADER = "X-Request-Id"
+
+#: how long a duplicate waits for its in-flight original before giving
+#: up with 504 (never 503: a 503 would invite the router to spill the
+#: duplicate to another replica WHILE the original still scores here)
+DEDUPE_WAIT_S = 30.0
+
+
+def sanitize_request_id(rid) -> Optional[str]:
+    """A usable idempotency key, or None. Bounded printable token —
+    the key is echoed into headers and ring memory, so it must not
+    carry newlines or unbounded junk."""
+    if not isinstance(rid, str):
+        return None
+    rid = rid.strip()
+    if not rid or len(rid) > 128 or not rid.isprintable() \
+            or " " in rid:
+        return None
+    return rid
 
 #: hard ceiling on sampled access-log events per second
 ACCESS_LOG_MAX_PER_S = 100
@@ -101,9 +124,26 @@ class MetricsServer:
                  max_body_bytes: int = MAX_BODY_BYTES,
                  frame_fn: Optional[Callable[
                      [Optional[str], bytes, Optional[str]],
-                     bytes]] = None):
+                     bytes]] = None,
+                 dedupe_capacity: int = 512,
+                 idle_timeout_s: Optional[float] = None,
+                 read_timeout_s: Optional[float] = None,
+                 write_timeout_s: Optional[float] = None,
+                 max_connections: Optional[int] = None):
         self.render_fn = render_fn
         self.health_fn = health_fn
+        #: idempotency ring for requests carrying X-Request-Id / frame
+        #: meta request_id (0 disables — scrape-only endpoints)
+        self.dedupe = DedupeRing(dedupe_capacity) \
+            if dedupe_capacity > 0 else None
+        #: slow-client / connection-gate overrides (None = the shared
+        #: core's defaults; see aiohttp_core.AsyncHTTPServer)
+        self._net_overrides = {
+            k: v for k, v in (("idle_timeout_s", idle_timeout_s),
+                              ("read_timeout_s", read_timeout_s),
+                              ("write_timeout_s", write_timeout_s),
+                              ("max_connections", max_connections))
+            if v is not None}
         #: ``score_fn(model_id_or_None, row, trace_id) -> score doc``;
         #: None disables the POST /score routes (scrape-only endpoint)
         self.score_fn = score_fn
@@ -166,7 +206,8 @@ class MetricsServer:
         self._http = AsyncHTTPServer(
             self._handle, port=self._requested_port, host=self._host,
             max_body_bytes=self.max_body_bytes,
-            name="transmogrifai-metrics-http").start()
+            name="transmogrifai-metrics-http",
+            **self._net_overrides).start()
         return self
 
     def stop(self) -> None:
@@ -225,12 +266,71 @@ class MetricsServer:
             req.header(TRACE_HEADER)) or new_trace_id()
         ctype = (req.header("content-type") or "").split(";")[0].strip()
         if ctype == CONTENT_TYPE_FRAME:
-            return await self._score_frame(req, path, model_id,
-                                           trace_id, t0)
-        if ctype == CONTENT_TYPE_NDJSON:
-            return await self._score_ndjson(req, path, model_id,
-                                            trace_id, t0)
-        return await self._score_json(req, path, model_id, trace_id, t0)
+            run = self._score_frame
+        elif ctype == CONTENT_TYPE_NDJSON:
+            run = self._score_ndjson
+        else:
+            run = self._score_json
+        request_id = sanitize_request_id(req.header(REQUEST_ID_HEADER))
+        if request_id is None and ctype == CONTENT_TYPE_FRAME:
+            from transmogrifai_tpu.serving.wireformat import (
+                peek_request_id,
+            )
+            request_id = sanitize_request_id(peek_request_id(req.body))
+        if self.dedupe is None or request_id is None:
+            return await run(req, path, model_id, trace_id, t0)
+        return await self._deduped(
+            request_id, trace_id,
+            lambda: run(req, path, model_id, trace_id, t0))
+
+    async def _deduped(self, request_id: str, trace_id: str,
+                       run) -> Response:
+        """Execute ``run()`` under the idempotency ring: a repeated key
+        is answered from cache ("this exact request was already scored
+        — here is that reply"), a key racing its in-flight original
+        waits for the original's result. Only 2xx replies are cached;
+        failures abandon the key so a legitimate client retry can
+        re-execute. Replies always travel as COPIES — the connection
+        loop mutates ``Response.close`` on whatever it returns, and a
+        cached object must never absorb that."""
+
+        def copy_of(resp: Response, dedupe: str) -> Response:
+            return Response(resp.status, resp.body, resp.ctype,
+                            {**resp.headers,
+                             REQUEST_ID_HEADER: request_id,
+                             "X-Dedupe": dedupe})
+
+        for _ in range(2):
+            tag, obj = self.dedupe.begin(request_id)
+            if tag == "hit":
+                return copy_of(obj, "hit")
+            if tag == "wait":
+                # park OFF the event loop; when the original finishes
+                # (or abandons), re-enter begin() for the verdict
+                done = await self._http.run_blocking(
+                    obj.event.wait, DEDUPE_WAIT_S)
+                if not done:
+                    break
+                continue
+            entry = obj
+            try:
+                resp = await run()
+            except BaseException:
+                self.dedupe.abandon(request_id, entry)
+                raise
+            if 200 <= resp.status < 300:
+                self.dedupe.complete(request_id, entry, copy_of(
+                    resp, "original"))
+            else:
+                self.dedupe.abandon(request_id, entry)
+            return copy_of(resp, "original")
+        body = (json.dumps(
+            {"error": f"duplicate of in-flight request "
+                      f"{request_id} timed out waiting for the "
+                      f"original", "traceId": trace_id}) + "\n").encode()
+        return Response(504, body, "application/json",
+                        {TRACE_HEADER: trace_id,
+                         REQUEST_ID_HEADER: request_id})
 
     def _err_json(self, code: int, e: BaseException, trace_id: str,
                   extra: Optional[dict] = None) -> Response:

@@ -63,7 +63,8 @@ __all__ = [
     "KIND_REQUEST", "KIND_REPLY", "KIND_ERROR",
     "F64", "F32", "I64", "I32", "BOOL", "TEXT", "JSONCOL",
     "WireFormatError", "WireColumn", "WireFrame",
-    "encode_frame", "decode_frame", "peek_model_id",
+    "encode_frame", "decode_frame", "peek_model_id", "peek_meta",
+    "peek_request_id",
     "encode_rows", "rows_to_columns", "reply_columns",
     "rows_to_reply_columns", "reply_to_rows", "frame_to_rows",
 ]
@@ -267,6 +268,45 @@ def peek_model_id(buf: bytes) -> str:
                          + mid_len]).decode("utf-8")
     except UnicodeDecodeError as e:
         raise WireFormatError(f"model id not utf-8: {e}") from None
+
+
+def peek_meta(buf: bytes) -> dict:
+    """The frame's meta dict, read WITHOUT touching any column — the
+    replica's idempotency hook (``meta["request_id"]``) and the
+    router's, when a client stamped the key in-band instead of in the
+    ``X-Request-Id`` header. Validates magic/version/lengths only as
+    far as the meta blob reaches."""
+    _need(buf, 0, MODEL_ID_OFFSET, "header")
+    (magic, version, kind, mid_len, n_rows, n_cols,
+     meta_len) = _HEADER.unpack_from(buf, 4)
+    if magic != MAGIC:
+        raise WireFormatError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise WireFormatError(f"unsupported frame version {version}")
+    if not meta_len:
+        return {}
+    at = MODEL_ID_OFFSET + mid_len
+    _need(buf, at, meta_len, "meta")
+    try:
+        meta = json.loads(bytes(buf[at:at + meta_len]))
+    except ValueError as e:
+        raise WireFormatError(f"frame meta not JSON: {e}") from None
+    if not isinstance(meta, dict):
+        raise WireFormatError("frame meta must be a JSON object")
+    return meta
+
+
+def peek_request_id(buf: bytes):
+    """``meta["request_id"]`` if the frame carries one (str, bounded),
+    else None. Never raises: a frame too mangled to peek returns None
+    and fails loudly later in :func:`decode_frame`."""
+    try:
+        rid = peek_meta(buf).get("request_id")
+    except Exception:  # noqa: BLE001 — peek is best-effort
+        return None
+    if isinstance(rid, str) and 0 < len(rid) <= 128:
+        return rid
+    return None
 
 
 def decode_frame(buf: bytes) -> WireFrame:

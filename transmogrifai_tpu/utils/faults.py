@@ -51,7 +51,19 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``scaleout.roll``         one replica step of a rolling hot-swap (a fault
                           here halts the roll and rolls already-swapped
                           replicas back to the old version)
+``net.accept``            one accepted client connection at a netchaos
+                          proxy (``utils/netchaos.py``)
+``net.connect``           one upstream dial by a netchaos proxy
+``net.read``              one request-direction socket read at a proxy
+``net.write``             one reply-direction socket write at a proxy
 ========================  ====================================================
+
+The four ``net.*`` sites take the NETWORK fault kinds (``delay`` |
+``reset`` | ``refuse`` | ``split`` | ``truncate`` | ``corrupt`` |
+``blackhole``) and are delivered at the socket layer by
+:class:`transmogrifai_tpu.utils.netchaos.ChaosProxy` rather than raised
+in-frame — one plan string (one env var) drives both layers, e.g.
+``transient@scaleout.route#1;reset@net.write#3``.
 
 Plan syntax (env ``TRANSMOGRIFAI_FAULT_PLAN`` or programmatic), entries
 separated by ``;``::
@@ -92,7 +104,8 @@ from typing import Optional
 
 __all__ = ["FaultPlan", "FaultSpec", "FaultHarnessError",
            "SimulatedPreemption", "XlaRuntimeError", "fault_point",
-           "install_plan", "clear_plan", "active_plan", "fault_plan"]
+           "install_plan", "clear_plan", "active_plan", "fault_plan",
+           "NET_KINDS", "NET_SITES"]
 
 #: the instrumented site names (documentation + parse-time validation)
 KNOWN_SITES = frozenset({
@@ -103,9 +116,21 @@ KNOWN_SITES = frozenset({
     "continuous.trigger",
     "continuous.retrain", "continuous.promote", "events.spill",
     "scaleout.route", "scaleout.heartbeat", "scaleout.roll",
+    "net.accept", "net.connect", "net.read", "net.write",
 })
 
+#: the socket-layer sites (delivered by utils/netchaos.py, never raised
+#: in-frame by fault_point)
+NET_SITES = frozenset({"net.accept", "net.connect", "net.read",
+                       "net.write"})
+
 KINDS = ("transient", "io", "slow", "preempt", "oom", "enospc")
+
+#: network fault kinds — only valid at NET_SITES, and NET_SITES only
+#: take these: the pairing is enforced at parse time so a typo'd plan
+#: fails loudly instead of silently never firing
+NET_KINDS = ("delay", "reset", "refuse", "split", "truncate", "corrupt",
+             "blackhole")
 
 
 class FaultHarnessError(Exception):
@@ -137,11 +162,17 @@ class FaultSpec:
 
     def __init__(self, kind: str, site: str, at: int = 0, times: int = 1,
                  delay_s: float = 1.0, prob: Optional[float] = None):
-        if kind not in KINDS:
-            raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+        if kind not in KINDS and kind not in NET_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of "
+                             f"{KINDS + NET_KINDS}")
         if site not in KNOWN_SITES:
             raise ValueError(
                 f"unknown fault site {site!r}; one of {sorted(KNOWN_SITES)}")
+        if (site in NET_SITES) != (kind in NET_KINDS):
+            raise ValueError(
+                f"fault kind {kind!r} does not pair with site {site!r}: "
+                f"net.* sites take {NET_KINDS}, framework sites take "
+                f"{KINDS}")
         self.kind = kind
         self.site = site
         self.at = int(at)
@@ -225,10 +256,28 @@ class FaultPlan:
             inv = self.invocations.get(site, 0)
             self.invocations[site] = inv + 1
             to_fire = [s for s in self.specs if s.site == site
+                       and s.kind not in NET_KINDS
                        and s.should_fire(inv, self._rng)]
         for s in to_fire:
             self.fired.append((site, inv, s.kind))
             _inject(s, site, inv)
+
+    def net_check(self, site: str) -> list:
+        """Count one invocation of a ``net.*`` site and return the
+        network fault specs scheduled for it. Nothing is raised here —
+        the netchaos proxy DELIVERS the returned specs at the socket
+        layer (reset, truncation, corruption, ...). Each returned spec
+        is recorded in ``fired`` exactly like a framework injection, so
+        determinism assertions cover both layers."""
+        with self._lock:
+            inv = self.invocations.get(site, 0)
+            self.invocations[site] = inv + 1
+            to_fire = [s for s in self.specs if s.site == site
+                       and s.kind in NET_KINDS
+                       and s.should_fire(inv, self._rng)]
+            for s in to_fire:
+                self.fired.append((site, inv, s.kind))
+        return to_fire
 
 
 def _inject(spec: FaultSpec, site: str, inv: int) -> None:

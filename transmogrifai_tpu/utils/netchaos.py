@@ -1,0 +1,410 @@
+"""Deterministic in-process TCP fault proxy: the network failure domain.
+
+``utils/faults.py`` injects failures INSIDE a frame — a raised
+exception, a stall, a simulated preemption. But the failures a
+millions-of-users serving deployment sees first live BELOW the frame:
+slow clients trickling headers, connections reset mid-frame, partial
+writes that truncate a binary reply, flapping upstreams refusing
+connects, black-holed peers that accept bytes and never answer. None of
+those can be expressed as an exception at a ``fault_point`` — they have
+to happen to real sockets carrying real bytes.
+
+:class:`ChaosProxy` is a seeded, deterministic TCP proxy that sits
+between any two hops of the data plane (client -> router, router ->
+replica) and delivers network faults scheduled by the SAME
+:class:`~transmogrifai_tpu.utils.faults.FaultPlan` grammar the rest of
+the chaos harness uses — one plan string (one
+``TRANSMOGRIFAI_FAULT_PLAN`` env var) drives both layers::
+
+    reset@net.write#3          RST the connection on the 4th reply write
+    truncate@net.write#5       forward half the reply bytes, then RST
+    corrupt@net.read%0.01      seeded 1% per-read byte corruption
+    delay@net.read:0.05        50 ms of added latency (with seeded jitter)
+    refuse@net.connect#2x2     refuse the 3rd and 4th upstream dials
+    blackhole@net.read#7       swallow a request and stall the socket
+    split@net.write            dribble a reply out byte-by-byte
+
+Sites count PER PROXY-WIDE invocation under the plan lock, so with
+sequential traffic the ``plan.fired`` log is exactly reproducible: same
+plan + same seed + same request sequence => same fired log (the
+determinism contract tests assert on).
+
+Fault kinds (``faults.NET_KINDS``) and where each is delivered:
+
+==============  ==============================================================
+``delay``       sleep ``:delay_s`` (seeded ±50% jitter) before forwarding
+                (sites: accept, connect, read, write)
+``reset``       hard RST (``SO_LINGER 0`` close) of both legs — the
+                mid-request reset a retrying router must treat as
+                "maybe delivered" (accept, read, write)
+``refuse``      close the client leg before the upstream dial — the
+                flapping-upstream analog (connect, accept)
+``split``       forward the chunk one byte at a time for the first 8
+                bytes, then the rest — exercises short-read handling in
+                every framed reader (read, write)
+``truncate``    forward only the first half of the chunk, then RST —
+                a mid-frame truncation the wire codec must refuse
+                loudly (read, write)
+``corrupt``     flip one seeded byte of the chunk — exercises magic /
+                length validation (read, write)
+``blackhole``   stop forwarding and hold BOTH sockets open silently
+                until the peer's deadline fires or the proxy stops —
+                the dead-peer stall that bounded reads/writes must
+                shed (accept, connect, read, write)
+==============  ==============================================================
+
+The proxy is threads + blocking sockets on purpose: it must be able to
+wrap the asyncio front without sharing its event loop (a stalled proxy
+thread models a stalled NETWORK, not a stalled server), and it must be
+spawnable per-test in microseconds. Every delivered fault emits a
+``net.fault`` flight-recorder event and increments
+``net_counters.faults_injected`` (``serving/aiohttp_core.py``) so chaos
+runs are self-explaining in an incident dump.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from transmogrifai_tpu.utils.faults import (
+    FaultPlan,
+    NET_KINDS,
+    active_plan,
+)
+
+__all__ = ["ChaosProxy", "NET_KINDS"]
+
+#: recv chunk size — small enough that multi-KB frames span several
+#: ``net.read``/``net.write`` invocations (so mid-frame faults exist)
+CHUNK = 16 << 10
+
+#: blackhole park poll interval (the stall ends when the proxy stops)
+_PARK_POLL_S = 0.05
+
+
+class _Abort(Exception):
+    """Internal: the current connection was chaos-terminated."""
+
+
+def _rst_close(sock: Optional[socket.socket]) -> None:
+    """Tear ``sock`` down abruptly: SO_LINGER 0 + shutdown + close, so
+    the peer sees the connection die mid-exchange (RST, or FIN-then-RST
+    when the shutdown races the close) exactly like a crashed or
+    NAT-expired middlebox. The ``shutdown`` is load-bearing, not
+    cosmetic: another proxy thread may be blocked in ``recv`` on this
+    very socket, and a bare ``close`` would leave the kernel socket
+    alive (the blocked read holds a file reference) — the peer would
+    then see NOTHING until its own deadline fired, turning an injected
+    reset into an accidental blackhole. Best-effort: the socket may
+    already be gone."""
+    if sock is None:
+        return
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class ProxyStats:
+    """Plain counters (GIL-atomic increments, same idiom as the serving
+    metrics objects)."""
+
+    def __init__(self) -> None:
+        self.connections = 0
+        self.upstream_dials = 0
+        self.bytes_up = 0        # client -> upstream
+        self.bytes_down = 0      # upstream -> client
+        self.faults_delivered = 0
+        self.by_kind: dict[str, int] = {}
+
+    def fault(self, kind: str) -> None:
+        self.faults_delivered += 1
+        self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+
+    def to_json(self) -> dict:
+        return {
+            "connections": self.connections,
+            "upstreamDials": self.upstream_dials,
+            "bytesUp": self.bytes_up,
+            "bytesDown": self.bytes_down,
+            "faultsDelivered": self.faults_delivered,
+            "byKind": dict(self.by_kind),
+        }
+
+
+class ChaosProxy:
+    """A TCP proxy that forwards ``host:port`` -> ``upstream`` while
+    delivering the active :class:`FaultPlan`'s ``net.*`` entries at the
+    socket layer.
+
+    ::
+
+        plan = FaultPlan.parse("reset@net.write#2;delay@net.read:0.05",
+                               seed=7)
+        proxy = ChaosProxy(replica_port, plan=plan).start()
+        router.set_replicas([ReplicaEndpoint("r0", port=proxy.port)])
+        ...
+        proxy.stop()
+        assert ("net.write", 2, "reset") in plan.fired
+
+    ``plan=None`` resolves :func:`active_plan` PER CONNECTION, so a proxy
+    started before ``fault_plan(...)`` enters still sees the scoped
+    plan — and a proxy with no plan at all is a transparent (if
+    unflattering) byte pump.
+    """
+
+    def __init__(self, upstream_port: int,
+                 upstream_host: str = "127.0.0.1", *,
+                 plan: Optional[FaultPlan] = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 name: str = "netchaos",
+                 connect_timeout_s: float = 5.0):
+        self.upstream = (upstream_host, int(upstream_port))
+        self.host = host
+        self.name = name
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._explicit_plan = plan
+        self.port = int(port)
+        self.stats = ProxyStats()
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._conn_lock = threading.Lock()
+        self._live: set[socket.socket] = set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ChaosProxy":
+        srv = socket.create_server((self.host, self.port))
+        srv.settimeout(0.2)  # deadline-ok: accept loop polls _stopping
+        self.port = srv.getsockname()[1]
+        self._listener = srv
+        self._stopping.clear()
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"{self.name}-accept", daemon=True)
+        self._accept_thread = t
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._conn_lock:
+            live = list(self._live)
+            self._live.clear()
+        for s in live:
+            _rst_close(s)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+
+    def __enter__(self) -> "ChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- plan plumbing -------------------------------------------------------
+
+    def _plan(self) -> Optional[FaultPlan]:
+        return self._explicit_plan if self._explicit_plan is not None \
+            else active_plan()
+
+    def _check(self, plan: Optional[FaultPlan], site: str) -> list:
+        if plan is None:
+            return []
+        specs = plan.net_check(site)
+        for s in specs:
+            self._record(plan, site, s)
+        return specs
+
+    def _record(self, plan: FaultPlan, site: str, spec) -> None:
+        self.stats.fault(spec.kind)
+        # lazy imports: netchaos must stay importable from the jax-free
+        # conformance stub without dragging anything heavy in
+        from transmogrifai_tpu.serving.aiohttp_core import net_counters
+        from transmogrifai_tpu.utils.events import events
+        from transmogrifai_tpu.utils.profiling import run_counters
+        net_counters.faults_injected += 1
+        run_counters.faults_injected += 1
+        events.emit("net.fault", proxy=self.name, site=site,
+                    faultKind=spec.kind, upstreamPort=self.upstream[1])
+
+    def _jittered(self, plan: Optional[FaultPlan], delay_s: float) -> float:
+        # ±50% seeded jitter so two delay faults never beat in lockstep;
+        # drawn from the PLAN's rng (under its lock) to stay reproducible
+        if plan is None:
+            return delay_s
+        with plan._lock:
+            return delay_s * (0.5 + plan._rng.random())
+
+    # -- accept / connect ----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                client, _addr = self._listener.accept()  # deadline-ok: 0.2s settimeout armed in start()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us: stop() ran
+            self.stats.connections += 1
+            t = threading.Thread(target=self._serve, args=(client,),
+                                 name=f"{self.name}-conn", daemon=True)
+            t.start()
+
+    def _park(self, *socks: Optional[socket.socket]) -> None:
+        """Blackhole: hold the sockets open, forward nothing, until the
+        proxy stops. The PEER's armed deadline is what ends the stall —
+        that is the point."""
+        while not self._stopping.is_set():
+            time.sleep(_PARK_POLL_S)
+        for s in socks:
+            _rst_close(s)
+
+    def _serve(self, client: socket.socket) -> None:
+        plan = self._plan()
+        upstream: Optional[socket.socket] = None
+        try:
+            client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            for spec in self._check(plan, "net.accept"):
+                if spec.kind == "delay":
+                    time.sleep(self._jittered(plan, spec.delay_s))
+                elif spec.kind in ("reset", "refuse", "truncate",
+                                   "corrupt", "split"):
+                    _rst_close(client)
+                    return
+                elif spec.kind == "blackhole":
+                    self._park(client)
+                    return
+            for spec in self._check(plan, "net.connect"):
+                if spec.kind == "delay":
+                    time.sleep(self._jittered(plan, spec.delay_s))
+                elif spec.kind == "blackhole":
+                    self._park(client)
+                    return
+                else:  # refuse / reset / anything else: no upstream dial
+                    _rst_close(client)
+                    return
+            self.stats.upstream_dials += 1
+            upstream = socket.create_connection(
+                self.upstream, timeout=self.connect_timeout_s)
+            upstream.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            upstream.settimeout(None)
+            client.settimeout(None)
+            with self._conn_lock:
+                self._live.add(client)
+                self._live.add(upstream)
+            # reply pump runs beside us; request pump runs in this thread
+            down = threading.Thread(
+                target=self._pump, name=f"{self.name}-down",
+                args=(upstream, client, "net.write", plan), daemon=True)
+            down.start()
+            self._pump(client, upstream, "net.read", plan)
+            down.join(timeout=5.0)
+        except (_Abort, OSError):
+            pass
+        finally:
+            with self._conn_lock:
+                self._live.discard(client)
+                if upstream is not None:
+                    self._live.discard(upstream)
+            for s in (client, upstream):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+    # -- the byte pump -------------------------------------------------------
+
+    def _pump(self, src: socket.socket, dst: socket.socket, site: str,
+              plan: Optional[FaultPlan]) -> None:
+        """Forward ``src`` -> ``dst`` chunk by chunk, delivering the
+        plan's faults for ``site`` on each chunk."""
+        try:
+            while not self._stopping.is_set():
+                try:
+                    chunk = src.recv(CHUNK)  # deadline-ok: peers armed
+                except OSError:
+                    break
+                if not chunk:
+                    try:  # propagate half-close so HTTP EOF semantics hold
+                        dst.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    break
+                chunk = self._mangle(plan, site, chunk, src, dst)
+                if chunk is None:
+                    break
+                try:
+                    dst.sendall(chunk)
+                except OSError:
+                    break
+                if site == "net.read":
+                    self.stats.bytes_up += len(chunk)
+                else:
+                    self.stats.bytes_down += len(chunk)
+        except _Abort:
+            pass
+
+    def _mangle(self, plan: Optional[FaultPlan], site: str, chunk: bytes,
+                src: socket.socket,
+                dst: socket.socket) -> Optional[bytes]:
+        """Apply scheduled faults to one forwarded chunk. Returns the
+        (possibly corrupted) bytes to forward, or ``None`` when the
+        connection was chaos-terminated."""
+        for spec in self._check(plan, site):
+            if spec.kind == "delay":
+                time.sleep(self._jittered(plan, spec.delay_s))
+            elif spec.kind == "reset" or spec.kind == "refuse":
+                _rst_close(dst)
+                _rst_close(src)
+                return None
+            elif spec.kind == "truncate":
+                half = chunk[: max(1, len(chunk) // 2)]
+                try:
+                    dst.sendall(half)
+                except OSError:
+                    pass
+                _rst_close(dst)
+                _rst_close(src)
+                return None
+            elif spec.kind == "corrupt":
+                if plan is not None:
+                    with plan._lock:
+                        i = plan._rng.randrange(len(chunk))
+                else:
+                    i = len(chunk) // 2
+                chunk = chunk[:i] + bytes([chunk[i] ^ 0xFF]) + chunk[i + 1:]
+            elif spec.kind == "split":
+                head = chunk[:8]
+                try:
+                    for b in head:
+                        dst.sendall(bytes([b]))
+                        time.sleep(0.001)
+                except OSError:
+                    return None
+                chunk = chunk[8:]
+            elif spec.kind == "blackhole":
+                self._park(src, dst)
+                return None
+        return chunk

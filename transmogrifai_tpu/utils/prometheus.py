@@ -246,6 +246,46 @@ def _resource_collectors(reg: PromRegistry) -> None:
         lambda: [({}, 1 if resources.ladder_enabled() else 0)])
 
 
+def _net_collectors(reg: PromRegistry) -> None:
+    """The network data plane's ``transmogrifai_net_*`` surface
+    (``serving/aiohttp_core.net_counters``): slow-client sheds, idle
+    reaps, write-deadline aborts, connection-gate sheds, injected
+    socket faults, idempotency dedupe hits/waits, and the router's
+    hedge/retry classification counters. Carried by EVERY registry —
+    chaos drills read these off whatever endpoint is already
+    scraped."""
+    from transmogrifai_tpu.serving.aiohttp_core import net_counters
+
+    for attr, help_ in (
+            ("accepted", "connections accepted by the event-loop "
+                         "front"),
+            ("shed_connections", "connections shed at the bounded "
+                                 "accept gate (503 + Retry-After)"),
+            ("slow_clients_shed", "requests shed by the header/body "
+                                  "read deadline (slowloris defense; "
+                                  "answered 408)"),
+            ("idle_closed", "idle keep-alive connections reaped "
+                            "silently"),
+            ("write_timeouts", "replies aborted by the write deadline "
+                               "(dead/slow peer)"),
+            ("faults_injected", "socket faults delivered by the "
+                                "netchaos proxy in this process"),
+            ("dedupe_hits", "retried requests answered from the "
+                            "idempotency ring instead of re-scored"),
+            ("dedupe_waits", "duplicate requests that waited on the "
+                             "original in-flight execution"),
+            ("hedges", "tail-latency hedge requests launched to a "
+                       "ring successor"),
+            ("resets_retried", "mid-request transport failures "
+                               "retried under an idempotency key"),
+            ("refusals_spilled", "connect-refused replicas spilled "
+                                 "past immediately (no retry budget "
+                                 "charged)")):
+        reg.register(f"transmogrifai_net_{attr}_total", "counter",
+                     help_,
+                     lambda a=attr: [({}, getattr(net_counters, a))])
+
+
 def _ingest_collectors(reg: PromRegistry) -> None:
     """The fused-ingest/FE surface (round 14, ``utils/profiling.
     IngestCounters``): fused vs host-side FE stage-rows, fused program
@@ -996,7 +1036,16 @@ def _router_collectors(reg: PromRegistry, router) -> None:
             ("no_replica", "no_replica",
              "requests with no routable replica at all"),
             ("rebalances", "rebalances",
-             "skew-triggered ring re-weightings applied")):
+             "skew-triggered ring re-weightings applied"),
+            ("refusals", "refusals",
+             "connect-refused attempts spilled to the next candidate "
+             "(provably undelivered; no retry budget charged)"),
+            ("resets", "resets",
+             "mid-request transport failures retried under the "
+             "request's idempotency key"),
+            ("hedges", "hedges",
+             "tail-latency hedges launched past the replica's "
+             "observed p99")):
         reg.register(f"transmogrifai_router_{name}_total", "counter",
                      help_, lambda a=attr: [({}, getattr(rm, a))])
     if getattr(router, "load_skew", None) is not None:
@@ -1152,6 +1201,7 @@ def build_registry(serving=None, server=None, fleet=None, continuous=None,
     _process_collectors(reg)
     _event_collectors(reg)
     _resource_collectors(reg)
+    _net_collectors(reg)
     _devicewatch_collectors(reg)
     _ingest_collectors(reg)
     if include_app:
