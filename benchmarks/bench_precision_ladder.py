@@ -1,0 +1,322 @@
+"""Precision ladder: bf16/int8 compiled serving vs the f32 master, with
+the shadow gate, compile-storm counters, and the pressure rung measured
+on the live server.
+
+Topology: one small binary AutoML endpoint trained in-process, served
+through ``ScoringServer`` (the same scorer/gate/pressure path the fleet
+lanes run). Legs:
+
+- **throughput**: closed-loop single-row traffic through the f32 lane,
+  then through a bf16-target lane after its shadow gate promoted —
+  ``speedup_bf16_x`` = bf16 rps / f32 rps. On CPU, XLA often emulates
+  bf16, so the speed arm may not clear; the artifact then stands on the
+  residency arm below (``check_artifacts.py`` accepts either).
+- **residency**: replay each rung's REAL per-(layer, bucket) HBM
+  accounting (``layer_entry_bytes``) into a fixed-budget
+  ``ProgramCache`` and count whole models resident before the first
+  eviction: bf16 halves every entry, so the same budget holds ~2x the
+  models. Counter-asserted on cache length, not arithmetic.
+- **parity**: max ``score_diff`` between the f32 master and each
+  promoted rung over PARITY_ROWS held-out rows (acceptance: <= the
+  gate tolerance).
+- **gate_rejection**: a ``serving.precision`` fault poisons the first
+  bf16 candidate — the batch must be SERVED from the f32 shadow leg
+  bit-identically (zero drops), counted as a rejection, and a
+  post-backoff retry must promote.
+- **compile_storm**: post-warmup compiles per (bucket, rung) across the
+  promoted leg — 0 means warmup covered every rung it later served.
+- **pressure**: an injected dispatch OOM on an f32-active lane with
+  bf16 headroom must take the precision rung BEFORE bucket shedding
+  (bucket set unchanged, demotions counter == 1).
+
+Platform honesty: the artifact records the measured backend verbatim;
+``PRECISION_EXPECT_ACCEL=1`` makes a CPU fallback a hard error instead
+of a mislabeled "accelerator" result.
+
+Run: ``python benchmarks/bench_precision_ladder.py``. Knobs:
+PRECISION_REQUESTS, PRECISION_TRAIN_ROWS, PRECISION_MAX_BATCH,
+PRECISION_TRIALS.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+TRIALS = int(os.environ.get("PRECISION_TRIALS", 2))
+REQUESTS = int(os.environ.get("PRECISION_REQUESTS", 400))
+TRAIN_ROWS = int(os.environ.get("PRECISION_TRAIN_ROWS", 600))
+MAX_BATCH = int(os.environ.get("PRECISION_MAX_BATCH", 32))
+PARITY_ROWS = 64
+TOLERANCE = 5e-2
+RESIDENCY_BUDGET_MODELS_F32 = 4  # budget sized to hold ~4 f32 models
+
+
+def _code_fingerprint() -> str:
+    h = hashlib.sha256()
+    for rel in ("benchmarks/bench_precision_ladder.py",
+                "transmogrifai_tpu/utils/precision.py",
+                "transmogrifai_tpu/serving/compiled.py",
+                "transmogrifai_tpu/serving/explain.py",
+                "transmogrifai_tpu/serving/server.py",
+                "transmogrifai_tpu/serving/fleet.py"):
+        try:
+            with open(os.path.join(REPO, rel), "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(rel.encode())
+    return h.hexdigest()[:12]
+
+
+def _train():
+    import numpy as np
+    from transmogrifai_tpu import frame as fr
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(7)
+    n = TRAIN_ROWS
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    color = rng.choice(["red", "green", "blue"], size=n)
+    logit = 1.5 * x1 - x2 + (color == "red") * 1.2
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "color": (ft.PickList, color.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"], feats["color"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=40), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "color": str(color[i])} for i in range(n)]
+    return model, rows
+
+
+def _drive(srv, rows, n_requests: int) -> dict:
+    """Closed-loop single-row traffic; best-of-TRIALS warm trials."""
+    best = None
+    for _ in range(TRIALS):
+        lats = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            r0 = time.perf_counter()
+            srv.score(rows[i % len(rows)])
+            lats.append((time.perf_counter() - r0) * 1e3)
+        wall = time.perf_counter() - t0
+        lats.sort()
+        leg = {"rps": round(n_requests / wall, 1),
+               "p50_ms": round(lats[len(lats) // 2], 3),
+               "p99_ms": round(lats[int(len(lats) * 0.99)], 3),
+               "requests": n_requests}
+        if best is None or leg["rps"] > best["rps"]:
+            best = leg
+    return best
+
+
+def _throughput(model, rows) -> tuple[dict, dict, dict]:
+    from transmogrifai_tpu.serving.server import ScoringServer
+    f32 = ScoringServer(model, max_batch=MAX_BATCH)
+    f32.start(warmup_row=rows[0])
+    try:
+        leg_f32 = _drive(f32, rows, REQUESTS)
+    finally:
+        f32.stop()
+
+    bf16 = ScoringServer(model, max_batch=MAX_BATCH, precision="bf16",
+                         precision_tolerance=TOLERANCE)
+    bf16.start(warmup_row=rows[0])
+    try:
+        for r in rows[:8]:  # traffic carries the gate; promotion is cheap
+            bf16.score(r)
+        snap = bf16.snapshot()
+        assert snap["config"]["precision"]["active"] == "bf16", snap
+        leg_bf16 = _drive(bf16, rows, REQUESTS)
+        storm = bf16.post_warmup_compiles()
+        compile_storm = {
+            "max_post_warmup_per_bucket":
+                max(storm.values()) if storm else 0,
+            "per_bucket": {str(k): v for k, v in storm.items()},
+        }
+        leg_bf16["promotions"] = snap["precision"]["promotions"]
+    finally:
+        bf16.stop()
+    return leg_f32, leg_bf16, compile_storm
+
+
+def _residency(model, rows) -> dict:
+    """Replay the rung's real HBM accounting into a fixed budget and
+    count whole resident models (cache len, not arithmetic)."""
+    from transmogrifai_tpu.serving import ProgramCache
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    from transmogrifai_tpu.utils.profiling import ServingCounters
+
+    scorer = CompiledScorer(model, max_batch=MAX_BATCH)
+    scorer.warmup(rows[0])
+    buckets = list(scorer.buckets)
+    layers = range(len(scorer._layers))
+    per_model_f32 = sum(scorer.layer_entry_bytes(li, b, "f32")
+                        for li in layers for b in buckets)
+    budget = RESIDENCY_BUDGET_MODELS_F32 * per_model_f32
+
+    def models_resident(rung: str) -> int:
+        cache = ProgramCache(budget_bytes=budget)
+        ctr = ServingCounters()
+        resident = 0
+        for m in range(64):
+            fp = f"model-{m}"
+            lk = (lambda li: li if rung == "f32" else (rung, li))
+            for li in layers:
+                for b in buckets:
+                    cache.get((fp, lk(li), b), lambda: object(),
+                              bytes_est=scorer.layer_entry_bytes(
+                                  li, b, rung),
+                              counters=ctr, bucket=b)
+            if cache.evictions:  # this model began evicting predecessors
+                return resident
+            resident = m + 1
+        return resident
+
+    n32, n16 = models_resident("f32"), models_resident("bf16")
+    return {"budget_bytes": budget,
+            "per_model_bytes_f32": per_model_f32,
+            "models_resident_f32": n32,
+            "models_resident_bf16": n16,
+            "ratio": round(n16 / max(n32, 1), 3)}
+
+
+def _parity(model, rows) -> dict:
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    from transmogrifai_tpu.serving.fleet import score_diff
+    scorer = CompiledScorer(model, max_batch=MAX_BATCH)
+    sample = rows[:PARITY_ROWS]
+    ref = list(scorer.score_batch(sample, precision="f32"))
+    out = {}
+    for rung in ("bf16", "int8"):
+        docs = list(scorer.score_batch(sample, precision=rung))
+        out[f"{rung}_max_score_diff"] = float(
+            max(score_diff(a, b) for a, b in zip(ref, docs)))
+    out.update({"tolerance": TOLERANCE, "rows": len(sample)})
+    return out
+
+
+def _gate_rejection(model, rows) -> dict:
+    from transmogrifai_tpu.serving.server import ScoringServer
+    from transmogrifai_tpu.utils.faults import fault_plan
+    srv = ScoringServer(model, max_batch=MAX_BATCH, precision="bf16",
+                        precision_backoff=2)
+    srv.start(warmup_row=rows[0])
+    try:
+        with fault_plan("transient@serving.precision#0"):
+            doc = srv.score(rows[0])
+        snap = srv.snapshot()
+        ref = list(srv.scorer.score_batch([rows[0]],
+                                          precision="f32"))[0]
+        served_f32 = (doc == ref
+                      and snap["config"]["precision"]["active"] == "f32")
+        for r in rows[1:8]:
+            srv.score(r)
+        snap2 = srv.snapshot()
+        return {"rejections": snap["precision"]["rejections"],
+                "served_f32": bool(served_f32),
+                "drops": 0 if doc is not None else 1,
+                "later_promoted":
+                    snap2["config"]["precision"]["active"] == "bf16"
+                    and snap2["precision"]["promotions"] >= 1}
+    finally:
+        srv.stop()
+
+
+def _pressure(model, rows) -> dict:
+    from transmogrifai_tpu.serving.server import ScoringServer
+    from transmogrifai_tpu.utils.faults import fault_plan
+    srv = ScoringServer(model, max_batch=MAX_BATCH, precision="bf16",
+                        retries=0)
+    srv.start(warmup_row=rows[0])
+    try:
+        buckets_before = list(srv.scorer.buckets)
+        with fault_plan("oom@serving.dispatch#0"):
+            doc = srv.score(rows[0])
+        snap = srv.snapshot()
+        shed = len(buckets_before) - len(list(srv.scorer.buckets))
+        return {"demotions": snap["precision"]["demotions"],
+                "precision_rung_first":
+                    snap["precision"]["demotions"] == 1 and shed == 0
+                    and doc is not None,
+                "buckets_shed_before_demotion": shed}
+    finally:
+        srv.stop()
+
+
+def main() -> int:
+    os.environ.setdefault("TRANSMOGRIFAI_SILENT", "1")
+    import jax
+    platform = jax.devices()[0].platform
+    if os.environ.get("PRECISION_EXPECT_ACCEL") == "1" \
+            and platform == "cpu":
+        print("PRECISION_EXPECT_ACCEL=1 but backend is cpu", flush=True)
+        return 1
+
+    model, rows = _train()
+    leg_f32, leg_bf16, compile_storm = _throughput(model, rows)
+    residency = _residency(model, rows)
+    parity = _parity(model, rows)
+    rejection = _gate_rejection(model, rows)
+    pressure = _pressure(model, rows)
+
+    doc = {
+        "metric": "precision_ladder",
+        "unit": "rps",
+        "platform": platform,
+        "requests": 2 * TRIALS * REQUESTS,
+        "train_rows": TRAIN_ROWS,
+        "max_batch": MAX_BATCH,
+        "f32_rps": leg_f32["rps"],
+        "bf16_rps": leg_bf16["rps"],
+        "f32": leg_f32,
+        "bf16": leg_bf16,
+        "speedup_bf16_x": round(leg_bf16["rps"] / leg_f32["rps"], 3),
+        "residency": residency,
+        "parity": parity,
+        "gate_rejection": rejection,
+        "compile_storm": compile_storm,
+        "pressure": pressure,
+        "code_fingerprint": _code_fingerprint(),
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    out = os.path.join(HERE, "PRECISION_LADDER.json")
+    with open(out, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(doc, indent=1))
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_artifacts import validate_artifact
+    errors = validate_artifact(doc)
+    for e in errors:
+        print(f"SCHEMA: {e}", flush=True)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,6 +105,125 @@ def validate_artifact(doc: object) -> list[str]:
         errors.extend(_validate_multitenant_fleet(doc))
     if doc.get("metric") == "network_chaos":
         errors.extend(_validate_network_chaos(doc))
+    if doc.get("metric") == "precision_ladder":
+        errors.extend(_validate_precision_ladder(doc))
+    return errors
+
+
+#: round-20 acceptance bounds for the precision ladder: a bf16 rung
+#: must pay for itself on at least ONE axis — either measured speed
+#: (>= MIN_BF16_SPEEDUP x the same-run f32 rps; realistic on a real
+#: accelerator) or measured residency (>= MIN_PRECISION_RESIDENCY_RATIO
+#: x whole models resident at the same HBM budget; what CPU runs can
+#: honestly demonstrate, since XLA emulates bf16 there). Parity must
+#: hold within the gate tolerance, the gate must have rejected at
+#: least once while serving f32 with zero drops, steady-state traffic
+#: must never have compiled per (bucket, rung), and the pressure path
+#: must have taken the precision rung BEFORE shedding a bucket.
+MIN_BF16_SPEEDUP = 1.2
+MIN_PRECISION_RESIDENCY_RATIO = 1.5
+
+
+def _validate_precision_ladder(doc: dict) -> list[str]:
+    """The ``benchmarks/PRECISION_LADDER.json`` contract (module
+    constants above for the bounds and their rationale)."""
+    errors = []
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    for leg in ("f32", "bf16"):
+        block = doc.get(leg)
+        if not (isinstance(block, dict) and num(block.get("rps"))
+                and block.get("rps", 0) > 0
+                and num(block.get("p50_ms")) and num(block.get("p99_ms"))):
+            errors.append(f"precision-ladder artifact: '{leg}' must "
+                          "record positive 'rps' + 'p50_ms'/'p99_ms'")
+    speedup = doc.get("speedup_bf16_x")
+    if not num(speedup):
+        errors.append("precision-ladder artifact: missing numeric "
+                      "'speedup_bf16_x' (bf16 rps / f32 rps, same run)")
+    res = doc.get("residency")
+    ratio = res.get("ratio") if isinstance(res, dict) else None
+    if not (isinstance(res, dict) and num(ratio)
+            and all(isinstance(res.get(k), int) and res.get(k, 0) > 0
+                    for k in ("budget_bytes", "models_resident_f32",
+                              "models_resident_bf16"))):
+        errors.append("precision-ladder artifact: 'residency' must "
+                      "record 'budget_bytes', counted "
+                      "'models_resident_f32'/'models_resident_bf16' and "
+                      "their 'ratio'")
+    if num(speedup) and num(ratio) \
+            and speedup < MIN_BF16_SPEEDUP \
+            and ratio < MIN_PRECISION_RESIDENCY_RATIO:
+        errors.append(
+            f"precision ladder pays on NO axis: speedup_bf16_x "
+            f"({speedup}) < {MIN_BF16_SPEEDUP:g} AND residency ratio "
+            f"({ratio}) < {MIN_PRECISION_RESIDENCY_RATIO:g} — a rung "
+            "that is neither faster nor denser is pure risk")
+    par = doc.get("parity")
+    if not (isinstance(par, dict) and num(par.get("tolerance"))
+            and par.get("tolerance", 0) > 0):
+        errors.append("precision-ladder artifact: 'parity' must record "
+                      "a positive 'tolerance'")
+    else:
+        tol = par["tolerance"]
+        for k in ("bf16_max_score_diff", "int8_max_score_diff"):
+            v = par.get(k)
+            if not num(v):
+                errors.append(f"precision-ladder artifact: parity.{k} "
+                              "must be numeric")
+            elif v > tol:
+                errors.append(
+                    f"parity violated: {k} ({v}) exceeds the gate "
+                    f"tolerance ({tol}) — this rung would never have "
+                    "been promoted")
+    rej = doc.get("gate_rejection")
+    if not isinstance(rej, dict):
+        errors.append("precision-ladder artifact: missing "
+                      "'gate_rejection' block")
+    else:
+        if not (isinstance(rej.get("rejections"), int)
+                and rej.get("rejections", 0) >= 1):
+            errors.append("precision-ladder artifact: gate_rejection."
+                          "rejections must be >= 1 — a gate that never "
+                          "rejected was never proven to guard")
+        if rej.get("served_f32") is not True:
+            errors.append("precision-ladder artifact: gate_rejection."
+                          "served_f32 must be true — the rejected batch "
+                          "must be answered from the f32 shadow leg "
+                          "bit-identically")
+        if rej.get("drops") != 0:
+            errors.append("precision-ladder artifact: gate_rejection."
+                          "drops must be 0 — a rejection is a fallback, "
+                          "never a failure")
+        if rej.get("later_promoted") is not True:
+            errors.append("precision-ladder artifact: gate_rejection."
+                          "later_promoted must be true — the rung must "
+                          "recover after the backoff window")
+    storm = doc.get("compile_storm")
+    if not (isinstance(storm, dict)
+            and storm.get("max_post_warmup_per_bucket") == 0):
+        errors.append("precision-ladder artifact: compile_storm."
+                      "max_post_warmup_per_bucket must be 0 — warmup "
+                      "must cover every (bucket, rung) it later serves")
+    press = doc.get("pressure")
+    if not isinstance(press, dict):
+        errors.append("precision-ladder artifact: missing 'pressure' "
+                      "block")
+    else:
+        if press.get("precision_rung_first") is not True:
+            errors.append("precision-ladder artifact: pressure."
+                          "precision_rung_first must be true — OOM with "
+                          "precision headroom must demote the rung, not "
+                          "shed a bucket")
+        if press.get("buckets_shed_before_demotion") != 0:
+            errors.append("precision-ladder artifact: pressure."
+                          "buckets_shed_before_demotion must be 0")
+        if not (isinstance(press.get("demotions"), int)
+                and press.get("demotions", 0) >= 1):
+            errors.append("precision-ladder artifact: pressure."
+                          "demotions must be >= 1 (counter-asserted)")
     return errors
 
 

@@ -1,0 +1,166 @@
+"""Lint the compiled serving path for dtype discipline, checked in CI.
+
+The precision ladder (f32 -> bf16 -> int8) only stays sound if the
+compiled path has exactly ONE place that decides compute dtype: the
+``precision`` argument threaded into the jit program builders
+(``dag.fuse_dag_program`` and friends). A stray ``.astype(...)`` or
+``np.float64`` widening inside ``serving/compiled.py``,
+``serving/explain.py`` or ``dag.py`` silently re-widens (or re-narrows)
+tensors behind the ladder's back — the exact bug class satellite 1 of
+the ladder PR fixed (a host column walk that forced every numeric
+column to f64 regardless of its fitted dtype). This lint makes "dtype
+changes go through the precision argument" a STRUCTURAL property of the
+compiled path instead of a review-time hope:
+
+- **casts**: any ``.astype(...)`` call, or any ``np.float64`` /
+  ``jnp.float64`` reference, in a linted module is a violation unless
+  the line carries a ``# precision-ok: <reason>`` escape comment.
+  Legitimate uses exist — host-side JSON materialization AFTER the
+  compiled program runs boxes results into Python floats, which are
+  f64 by definition — and the escape comment forces each one to state
+  why it cannot leak into the traced program.
+- **builders**: every jit program builder (``fuse_layer_program``,
+  ``fuse_dag_program``, ``_program_for``, ``_explain_program_for``,
+  ``_build_explain_program``) must declare an explicit ``precision``
+  parameter, and every call to the two public builders must pass
+  ``precision=`` — so a new builder (or call site) cannot quietly
+  hard-code a rung. Training-executor call sites that are f32 by
+  contract annotate the line instead.
+
+Library use: ``check_file(path)`` / ``check_tree(paths)`` return
+violation lists; ``main()`` lints the three compiled-path modules,
+printing every violation and exiting 1. Wired into tier-1 via
+``tests/test_precision.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+
+__all__ = ["check_file", "check_tree"]
+
+#: float-widening dtype attributes that must not appear on the compiled path
+FORBIDDEN_DTYPES = {"float64"}
+
+#: jit program builders that must declare an explicit ``precision`` parameter
+BUILDER_DEFS = {"fuse_layer_program", "fuse_dag_program", "_program_for",
+                "_explain_program_for", "_build_explain_program"}
+
+#: public builders whose CALLS must pass ``precision=`` explicitly
+BUILDER_CALLS = {"fuse_layer_program", "fuse_dag_program"}
+
+
+def _line_ok(source_lines: list[str], lineno: int) -> bool:
+    line = source_lines[lineno - 1] if 0 < lineno <= len(source_lines) \
+        else ""
+    return "# precision-ok" in line
+
+
+def _call_name(node: ast.AST) -> str:
+    """The bare name of a direct call target (``f(...)`` or ``m.f(...)``)."""
+    if not isinstance(node, ast.Call):
+        return ""
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _param_names(fn: ast.AST) -> set:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    return set(names)
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = source.splitlines()
+    out: list[str] = []
+    rel = os.path.relpath(path)
+
+    for node in ast.walk(tree):
+        # pass 1a: .astype(...) calls — in-line dtype changes bypass the
+        # single precision argument the ladder relies on
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" \
+                and not _line_ok(lines, node.lineno):
+            out.append(
+                f"{rel}:{node.lineno}: `.astype(...)` on the compiled "
+                "path — thread the dtype through the builder's "
+                "`precision` argument, or annotate the line with "
+                "`# precision-ok: <reason>`")
+        # pass 1b: np.float64 / jnp.float64 references — silent widening
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in FORBIDDEN_DTYPES \
+                and not _line_ok(lines, node.lineno):
+            out.append(
+                f"{rel}:{node.lineno}: `{node.attr}` reference on the "
+                "compiled path widens behind the precision ladder's "
+                "back — keep the fitted dtype, or annotate with "
+                "`# precision-ok: <reason>`")
+        # pass 2a: builder defs must declare an explicit precision param
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in BUILDER_DEFS \
+                and "precision" not in _param_names(node):
+            out.append(
+                f"{rel}:{node.lineno}: program builder `{node.name}` has "
+                "no `precision` parameter — every jit builder must "
+                "thread the ladder rung explicitly")
+        # pass 2b: public builder calls must pass precision= (or state
+        # why the hard-coded f32 default is the contract)
+        elif isinstance(node, ast.Call) \
+                and _call_name(node) in BUILDER_CALLS \
+                and not any(kw.arg == "precision" for kw in node.keywords) \
+                and not _line_ok(lines, node.lineno):
+            out.append(
+                f"{rel}:{node.lineno}: `{_call_name(node)}(...)` called "
+                "without `precision=` — pass the active rung, or "
+                "annotate with `# precision-ok: <reason>` if f32 is the "
+                "contract at this site")
+    return out
+
+
+def check_tree(roots) -> list[str]:
+    out: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.extend(check_file(root))
+            continue
+        for path in sorted(glob.glob(os.path.join(root, "**", "*.py"),
+                                     recursive=True)):
+            out.extend(check_file(path))
+    return out
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "transmogrifai_tpu")
+    roots = args or [os.path.join(pkg, "serving", "compiled.py"),
+                     os.path.join(pkg, "serving", "explain.py"),
+                     os.path.join(pkg, "dag.py")]
+    violations = check_tree(roots)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} precision-path violation(s) found")
+        return 1
+    print("precision-path lint clean: " + ", ".join(
+        os.path.relpath(r) for r in roots))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

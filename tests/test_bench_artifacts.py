@@ -946,3 +946,105 @@ def test_multitenant_artifact_committed_and_healthy(checker):
     assert art["tiers"]["promotions_disk_ram"] >= 1
     assert art["tiers"]["demotions_ram"] >= 1
     assert art["distinct_models_scored"] > 0
+
+
+def _precision_ladder_good():
+    return {
+        "metric": "precision_ladder", "platform": "cpu",
+        "requests": 1600, "f32_rps": 269.0, "bf16_rps": 281.0,
+        "f32": {"rps": 269.0, "p50_ms": 3.6, "p99_ms": 6.7},
+        "bf16": {"rps": 281.0, "p50_ms": 3.5, "p99_ms": 6.5},
+        "speedup_bf16_x": 1.045,
+        "residency": {"budget_bytes": 18256, "per_model_bytes_f32": 4564,
+                      "models_resident_f32": 4,
+                      "models_resident_bf16": 8, "ratio": 2.0},
+        "parity": {"bf16_max_score_diff": 0.006,
+                   "int8_max_score_diff": 0.014,
+                   "tolerance": 0.05, "rows": 64},
+        "gate_rejection": {"rejections": 1, "served_f32": True,
+                           "drops": 0, "later_promoted": True},
+        "compile_storm": {"max_post_warmup_per_bucket": 0},
+        "pressure": {"demotions": 1, "precision_rung_first": True,
+                     "buckets_shed_before_demotion": 0},
+    }
+
+
+def test_precision_ladder_artifact_schema_rejections(checker):
+    v = checker.validate_artifact
+    good = _precision_ladder_good()
+    assert v(good) == []
+    # both legs must carry real latency blocks
+    assert any("'f32'" in e for e in v(
+        {k: x for k, x in good.items() if k != "f32"}))
+    assert any("'bf16'" in e for e in v(
+        {**good, "bf16": {"rps": 0, "p50_ms": 1.0, "p99_ms": 2.0}}))
+    # the either-axis rule: slower AND no denser is pure risk
+    bad_both = {**good, "speedup_bf16_x": 1.0,
+                "residency": {**good["residency"], "ratio": 1.1}}
+    assert any("pays on NO axis" in e for e in v(bad_both))
+    # ... but ONE passing axis is enough (the CPU residency arm)
+    assert v({**good, "speedup_bf16_x": 1.0}) == []
+    assert v({**good, "residency": {**good["residency"], "ratio": 1.1},
+              "speedup_bf16_x": 1.3}) == []
+    # parity beyond the gate tolerance could never have been promoted
+    assert any("parity violated" in e for e in v(
+        {**good, "parity": {**good["parity"],
+                            "int8_max_score_diff": 0.06}}))
+    assert any("parity.bf16_max_score_diff" in e for e in v(
+        {**good, "parity": {k: x for k, x in good["parity"].items()
+                            if k != "bf16_max_score_diff"}}))
+    # the gate must have been seen rejecting — and rejecting SAFELY
+    assert any("rejections" in e for e in v(
+        {**good, "gate_rejection": {**good["gate_rejection"],
+                                    "rejections": 0}}))
+    assert any("served_f32" in e for e in v(
+        {**good, "gate_rejection": {**good["gate_rejection"],
+                                    "served_f32": False}}))
+    assert any("drops" in e for e in v(
+        {**good, "gate_rejection": {**good["gate_rejection"],
+                                    "drops": 1}}))
+    assert any("later_promoted" in e for e in v(
+        {**good, "gate_rejection": {**good["gate_rejection"],
+                                    "later_promoted": False}}))
+    # steady state must be compile-free per (bucket, rung)
+    assert any("compile_storm" in e for e in v(
+        {**good, "compile_storm": {"max_post_warmup_per_bucket": 1}}))
+    # pressure must take the precision rung BEFORE bucket shedding
+    assert any("precision_rung_first" in e for e in v(
+        {**good, "pressure": {**good["pressure"],
+                              "precision_rung_first": False}}))
+    assert any("buckets_shed_before_demotion" in e for e in v(
+        {**good, "pressure": {**good["pressure"],
+                              "buckets_shed_before_demotion": 1}}))
+
+
+def test_precision_ladder_artifact_committed_and_healthy(checker):
+    """The round-20 acceptance contract on the COMMITTED artifact: the
+    bf16 rung pays on at least one axis (speed or HBM residency), both
+    promoted rungs hold parity within the gate tolerance, the gate was
+    observed rejecting while serving f32 with zero drops, steady-state
+    traffic never compiled, and the pressure path demoted precision
+    before shedding a bucket."""
+    path = os.path.join(REPO, "benchmarks", "PRECISION_LADDER.json")
+    assert os.path.exists(path), \
+        "benchmarks/PRECISION_LADDER.json not committed"
+    art = json.load(open(path))
+    assert checker.validate_artifact(art) == []
+    assert art["metric"] == "precision_ladder"
+    assert (art["speedup_bf16_x"] >= checker.MIN_BF16_SPEEDUP
+            or art["residency"]["ratio"]
+            >= checker.MIN_PRECISION_RESIDENCY_RATIO)
+    tol = art["parity"]["tolerance"]
+    assert art["parity"]["bf16_max_score_diff"] <= tol
+    assert art["parity"]["int8_max_score_diff"] <= tol
+    assert art["gate_rejection"]["rejections"] >= 1
+    assert art["gate_rejection"]["served_f32"] is True
+    assert art["gate_rejection"]["drops"] == 0
+    assert art["gate_rejection"]["later_promoted"] is True
+    assert art["compile_storm"]["max_post_warmup_per_bucket"] == 0
+    assert art["pressure"]["precision_rung_first"] is True
+    assert art["pressure"]["buckets_shed_before_demotion"] == 0
+    assert art["pressure"]["demotions"] >= 1
+    # counted residency, not arithmetic: the cache really held 2x models
+    assert art["residency"]["models_resident_bf16"] \
+        >= art["residency"]["models_resident_f32"]

@@ -1,0 +1,519 @@
+"""Precision ladder (f32 -> bf16 -> int8) for compiled serving + explain:
+quantization primitives, precision-tagged program-cache keys with the
+default f32 keys byte-identical to the pre-ladder scheme, the per-model
+shadow-gated promotion flow (rejection keeps f32 bit-identically), the
+pressure rung ABOVE bucket-shedding, tenancy-shed preference for
+demotion over COLD-paging, the dtype-discipline lint, and the
+Prometheus ladder series.
+
+Every end-to-end test shares ONE module-scoped trained model (tier-1
+wall budget)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401
+from transmogrifai_tpu import frame as fr
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.uid import UID
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.utils.precision import (
+    PRECISION_BITS, PRECISION_BYTE_FACTOR, ExactTensor, QuantizedTensor,
+    cast_float_leaves, compute_dtype, fits_int16, ladder_for,
+    materialize_tree, normalize_precision, params_nbytes, quantize_weights,
+)
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+N = 160
+
+
+@pytest.fixture(scope="module")
+def served():
+    """ONE tiny fitted binary workflow + its raw rows, shared by every
+    server/scorer test in this module."""
+    UID.reset()
+    rng = np.random.default_rng(3)
+    x1 = rng.normal(size=N)
+    x2 = rng.normal(size=N)
+    color = rng.choice(["red", "green", "blue"], size=N)
+    logit = 1.5 * x1 - x2 + (color == "red") * 1.2
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-logit))).astype(float)
+    frame = fr.HostFrame.from_dict({
+        "y": (ft.RealNN, y.tolist()),
+        "x1": (ft.Real, x1.tolist()),
+        "x2": (ft.Real, x2.tolist()),
+        "color": (ft.PickList, color.tolist()),
+    })
+    feats = FeatureBuilder.from_frame(frame, response="y")
+    features = transmogrify([feats["x1"], feats["x2"], feats["color"]])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, models_and_parameters=[
+            (OpLogisticRegression(max_iter=25), [{}])])
+    pred = feats["y"].transform_with(sel, features)
+    model = (Workflow().set_input_frame(frame)
+             .set_result_features(pred, features).train())
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "color": str(color[i])} for i in range(N)]
+    return model, rows
+
+
+def _max_diff(a_docs, b_docs) -> float:
+    from transmogrifai_tpu.serving.fleet import score_diff
+    return max(score_diff(a, b) for a, b in zip(a_docs, b_docs))
+
+
+# -- primitives ---------------------------------------------------------------
+
+def test_ladder_semantics():
+    assert ladder_for("f32") == ("f32",)
+    assert ladder_for(None) == ("f32",)
+    assert ladder_for("bf16") == ("f32", "bf16")
+    assert ladder_for("int8") == ("f32", "bf16", "int8")
+    assert ladder_for("auto") == ("f32", "bf16", "int8")
+    assert normalize_precision("BF16") == "bf16"
+    with pytest.raises(ValueError, match="unknown precision"):
+        normalize_precision("fp8")
+    assert compute_dtype("f32") is None
+    import jax.numpy as jnp
+    assert compute_dtype("bf16") == jnp.bfloat16
+    assert PRECISION_BITS["int8"] == 8
+    assert PRECISION_BYTE_FACTOR["bf16"] == 0.5
+
+
+def test_quantize_weights_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 4)).astype(np.float32) * np.array(
+        [0.1, 1.0, 10.0, 100.0], np.float32)
+    qt = quantize_weights(w)
+    assert np.asarray(qt.q).dtype == np.int8
+    deq = np.asarray(qt.materialize(np.float32))
+    # symmetric round-to-nearest: error bounded by half a step per channel
+    step = np.asarray(qt.scale)
+    assert np.all(np.abs(deq - w) <= step / 2 + 1e-7)
+    # all-zero channel quantizes to exact zeros, not NaN
+    w0 = np.zeros((8, 2), np.float32)
+    deq0 = np.asarray(quantize_weights(w0).materialize(np.float32))
+    assert np.all(deq0 == 0.0) and np.all(np.isfinite(deq0))
+    # 1-D weights: single scalar scale
+    q1 = quantize_weights(np.array([1.0, -2.0, 0.5], np.float32))
+    assert np.ndim(np.asarray(q1.scale)) == 0
+    # byte accounting: int8 payload + f32 scales
+    assert qt.nbytes == w.size + 4 * 4
+    assert params_nbytes({"w": qt}) == qt.nbytes
+
+
+def test_fits_int16():
+    assert fits_int16(np.array([0, 32767, -32768]))
+    assert not fits_int16(np.array([0, 32768]))
+    assert fits_int16(np.array([], np.int64))
+
+
+def test_cast_and_materialize_leaf_discipline():
+    import jax.numpy as jnp
+    qt = quantize_weights(np.eye(3, dtype=np.float32))
+    et = ExactTensor(jnp.arange(4, dtype=jnp.float64 if False else
+                                jnp.float32))
+    tree = {"f": jnp.ones(3, jnp.float32), "i": jnp.arange(3),
+            "b": jnp.ones(3, bool), "q": qt, "e": et}
+    cast = cast_float_leaves(tree, jnp.bfloat16)
+    assert cast["f"].dtype == jnp.bfloat16
+    assert cast["i"].dtype == tree["i"].dtype        # ints untouched
+    assert cast["b"].dtype == bool                   # bools untouched
+    assert cast["q"] is qt and cast["e"] is et       # wrappers untouched
+    mat = materialize_tree(cast, jnp.bfloat16)
+    assert mat["q"].dtype == jnp.bfloat16            # dequantized in-dtype
+    assert mat["e"].dtype == jnp.float32             # exact keeps stored
+
+
+def test_quantized_leaves_flow_through_jit():
+    import jax
+    import jax.numpy as jnp
+    qt = quantize_weights(np.full((4, 2), 0.5, np.float32))
+
+    @jax.jit
+    def f(q, x):
+        return x @ q.materialize(jnp.float32)
+
+    out = f(qt, jnp.ones((1, 4), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0, atol=1e-2)
+
+
+# -- program-cache key scheme -------------------------------------------------
+
+def test_rung_of_layer_key():
+    from transmogrifai_tpu.serving.compiled import rung_of_layer_key
+    assert rung_of_layer_key(2) == "f32"
+    assert rung_of_layer_key(("bf16", 2)) == "bf16"
+    assert rung_of_layer_key(("explain", 1, 64)) == "f32"
+    assert rung_of_layer_key(("explain", 1, 64, "int8")) == "int8"
+
+
+def test_shared_cache_predicates_cover_tagged_keys():
+    """The pre-ladder eviction predicates (len==3 / k[0]==fp / k[2]==
+    bucket) must keep matching precision-tagged layer keys with NO
+    predicate change — that is the whole point of folding the rung into
+    the LAYER component."""
+    from transmogrifai_tpu.serving import ProgramCache
+    from transmogrifai_tpu.utils.profiling import ServingCounters
+    cache = ProgramCache(budget_bytes=None)
+    ctr = ServingCounters()
+    for lk in (0, ("bf16", 0), ("explain", 0, 32), ("explain", 0, 32,
+                                                    "bf16")):
+        for bucket in (8, 16):
+            cache.get(("fpA", lk, bucket), lambda: object(), bytes_est=10,
+                      counters=ctr, bucket=bucket)
+    assert len(cache) == 8
+    # evict_bucket drops EVERY rung's entries for that bucket
+    assert cache.evict_bucket("fpA", 16) == 4
+    assert len(cache) == 4
+    # evict_model drops everything of the fingerprint, all rungs
+    assert cache.evict_model("fpA") == 4
+    assert len(cache) == 0
+
+
+def test_scorer_default_f32_keys_unchanged(served):
+    """A default-precision scorer's private program dict keys stay plain
+    layer ints — byte-identical to the pre-ladder scheme."""
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    model, rows = served
+    scorer = CompiledScorer(model, max_batch=16)
+    scorer.warmup(rows[0])
+    assert scorer.precision == "f32"
+    assert all(isinstance(k, int) for k in scorer._programs)
+
+
+def test_scorer_bf16_parity_and_eviction(served):
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    model, rows = served
+    scorer = CompiledScorer(model, max_batch=16)
+    ref = list(scorer.score_batch(rows[:8], precision="f32"))
+    out = list(scorer.score_batch(rows[:8], precision="bf16"))
+    assert _max_diff(ref, out) <= 5e-2
+    # f32 keys stayed ints; bf16 variants tagged ("bf16", li)
+    assert any(isinstance(k, int) for k in scorer._programs)
+    assert any(isinstance(k, tuple) and k[0] == "bf16"
+               for k in scorer._programs)
+    # eviction removes exactly one rung
+    n_before = len(scorer._programs)
+    scorer.evict_precision("bf16")
+    assert all(not (isinstance(k, tuple) and k[0] == "bf16")
+               for k in scorer._programs)
+    assert any(isinstance(k, int) for k in scorer._programs)
+    assert len(scorer._programs) < n_before
+
+
+def test_scorer_int8_quantized_weights(served):
+    """int8: the prediction stage's weights ride as QuantizedTensor and
+    scores stay within the gate tolerance of f32."""
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    model, rows = served
+    scorer = CompiledScorer(model, max_batch=16)
+    ref = list(scorer.score_batch(rows[:8], precision="f32"))
+    out = list(scorer.score_batch(rows[:8], precision="int8"))
+    assert _max_diff(ref, out) <= 5e-2
+    # the memoized int8 param tree actually contains quantized leaves
+    import jax
+    from transmogrifai_tpu.utils.precision import QuantizedTensor as QT
+    quant = [p for p in scorer._qparams.values()]
+    assert quant, "int8 dispatch must memoize a quantized param tree"
+    leaves = [leaf for tree in quant for leaf in jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: isinstance(x, QT))]
+    assert any(isinstance(x, QT) for x in leaves)
+
+
+def test_layer_entry_bytes_scale_with_rung(served):
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    model, _ = served
+    scorer = CompiledScorer(model, max_batch=16)
+    li = len(scorer._layers) - 1
+    b32 = scorer.layer_entry_bytes(li, 16, "f32")
+    b16 = scorer.layer_entry_bytes(li, 16, "bf16")
+    b8 = scorer.layer_entry_bytes(li, 16, "int8")
+    assert b16 == max(1, int(b32 * 0.5))
+    assert b8 == max(1, int(b32 * 0.25))
+
+
+# -- satellite 1: dtype-preserving host column walk ---------------------------
+
+def test_wire_numeric_columns_keep_their_dtype(served):
+    """An F32/I32 wire column with missing values must NOT silently
+    upcast to f64 on the host (2x memory per request frame)."""
+    from transmogrifai_tpu.serving import wireformat as wf
+    from transmogrifai_tpu.serving.compiled import CompiledScorer
+    mask = np.array([True, False, True], bool)
+    col32 = wf.WireColumn("x1", wf.F32,
+                          np.array([1.0, 2.0, 3.0], np.float32), mask)
+    host = CompiledScorer._host_col_from_wire("x1", ft.Real, col32, 3)
+    assert host.values.dtype == np.float32
+    assert host.values[1] == np.float32(0.0)  # fill in the column's dtype
+    coli = wf.WireColumn("x1", wf.I32,
+                         np.array([1, 2, 3], np.int32), mask)
+    hosti = CompiledScorer._host_col_from_wire("x1", ft.Integral, coli, 3)
+    assert hosti.values.dtype == np.int32
+    geo = wf.WireColumn(
+        "g", wf.F32, np.ones((3, 3), np.float32), mask)
+    hostg = CompiledScorer._host_col_from_wire("g", ft.Geolocation, geo, 3)
+    assert hostg.values.dtype == np.float32
+
+
+# -- server: gated promotion, chaos rejection, pressure demotion --------------
+
+def test_server_promotes_through_gate_compile_free(served):
+    from transmogrifai_tpu.serving.server import ScoringServer
+    model, rows = served
+    srv = ScoringServer(model, max_batch=16, precision="bf16",
+                        precision_tolerance=5e-2)
+    srv.start(warmup_row=rows[0])
+    try:
+        for r in rows[:6]:
+            srv.score(r)
+        snap = srv.snapshot()
+        assert snap["config"]["precision"] == {
+            "target": "bf16", "active": "bf16",
+            "ladder": ["f32", "bf16"], "tolerance": 5e-2}
+        assert snap["precision"]["promotions"] == 1
+        assert snap["precision"]["rejections"] == 0
+        assert snap["precision"]["bits"] == 16
+        # the acceptance bar: warmup covered BOTH rungs, steady-state
+        # traffic (including the gate's f32 shadow leg) never compiles
+        assert srv.post_warmup_compiles() == {}
+    finally:
+        srv.stop()
+
+
+def test_chaos_gate_rejection_keeps_f32_then_promotes(served):
+    """Satellite 3: a fault at ``serving.precision`` poisons the bf16
+    candidate mid-gate. The batch must serve the f32 reference
+    bit-identically (zero drops), count ONE rejection, flight-record it,
+    and a post-backoff retry must promote."""
+    from transmogrifai_tpu.serving.server import ScoringServer
+    from transmogrifai_tpu.utils.events import events
+    from transmogrifai_tpu.utils.faults import fault_plan
+    model, rows = served
+    srv = ScoringServer(model, max_batch=16, precision="bf16",
+                        precision_backoff=2)
+    srv.start(warmup_row=rows[0])
+    try:
+        with fault_plan("transient@serving.precision#0") as plan:
+            doc = srv.score(rows[0])
+            assert plan.fired == [("serving.precision", 0, "transient")]
+        snap = srv.snapshot()
+        assert snap["config"]["precision"]["active"] == "f32"
+        assert snap["precision"]["rejections"] == 1
+        assert snap["precision"]["promotions"] == 0
+        assert snap["precision"]["demotions"] == 0
+        # the rejected batch was SERVED, on the f32 lane, bit-identically
+        ref = list(srv.scorer.score_batch([rows[0]], precision="f32"))[0]
+        assert doc == ref
+        kinds = [e["kind"] for e in events.tail(50)]
+        assert "serving.precision_rejected" in kinds
+        # backoff window: the next scores stay f32, then the retry
+        # promotes (the fault fired exactly once)
+        for r in rows[1:6]:
+            srv.score(r)
+        snap2 = srv.snapshot()
+        assert snap2["config"]["precision"]["active"] == "bf16"
+        assert snap2["precision"]["promotions"] == 1
+        assert snap2["precision"]["rejections"] == 1
+    finally:
+        srv.stop()
+
+
+def test_oom_demotes_precision_before_bucket_shed(served):
+    """The ladder rung ABOVE bucket-shedding: a dispatch OOM on an f32
+    lane with bf16 headroom demotes the rung and retries — the bucket
+    set must be untouched and the request served."""
+    from transmogrifai_tpu.serving.server import ScoringServer
+    from transmogrifai_tpu.utils.faults import fault_plan
+    model, rows = served
+    srv = ScoringServer(model, max_batch=16, precision="bf16", retries=0)
+    srv.start(warmup_row=rows[0])
+    try:
+        assert srv.scorer.precision == "f32"
+        buckets_before = list(srv.scorer.buckets)
+        with fault_plan("oom@serving.dispatch#0"):
+            doc = srv.score(rows[0])
+        snap = srv.snapshot()
+        assert snap["config"]["precision"]["active"] == "bf16"
+        assert snap["precision"]["demotions"] == 1
+        assert list(srv.scorer.buckets) == buckets_before
+        assert isinstance(doc, dict)
+    finally:
+        srv.stop()
+
+
+def test_f32_target_has_no_gate_and_no_demotion_rung(served):
+    """Default precision: the ladder is a single rung — no candidate, no
+    gate legs, and an OOM goes straight to the bucket-shed rung."""
+    from transmogrifai_tpu.serving.server import ScoringServer
+    from transmogrifai_tpu.utils.faults import fault_plan
+    model, rows = served
+    srv = ScoringServer(model, max_batch=16, retries=0)
+    srv.start(warmup_row=rows[0])
+    try:
+        buckets_before = list(srv.scorer.buckets)
+        with fault_plan("oom@serving.dispatch#0"):
+            doc = srv.score(rows[0])
+        snap = srv.snapshot()
+        assert snap["config"]["precision"]["active"] == "f32"
+        assert snap["precision"]["demotions"] == 0
+        # no precision headroom: pressure falls through to bucket shed
+        assert len(srv.scorer.buckets) < len(buckets_before)
+        assert isinstance(doc, dict)
+    finally:
+        srv.stop()
+
+
+# -- fleet: lineage stamp + fleet-wide pressure demotion ----------------------
+
+def test_fleet_lineage_precision_and_pressure_demotion(served):
+    from transmogrifai_tpu.serving import FleetServer
+    model, rows = served
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0, precision="bf16")
+    fleet.register(model=model, model_id="m")
+    fleet.start(warmup_rows={"m": rows[0]})
+    try:
+        doc = fleet._http_score("m", dict(rows[0]))
+        assert doc["lineage"]["precision"] in ("f32", "bf16")
+        # row traffic promotes the lane through the gate
+        for r in rows[1:4]:
+            fleet._http_score("m", dict(r))
+        assert fleet._lane_precision("m", "v1") == "bf16"
+        doc2 = fleet._http_score("m", dict(rows[4]))
+        assert doc2["lineage"]["precision"] == "bf16"
+    finally:
+        fleet.stop()
+
+
+def test_fleet_pressure_demotes_every_lane(served):
+    from transmogrifai_tpu.serving import FleetServer
+    model, rows = served
+    fleet = FleetServer(max_batch=8, max_wait_ms=1.0, precision="bf16")
+    fleet.register(model=model, model_id="m")
+    fleet.start(warmup_rows={"m": rows[0]})
+    try:
+        lane = fleet.active_lanes()["m"]
+        assert lane.scorer.precision == "f32"
+        before = fleet.program_cache.current_bytes
+        freed = fleet._demote_fleet_precision()
+        assert lane.scorer.precision == "bf16"
+        # the demoted-from f32 programs left the shared cache
+        assert freed > 0
+        assert fleet.program_cache.current_bytes == before - freed
+        # ladder floor: a second demotion is a no-op
+        assert fleet._demote_fleet_precision() == 0
+    finally:
+        fleet.stop()
+
+
+def test_store_shed_prefers_precision_demotion():
+    """``TieredModelStore.shed`` calls the precision hook FIRST; when it
+    frees enough, zero tenants COLD-page."""
+    from transmogrifai_tpu.serving.registry import UnknownModelError
+    from transmogrifai_tpu.tenancy.store import TieredModelStore, _Residency
+
+    class Reg:
+        def attach_tier_store(self, store):
+            pass
+
+        def get(self, *a):
+            raise UnknownModelError("gone")
+
+    calls = []
+
+    def hook():
+        calls.append(1)
+        return 400
+
+    store = TieredModelStore(Reg(), None, ram_budget_bytes=10 ** 9,
+                             on_precision_demote=hook)
+    store._resident[("a", "v1")] = _Residency(500, False)
+    store._resident[("b", "v1")] = _Residency(500, False)
+    freed = store.shed(300)
+    assert calls == [1]
+    assert freed == 400
+    assert len(store._resident) == 2          # nobody COLD-paged
+    assert store.metrics.sheds == 1
+    # shortfall: the hook's bytes seed the victim loop, ONE victim pages
+    calls.clear()
+    freed2 = store.shed(700)
+    assert calls == [1]
+    assert freed2 == 400 + 500
+    assert len(store._resident) == 1
+
+
+# -- observability ------------------------------------------------------------
+
+def test_prometheus_ladder_series(served):
+    from transmogrifai_tpu.serving.metrics import ServingMetrics
+    from transmogrifai_tpu.utils.prometheus import build_registry
+    m = ServingMetrics()
+    m.record_precision("bf16", promoted=True)
+    m.record_precision("bf16", rejected=True)
+    m.record_precision("bf16", demoted=True)
+    rendered = build_registry(serving=m, include_app=False).render()
+    for name in ("transmogrifai_precision_promotions_total",
+                 "transmogrifai_precision_rejections_total",
+                 "transmogrifai_precision_demotions_total"):
+        assert f"{name} 1" in rendered, name
+    assert "transmogrifai_serving_precision_bits 16" in rendered
+
+
+def test_metrics_precision_snapshot():
+    from transmogrifai_tpu.serving.metrics import ServingMetrics
+    m = ServingMetrics()
+    snap = m.snapshot()["precision"]
+    assert snap["active"] == "f32" and snap["bits"] == 32
+    m.record_precision("int8", demoted=True)
+    snap = m.snapshot()["precision"]
+    assert snap["active"] == "int8" and snap["bits"] == 8
+    assert snap["demotions"] == 1
+
+
+# -- satellite 2: the dtype-discipline lint -----------------------------------
+
+def _lint():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import check_precision_paths
+        return check_precision_paths
+    finally:
+        sys.path.remove(SCRIPTS)
+
+
+def test_precision_path_lint_is_clean():
+    lint = _lint()
+    assert lint.main([]) == 0
+
+
+def test_precision_path_lint_catches_violations(tmp_path):
+    lint = _lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def fuse_layer_program(dev_ts, donate=False):\n"
+        "    return None\n"
+        "def walk(col):\n"
+        "    a = col.values.astype(np.float64)\n"
+        "    return fuse_layer_program([])\n")
+    out = lint.check_file(str(bad))
+    # missing precision param, astype, float64, builder call w/o rung
+    assert len(out) == 4, out
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import numpy as np\n"
+        "def fuse_layer_program(dev_ts, donate=False, precision='f32'):\n"
+        "    return None\n"
+        "def walk(col):\n"
+        "    a = np.asarray(col.values, np.float64)  # precision-ok: test\n"
+        "    return fuse_layer_program([], precision='f32')\n")
+    assert lint.check_file(str(ok)) == []

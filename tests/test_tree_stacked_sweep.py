@@ -85,6 +85,35 @@ def _tree_regression_selector(**kw):
         splitter=DataSplitter(reserve_test_fraction=0.2, seed=1), **kw)
 
 
+@pytest.fixture(scope="module")
+def shared_frame():
+    """ONE 240-row binary frame shared by every test that exercises the
+    canonical ``_tree_binary_selector`` (tier-1 wall: training the same
+    selector on per-test frames re-paid the full sweep repeatedly)."""
+    return _frame()
+
+
+@pytest.fixture(scope="module")
+def stacked_run(shared_frame):
+    """Module-scoped canonical STACKED sweep: (summary, counters) for
+    ``_tree_binary_selector`` trained once with stacking forced on."""
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("TRANSMOGRIFAI_TREE_STACKED", "1")
+        sweep_counters.reset()
+        s = _train(_tree_binary_selector(), shared_frame).selector_summary()
+        return s, sweep_counters.to_json()
+
+
+@pytest.fixture(scope="module")
+def loop_run(shared_frame):
+    """Module-scoped canonical per-fold LOOP sweep on the same frame."""
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("TRANSMOGRIFAI_TREE_STACKED", "0")
+        sweep_counters.reset()
+        s = _train(_tree_binary_selector(), shared_frame).selector_summary()
+        return s, sweep_counters.to_json()
+
+
 def _summaries_equal(s1, s2, tol=1e-6):
     assert s1.best_model_name == s2.best_model_name
     v1 = {r.model_name: r.metric_values for r in s1.validation_results}
@@ -95,19 +124,12 @@ def _summaries_equal(s1, s2, tol=1e-6):
             assert abs(v1[k][m] - v2[k][m]) <= tol, (k, m)
 
 
-def test_tree_stacked_parity_binary(monkeypatch):
+def test_tree_stacked_parity_binary(stacked_run, loop_run):
     """RF + GBT: the fold x grid-stacked path reproduces the per-fold
     loop's winner and per-candidate metrics EXACTLY (same binned sweep
     metric, same bin-once codes, same PRNG draws)."""
-    frame = _frame()
-    monkeypatch.setenv("TRANSMOGRIFAI_TREE_STACKED", "1")
-    sweep_counters.reset()
-    s1 = _train(_tree_binary_selector(), frame).selector_summary()
-    c1 = sweep_counters.to_json()
-    monkeypatch.setenv("TRANSMOGRIFAI_TREE_STACKED", "0")
-    sweep_counters.reset()
-    s2 = _train(_tree_binary_selector(), frame).selector_summary()
-    c2 = sweep_counters.to_json()
+    s1, c1 = stacked_run
+    s2, c2 = loop_run
     _summaries_equal(s1, s2, tol=0.0)
     assert all(v["mode"] == "tree_stacked" for v in c1.values()), c1
     assert all(v["mode"] == "fold_loop" for v in c2.values()), c2
@@ -257,12 +279,13 @@ def test_tree_stacked_bin_once_disabled_falls_back(monkeypatch):
     _summaries_equal(s1, s2, tol=0.0)
 
 
-def test_hbm_guard_lane_chunking(monkeypatch):
+def test_hbm_guard_lane_chunking(monkeypatch, shared_frame, stacked_run):
     """A budget that fits one lane but not two splits each depth-group
     into lane chunks — one dispatch + one sync per chunk, metrics
-    identical to the unchunked run; an impossible budget (not even one
-    lane) drops the family all the way to the loop."""
-    frame = _frame(seed=9)
+    identical to the unchunked run (the shared module-scoped stacked
+    sweep); an impossible budget (not even one lane) drops the family
+    all the way to the loop."""
+    frame = shared_frame
     est = OpGBTClassifier(num_rounds=3, max_depth=2, max_bins=8)
     group = est.tree_stack_groups(
         [{"learning_rate": 0.1}, {"learning_rate": 0.3}])[0]
@@ -281,8 +304,7 @@ def test_hbm_guard_lane_chunking(monkeypatch):
         assert fc["laneChunks"] == 2, (name, fc)       # 2 lanes, 1 each
         assert fc["hostSyncs"] == 2, (name, fc)        # one per chunk
     monkeypatch.delenv("TRANSMOGRIFAI_SWEEP_HBM_BUDGET")
-    s2 = _train(_tree_binary_selector(), frame).selector_summary()
-    _summaries_equal(s1, s2, tol=0.0)
+    _summaries_equal(s1, stacked_run[0], tol=0.0)
     # not even one lane: the whole family keeps the per-fold loop
     monkeypatch.setenv("TRANSMOGRIFAI_SWEEP_HBM_BUDGET", "1")
     sweep_counters.reset()
@@ -434,18 +456,17 @@ def test_checkpoint_mid_family_group_resume(tmp_path, monkeypatch):
     assert len(names) == 2
 
 
-def test_tree_stacked_under_mesh(monkeypatch):
+def test_tree_stacked_under_mesh(monkeypatch, shared_frame, stacked_run):
     """The stacked (fold x lane) tree batch shards 2-D over an active
     mesh (rows on "data", folds on "model" when they divide it) and
     completes on the GSPMD scatter engine. Trees are discrete: sharded
     scatter+psum reduction order can flip near-tied splits, so the
     assertion is structural (mode, coverage, finite metrics) plus a
-    loose value check against the single-device stacked run."""
+    loose value check against the shared single-device stacked run."""
     from transmogrifai_tpu.parallel.mesh import make_mesh, use_mesh
-    frame = _frame(seed=13)
-    monkeypatch.setenv("TRANSMOGRIFAI_TREE_STACKED", "1")
-    s1 = _train(_tree_binary_selector(), frame).selector_summary()
-    monkeypatch.delenv("TRANSMOGRIFAI_TREE_STACKED")
+    frame = shared_frame
+    s1 = stacked_run[0]
+    monkeypatch.delenv("TRANSMOGRIFAI_TREE_STACKED", raising=False)
     ctx = make_mesh(n_data=4, n_model=2)
     with use_mesh(ctx):
         sweep_counters.reset()

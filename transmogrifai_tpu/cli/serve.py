@@ -158,6 +158,18 @@ def add_serve_args(sp: argparse.ArgumentParser) -> None:
                          "ahead of traffic each prewarm tick "
                          "(popularity EWMA ranking; 0 = no daemon, "
                          "the default)")
+    sp.add_argument("--precision",
+                    choices=("auto", "f32", "bf16", "int8"),
+                    default="f32",
+                    help="precision-ladder target (docs/SERVING.md "
+                         "'Precision ladder'): serving starts on the "
+                         "f32 master rung and PROMOTES to bf16/int8 "
+                         "only after the shadow gate proves the rung's "
+                         "scores within tolerance of f32 on live rows; "
+                         "'auto' climbs the whole ladder. Under memory "
+                         "pressure the active rung demotes (gate "
+                         "skipped, counted) BEFORE any padding bucket "
+                         "is shed. Default f32: ladder off")
     sp.add_argument("--resource-ladder", choices=("on", "off"),
                     default=None,
                     help="override the adaptive degradation ladder "
@@ -316,7 +328,8 @@ def run_serve(args: argparse.Namespace) -> int:
         metrics_port=args.metrics_port, metrics_host=args.metrics_host,
         access_log_sample=args.access_log_sample, slo=slo,
         explain=explaining,
-        explain_top_k=args.explain_top_k if explaining else 5)
+        explain_top_k=args.explain_top_k if explaining else 5,
+        precision=args.precision)
 
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     t0 = time.monotonic()
@@ -435,6 +448,7 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
             tenancy_kw["rate_per_s"] = args.tenant_rate or None
         if args.prewarm_top_k is not None:
             tenancy_kw["prewarm_top_k"] = args.prewarm_top_k
+        tenancy_kw["precision"] = args.precision
         tenancy = TenancyConfig(**tenancy_kw)
     fleet = FleetServer(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -443,7 +457,7 @@ def _run_serve_fleet(args: argparse.Namespace, slo=None) -> int:
         route_field=args.model_field,
         metrics_port=args.metrics_port, metrics_host=args.metrics_host,
         access_log_sample=args.access_log_sample, slo=slo,
-        tenancy=tenancy, **explain_kw)
+        tenancy=tenancy, precision=args.precision, **explain_kw)
     entries = fleet.register_dir(args.model_dir)
     if not entries:
         print(f"serve: no saved models (model.json) under "

@@ -33,6 +33,7 @@ import time
 from typing import Any, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 
 _PROFILE = os.environ.get("TRANSMOGRIFAI_PROFILE") == "1"
 
@@ -319,7 +320,7 @@ class DagExecutor:
         cached = self._fused_cache.get(key)
         if cached is not None:
             return cached
-        base = fuse_layer_program(dev_ts)
+        base = fuse_layer_program(dev_ts)  # precision-ok: training executor is f32 by contract
         compiled = lambda params, in_cols: base(params, {}, in_cols)  # noqa: E731
         self._fused_cache[key] = compiled
         return compiled
@@ -345,7 +346,7 @@ class DagExecutor:
         key = tuple(t.uid for t in stages)
         prog = self._fused_dag_cache.get(key)
         if prog is None:
-            base = fuse_dag_program(layers)
+            base = fuse_dag_program(layers)  # precision-ok: training executor is f32 by contract
             prog = lambda params, in_cols: base(params, {}, in_cols)  # noqa: E731
             self._fused_dag_cache[key] = prog
         params = {t.uid: t.device_params() for t in stages}
@@ -399,7 +400,8 @@ class DagExecutor:
         return data
 
 
-def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False):
+def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False,
+                       precision: str = "f32"):
     """One jitted XLA program applying every device transformer of a layer.
 
     Signature: ``fused(params, donate_cols, keep_cols) -> {out name: col}``
@@ -410,11 +412,12 @@ def fuse_layer_program(dev_ts: Sequence[Transformer], donate: bool = False):
     not touch a donated column afterwards. Batch scoring passes everything
     in ``keep_cols`` — columns live in the executor's PipelineData and are
     reread by later layers and host pulls."""
-    return fuse_dag_program([list(dev_ts)], donate=donate)
+    return fuse_dag_program([list(dev_ts)], donate=donate,
+                            precision=precision)
 
 
 def fuse_dag_program(layers: Sequence[Sequence[Transformer]],
-                     donate: bool = False):
+                     donate: bool = False, precision: str = "f32"):
     """One jitted XLA program applying a run of consecutive ALL-device DAG
     levels — the round-14 generalization of :func:`fuse_layer_program`
     (which is the single-level special case and shares this builder, so
@@ -425,11 +428,26 @@ def fuse_dag_program(layers: Sequence[Sequence[Transformer]],
     returned dict holds EVERY stage output across the fused levels.
     Level-to-level intermediates flow through the traced program directly:
     a later level's stage reads an earlier level's output column from the
-    in-program environment, never from HBM."""
+    in-program environment, never from HBM.
+
+    ``precision`` selects the ladder rung the program computes at. The
+    default ``"f32"`` rung traces exactly the pre-ladder program (no
+    casts staged out at all). Non-f32 rungs cast float input leaves and
+    per-stage float params to the rung's compute dtype in-trace
+    (``QuantizedTensor`` weights dequantize, ``ExactTensor`` leaves keep
+    their stored dtype) and cast float output leaves back to f32, so
+    callers always see f32 results regardless of rung."""
+    from transmogrifai_tpu.utils.precision import (
+        cast_float_leaves, compute_dtype, materialize_tree)
     layer_list = [list(layer) for layer in layers]
+    comp = compute_dtype(precision)
 
     def fused(params, donate_cols, keep_cols):
         env = {**donate_cols, **keep_cols}
+        if comp is not None:
+            env = cast_float_leaves(env, comp)
+            params = cast_float_leaves(params, comp)
+            params = materialize_tree(params, comp)
         out = {}
         for ts in layer_list:
             produced = {}
@@ -446,6 +464,8 @@ def fuse_dag_program(layers: Sequence[Sequence[Transformer]],
             # (within a level, stages are independent by construction)
             env.update(produced)
             out.update(produced)
+        if comp is not None:
+            out = cast_float_leaves(out, jnp.float32)
         return out
 
     return jax.jit(fused, donate_argnums=(1,) if donate else ())

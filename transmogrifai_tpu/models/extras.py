@@ -52,6 +52,13 @@ class NaiveBayesModel(PredictionModel):
         return (jnp.asarray(self.log_prior, jnp.float32),
                 jnp.asarray(self.log_theta, jnp.float32))
 
+    def quantize_device_params(self, precision):
+        if precision != "int8":
+            return None
+        from transmogrifai_tpu.utils.precision import quantize_weights
+        log_prior, log_theta = self.device_params()
+        return (log_prior, quantize_weights(log_theta))
+
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         log_prior, log_theta = params
         X = jnp.maximum(col.values, 0.0)  # multinomial NB needs counts
@@ -245,6 +252,12 @@ class MLPModel(PredictionModel):
     def device_params(self):
         return tuple((jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
                      for W, b in self.params)
+
+    def quantize_device_params(self, precision):
+        if precision != "int8":
+            return None
+        from transmogrifai_tpu.utils.precision import quantize_weights
+        return tuple((quantize_weights(W), b) for W, b in self.device_params())
 
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         h = col.values
@@ -535,6 +548,13 @@ class GLMModel(PredictionModel):
     def device_params(self):
         return (jnp.asarray(self.weights, jnp.float32),
                 jnp.asarray(self.intercept, jnp.float32))
+
+    def quantize_device_params(self, precision):
+        if precision != "int8":
+            return None
+        from transmogrifai_tpu.utils.precision import quantize_weights
+        W, b = self.device_params()
+        return (quantize_weights(W), b)
 
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         W, b = params

@@ -331,6 +331,13 @@ class LinearClassificationModel(PredictionModel):
         return (jnp.asarray(self.weights, jnp.float32),
                 jnp.asarray(self.intercept, jnp.float32))
 
+    def quantize_device_params(self, precision):
+        if precision != "int8":
+            return None
+        from transmogrifai_tpu.utils.precision import quantize_weights
+        W, b = self.device_params()
+        return (quantize_weights(W), b)
+
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         W, b = params
         z = col.values @ W + b
@@ -375,6 +382,13 @@ class LinearRegressionModel(PredictionModel):
     def device_params(self):
         return (jnp.asarray(self.weights, jnp.float32),
                 jnp.asarray(self.intercept, jnp.float32))
+
+    def quantize_device_params(self, precision):
+        if precision != "int8":
+            return None
+        from transmogrifai_tpu.utils.precision import quantize_weights
+        W, b = self.device_params()
+        return (quantize_weights(W), b)
 
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         W, b = params

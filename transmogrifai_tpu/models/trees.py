@@ -866,6 +866,20 @@ class TreeEnsembleModel(PredictionModel):
     def device_params(self):
         return (jnp.asarray(self.bin_edges), self.trees)
 
+    def quantize_device_params(self, precision):
+        from transmogrifai_tpu.utils.precision import ExactTensor, fits_int16
+        edges, (feats, bins, leaves) = self.device_params()
+        if precision == "int8" and all(fits_int16(a)
+                                       for a in (*feats, *bins)):
+            # node traversal compares binned int data: int16 vs int32
+            # promotes exactly, so the threshold path is bitwise-safe
+            feats = tuple(jnp.asarray(a, jnp.int16) for a in feats)
+            bins = tuple(jnp.asarray(a, jnp.int16) for a in bins)
+        # bin edges stay f32 master values at every rung (ExactTensor
+        # pins them through the builder's generic float cast); leaf
+        # values take the rung's activation dtype like any float param
+        return (ExactTensor(edges), (feats, bins, leaves))
+
     def device_apply(self, params, col: fr.VectorColumn) -> fr.PredictionColumn:
         edges, trees = params
         Xb = bin_data(col.values, edges)

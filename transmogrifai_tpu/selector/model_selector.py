@@ -160,6 +160,9 @@ class SelectedModel(PredictionModel):
     def device_params(self):
         return self.model.device_params()
 
+    def quantize_device_params(self, precision):
+        return self.model.quantize_device_params(precision)
+
     def device_apply(self, params, col):
         return self.model.device_apply(params, col)
 

@@ -41,9 +41,24 @@ from transmogrifai_tpu.dag import fuse_layer_program
 from transmogrifai_tpu.pipeline_data import PipelineData
 from transmogrifai_tpu.serving import wireformat as wf
 from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.precision import (
+    PRECISION_BYTE_FACTOR, normalize_precision, params_nbytes,
+)
 from transmogrifai_tpu.utils.profiling import ServingCounters
 
-__all__ = ["CompiledScorer", "UNKNOWN_TOKEN"]
+__all__ = ["CompiledScorer", "UNKNOWN_TOKEN", "rung_of_layer_key"]
+
+
+def rung_of_layer_key(lk) -> str:
+    """The precision rung a (private or shared) program-layer key belongs
+    to. Key shapes: ``li`` (int, f32 scoring) | ``(precision, li)``
+    (variant scoring) | ``("explain", li, chunk)`` (f32 explain) |
+    ``("explain", li, chunk, precision)`` (variant explain)."""
+    if not isinstance(lk, tuple):
+        return "f32"
+    if lk[:1] == ("explain",):
+        return lk[3] if len(lk) > 3 else "f32"
+    return lk[0]
 
 #: sentinel appended to every frozen serving vocab; never a fitted category,
 #: so downstream static tables route it to their OTHER/unseen slot
@@ -61,10 +76,10 @@ def _prediction_rows(col: fr.PredictionColumn, n: int) -> list[dict]:
     """Bulk ``PredictionColumn -> [{prediction, rawPrediction_i,
     probability_i}]`` matching ``ft.Prediction.make(...).value`` exactly."""
     def as_2d(a):
-        a = np.asarray(a, np.float64)
+        a = np.asarray(a, np.float64)  # precision-ok: post-program JSON boxing
         return a.reshape(a.shape[0], -1)[:n]
 
-    pred = np.asarray(col.prediction, np.float64)[:n].tolist()
+    pred = np.asarray(col.prediction, np.float64)[:n].tolist()  # precision-ok: post-program JSON boxing
     raw = as_2d(col.raw_prediction)
     prob = as_2d(col.probability)
     raw_keys = [f"{ft.Prediction.RawPredictionName}_{i}"
@@ -94,10 +109,17 @@ class CompiledScorer:
     def __init__(self, model, max_batch: int = 256, min_bucket: int = 8,
                  donate: Optional[bool] = None,
                  counters: Optional[ServingCounters] = None,
-                 program_cache=None, fingerprint: Optional[str] = None):
+                 program_cache=None, fingerprint: Optional[str] = None,
+                 precision: str = "f32"):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.model = model
+        #: ACTIVE precision-ladder rung — the rung steady-state dispatches
+        #: run at. The default f32 rung is byte-identical to the
+        #: pre-ladder scorer (keys, programs, params untouched). The
+        #: server owns rung transitions (gated promotion / pressure
+        #: demotion) via ``set_precision`` on its dispatcher thread.
+        self.precision = normalize_precision(precision)
         #: per-scorer compile/dispatch attribution: THIS scorer's snapshot
         #: must not include other servers' compiles
         self.counters = counters if counters is not None else \
@@ -152,7 +174,12 @@ class CompiledScorer:
             if f.is_response:
                 ftype = ft.nullable_base(ftype)
             self._raw.append((f.name, ftype))
-        self._programs: dict[int, Any] = {}
+        #: private fused programs: layer index ``li`` for the f32 rung
+        #: (pre-ladder key, unchanged), ``(precision, li)`` for variants
+        self._programs: dict[Any, Any] = {}
+        #: memoized per-(stage uid, rung) quantized/specialized params —
+        #: quantization is host-side work that must not run per dispatch
+        self._qparams: dict[tuple, Any] = {}
         #: warmup-only program cost analysis (utils/devicewatch.py):
         #: lowering re-traces on host, so it runs once per (layer,
         #: bucket) during warmup and NEVER on the steady-state path
@@ -229,6 +256,54 @@ class CompiledScorer:
             self.program_cache.evict_bucket(self.fingerprint, shed)
         return shed
 
+    def set_precision(self, precision: str) -> str:
+        """Switch the active ladder rung. Programs/params for the new rung
+        build lazily on the next dispatch (or eagerly if ``warmup`` warmed
+        the rung); the old rung's programs stay cached so a fallback to
+        f32 after a rejected promotion re-dispatches without a compile.
+        Returns the previous rung. Caller is the server's dispatcher
+        thread (the only mutator, like ``buckets``)."""
+        prev, self.precision = self.precision, normalize_precision(precision)
+        return prev
+
+    def evict_precision(self, precision: str) -> int:
+        """Drop every compiled entry of one rung (all buckets, scoring
+        AND explain) so its accounted HBM actually releases — the
+        demotion rung's analog of ``shed_largest_bucket``'s eviction.
+        Returns the number of entries evicted (shared cache) or private
+        programs dropped."""
+        precision = normalize_precision(precision)
+        if self.program_cache is not None:
+            return self.program_cache.evict_matching(
+                lambda k: isinstance(k, tuple) and len(k) == 3
+                and k[0] == self.fingerprint
+                and rung_of_layer_key(k[1]) == precision)
+        stale = [k for k in self._programs
+                 if rung_of_layer_key(k) == precision]
+        for k in stale:
+            self._programs.pop(k, None)
+        return len(stale)
+
+    def _params_for(self, dev_ts, precision: str) -> dict:
+        """Per-stage params pytree for a rung. The f32 master rung calls
+        ``device_params()`` fresh per dispatch, exactly like the
+        pre-ladder path. Non-f32 rungs memoize the (possibly quantized)
+        tree per (stage uid, rung): ``quantize_device_params`` does
+        host-side weight quantization that must not rerun per batch."""
+        if precision == "f32":
+            return {t.uid: t.device_params() for t in dev_ts}
+        params = {}
+        for t in dev_ts:
+            key = (t.uid, precision)
+            p = self._qparams.get(key)
+            if p is None and key not in self._qparams:
+                p = t.quantize_device_params(precision)
+                if p is None:
+                    p = t.device_params()
+                self._qparams[key] = p
+            params[t.uid] = p
+        return params
+
     # -- encoding ------------------------------------------------------------
     def _encode_text(self, name: str, col: fr.HostColumn) -> fr.CodesColumn:
         import jax.numpy as jnp
@@ -261,35 +336,47 @@ class CompiledScorer:
         return data.device_col(name)
 
     # -- scoring -------------------------------------------------------------
-    def warmup(self, row: dict, buckets: Optional[Sequence[int]] = None
-               ) -> list[int]:
-        """Dispatch one replicated batch per padding bucket so every fused
-        layer program is compiled before traffic arrives. Returns the
-        buckets warmed. Compiles triggered here attribute to the
-        ``serving.bucket_<n>`` site of the devicewatch compile telemetry,
-        and each (layer, bucket) program gets a one-time cost analysis
-        (FLOPs / bytes / HLO size) — warmup is the cold seam, so the
-        steady-state dispatch path pays nothing for either."""
+    def warmup(self, row: dict, buckets: Optional[Sequence[int]] = None,
+               precisions: Optional[Sequence[str]] = None) -> list[int]:
+        """Dispatch one replicated batch per padding bucket (per ladder
+        rung in ``precisions``, default the active rung only) so every
+        fused layer program is compiled before traffic arrives. Returns
+        the buckets warmed. Compiles triggered here attribute to the
+        ``serving.bucket_<n>`` site of the devicewatch compile telemetry
+        (non-f32 rungs suffix the rung name), and each (layer, bucket,
+        rung) program gets a one-time cost analysis (FLOPs / bytes / HLO
+        size) — warmup is the cold seam, so the steady-state dispatch
+        path pays nothing for either. Warming every rung a server may
+        promote/demote to is what makes rung transitions compile-free:
+        0 post-warmup compiles per (bucket, precision)."""
         from transmogrifai_tpu.utils.devicewatch import compile_telemetry
         warmed = []
         self._analyze_cold = True
         try:
-            for b in (buckets if buckets is not None else self.buckets):
-                with compile_telemetry.building(f"serving.bucket_{b}"):
-                    self.score_batch([dict(row)] * int(b))
-                warmed.append(int(b))
+            for p in (precisions if precisions is not None
+                      else (self.precision,)):
+                p = normalize_precision(p)
+                site_suffix = "" if p == "f32" else f"_{p}"
+                for b in (buckets if buckets is not None else self.buckets):
+                    with compile_telemetry.building(
+                            f"serving.bucket_{b}{site_suffix}"):
+                        self.score_batch([dict(row)] * int(b), precision=p)
+                    if int(b) not in warmed:
+                        warmed.append(int(b))
         finally:
             self._analyze_cold = False
         return warmed
 
-    def score_batch(self, rows: Sequence[dict]) -> list[dict]:
+    def score_batch(self, rows: Sequence[dict],
+                    precision: Optional[str] = None) -> list[dict]:
         rows = list(rows)
         if not rows:
             return []
         if len(rows) > self.max_batch:
             out: list[dict] = []
             for i in range(0, len(rows), self.max_batch):
-                out.extend(self.score_batch(rows[i:i + self.max_batch]))
+                out.extend(self.score_batch(rows[i:i + self.max_batch],
+                                            precision=precision))
             return out
         n = len(rows)
         bucket = self.bucket_for(n)
@@ -301,27 +388,31 @@ class CompiledScorer:
                     ftype, [r.get(name) for r in padded])
                 for name, ftype in self._raw}
         data = self._transform_counted(
-            PipelineData(fr.HostFrame(cols)), bucket)
+            PipelineData(fr.HostFrame(cols)), bucket, precision)
         return self._extract_rows(data, n)
 
-    def _transform_counted(self, data: PipelineData,
-                           bucket: int) -> PipelineData:
+    def _transform_counted(self, data: PipelineData, bucket: int,
+                           precision: Optional[str] = None) -> PipelineData:
         """``_transform`` plus per-dispatch compile accounting — shared
         by the row entry (``score_batch``) and the columnar entry
-        (``score_columns``)."""
+        (``score_columns``). ``precision=None`` dispatches at the active
+        rung; the server's promotion gate passes an explicit rung to
+        shadow-score a candidate without touching the live one."""
+        precision = self.precision if precision is None \
+            else normalize_precision(precision)
         if self.program_cache is not None:
             # shared-cache mode: one program per (fingerprint, layer,
             # bucket) key, so an insertion IS a compile (the entry's one
             # shape traces on first dispatch) — the cache attributes
             # insertions/evictions to this scorer's counters directly
-            data = self._transform(data, bucket)
+            data = self._transform(data, bucket, precision)
             self.counters.count(bucket, dispatches=1)
             return data
         # compile accounting via this scorer's OWN fused-program
         # jit-cache growth: exact and per-scorer (a process-global
         # compile listener would cross-attribute concurrent servers)
         before = self._program_cache_entries()
-        data = self._transform(data, bucket)
+        data = self._transform(data, bucket, precision)
         grew = self._program_cache_entries() - before
         self.counters.count(bucket, dispatches=1, compiles=grew)
         if grew:
@@ -330,7 +421,7 @@ class CompiledScorer:
             # symptom of a bucket/cache misconfiguration
             from transmogrifai_tpu.utils.events import events
             events.emit("serving.compile", bucket=bucket,
-                        programs=grew,
+                        programs=grew, precision=precision,
                         fingerprint=self.fingerprint)
         return data
 
@@ -384,11 +475,12 @@ class CompiledScorer:
             if not ftype.is_nullable and not mask.all():
                 raise ft.FeatureTypeValueError(
                     f"{ftype.__name__} column contains empty values")
-            if vals.dtype != np.float64:
-                vals = vals.astype(np.float64)
             if not mask.all():
-                # missing slots hold 0.0, matching _build_numeric
-                vals = np.where(mask, vals, 0.0)
+                # missing slots hold 0, matching _build_numeric — fill
+                # with the column's OWN dtype so a binary F32/I32 frame
+                # never pays a silent f64 upcast (2x host memory) here;
+                # the device path casts once, straight to f32
+                vals = np.where(mask, vals, vals.dtype.type(0))
             return fr.HostColumn(ftype, vals, mask)
         if kind in fr.TEXT_KINDS:
             if col.dtype != wf.TEXT:
@@ -406,11 +498,13 @@ class CompiledScorer:
                 raise wf.WireFormatError(
                     f"column {name!r}: geolocation rides as F64 "
                     "width=3 (lat, lon, accuracy)")
-            vals = np.asarray(col.values, dtype=np.float64)
+            # dtype-preserving: an F32 geolocation block stays f32 on the
+            # host (no silent 2x copy); F64 wire data keeps f64
+            vals = np.asarray(col.values)
             mask = np.ones(n, dtype=bool) if col.mask is None \
                 else np.asarray(col.mask, dtype=bool)
             if not mask.all():
-                vals = np.where(mask[:, None], vals, 0.0)
+                vals = np.where(mask[:, None], vals, vals.dtype.type(0))
             return fr.HostColumn(ftype, vals, mask)
         if kind == "vector":
             if col.dtype not in (wf.F32, wf.F64) \
@@ -442,7 +536,8 @@ class CompiledScorer:
             out[name] = fr.HostColumn(col.ftype, vals, mask, col.meta)
         return out
 
-    def score_columns(self, cols: dict, n: int) -> dict:
+    def score_columns(self, cols: dict, n: int,
+                      precision: Optional[str] = None) -> dict:
         """Columnar scoring entry: ``{name: HostColumn}`` (every raw
         feature the DAG reads, ``n`` rows each) -> ``{result name:
         ndarray | list}`` with prediction results flattened to dotted
@@ -459,7 +554,8 @@ class CompiledScorer:
                 j = min(i + self.max_batch, n)
                 part = self.score_columns(
                     {name: c.take(np.arange(i, j))
-                     for name, c in cols.items()}, j - i)
+                     for name, c in cols.items()}, j - i,
+                    precision=precision)
                 for k, v in part.items():
                     if k in merged:
                         merged[k] = np.concatenate([merged[k], v]) \
@@ -471,7 +567,7 @@ class CompiledScorer:
         bucket = self.bucket_for(n)
         data = self._transform_counted(
             PipelineData(fr.HostFrame(self._pad_cols(cols, n, bucket))),
-            bucket)
+            bucket, precision)
         return self._extract_columns(data, n)
 
     def _extract_columns(self, data: PipelineData, n: int) -> dict:
@@ -483,19 +579,19 @@ class CompiledScorer:
             dev = data.device.get(name)
             if isinstance(dev, fr.PredictionColumn):
                 out[f"{name}.{ft.Prediction.PredictionName}"] = \
-                    np.asarray(dev.prediction, np.float64)[:n]
+                    np.asarray(dev.prediction, np.float64)[:n]  # precision-ok: post-program reply columns
                 for label, block in (
                         (ft.Prediction.RawPredictionName,
                          dev.raw_prediction),
                         (ft.Prediction.ProbabilityName,
                          dev.probability)):
-                    arr = np.asarray(block, np.float64)
+                    arr = np.asarray(block, np.float64)  # precision-ok: post-program reply columns
                     arr = arr.reshape(arr.shape[0], -1)[:n]
                     for i in range(arr.shape[1]):
                         out[f"{name}.{label}_{i}"] = \
                             np.ascontiguousarray(arr[:, i])
             elif isinstance(dev, fr.VectorColumn):
-                out[name] = np.asarray(dev.values, np.float64)[:n]
+                out[name] = np.asarray(dev.values, np.float64)[:n]  # precision-ok: post-program reply columns
             else:
                 col = data.host_col(name)
                 vectorish = issubclass(ftype, ft.OPVector)
@@ -515,27 +611,40 @@ class CompiledScorer:
                 pass
         return total
 
-    def _program_for(self, li: int, dev_ts, bucket: int):
+    def _program_for(self, li: int, dev_ts, bucket: int,
+                     precision: str = "f32"):
         """The fused program for layer ``li`` at ``bucket`` — from the
         shared cross-model cache when one is attached (per-bucket program
         instances so the LRU can evict at (model, bucket) granularity),
         else this scorer's private per-layer dict (whose jit cache holds
-        every bucket's trace, bounded by construction)."""
+        every bucket's trace, bounded by construction).
+
+        The precision rung tags the key: f32 keeps the pre-ladder keys
+        byte-identical (``li`` private / ``(fp, li, bucket)`` shared);
+        non-f32 rungs fold the rung into the LAYER component —
+        ``(precision, li)`` private, ``(fp, (precision, li), bucket)``
+        shared — so every existing eviction predicate (``len(k) == 3``,
+        ``k[0] == fp``, ``k[2] == bucket``) covers variant entries with
+        no change."""
+        lk = li if precision == "f32" else (precision, li)
         if self.program_cache is None:
-            program = self._programs.get(li)
+            program = self._programs.get(lk)
             if program is None:
-                program = fuse_layer_program(dev_ts, donate=self.donate)
-                self._programs[li] = program
+                program = fuse_layer_program(dev_ts, donate=self.donate,
+                                             precision=precision)
+                self._programs[lk] = program
             return program
         return self.program_cache.get(
-            (self.fingerprint, li, bucket),
-            lambda: fuse_layer_program(dev_ts, donate=self.donate),
+            (self.fingerprint, lk, bucket),
+            lambda: fuse_layer_program(dev_ts, donate=self.donate,
+                                       precision=precision),
             # thunk: the param-pytree walk only runs on a miss, not on
             # every steady-state dispatch
-            bytes_est=lambda: self.layer_entry_bytes(li, bucket),
+            bytes_est=lambda: self.layer_entry_bytes(li, bucket, precision),
             counters=self.counters, bucket=bucket)
 
-    def layer_entry_bytes(self, li: int, bucket: int) -> int:
+    def layer_entry_bytes(self, li: int, bucket: int,
+                          precision: str = "f32") -> int:
         """Coarse HBM estimate for one compiled (layer, bucket) entry:
         the padded per-batch IO buffers (inputs + outputs x bucket rows x
         8B) plus the layer's fitted parameters AMORTIZED over this
@@ -545,19 +654,25 @@ class CompiledScorer:
         the shared cache's LRU into needless evict/recompile churn. The
         serving generalization of the sweep's ``tree_stack_bytes``
         guard; an ESTIMATE by design (vector widths are unknown before
-        trace) — a working-set bound, not an allocator."""
+        trace) — a working-set bound, not an allocator.
+
+        Non-f32 rungs scale by the rung's byte factor (bf16 halves the
+        in-program IO/activation footprint, int8 quarters the weight
+        payload) — the accounting that turns precision demotion into
+        real resident-model headroom at a fixed cache budget."""
         host_ts, dev_ts = self._layers[li]
         n_io = len({n for t in dev_ts for n in t.runtime_input_names()}) \
             + len(dev_ts)
-        import jax
         param_bytes = 0
         for t in dev_ts:
-            for leaf in jax.tree_util.tree_leaves(t.device_params()):
-                param_bytes += getattr(leaf, "nbytes", 8)
-        return n_io * int(bucket) * 8 \
+            param_bytes += params_nbytes(t.device_params())
+        raw = n_io * int(bucket) * 8 \
             + param_bytes // max(len(self.buckets), 1)
+        factor = PRECISION_BYTE_FACTOR.get(precision, 1.0)
+        return max(1, int(raw * factor))
 
-    def _transform(self, data: PipelineData, bucket: int) -> PipelineData:
+    def _transform(self, data: PipelineData, bucket: int,
+                   precision: str = "f32") -> PipelineData:
         for li, (host_ts, dev_ts) in enumerate(self._layers):
             if host_ts:
                 data = data.with_host_cols(
@@ -565,23 +680,25 @@ class CompiledScorer:
                      for t in host_ts})
             if not dev_ts:
                 continue
-            program = self._program_for(li, dev_ts, bucket)
-            params = {t.uid: t.device_params() for t in dev_ts}
+            program = self._program_for(li, dev_ts, bucket, precision)
+            params = self._params_for(dev_ts, precision)
             in_cols = {n: self._device_input(data, n)
                        for t in dev_ts for n in t.runtime_input_names()}
             spent = set(self._free_plan[li]) if self.donate else set()
             donate_cols = {n: c for n, c in in_cols.items() if n in spent}
             keep_cols = {n: c for n, c in in_cols.items() if n not in spent}
-            if self._analyze_cold and (li, bucket) not in self._analyzed:
+            if self._analyze_cold \
+                    and (li, bucket, precision) not in self._analyzed:
                 # warmup-only: lower (host retrace, no backend compile)
                 # and record FLOPs/bytes/HLO size BEFORE the dispatch —
                 # after it, donated buffers are dead
-                self._analyzed.add((li, bucket))
+                self._analyzed.add((li, bucket, precision))
                 from transmogrifai_tpu.utils.devicewatch import (
                     analyze_program, compile_telemetry,
                 )
+                suffix = "" if precision == "f32" else f".{precision}"
                 compile_telemetry.record_program_cost(
-                    f"serving.layer{li}.bucket{bucket}",
+                    f"serving.layer{li}.bucket{bucket}{suffix}",
                     analyze_program(program, params, donate_cols,
                                     keep_cols))
             outs = program(params, donate_cols, keep_cols)
@@ -611,7 +728,7 @@ class CompiledScorer:
                 per_col.append(_prediction_rows(dev, n))
             elif isinstance(dev, fr.VectorColumn):
                 per_col.append(
-                    np.asarray(dev.values, np.float64)[:n].tolist())
+                    np.asarray(dev.values, np.float64)[:n].tolist())  # precision-ok: post-program JSON boxing
             else:
                 col = data.host_col(name)
                 vectorish = issubclass(ftype, ft.OPVector)

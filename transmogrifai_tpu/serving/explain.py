@@ -41,6 +41,10 @@ import numpy as np
 from transmogrifai_tpu import frame as fr
 from transmogrifai_tpu.insights.loco import group_masks, loco_groups
 from transmogrifai_tpu.serving.compiled import CompiledScorer
+from transmogrifai_tpu.utils.precision import (
+    PRECISION_BYTE_FACTOR, cast_float_leaves, compute_dtype,
+    materialize_tree, normalize_precision,
+)
 
 __all__ = ["CompiledExplainer", "resolve_prediction_stage",
            "DEFAULT_MASK_CHUNK", "MASK_CHUNK_ENV"]
@@ -177,38 +181,55 @@ class CompiledExplainer(CompiledScorer):
         return self.mask_chunk
 
     # -- compiled explain program -------------------------------------------
-    def _explain_program_for(self, dev_ts, bucket: int, chunk: int):
-        factory = lambda: self._build_explain_program(dev_ts)  # noqa: E731
+    def _explain_program_for(self, dev_ts, bucket: int, chunk: int,
+                             precision: str = "f32"):
+        factory = lambda: self._build_explain_program(  # noqa: E731
+            dev_ts, precision)
+        # rung-tagged key, same scheme as the scoring layers: f32 keeps
+        # the pre-ladder 3-tuple layer component; variants append the
+        # rung LAST so ``k[1][2] == chunk`` (shrink_mask_chunk's
+        # predicate) keeps matching every rung's entries
+        ek = ("explain", self._pred_li, chunk) if precision == "f32" \
+            else ("explain", self._pred_li, chunk, precision)
         if self.program_cache is None:
-            key = ("explain", self._pred_li, chunk)
-            program = self._programs.get(key)
+            program = self._programs.get(ek)
             if program is None:
                 program = factory()
-                self._programs[key] = program
+                self._programs[ek] = program
             return program
         return self.program_cache.get(
-            (self.fingerprint, ("explain", self._pred_li, chunk), bucket),
+            (self.fingerprint, ek, bucket),
             factory,
-            bytes_est=lambda: self.explain_entry_bytes(bucket, chunk),
+            bytes_est=lambda: self.explain_entry_bytes(bucket, chunk,
+                                                       precision),
             counters=self.counters, bucket=bucket)
 
-    def explain_entry_bytes(self, bucket: int, chunk: int) -> int:
+    def explain_entry_bytes(self, bucket: int, chunk: int,
+                            precision: str = "f32") -> int:
         """Coarse HBM estimate for one compiled explain entry: the
         scoring layer's estimate plus the masked-input working set
         (``chunk`` masked ``[bucket, d]`` copies when XLA materializes
-        them) — an estimate by design, like every HBM guard here."""
+        them) — an estimate by design, like every HBM guard here. Non-f32
+        rungs scale the masked working set by the rung's byte factor
+        (masked copies are activations in the rung's compute dtype)."""
         d = self._vec_d if self._vec_d is not None else 0
-        return self.layer_entry_bytes(self._pred_li, bucket) \
-            + int(chunk) * int(bucket) * int(d) * 4
+        factor = PRECISION_BYTE_FACTOR.get(precision, 1.0)
+        return self.layer_entry_bytes(self._pred_li, bucket, precision) \
+            + max(1, int(int(chunk) * int(bucket) * int(d) * 4 * factor))
 
-    def _build_explain_program(self, dev_ts):
+    def _build_explain_program(self, dev_ts, precision: str = "f32"):
         """ONE jitted program: the prediction layer's forward pass (same
         outputs the plain path extracts) + the G masked re-scores of the
-        prediction model, chunked ``lax.map`` over an inner ``vmap``."""
+        prediction model, chunked ``lax.map`` over an inner ``vmap``.
+        Non-f32 rungs cast inputs/params/masks to the rung's compute
+        dtype in-trace and return f32 outputs/deltas, mirroring
+        ``dag.fuse_dag_program``."""
         import jax
+        import jax.numpy as jnp
 
         dev_ts = list(dev_ts)
         pstage, vec_name = self._pstage, self._vec_name
+        comp = compute_dtype(precision)
         from transmogrifai_tpu.utils.tracing import device_scope
 
         def score_of(out):
@@ -219,6 +240,11 @@ class CompiledExplainer(CompiledScorer):
 
         def fused(params, donate_cols, keep_cols, masks):
             env = {**donate_cols, **keep_cols}
+            if comp is not None:
+                env = cast_float_leaves(env, comp)
+                params = materialize_tree(
+                    cast_float_leaves(params, comp), comp)
+                masks = cast_float_leaves(masks, comp)
             produced = {}
             for t in dev_ts:
                 cols = [env[n] for n in t.runtime_input_names()]
@@ -236,30 +262,45 @@ class CompiledExplainer(CompiledScorer):
             with device_scope(f"loco[{pstage.uid}]"):
                 deltas = jax.lax.map(jax.vmap(one), masks)
             # [n_chunks, chunk, n] -> [G_pad, n]
-            return produced, deltas.reshape(-1, X.shape[0])
+            deltas = deltas.reshape(-1, X.shape[0])
+            if comp is not None:
+                produced = cast_float_leaves(produced, jnp.float32)
+                deltas = jnp.asarray(deltas, jnp.float32)
+            return produced, deltas
 
         return jax.jit(fused, donate_argnums=(1,) if self.donate else ())
 
     # -- explain dispatch ----------------------------------------------------
-    def warmup(self, row: dict, buckets: Optional[Sequence[int]] = None
-               ) -> list[int]:
+    def warmup(self, row: dict, buckets: Optional[Sequence[int]] = None,
+               precisions: Optional[Sequence[str]] = None) -> list[int]:
         """Pre-compile every padding bucket's EXPLAIN path (which also
-        warms/shares the plain layers' programs) before traffic."""
+        warms/shares the plain layers' programs) before traffic, per
+        ladder rung in ``precisions`` (default: the active rung)."""
         from transmogrifai_tpu.utils.devicewatch import compile_telemetry
         warmed = []
-        for b in (buckets if buckets is not None else self.buckets):
-            with compile_telemetry.building(f"serving.explain_bucket_{b}"):
-                self.explain_batch([dict(row)] * int(b))
-            warmed.append(int(b))
+        for p in (precisions if precisions is not None
+                  else (self.precision,)):
+            p = normalize_precision(p)
+            suffix = "" if p == "f32" else f"_{p}"
+            for b in (buckets if buckets is not None else self.buckets):
+                with compile_telemetry.building(
+                        f"serving.explain_bucket_{b}{suffix}"):
+                    self.explain_batch([dict(row)] * int(b), precision=p)
+                if int(b) not in warmed:
+                    warmed.append(int(b))
         return warmed
 
-    def explain_batch(self, rows: Sequence[dict],
-                      top_k=None) -> tuple[list[dict], list[list]]:
+    def explain_batch(self, rows: Sequence[dict], top_k=None,
+                      precision: Optional[str] = None
+                      ) -> tuple[list[dict], list[list]]:
         """Score + explain one batch. ``top_k``: None (the explainer's
-        default), an int for the whole batch, or a per-row list."""
+        default), an int for the whole batch, or a per-row list.
+        ``precision``: None dispatches at the active rung."""
         rows = list(rows)
         if not rows:
             return [], []
+        precision = self.precision if precision is None \
+            else normalize_precision(precision)
         ks = self._per_row_ks(rows, top_k)
         if len(rows) > self.max_batch:
             docs: list[dict] = []
@@ -267,7 +308,7 @@ class CompiledExplainer(CompiledScorer):
             for i in range(0, len(rows), self.max_batch):
                 d_, e_ = self.explain_batch(
                     rows[i:i + self.max_batch],
-                    ks[i:i + self.max_batch])
+                    ks[i:i + self.max_batch], precision=precision)
                 docs.extend(d_)
                 exps.extend(e_)
             return docs, exps
@@ -280,17 +321,18 @@ class CompiledExplainer(CompiledScorer):
                 for name, ftype in self._raw}
         data = PipelineData(fr.HostFrame(cols))
         if self.program_cache is not None:
-            data, deltas = self._transform_explain(data, bucket)
+            data, deltas = self._transform_explain(data, bucket, precision)
             self.counters.count(bucket, dispatches=1)
         else:
             before = self._program_cache_entries()
-            data, deltas = self._transform_explain(data, bucket)
+            data, deltas = self._transform_explain(data, bucket, precision)
             grew = self._program_cache_entries() - before
             self.counters.count(bucket, dispatches=1, compiles=grew)
             if grew:
                 from transmogrifai_tpu.utils.events import events
                 events.emit("serving.compile", bucket=bucket,
                             programs=grew, lane="explain",
+                            precision=precision,
                             fingerprint=self.fingerprint)
         docs = self._extract_rows(data, n)
         exps = self._extract_explanations(deltas, n, ks)
@@ -303,7 +345,8 @@ class CompiledExplainer(CompiledScorer):
             return [top_k] * len(rows)
         return [self.top_k if k is None else int(k) for k in top_k]
 
-    def _transform_explain(self, data, bucket: int):
+    def _transform_explain(self, data, bucket: int,
+                           precision: str = "f32"):
         """The scorer's ``_transform`` with the prediction layer's
         program swapped for the fused forward+LOCO one. Returns
         ``(data, deltas[G, bucket] np.ndarray)``."""
@@ -320,17 +363,18 @@ class CompiledExplainer(CompiledScorer):
             spent = set(self._free_plan[li]) if self.donate else set()
             donate_cols = {n: c for n, c in in_cols.items() if n in spent}
             keep_cols = {n: c for n, c in in_cols.items() if n not in spent}
-            params = {t.uid: t.device_params() for t in dev_ts}
+            params = self._params_for(dev_ts, precision)
             if li == self._pred_li:
                 if self._groups is None:
                     self._resolve_groups(in_cols[self._vec_name])
                 chunk = self.effective_mask_chunk()
-                program = self._explain_program_for(dev_ts, bucket, chunk)
+                program = self._explain_program_for(dev_ts, bucket, chunk,
+                                                    precision)
                 outs, dd = program(params, donate_cols, keep_cols,
                                    self._chunked_masks(chunk))
                 deltas = np.asarray(dd)[:len(self._groups)]
             else:
-                program = self._program_for(li, dev_ts, bucket)
+                program = self._program_for(li, dev_ts, bucket, precision)
                 outs = program(params, donate_cols, keep_cols)
             for name in self._free_plan[li]:
                 data.device.pop(name, None)

@@ -441,10 +441,14 @@ class FleetServer:
             )
             cfg = TenancyConfig() if tenancy is True else tenancy
             self.tenancy = cfg
+            if getattr(cfg, "precision", "f32") != "f32" \
+                    and "precision" not in self._lane_kwargs:
+                self._lane_kwargs["precision"] = cfg.precision
             self.tenancy_store = TieredModelStore(
                 self.registry, self.program_cache,
                 ram_budget_bytes=cfg.ram_budget_bytes,
-                on_demote=self._demote_lane)
+                on_demote=self._demote_lane,
+                on_precision_demote=self._demote_fleet_precision)
             if cfg.rate_per_s:
                 self.admission = TenantAdmission(
                     cfg.rate_per_s, cfg.burst, weights=cfg.weights)
@@ -529,10 +533,16 @@ class FleetServer:
             row = dict(recent[-1])
         kw = {k: v for k, v in self._lane_kwargs.items()
               if k in ("max_batch", "min_bucket", "donate")}
+        # precision-ladder fleets prewarm EVERY rung the lanes may
+        # promote/demote to: a post-swap rung transition must be a pure
+        # cache hit, exactly like a post-swap score
+        from transmogrifai_tpu.utils.precision import ladder_for
+        rungs = ladder_for(self._lane_kwargs.get("precision", "f32"))
+        precisions = rungs if len(rungs) > 1 else None
         scorer = CompiledScorer(entry.model,
                                 program_cache=self.program_cache,
                                 fingerprint=entry.fingerprint, **kw)
-        warmed = scorer.warmup(row)
+        warmed = scorer.warmup(row, precisions=precisions)
         if self._lane_kwargs.get("explain"):
             # explain-enabled fleets prewarm the candidate's explain
             # programs too — a post-swap explain request must be a pure
@@ -544,7 +554,7 @@ class FleetServer:
                 top_k=int(self._lane_kwargs.get("explain_top_k", 5)),
                 mask_chunk=self._lane_kwargs.get("explain_mask_chunk"),
                 **kw)
-            explainer.warmup(row)
+            explainer.warmup(row, precisions=precisions)
         return warmed
 
     def _start_lane(self, entry: ModelEntry,
@@ -604,6 +614,26 @@ class FleetServer:
                         version=entry.version,
                         wallMs=round(wall * 1e3, 3))
             return lane
+
+    def _demote_fleet_precision(self) -> int:
+        """The fleet pressure path's PRECISION rung (the tier store's
+        ``on_precision_demote`` hook, called at the top of ``shed``):
+        demote every active lane one rung down its configured precision
+        ladder — each eviction of the demoted-from rung's programs
+        releases accounted HBM while every tenant keeps serving.
+        Returns the program-cache bytes released (0 when no lane had a
+        rung left to give — the store then COLD-pages as before)."""
+        before = self.program_cache.current_bytes
+        demoted = 0
+        for lane in self.active_lanes().values():
+            if lane.demote_precision() is not None:
+                demoted += 1
+        if not demoted:
+            return 0
+        freed = max(before - self.program_cache.current_bytes, 0)
+        events.emit("fleet.precision_demoted", lanes=demoted,
+                    bytesFreed=freed)
+        return freed
 
     def _demote_lane(self, entry: ModelEntry) -> None:
         """Tier-store demotion hook (called under the victim's page
@@ -914,7 +944,19 @@ class FleetServer:
                 doc["lineage"] = {"modelId": model_id,
                                   "version": version,
                                   "fingerprint": None}
+        # the rung the scores were computed at is part of lineage: an
+        # auditor replaying this reply must reproduce it at the SAME
+        # precision, not just the same fingerprint
+        doc["lineage"]["precision"] = self._lane_precision(model_id,
+                                                           version)
         return doc
+
+    def _lane_precision(self, model_id: str, version) -> Optional[str]:
+        """Active precision rung of the lane that scored — None when
+        its lane is already gone (swap/demotion race)."""
+        with self._lock:
+            lane = self._lanes.get((model_id, version))
+        return lane.scorer.precision if lane is not None else None
 
     def _submit_frame_routed(self, model_id: str, frame,
                              timeout_ms: Optional[float] = None,
@@ -958,6 +1000,8 @@ class FleetServer:
                 meta["lineage"] = {"modelId": model_id,
                                    "version": version,
                                    "fingerprint": None}
+        meta["lineage"]["precision"] = self._lane_precision(model_id,
+                                                            version)
         return meta
 
     def _http_frame(self, model_id: Optional[str], frame_bytes: bytes,

@@ -69,6 +69,14 @@ class ServingMetrics:
         self.recoveries = 0
         self.dispatch_retries = 0
         self.degraded_active = False
+        # precision ladder (PR 20): gated promotions, gate rejections,
+        # pressure-forced demotions; active rung mirrored as bits so the
+        # Prometheus gauge is numeric
+        self.precision_promotions = 0
+        self.precision_rejections = 0
+        self.precision_demotions = 0
+        self.precision_bits = 32
+        self.precision = "f32"
         # latency reservoir (seconds), newest max_samples
         self._latency: collections.deque = collections.deque(
             maxlen=max_samples)
@@ -164,6 +172,26 @@ class ServingMetrics:
         with self._lock:
             self.dispatch_retries += n
 
+    def record_precision(self, precision: str, *, promoted: bool = False,
+                         rejected: bool = False,
+                         demoted: bool = False) -> None:
+        """Precision-ladder lifecycle: ``promoted`` (gate accepted a
+        rung), ``rejected`` (gate refused — lane stays on its rung),
+        ``demoted`` (pressure forced a rung without the gate). The
+        active rung/bits always update to ``precision`` except on a
+        rejection, where the lane by definition did not move."""
+        from transmogrifai_tpu.utils.precision import PRECISION_BITS
+        with self._lock:
+            if promoted:
+                self.precision_promotions += 1
+            if rejected:
+                self.precision_rejections += 1
+            if demoted:
+                self.precision_demotions += 1
+            if not rejected:
+                self.precision = precision
+                self.precision_bits = PRECISION_BITS.get(precision, 32)
+
     # -- queries -------------------------------------------------------------
     def latency_percentiles_ms(self) -> dict:
         with self._lock:
@@ -254,6 +282,13 @@ class ServingMetrics:
                     "entries": self.degraded_entries,
                     "recoveries": self.recoveries,
                     "dispatchRetries": self.dispatch_retries,
+                },
+                "precision": {
+                    "active": self.precision,
+                    "bits": self.precision_bits,
+                    "promotions": self.precision_promotions,
+                    "rejections": self.precision_rejections,
+                    "demotions": self.precision_demotions,
                 },
             }
         doc["latencyMs"] = lat

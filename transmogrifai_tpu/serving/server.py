@@ -78,8 +78,27 @@ class ScoringServer:
                  slo=None, event_label: Optional[str] = None,
                  program_cache=None, fingerprint: Optional[str] = None,
                  explain: bool = False, explain_top_k: int = 5,
-                 explain_mask_chunk: Optional[int] = None):
+                 explain_mask_chunk: Optional[int] = None,
+                 precision: str = "f32",
+                 precision_tolerance: float = 5e-2,
+                 precision_backoff: int = 50):
+        from transmogrifai_tpu.utils.precision import ladder_for
         self.model = model
+        #: precision-ladder target (``"f32"`` | ``"bf16"`` | ``"int8"`` |
+        #: ``"auto"``). Serving always STARTS at the f32 master rung;
+        #: lower rungs are reached only through the per-model shadow gate
+        #: (promotion) or the resource ladder (forced demotion) — see
+        #: ``_precision_candidate`` / ``_shed_and_retry``
+        self.precision_target = str(precision)
+        self._ladder = ladder_for(precision)
+        #: max ``fleet.score_diff`` between the f32 reference and a
+        #: candidate rung's scores for the candidate to be promoted
+        self.precision_tolerance = float(precision_tolerance)
+        #: dispatches to wait after a gate rejection before re-trying the
+        #: candidate (NaN / out-of-tolerance rungs must not double every
+        #: batch's work retrying forever)
+        self.precision_backoff = int(precision_backoff)
+        self._precision_backoff_left = 0
         #: label stamped on this server's flight-recorder events (the
         #: fleet sets the model id; a standalone server has none)
         self.event_label = event_label
@@ -177,8 +196,14 @@ class ScoringServer:
         malformed one) must not keep the server from starting — buckets
         then compile lazily on first traffic."""
         if warmup_row is not None:
+            # warming EVERY rung of the configured ladder is what makes
+            # later promotions/demotions compile-free: rung transitions
+            # re-dispatch against already-traced programs (0 post-warmup
+            # compiles per (bucket, precision))
+            rungs = self._ladder if len(self._ladder) > 1 else None
             try:
-                self.scorer.warmup(warmup_row, buckets=warmup_buckets)
+                self.scorer.warmup(warmup_row, buckets=warmup_buckets,
+                                   precisions=rungs)
             except Exception as e:  # noqa: BLE001 — degrade to lazy compile
                 warnings.warn(
                     f"serving: warmup failed ({type(e).__name__}: "
@@ -187,7 +212,8 @@ class ScoringServer:
             if self.explainer is not None:
                 try:
                     self.explainer.warmup(warmup_row,
-                                          buckets=warmup_buckets)
+                                          buckets=warmup_buckets,
+                                          precisions=rungs)
                 except Exception as e:  # noqa: BLE001 — degrade to lazy compile
                     warnings.warn(
                         f"serving: explain warmup failed "
@@ -618,6 +644,9 @@ class ScoringServer:
             # path, anything else the degrade-to-row-path machinery —
             # inside attempt() so serving's own retry metrics see it
             fault_point("serving.dispatch")
+            cand = self._precision_candidate()
+            if cand is not None:
+                return self._gated_score(rows, cand)
             return self.scorer.score_batch(rows)
 
         # devicewatch: one ledger entry + one armed stall deadline per
@@ -639,6 +668,117 @@ class ScoringServer:
                 self.metrics.record_retry(attempts["n"] - 1)
         self._exit_degraded()
         return list(results)
+
+    # -- precision ladder (dispatcher thread) --------------------------------
+    def _precision_candidate(self) -> Optional[str]:
+        """The next rung of the configured ladder beyond the active one,
+        or None when there is nothing to promote to (ladder floor, or a
+        rejection backoff window is still open). Called once per compiled
+        dispatch attempt — the f32-only default returns None on the
+        first comparison, costing nothing."""
+        if len(self._ladder) <= 1:
+            return None
+        active = self.scorer.precision
+        try:
+            i = self._ladder.index(active)
+        except ValueError:
+            return None
+        if i + 1 >= len(self._ladder):
+            return None
+        if self._precision_backoff_left > 0:
+            self._precision_backoff_left -= 1
+            return None
+        return self._ladder[i + 1]
+
+    def _set_precision(self, precision: str) -> str:
+        """Flip the active rung on BOTH compiled lanes (the explain
+        lane's attributions must be computed at the precision the scores
+        were served at). Returns the previous rung."""
+        prev = self.scorer.set_precision(precision)
+        if self.explainer is not None:
+            self.explainer.set_precision(precision)
+        return prev
+
+    def _gated_score(self, rows: Sequence[dict], cand: str) -> list:
+        """The shadow gate: score the batch on the live f32 master lane,
+        shadow-score the SAME rows at the candidate rung, and promote
+        only when the max ``fleet.score_diff`` is within tolerance.
+        A rejected (or crashed, or NaN-scoring) candidate serves the f32
+        results BIT-IDENTICALLY — the gate can never cost a request — and
+        opens a ``precision_backoff``-dispatch window before retrying.
+        Harness errors surface (a chaos plan at ``serving.precision``
+        exercises exactly this rejection path via non-harness kinds)."""
+        from transmogrifai_tpu.serving.fleet import score_diff
+        from transmogrifai_tpu.utils.faults import (
+            FaultHarnessError, fault_point,
+        )
+        ref = self.scorer.score_batch(rows, precision="f32")
+        out = None
+        try:
+            fault_point("serving.precision")
+            out = self.scorer.score_batch(rows, precision=cand)
+            diff = max((score_diff(a, b) for a, b in zip(ref, out)),
+                       default=0.0)
+        except FaultHarnessError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a crashing candidate is a rejection
+            diff = float("inf")
+            events.emit("serving.precision_error", model=self.event_label,
+                        precision=cand,
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
+        if diff <= self.precision_tolerance and out is not None:
+            self._set_precision(cand)
+            self.metrics.record_precision(cand, promoted=True)
+            events.emit("serving.precision_promoted",
+                        model=self.event_label, precision=cand,
+                        scoreDiff=round(diff, 9),
+                        tolerance=self.precision_tolerance)
+            return out
+        self.metrics.record_precision(cand, rejected=True)
+        self._precision_backoff_left = self.precision_backoff
+        events.emit("serving.precision_rejected", model=self.event_label,
+                    precision=cand,
+                    scoreDiff=None if diff == float("inf")
+                    else round(diff, 9),
+                    tolerance=self.precision_tolerance,
+                    backoffDispatches=self.precision_backoff)
+        return ref
+
+    def demote_precision(self) -> Optional[str]:
+        """Force one precision-ladder demotion without an exception in
+        hand — the FLEET pressure path's entry point (the tier store's
+        shed prefers degrading every lane's quality one rung over
+        COLD-paging a tenant out). Returns the new rung or None at the
+        ladder floor."""
+        return self._demote_precision(None, 0)
+
+    def _demote_precision(self, err: Optional[BaseException],
+                          n_rows: int) -> Optional[str]:
+        """The resource ladder's precision rung — taken BEFORE any
+        bucket is shed: advance the active rung one step down the
+        configured ladder WITHOUT the shadow gate (pressure cannot wait
+        for a parity check), evict the demoted-from rung's programs so
+        their accounted HBM actually releases, and let the caller retry
+        the same batch. Returns the new rung, or None at the ladder
+        floor (then buckets shed as before)."""
+        from transmogrifai_tpu.utils.resources import record_degradation
+        active = self.scorer.precision
+        try:
+            i = self._ladder.index(active)
+        except ValueError:
+            return None
+        if i + 1 >= len(self._ladder):
+            return None
+        nxt = self._ladder[i + 1]
+        prev = self._set_precision(nxt)
+        freed = self.scorer.evict_precision(prev)
+        if self.explainer is not None:
+            freed += self.explainer.evict_precision(prev)
+        self.metrics.record_precision(nxt, demoted=True)
+        record_degradation(
+            "serving.dispatch", f"demote_precision_{nxt}", error=err,
+            model=self.event_label, rows=n_rows, evicted=freed)
+        return nxt
 
     def _exit_degraded(self) -> None:
         """A compiled-path success while degraded IS the recovery —
@@ -672,6 +812,29 @@ class ScoringServer:
         from transmogrifai_tpu.utils.tracing import span
         if not ladder_enabled() or not is_resource_exhausted(err):
             return None
+        # precision rung FIRST: a narrower rung keeps every padding
+        # bucket (full batch shapes, no re-splitting) while roughly
+        # halving the live working set — strictly gentler than shedding
+        # a bucket. Only when the ladder floor is reached (or the rung
+        # still OOMs) does bucket shedding start.
+        while True:
+            demoted = self._demote_precision(err, len(rows))
+            if demoted is None:
+                break
+            try:
+                with span("resource.degrade", site="serving.dispatch",
+                          rung=f"demote_precision_{demoted}",
+                          rows=len(rows)):
+                    return list(self.scorer.score_batch(rows))
+            except Exception as e:  # noqa: BLE001 — next rung / fall through to shed
+                from transmogrifai_tpu.utils.faults import (
+                    FaultHarnessError,
+                )
+                if isinstance(e, FaultHarnessError):
+                    raise
+                if not is_resource_exhausted(e):
+                    return None
+                err = e
         cache = self.scorer.program_cache
         if cache is not None:
             # fleet pressure rung: cold (fingerprint, layer, bucket)
@@ -845,6 +1008,12 @@ class ScoringServer:
             "retries": self.retries,
             "probeIntervalSeconds": self.probe_interval_s,
             "donate": self.scorer.donate,
+            "precision": {
+                "target": self.precision_target,
+                "active": self.scorer.precision,
+                "ladder": list(self._ladder),
+                "tolerance": self.precision_tolerance,
+            },
         }
         doc["degraded"]["active"] = self.degraded
         doc["state"] = self.state

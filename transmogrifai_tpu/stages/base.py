@@ -228,6 +228,16 @@ class DeviceTransformer(Transformer):
     def device_params(self) -> Any:
         return ()
 
+    def quantize_device_params(self, precision: str) -> Any:
+        """Precision-ladder hook: return a params pytree specialized for a
+        non-f32 rung, or ``None`` to use ``device_params()`` with the
+        builder's generic float cast. Stages with quantizable weight
+        payloads (linear/GLM/MLP/NB matmul weights, tree index/threshold
+        arrays) override this; returned trees may carry
+        ``QuantizedTensor``/``ExactTensor`` leaves which the fused program
+        materializes in-trace, so ``device_apply`` stays unchanged."""
+        return None
+
     def device_apply(self, params: Any, *cols: Any) -> Any:
         raise NotImplementedError
 

@@ -67,3 +67,9 @@ class TenancyConfig:
     #: prewarm this many hottest models per tick; 0 disables the daemon
     prewarm_top_k: int = 0
     prewarm_interval_s: float = 2.0
+    #: precision-ladder target for every lane (``"f32"`` | ``"bf16"`` |
+    #: ``"int8"`` | ``"auto"``): forwarded as the lanes' ``precision=``
+    #: unless the fleet was given one explicitly. Under RAM pressure the
+    #: store's ``shed`` demotes active lanes' precision FIRST — quality
+    #: degradation before any tenant loses residency
+    precision: str = "f32"

@@ -168,7 +168,8 @@ class TieredModelStore:
 
     def __init__(self, registry, program_cache=None, *,
                  ram_budget_bytes: Optional[int] = None,
-                 on_demote: Optional[Callable] = None):
+                 on_demote: Optional[Callable] = None,
+                 on_precision_demote: Optional[Callable] = None):
         if ram_budget_bytes is None:
             env = os.environ.get(RAM_BUDGET_ENV)
             ram_budget_bytes = int(float(env)) if env else None
@@ -178,6 +179,11 @@ class TieredModelStore:
         #: fleet hook, called (entry) under the victim's page lock
         #: BEFORE the model object drops — the lane stop + drain
         self.on_demote = on_demote
+        #: fleet hook, called () FIRST by ``shed``: demote active
+        #: lanes one precision rung (quality degradation, every tenant
+        #: keeps serving) before any tenant is COLD-paged out entirely;
+        #: returns the accounted bytes it released
+        self.on_precision_demote = on_precision_demote
         self.metrics = TierMetrics()
         self._lock = threading.Lock()
         #: (model_id, version) -> _Residency, LRU order (oldest first)
@@ -346,9 +352,26 @@ class TieredModelStore:
         released (never the newest — the model serving the request that
         tripped the pressure must survive). Records through the
         resource ladder under site ``tenancy.store``. Returns the bytes
-        freed."""
+        freed.
+
+        Precision demotion runs FIRST (the fleet's
+        ``on_precision_demote`` hook): every active lane drops one rung
+        of its precision ladder, releasing the demoted-from rung's
+        compiled programs while every tenant KEEPS SERVING — only the
+        shortfall COLD-pages residents out."""
         victims: list = []
         freed = 0
+        if self.on_precision_demote is not None:
+            freed = int(self.on_precision_demote() or 0)
+            if freed:
+                from transmogrifai_tpu.utils.resources import (
+                    record_degradation,
+                )
+                record_degradation("tenancy.store", "demote_precision",
+                                   bytesFreed=freed)
+            if freed >= bytes_to_free:
+                self.metrics.note_shed()
+                return freed
         with self._lock:
             for vkey in list(self._resident):
                 if freed >= bytes_to_free or len(self._resident) <= 1:

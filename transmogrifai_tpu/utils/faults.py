@@ -30,6 +30,11 @@ Instrumented sites (grep ``fault_point(`` for the authoritative list):
 ``serving.dispatch``      one compiled serving batch dispatch
 ``serving.explain``       one compiled explain-lane batch dispatch (OOM
                           here takes the mask-chunk-halving ladder rung)
+``serving.precision``     the precision shadow gate's candidate scoring
+                          (between the f32 reference and the candidate
+                          rung) — any non-harness kind here forces a
+                          counted gate REJECTION: the batch serves the
+                          f32 results bit-identically, never degrades
 ``serving.swap``          mid-fleet-hot-swap (candidate warm, alias not
                           yet flipped — the abort path must leave the old
                           version serving with zero drops)
@@ -112,7 +117,8 @@ KNOWN_SITES = frozenset({
     "dag.apply_layer", "sweep.fit", "selector.refit", "train.layer",
     "ingest.read", "ingest.fuse", "ingest.prefetch",
     "checkpoint.write", "collective", "serving.dispatch",
-    "serving.explain", "serving.swap", "continuous.ingest",
+    "serving.explain", "serving.precision", "serving.swap",
+    "continuous.ingest",
     "continuous.trigger",
     "continuous.retrain", "continuous.promote", "events.spill",
     "scaleout.route", "scaleout.heartbeat", "scaleout.roll",

@@ -1,0 +1,230 @@
+"""Precision-ladder primitives for compiled serving and explain programs.
+
+The ladder has three rungs, widest first::
+
+    f32  ──gate──▶  bf16  ──gate──▶  int8
+
+* **f32** is the master format: fitted parameters are always stored f32
+  and the default serving path is byte-identical to the pre-ladder code.
+* **bf16** is an *activation* variant: inside the traced program the
+  input environment and the per-stage float parameters are cast to
+  bfloat16, matmuls/accumulations run in bf16, and every float output
+  leaf is cast back to f32 before leaving the program. Parameters on the
+  host stay f32 (master weights) — the cast happens in-trace.
+* **int8** keeps the bf16 activation scheme and additionally swaps
+  stage weights for :class:`QuantizedTensor` (int8 payload +
+  per-output-channel f32 scale) where a stage opts in via
+  ``quantize_device_params`` — linear/GLM/MLP/NB matmul weights, and
+  exact int16 index/threshold arrays for tree ensembles (integer
+  comparisons are bitwise-safe, so the tree *structure* path is exact).
+
+Advancing a rung is either a gated **promotion** (shadow-scored against
+the live f32 lane, ``score_diff`` tolerance as the acceptance test) or a
+pressure-forced **demotion** (the resource-ladder rung above
+bucket-shedding). Both move toward fewer bits; only the gate proves
+parity.
+
+Leaf wrappers (:class:`QuantizedTensor`, :class:`ExactTensor`) are
+registered pytrees so they flow through ``jax.jit`` argument flattening
+unchanged; :func:`materialize_tree` turns them back into plain arrays
+inside the trace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Ladder rungs in order, widest (master) first.
+PRECISIONS = ("f32", "bf16", "int8")
+
+#: Logical bits per rung — exported as the per-lane precision gauge.
+PRECISION_BITS = {"f32": 32, "bf16": 16, "int8": 8}
+
+#: Resident-bytes factor vs f32 used by ``ProgramCache`` HBM accounting
+#: (``layer_entry_bytes``): bf16 halves IO/param bytes, int8 quarters
+#: the dominant weight payload.
+PRECISION_BYTE_FACTOR = {"f32": 1.0, "bf16": 0.5, "int8": 0.25}
+
+#: Accepted spellings for the precision knobs (CLI / config). ``auto``
+#: means "the full ladder, promote stepwise as far as the gate allows".
+PRECISION_CHOICES = ("auto",) + PRECISIONS
+
+
+def normalize_precision(precision: Optional[str]) -> str:
+    """Validate and canonicalize a concrete rung name (not ``auto``)."""
+    p = "f32" if precision is None else str(precision).lower()
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {precision!r}: expected one of {PRECISIONS}")
+    return p
+
+
+def ladder_for(target: Optional[str]) -> tuple[str, ...]:
+    """The rung sequence a server configured with ``target`` walks,
+    starting at the f32 master rung. ``auto`` walks the whole ladder."""
+    t = "f32" if target is None else str(target).lower()
+    if t == "auto":
+        return PRECISIONS
+    p = normalize_precision(t)
+    return PRECISIONS[:PRECISIONS.index(p) + 1]
+
+
+def compute_dtype(precision: str):
+    """In-trace compute dtype for a rung — ``None`` for f32 (the builder
+    must not touch anything on the master rung)."""
+    p = normalize_precision(precision)
+    if p == "f32":
+        return None
+    return jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# Leaf wrappers
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 weight payload + per-output-channel f32 scale.
+
+    ``q`` holds round-to-nearest int8 codes, ``scale`` the per-last-axis
+    f32 scales (a scalar for 1-D weights). ``materialize(dtype)``
+    dequantizes in-trace: ``q * scale`` cast to the rung's compute
+    dtype, so stage ``device_apply`` methods stay unchanged.
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def materialize(self, dtype=jnp.float32):
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(getattr(self.q, "nbytes", 0)) + int(
+            getattr(self.scale, "nbytes", 0))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"QuantizedTensor(q={getattr(self.q, 'shape', ())}, " \
+               f"scale={getattr(self.scale, 'shape', ())})"
+
+
+@jax.tree_util.register_pytree_node_class
+class ExactTensor:
+    """A parameter leaf pinned to its stored dtype at EVERY rung.
+
+    Tree-ensemble bin edges ride in one of these: binning must compare
+    f32 inputs against f32 edges bit-exactly or the int-threshold claim
+    of the int8 tree path evaporates. ``cast_float_leaves`` skips these;
+    ``materialize`` unwraps to the untouched array.
+    """
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def tree_flatten(self):
+        return (self.value,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def materialize(self, dtype=None):
+        return self.value
+
+    @property
+    def nbytes(self) -> int:
+        return int(getattr(self.value, "nbytes", 0))
+
+
+def _is_wrapper(x: Any) -> bool:
+    return isinstance(x, (QuantizedTensor, ExactTensor))
+
+
+# ---------------------------------------------------------------------------
+# Tree helpers (used INSIDE traced programs)
+# ---------------------------------------------------------------------------
+
+def _is_float_leaf(x: Any) -> bool:
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        return False
+    try:
+        return bool(jnp.issubdtype(dt, jnp.floating))
+    except TypeError:  # pragma: no cover - exotic non-array leaf
+        return False
+
+
+def cast_float_leaves(tree: Any, dtype) -> Any:
+    """Cast every floating leaf of ``tree`` to ``dtype``; integer, bool
+    and wrapped (:class:`QuantizedTensor`/:class:`ExactTensor`) leaves
+    pass through untouched."""
+    def cast(x):
+        if _is_wrapper(x) or not _is_float_leaf(x):
+            return x
+        return jnp.asarray(x, dtype)
+    return jax.tree_util.tree_map(cast, tree, is_leaf=_is_wrapper)
+
+
+def materialize_tree(tree: Any, dtype) -> Any:
+    """Unwrap precision leaf wrappers: quantized leaves dequantize to
+    ``dtype``, exact leaves keep their stored dtype, everything else is
+    returned as-is."""
+    def mat(x):
+        if _is_wrapper(x):
+            return x.materialize(dtype)
+        return x
+    return jax.tree_util.tree_map(mat, tree, is_leaf=_is_wrapper)
+
+
+def params_nbytes(tree: Any) -> int:
+    """Resident bytes of a (possibly wrapped) parameter tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree, is_leaf=_is_wrapper):
+        total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Host-side quantization (fit once per (stage, rung), memoized by callers)
+# ---------------------------------------------------------------------------
+
+def quantize_weights(w) -> QuantizedTensor:
+    """Symmetric round-to-nearest int8 quantization with per-output-channel
+    (last axis) f32 scales; 1-D weights get a single scalar scale.
+
+    The scale is ``amax / 127`` with a zero-column guard, so all-zero
+    channels quantize to exact zeros instead of NaN.
+    """
+    w = np.asarray(w, dtype=np.float32)
+    if w.ndim >= 2:
+        amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    else:
+        amax = np.max(np.abs(w)) if w.size else np.float32(0.0)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return QuantizedTensor(jnp.asarray(q), jnp.asarray(scale, jnp.float32))
+
+
+def fits_int16(arr) -> bool:
+    """True when an integer array's values survive an int16 cast exactly."""
+    a = np.asarray(arr)
+    if a.size == 0:
+        return True
+    return bool(a.min() >= np.iinfo(np.int16).min
+                and a.max() <= np.iinfo(np.int16).max)

@@ -630,6 +630,26 @@ def _serving_collectors(reg: PromRegistry, lanes_fn) -> None:
             return out
         return collect
 
+    # precision-ladder lifecycle: the counters carry the bare
+    # transmogrifai_precision_ prefix — the ladder is ONE surface
+    # whether a lane or a fleet runs it — and the bits gauge rides the
+    # serving namespace per lane (32 = f32 master, 16 = bf16, 8 = int8)
+    for attr, name, help_ in (
+            ("precision_promotions", "promotions",
+             "precision-rung promotions accepted by the shadow gate "
+             "(candidate within score-diff tolerance of f32)"),
+            ("precision_rejections", "rejections",
+             "candidate rungs rejected by the shadow gate (the batch "
+             "served the f32 scores bit-identically)"),
+            ("precision_demotions", "demotions",
+             "gate-skipping precision demotions forced by resource "
+             "pressure")):
+        reg.register(f"transmogrifai_precision_{name}_total", "counter",
+                     help_, per_lane(attr))
+    reg.register(
+        "transmogrifai_serving_precision_bits", "gauge",
+        "active precision-rung width in bits per lane",
+        per_lane("precision_bits"))
     reg.register("transmogrifai_serving_compiles_total", "counter",
                  "fused-program compiles per padding bucket",
                  per_bucket("compiles"))
@@ -752,7 +772,9 @@ _ROLLUP_SUM_ATTRS = frozenset({
     "admitted", "completed", "failed", "expired", "batches",
     "degraded_batches", "data_error_batches", "batch_rows",
     "degraded_entries", "recoveries", "dispatch_retries",
-    "batch_wall_s", "rejected_backpressure", "rejected_invalid"})
+    "batch_wall_s", "rejected_backpressure", "rejected_invalid",
+    "precision_promotions", "precision_rejections",
+    "precision_demotions"})
 
 
 class _ServingRollup:
@@ -776,6 +798,12 @@ class _ServingRollup:
     @property
     def degraded_active(self):
         return int(any(m.degraded_active for m in self._members))
+
+    @property
+    def precision_bits(self):
+        # the honest aggregate is the WORST (narrowest) rung: a single
+        # demoted tail lane must show through the rollup
+        return min((m.precision_bits for m in self._members), default=32)
 
     @property
     def queue_capacity(self):
